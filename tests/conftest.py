@@ -1,0 +1,16 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's approach of simulating devices it doesn't have
+(reference: internal/mining/workers.go:557-620 simulates GPU batches on CPU);
+we simulate a TPU pod slice with XLA host devices so sharding/collective code
+paths compile and execute in CI without TPU hardware.
+
+Must set env vars before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

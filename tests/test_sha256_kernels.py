@@ -1,0 +1,116 @@
+"""sha256 / sha256d kernel correctness vs hashlib (the ground truth)."""
+
+import hashlib
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from otedama_tpu.kernels import target as tgt
+from otedama_tpu.kernels import sha256_jax as sj
+from otedama_tpu.utils import sha256_host as sh
+
+
+def _random_header(rng: np.random.Generator) -> bytes:
+    return rng.bytes(80)
+
+
+def test_host_compress_matches_hashlib():
+    rng = np.random.default_rng(0)
+    for ln in (0, 1, 55, 56, 63, 64, 65, 119, 120, 128, 1000):
+        data = rng.bytes(ln)
+        # pad + compress manually
+        bitlen = ln * 8
+        padded = data + b"\x80" + b"\x00" * ((56 - ln - 1) % 64) + struct.pack(">Q", bitlen)
+        state = sh.SHA256_IV
+        for off in range(0, len(padded), 64):
+            state = sh.sha256_compress(state, padded[off : off + 64])
+        digest = b"".join(struct.pack(">I", s) for s in state)
+        assert digest == hashlib.sha256(data).digest(), f"len={ln}"
+
+
+def test_jax_sha256_matches_hashlib():
+    rng = np.random.default_rng(1)
+    for ln in (0, 3, 55, 56, 64, 80, 100, 256):
+        data = rng.bytes(ln)
+        assert sj.sha256_bytes_jax(data) == hashlib.sha256(data).digest(), f"len={ln}"
+
+
+def test_midstate_path_matches_full_hash():
+    rng = np.random.default_rng(2)
+    header = bytearray(_random_header(rng))
+    ms = sh.midstate(bytes(header[:64]))
+    tail = struct.unpack(">3I", bytes(header[64:76]))
+
+    nonces = np.array([0, 1, 0xDEADBEEF, 0xFFFFFFFF, 12345], dtype=np.uint32)
+    d = sj.sha256d_from_midstate(ms, tail, nonces)
+    d_np = np.stack([np.asarray(x) for x in d])  # [8, N]
+
+    for i, nonce in enumerate(nonces.tolist()):
+        h = bytearray(header)
+        h[76:80] = struct.pack(">I", nonce)
+        expect = sh.sha256d(bytes(h))
+        got = b"".join(struct.pack(">I", int(d_np[w, i])) for w in range(8))
+        assert got == expect, f"nonce={nonce:#x}"
+
+
+def test_compare_order_and_le256():
+    rng = np.random.default_rng(3)
+    header = bytearray(_random_header(rng))
+    ms = sh.midstate(bytes(header[:64]))
+    tail = struct.unpack(">3I", bytes(header[64:76]))
+    nonces = np.arange(0, 4096, dtype=np.uint32)
+
+    d = sj.sha256d_from_midstate(ms, tail, nonces)
+    h = sj.digest_words_to_compare_order(d)
+    h_np = np.stack([np.asarray(x) for x in h])
+
+    # pick a target that splits the batch: the median hash value
+    values = []
+    for i in range(len(nonces)):
+        hdr = bytearray(header)
+        hdr[76:80] = struct.pack(">I", int(nonces[i]))
+        values.append(int.from_bytes(sh.sha256d(bytes(hdr)), "little"))
+    target = sorted(values)[len(values) // 2]
+
+    limbs = tgt.target_to_limbs(target)
+    hits = np.asarray(sj.le256(h, tuple(limbs.tolist())))
+    expect_hits = np.array([v <= target for v in values])
+    np.testing.assert_array_equal(hits, expect_hits)
+
+    # hash_hi is the most significant limb of the little-endian hash value
+    for i in range(0, len(nonces), 517):
+        assert int(h_np[0, i]) == values[i] >> 224
+
+
+def test_sha256d_search_finds_known_share():
+    # deterministic easy-difficulty search: target with 2^-8 selectivity
+    header = bytearray(b"\x01" * 80)
+    ms = sh.midstate(bytes(header[:64]))
+    tail = struct.unpack(">3I", bytes(header[64:76]))
+    target = tgt.MAX_TARGET >> 8
+    limbs = tgt.target_to_limbs(target)
+
+    nonces = np.arange(0, 8192, dtype=np.uint32)
+    hits, hash_hi = sj.sha256d_search(ms, tail, nonces, limbs)
+    hits = np.asarray(hits)
+    assert hits.sum() > 0, "expected ~32 hits at 2^-8 selectivity over 8192 nonces"
+
+    for nonce in nonces[hits][:4].tolist():
+        hdr = bytearray(header)
+        hdr[76:80] = struct.pack(">I", nonce)
+        assert tgt.hash_meets_target(sh.sha256d(bytes(hdr)), target)
+
+
+def test_target_roundtrips():
+    assert tgt.bits_to_target(0x1D00FFFF) == tgt.DIFF1_TARGET
+    assert tgt.target_to_bits(tgt.DIFF1_TARGET) == 0x1D00FFFF
+    assert tgt.difficulty_to_target(1) == tgt.DIFF1_TARGET
+    assert tgt.difficulty_to_target(2) == tgt.DIFF1_TARGET // 2
+    # fractional difficulty: 0.5 doubles the target
+    assert abs(tgt.difficulty_to_target(0.5) - tgt.DIFF1_TARGET * 2) <= 1
+    t = tgt.difficulty_to_target(4096)
+    np.testing.assert_array_equal(tgt.target_to_limbs(t), tgt.target_to_limbs(tgt.limbs_to_target(tgt.target_to_limbs(t))))
+    # genesis-block difficulty checks
+    assert tgt.target_to_difficulty(tgt.DIFF1_TARGET) == 1.0
