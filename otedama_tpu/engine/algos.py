@@ -1,0 +1,119 @@
+"""Algorithm registry with capability flags.
+
+Reference parity: internal/mining/multi_algorithm.go:22-40 (global registry
+keyed by name), algorithm_simple_impls.go (name-registered entries), and the
+15 algorithm name constants of types.go:11-27. Redesigned: an entry declares
+*which execution backends actually implement it* (pallas-tpu / xla /
+native-cpu) instead of the reference's pattern of registering stub engines
+that silently fall back to sha256 (reference: multi_algorithm.go:155-160
+"simplified" ethash) — asking for an unimplemented (algorithm, backend)
+pair here is a loud error.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+# planning-assumption hashrates (H/s) for profitability estimates when no
+# measured rate exists yet — the reference hard-codes similar numbers
+# (internal/mining/engine.go:1092-1104); ours are per-v5e-chip estimates.
+_PLANNING = {
+    "sha256d": 5.0e8,
+    "sha256": 1.0e9,
+    "scrypt": 2.0e5,
+    "x11": 5.0e7,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class AlgorithmSpec:
+    name: str
+    aliases: tuple[str, ...] = ()
+    header_size: int = 80
+    nonce_offset: int = 76
+    backends: tuple[str, ...] = ()      # implemented search backends
+    memory_hard: bool = False           # scrypt-family (VMEM/HBM scratch)
+    chained: int = 1                    # number of chained hash rounds (x11=11)
+    planning_hashrate: float = 0.0      # H/s per chip, pre-measurement
+    # hook: (header76, target) -> runtime JobConstants; None = sha256d scheme
+    constants_builder: Callable | None = None
+
+    def implemented(self) -> bool:
+        return bool(self.backends)
+
+
+_REGISTRY: dict[str, AlgorithmSpec] = {}
+
+
+def register(spec: AlgorithmSpec) -> AlgorithmSpec:
+    _REGISTRY[spec.name] = spec
+    for alias in spec.aliases:
+        _REGISTRY[alias] = spec
+    return spec
+
+
+def get(name: str) -> AlgorithmSpec:
+    try:
+        return _REGISTRY[name.lower()]
+    except KeyError:
+        raise KeyError(
+            f"unknown algorithm {name!r}; known: {sorted(set(s.name for s in _REGISTRY.values()))}"
+        ) from None
+
+
+def names(implemented_only: bool = False) -> list[str]:
+    out = {s.name: s for s in _REGISTRY.values()}
+    return sorted(
+        n for n, s in out.items() if s.implemented() or not implemented_only
+    )
+
+
+def supports(name: str, backend: str) -> bool:
+    try:
+        return backend in get(name).backends
+    except KeyError:
+        return False
+
+
+# --- the algorithm surface of the reference (types.go:11-27), with honest
+# capability flags: implemented ones carry backends, planned ones don't. ---
+
+register(AlgorithmSpec(
+    name="sha256d",
+    aliases=("sha256double", "bitcoin"),
+    backends=("pallas-tpu", "xla", "native-cpu"),
+    planning_hashrate=_PLANNING["sha256d"],
+))
+register(AlgorithmSpec(
+    name="sha256",
+    backends=("xla", "native-cpu"),
+    planning_hashrate=_PLANNING["sha256"],
+))
+register(AlgorithmSpec(
+    name="scrypt",
+    aliases=("litecoin",),
+    memory_hard=True,
+    backends=(),  # filled in by kernels.scrypt import-time registration
+    planning_hashrate=_PLANNING["scrypt"],
+))
+register(AlgorithmSpec(
+    name="x11",
+    aliases=("dash",),
+    chained=11,
+    backends=(),  # filled in by kernels.x11 import-time registration
+    planning_hashrate=_PLANNING["x11"],
+))
+# declared by the reference but unimplemented there too (stub registrations,
+# reference: algorithm_simple_impls.go:84-101) — declared here for parity,
+# loudly unimplemented:
+for _name in ("ethash", "etchash", "randomx", "kawpow", "autolykos2",
+              "kheavyhash", "blake3", "equihash", "cuckatoo32", "x16r"):
+    register(AlgorithmSpec(name=_name))
+
+
+def mark_implemented(name: str, backend: str) -> None:
+    """Kernel modules call this when they load successfully."""
+    spec = get(name)
+    if backend not in spec.backends:
+        register(dataclasses.replace(spec, backends=spec.backends + (backend,)))

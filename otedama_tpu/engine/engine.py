@@ -1,0 +1,196 @@
+"""The mining engine: async orchestration of device search.
+
+Reference parity: internal/mining/engine.go — job channel -> workers ->
+share channel -> submit (goroutines jobProcessor/shareProcessor/statsUpdater,
+engine.go:319-341). TPU-native redesign: goroutine-per-worker becomes one
+async searcher per device *backend* (a backend may itself be a whole pod via
+``runtime.mesh.PodSearch``), because device parallelism lives inside the
+compiled XLA program, not in host threads. The host loop's only jobs are to
+keep the device fed, roll extranonce spaces, and pump found shares to the
+submit callback.
+
+Flow per device task:
+  current job -> (extranonce2, ntime) -> JobConstants (host midstate) ->
+  backend.search(batch) in a worker thread -> winners -> Share -> on_share
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import time
+from typing import Awaitable, Callable, Protocol
+
+from otedama_tpu.engine import algos
+from otedama_tpu.engine.jobs import job_constants
+from otedama_tpu.engine.types import (
+    DeviceStats,
+    EngineState,
+    EngineStats,
+    Job,
+    Share,
+)
+from otedama_tpu.kernels import target as tgt
+from otedama_tpu.runtime.partition import ExtranonceCounter, NonceRange
+from otedama_tpu.runtime.search import JobConstants, SearchResult
+
+log = logging.getLogger("otedama.engine")
+
+ShareCallback = Callable[[Share], Awaitable[None]]
+
+
+class SearchBackendProtocol(Protocol):
+    name: str
+
+    def search(self, jc: JobConstants, base: int, count: int) -> SearchResult: ...
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    worker_name: str = "otedama-tpu"
+    algorithm: str = "sha256d"
+    batch_size: int = 1 << 22
+    extranonce2_size: int = 4
+    # stop searching a job after this age even without a replacement
+    job_max_age: float = 120.0
+
+
+class MiningEngine:
+    """Owns device backends and turns jobs into shares."""
+
+    def __init__(
+        self,
+        backends: dict[str, SearchBackendProtocol],
+        on_share: ShareCallback | None = None,
+        config: EngineConfig | None = None,
+    ):
+        if not backends:
+            raise ValueError("need at least one search backend")
+        self.backends = backends
+        self.on_share = on_share
+        self.config = config or EngineConfig()
+        algos.get(self.config.algorithm)  # validate early
+        self.state = EngineState.IDLE
+        self.stats = EngineStats(algorithm=self.config.algorithm)
+        for name in backends:
+            self.stats.devices[name] = DeviceStats()
+        self._job: Job | None = None
+        self._job_event = asyncio.Event()
+        self._job_serial = 0
+        self._tasks: list[asyncio.Task] = []
+        self._stop = asyncio.Event()
+        self._seen_shares: set[tuple[str, bytes, int, int]] = set()
+
+    # -- job intake ---------------------------------------------------------
+
+    def set_job(self, job: Job) -> None:
+        """Replace the current job. Clean jobs invalidate in-flight work
+        (the searcher rechecks the serial between batches)."""
+        self._job = job
+        self._job_serial += 1
+        self.stats.current_job_id = job.job_id
+        self._seen_shares.clear()
+        self._job_event.set()
+        log.debug("job %s set (clean=%s)", job.job_id, job.clean)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def start(self) -> None:
+        if self.state == EngineState.RUNNING:
+            return
+        self.state = EngineState.STARTING
+        self._stop.clear()
+        loop = asyncio.get_running_loop()
+        for i, (name, backend) in enumerate(self.backends.items()):
+            self._tasks.append(
+                loop.create_task(self._search_loop(i, name, backend))
+            )
+        self.state = EngineState.RUNNING
+        log.info("engine started with backends: %s", list(self.backends))
+
+    async def stop(self) -> None:
+        self.state = EngineState.STOPPING
+        self._stop.set()
+        self._job_event.set()
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks.clear()
+        self.state = EngineState.STOPPED
+        log.info("engine stopped")
+
+    # -- the hot host loop --------------------------------------------------
+
+    async def _search_loop(self, index: int, name: str, backend) -> None:
+        loop = asyncio.get_running_loop()
+        dstats = self.stats.devices[name]
+        n_dev = len(self.backends)
+        while not self._stop.is_set():
+            job = self._job
+            if job is None or job.is_expired(self.config.job_max_age):
+                self._job_event.clear()
+                try:
+                    await asyncio.wait_for(self._job_event.wait(), timeout=1.0)
+                except asyncio.TimeoutError:
+                    pass
+                continue
+
+            serial = self._job_serial
+            extranonce = ExtranonceCounter(size=job.extranonce2_size or self.config.extranonce2_size)
+            # device-disjoint extranonce spaces: stride by device count
+            extranonce.value = index
+            while not self._stop.is_set() and serial == self._job_serial:
+                en2 = extranonce.current()
+                jc = await loop.run_in_executor(None, job_constants, job, en2)
+                space = NonceRange(0, 1 << 32)
+                for base, count in space.batches(self.config.batch_size):
+                    if self._stop.is_set() or serial != self._job_serial:
+                        break
+                    t0 = time.monotonic()
+                    result: SearchResult = await loop.run_in_executor(
+                        None, backend.search, jc, base, count
+                    )
+                    dt = time.monotonic() - t0
+                    dstats.record_batch(result.hashes, dt)
+                    self.stats.hashes += result.hashes
+                    await self._emit_shares(job, en2, result)
+                else:
+                    # nonce space exhausted: roll to the next extranonce2
+                    for _ in range(n_dev):
+                        extranonce.roll()
+                    continue
+                break  # job changed or stopping
+
+    async def _emit_shares(self, job: Job, en2: bytes, result: SearchResult) -> None:
+        for w in result.winners:
+            key = (job.job_id, en2, job.ntime, w.nonce_word)
+            if key in self._seen_shares:
+                continue
+            self._seen_shares.add(key)
+            diff = tgt.difficulty_of_digest(w.digest)
+            share = Share(
+                job_id=job.job_id,
+                worker=self.config.worker_name,
+                extranonce2=en2,
+                ntime=job.ntime,
+                nonce_word=w.nonce_word,
+                digest=w.digest,
+                difficulty=diff,
+                algorithm=job.algorithm,
+            )
+            self.stats.shares_found += 1
+            self.stats.best_difficulty = max(self.stats.best_difficulty, diff)
+            network_target = tgt.bits_to_target(job.nbits)
+            if tgt.hash_meets_target(w.digest, network_target):
+                self.stats.blocks_found += 1
+                log.info("BLOCK candidate found: job=%s nonce=%s", job.job_id, w.nonce_hex)
+            if self.on_share is not None:
+                await self.on_share(share)
+
+    # -- reporting ----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        snap = self.stats.snapshot()
+        snap["state"] = self.state.value
+        return snap

@@ -158,7 +158,7 @@ def le256(h, t):
     ``h``: tuple of 8 uint32 arrays (lanes); ``t``: tuple of 8 uint32
     scalars. Returns a bool array shaped like the lanes.
     """
-    t = tuple(_U32(np.uint32(x)) for x in t)
+    t = tuple(x if isinstance(x, jax.Array) else _U32(np.uint32(x)) for x in t)
     le = h[7] <= t[7]
     for i in range(6, -1, -1):
         le = (h[i] < t[i]) | ((h[i] == t[i]) & le)

@@ -1,0 +1,146 @@
+"""Multi-chip pod search over a ``jax.sharding.Mesh``.
+
+The reference scales across devices with a load balancer handing nonce
+ranges to GPU workers (reference: internal/gpu/multi_gpu.go:15-112
+``MultiGPUManager``/``LoadBalancer``) and across hosts by stratum extranonce
+partitioning (internal/stratum/unified_stratum.go:690-714). The TPU-native
+design collapses the intra-pod half of that into one SPMD program:
+
+- each chip derives its disjoint nonce base from ``axis_index`` (static
+  stride partition — no load balancer needed, the search is perfectly
+  uniform);
+- per-chip hit counts and best-hash telemetry are reduced over **ICI** with
+  ``psum``/``pmin`` so the pod reports one aggregate worker to the pool
+  (the BASELINE north star);
+- per-chip winner candidates come back sharded along the mesh axis; the
+  host validates them exactly, same as the single-chip driver.
+
+A second, optional ``host`` mesh axis models extranonce-style partitioning
+across pod slices: each host-row searches a different extranonce2 space, so
+the 2D mesh (host, chip) covers header-space x nonce-space. On real
+hardware rows map to DCN-connected slices; in tests both axes live on the
+virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from otedama_tpu.kernels import sha256_jax as sj
+from otedama_tpu.runtime.search import JobConstants, SearchResult, Winner
+from otedama_tpu.kernels import target as tgt
+
+NO_WINNER = np.uint32(0xFFFFFFFF)
+
+
+def _local_search(midstate8, tail3, limbs8, base, batch: int):
+    """Exact jnp search of ``batch`` nonces from ``base``; returns
+    (winner_nonce, hit_count, min_h0) scalars."""
+    nonces = base + jax.lax.iota(jnp.uint32, batch)
+    d = sj.sha256d_from_midstate(
+        tuple(midstate8[i] for i in range(8)),
+        (tail3[0], tail3[1], tail3[2]),
+        nonces,
+    )
+    h = sj.digest_words_to_compare_order(d)
+    hits = sj.le256(h, tuple(limbs8[i] for i in range(8)))
+    h0 = h[0]
+    masked = jnp.where(hits, h0, jnp.uint32(NO_WINNER))
+    best = _umin(masked)
+    winner = _umin(jnp.where((masked == best) & hits, nonces, jnp.uint32(NO_WINNER)))
+    return winner, jnp.sum(hits.astype(jnp.int32)), _umin(h0)
+
+
+_U32_SIGN = np.uint32(0x80000000)
+
+
+def _umin(x):
+    flipped = (x ^ jnp.uint32(_U32_SIGN)).astype(jnp.int32)
+    return jnp.min(flipped).astype(jnp.uint32) ^ jnp.uint32(_U32_SIGN)
+
+
+@dataclasses.dataclass
+class PodSearch:
+    """SPMD nonce search across every chip of a mesh.
+
+    One ``step(job_arrays, base)`` call searches ``batch_per_chip * n_chips``
+    nonces and returns per-chip winner candidates plus pod-aggregated
+    counters (reduced over ICI inside the compiled program).
+    """
+
+    mesh: Mesh
+    batch_per_chip: int = 1 << 15
+    axis: str = "chips"
+
+    def __post_init__(self):
+        if len(self.mesh.axis_names) != 1:
+            raise ValueError("PodSearch wants a 1D chip mesh; see __graft_entry__ for the 2D host x chip variant")
+        n = int(np.prod([self.mesh.shape[a] for a in self.mesh.axis_names]))
+        self.n_chips = n
+        batch = self.batch_per_chip
+        axis = self.axis
+
+        @functools.partial(
+            shard_map,
+            mesh=self.mesh,
+            in_specs=(P(), P(), P(), P()),
+            out_specs=(P(self.mesh.axis_names[0]), P(self.mesh.axis_names[0]), P(), P()),
+        )
+        def _step(midstate8, tail3, limbs8, base):
+            idx = jax.lax.axis_index(axis)
+            my_base = base + idx.astype(jnp.uint32) * jnp.uint32(batch)
+            winner, count, minh = _local_search(midstate8, tail3, limbs8, my_base, batch)
+            total_hits = jax.lax.psum(count, axis)          # ICI reduce
+            # pmin in the sign-flipped int32 view (unsigned order-preserving)
+            pod_best = jax.lax.pmin(
+                (minh ^ jnp.uint32(_U32_SIGN)).astype(jnp.int32), axis
+            )
+            return (
+                winner[None],
+                count[None],
+                total_hits,
+                pod_best,
+            )
+
+        self._step = jax.jit(_step)
+
+    def search(self, jc: JobConstants, base: int) -> SearchResult:
+        ms = jnp.asarray(np.array(jc.midstate, dtype=np.uint32))
+        tl = jnp.asarray(np.array(jc.tail, dtype=np.uint32))
+        lb = jnp.asarray(jc.limbs)
+        winners_d, counts_d, total_hits, pod_best = self._step(
+            ms, tl, lb, jnp.uint32(base & 0xFFFFFFFF)
+        )
+        winners_np = np.asarray(winners_d)
+        counts_np = np.asarray(counts_d)
+        out: list[Winner] = []
+        for chip in np.nonzero(counts_np)[0].tolist():
+            chip_base = (base + chip * self.batch_per_chip) & 0xFFFFFFFF
+            if int(counts_np[chip]) == 1 and winners_np[chip] != NO_WINNER:
+                w = int(winners_np[chip])
+                digest = jc.digest_for(w)
+                if tgt.hash_meets_target(digest, jc.target):
+                    out.append(Winner(w, digest))
+            else:
+                # several winners on one chip: host-exact rescan of its range
+                from otedama_tpu.runtime.search import XlaBackend
+
+                res = XlaBackend(chunk=min(self.batch_per_chip, 1 << 16)).search(
+                    jc, chip_base, self.batch_per_chip
+                )
+                out.extend(res.winners)
+        # pmin returned the sign-flip int32 view; undo for telemetry
+        best = (int(pod_best) & 0xFFFFFFFF) ^ 0x80000000
+        return SearchResult(out, self.batch_per_chip * self.n_chips, best)
+
+
+def make_chip_mesh(devices=None, axis: str = "chips") -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.array(devices), (axis,))

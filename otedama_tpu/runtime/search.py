@@ -1,0 +1,187 @@
+"""Batched nonce-search drivers (single device).
+
+The TPU replacement for the reference's per-worker hot loop
+(reference: internal/mining/workers.go:330-401 ``processJobReal`` assembles an
+80-byte header and hashes nonce-by-nonce; internal/mining/hardware_accelerated.go
+:51-114 batches headers through pools). Here the host prepares per-job
+constants once (midstate, tail words, target limbs) and the device consumes
+the nonce space in large strides:
+
+- ``PallasBackend`` — the TPU hot path (``kernels.sha256_pallas``): device
+  returns per-tile candidate winners under a top-limb filter; the host
+  validates candidates exactly against the 256-bit target (hashlib) and
+  rescans a tile with the XLA path when several candidates landed in it.
+- ``XlaBackend`` — pure-jnp exact search; correctness oracle, CPU/GPU
+  fallback, and the path used inside the multi-chip CPU-mesh tests.
+
+Winner nonces use the kernel word convention: ``nonce_word`` is the
+big-endian read of header bytes 76:80 (wire bytes = pack(">I", nonce_word)).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from otedama_tpu.kernels import sha256_jax as sj
+from otedama_tpu.kernels import sha256_pallas as sp
+from otedama_tpu.kernels import target as tgt
+from otedama_tpu.utils import sha256_host as sh
+
+
+@dataclasses.dataclass(frozen=True)
+class JobConstants:
+    """Per-job device constants, derived from the first 76 header bytes."""
+
+    header76: bytes
+    target: int
+    midstate: tuple[int, ...]
+    tail: tuple[int, int, int]
+    limbs: np.ndarray  # uint32[8], most-significant-first
+
+    @classmethod
+    def from_header_prefix(cls, header76: bytes, target: int) -> "JobConstants":
+        if len(header76) != 76:
+            raise ValueError(f"need 76 header bytes, got {len(header76)}")
+        return cls(
+            header76=bytes(header76),
+            target=target,
+            midstate=sh.midstate(header76[:64]),
+            tail=struct.unpack(">3I", header76[64:76]),
+            limbs=tgt.target_to_limbs(target),
+        )
+
+    def header_for(self, nonce_word: int) -> bytes:
+        return self.header76 + struct.pack(">I", nonce_word)
+
+    def digest_for(self, nonce_word: int) -> bytes:
+        return sh.sha256d(self.header_for(nonce_word))
+
+
+@dataclasses.dataclass(frozen=True)
+class Winner:
+    nonce_word: int
+    digest: bytes  # 32-byte sha256d of the full header
+
+    @property
+    def nonce_hex(self) -> str:
+        return struct.pack(">I", self.nonce_word).hex()
+
+
+@dataclasses.dataclass
+class SearchResult:
+    winners: list[Winner]
+    hashes: int
+    best_hash_hi: int  # min top compare limb observed (best-share telemetry)
+
+    def merge(self, other: "SearchResult") -> "SearchResult":
+        return SearchResult(
+            winners=self.winners + other.winners,
+            hashes=self.hashes + other.hashes,
+            best_hash_hi=min(self.best_hash_hi, other.best_hash_hi),
+        )
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _xla_search_step(midstate8, tail3, base, limbs8, *, n: int):
+    nonces = base + jax.lax.iota(jnp.uint32, n)
+    d = sj.sha256d_from_midstate(
+        tuple(midstate8[i] for i in range(8)),
+        (tail3[0], tail3[1], tail3[2]),
+        nonces,
+    )
+    h = sj.digest_words_to_compare_order(d)
+    hits = sj.le256(h, tuple(limbs8[i] for i in range(8)))
+    return hits, h[0]
+
+
+class XlaBackend:
+    """Exact jnp/XLA search; works on any JAX backend."""
+
+    name = "xla"
+
+    def __init__(self, chunk: int = 1 << 16):
+        self.chunk = chunk
+
+    def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
+        ms = jnp.asarray(np.array(jc.midstate, dtype=np.uint32))
+        tl = jnp.asarray(np.array(jc.tail, dtype=np.uint32))
+        lb = jnp.asarray(jc.limbs)
+        winners: list[Winner] = []
+        best = 0xFFFFFFFF
+        done = 0
+        while done < count:
+            n = self.chunk  # fixed shape avoids recompiles; extra lanes are overscan
+            hits, h0 = _xla_search_step(
+                ms, tl, jnp.uint32((base + done) & 0xFFFFFFFF), lb, n=n
+            )
+            hits = np.asarray(hits)
+            h0 = np.asarray(h0)
+            valid = min(n, count - done)
+            hits = hits[:valid]
+            best = min(best, int(h0[:valid].min()))
+            for idx in np.nonzero(hits)[0].tolist():
+                w = (base + done + idx) & 0xFFFFFFFF
+                winners.append(Winner(w, jc.digest_for(w)))
+            done += valid
+        return SearchResult(winners, count, best)
+
+
+class PallasBackend:
+    """TPU hot path: Pallas kernel + host-side exact validation."""
+
+    name = "pallas-tpu"
+
+    def __init__(self, sub: int = 256, interpret: bool | None = None):
+        self.sub = sub
+        self.interpret = interpret
+        self._rescan = XlaBackend(chunk=sub * 128)
+
+    @property
+    def tile(self) -> int:
+        return self.sub * 128
+
+    def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
+        tile = self.tile
+        batch = (count + tile - 1) // tile * tile  # overscan to tile multiple
+        jw = sp.pack_job_words(jc.midstate, jc.tail, base, jc.limbs)
+        win, cnt, mh = sp.sha256d_pallas_search(
+            jw, batch=batch, sub=self.sub, interpret=self.interpret
+        )
+        win = np.asarray(win)
+        cnt = np.asarray(cnt)
+        mh = np.asarray(mh)
+
+        winners: list[Winner] = []
+        for t in np.nonzero(cnt)[0].tolist():
+            if int(cnt[t]) == 1 and win[t] != sp.NO_WINNER:
+                w = int(win[t])
+                digest = jc.digest_for(w)
+                if tgt.hash_meets_target(digest, jc.target):
+                    winners.append(Winner(w, digest))
+            else:
+                # several filter candidates in one tile: exact rescan
+                tile_base = (base + t * tile) & 0xFFFFFFFF
+                res = self._rescan.search(jc, tile_base, tile)
+                winners.extend(res.winners)
+        # drop overscan winners beyond the requested range
+        if batch != count:
+            winners = [
+                w
+                for w in winners
+                if ((w.nonce_word - base) & 0xFFFFFFFF) < count
+            ]
+        return SearchResult(winners, count, int(mh.min()))
+
+
+def make_backend(kind: str, **kwargs):
+    if kind == "pallas-tpu":
+        return PallasBackend(**kwargs)
+    if kind == "xla":
+        return XlaBackend(**kwargs)
+    raise ValueError(f"unknown backend {kind!r} (native-cpu arrives with otedama_tpu.native)")
