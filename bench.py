@@ -1,0 +1,96 @@
+"""Headline benchmark: sha256d nonce-search hashrate per chip.
+
+Prints ONE JSON line:
+  {"metric": "sha256d_hashrate_per_chip", "value": N, "unit": "GH/s",
+   "vs_baseline": N / 1.0}
+
+Baseline = 1 GH/s/chip (BASELINE.md config 1, v5e). On TPU this drives the
+Pallas kernel (otedama_tpu.kernels.sha256_pallas); off-TPU it falls back to
+the exact XLA path so the benchmark always runs.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import sys
+import time
+
+BASELINE_GHS = 1.0
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import jax
+    import numpy as np
+
+    from otedama_tpu.runtime.search import JobConstants
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    log(f"bench: platform={platform} devices={len(jax.devices())}")
+
+    header76 = bytes(range(64)) + struct.pack(">3I", 0x17034219, 0x6530D1B7, 0x17034219)
+    # impossible target: pure search throughput, no winner extraction cost
+    jc = JobConstants.from_header_prefix(header76, target=0)
+
+    if on_tpu:
+        from otedama_tpu.kernels import sha256_pallas as sp
+
+        sub = 256
+        batch = 1 << 25
+        jw = sp.pack_job_words(jc.midstate, jc.tail, 0, jc.limbs)
+
+        def run(base: int):
+            jw2 = jw.copy()
+            jw2[11] = np.uint32(base & 0xFFFFFFFF)
+            out = sp.sha256d_pallas_search(jw2, batch=batch, sub=sub, interpret=False)
+            jax.block_until_ready(out)
+            return out
+
+        log("bench: compiling pallas kernel ...")
+        t0 = time.monotonic()
+        run(0)
+        log(f"bench: compile+first run {time.monotonic() - t0:.1f}s")
+
+        iters = 8
+        t0 = time.monotonic()
+        for i in range(iters):
+            run((i + 1) * batch)
+        dt = time.monotonic() - t0
+        hashes = iters * batch
+        name = "pallas-tpu"
+    else:
+        from otedama_tpu.runtime.search import XlaBackend
+
+        backend = XlaBackend(chunk=1 << 18)
+        log("bench: compiling xla fallback ...")
+        backend.search(jc, 0, backend.chunk)  # warmup
+        iters = 4
+        count = backend.chunk * 8
+        t0 = time.monotonic()
+        for i in range(iters):
+            backend.search(jc, (i + 1) * count, count)
+        dt = time.monotonic() - t0
+        hashes = iters * count
+        name = "xla-" + platform
+
+    ghs = hashes / dt / 1e9
+    log(f"bench: {name} {hashes} hashes in {dt:.2f}s -> {ghs:.3f} GH/s")
+    print(
+        json.dumps(
+            {
+                "metric": "sha256d_hashrate_per_chip",
+                "value": round(ghs, 4),
+                "unit": "GH/s",
+                "vs_baseline": round(ghs / BASELINE_GHS, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

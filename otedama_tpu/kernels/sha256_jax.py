@@ -96,6 +96,42 @@ def compress(state, w):
     return tuple(x + y for x, y in zip(state, s))
 
 
+def compress_rolled(state, w):
+    """One SHA-256 compression as a ``lax.fori_loop`` — O(1) graph size.
+
+    Semantically identical to ``compress``; compiles in milliseconds where
+    the unrolled form costs XLA a 64x larger graph. The TPU hot path wants
+    ``compress`` (register allocation over the unrolled rounds); CPU-mesh
+    tests, dryruns and one-off hashing want this one.
+    """
+    W = jnp.stack([jnp.asarray(x, dtype=jnp.uint32) for x in w])  # (16, ...)
+    K = jnp.asarray(_K_NP)
+
+    def round_fn(i, carry):
+        a, b, c, d, e, f, g, h, W = carry
+        j = i % 16
+
+        def scheduled(W):
+            wj = (
+                W[j]
+                + _small_sigma0(W[(i - 15) % 16])
+                + W[(i - 7) % 16]
+                + _small_sigma1(W[(i - 2) % 16])
+            )
+            return W.at[j].set(wj), wj
+
+        W, wi = jax.lax.cond(
+            i < 16, lambda W: (W, W[j]), scheduled, W
+        )
+        t1 = h + _big_sigma1(e) + _ch(e, f, g) + K[i] + wi
+        t2 = _big_sigma0(a) + _maj(a, b, c)
+        return (t1 + t2, a, b, c, d + t1, e, f, g, W)
+
+    init = tuple(jnp.asarray(s, dtype=jnp.uint32) for s in state) + (W,)
+    out = jax.lax.fori_loop(0, 64, round_fn, init)
+    return tuple(x + y for x, y in zip(state, out[:8]))
+
+
 def bswap32(x):
     """Byte-swap each uint32 lane."""
     return (
@@ -106,17 +142,19 @@ def bswap32(x):
     )
 
 
-def sha256d_from_midstate(midstate, tail, nonces):
+def sha256d_from_midstate(midstate, tail, nonces, *, rolled: bool = False):
     """double-SHA256 of an 80-byte header across a lane axis of nonces.
 
     ``midstate``: 8 uint32 scalars/arrays — compression of header[0:64].
     ``tail``: 3 uint32 scalars — header words 16,17,18 (merkle tail, ntime,
     nbits), big-endian word values.
     ``nonces``: uint32 array — header word 19, one lane per candidate.
+    ``rolled``: use the fori_loop compression (fast compile, CPU/test path).
 
     Returns the 8 big-endian digest words ``d[0..8]`` of sha256d(header),
     each with the shape of ``nonces``.
     """
+    comp = compress_rolled if rolled else compress
     zero = jnp.zeros_like(nonces)
     pad1 = zero + _U32(0x80000000)
     w = [
@@ -129,7 +167,7 @@ def sha256d_from_midstate(midstate, tail, nonces):
         zero + _U32(640),  # 80 bytes * 8 bits
     ]
     ms = tuple(zero + _U32(m) for m in midstate)
-    d = compress(ms, w)
+    d = comp(ms, w)
 
     # Second hash: one block = 32-byte digest + padding, from the IV.
     w2 = [
@@ -139,7 +177,7 @@ def sha256d_from_midstate(midstate, tail, nonces):
         zero + _U32(256),  # 32 bytes * 8 bits
     ]
     iv = tuple(zero + _U32(v) for v in _IV_NP)
-    return compress(iv, w2)
+    return comp(iv, w2)
 
 
 def digest_words_to_compare_order(d):

@@ -30,7 +30,10 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental.shard_map import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from otedama_tpu.kernels import sha256_jax as sj
@@ -40,7 +43,7 @@ from otedama_tpu.kernels import target as tgt
 NO_WINNER = np.uint32(0xFFFFFFFF)
 
 
-def _local_search(midstate8, tail3, limbs8, base, batch: int):
+def _local_search(midstate8, tail3, limbs8, base, batch: int, rolled: bool = False):
     """Exact jnp search of ``batch`` nonces from ``base``; returns
     (winner_nonce, hit_count, min_h0) scalars."""
     nonces = base + jax.lax.iota(jnp.uint32, batch)
@@ -48,6 +51,7 @@ def _local_search(midstate8, tail3, limbs8, base, batch: int):
         tuple(midstate8[i] for i in range(8)),
         (tail3[0], tail3[1], tail3[2]),
         nonces,
+        rolled=rolled,
     )
     h = sj.digest_words_to_compare_order(d)
     hits = sj.le256(h, tuple(limbs8[i] for i in range(8)))
@@ -78,6 +82,7 @@ class PodSearch:
     mesh: Mesh
     batch_per_chip: int = 1 << 15
     axis: str = "chips"
+    rolled: bool | None = None  # None = rolled off-TPU (compile time)
 
     def __post_init__(self):
         if len(self.mesh.axis_names) != 1:
@@ -86,6 +91,9 @@ class PodSearch:
         self.n_chips = n
         batch = self.batch_per_chip
         axis = self.axis
+        if self.rolled is None:
+            self.rolled = jax.default_backend() != "tpu"
+        rolled = self.rolled
 
         @functools.partial(
             shard_map,
@@ -96,7 +104,9 @@ class PodSearch:
         def _step(midstate8, tail3, limbs8, base):
             idx = jax.lax.axis_index(axis)
             my_base = base + idx.astype(jnp.uint32) * jnp.uint32(batch)
-            winner, count, minh = _local_search(midstate8, tail3, limbs8, my_base, batch)
+            winner, count, minh = _local_search(
+                midstate8, tail3, limbs8, my_base, batch, rolled=rolled
+            )
             total_hits = jax.lax.psum(count, axis)          # ICI reduce
             # pmin in the sign-flipped int32 view (unsigned order-preserving)
             pod_best = jax.lax.pmin(

@@ -87,17 +87,27 @@ class SearchResult:
         )
 
 
-@functools.partial(jax.jit, static_argnames=("n",))
-def _xla_search_step(midstate8, tail3, base, limbs8, *, n: int):
+@functools.partial(jax.jit, static_argnames=("n", "rolled"))
+def _xla_search_step(midstate8, tail3, base, limbs8, *, n: int, rolled: bool):
     nonces = base + jax.lax.iota(jnp.uint32, n)
     d = sj.sha256d_from_midstate(
         tuple(midstate8[i] for i in range(8)),
         (tail3[0], tail3[1], tail3[2]),
         nonces,
+        rolled=rolled,
     )
     h = sj.digest_words_to_compare_order(d)
     hits = sj.le256(h, tuple(limbs8[i] for i in range(8)))
     return hits, h[0]
+
+
+def _default_rolled() -> bool:
+    """Unrolled rounds on TPU (throughput), rolled elsewhere (compile time —
+    the single-core CI box pays ~minutes per unrolled XLA-CPU compile)."""
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:  # pragma: no cover
+        return True
 
 
 class XlaBackend:
@@ -105,8 +115,9 @@ class XlaBackend:
 
     name = "xla"
 
-    def __init__(self, chunk: int = 1 << 16):
+    def __init__(self, chunk: int = 1 << 16, rolled: bool | None = None):
         self.chunk = chunk
+        self.rolled = _default_rolled() if rolled is None else rolled
 
     def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
         ms = jnp.asarray(np.array(jc.midstate, dtype=np.uint32))
@@ -118,7 +129,8 @@ class XlaBackend:
         while done < count:
             n = self.chunk  # fixed shape avoids recompiles; extra lanes are overscan
             hits, h0 = _xla_search_step(
-                ms, tl, jnp.uint32((base + done) & 0xFFFFFFFF), lb, n=n
+                ms, tl, jnp.uint32((base + done) & 0xFFFFFFFF), lb,
+                n=n, rolled=self.rolled,
             )
             hits = np.asarray(hits)
             h0 = np.asarray(h0)
@@ -179,9 +191,31 @@ class PallasBackend:
         return SearchResult(winners, count, int(mh.min()))
 
 
+class PythonBackend:
+    """Pure-python hashlib search. Slow; the zero-dependency oracle used by
+    protocol-level tests and as a last-resort host fallback (the analogue of
+    the reference's stdlib-crypto CPU path, internal/mining/workers.go:330)."""
+
+    name = "python"
+
+    def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
+        winners: list[Winner] = []
+        best = 0xFFFFFFFF
+        for i in range(count):
+            w = (base + i) & 0xFFFFFFFF
+            digest = jc.digest_for(w)
+            hi = int.from_bytes(digest[28:32], "little")
+            best = min(best, hi)
+            if tgt.hash_meets_target(digest, jc.target):
+                winners.append(Winner(w, digest))
+        return SearchResult(winners, count, best)
+
+
 def make_backend(kind: str, **kwargs):
     if kind == "pallas-tpu":
         return PallasBackend(**kwargs)
     if kind == "xla":
         return XlaBackend(**kwargs)
+    if kind == "python":
+        return PythonBackend(**kwargs)
     raise ValueError(f"unknown backend {kind!r} (native-cpu arrives with otedama_tpu.native)")

@@ -16,3 +16,30 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# sitecustomize (axon tunnel) re-pins JAX_PLATFORMS=axon at interpreter start,
+# so the env var alone is not enough — pin the platform via jax.config too.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+# -- minimal async test support (pytest-asyncio is not in the image) ---------
+
+import asyncio  # noqa: E402
+import inspect  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "asyncio: run test via asyncio.run")
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(fn(**kwargs))
+        return True
+    return None

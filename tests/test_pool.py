@@ -1,0 +1,176 @@
+"""Pool backend: payout schemes, persistence, block lifecycle, failover.
+
+Mirrors reference internal/pool/payout_system_test.go (MockWallet payouts)
+and test/integration pool-manager coverage.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+
+import pytest
+
+from otedama_tpu.db import Database
+from otedama_tpu.engine import jobs as jobmod
+from otedama_tpu.kernels import target as tgt
+from otedama_tpu.pool.blockchain import MockChainClient
+from otedama_tpu.pool.failover import FailoverManager, FailoverStrategy, UpstreamPool
+from otedama_tpu.pool.manager import MockWallet, PoolConfig, PoolManager
+from otedama_tpu.pool.payouts import (
+    FeeDistributor,
+    FeeSplit,
+    PayoutCalculator,
+    PayoutConfig,
+    PayoutScheme,
+)
+from otedama_tpu.stratum.server import AcceptedShare
+from otedama_tpu.utils.sha256_host import sha256d
+
+
+def shares_for(workers: dict[str, float]) -> list[dict]:
+    return [
+        {"worker": w, "difficulty": d, "job_id": "j", "created_at": 0.0}
+        for w, d in workers.items()
+    ]
+
+
+def test_pplns_distribution_exact_sum():
+    calc = PayoutCalculator(PayoutConfig(scheme=PayoutScheme.PPLNS, pool_fee_percent=2.0))
+    reward = 625_000_000
+    result = calc.calculate_block(reward, shares_for({"a": 10, "b": 30, "c": 60}))
+    assert result.pool_fee == int(reward * 0.02)
+    assert result.distributed == reward - result.pool_fee
+    amounts = {p.worker: p.amount for p in result.payouts}
+    assert amounts["c"] > amounts["b"] > amounts["a"]
+    # proportionality within rounding
+    assert abs(amounts["b"] / amounts["a"] - 3.0) < 0.01
+
+
+def test_pplns_window_limits_shares():
+    calc = PayoutCalculator(PayoutConfig(scheme=PayoutScheme.PPLNS, pplns_window=2,
+                                         pool_fee_percent=0.0))
+    shares = shares_for({"old": 100.0}) + shares_for({"a": 1.0}) + shares_for({"b": 1.0})
+    result = calc.calculate_block(1000, shares)
+    workers = {p.worker for p in result.payouts}
+    assert workers == {"a", "b"}
+
+
+def test_solo_scheme_pays_finder():
+    calc = PayoutCalculator(PayoutConfig(scheme=PayoutScheme.SOLO, pool_fee_percent=1.0))
+    result = calc.calculate_block(1000, shares_for({"a": 5.0}), finder="lucky")
+    assert len(result.payouts) == 1
+    assert result.payouts[0].worker == "lucky"
+    assert result.payouts[0].amount == 990
+
+
+def test_pps_credit():
+    calc = PayoutCalculator(PayoutConfig(
+        scheme=PayoutScheme.PPS, pps_rate_per_diff1=1000.0, pool_fee_percent=1.0
+    ))
+    assert calc.pps_credit(2.0) == int(2.0 * 1000.0 * 0.99)
+    assert calc.calculate_block(1000, shares_for({"a": 1.0})).payouts == []
+
+
+def test_fee_distributor_exact():
+    fd = FeeDistributor([FeeSplit("op", 70.0), FeeSplit("dev", 30.0)])
+    out = fd.distribute(1001)
+    assert sum(out.values()) == 1001
+    assert out["op"] == 700
+
+
+def test_database_migrations_and_repos(tmp_path):
+    db = Database(str(tmp_path / "pool.db"))
+    assert db.schema_version() >= 2
+    pm = PoolManager(db, MockChainClient())
+    pm.workers.upsert("w1", wallet="addr1")
+    pm.workers.record_share("w1", True)
+    pm.shares.create("w1", "j1", 1.0)
+    w = pm.workers.get("w1")
+    assert w["shares_valid"] == 1 and w["wallet"] == "addr1"
+    assert pm.shares.count() == 1
+    db.close()
+
+
+@pytest.mark.asyncio
+async def test_block_lifecycle_with_mock_chain():
+    """Find a block against the mock chain, submit, distribute, pay out."""
+    db = Database()
+    chain = MockChainClient(nbits=0x207FFFFF)
+    wallet = MockWallet()
+    cfg = PoolConfig(payout=PayoutConfig(
+        scheme=PayoutScheme.PPLNS, pool_fee_percent=1.0,
+        minimum_payout=1000, payout_fee=10,
+    ))
+    pm = PoolManager(db, chain, wallet, cfg)
+
+    job = await pm.next_job()
+    # accumulate a shares window
+    for worker, diff in [("w.a", 1.0), ("w.b", 3.0)]:
+        await pm.on_share(AcceptedShare(
+            session_id=1, worker_user=worker, job_id=job.job_id,
+            difficulty=diff, actual_difficulty=diff, digest=b"\x00" * 32,
+            is_block=False, submitted_at=0.0,
+        ))
+
+    # brute-force a block for the regtest-easy target
+    target = tgt.bits_to_target(chain.nbits)
+    prefix = jobmod.build_header_prefix(job, b"\x00" * job.extranonce2_size)
+    nonce = next(
+        n for n in range(1 << 20)
+        if tgt.hash_meets_target(sha256d(prefix + struct.pack(">I", n)), target)
+    )
+    header = prefix + struct.pack(">I", nonce)
+
+    await pm.on_block(header, job, AcceptedShare(
+        session_id=1, worker_user="w.b", job_id=job.job_id,
+        difficulty=3.0, actual_difficulty=1e9, digest=sha256d(header),
+        is_block=True, submitted_at=0.0,
+    ))
+
+    assert chain.submitted, "block not accepted by chain"
+    assert pm.blocks.pending(), "block not recorded"
+
+    balances = {w["name"]: w["balance"] for w in pm.workers.list()}
+    total = chain.reward - int(chain.reward * 0.01)
+    assert sum(balances.values()) == total
+    assert balances["w.b"] == pytest.approx(total * 0.75, rel=0.01)
+
+    paid = await pm.process_payouts()
+    assert paid == 2
+    assert wallet.sent and sum(wallet.sent[0].values()) == total - 2 * 10
+    assert all(w["balance"] == 0 for w in pm.workers.list())
+
+    # confirmations advance on poll
+    await pm.submitter.check_pending()
+    db.close()
+
+
+@pytest.mark.asyncio
+async def test_failover_scoring_and_selection():
+    good = UpstreamPool("good", "127.0.0.1", 1, priority=1)
+    bad = UpstreamPool("bad", "127.0.0.1", 2, priority=0)
+    fm = FailoverManager([good, bad], FailoverStrategy.PRIORITY, failure_threshold=1)
+
+    # a real listener for "good", nothing for "bad"
+    server = await asyncio.start_server(lambda r, w: w.close(), "127.0.0.1", 0)
+    good.port = server.sockets[0].getsockname()[1]
+    bad.port = good.port + 1 if good.port < 65000 else good.port - 1
+    # ensure bad port is actually closed
+    await fm.check_all()
+    server.close()
+    await server.wait_closed()
+
+    assert good.reachable
+    # priority prefers bad(0) but it's unreachable -> good selected
+    if not bad.reachable:
+        assert fm.select() is good
+
+    fm.record_share_result(good, accepted=False)
+    fm.record_share_result(good, accepted=True)
+    assert good.reject_rate == 0.5
+    assert 0.0 < good.health_score() <= 1.0
+
+    fm2 = FailoverManager([good, bad], FailoverStrategy.PERFORMANCE)
+    if not bad.reachable:
+        assert fm2.select() is good
