@@ -36,6 +36,7 @@ class NodeConfig:
     host: str = "127.0.0.1"
     port: int = 0                    # 0 = ephemeral
     max_peers: int = 32
+    connect_timeout: float = 10.0
     keepalive_seconds: float = 30.0
     peer_timeout: float = 90.0
     dedup_window: int = 4096
@@ -121,23 +122,39 @@ class P2PNode:
 
     async def connect(self, host: str, port: int) -> Peer:
         """Dial a peer and run the handshake."""
-        reader, writer = await asyncio.open_connection(host, port)
-        hello = P2PMessage(
-            MessageType.HANDSHAKE,
-            {
-                "version": PROTOCOL_VERSION,
-                "listen_port": self.config.port,
-            },
-            sender=self.node_id,
+        if len(self.peers) >= self.config.max_peers:
+            raise ConnectionError("peer slots full")
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, port), self.config.connect_timeout
         )
-        writer.write(hello.encode())
-        await writer.drain()
-        ack = P2PMessage.decode_frame(
-            await asyncio.wait_for(read_frame(reader), 10.0)
-        )
+        try:
+            hello = P2PMessage(
+                MessageType.HANDSHAKE,
+                {
+                    "version": PROTOCOL_VERSION,
+                    "listen_port": self.config.port,
+                },
+                sender=self.node_id,
+            )
+            writer.write(hello.encode())
+            await writer.drain()
+            ack = P2PMessage.decode_frame(
+                await asyncio.wait_for(read_frame(reader), self.config.connect_timeout)
+            )
+        except BaseException:
+            writer.close()
+            raise
         if ack.type != MessageType.HANDSHAKE_ACK:
             writer.close()
             raise ConnectionError(f"expected handshake ack, got {ack.type}")
+        if ack.sender == self.node_id:
+            writer.close()
+            raise ConnectionError("connected to self")
+        existing = self.peers.get(ack.sender)
+        if existing is not None:
+            # simultaneous mutual dial: keep the established connection
+            writer.close()
+            return existing
         peer = self._register_peer(
             ack.sender, reader, writer,
             listen_port=int(ack.payload.get("listen_port", port)),
@@ -198,10 +215,13 @@ class P2PNode:
         return peer
 
     def _drop_peer(self, peer: Peer) -> None:
-        self.peers.pop(peer.node_id, None)
-        task = self._peer_tasks.pop(peer.node_id, None)
-        if task is not None and task is not asyncio.current_task():
-            task.cancel()
+        # only unregister if this Peer object still owns the slot — a stale
+        # connection for a re-registered node_id must not evict the live one
+        if self.peers.get(peer.node_id) is peer:
+            self.peers.pop(peer.node_id, None)
+            task = self._peer_tasks.pop(peer.node_id, None)
+            if task is not None and task is not asyncio.current_task():
+                task.cancel()
         peer.writer.close()
         log.info("peer %s dropped", peer.node_id[:12])
 
@@ -321,6 +341,8 @@ class P2PNode:
             peer.send(P2PMessage(MessageType.GET_PEERS, {}, sender=self.node_id))
 
     async def _maybe_connect_new(self, addresses: list) -> None:
+        # dial in the background: one unroutable advertised address must not
+        # stall the advertising peer's message pump
         for entry in addresses:
             if len(self.peers) >= self.config.max_peers:
                 return
@@ -330,10 +352,14 @@ class P2PNode:
                 continue
             if node_id == self.node_id or node_id in self.peers:
                 continue
-            try:
-                await self.connect(host, port)
-            except (OSError, ConnectionError, asyncio.TimeoutError):
-                continue
+            self._tasks.append(asyncio.create_task(self._connect_quietly(host, port)))
+        self._tasks = [t for t in self._tasks if not t.done()]
+
+    async def _connect_quietly(self, host: str, port: int) -> None:
+        try:
+            await self.connect(host, port)
+        except (OSError, ConnectionError, asyncio.TimeoutError, ValueError):
+            pass
 
     # -- keepalive ----------------------------------------------------------
 

@@ -36,6 +36,7 @@ class P2PPool:
         self.node = P2PNode(config)
         self.window = window
         self.ledger: list[LedgerEntry] = []
+        self._ledger_keys: set[tuple] = set()
         self.blocks_seen: list[dict] = []
         self.jobs_seen: dict[str, dict] = {}
         self.node.on(MessageType.SHARE, self._on_share)
@@ -132,9 +133,21 @@ class P2PPool:
     # -- ledger -------------------------------------------------------------
 
     def _append(self, entry: LedgerEntry) -> None:
+        # dedup by identity, not message_id: overlapping SYNC_RESPONSEs from
+        # several peers carry the same entries under fresh message ids, and
+        # double-counting would skew every node's PPLNS split
+        key = (entry.origin, entry.worker, entry.job_id, entry.timestamp,
+               entry.difficulty)
+        if key in self._ledger_keys:
+            return
+        self._ledger_keys.add(key)
         self.ledger.append(entry)
         if len(self.ledger) > 2 * self.window:
             del self.ledger[: -self.window]
+            self._ledger_keys = {
+                (e.origin, e.worker, e.job_id, e.timestamp, e.difficulty)
+                for e in self.ledger
+            }
 
     def weights(self) -> dict[str, float]:
         """PPLNS weights over the last-N ledger window — every node computes

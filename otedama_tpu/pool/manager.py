@@ -88,14 +88,23 @@ class PoolManager:
         self._job_counter = itertools.count(1)
         self._round_start = time.time()     # PROP round boundary
         self._current_reward = 0
+        # reward is credited per found job, not per latest template: a
+        # template refresh mid-round must not change the split of a block
+        # found on the previous job
+        self._job_rewards: dict[str, int] = {}
         self._tasks: list[asyncio.Task] = []
 
     # -- job production -----------------------------------------------------
 
     def job_from_template(self, t: BlockTemplate, algorithm: str = "sha256d") -> Job:
         self._current_reward = t.reward
+        job_id = f"{next(self._job_counter):x}"
+        self._job_rewards[job_id] = t.reward
+        if len(self._job_rewards) > 512:
+            for jid in list(self._job_rewards)[:-256]:
+                del self._job_rewards[jid]
         return Job(
-            job_id=f"{next(self._job_counter):x}",
+            job_id=job_id,
             prev_hash=t.prev_hash,
             coinb1=t.coinb1,
             coinb2=t.coinb2,
@@ -129,12 +138,11 @@ class PoolManager:
             self.workers.credit(worker, credit)
 
     async def on_block(self, header: bytes, job: Job, share: AcceptedShare) -> None:
-        outcome = await self.submitter.submit(
-            header, share.worker_user, self._current_reward
-        )
+        reward = self._job_rewards.get(job.job_id, self._current_reward)
+        outcome = await self.submitter.submit(header, share.worker_user, reward)
         if not outcome.accepted:
             return
-        self.distribute_block(self._current_reward, finder=share.worker_user)
+        self.distribute_block(reward, finder=share.worker_user)
 
     # -- reward distribution ------------------------------------------------
 

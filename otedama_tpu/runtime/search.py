@@ -191,6 +191,72 @@ class PallasBackend:
         return SearchResult(winners, count, int(mh.min()))
 
 
+class ScryptXlaBackend:
+    """Vectorized scrypt (N=1024,r=1,p=1) search on any JAX backend.
+
+    Consumes the same ``JobConstants`` as the sha256d backends but reads only
+    ``header76``/``target``/``limbs`` (scrypt has no midstate trick: the nonce
+    sits inside the PBKDF2 password, so the whole pipeline runs per lane).
+    Memory budget: the ROMix V tensor is 128 KiB/lane, so ``chunk`` lanes cost
+    ``chunk * 128 KiB`` of HBM (default 4096 lanes = 512 MiB).
+    """
+
+    name = "scrypt-xla"
+    algorithm = "scrypt"
+
+    def __init__(self, chunk: int = 1 << 12, rolled: bool | None = None):
+        self.chunk = chunk
+        self.rolled = _default_rolled() if rolled is None else rolled
+
+    def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
+        from otedama_tpu.kernels import scrypt_jax as sc
+
+        h19 = jnp.asarray(
+            np.array(sc.header_words19(jc.header76), dtype=np.uint32)
+        )
+        lb = jnp.asarray(jc.limbs)
+        winners: list[Winner] = []
+        best = 0xFFFFFFFF
+        done = 0
+        while done < count:
+            n = self.chunk
+            hits, h0 = sc.scrypt_search_step(
+                h19, jnp.uint32((base + done) & 0xFFFFFFFF), lb,
+                n=n, rolled=self.rolled,
+            )
+            hits = np.asarray(hits)
+            h0 = np.asarray(h0)
+            valid = min(n, count - done)
+            best = min(best, int(h0[:valid].min()))
+            for idx in np.nonzero(hits[:valid])[0].tolist():
+                w = (base + done + idx) & 0xFFFFFFFF
+                digest = sc.scrypt_digest_host(jc.header_for(w))
+                if tgt.hash_meets_target(digest, jc.target):
+                    winners.append(Winner(w, digest))
+            done += valid
+        return SearchResult(winners, count, best)
+
+
+class ScryptPythonBackend:
+    """Scalar hashlib.scrypt search — protocol-test oracle."""
+
+    name = "scrypt-python"
+    algorithm = "scrypt"
+
+    def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
+        from otedama_tpu.kernels import scrypt_jax as sc
+
+        winners: list[Winner] = []
+        best = 0xFFFFFFFF
+        for i in range(count):
+            w = (base + i) & 0xFFFFFFFF
+            digest = sc.scrypt_digest_host(jc.header_for(w))
+            best = min(best, int.from_bytes(digest[28:32], "little"))
+            if tgt.hash_meets_target(digest, jc.target):
+                winners.append(Winner(w, digest))
+        return SearchResult(winners, count, best)
+
+
 class PythonBackend:
     """Pure-python hashlib search. Slow; the zero-dependency oracle used by
     protocol-level tests and as a last-resort host fallback (the analogue of
@@ -211,11 +277,26 @@ class PythonBackend:
         return SearchResult(winners, count, best)
 
 
-def make_backend(kind: str, **kwargs):
-    if kind == "pallas-tpu":
-        return PallasBackend(**kwargs)
-    if kind == "xla":
-        return XlaBackend(**kwargs)
-    if kind == "python":
-        return PythonBackend(**kwargs)
-    raise ValueError(f"unknown backend {kind!r} (native-cpu arrives with otedama_tpu.native)")
+def make_backend(kind: str, algorithm: str = "sha256d", **kwargs):
+    if algorithm in ("sha256d", "sha256"):
+        if kind == "pallas-tpu":
+            return PallasBackend(**kwargs)
+        if kind == "xla":
+            return XlaBackend(**kwargs)
+        if kind == "python":
+            return PythonBackend(**kwargs)
+        if kind == "native-cpu":
+            try:
+                from otedama_tpu.native import NativeCpuBackend
+            except ImportError as e:
+                raise ValueError(
+                    "native-cpu backend unavailable (C++ extension not built; "
+                    f"run `make -C otedama_tpu/native`): {e}"
+                ) from None
+            return NativeCpuBackend(**kwargs)
+    elif algorithm == "scrypt":
+        if kind == "xla":
+            return ScryptXlaBackend(**kwargs)
+        if kind == "python":
+            return ScryptPythonBackend(**kwargs)
+    raise ValueError(f"no backend {kind!r} for algorithm {algorithm!r}")

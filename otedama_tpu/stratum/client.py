@@ -170,19 +170,21 @@ class StratumClient:
         )
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[msg_id] = fut
-        await self._send(sp.Message(id=msg_id, method=method, params=params))
-        # the read loop may not be running yet during the handshake: poll the
-        # socket inline until our response arrives
-        if not self.connected.is_set():
-            while not fut.done():
-                line = await asyncio.wait_for(
-                    self._reader.readline(), self.config.response_timeout
-                )
-                if not line:
-                    raise ConnectionError("closed during handshake")
-                self._dispatch(sp.decode_line(line))
-        result = await asyncio.wait_for(fut, self.config.response_timeout)
-        return result
+        try:
+            await self._send(sp.Message(id=msg_id, method=method, params=params))
+            # the read loop may not be running yet during the handshake: poll
+            # the socket inline until our response arrives
+            if not self.connected.is_set():
+                while not fut.done():
+                    line = await asyncio.wait_for(
+                        self._reader.readline(), self.config.response_timeout
+                    )
+                    if not line:
+                        raise ConnectionError("closed during handshake")
+                    self._dispatch(sp.decode_line(line))
+            return await asyncio.wait_for(fut, self.config.response_timeout)
+        finally:
+            self._pending.pop(msg_id, None)
 
     async def _read_loop(self) -> None:
         assert self._reader is not None
@@ -261,6 +263,14 @@ class StratumClient:
             latency = time.monotonic() - t0
             accepted = False
             err = e.as_triple()
+        except (asyncio.TimeoutError, ConnectionError, asyncio.CancelledError) as e:
+            # pool went silent or the session dropped mid-submit: report a
+            # rejected share instead of crashing the caller's submit loop
+            if isinstance(e, asyncio.CancelledError) and self._stop:
+                raise
+            latency = time.monotonic() - t0
+            accepted = False
+            err = [sp.ERR_OTHER, f"no pool response: {type(e).__name__}", None]
         if accepted:
             self.stats["shares_accepted"] += 1
             self.stats["last_accept_latency"] = latency

@@ -29,7 +29,7 @@ from otedama_tpu.engine.types import Job, ShareOutcome
 from otedama_tpu.engine.vardiff import VardiffConfig, VardiffManager
 from otedama_tpu.kernels import target as tgt
 from otedama_tpu.stratum import protocol as sp
-from otedama_tpu.utils.sha256_host import sha256d
+from otedama_tpu.utils.pow_host import pow_digest
 
 log = logging.getLogger("otedama.stratum.server")
 
@@ -294,7 +294,11 @@ class StratumServer:
                     job = self.jobs.get(sub.job_id)
                     if self.on_block is not None and job is not None:
                         header = jobmod.header_from_share(
-                            dataclasses.replace(job, extranonce1=session.extranonce1),
+                            dataclasses.replace(
+                                job,
+                                extranonce1=session.extranonce1,
+                                extranonce2_size=session.extranonce2_size,
+                            ),
                             sub.extranonce2, sub.ntime, sub.nonce_word,
                         )
                         await self.on_block(header, job, accepted)
@@ -334,11 +338,18 @@ class StratumServer:
             return ShareOutcome.REJECTED_DUPLICATE, None
         session.seen.add(key)
 
-        header = jobmod.header_from_share(
-            dataclasses.replace(job, extranonce1=session.extranonce1),
-            sub.extranonce2, sub.ntime, sub.nonce_word,
-        )
-        digest = sha256d(header)
+        try:
+            header = jobmod.header_from_share(
+                dataclasses.replace(
+                    job,
+                    extranonce1=session.extranonce1,
+                    extranonce2_size=session.extranonce2_size,
+                ),
+                sub.extranonce2, sub.ntime, sub.nonce_word,
+            )
+        except ValueError:
+            return ShareOutcome.REJECTED_INVALID, None
+        digest = pow_digest(header, job.algorithm)
         # credit at the difficulty the session was mining at; allow the
         # previous difficulty during a retarget window
         credit_diff = session.difficulty

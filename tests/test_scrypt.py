@@ -1,0 +1,68 @@
+"""Scrypt kernel correctness vs the hashlib.scrypt (OpenSSL) oracle.
+
+Mirrors the reference's scrypt usage: Litecoin parameters N=1024, r=1, p=1,
+password = salt = the 80-byte header (reference:
+internal/mining/multi_algorithm.go:100-140).
+"""
+
+import hashlib
+import struct
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from otedama_tpu.kernels import scrypt_jax as sc
+from otedama_tpu.kernels import target as tgt
+from otedama_tpu.runtime.search import JobConstants, ScryptXlaBackend
+
+
+def _header76(seed: int = 7) -> bytes:
+    rng = np.random.RandomState(seed)
+    return rng.bytes(76)
+
+
+def _oracle(header80: bytes) -> bytes:
+    return hashlib.scrypt(
+        header80, salt=header80, n=1024, r=1, p=1,
+        maxmem=64 * 1024 * 1024, dklen=32,
+    )
+
+
+def test_scrypt_matches_hashlib_across_lanes():
+    h76 = _header76()
+    words = sc.header_words19(h76)
+    nonces = np.array([0, 1, 2, 0xDEADBEEF, 0xFFFFFFFF], dtype=np.uint32)
+    d8 = sc.scrypt_1024_1_1(words, jnp.asarray(nonces), rolled=True)
+    got = np.stack([np.asarray(x) for x in d8], axis=-1)  # [B, 8] BE words
+    for lane, nw in enumerate(nonces.tolist()):
+        header80 = h76 + struct.pack(">I", nw)
+        want = np.frombuffer(_oracle(header80), dtype=">u4").astype(np.uint32)
+        assert np.array_equal(got[lane], want), f"lane {lane} nonce {nw:#x}"
+
+
+def test_scrypt_search_finds_planted_winner():
+    h76 = _header76(seed=11)
+    base, span = 100, 16
+    digests = {
+        n: _oracle(h76 + struct.pack(">I", n)) for n in range(base, base + span)
+    }
+    values = {n: int.from_bytes(d, "little") for n, d in digests.items()}
+    winner = min(values, key=values.get)
+    # target exactly at the winner's value: only that lane may hit
+    jc = JobConstants.from_header_prefix(h76, values[winner])
+
+    backend = ScryptXlaBackend(chunk=span)
+    res = backend.search(jc, base, span)
+    assert res.hashes == span
+    assert [w.nonce_word for w in res.winners] == [winner]
+    assert res.winners[0].digest == digests[winner]
+    assert tgt.hash_meets_target(res.winners[0].digest, jc.target)
+
+
+def test_scrypt_registered_as_implemented():
+    from otedama_tpu.engine import algos
+
+    assert algos.supports("scrypt", "xla")
+    assert "scrypt" in algos.names(implemented_only=True)
