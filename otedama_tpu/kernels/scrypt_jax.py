@@ -178,13 +178,11 @@ def scrypt_search_step(header19, base, limbs8, *, n: int, rolled: bool = True):
 
 
 def scrypt_digest_host(header80: bytes) -> bytes:
-    """Scalar oracle via hashlib (OpenSSL scrypt)."""
-    import hashlib
+    """Scalar oracle via hashlib (OpenSSL scrypt) — the same host path the
+    validation side uses (utils.pow_host), so miner and pool can't diverge."""
+    from otedama_tpu.utils.pow_host import scrypt_1024_1_1
 
-    return hashlib.scrypt(
-        header80, salt=header80, n=SCRYPT_N, r=SCRYPT_R, p=SCRYPT_P,
-        maxmem=64 * 1024 * 1024, dklen=32,
-    )
+    return scrypt_1024_1_1(header80)
 
 
 def header_words19(header76: bytes) -> tuple[int, ...]:

@@ -79,6 +79,7 @@ class P2PNode:
         self._tasks: list[asyncio.Task] = []
         self._peer_tasks: dict[str, asyncio.Task] = {}
         self._ping_sent: dict[str, float] = {}
+        self._dialing: set[tuple[str, int]] = set()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -185,6 +186,11 @@ class P2PNode:
         )
         writer.write(ack.encode())
         await writer.drain()
+        if hello.sender in self.peers:
+            # a concurrent handshake for the same node won the race while we
+            # awaited the drain — keep the registered connection
+            writer.close()
+            return
         self._register_peer(
             hello.sender, reader, writer,
             listen_port=int(hello.payload.get("listen_port", 0)),
@@ -356,10 +362,16 @@ class P2PNode:
         self._tasks = [t for t in self._tasks if not t.done()]
 
     async def _connect_quietly(self, host: str, port: int) -> None:
+        key = (host, port)
+        if key in self._dialing:
+            return
+        self._dialing.add(key)
         try:
             await self.connect(host, port)
         except (OSError, ConnectionError, asyncio.TimeoutError, ValueError):
             pass
+        finally:
+            self._dialing.discard(key)
 
     # -- keepalive ----------------------------------------------------------
 

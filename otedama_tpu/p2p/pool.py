@@ -13,6 +13,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import time
+from collections import OrderedDict
 
 from otedama_tpu.p2p.messages import MessageType, P2PMessage
 from otedama_tpu.p2p.node import NodeConfig, P2PNode, Peer
@@ -36,7 +37,9 @@ class P2PPool:
         self.node = P2PNode(config)
         self.window = window
         self.ledger: list[LedgerEntry] = []
-        self._ledger_keys: set[tuple] = set()
+        # dedup keys outlive the ledger window (bounded LRU) so late syncs
+        # can't re-append shares that were already counted and then trimmed
+        self._ledger_keys: "OrderedDict[tuple, None]" = OrderedDict()
         self.blocks_seen: list[dict] = []
         self.jobs_seen: dict[str, dict] = {}
         self.node.on(MessageType.SHARE, self._on_share)
@@ -140,14 +143,12 @@ class P2PPool:
                entry.difficulty)
         if key in self._ledger_keys:
             return
-        self._ledger_keys.add(key)
+        self._ledger_keys[key] = None
+        while len(self._ledger_keys) > 8 * self.window:
+            self._ledger_keys.popitem(last=False)
         self.ledger.append(entry)
         if len(self.ledger) > 2 * self.window:
             del self.ledger[: -self.window]
-            self._ledger_keys = {
-                (e.origin, e.worker, e.job_id, e.timestamp, e.difficulty)
-                for e in self.ledger
-            }
 
     def weights(self) -> dict[str, float]:
         """PPLNS weights over the last-N ledger window — every node computes

@@ -110,6 +110,56 @@ def _default_rolled() -> bool:
         return True
 
 
+def _chunked_search(
+    jc: JobConstants,
+    base: int,
+    count: int,
+    chunk: int,
+    step,
+    digest_fn,
+    verify: bool = False,
+) -> SearchResult:
+    """Shared chunked-search driver: fixed-shape device steps with overscan,
+    best-limb telemetry, and host-side winner digestion.
+
+    ``step(base) -> (hits, h0)`` runs one device batch of ``chunk`` lanes;
+    ``digest_fn(nonce_word) -> bytes`` produces the candidate's digest on the
+    host; ``verify`` re-checks candidates against the exact 256-bit target
+    (for steps whose device filter is approximate).
+    """
+    winners: list[Winner] = []
+    best = 0xFFFFFFFF
+    done = 0
+    while done < count:
+        hits, h0 = step((base + done) & 0xFFFFFFFF)
+        hits = np.asarray(hits)
+        h0 = np.asarray(h0)
+        valid = min(chunk, count - done)
+        best = min(best, int(h0[:valid].min()))
+        for idx in np.nonzero(hits[:valid])[0].tolist():
+            w = (base + done + idx) & 0xFFFFFFFF
+            digest = digest_fn(w)
+            if not verify or tgt.hash_meets_target(digest, jc.target):
+                winners.append(Winner(w, digest))
+        done += valid
+    return SearchResult(winners, count, best)
+
+
+def _scalar_search(
+    jc: JobConstants, base: int, count: int, digest_fn
+) -> SearchResult:
+    """Shared pure-host search loop (protocol-test oracles)."""
+    winners: list[Winner] = []
+    best = 0xFFFFFFFF
+    for i in range(count):
+        w = (base + i) & 0xFFFFFFFF
+        digest = digest_fn(w)
+        best = min(best, int.from_bytes(digest[28:32], "little"))
+        if tgt.hash_meets_target(digest, jc.target):
+            winners.append(Winner(w, digest))
+    return SearchResult(winners, count, best)
+
+
 class XlaBackend:
     """Exact jnp/XLA search; works on any JAX backend."""
 
@@ -123,25 +173,15 @@ class XlaBackend:
         ms = jnp.asarray(np.array(jc.midstate, dtype=np.uint32))
         tl = jnp.asarray(np.array(jc.tail, dtype=np.uint32))
         lb = jnp.asarray(jc.limbs)
-        winners: list[Winner] = []
-        best = 0xFFFFFFFF
-        done = 0
-        while done < count:
-            n = self.chunk  # fixed shape avoids recompiles; extra lanes are overscan
-            hits, h0 = _xla_search_step(
-                ms, tl, jnp.uint32((base + done) & 0xFFFFFFFF), lb,
-                n=n, rolled=self.rolled,
+
+        def step(b):
+            return _xla_search_step(
+                ms, tl, jnp.uint32(b), lb, n=self.chunk, rolled=self.rolled
             )
-            hits = np.asarray(hits)
-            h0 = np.asarray(h0)
-            valid = min(n, count - done)
-            hits = hits[:valid]
-            best = min(best, int(h0[:valid].min()))
-            for idx in np.nonzero(hits)[0].tolist():
-                w = (base + done + idx) & 0xFFFFFFFF
-                winners.append(Winner(w, jc.digest_for(w)))
-            done += valid
-        return SearchResult(winners, count, best)
+
+        return _chunked_search(
+            jc, base, count, self.chunk, step, jc.digest_for
+        )
 
 
 class PallasBackend:
@@ -215,26 +255,17 @@ class ScryptXlaBackend:
             np.array(sc.header_words19(jc.header76), dtype=np.uint32)
         )
         lb = jnp.asarray(jc.limbs)
-        winners: list[Winner] = []
-        best = 0xFFFFFFFF
-        done = 0
-        while done < count:
-            n = self.chunk
-            hits, h0 = sc.scrypt_search_step(
-                h19, jnp.uint32((base + done) & 0xFFFFFFFF), lb,
-                n=n, rolled=self.rolled,
+
+        def step(b):
+            return sc.scrypt_search_step(
+                h19, jnp.uint32(b), lb, n=self.chunk, rolled=self.rolled
             )
-            hits = np.asarray(hits)
-            h0 = np.asarray(h0)
-            valid = min(n, count - done)
-            best = min(best, int(h0[:valid].min()))
-            for idx in np.nonzero(hits[:valid])[0].tolist():
-                w = (base + done + idx) & 0xFFFFFFFF
-                digest = sc.scrypt_digest_host(jc.header_for(w))
-                if tgt.hash_meets_target(digest, jc.target):
-                    winners.append(Winner(w, digest))
-            done += valid
-        return SearchResult(winners, count, best)
+
+        return _chunked_search(
+            jc, base, count, self.chunk, step,
+            lambda w: sc.scrypt_digest_host(jc.header_for(w)),
+            verify=True,
+        )
 
 
 class ScryptPythonBackend:
@@ -246,15 +277,9 @@ class ScryptPythonBackend:
     def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
         from otedama_tpu.kernels import scrypt_jax as sc
 
-        winners: list[Winner] = []
-        best = 0xFFFFFFFF
-        for i in range(count):
-            w = (base + i) & 0xFFFFFFFF
-            digest = sc.scrypt_digest_host(jc.header_for(w))
-            best = min(best, int.from_bytes(digest[28:32], "little"))
-            if tgt.hash_meets_target(digest, jc.target):
-                winners.append(Winner(w, digest))
-        return SearchResult(winners, count, best)
+        return _scalar_search(
+            jc, base, count, lambda w: sc.scrypt_digest_host(jc.header_for(w))
+        )
 
 
 class PythonBackend:
@@ -265,16 +290,7 @@ class PythonBackend:
     name = "python"
 
     def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
-        winners: list[Winner] = []
-        best = 0xFFFFFFFF
-        for i in range(count):
-            w = (base + i) & 0xFFFFFFFF
-            digest = jc.digest_for(w)
-            hi = int.from_bytes(digest[28:32], "little")
-            best = min(best, hi)
-            if tgt.hash_meets_target(digest, jc.target):
-                winners.append(Winner(w, digest))
-        return SearchResult(winners, count, best)
+        return _scalar_search(jc, base, count, jc.digest_for)
 
 
 def make_backend(kind: str, algorithm: str = "sha256d", **kwargs):

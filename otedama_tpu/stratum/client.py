@@ -182,7 +182,15 @@ class StratumClient:
                     if not line:
                         raise ConnectionError("closed during handshake")
                     self._dispatch(sp.decode_line(line))
-            return await asyncio.wait_for(fut, self.config.response_timeout)
+            try:
+                return await asyncio.wait_for(fut, self.config.response_timeout)
+            except asyncio.CancelledError:
+                if fut.cancelled():
+                    # internal: _close() cancelled the pending future on
+                    # reconnect — surface as a connection error, not as a
+                    # cancellation of the caller's task
+                    raise ConnectionError("connection closed while waiting") from None
+                raise
         finally:
             self._pending.pop(msg_id, None)
 
@@ -263,11 +271,11 @@ class StratumClient:
             latency = time.monotonic() - t0
             accepted = False
             err = e.as_triple()
-        except (asyncio.TimeoutError, ConnectionError, asyncio.CancelledError) as e:
+        except (asyncio.TimeoutError, ConnectionError) as e:
             # pool went silent or the session dropped mid-submit: report a
             # rejected share instead of crashing the caller's submit loop
-            if isinstance(e, asyncio.CancelledError) and self._stop:
-                raise
+            # (external task cancellation propagates — _call converts internal
+            # future cancellation to ConnectionError)
             latency = time.monotonic() - t0
             accepted = False
             err = [sp.ERR_OTHER, f"no pool response: {type(e).__name__}", None]

@@ -56,6 +56,7 @@ class AcceptedShare:
     difficulty: float        # difficulty credited (session difficulty at job time)
     actual_difficulty: float # difficulty the digest actually achieved
     digest: bytes
+    header: bytes            # the 80-byte header the share hashed
     is_block: bool
     submitted_at: float
 
@@ -293,15 +294,7 @@ class StratumServer:
                     self.stats["blocks_found"] += 1
                     job = self.jobs.get(sub.job_id)
                     if self.on_block is not None and job is not None:
-                        header = jobmod.header_from_share(
-                            dataclasses.replace(
-                                job,
-                                extranonce1=session.extranonce1,
-                                extranonce2_size=session.extranonce2_size,
-                            ),
-                            sub.extranonce2, sub.ntime, sub.nonce_word,
-                        )
-                        await self.on_block(header, job, accepted)
+                        await self.on_block(accepted.header, job, accepted)
                 if self.on_share is not None:
                     await self.on_share(accepted)
         else:
@@ -370,6 +363,7 @@ class StratumServer:
             difficulty=credit_diff,
             actual_difficulty=tgt.difficulty_of_digest(digest),
             digest=digest,
+            header=header,
             is_block=is_block,
             submitted_at=time.time(),
         )

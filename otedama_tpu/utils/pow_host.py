@@ -33,7 +33,7 @@ def pow_digest(header: bytes, algorithm: str = "sha256d") -> bytes:
     if algorithm in ("scrypt", "litecoin"):
         return scrypt_1024_1_1(header)
     if algorithm in ("x11", "dash"):
-        from otedama_tpu.kernels.x11_ref import x11_digest
+        from otedama_tpu.kernels.x11 import x11_digest
 
         return x11_digest(header)
     raise ValueError(f"no host PoW digest for algorithm {algorithm!r}")
