@@ -20,15 +20,28 @@ External validation status (offline environment, no third-party oracles):
   BLAKE submission: 1 zero byte and 144 zero bytes).
 - cubehash512: VALIDATED IV (the 160-round parameter-derived IV reproduces
   the published CubeHash16/32-512 IV table).
-- skein512, bmw512: spec-faithful, structurally tested, awaiting an
-  external KAT source.
+- groestl512: VALIDATED (empty-string digest matches the published
+  Groestl-512 KAT; AES S-box derived from its GF(2^8) definition).
+- skein512, bmw512, jh512: spec-faithful, structurally tested, awaiting an
+  external KAT source (jh's round constants and IV are self-derived from
+  the spec's generation rules).
 """
 
 from __future__ import annotations
 
 import numpy as np
 
-from otedama_tpu.kernels.x11 import blake, bmw, cubehash, keccak, skein
+from otedama_tpu.kernels.x11 import (
+    blake,
+    bmw,
+    cubehash,
+    echo,
+    groestl,
+    jh,
+    keccak,
+    luffa,
+    skein,
+)
 
 ORDER = (
     "blake512", "bmw512", "groestl512", "skein512", "jh512", "keccak512",
@@ -39,9 +52,13 @@ ORDER = (
 STAGES_BYTES = {
     "blake512": blake.blake512_bytes,
     "bmw512": bmw.bmw512_bytes,
+    "groestl512": groestl.groestl512_bytes,
     "skein512": skein.skein512_bytes,
+    "jh512": jh.jh512_bytes,
     "keccak512": keccak.keccak512_bytes,
+    "luffa512": luffa.luffa512_bytes,
     "cubehash512": cubehash.cubehash512_bytes,
+    "echo512": echo.echo512_bytes,
 }
 
 

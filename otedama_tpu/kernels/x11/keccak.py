@@ -89,7 +89,6 @@ def _absorb(data_words: np.ndarray, n_bytes: int, domain: int) -> list:
     n_blocks = n_bytes // RATE_512 + 1
     total_words = n_blocks * rate_words
     padded = np.zeros((B, total_words), dtype=np.uint64)
-    full_words = n_bytes // 8
     padded[:, :data_words.shape[1]] = data_words
     # domain byte at position n_bytes
     word_i, byte_i = divmod(n_bytes, 8)

@@ -107,7 +107,11 @@ class StratumClient:
         self.connected.clear()
         for fut in self._pending.values():
             if not fut.done():
-                fut.cancel()
+                # a real exception, not cancel(): wait_for also cancels the
+                # future when the *caller's* task is cancelled, so cancel()
+                # would make internal closure indistinguishable from external
+                # cancellation at the await site
+                fut.set_exception(ConnectionError("connection closed"))
         self._pending.clear()
 
     async def _session_loop(self) -> None:
@@ -182,15 +186,7 @@ class StratumClient:
                     if not line:
                         raise ConnectionError("closed during handshake")
                     self._dispatch(sp.decode_line(line))
-            try:
-                return await asyncio.wait_for(fut, self.config.response_timeout)
-            except asyncio.CancelledError:
-                if fut.cancelled():
-                    # internal: _close() cancelled the pending future on
-                    # reconnect — surface as a connection error, not as a
-                    # cancellation of the caller's task
-                    raise ConnectionError("connection closed while waiting") from None
-                raise
+            return await asyncio.wait_for(fut, self.config.response_timeout)
         finally:
             self._pending.pop(msg_id, None)
 
@@ -274,8 +270,8 @@ class StratumClient:
         except (asyncio.TimeoutError, ConnectionError) as e:
             # pool went silent or the session dropped mid-submit: report a
             # rejected share instead of crashing the caller's submit loop
-            # (external task cancellation propagates — _call converts internal
-            # future cancellation to ConnectionError)
+            # (external task cancellation propagates as CancelledError;
+            # internal closure surfaces as ConnectionError via the future)
             latency = time.monotonic() - t0
             accepted = False
             err = [sp.ERR_OTHER, f"no pool response: {type(e).__name__}", None]

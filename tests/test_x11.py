@@ -15,7 +15,17 @@ import numpy as np
 import pytest
 
 from otedama_tpu.kernels import x11
-from otedama_tpu.kernels.x11 import blake, bmw, cubehash, keccak, skein
+from otedama_tpu.kernels.x11 import (
+    blake,
+    bmw,
+    cubehash,
+    echo,
+    groestl,
+    jh,
+    keccak,
+    luffa,
+    skein,
+)
 
 
 # -- keccak: real external oracle -------------------------------------------
@@ -57,14 +67,33 @@ def test_cubehash_iv_matches_published_words():
     ]
 
 
+# -- groestl: published empty-string KAT + S-box definition -----------------
+
+def test_groestl512_published_empty_kat():
+    assert groestl.groestl512_bytes(b"").hex() == (
+        "6d3ad29d279110eef3adbd66de2a0345a77baede1557f5d099fce0c03d6dc2ba"
+        "8e6d4a6633dfbd66053c20faa87d1a11f39a7fbe4a6c2f009801370308fc4ad8"
+    )
+
+
+def test_aes_sbox_definition_points():
+    sb = groestl.aes_sbox()
+    assert sb[0x00] == 0x63 and sb[0x01] == 0x7C
+    assert sb[0x53] == 0xED and sb[0xFF] == 0x16
+
+
 # -- structural tests for every stage ---------------------------------------
 
 STAGE_FNS = {
     "blake512": blake.blake512_bytes,
     "bmw512": bmw.bmw512_bytes,
+    "groestl512": groestl.groestl512_bytes,
     "skein512": skein.skein512_bytes,
+    "jh512": jh.jh512_bytes,
     "keccak512": keccak.keccak512_bytes,
+    "luffa512": luffa.luffa512_bytes,
     "cubehash512": cubehash.cubehash512_bytes,
+    "echo512": echo.echo512_bytes,
 }
 
 
@@ -100,16 +129,30 @@ def test_lane_batching_matches_scalar(mod, dtype):
         cubehash: cubehash.cubehash512,
     }[mod]
     batched = fn(arr, 80)
-    scalar_fn = {
-        blake: blake.blake512_bytes,
-        bmw: bmw.bmw512_bytes,
-        skein: skein.skein512_bytes,
-        keccak: keccak.keccak512_bytes,
-        cubehash: cubehash.cubehash512_bytes,
-    }[mod]
+    scalar_fn = STAGE_FNS[mod.__name__.rsplit(".", 1)[-1] + "512"]
     for lane, m in enumerate(msgs):
         got = batched[lane].astype(dtype).tobytes()
         assert got == scalar_fn(m), f"{mod.__name__} lane {lane}"
+
+
+@pytest.mark.parametrize("mod", [groestl, jh, echo])
+def test_byte_lane_batching_matches_scalar(mod):
+    msgs = [os.urandom(80) for _ in range(4)]
+    arr = np.stack([np.frombuffer(m, dtype=np.uint8) for m in msgs])
+    fn = {groestl: groestl.groestl512, jh: jh.jh512, echo: echo.echo512}[mod]
+    scalar = STAGE_FNS[mod.__name__.rsplit(".", 1)[-1] + "512"]
+    batched = fn(arr, 80)
+    for lane, m in enumerate(msgs):
+        assert batched[lane].tobytes() == scalar(m), f"lane {lane}"
+
+
+def test_luffa_lane_batching_matches_scalar():
+    msgs = [os.urandom(80) for _ in range(4)]
+    arr = np.stack([np.frombuffer(m, dtype=">u4") for m in msgs]).astype(np.uint32)
+    batched = luffa.luffa512(arr, 80)
+    for lane, m in enumerate(msgs):
+        got = batched[lane].astype(">u4").tobytes()
+        assert got == luffa.luffa512_bytes(m), f"lane {lane}"
 
 
 # -- chain gating ------------------------------------------------------------
