@@ -1,0 +1,207 @@
+"""REST + WebSocket + Prometheus API server.
+
+Reference parity: internal/api/server.go:334-407 (route table) — the
+/api/v1 surface below mirrors the reference's resource names; /metrics
+serves the Prometheus family of unified_monitoring.go; /ws pushes periodic
+stats snapshots (monitoring/unified_monitoring.go:403-530 WS broadcast).
+
+Decoupling: the server renders *snapshot providers* (name -> callable), so
+any subsystem (engine, pool, p2p, switcher) plugs in without the API
+importing it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import logging
+import time
+from typing import Callable
+
+from otedama_tpu.api.http import HttpServer, Request, Response, WebSocket
+from otedama_tpu.api.metrics import MetricsRegistry, SystemCollector
+from otedama_tpu.security.auth import AuthManager, TokenError
+from otedama_tpu.security.ratelimit import RateLimiter
+
+log = logging.getLogger("otedama.api")
+
+
+@dataclasses.dataclass
+class ApiConfig:
+    host: str = "127.0.0.1"
+    port: int = 8080
+    rate_limit_per_minute: float = 600.0
+    ws_push_seconds: float = 2.0
+    auth_secret: str = ""            # empty = admin/control routes disabled
+
+
+class ApiServer:
+    def __init__(self, config: ApiConfig | None = None,
+                 registry: MetricsRegistry | None = None):
+        self.config = config or ApiConfig()
+        self.registry = registry or MetricsRegistry()
+        self.system_collector = SystemCollector(self.registry)
+        self.providers: dict[str, Callable[[], dict]] = {}
+        self.controls: dict[str, Callable] = {}   # name -> async control fn
+        self.auth: AuthManager | None = (
+            AuthManager(self.config.auth_secret) if self.config.auth_secret else None
+        )
+        self.limiter = RateLimiter(self.config.rate_limit_per_minute)
+        self.http = HttpServer(self.config.host, self.config.port)
+        self.started_at = time.time()
+        self._install_routes()
+
+    # -- wiring ---------------------------------------------------------------
+
+    def add_provider(self, name: str, fn: Callable[[], dict]) -> None:
+        self.providers[name] = fn
+
+    def add_control(self, name: str, fn: Callable) -> None:
+        """Async fn(params: dict) -> dict; exposed as POST /api/v1/control/{name},
+        requires auth when configured."""
+        self.controls[name] = fn
+
+    async def start(self) -> None:
+        await self.http.start()
+
+    async def stop(self) -> None:
+        await self.http.stop()
+
+    @property
+    def port(self) -> int:
+        return self.http.port
+
+    # -- routes ---------------------------------------------------------------
+
+    def _install_routes(self) -> None:
+        h = self.http
+        h.middleware(self._rate_limit)
+        h.route("GET", "/health", self._health)
+        h.route("GET", "/api/v1/status", self._status)
+        h.route("GET", "/api/v1/stats", self._status)
+        h.route("GET", "/api/v1/stats/{name}", self._stats_one)
+        h.route("GET", "/api/v1/algorithms", self._algorithms)
+        h.route("GET", "/metrics", self._metrics)
+        h.route("POST", "/api/v1/auth/login", self._login)
+        h.route("POST", "/api/v1/control/{name}", self._control)
+        h.websocket("/ws", self._ws_stats)
+
+    async def _rate_limit(self, request: Request) -> Response | None:
+        if not self.limiter.allow(request.peer):
+            return Response.error(429, "rate limited")
+        return None
+
+    async def _health(self, request: Request) -> Response:
+        return Response.json({
+            "status": "ok",
+            "uptime_seconds": round(time.time() - self.started_at, 1),
+        })
+
+    def _snapshot(self) -> dict:
+        out = {}
+        for name, fn in self.providers.items():
+            try:
+                out[name] = fn()
+            except Exception as e:  # one broken provider must not kill /status
+                log.exception("provider %s failed", name)
+                out[name] = {"error": str(e)}
+        return out
+
+    async def _status(self, request: Request) -> Response:
+        return Response.json({"timestamp": time.time(), **self._snapshot()})
+
+    async def _stats_one(self, request: Request) -> Response:
+        name = request.params["name"]
+        fn = self.providers.get(name)
+        if fn is None:
+            return Response.error(404, f"no stats provider {name!r}")
+        return Response.json(fn())
+
+    async def _algorithms(self, request: Request) -> Response:
+        from otedama_tpu.engine import algos
+
+        out = []
+        for name in algos.names():
+            spec = algos.get(name)
+            out.append({
+                "name": spec.name,
+                "implemented": spec.implemented(),
+                "backends": list(spec.backends),
+                "memory_hard": spec.memory_hard,
+                "chained": spec.chained,
+            })
+        return Response.json(out)
+
+    async def _metrics(self, request: Request) -> Response:
+        self.system_collector.collect()
+        return Response(
+            200, self.registry.render(),
+            "text/plain; version=0.0.4; charset=utf-8",
+        )
+
+    async def _login(self, request: Request) -> Response:
+        if self.auth is None:
+            return Response.error(403, "auth disabled (no api.auth_secret)")
+        try:
+            body = request.json() or {}
+            token = self.auth.login(
+                str(body.get("username", "")),
+                str(body.get("password", "")),
+                str(body.get("totp", "")),
+            )
+        except (json.JSONDecodeError, TokenError) as e:
+            return Response.error(401, str(e))
+        return Response.json({"token": token})
+
+    async def _control(self, request: Request) -> Response:
+        name = request.params["name"]
+        fn = self.controls.get(name)
+        if fn is None:
+            return Response.error(404, f"no control {name!r}")
+        if self.auth is None:
+            return Response.error(403, "control requires api.auth_secret")
+        header = request.headers.get("authorization", "")
+        token = header[7:] if header.lower().startswith("bearer ") else ""
+        try:
+            claims = self.auth.authorize(token, "mining.control")
+        except TokenError as e:
+            return Response.error(401, str(e))
+        try:
+            params = request.json() or {}
+        except json.JSONDecodeError:
+            return Response.error(400, "bad json body")
+        try:
+            result = await fn(params)
+        except Exception as e:
+            log.exception("control %s failed", name)
+            return Response.error(500, str(e))
+        return Response.json({"ok": True, "by": claims.get("sub"), "result": result})
+
+    async def _ws_stats(self, request: Request, ws: WebSocket) -> None:
+        """Push stats snapshots until the client goes away."""
+        while not ws.closed:
+            await ws.send_json({"timestamp": time.time(), **self._snapshot()})
+            try:
+                await asyncio.wait_for(
+                    ws.recv(), timeout=self.config.ws_push_seconds
+                )
+            except asyncio.TimeoutError:
+                continue
+
+    # -- metric sync ----------------------------------------------------------
+
+    def sync_engine_metrics(self, snapshot: dict) -> None:
+        """Map an engine snapshot onto the reference's metric names."""
+        reg = self.registry
+        reg.gauge_set("otedama_hashrate", snapshot.get("hashrate", 0.0),
+                      help_="Total hashrate in H/s")
+        for device, d in snapshot.get("devices", {}).items():
+            reg.gauge_set("otedama_device_hashrate", d.get("hashrate", 0.0),
+                          {"device": device}, help_="Per-device hashrate")
+        shares = snapshot.get("shares", {})
+        for status in ("found", "accepted", "rejected", "stale"):
+            reg.counter_set("otedama_shares_total", shares.get(status, 0),
+                            {"status": status}, help_="Share counters")
+        reg.counter_set("otedama_blocks_found_total",
+                        snapshot.get("blocks_found", 0), help_="Blocks found")

@@ -1,0 +1,381 @@
+"""Application composition root + lifecycle.
+
+Reference parity: internal/app/application.go:32-135 (New/Start/Shutdown)
+and internal/core/unified.go:21-247 (OtedamaSystem composing mining engine,
+pool manager, stratum server, monitoring; ordered start, reverse-order
+shutdown, health monitor loop). Modes:
+
+- miner  (client): engine + upstream stratum client(s) with failover
+- solo   : engine + chain client (mock or bitcoind RPC) as job source
+- pool   : stratum server + pool manager + persistence
+- p2p    : pool mode + gossip overlay
+
+Any combination can be enabled from one AppConfig; the API server exposes
+every enabled subsystem through snapshot providers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+from otedama_tpu.api.server import ApiConfig as ApiServerConfig, ApiServer
+from otedama_tpu.config.schema import AppConfig
+from otedama_tpu.engine.algo_manager import AlgorithmManager
+from otedama_tpu.engine.engine import EngineConfig, MiningEngine
+from otedama_tpu.engine.types import Job, Share
+from otedama_tpu.engine.vardiff import VardiffConfig
+from otedama_tpu.kernels import target as tgt
+
+log = logging.getLogger("otedama.app")
+
+
+def parse_upstream_url(url: str, default_port: int = 3333) -> tuple[str, int]:
+    """'pool.example.com', 'host:3333' and 'stratum+tcp://host:3333' all work."""
+    rest = url.strip()
+    if "://" in rest:
+        rest = rest.split("://", 1)[1]
+    rest = rest.rstrip("/")
+    host, _, port_str = rest.rpartition(":")
+    if not host:
+        return rest, default_port
+    try:
+        return host, int(port_str)
+    except ValueError:
+        return rest, default_port
+
+
+class Application:
+    def __init__(self, config: AppConfig | None = None):
+        self.config = config or AppConfig()
+        self.algo_manager = AlgorithmManager(self.config.mining.backend)
+        self.engine: MiningEngine | None = None
+        self.client = None          # stratum client (miner mode)
+        self.chain = None           # chain client (solo mode)
+        self.server = None          # stratum server (pool mode)
+        self.pool = None            # pool manager
+        self.db = None
+        self.p2p = None
+        self.api: ApiServer | None = None
+        self._solo_jobs: dict[str, Job] = {}
+        self._tasks: list[asyncio.Task] = []
+        self._started: list = []    # components in start order
+        self.started_at = 0.0
+
+    # -- construction ---------------------------------------------------------
+
+    def _build_engine(self) -> MiningEngine:
+        cfg = self.config.mining
+        backend = self.algo_manager.backend_for(cfg.algorithm)
+        engine = MiningEngine(
+            backends={getattr(backend, "name", "device0"): backend},
+            on_share=self._on_share,
+            config=EngineConfig(
+                worker_name=cfg.worker_name,
+                algorithm=cfg.algorithm,
+                batch_size=cfg.batch_size,
+            ),
+        )
+        return engine
+
+    async def _on_share(self, share: Share) -> None:
+        if self.client is not None:
+            result = await self.client.submit(share)
+            if self.engine is not None:
+                if result.accepted:
+                    self.engine.stats.shares_accepted += 1
+                else:
+                    self.engine.stats.shares_rejected += 1
+        elif self.chain is not None:
+            # solo: submit headers that meet the network target to the chain
+            if self.engine is not None:
+                self.engine.stats.shares_accepted += 1
+            job = self._solo_jobs.get(share.job_id)
+            if job is None:
+                return
+            if tgt.hash_meets_target(share.digest, tgt.bits_to_target(job.nbits)):
+                from otedama_tpu.engine.jobs import header_from_share
+
+                header = header_from_share(
+                    job, share.extranonce2, share.ntime, share.nonce_word
+                )
+                outcome = await self.chain.submit_block(header)
+                if outcome.accepted:
+                    log.info("solo block accepted: %s", outcome.block_hash[:24])
+                else:
+                    log.warning("solo block rejected: %s", outcome.reason)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    async def start(self) -> None:
+        self.started_at = time.time()
+        cfg = self.config
+
+        if cfg.pool.enabled:
+            await self._start_pool_side()
+        if cfg.mining.enabled:
+            await self._start_miner_side()
+        if cfg.p2p.enabled:
+            await self._start_p2p()
+        if cfg.api.enabled:
+            await self._start_api()
+        log.info("application started (%s)", ", ".join(
+            name for name, on in (
+                ("mining", cfg.mining.enabled), ("pool", cfg.pool.enabled),
+                ("p2p", cfg.p2p.enabled), ("api", cfg.api.enabled),
+            ) if on
+        ))
+
+    async def _start_pool_side(self) -> None:
+        from otedama_tpu.db import Database
+        from otedama_tpu.pool.blockchain import BitcoinRPCClient, MockChainClient
+        from otedama_tpu.pool.manager import PoolConfig, PoolManager
+        from otedama_tpu.pool.payouts import PayoutConfig, PayoutScheme
+        from otedama_tpu.stratum.server import ServerConfig, StratumServer
+
+        cfg = self.config
+        self.db = Database(cfg.pool.database)
+        chain = (
+            BitcoinRPCClient(cfg.pool.chain_rpc_url, cfg.pool.chain_rpc_user,
+                             cfg.pool.chain_rpc_password)
+            if cfg.pool.chain_rpc_url
+            else MockChainClient()
+        )
+        self.pool = PoolManager(
+            self.db, chain,
+            config=PoolConfig(payout=PayoutConfig(
+                scheme=PayoutScheme(cfg.pool.payout_scheme.upper()),
+                pplns_window=cfg.pool.pplns_window,
+                pool_fee_percent=cfg.pool.fee_percent,
+                minimum_payout=cfg.pool.minimum_payout,
+            )),
+        )
+        self.server = StratumServer(
+            ServerConfig(
+                host=cfg.stratum.host,
+                port=cfg.stratum.port,
+                extranonce2_size=cfg.stratum.extranonce2_size,
+                initial_difficulty=cfg.stratum.initial_difficulty,
+                max_clients=cfg.stratum.max_clients,
+                vardiff=VardiffConfig(
+                    target_share_seconds=cfg.stratum.vardiff_target_seconds
+                ),
+            ),
+            on_share=self.pool.on_share,
+            on_block=self.pool.on_block,
+        )
+        await self.server.start()
+        await self.pool.start()
+        self._started += [self.pool, self.server]
+        self._tasks.append(asyncio.create_task(self._template_loop(chain)))
+
+    async def _template_loop(self, chain) -> None:
+        """Poll the chain for templates and broadcast jobs (pool mode)."""
+        last_height = -1
+        while True:
+            try:
+                t = await chain.get_block_template()
+                if t.height != last_height and self.pool is not None:
+                    job = self.pool.job_from_template(
+                        t, algorithm=self.config.mining.algorithm
+                    )
+                    last_height = t.height
+                    if self.server is not None:
+                        self.server.set_job(job, clean=True)
+            except Exception:
+                log.exception("template poll failed")
+            await asyncio.sleep(self.pool.config.template_poll_seconds if self.pool else 5.0)
+
+    async def _start_miner_side(self) -> None:
+        self.engine = self._build_engine()
+        cfg = self.config
+        if cfg.upstreams:
+            from otedama_tpu.pool.failover import FailoverManager, UpstreamPool
+            from otedama_tpu.stratum.client import ClientConfig, StratumClient
+
+            ups = []
+            for u in cfg.upstreams:
+                host, port = parse_upstream_url(u.url)
+                ups.append(UpstreamPool(
+                    name=u.url,
+                    host=host,
+                    port=port,
+                    priority=u.priority,
+                ))
+            self.failover = FailoverManager(ups)
+            selected = self.failover.select()
+            self._upstream_auth = {
+                u.url: (u.username, u.password) for u in cfg.upstreams
+            }
+            username, password = self._upstream_auth[selected.name]
+            self.client = StratumClient(
+                ClientConfig(
+                    host=selected.host, port=selected.port,
+                    username=username, password=password,
+                    algorithm=cfg.mining.algorithm,
+                ),
+                on_job=self.engine.set_job,
+            )
+            self._active_upstream = selected
+            await self.client.start()
+            self.failover.start()
+            self._started += [self.client, self.failover]
+            self._tasks.append(asyncio.create_task(self._failover_loop()))
+        elif self.server is not None:
+            # pool mode with local mining: loop back to our own server
+            from otedama_tpu.stratum.client import ClientConfig, StratumClient
+
+            self.client = StratumClient(
+                ClientConfig(
+                    host="127.0.0.1", port=self.server.port,
+                    username=cfg.mining.worker_name,
+                    algorithm=cfg.mining.algorithm,
+                ),
+                on_job=self.engine.set_job,
+            )
+            await self.client.start()
+            self._started.append(self.client)
+        else:
+            # solo against a chain client
+            from otedama_tpu.pool.blockchain import BitcoinRPCClient, MockChainClient
+
+            self.chain = (
+                BitcoinRPCClient(cfg.pool.chain_rpc_url, cfg.pool.chain_rpc_user,
+                                 cfg.pool.chain_rpc_password)
+                if cfg.pool.chain_rpc_url
+                else MockChainClient()
+            )
+            self._tasks.append(asyncio.create_task(self._solo_job_loop()))
+        await self.engine.start()
+        self._started.append(self.engine)
+
+    async def _failover_loop(self) -> None:
+        """Re-point the stratum client when a better upstream wins the
+        health-scored selection (reference: advanced_failover strategies)."""
+        from otedama_tpu.stratum.client import ClientConfig, StratumClient
+
+        while True:
+            await asyncio.sleep(self.failover.check_interval)
+            selected = self.failover.select()
+            if selected is self._active_upstream:
+                continue
+            log.info("failing over to upstream %s", selected.name)
+            old = self.client
+            username, password = self._upstream_auth[selected.name]
+            self.client = StratumClient(
+                ClientConfig(
+                    host=selected.host, port=selected.port,
+                    username=username, password=password,
+                    algorithm=self.config.mining.algorithm,
+                ),
+                on_job=self.engine.set_job,
+            )
+            self._active_upstream = selected
+            await self.client.start()
+            if old is not None:
+                await old.stop()
+
+    async def _solo_job_loop(self) -> None:
+        counter = 0
+        last_height = -1
+        while True:
+            try:
+                t = await self.chain.get_block_template()
+                if t.height != last_height:
+                    counter += 1
+                    last_height = t.height
+                    job = Job(
+                        job_id=f"solo-{counter:x}",
+                        prev_hash=t.prev_hash,
+                        coinb1=t.coinb1,
+                        coinb2=t.coinb2,
+                        merkle_branch=t.merkle_branch,
+                        version=t.version,
+                        nbits=t.nbits,
+                        ntime=t.ntime,
+                        clean=True,
+                        algorithm=self.config.mining.algorithm,
+                        share_target=tgt.bits_to_target(t.nbits),
+                    )
+                    self._solo_jobs[job.job_id] = job
+                    if len(self._solo_jobs) > 64:
+                        for jid in list(self._solo_jobs)[:-32]:
+                            del self._solo_jobs[jid]
+                    if self.engine is not None:
+                        self.engine.set_job(job)
+            except Exception:
+                log.exception("solo template poll failed")
+            await asyncio.sleep(5.0)
+
+    async def _start_p2p(self) -> None:
+        from otedama_tpu.p2p.node import NodeConfig
+        from otedama_tpu.p2p.pool import P2PPool
+
+        cfg = self.config.p2p
+        bootstrap = []
+        for entry in cfg.bootstrap:
+            host, _, port = str(entry).rpartition(":")
+            if host:
+                bootstrap.append((host, int(port)))
+        self.p2p = P2PPool(NodeConfig(
+            host=cfg.host, port=cfg.port, max_peers=cfg.max_peers,
+            bootstrap=bootstrap,
+        ))
+        await self.p2p.start()
+        self._started.append(self.p2p)
+
+    async def _start_api(self) -> None:
+        cfg = self.config.api
+        self.api = ApiServer(ApiServerConfig(
+            host=cfg.host, port=cfg.port,
+            rate_limit_per_minute=cfg.rate_limit_per_minute,
+            auth_secret=cfg.auth_secret,
+        ))
+        if self.engine is not None:
+            self.api.add_provider("engine", self.engine.snapshot)
+        if self.client is not None:
+            self.api.add_provider("upstream", lambda: dict(self.client.stats))
+        if self.server is not None:
+            self.api.add_provider("stratum", self.server.snapshot)
+        if self.pool is not None:
+            self.api.add_provider("pool", self.pool.snapshot)
+        if self.p2p is not None:
+            self.api.add_provider("p2p", self.p2p.snapshot)
+        self.api.add_provider("benchmarks", self.algo_manager.snapshot)
+        await self.api.start()
+        self._started.append(self.api)
+        self._tasks.append(asyncio.create_task(self._metrics_loop()))
+
+    async def _metrics_loop(self) -> None:
+        while True:
+            await asyncio.sleep(5.0)
+            if self.api is not None and self.engine is not None:
+                self.api.sync_engine_metrics(self.engine.snapshot())
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks.clear()
+        for component in reversed(self._started):
+            try:
+                await component.stop()
+            except Exception:
+                log.exception("stopping %s failed", type(component).__name__)
+        self._started.clear()
+        if self.db is not None:
+            self.db.close()
+        log.info("application stopped")
+
+    def snapshot(self) -> dict:
+        out = {"uptime_seconds": round(time.time() - self.started_at, 1)}
+        if self.engine is not None:
+            out["engine"] = self.engine.snapshot()
+        if self.server is not None:
+            out["stratum"] = self.server.snapshot()
+        if self.pool is not None:
+            out["pool"] = self.pool.snapshot()
+        if self.p2p is not None:
+            out["p2p"] = self.p2p.snapshot()
+        return out

@@ -44,6 +44,27 @@ class AlgorithmSpec:
 
 
 _REGISTRY: dict[str, AlgorithmSpec] = {}
+_KERNELS_LOADED = False
+
+
+def _load_kernels() -> None:
+    """Import kernel modules so their ``mark_implemented`` registrations run.
+
+    Capability queries must reflect what is actually loadable, not which
+    modules a caller happened to import first (the scrypt/x11 backends
+    register themselves at import time).
+    """
+    global _KERNELS_LOADED
+    if _KERNELS_LOADED:
+        return
+    _KERNELS_LOADED = True
+    import importlib
+
+    for mod in ("otedama_tpu.kernels.scrypt_jax",):
+        try:
+            importlib.import_module(mod)
+        except Exception:  # pragma: no cover - kernel import failure is loud elsewhere
+            pass
 
 
 def register(spec: AlgorithmSpec) -> AlgorithmSpec:
@@ -63,6 +84,8 @@ def get(name: str) -> AlgorithmSpec:
 
 
 def names(implemented_only: bool = False) -> list[str]:
+    if implemented_only:
+        _load_kernels()
     out = {s.name: s for s in _REGISTRY.values()}
     return sorted(
         n for n, s in out.items() if s.implemented() or not implemented_only
@@ -70,8 +93,17 @@ def names(implemented_only: bool = False) -> list[str]:
 
 
 def supports(name: str, backend: str) -> bool:
+    _load_kernels()
     try:
         return backend in get(name).backends
+    except KeyError:
+        return False
+
+
+def implemented(name: str) -> bool:
+    _load_kernels()
+    try:
+        return get(name).implemented()
     except KeyError:
         return False
 

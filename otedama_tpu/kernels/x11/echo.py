@@ -34,21 +34,27 @@ _AES_SHIFT = np.array(
 )
 
 
+def _mix_columns(cols: np.ndarray, axis_row: int) -> np.ndarray:
+    """AES 2-3-1-1 MDS along ``axis_row`` (length 4) of any byte tensor."""
+    gf = _gf_tables()
+    m2, m3 = gf[2], gf[3]
+    a = np.moveaxis(cols, axis_row, 0)
+    a0, a1, a2, a3 = a[0], a[1], a[2], a[3]
+    out = np.empty_like(a)
+    out[0] = m2[a0] ^ m3[a1] ^ a2 ^ a3
+    out[1] = a0 ^ m2[a1] ^ m3[a2] ^ a3
+    out[2] = a0 ^ a1 ^ m2[a2] ^ m3[a3]
+    out[3] = m3[a0] ^ a1 ^ a2 ^ m2[a3]
+    return np.moveaxis(out, 0, axis_row)
+
+
 def _aes_round(w: np.ndarray, key: np.ndarray) -> np.ndarray:
     """One AES round on ``[B, 16]`` states (column-major bytes).
     ``key``: broadcastable ``[..., 16]`` uint8."""
     sbox = aes_sbox()
-    gf = _gf_tables()
     s = sbox[w][:, _AES_SHIFT]
     cols = s.reshape(s.shape[0], 4, 4)  # [B, col, row]
-    a0, a1, a2, a3 = (cols[:, :, i] for i in range(4))
-    m2, m3 = gf[2], gf[3]
-    out = np.empty_like(cols)
-    out[:, :, 0] = m2[a0] ^ m3[a1] ^ a2 ^ a3
-    out[:, :, 1] = a0 ^ m2[a1] ^ m3[a2] ^ a3
-    out[:, :, 2] = a0 ^ a1 ^ m2[a2] ^ m3[a3]
-    out[:, :, 3] = m3[a0] ^ a1 ^ a2 ^ m2[a3]
-    return out.reshape(w.shape) ^ key
+    return _mix_columns(cols, 2).reshape(w.shape) ^ key
 
 
 # BIG.ShiftRows: word at (row r, col c) moves to col (c - r) mod 4;
@@ -61,7 +67,6 @@ _BIG_SHIFT = np.array(
 def echo512_compress(V: np.ndarray, M: np.ndarray, counter: int) -> np.ndarray:
     """One ECHO-512 compression. ``V``/``M``: ``[B, 8, 16]`` uint8 words."""
     B = V.shape[0]
-    gf = _gf_tables()
     state = np.concatenate([V, M], axis=1)  # [B, 16, 16]
     k = counter
     zero_key = np.zeros(16, dtype=np.uint8)
@@ -79,14 +84,7 @@ def echo512_compress(V: np.ndarray, M: np.ndarray, counter: int) -> np.ndarray:
         state = new[:, _BIG_SHIFT, :]
         # BIG.MixColumns: words grouped by column (4 consecutive indices)
         cols = state.reshape(B, 4, 4, 16)  # [B, col, row, byte]
-        a0, a1, a2, a3 = (cols[:, :, i, :] for i in range(4))
-        m2, m3 = gf[2], gf[3]
-        mixed = np.empty_like(cols)
-        mixed[:, :, 0, :] = m2[a0] ^ m3[a1] ^ a2 ^ a3
-        mixed[:, :, 1, :] = a0 ^ m2[a1] ^ m3[a2] ^ a3
-        mixed[:, :, 2, :] = a0 ^ a1 ^ m2[a2] ^ m3[a3]
-        mixed[:, :, 3, :] = m3[a0] ^ a1 ^ a2 ^ m2[a3]
-        state = mixed.reshape(B, 16, 16)
+        state = _mix_columns(cols, 2).reshape(B, 16, 16)
     return V ^ M ^ state[:, :8, :] ^ state[:, 8:, :]
 
 

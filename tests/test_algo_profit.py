@@ -1,0 +1,160 @@
+"""Algorithm manager, profit analyzer/switcher, network difficulty manager."""
+
+import asyncio
+import time
+
+import pytest
+
+from otedama_tpu.engine.algo_manager import AlgorithmManager
+from otedama_tpu.engine.difficulty import (
+    BlockStamp,
+    DifficultyConfig,
+    NetworkDifficultyManager,
+)
+from otedama_tpu.kernels import target as tgt
+from otedama_tpu.profit import (
+    CoinMetrics,
+    ProfitAnalyzer,
+    ProfitSwitcher,
+    SwitcherConfig,
+)
+
+
+# -- difficulty --------------------------------------------------------------
+
+def test_epoch_retarget_scales_with_block_rate():
+    cfg = DifficultyConfig(algorithm="epoch", epoch_interval=8, block_time=600.0)
+    mgr = NetworkDifficultyManager(0x1D00FFFF, cfg)
+    t0 = mgr.current_target
+    # 8 blocks found twice as fast as expected -> target halves (diff doubles)
+    for h in range(8):
+        mgr.record_block(h, timestamp=1000.0 + h * 300.0)
+    assert mgr.retargets == 1
+    assert t0 / mgr.current_target == pytest.approx(2.0, rel=0.05)
+
+
+def test_epoch_retarget_clamps_at_4x():
+    cfg = DifficultyConfig(algorithm="epoch", epoch_interval=8, block_time=600.0)
+    mgr = NetworkDifficultyManager(0x1D00FFFF, cfg)
+    t0 = mgr.current_target
+    for h in range(8):
+        mgr.record_block(h, timestamp=1000.0 + h * 60000.0)  # 100x slow
+    assert mgr.current_target / t0 == pytest.approx(4.0, rel=0.05)
+
+
+def test_lwma_responds_per_block():
+    cfg = DifficultyConfig(algorithm="lwma", lwma_window=10, block_time=60.0)
+    mgr = NetworkDifficultyManager(0x1D00FFFF, cfg)
+    t0 = mgr.current_target
+    for h in range(12):
+        mgr.record_block(h, timestamp=1000.0 + h * 30.0)  # 2x fast
+    assert mgr.retargets > 1
+    assert mgr.current_target < t0
+
+
+def test_emergency_eases_target_on_stall():
+    mgr = NetworkDifficultyManager(0x1B00FFFF, DifficultyConfig(block_time=60.0))
+    mgr.record_block(0, timestamp=1000.0)
+    t0 = mgr.current_target
+    assert not mgr.check_emergency(now=1000.0 + 100.0)
+    assert mgr.check_emergency(now=1000.0 + 100 * 60.0)
+    assert mgr.current_target == 2 * t0
+
+
+# -- profit analyzer ---------------------------------------------------------
+
+def _metrics(coin, algo, price, diff, reward=3.125):
+    return CoinMetrics(coin=coin, algorithm=algo, price=price,
+                       network_difficulty=diff, block_reward=reward)
+
+
+def test_profit_estimate_math():
+    pa = ProfitAnalyzer(power_watts=1000.0, power_price_kwh=0.10)
+    pa.update_metrics(_metrics("BTC", "sha256d", price=50000.0, diff=1e12))
+    est = pa.estimate("BTC", hashrate=1e12)  # 1 TH/s
+    coins = 1e12 / (1e12 * 4294967296.0) * 86400 * 3.125
+    assert est.coins_per_day == pytest.approx(coins)
+    assert est.revenue_per_day == pytest.approx(coins * 50000.0)
+    assert est.power_cost_per_day == pytest.approx(1.0 * 24 * 0.10)
+
+
+def test_profit_best_picks_highest():
+    pa = ProfitAnalyzer()
+    pa.update_metrics(_metrics("BTC", "sha256d", 50000.0, 1e13))
+    pa.update_metrics(_metrics("LTC", "scrypt", 80.0, 1e7, reward=6.25))
+    best = pa.best({"sha256d": 1e12, "scrypt": 1e9})
+    assert best is not None and best.coin in ("BTC", "LTC")
+    # scrypt at this difficulty/hashrate dominates by orders of magnitude
+    assert best.coin == "LTC"
+
+
+# -- switcher ----------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_switcher_switches_with_hysteresis():
+    pa = ProfitAnalyzer()
+    pa.update_metrics(_metrics("BTC", "sha256d", 50000.0, 1e13))
+    pa.update_metrics(_metrics("LTC", "scrypt", 80.0, 1e7, reward=6.25))
+    switched = []
+
+    async def on_switch(algorithm, est):
+        switched.append(algorithm)
+
+    sw = ProfitSwitcher(
+        pa, on_switch,
+        SwitcherConfig(cooldown_seconds=0.0, min_improvement_percent=10.0),
+        current_algorithm="sha256d",
+    )
+    sw.record_hashrate("sha256d", 1e12)
+    sw.record_hashrate("scrypt", 1e9)
+    assert await sw.maybe_switch()
+    assert switched == ["scrypt"] and sw.current_algorithm == "scrypt"
+    # already on the best algorithm: no further switch
+    assert not await sw.maybe_switch()
+
+
+@pytest.mark.asyncio
+async def test_switcher_respects_cooldown():
+    pa = ProfitAnalyzer()
+    pa.update_metrics(_metrics("BTC", "sha256d", 50000.0, 1e13))
+    pa.update_metrics(_metrics("LTC", "scrypt", 80.0, 1e7))
+
+    async def on_switch(a, e):
+        pass
+
+    sw = ProfitSwitcher(pa, on_switch, SwitcherConfig(cooldown_seconds=9999.0),
+                        current_algorithm="sha256d")
+    sw.record_hashrate("scrypt", 1e9)
+    sw.last_switch = time.time()
+    assert not await sw.maybe_switch()
+
+
+def test_switcher_never_picks_unimplemented():
+    pa = ProfitAnalyzer()
+    # an algorithm that's registered but has no backends
+    pa.update_metrics(_metrics("RVN", "kawpow", 1e9, 1.0, reward=2500.0))
+
+    async def on_switch(a, e):
+        pass
+
+    sw = ProfitSwitcher(pa, on_switch, SwitcherConfig(cooldown_seconds=0.0),
+                        current_algorithm="sha256d")
+    sw.record_hashrate("kawpow", 1e12)
+    assert sw.evaluate() is None
+
+
+# -- algorithm manager -------------------------------------------------------
+
+def test_algorithm_manager_benchmarks_sha256d():
+    mgr = AlgorithmManager(preferred_backend="xla")
+    r = mgr.benchmark("sha256d", budget_hashes=1 << 14)
+    assert r.hashrate > 0
+    assert mgr.measured_hashrates()["sha256d"] == r.hashrate
+
+
+def test_algorithm_manager_rejects_stub_algorithms():
+    mgr = AlgorithmManager()
+    with pytest.raises(ValueError):
+        mgr.backend_for("kawpow")
+    with pytest.raises(ValueError):
+        mgr.backend_for("sha256d", "nonexistent-backend")

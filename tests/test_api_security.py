@@ -1,0 +1,292 @@
+"""API server (REST/WS/metrics), security (ratelimit/auth/zkp), CLI, app."""
+
+import asyncio
+import json
+import time
+import urllib.request
+
+import pytest
+
+from otedama_tpu.api.metrics import MetricsRegistry
+from otedama_tpu.api.server import ApiConfig, ApiServer
+from otedama_tpu.security.auth import (
+    AuthManager,
+    Role,
+    TokenError,
+    hash_password,
+    jwt_decode,
+    jwt_encode,
+    totp_code,
+    totp_verify,
+    verify_password,
+)
+from otedama_tpu.security.ratelimit import ConnectionGuard, RateLimiter, TokenBucket
+from otedama_tpu.security.zkp import SchnorrProver, SchnorrVerifier
+
+
+# -- metrics -----------------------------------------------------------------
+
+def test_metrics_render_prometheus_text():
+    reg = MetricsRegistry()
+    reg.gauge_set("otedama_hashrate", 1.5e9, help_="Total hashrate")
+    reg.counter_add("otedama_shares_total", 3, {"status": "accepted"})
+    text = reg.render()
+    assert "# TYPE otedama_hashrate gauge" in text
+    assert "otedama_hashrate 1500000000" in text
+    assert 'otedama_shares_total{status="accepted"} 3' in text
+
+
+# -- rate limit --------------------------------------------------------------
+
+def test_token_bucket_refill():
+    b = TokenBucket(capacity=2, refill_per_second=1.0)
+    now = time.monotonic()
+    assert b.allow(now=now) and b.allow(now=now)
+    assert not b.allow(now=now)
+    assert b.allow(now=now + 1.1)
+
+
+def test_rate_limiter_per_key():
+    rl = RateLimiter(rate_per_minute=60, burst=2)
+    assert rl.allow("a") and rl.allow("a")
+    assert not rl.allow("a")
+    assert rl.allow("b")  # independent key
+    assert rl.denied == 1
+
+
+def test_connection_guard():
+    g = ConnectionGuard(max_concurrent_per_ip=2, connects_per_minute=1000)
+    assert g.acquire("1.2.3.4") and g.acquire("1.2.3.4")
+    assert not g.acquire("1.2.3.4")
+    g.release("1.2.3.4")
+    assert g.acquire("1.2.3.4")
+
+
+# -- auth --------------------------------------------------------------------
+
+def test_jwt_roundtrip_and_tamper():
+    token = jwt_encode({"sub": "alice", "role": "admin"}, "s3cret", ttl_seconds=60)
+    claims = jwt_decode(token, "s3cret")
+    assert claims["sub"] == "alice"
+    with pytest.raises(TokenError):
+        jwt_decode(token, "wrong-secret")
+    with pytest.raises(TokenError):
+        jwt_decode(token[:-4] + "AAAA", "s3cret")
+
+
+def test_jwt_expiry():
+    token = jwt_encode({"sub": "x"}, "k", ttl_seconds=-10)
+    with pytest.raises(TokenError):
+        jwt_decode(token, "k")
+
+
+def test_password_hashing():
+    stored = hash_password("hunter2")
+    assert verify_password("hunter2", stored)
+    assert not verify_password("hunter3", stored)
+
+
+def test_totp_rfc6238_vector():
+    # RFC 6238 test secret (sha1): "12345678901234567890" base32
+    secret = "GEZDGNBVGY3TQOJQGEZDGNBVGY3TQOJQ"
+    # at t=59, 8-digit code is 94287082 -> 6-digit suffix 287082
+    assert totp_code(secret, at=59) == "287082"
+    assert totp_verify(secret, "287082", at=59)
+    assert not totp_verify(secret, "000000", at=59)
+
+
+def test_auth_manager_login_rbac():
+    mgr = AuthManager("topsecret")
+    mgr.add_user("op", "pw", Role.OPERATOR)
+    token = mgr.login("op", "pw")
+    claims = mgr.authorize(token, "mining.control")
+    assert claims["sub"] == "op"
+    with pytest.raises(TokenError):
+        mgr.authorize(token, "users.manage")  # operator lacks admin perm
+    with pytest.raises(TokenError):
+        mgr.login("op", "wrong")
+
+
+def test_auth_2fa_required():
+    mgr = AuthManager("s")
+    user = mgr.add_user("alice", "pw", Role.ADMIN, enable_2fa=True)
+    with pytest.raises(TokenError):
+        mgr.login("alice", "pw", totp="000000")
+    token = mgr.login("alice", "pw", totp=totp_code(user.totp_secret))
+    assert mgr.authorize(token, "users.manage")["sub"] == "alice"
+
+
+# -- zkp ---------------------------------------------------------------------
+
+def test_schnorr_zkp_roundtrip():
+    prover = SchnorrProver.from_passphrase("wallet-secret")
+    verifier = SchnorrVerifier(prover.y)
+    proof = prover.prove(b"login:alice:163400")
+    assert verifier.verify(b"login:alice:163400", proof)
+    assert not verifier.verify(b"login:mallory:163400", proof)
+    other = SchnorrProver()
+    assert not SchnorrVerifier(other.y).verify(b"login:alice:163400", proof)
+
+
+# -- api server e2e ----------------------------------------------------------
+
+def _get(url, headers=None):
+    req = urllib.request.Request(url, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=5.0) as resp:
+        return resp.status, resp.read()
+
+
+def _post(url, obj, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=5.0) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+@pytest.mark.asyncio
+async def test_api_server_end_to_end():
+    api = ApiServer(ApiConfig(port=0, auth_secret="adminsecret"))
+    api.add_provider("engine", lambda: {"hashrate": 123.0, "devices": {}})
+    switched = {}
+
+    async def control_switch(params):
+        switched.update(params)
+        return {"switched": True}
+
+    api.add_control("switch", control_switch)
+    api.auth.add_user("admin", "pw", Role.ADMIN)
+    await api.start()
+    base = f"http://127.0.0.1:{api.port}"
+    loop = asyncio.get_running_loop()
+
+    # /health and /api/v1/status
+    status, body = await loop.run_in_executor(None, _get, f"{base}/health")
+    assert status == 200 and json.loads(body)["status"] == "ok"
+    status, body = await loop.run_in_executor(None, _get, f"{base}/api/v1/status")
+    assert json.loads(body)["engine"]["hashrate"] == 123.0
+
+    # /api/v1/algorithms lists implemented + stub algorithms honestly
+    status, body = await loop.run_in_executor(
+        None, _get, f"{base}/api/v1/algorithms"
+    )
+    algos = {a["name"]: a for a in json.loads(body)}
+    assert algos["sha256d"]["implemented"]
+    assert not algos["randomx"]["implemented"]
+
+    # /metrics renders prometheus text
+    api.sync_engine_metrics({"hashrate": 5.0, "devices": {}, "shares": {}})
+    status, body = await loop.run_in_executor(None, _get, f"{base}/metrics")
+    assert b"otedama_hashrate 5" in body
+
+    # control requires auth
+    status, obj = await loop.run_in_executor(
+        None, _post, f"{base}/api/v1/control/switch", {"algorithm": "scrypt"}
+    )
+    assert status == 401
+    status, obj = await loop.run_in_executor(
+        None, _post, f"{base}/api/v1/auth/login",
+        {"username": "admin", "password": "pw"},
+    )
+    assert status == 200
+    token = obj["token"]
+    status, obj = await loop.run_in_executor(
+        None, _post, f"{base}/api/v1/control/switch", {"algorithm": "scrypt"},
+        {"Authorization": f"Bearer {token}"},
+    )
+    assert status == 200 and obj["ok"] and switched == {"algorithm": "scrypt"}
+    await api.stop()
+
+
+@pytest.mark.asyncio
+async def test_api_websocket_push():
+    api = ApiServer(ApiConfig(port=0, ws_push_seconds=0.1))
+    api.add_provider("engine", lambda: {"hashrate": 7.0})
+    await api.start()
+
+    # raw RFC6455 client handshake
+    reader, writer = await asyncio.open_connection("127.0.0.1", api.port)
+    writer.write(
+        b"GET /ws HTTP/1.1\r\nhost: x\r\nupgrade: websocket\r\n"
+        b"connection: Upgrade\r\nsec-websocket-key: dGhlIHNhbXBsZSBub25jZQ==\r\n"
+        b"sec-websocket-version: 13\r\n\r\n"
+    )
+    await writer.drain()
+    head = await reader.readuntil(b"\r\n\r\n")
+    assert b"101" in head.split(b"\r\n")[0]
+    assert b"s3pPLMBiTxaQ9kYGzzhZRbK+xOo=" in head  # RFC 6455 sample accept
+
+    # first pushed frame: unmasked server text frame
+    b0 = await reader.readexactly(2)
+    assert b0[0] == 0x81
+    length = b0[1] & 0x7F
+    if length == 126:
+        import struct as _s
+
+        length = _s.unpack("!H", await reader.readexactly(2))[0]
+    payload = await reader.readexactly(length)
+    msg = json.loads(payload)
+    assert msg["engine"]["hashrate"] == 7.0
+    writer.close()
+    await api.stop()
+
+
+# -- cli ---------------------------------------------------------------------
+
+def test_cli_init_and_benchmark(tmp_path, capsys):
+    from otedama_tpu.cli import main
+
+    cfg = tmp_path / "otedama.yaml"
+    assert main(["-c", str(cfg), "init"]) == 0
+    assert cfg.exists()
+    assert main(["-c", str(cfg), "init"]) == 1  # refuses overwrite
+    rc = main(["benchmark", "-a", "sha256d", "-b", "xla", "-n", "16384"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "sha256d" in out and "benchmarks_h_per_s" in out
+
+
+# -- application composition -------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_app_pool_mode_with_local_miner_finds_blocks():
+    """Full loop: app in pool mode + local mining against the mock chain."""
+    from otedama_tpu.app import Application
+    from otedama_tpu.config.schema import AppConfig
+
+    cfg = AppConfig()
+    cfg.mining.enabled = True
+    cfg.mining.batch_size = 1 << 14
+    cfg.pool.enabled = True
+    cfg.pool.database = ":memory:"
+    cfg.stratum.enabled = True
+    cfg.stratum.port = 0
+    cfg.stratum.initial_difficulty = 0.0001
+    cfg.api.enabled = True
+    cfg.api.port = 0
+    cfg.mining.backend = "xla"
+
+    app = Application(cfg)
+    await app.start()
+    try:
+        # generous: first XLA compile can eat tens of seconds on a loaded CI box
+        deadline = time.monotonic() + 90.0
+        while time.monotonic() < deadline:
+            snap = app.server.snapshot()
+            if snap["shares_valid"] >= 1:
+                break
+            await asyncio.sleep(0.25)
+        assert app.server.snapshot()["shares_valid"] >= 1, app.snapshot()
+        # API surfaces the whole system
+        loop = asyncio.get_running_loop()
+        status, body = await loop.run_in_executor(
+            None, _get, f"http://127.0.0.1:{app.api.port}/api/v1/status"
+        )
+        obj = json.loads(body)
+        assert "engine" in obj and "stratum" in obj and "pool" in obj
+    finally:
+        await app.stop()
