@@ -191,8 +191,15 @@ class HttpServer:
             if request is None:
                 writer.close()
                 return
-            # websocket upgrade?
+            # websocket upgrade? (middleware — rate limiting — applies first)
             if request.headers.get("upgrade", "").lower() == "websocket":
+                for fn in self._middleware:
+                    early = await fn(request)
+                    if early is not None:
+                        writer.write(early.encode())
+                        await writer.drain()
+                        writer.close()
+                        return
                 await self._handle_ws(request, reader, writer)
                 return
             response = await self._dispatch(request)

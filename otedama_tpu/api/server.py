@@ -179,15 +179,29 @@ class ApiServer:
         return Response.json({"ok": True, "by": claims.get("sub"), "result": result})
 
     async def _ws_stats(self, request: Request, ws: WebSocket) -> None:
-        """Push stats snapshots until the client goes away."""
-        while not ws.closed:
-            await ws.send_json({"timestamp": time.time(), **self._snapshot()})
+        """Push stats snapshots until the client goes away.
+
+        The reader runs as its own task (pings/close handling) — cancelling
+        ``recv`` mid-frame would desync the stream, so it is never raced
+        against a timeout."""
+        reader = asyncio.create_task(self._ws_drain(ws))
+        try:
+            while not ws.closed:
+                await ws.send_json({"timestamp": time.time(), **self._snapshot()})
+                await asyncio.sleep(self.config.ws_push_seconds)
+        except (ConnectionError, RuntimeError):
+            pass
+        finally:
+            reader.cancel()
             try:
-                await asyncio.wait_for(
-                    ws.recv(), timeout=self.config.ws_push_seconds
-                )
-            except asyncio.TimeoutError:
-                continue
+                await reader
+            except asyncio.CancelledError:
+                pass
+
+    @staticmethod
+    async def _ws_drain(ws: WebSocket) -> None:
+        while await ws.recv() is not None:
+            pass
 
     # -- metric sync ----------------------------------------------------------
 

@@ -58,6 +58,9 @@ class Application:
         self.db = None
         self.p2p = None
         self.api: ApiServer | None = None
+        self.recovery = None
+        self.failure_detector = None
+        self.backups = None
         self._solo_jobs: dict[str, Job] = {}
         self._tasks: list[asyncio.Task] = []
         self._started: list = []    # components in start order
@@ -120,6 +123,7 @@ class Application:
             await self._start_p2p()
         if cfg.api.enabled:
             await self._start_api()
+        await self._start_supervision()
         log.info("application started (%s)", ", ".join(
             name for name, on in (
                 ("mining", cfg.mining.enabled), ("pool", cfg.pool.enabled),
@@ -273,6 +277,10 @@ class Application:
             )
             self._active_upstream = selected
             await self.client.start()
+            # keep shutdown bookkeeping pointed at the live client
+            self._started = [
+                self.client if c is old else c for c in self._started
+            ]
             if old is not None:
                 await old.stop()
 
@@ -346,6 +354,70 @@ class Application:
         await self.api.start()
         self._started.append(self.api)
         self._tasks.append(asyncio.create_task(self._metrics_loop()))
+
+    async def _start_supervision(self) -> None:
+        """Failure detector + component recovery + scheduled backups
+        (reference: core/recovery.go, hardware/failure_detector.go,
+        backup/manager.go — here they actually run in the serve path)."""
+        from otedama_tpu.runtime.failure import (
+            CallbackStrategy,
+            FailureDetector,
+            FailureType,
+            RecoveryManager,
+        )
+
+        self.recovery = RecoveryManager()
+        if self.engine is not None:
+            engine = self.engine
+
+            async def engine_probe() -> bool:
+                return engine.state.value in ("running", "starting")
+
+            async def engine_restart() -> None:
+                await engine.stop()
+                await engine.start()
+
+            self.recovery.register("engine", engine_probe, engine_restart)
+
+            async def restart_engine_on_failure(failure) -> bool:
+                await engine_restart()
+                return True
+
+            self.failure_detector = FailureDetector(engine)
+            self.failure_detector.add_strategy(CallbackStrategy(
+                "engine-restart",
+                (FailureType.BATCH_STALL, FailureType.HASHRATE_DROP),
+                restart_engine_on_failure,
+            ))
+            await self.failure_detector.start()
+            self._started.append(self.failure_detector)
+            if self.api is not None:
+                self.api.add_provider("failures", self.failure_detector.snapshot)
+        await self.recovery.start()
+        self._started.append(self.recovery)
+        if self.api is not None:
+            self.api.add_provider("recovery", self.recovery.snapshot)
+
+        if self.db is not None and self.config.pool.database not in ("", ":memory:"):
+            from otedama_tpu.utils.backup import BackupConfig, BackupManager
+
+            self.backups = BackupManager(
+                self.config.pool.database,
+                BackupConfig(directory=self.config.pool.database + ".backups"),
+            )
+            self._tasks.append(asyncio.create_task(self._backup_loop()))
+            if self.api is not None:
+                self.api.add_provider("backups", self.backups.snapshot)
+
+    async def _backup_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.backups.config.interval_seconds)
+            try:
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self.backups.create
+                )
+            except Exception:
+                log.exception("scheduled backup failed")
 
     async def _metrics_loop(self) -> None:
         while True:

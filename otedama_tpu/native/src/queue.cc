@@ -1,0 +1,82 @@
+// Lock-free SPSC ring buffer for fixed-size records.
+//
+// Reference parity: internal/optimization/lockfree_queue.go:11 (lock-free
+// MPMC queue) and internal/performance/lockfree_profiler.go:18-187 (ring
+// buffers). Used by the native profiler/share pipeline: one producer (the
+// search thread) and one consumer (the host pump) exchange fixed-size
+// records without taking the GIL or a mutex.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+struct Ring {
+  uint64_t capacity;     // number of slots (power of two)
+  uint64_t record_size;  // bytes per slot
+  std::atomic<uint64_t> head;  // next write
+  std::atomic<uint64_t> tail;  // next read
+  uint8_t* data;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* otedama_ring_new(uint64_t capacity_pow2, uint64_t record_size) {
+  if (capacity_pow2 == 0 || (capacity_pow2 & (capacity_pow2 - 1)) != 0)
+    return nullptr;
+  Ring* r = new Ring();
+  r->capacity = capacity_pow2;
+  r->record_size = record_size;
+  r->head.store(0);
+  r->tail.store(0);
+  r->data = static_cast<uint8_t*>(std::malloc(capacity_pow2 * record_size));
+  if (!r->data) {
+    delete r;
+    return nullptr;
+  }
+  return r;
+}
+
+void otedama_ring_free(void* ring) {
+  Ring* r = static_cast<Ring*>(ring);
+  if (r) {
+    std::free(r->data);
+    delete r;
+  }
+}
+
+// returns 1 on success, 0 when full
+int otedama_ring_push(void* ring, const void* record) {
+  Ring* r = static_cast<Ring*>(ring);
+  const uint64_t head = r->head.load(std::memory_order_relaxed);
+  const uint64_t tail = r->tail.load(std::memory_order_acquire);
+  if (head - tail >= r->capacity) return 0;
+  std::memcpy(r->data + (head & (r->capacity - 1)) * r->record_size, record,
+              r->record_size);
+  r->head.store(head + 1, std::memory_order_release);
+  return 1;
+}
+
+// returns 1 on success, 0 when empty
+int otedama_ring_pop(void* ring, void* record) {
+  Ring* r = static_cast<Ring*>(ring);
+  const uint64_t tail = r->tail.load(std::memory_order_relaxed);
+  const uint64_t head = r->head.load(std::memory_order_acquire);
+  if (tail == head) return 0;
+  std::memcpy(record, r->data + (tail & (r->capacity - 1)) * r->record_size,
+              r->record_size);
+  r->tail.store(tail + 1, std::memory_order_release);
+  return 1;
+}
+
+uint64_t otedama_ring_len(void* ring) {
+  Ring* r = static_cast<Ring*>(ring);
+  return r->head.load(std::memory_order_acquire) -
+         r->tail.load(std::memory_order_acquire);
+}
+
+}  // extern "C"

@@ -59,6 +59,9 @@ class ProfitAnalyzer:
         self.metrics[m.coin] = m
 
     def estimate(self, coin: str, hashrate: float) -> ProfitEstimate | None:
+        """Pure estimate — no history side effect (probes from best()/the
+        switcher must not pollute the trend series); use ``sample`` for the
+        periodic recording path."""
         m = self.metrics.get(coin)
         if m is None or m.network_difficulty <= 0:
             return None
@@ -67,7 +70,7 @@ class ProfitAnalyzer:
         )
         revenue = coins_per_day * m.price
         power_cost = self.power_watts / 1000.0 * 24.0 * self.power_price_kwh
-        est = ProfitEstimate(
+        return ProfitEstimate(
             coin=coin,
             algorithm=m.algorithm,
             hashrate=hashrate,
@@ -76,9 +79,14 @@ class ProfitAnalyzer:
             power_cost_per_day=power_cost,
             profit_per_day=revenue - power_cost,
         )
-        hist = self._history.setdefault(coin, [])
-        hist.append((time.time(), est.profit_per_day))
-        del hist[: -self.history_window]
+
+    def sample(self, coin: str, hashrate: float) -> ProfitEstimate | None:
+        """Estimate AND record into the trend/forecast history."""
+        est = self.estimate(coin, hashrate)
+        if est is not None:
+            hist = self._history.setdefault(coin, [])
+            hist.append((time.time(), est.profit_per_day))
+            del hist[: -self.history_window]
         return est
 
     def best(self, hashrates: dict[str, float]) -> ProfitEstimate | None:

@@ -44,6 +44,9 @@ class ServerConfig:
     ntime_slack: int = 600               # seconds of ntime roll allowed
     max_clients: int = 10000
     vardiff: VardiffConfig = dataclasses.field(default_factory=VardiffConfig)
+    # optional custom extranonce1 allocator (session_id -> bytes); the proxy
+    # uses this to nest downstream sessions inside an upstream allocation
+    extranonce1_factory: Callable[[int], bytes] | None = None
 
 
 @dataclasses.dataclass
@@ -57,6 +60,9 @@ class AcceptedShare:
     actual_difficulty: float # difficulty the digest actually achieved
     digest: bytes
     header: bytes            # the 80-byte header the share hashed
+    extranonce2: bytes       # as submitted by the miner
+    ntime: int
+    nonce_word: int
     is_block: bool
     submitted_at: float
 
@@ -162,7 +168,9 @@ class StratumServer:
 
     # -- connection handling ------------------------------------------------
 
-    def _alloc_extranonce1(self) -> bytes:
+    def _alloc_extranonce1(self, session_id: int) -> bytes:
+        if self.config.extranonce1_factory is not None:
+            return self.config.extranonce1_factory(session_id)
         v = self._next_extranonce1
         self._next_extranonce1 += 1
         return struct.pack(">I", v & 0xFFFFFFFF)
@@ -174,14 +182,15 @@ class StratumServer:
             writer.close()
             return
         peer = writer.get_extra_info("peername")
+        session_id = self._next_session
+        self._next_session += 1
         session = Session(
-            id=self._next_session,
+            id=session_id,
             peer=f"{peer[0]}:{peer[1]}" if peer else "?",
-            extranonce1=self._alloc_extranonce1(),
+            extranonce1=self._alloc_extranonce1(session_id),
             extranonce2_size=self.config.extranonce2_size,
             writer=writer,
         )
-        self._next_session += 1
         self.sessions[session.id] = session
         self.stats["connections_total"] += 1
         log.info("client %d connected from %s", session.id, session.peer)
@@ -364,6 +373,9 @@ class StratumServer:
             actual_difficulty=tgt.difficulty_of_digest(digest),
             digest=digest,
             header=header,
+            extranonce2=sub.extranonce2,
+            ntime=sub.ntime,
+            nonce_word=sub.nonce_word,
             is_block=is_block,
             submitted_at=time.time(),
         )

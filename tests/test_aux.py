@@ -1,0 +1,249 @@
+"""Worker registry, stratum proxy, getwork server, analytics, currency."""
+
+import asyncio
+import json
+import struct
+import time
+import urllib.request
+
+import pytest
+
+from otedama_tpu.analytics import AnalyticsEngine, TimeSeries
+from otedama_tpu import currency
+from otedama_tpu.engine.types import Job
+from otedama_tpu.engine import jobs as jobmod
+from otedama_tpu.kernels import target as tgt
+from otedama_tpu.pool.workers import RegistryConfig, WorkerRegistry, validate_wallet
+from otedama_tpu.utils.pow_host import pow_digest
+
+
+def _mkjob(ntime=None, nbits=0x1D00FFFF, **kw):
+    return Job(
+        job_id=kw.get("job_id", "j1"),
+        prev_hash=b"\x11" * 32,
+        coinb1=b"\x01\x02",
+        coinb2=b"\x03\x04",
+        merkle_branch=[],
+        version=0x20000000,
+        nbits=nbits,
+        ntime=ntime or int(time.time()),
+        clean=True,
+    )
+
+
+# -- worker registry ---------------------------------------------------------
+
+def test_wallet_validation():
+    assert validate_wallet("1A1zP1eP5QGefi2DMPTfTL5SLmv7DivfNa")
+    assert validate_wallet("bc1qw508d6qejxtdg4y5r3zarvary0c5xw7kv8f3t4")
+    assert not validate_wallet("not-a-wallet")
+    assert not validate_wallet("")
+
+
+def test_registry_registration_and_hashrate():
+    reg = WorkerRegistry(RegistryConfig(require_valid_wallet=True))
+    with pytest.raises(ValueError):
+        reg.register("garbage!.rig", 1)
+    w = reg.register("1A1zP1eP5QGefi2DMPTfTL5SLmv7DivfNa.rig1", 1)
+    now = time.time()
+    for i in range(10):
+        reg.record_share(w.name, True, 2.0, now=now - 100 + i * 10)
+    assert w.shares_accepted == 10
+    # 20 diff over ~90s -> about 20 * 2^32 / 100 H/s (window spans to `now`)
+    assert w.hashrate(now) == pytest.approx(20 * 4294967296.0 / 90.0, rel=0.2)
+    assert reg.total_hashrate(now) > 0
+    assert reg.snapshot()["workers"] == 1
+
+
+def test_registry_bans_spammy_worker():
+    reg = WorkerRegistry(RegistryConfig(ban_min_shares=10, ban_reject_rate=0.5))
+    w = reg.register("wallet.rig", 1)
+    now = 1000.0
+    for _ in range(2):
+        reg.record_share(w.name, True, 1.0, now=now)
+    for _ in range(18):
+        reg.record_share(w.name, False, 1.0, now=now)
+    assert reg.is_banned(w.name, now=now + 1)
+    assert not reg.is_banned(w.name, now=now + 1e6)
+
+
+def test_registry_cleanup():
+    reg = WorkerRegistry(RegistryConfig(inactive_timeout=100.0))
+    reg.register("a.b", 1)
+    assert reg.cleanup(now=time.time() + 1000.0) == 1
+    assert not reg.workers
+
+
+# -- proxy -------------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_proxy_relays_shares_upstream():
+    """miner -> proxy -> upstream pool, all in-process on loopback."""
+    from otedama_tpu.stratum.client import ClientConfig, StratumClient
+    from otedama_tpu.stratum.proxy import ProxyConfig, StratumProxy
+    from otedama_tpu.stratum.server import ServerConfig, StratumServer
+
+    upstream_accepted = []
+
+    async def on_up_share(s):
+        upstream_accepted.append(s)
+
+    upstream = StratumServer(
+        ServerConfig(port=0, initial_difficulty=0.001, extranonce2_size=4),
+        on_share=on_up_share,
+    )
+    await upstream.start()
+    upstream.set_job(_mkjob())
+
+    proxy = StratumProxy(ProxyConfig(
+        listen_host="127.0.0.1", listen_port=0,
+        upstream=ClientConfig(host="127.0.0.1", port=upstream.port,
+                              username="proxywallet.agg"),
+        session_prefix_bytes=2,
+        downstream_difficulty=0.001,
+    ))
+    await proxy.start()
+    await asyncio.sleep(0.2)  # upstream job propagates downstream
+
+    jobs = []
+    miner = StratumClient(
+        ClientConfig(host="127.0.0.1", port=proxy.port, username="w.rig"),
+        on_job=jobs.append,
+    )
+    await miner.start()
+    for _ in range(50):
+        if jobs:
+            break
+        await asyncio.sleep(0.05)
+    assert jobs, "miner never received a job through the proxy"
+    job = jobs[0]
+    assert job.extranonce2_size == 2  # 4 upstream - 2 prefix
+
+    # mine a share against the downstream job
+    en2 = b"\x00" * job.extranonce2_size
+    prefix76 = jobmod.build_header_prefix(job, en2)
+    target = tgt.difficulty_to_target(0.001)
+    nonce = next(
+        n for n in range(1 << 24)
+        if tgt.hash_meets_target(pow_digest(prefix76 + struct.pack(">I", n)), target)
+    )
+    from otedama_tpu.engine.types import Share
+
+    share = Share(
+        job_id=job.job_id, worker="w.rig", extranonce2=en2,
+        ntime=job.ntime, nonce_word=nonce,
+        digest=pow_digest(prefix76 + struct.pack(">I", nonce)),
+        difficulty=1.0,
+    )
+    result = await miner.submit(share)
+    assert result.accepted, result
+    for _ in range(50):
+        if upstream_accepted:
+            break
+        await asyncio.sleep(0.05)
+    assert upstream_accepted, "share never reached the upstream pool"
+    assert upstream_accepted[0].worker_user == "proxywallet.agg"
+
+    await miner.stop()
+    await proxy.stop()
+    await upstream.stop()
+
+
+# -- getwork -----------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_getwork_issue_and_submit():
+    from otedama_tpu.stratum.getwork import (
+        GetworkConfig,
+        GetworkServer,
+        decode_work_data,
+        encode_work_data,
+    )
+
+    header = bytes(range(80))
+    assert decode_work_data(encode_work_data(header)) == header
+
+    shares = []
+
+    async def on_share(worker, hdr, digest):
+        shares.append((worker, hdr, digest))
+
+    srv = GetworkServer(
+        GetworkConfig(port=0, share_difficulty=0.001), on_share=on_share
+    )
+    await srv.start()
+    srv.set_job(_mkjob())
+    loop = asyncio.get_running_loop()
+
+    def rpc(obj):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/",
+            data=json.dumps(obj).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return json.loads(resp.read())
+
+    got = await loop.run_in_executor(
+        None, rpc, {"id": 1, "method": "getwork", "params": []}
+    )
+    work = got["result"]
+    header76 = decode_work_data(work["data"])[:76]
+    target = int.from_bytes(bytes.fromhex(work["target"]), "little")
+    nonce = next(
+        n for n in range(1 << 24)
+        if tgt.hash_meets_target(pow_digest(header76 + struct.pack(">I", n)), target)
+    )
+    solved = header76 + struct.pack(">I", nonce)
+    res = await loop.run_in_executor(
+        None, rpc,
+        {"id": 2, "method": "submitwork", "params": [encode_work_data(solved)]},
+    )
+    assert res["result"] is True, res
+    assert shares and shares[0][1] == solved
+    # resubmission of unknown work rejects
+    bogus = bytes(80)
+    res = await loop.run_in_executor(
+        None, rpc, {"id": 3, "method": "submitwork",
+                    "params": [encode_work_data(bogus)]},
+    )
+    assert res["result"] is False
+    await srv.stop()
+
+
+# -- analytics ---------------------------------------------------------------
+
+def test_timeseries_aggregate_and_rate():
+    ts = TimeSeries()
+    for i in range(10):
+        ts.add(float(i * 100), timestamp=1000.0 + i)
+    agg = ts.aggregate(5.0, now=1009.0)
+    assert agg["count"] == 6 and agg["last"] == 900.0
+    assert ts.rate_per_second(100.0, now=1009.0) == pytest.approx(100.0)
+
+
+def test_analytics_engine_report():
+    eng = AnalyticsEngine()
+    for i in range(5):
+        eng.ingest_engine(
+            {"hashrate": 1000.0 + i, "hashes": i * 500,
+             "shares": {"found": i, "accepted": i}},
+            timestamp=1000.0 + i,
+        )
+    report = eng.report(now=1004.0)
+    assert report["hashrate"]["1m"]["count"] == 5
+    assert report["hashes"]["rate_per_second"] == pytest.approx(500.0)
+
+
+# -- currency ----------------------------------------------------------------
+
+def test_currency_registry_and_clients():
+    assert currency.get("btc").algorithm == "sha256d"
+    assert currency.get("DASH").algorithm == "x11"
+    with pytest.raises(KeyError):
+        currency.get("NOPE")
+    mgr = currency.ClientManager()
+    client = mgr.client("LTC")
+    assert mgr.client("LTC") is client  # cached
+    snap = mgr.snapshot()
+    assert snap["LTC"]["connected"] and not snap["BTC"]["connected"]

@@ -1,0 +1,81 @@
+"""Native C++ library: sha256d search, midstate, ring buffer.
+
+Builds the library on first import (g++ is baked into the image); if the
+toolchain is somehow absent the whole module skips.
+"""
+
+import hashlib
+import os
+import struct
+
+import numpy as np
+import pytest
+
+native = pytest.importorskip("otedama_tpu.native")
+
+from otedama_tpu.kernels import target as tgt
+from otedama_tpu.runtime.search import JobConstants, PythonBackend
+
+
+def test_native_sha256_matches_hashlib():
+    for n in (0, 1, 55, 56, 63, 64, 65, 80, 200):
+        data = os.urandom(n)
+        assert native.sha256(data) == hashlib.sha256(data).digest(), n
+        assert native.sha256d(data) == hashlib.sha256(
+            hashlib.sha256(data).digest()
+        ).digest(), n
+
+
+def test_native_midstate_matches_host():
+    from otedama_tpu.utils.sha256_host import midstate as py_midstate
+
+    h = os.urandom(64)
+    assert native.midstate(h) == py_midstate(h)
+
+
+def test_native_search_matches_python_oracle():
+    rng = np.random.RandomState(3)
+    h76 = rng.bytes(76)
+    # pick a target that yields a few winners in a small window
+    digests = [
+        hashlib.sha256(hashlib.sha256(h76 + struct.pack(">I", n)).digest()).digest()
+        for n in range(2048)
+    ]
+    values = sorted(int.from_bytes(d, "little") for d in digests)
+    target = values[4]  # exactly 5 winners (≤ target)
+    jc = JobConstants.from_header_prefix(h76, target)
+
+    want = PythonBackend().search(jc, 0, 2048)
+    got = native.NativeCpuBackend().search(jc, 0, 2048)
+    assert [w.nonce_word for w in got.winners] == [w.nonce_word for w in want.winners]
+    assert [w.digest for w in got.winners] == [w.digest for w in want.winners]
+    assert got.best_hash_hi == want.best_hash_hi
+
+
+def test_native_search_wraps_nonce_space():
+    h76 = b"\x07" * 76
+    jc = JobConstants.from_header_prefix(h76, tgt.MAX_TARGET)  # everything wins
+    res = native.NativeCpuBackend(max_winners=8).search(jc, 0xFFFFFFFE, 4)
+    assert [w.nonce_word for w in res.winners] == [
+        0xFFFFFFFE, 0xFFFFFFFF, 0x0, 0x1
+    ]
+
+
+def test_native_ring_roundtrip():
+    ring = native.NativeRing(8, 16)
+    assert len(ring) == 0 and ring.pop() is None
+    records = [os.urandom(16) for _ in range(8)]
+    for r in records:
+        assert ring.push(r)
+    assert not ring.push(b"\x00" * 16)  # full
+    assert len(ring) == 8
+    for r in records:
+        assert ring.pop() == r
+    assert ring.pop() is None
+    ring.close()
+
+
+def test_native_registered_in_algos():
+    from otedama_tpu.engine import algos
+
+    assert algos.supports("sha256d", "native-cpu")

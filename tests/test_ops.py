@@ -1,0 +1,172 @@
+"""Failure detection/recovery, backup manager, profiler, logging tools."""
+
+import asyncio
+import logging
+import os
+import sqlite3
+import time
+
+import pytest
+
+from otedama_tpu.runtime.failure import (
+    CallbackStrategy,
+    DetectorConfig,
+    Failure,
+    FailureDetector,
+    FailureType,
+    RecoveryManager,
+)
+from otedama_tpu.utils.backup import BackupConfig, BackupManager
+from otedama_tpu.utils.logging_setup import AuditLogger, LogAnalyzer
+from otedama_tpu.utils.profiler import Profiler
+
+
+class FakeEngine:
+    def __init__(self):
+        self.hashrate = 1000.0
+        self.hashes = 0
+        self.state = "running"
+
+    def snapshot(self):
+        return {
+            "hashrate": self.hashrate,
+            "hashes": self.hashes,
+            "state": self.state,
+            "current_job": "j1",
+        }
+
+
+# -- failure detector --------------------------------------------------------
+
+def test_detector_flags_hashrate_drop_and_stall():
+    eng = FakeEngine()
+    det = FailureDetector(eng, DetectorConfig(stall_seconds=30.0))
+    eng.hashes = 100
+    assert det.check(now=1000.0) == []          # establishes peak + progress
+    eng.hashrate = 100.0                        # 10% of peak
+    found = det.check(now=1010.0)
+    assert [f.type for f in found] == [FailureType.HASHRATE_DROP]
+    # no hash progress for 40s -> stall too
+    found = det.check(now=1050.0)
+    assert FailureType.BATCH_STALL in [f.type for f in found]
+
+
+@pytest.mark.asyncio
+async def test_detector_runs_matching_strategy_with_cooldown():
+    eng = FakeEngine()
+    det = FailureDetector(eng, DetectorConfig(recovery_cooldown=9999.0))
+    calls = []
+
+    async def fix(failure):
+        calls.append(failure.type)
+        return True
+
+    det.add_strategy(CallbackStrategy("restart", (FailureType.BATCH_STALL,), fix))
+    stall = Failure(FailureType.BATCH_STALL, "engine", "test")
+    assert await det.handle(stall)
+    assert det.recoveries == 1 and calls == [FailureType.BATCH_STALL]
+    # cooldown suppresses immediate retry
+    assert not await det.handle(stall)
+    # unmatched type -> failed recovery
+    assert not await det.handle(Failure(FailureType.BACKEND_ERROR, "engine", "x"))
+    assert det.failed_recoveries == 1
+
+
+@pytest.mark.asyncio
+async def test_recovery_manager_restarts_with_backoff():
+    mgr = RecoveryManager()
+    state = {"healthy": False, "restarts": 0}
+
+    async def probe():
+        return state["healthy"]
+
+    async def restart():
+        state["restarts"] += 1
+        if state["restarts"] >= 2:
+            state["healthy"] = True
+
+    mgr.register("engine", probe, restart)
+    await mgr.check_all(now=1000.0)
+    assert state["restarts"] == 1
+    await mgr.check_all(now=1000.5)        # inside backoff window: no restart
+    assert state["restarts"] == 1
+    await mgr.check_all(now=1002.0)
+    assert state["restarts"] == 2
+    result = await mgr.check_all(now=1010.0)
+    assert result["engine"] is True
+    assert mgr.snapshot()["engine"]["restarts"] == 2
+
+
+# -- backup ------------------------------------------------------------------
+
+def test_backup_create_verify_restore_prune(tmp_path):
+    db_path = str(tmp_path / "pool.db")
+    conn = sqlite3.connect(db_path)
+    conn.execute("CREATE TABLE shares (id INTEGER PRIMARY KEY, v TEXT)")
+    conn.execute("INSERT INTO shares (v) VALUES ('x')")
+    conn.commit()
+    conn.close()
+
+    mgr = BackupManager(db_path, BackupConfig(
+        directory=str(tmp_path / "bk"),
+        secondary_directory=str(tmp_path / "bk2"),
+        retention=2,
+    ))
+    rec = mgr.create()
+    assert rec.verified and os.path.exists(rec.path)
+    assert os.path.exists(rec.path + ".meta.json")
+    assert len(os.listdir(tmp_path / "bk2")) == 2  # copy + meta
+
+    # corrupt detection
+    with open(rec.path, "r+b") as f:
+        f.seek(30)
+        f.write(b"\xde\xad")
+    assert not mgr.verify(rec.path)
+
+    rec2 = mgr.create()
+    target = str(tmp_path / "restored.db")
+    mgr.restore(rec2.path, target)
+    conn = sqlite3.connect(target)
+    assert conn.execute("SELECT count(*) FROM shares").fetchone()[0] == 1
+    conn.close()
+
+    for _ in range(3):
+        mgr.create()
+    assert len(mgr.list_backups()) <= 2
+
+
+# -- profiler ----------------------------------------------------------------
+
+def test_profiler_report():
+    p = Profiler(capacity_pow2=64, use_native=True)
+    for _ in range(10):
+        with p.span("hash_batch"):
+            pass
+    p.record("submit", 0.25)
+    report = p.report()
+    assert report["hash_batch"]["count"] == 10
+    assert report["submit"]["p50_ms"] == pytest.approx(250.0)
+    assert p.report() == {}  # drained
+
+
+# -- logging tools -----------------------------------------------------------
+
+def test_audit_logger_roundtrip(tmp_path):
+    audit = AuditLogger(str(tmp_path / "audit.jsonl"))
+    audit.record("admin", "payout", "tx=abc")
+    audit.record("admin", "login")
+    audit.record("worker1", "login", outcome="denied")
+    assert len(audit.query()) == 3
+    assert len(audit.query(actor="admin")) == 2
+    assert audit.query(action="payout")[0]["detail"] == "tx=abc"
+
+
+def test_log_analyzer_groups_error_shapes():
+    lines = [
+        "2026-07-29 10:00:00,123 ERROR   otedama.engine: batch 17 failed",
+        "2026-07-29 10:00:01,123 ERROR   otedama.engine: batch 99 failed",
+        "2026-07-29 10:00:02,123 INFO    otedama.stratum.server: client 5 connected",
+    ]
+    report = LogAnalyzer().analyze(lines)
+    assert report["levels"] == {"ERROR": 2, "INFO": 1}
+    assert report["top_errors"][0] == ("batch # failed", 2)

@@ -110,7 +110,8 @@ async def test_block_lifecycle_with_mock_chain():
         await pm.on_share(AcceptedShare(
             session_id=1, worker_user=worker, job_id=job.job_id,
             difficulty=diff, actual_difficulty=diff, digest=b"\x00" * 32,
-            header=b"\x00" * 80, is_block=False, submitted_at=0.0,
+            header=b"\x00" * 80, extranonce2=b"\x00" * 4, ntime=0,
+            nonce_word=0, is_block=False, submitted_at=0.0,
         ))
 
     # brute-force a block for the regtest-easy target
@@ -125,7 +126,8 @@ async def test_block_lifecycle_with_mock_chain():
     await pm.on_block(header, job, AcceptedShare(
         session_id=1, worker_user="w.b", job_id=job.job_id,
         difficulty=3.0, actual_difficulty=1e9, digest=sha256d(header),
-        header=header, is_block=True, submitted_at=0.0,
+        header=header, extranonce2=b"\x00" * 4, ntime=0, nonce_word=0,
+        is_block=True, submitted_at=0.0,
     ))
 
     assert chain.submitted, "block not accepted by chain"
