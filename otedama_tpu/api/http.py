@@ -105,33 +105,39 @@ class WebSocket:
         await self.send_text(json.dumps(obj))
 
     async def recv(self) -> str | None:
-        """One text message; None on close."""
+        """One text message; None on close (any mid-frame disconnect closes)."""
         while True:
             try:
                 head = await self.reader.readexactly(2)
+                opcode = head[0] & 0x0F
+                masked = head[1] & 0x80
+                length = head[1] & 0x7F
+                if length == 126:
+                    length = struct.unpack("!H", await self.reader.readexactly(2))[0]
+                elif length == 127:
+                    length = struct.unpack("!Q", await self.reader.readexactly(8))[0]
+                if length > MAX_BODY_BYTES:
+                    self.closed = True
+                    return None
+                mask = await self.reader.readexactly(4) if masked else b"\x00" * 4
+                payload = bytearray(await self.reader.readexactly(length))
             except (asyncio.IncompleteReadError, ConnectionError):
                 self.closed = True
                 return None
-            opcode = head[0] & 0x0F
-            masked = head[1] & 0x80
-            length = head[1] & 0x7F
-            if length == 126:
-                length = struct.unpack("!H", await self.reader.readexactly(2))[0]
-            elif length == 127:
-                length = struct.unpack("!Q", await self.reader.readexactly(8))[0]
-            if length > MAX_BODY_BYTES:
-                self.closed = True
-                return None
-            mask = await self.reader.readexactly(4) if masked else b"\x00" * 4
-            payload = bytearray(await self.reader.readexactly(length))
             for i in range(length):
                 payload[i] ^= mask[i % 4]
             if opcode == 0x8:  # close
                 self.closed = True
                 return None
             if opcode == 0x9:  # ping -> pong
-                self.writer.write(struct.pack("!BB", 0x8A, len(payload)) + bytes(payload))
-                await self.writer.drain()
+                try:
+                    self.writer.write(
+                        struct.pack("!BB", 0x8A, len(payload)) + bytes(payload)
+                    )
+                    await self.writer.drain()
+                except (ConnectionError, RuntimeError):
+                    self.closed = True
+                    return None
                 continue
             if opcode in (0x1, 0x2):
                 return payload.decode(errors="replace")

@@ -195,13 +195,16 @@ class ApiServer:
             reader.cancel()
             try:
                 await reader
-            except asyncio.CancelledError:
+            except (asyncio.CancelledError, Exception):
                 pass
 
     @staticmethod
     async def _ws_drain(ws: WebSocket) -> None:
-        while await ws.recv() is not None:
-            pass
+        try:
+            while await ws.recv() is not None:
+                pass
+        except Exception:
+            ws.closed = True
 
     # -- metric sync ----------------------------------------------------------
 

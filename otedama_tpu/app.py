@@ -61,7 +61,13 @@ class Application:
         self.recovery = None
         self.failure_detector = None
         self.backups = None
+        self.profit_analyzer = None
+        self.profit_switcher = None
         self._solo_jobs: dict[str, Job] = {}
+        # engine restarts are requested by two supervisors (failure detector
+        # and recovery manager); serialize them or interleaved stop/start
+        # orphans search tasks
+        self._restart_lock = asyncio.Lock()
         self._tasks: list[asyncio.Task] = []
         self._started: list = []    # components in start order
         self.started_at = 0.0
@@ -351,9 +357,52 @@ class Application:
         if self.p2p is not None:
             self.api.add_provider("p2p", self.p2p.snapshot)
         self.api.add_provider("benchmarks", self.algo_manager.snapshot)
+        self._wire_profit()
         await self.api.start()
         self._started.append(self.api)
         self._tasks.append(asyncio.create_task(self._metrics_loop()))
+
+    def _wire_profit(self) -> None:
+        """Profit analyzer + switcher: market data arrives via the
+        update_market control; the metrics loop samples profitability for
+        trend/forecast; the switcher re-points the engine algorithm."""
+        from otedama_tpu.profit import ProfitAnalyzer, ProfitSwitcher
+
+        self.profit_analyzer = ProfitAnalyzer()
+
+        async def on_switch(algorithm, est):
+            if self.engine is None:
+                return
+            backend = self.algo_manager.backend_for(algorithm)
+            async with self._restart_lock:
+                await self.engine.stop()
+                self.engine.backends = {getattr(backend, "name", "device0"): backend}
+                self.engine.config.algorithm = algorithm
+                self.engine.stats.algorithm = algorithm
+                await self.engine.start()
+            log.info("algorithm switched to %s", algorithm)
+
+        self.profit_switcher = ProfitSwitcher(
+            self.profit_analyzer, on_switch,
+            current_algorithm=self.config.mining.algorithm,
+        )
+        if self.api is not None:
+            async def update_market(params: dict) -> dict:
+                from otedama_tpu.profit import CoinMetrics
+
+                m = CoinMetrics(
+                    coin=str(params["coin"]),
+                    algorithm=str(params["algorithm"]),
+                    price=float(params["price"]),
+                    network_difficulty=float(params["difficulty"]),
+                    block_reward=float(params.get("reward", 0.0)),
+                )
+                self.profit_analyzer.update_metrics(m)
+                return {"coins": sorted(self.profit_analyzer.metrics)}
+
+            self.api.add_control("update_market", update_market)
+            self.api.add_provider("profit", self.profit_analyzer.snapshot)
+            self.api.add_provider("switcher", self.profit_switcher.snapshot)
 
     async def _start_supervision(self) -> None:
         """Failure detector + component recovery + scheduled backups
@@ -369,18 +418,26 @@ class Application:
         self.recovery = RecoveryManager()
         if self.engine is not None:
             engine = self.engine
+            lock = self._restart_lock
 
             async def engine_probe() -> bool:
-                return engine.state.value in ("running", "starting")
+                # transitional states (starting/stopping) are another
+                # supervisor's restart in flight, not ill health
+                return engine.state.value in ("running", "starting", "stopping")
 
             async def engine_restart() -> None:
-                await engine.stop()
-                await engine.start()
+                async with lock:
+                    if engine.state.value == "running":
+                        return  # someone else already recovered it
+                    await engine.stop()
+                    await engine.start()
 
             self.recovery.register("engine", engine_probe, engine_restart)
 
             async def restart_engine_on_failure(failure) -> bool:
-                await engine_restart()
+                async with lock:
+                    await engine.stop()
+                    await engine.start()
                 return True
 
             self.failure_detector = FailureDetector(engine)
@@ -423,7 +480,17 @@ class Application:
         while True:
             await asyncio.sleep(5.0)
             if self.api is not None and self.engine is not None:
-                self.api.sync_engine_metrics(self.engine.snapshot())
+                snap = self.engine.snapshot()
+                self.api.sync_engine_metrics(snap)
+                if self.profit_analyzer is not None and self.profit_switcher is not None:
+                    self.profit_switcher.record_hashrate(
+                        snap.get("algorithm", ""), snap.get("hashrate", 0.0)
+                    )
+                    # record profitability history for trend/forecast
+                    for coin, m in self.profit_analyzer.metrics.items():
+                        h = self.profit_switcher.hashrates.get(m.algorithm)
+                        if h:
+                            self.profit_analyzer.sample(coin, h)
 
     async def stop(self) -> None:
         for t in self._tasks:

@@ -34,10 +34,10 @@ def _swap_words(data: bytes) -> bytes:
 
 
 def encode_work_data(header80: bytes) -> str:
-    padded = header80 + bytes.fromhex(
-        "800000000000000000000000000000000000000000000000"
-        "000000000000000000000000000000000280"
-    )
+    # 128 bytes total: header + 0x80 marker + zeros + 64-bit BE bit length
+    padding = b"\x80" + b"\x00" * 39 + (640).to_bytes(8, "big")
+    padded = header80 + padding
+    assert len(padded) == 128
     return _swap_words(padded).hex()
 
 
@@ -146,6 +146,9 @@ class GetworkServer:
         if issued is None or time.time() - issued[1] > self.config.work_expiry:
             self.stats["shares_rejected"] += 1
             return Response.json({"result": False, "error": "stale or unknown work", "id": rid})
+        # one solution per issued work: consuming the entry makes duplicate
+        # resubmission of the same data reject as unknown
+        del self._issued[header[:76]]
         algorithm = self.current_job.algorithm if self.current_job else "sha256d"
         digest = pow_digest(header, algorithm)
         if not tgt.hash_meets_target(digest, self._share_target()):

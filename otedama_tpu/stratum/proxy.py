@@ -67,9 +67,7 @@ class StratumProxy:
         # learn the upstream's extranonce allocation first: downstream
         # sessions are told extranonce2_size at subscribe time
         await self.upstream.start()
-        self.server.config = dataclasses.replace(
-            self.server.config, extranonce2_size=self._downstream_en2_size()
-        )
+        self._adopt_upstream_sizes()
         await self.server.start()
         log.info(
             "proxy listening on %s:%d -> upstream %s:%d",
@@ -87,8 +85,24 @@ class StratumProxy:
 
     # -- job fan-out ----------------------------------------------------------
 
+    def _adopt_upstream_sizes(self) -> None:
+        """Fit the session prefix inside the upstream's extranonce2
+        allocation — a prefix as large as the whole allocation would leave
+        downstream miners no search space and shares of the wrong length."""
+        if self.upstream.extranonce2_size <= self.config.session_prefix_bytes:
+            new_prefix = max(0, self.upstream.extranonce2_size - 1)
+            log.warning(
+                "upstream extranonce2_size=%d too small for prefix=%d; using %d",
+                self.upstream.extranonce2_size,
+                self.config.session_prefix_bytes, new_prefix,
+            )
+            self.config.session_prefix_bytes = new_prefix
+        self.server.config = dataclasses.replace(
+            self.server.config, extranonce2_size=self._downstream_en2_size()
+        )
+
     def _downstream_en2_size(self) -> int:
-        return max(1, self.upstream.extranonce2_size - self.config.session_prefix_bytes)
+        return self.upstream.extranonce2_size - self.config.session_prefix_bytes
 
     def _downstream_extranonce1(self, session_id: int) -> bytes:
         """Downstream extranonce1 = upstream_en1 || session prefix — the
@@ -100,16 +114,20 @@ class StratumProxy:
         """Re-issue the upstream job downstream. Each downstream session's
         extranonce1 = upstream_extranonce1 || session_prefix, so coinbases
         stay inside the upstream's allocation and remain per-miner disjoint."""
-        if self.upstream.extranonce1 != self._upstream_en1:
+        alloc = (self.upstream.extranonce1, self.upstream.extranonce2_size)
+        if alloc != (self._upstream_en1, self.server.config.extranonce2_size
+                     + self.config.session_prefix_bytes):
             # upstream reconnect / set_extranonce: every downstream session's
-            # baked-in extranonce1 is now wrong — force miners to resubscribe
+            # baked-in extranonce1 (and told en2 size) is now wrong — refresh
+            # the server config and force miners to resubscribe
             if self._upstream_en1:
                 log.warning(
-                    "upstream extranonce1 changed; disconnecting %d downstream sessions",
+                    "upstream extranonce allocation changed; disconnecting %d downstream sessions",
                     len(self.server.sessions),
                 )
                 for s in list(self.server.sessions.values()):
                     s.writer.close()
+            self._adopt_upstream_sizes()
             self._upstream_en1 = self.upstream.extranonce1
         down = dataclasses.replace(
             job,

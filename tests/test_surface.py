@@ -1,0 +1,182 @@
+"""Caches, tuner, DEX, DeFi, mobile, i18n."""
+
+import pytest
+
+from otedama_tpu.defi import DefiError, LendingEngine, LendingMarket
+from otedama_tpu.dex import DexError, LiquidityPool, OrderBook, SwapRouter
+from otedama_tpu.mobile import MobileService
+from otedama_tpu.tuner import GeneticTuner, Knob, TunerConfig
+from otedama_tpu.utils.cache import BloomFilter, MmapBlockCache, TieredCache
+from otedama_tpu.utils.i18n import I18n
+
+
+# -- caches ------------------------------------------------------------------
+
+def test_bloom_filter_no_false_negatives():
+    bf = BloomFilter(capacity=1000)
+    keys = [f"key-{i}".encode() for i in range(500)]
+    for k in keys:
+        bf.add(k)
+    assert all(k in bf for k in keys)
+    misses = sum(1 for i in range(10000) if f"other-{i}".encode() in bf)
+    assert misses < 500  # ~1% error target, generous bound
+
+
+def test_tiered_cache_promotion_and_bloom_skip():
+    c = TieredCache(l1_size=2, l2_size=10)
+    c.put("a", 1)
+    c.put("b", 2)
+    c.put("c", 3)           # evicts "a" to L2
+    assert c.get("a") == 1  # L2 hit, promoted
+    assert c.stats["hits_l2"] == 1
+    assert c.get("zzz") is None
+    assert c.stats["bloom_skips"] >= 1
+
+
+def test_mmap_block_cache_lru_and_reopen(tmp_path):
+    path = str(tmp_path / "blocks.cache")
+    mc = MmapBlockCache(path, slots=4, slot_size=64)
+    for i in range(4):
+        mc.put(f"k{i}".encode(), f"v{i}".encode() * 3)
+    assert mc.get(b"k0") == b"v0v0v0"
+    mc.put(b"k4", b"new")          # evicts LRU (k1: oldest untouched)
+    assert mc.get(b"k4") == b"new"
+    assert mc.get(b"k1") is None
+    mc.close()
+    # index rebuilds from the file
+    mc2 = MmapBlockCache(path, slots=4, slot_size=64)
+    assert mc2.get(b"k4") == b"new"
+    with pytest.raises(ValueError):
+        mc2.put(b"big", b"x" * 65)
+    mc2.close()
+
+
+# -- tuner -------------------------------------------------------------------
+
+def test_genetic_tuner_finds_optimum():
+    knobs = (
+        Knob("batch", (1, 2, 4, 8, 16)),
+        Knob("threads", (1, 2, 4)),
+    )
+
+    def objective(genome):
+        # unimodal: best at batch=8, threads=2
+        return -abs(genome["batch"] - 8) - 2 * abs(genome["threads"] - 2)
+
+    tuner = GeneticTuner(objective, knobs, TunerConfig(seed=3))
+    best, score = tuner.run()
+    assert best == {"batch": 8, "threads": 2} and score == 0
+    # deterministic under the same seed
+    tuner2 = GeneticTuner(objective, knobs, TunerConfig(seed=3))
+    assert tuner2.run() == (best, score)
+
+
+# -- dex ---------------------------------------------------------------------
+
+def test_amm_swap_and_liquidity():
+    pool = LiquidityPool("BTC", "USD")
+    shares = pool.add_liquidity("alice", 10_000, 1_000_000)
+    assert shares > 0
+    out = pool.swap("BTC", 1_000)  # ~9% of reserve
+    assert 0 < out < 100_000
+    # x*y=k (with fee, k grows slightly)
+    assert pool.reserve_a * pool.reserve_b >= 10_000 * 1_000_000
+    a, b = pool.remove_liquidity("alice", shares)
+    assert a == pool.reserve_a + a - pool.reserve_a  # got the full pool back
+    with pytest.raises(DexError):
+        pool.swap("BTC", 100)  # empty now
+
+
+def test_orderbook_price_time_priority():
+    book = OrderBook("BTC", "USD")
+    book.place("m1", "sell", 101.0, 5)
+    book.place("m2", "sell", 100.0, 5)
+    taker = book.place("t", "buy", 101.0, 8)
+    assert taker.amount == 0
+    # cheaper ask fills first
+    assert book.trades[0]["price"] == 100.0 and book.trades[0]["amount"] == 5
+    assert book.trades[1]["price"] == 101.0 and book.trades[1]["amount"] == 3
+    assert book.asks[0].amount == 2
+    assert book.spread() is None  # no bids resting
+
+
+def test_router_prefers_best_path():
+    r = SwapRouter()
+    ab = LiquidityPool("A", "B"); ab.add_liquidity("lp", 10**6, 10**6)
+    bc = LiquidityPool("B", "C"); bc.add_liquidity("lp", 10**6, 10**6)
+    ac = LiquidityPool("A", "C"); ac.add_liquidity("lp", 10**6, 10**4)  # bad rate
+    for p in (ab, bc, ac):
+        r.add_pool(p)
+    path, out = r.best_route("A", "C", 1000)
+    assert path == ["A", "B", "C"]     # two good hops beat the bad direct pool
+    got = r.swap("A", "C", 1000)
+    assert got == pytest.approx(out, abs=2)
+
+
+# -- defi --------------------------------------------------------------------
+
+def _engine(prices):
+    eng = LendingEngine(lambda asset: prices[asset])
+    eng.add_market(LendingMarket("BTC"))
+    eng.add_market(LendingMarket("USD"))
+    return eng
+
+
+def test_lending_borrow_and_health():
+    prices = {"BTC": 100.0, "USD": 1.0}
+    eng = _engine(prices)
+    eng.deposit("lender", "USD", 100_000)
+    pos = eng.open_position("bob", "BTC", 100, "USD", 7_000)  # 70% LTV
+    assert eng.health(pos.id) > 1.0
+    with pytest.raises(DefiError):
+        eng.open_position("bob", "BTC", 100, "USD", 8_000)  # > 75% factor
+    # price crash makes it liquidatable
+    prices["BTC"] = 70.0
+    assert eng.health(pos.id) < 1.0
+    event = eng.liquidate(pos.id, "liquidator")
+    assert event["repaid"] == 7_000 and event["seized"] > 0
+    assert pos.id not in eng.positions
+
+
+def test_lending_interest_accrual():
+    eng = _engine({"BTC": 100.0, "USD": 1.0})
+    eng.deposit("lender", "USD", 100_000)
+    pos = eng.open_position("bob", "BTC", 100, "USD", 5_000)
+    debt = eng.accrue(pos.id, now=pos.last_accrual + 365 * 86400)
+    assert debt == pytest.approx(5_000 * 1.08, rel=0.01)
+    eng.repay(pos.id, debt)
+    assert pos.id not in eng.positions
+
+
+# -- mobile ------------------------------------------------------------------
+
+def test_mobile_registration_and_feed():
+    svc = MobileService()
+    d1 = svc.register_device("alice", "token-1", "ios")
+    svc.register_device("bob", "token-2", "android")
+    # re-register same token updates instead of duplicating
+    assert svc.register_device("alice", "token-1").id == d1.id
+    assert len(svc.devices) == 2
+
+    svc.notify("block", "Block found", "height 100")
+    svc.notify("payout", "Payout", "0.1 BTC", user="alice")
+    assert len(svc.feed("alice")) == 2
+    assert len(svc.feed("bob")) == 1
+
+    summary = svc.summarize(
+        {"hashrate": 5.0, "shares": {"accepted": 2, "rejected": 0},
+         "blocks_found": 1, "algorithm": "sha256d"},
+        {"workers": 3, "shares": 10, "blocks": 1},
+    )
+    assert summary["miner"]["hashrate"] == 5.0 and summary["pool"]["workers"] == 3
+
+
+# -- i18n --------------------------------------------------------------------
+
+def test_i18n_locales_and_fallback():
+    en = I18n("en")
+    ja = I18n("ja")
+    assert en.t("share.accepted", difficulty=2.0) == "Share accepted (2.0)"
+    assert "シェア" in ja.t("share.accepted", difficulty=2.0)
+    assert ja.t("no.such.key") == "no.such.key"
+    assert I18n("xx").locale == "en"  # unknown locale falls back
