@@ -130,6 +130,9 @@ class WebSocket:
                 self.closed = True
                 return None
             if opcode == 0x9:  # ping -> pong
+                if len(payload) > 125:  # RFC 6455: control frames cap at 125
+                    self.closed = True
+                    return None
                 try:
                     self.writer.write(
                         struct.pack("!BB", 0x8A, len(payload)) + bytes(payload)

@@ -360,6 +360,9 @@ class Application:
         self._wire_profit()
         await self.api.start()
         self._started.append(self.api)
+        if self.profit_switcher is not None:
+            await self.profit_switcher.start()
+            self._started.append(self.profit_switcher)
         self._tasks.append(asyncio.create_task(self._metrics_loop()))
 
     def _wire_profit(self) -> None:

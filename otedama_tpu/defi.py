@@ -111,6 +111,10 @@ class LendingEngine:
         interest = int(
             pos.debt_amount * market.borrow_rate_per_year * elapsed / (365 * 86400)
         )
+        if interest == 0:
+            # sub-unit interest: leave last_accrual so the fraction keeps
+            # accumulating instead of being truncated away on every call
+            return pos.debt_amount
         pos.debt_amount += interest
         market.total_borrows += interest
         pos.last_accrual = now

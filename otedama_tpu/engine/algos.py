@@ -60,7 +60,7 @@ def _load_kernels() -> None:
     _KERNELS_LOADED = True
     import importlib
 
-    for mod in ("otedama_tpu.kernels.scrypt_jax",):
+    for mod in ("otedama_tpu.kernels.scrypt_jax", "otedama_tpu.kernels.x11"):
         try:
             importlib.import_module(mod)
         except Exception:  # pragma: no cover - kernel import failure is loud elsewhere

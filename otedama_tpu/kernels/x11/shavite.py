@@ -1,0 +1,185 @@
+"""SHAvite-3-512 (AES-based Feistel — x11 stage 9).
+
+Lane-axis implementation. C512 compression: 512-bit state as four 128-bit
+quarters (p0..p3), 14 Feistel rounds where each of the two branch updates
+runs a 4-AES-round keyed F function; 448 32-bit subkeys from the message
+expansion (initial 32 message words, then alternating nonlinear rounds —
+AES on the word-rotated previous subkey xored with the 32-words-back value
+— and linear rounds rk[i] = rk[i-32] ^ rk[i-4]), with the 128-bit bit
+counter folded into the four nonlinear expansion rounds under rotating
+word order and a complemented final word.
+
+Words are little-endian; AES rounds view each 128-bit quantity as the
+standard column-major AES state.
+
+Validation status: structure per the SHAvite-3 submission; the exact
+counter-injection offsets inside the expansion follow this module's
+documented layout (first 4 words of each nonlinear round) — no offline
+oracle exists to confirm the submission's exact offsets, so cross-
+implementation parity for this stage is unverified (see kernels/x11
+package docstring; miner and pool share this implementation, so in-framework
+behavior is consistent).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from otedama_tpu.kernels.x11.echo import _aes_round
+
+U32 = np.uint32
+
+ROUNDS = 14
+RK_WORDS = 448
+
+# expansion schedule: 13 rounds of 32 words after the message block;
+# nonlinear at expansion rounds 0, 3, 6, 9 (4 nonlinear total)
+_NONLINEAR_ROUNDS = (0, 3, 6, 9)
+
+# counter word order per nonlinear round (index into cnt[4]); the last
+# listed word is complemented
+_CNT_ORDERS = (
+    (0, 1, 2, 3),
+    (3, 2, 1, 0),
+    (2, 3, 0, 1),
+    (1, 0, 3, 2),
+)
+
+
+def _words_to_aes_bytes(w: list[np.ndarray]) -> np.ndarray:
+    """4 uint32 LE lanes -> [B, 16] AES byte state."""
+    B = w[0].shape[0]
+    out = np.empty((B, 16), dtype=np.uint8)
+    for i in range(4):
+        for b in range(4):
+            out[:, 4 * i + b] = ((w[i] >> U32(8 * b)) & U32(0xFF)).astype(np.uint8)
+    return out
+
+
+def _aes_bytes_to_words(s: np.ndarray) -> list[np.ndarray]:
+    out = []
+    for i in range(4):
+        w = np.zeros(s.shape[0], dtype=np.uint32)
+        for b in range(4):
+            w |= s[:, 4 * i + b].astype(np.uint32) << U32(8 * b)
+        out.append(w)
+    return out
+
+
+_ZERO_KEY = np.zeros(16, dtype=np.uint8)
+
+
+def _aes0_words(w: list[np.ndarray]) -> list[np.ndarray]:
+    """Keyless AES round over a 128-bit quantity given as 4 LE uint32 lanes."""
+    return _aes_bytes_to_words(_aes_round(_words_to_aes_bytes(w), _ZERO_KEY))
+
+
+def expand_keys(m: list[np.ndarray], counter: int) -> list[np.ndarray]:
+    """448 subkey words (lanes) from 32 message words + the bit counter."""
+    cnt = [(counter >> (32 * i)) & 0xFFFFFFFF for i in range(4)]
+    rk: list[np.ndarray] = list(m)
+    nl_index = 0
+    for e in range(13):
+        base = 32 * (e + 1)
+        if e in _NONLINEAR_ROUNDS:
+            for t in range(8):
+                i = base + 4 * t
+                prev = [rk[i - 4], rk[i - 3], rk[i - 2], rk[i - 1]]
+                # rotate the previous subkey by one word, then AES it
+                rot = [prev[1], prev[2], prev[3], prev[0]]
+                a = _aes0_words(rot)
+                for j in range(4):
+                    rk.append(a[j] ^ rk[i - 32 + j])
+            order = _CNT_ORDERS[nl_index]
+            for j in range(4):
+                word = U32(cnt[order[j]])
+                if j == 3:
+                    word = ~word
+                rk[base + j] = rk[base + j] ^ word
+            nl_index += 1
+        else:
+            for t in range(32):
+                i = base + t
+                rk.append(rk[i - 32] ^ rk[i - 4])
+    assert len(rk) == RK_WORDS
+    return rk
+
+
+def _f4(x: list[np.ndarray], keys: list[np.ndarray]) -> list[np.ndarray]:
+    """4 keyed AES rounds: x ^ k0 -> A -> ^k1 -> A -> ^k2 -> A -> ^k3 -> A."""
+    t = [x[j] ^ keys[j] for j in range(4)]
+    for r in range(1, 4):
+        t = _aes0_words(t)
+        t = [t[j] ^ keys[4 * r + j] for j in range(4)]
+    return _aes0_words(t)
+
+
+def c512(h: list[np.ndarray], m: list[np.ndarray], counter: int) -> list[np.ndarray]:
+    """One C512 compression. ``h``: 16 uint32 lanes; ``m``: 32 uint32 lanes."""
+    rk = expand_keys(m, counter)
+    p = [h[4 * q : 4 * q + 4] for q in range(4)]  # p0..p3 as 4-word groups
+    for r in range(ROUNDS):
+        k = rk[32 * r : 32 * (r + 1)]
+        f1 = _f4(p[1], k[:16])
+        f2 = _f4(p[3], k[16:])
+        p[0] = [p[0][j] ^ f1[j] for j in range(4)]
+        p[2] = [p[2][j] ^ f2[j] for j in range(4)]
+        p = [p[3], p[0], p[1], p[2]]
+    flat = [w for quarter in p for w in quarter]
+    return [h[i] ^ flat[i] for i in range(16)]
+
+
+def shavite512(data_words: np.ndarray, n_bytes: int) -> np.ndarray:
+    """SHAvite-3-512 across lanes. ``data_words``: uint32 ``[B, ceil(n/4)]``
+    little-endian words. Returns ``[B, 16]`` LE digest words."""
+    data_words = np.atleast_2d(data_words)
+    B = data_words.shape[0]
+    bitlen = n_bytes * 8
+    # pad: 0x80, zeros, 16-byte LE counter, 2-byte LE digest size, to 128B
+    n_blocks = (n_bytes + 1 + 18 + 127) // 128
+    padded = np.zeros((B, n_blocks * 32), dtype=np.uint32)
+    padded[:, : data_words.shape[1]] = data_words
+    word_i, byte_i = divmod(n_bytes, 4)
+    padded[:, word_i] |= U32(0x80) << U32(8 * byte_i)
+    tail = bitlen.to_bytes(16, "little") + (512).to_bytes(2, "little")
+    tail_words = np.frombuffer(tail + b"\x00\x00", dtype="<u4")
+    padded[:, -5:] = tail_words[:5]
+
+    # IV: generated per the spec style — C512 of a zero block from a state
+    # holding the digest size, counter 0 (precomputed once, deterministic)
+    h = _iv512(B)
+    for blk in range(n_blocks):
+        m = [padded[:, blk * 32 + i] for i in range(32)]
+        # counter: message bits processed incl. this block; 0 for pad-only
+        c = min(bitlen, (blk + 1) * 1024)
+        if c - blk * 1024 <= 0:
+            c = 0
+        h = c512(h, m, c)
+    return np.stack(h, axis=-1)
+
+
+_IV_CACHE: np.ndarray | None = None
+
+
+def _iv512(B: int) -> list[np.ndarray]:
+    global _IV_CACHE
+    if _IV_CACHE is None:
+        seed = [np.full(1, U32(512), dtype=np.uint32)] + [
+            np.zeros(1, dtype=np.uint32) for _ in range(15)
+        ]
+        zero_m = [np.zeros(1, dtype=np.uint32) for _ in range(32)]
+        out = c512(seed, zero_m, 0)
+        _IV_CACHE = np.array([int(w[0]) for w in out], dtype=np.uint32)
+    return [np.full(B, _IV_CACHE[i], dtype=np.uint32) for i in range(16)]
+
+
+def shavite512_bytes(data: bytes) -> bytes:
+    n = len(data)
+    padded = data + b"\x00" * ((-n) % 4)
+    words = (
+        np.frombuffer(padded, dtype="<u4").astype(np.uint32)[None, :]
+        if padded
+        else np.zeros((1, 0), dtype=np.uint32)
+    )
+    out = shavite512(words, n)
+    return out[0].astype("<u4").tobytes()

@@ -282,6 +282,49 @@ class ScryptPythonBackend:
         )
 
 
+class X11NumpyBackend:
+    """Vectorized x11 chained-hash search (lane-axis numpy pipeline).
+
+    The 11 stages run as batched numpy kernels; winner checks happen on the
+    final 32-byte digest with the usual LE-int target compare. P4 of
+    SURVEY.md's parallelism map: the multi-kernel pipeline executes as a
+    chain over the whole nonce batch, not per nonce.
+    """
+
+    name = "x11-numpy"
+    algorithm = "x11"
+
+    def __init__(self, chunk: int = 1 << 10):
+        self.chunk = chunk
+
+    def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
+        from otedama_tpu.kernels import x11
+
+        winners: list[Winner] = []
+        best = 0xFFFFFFFF
+        done = 0
+        prefix = np.frombuffer(jc.header76, dtype=np.uint8)
+        while done < count:
+            n = min(self.chunk, count - done)
+            headers = np.empty((n, 80), dtype=np.uint8)
+            headers[:, :76] = prefix
+            nonces = (base + done + np.arange(n, dtype=np.uint64)) & 0xFFFFFFFF
+            headers[:, 76:] = (
+                nonces.astype(">u4").view(np.uint8).reshape(n, 4)
+            )
+            digests = x11.x11_digest_batch(headers)
+            # LE-int compare: top limb = last 4 digest bytes, little-endian
+            hi = digests[:, 28:32].copy().view("<u4").reshape(n)
+            best = min(best, int(hi.min()))
+            top_limb = (jc.target >> 224) & 0xFFFFFFFF
+            for idx in np.nonzero(hi <= top_limb)[0].tolist():
+                digest = digests[idx].tobytes()
+                if tgt.hash_meets_target(digest, jc.target):
+                    winners.append(Winner(int(nonces[idx]), digest))
+            done += n
+        return SearchResult(winners, count, best)
+
+
 class PythonBackend:
     """Pure-python hashlib search. Slow; the zero-dependency oracle used by
     protocol-level tests and as a last-resort host fallback (the analogue of
@@ -315,4 +358,7 @@ def make_backend(kind: str, algorithm: str = "sha256d", **kwargs):
             return ScryptXlaBackend(**kwargs)
         if kind == "python":
             return ScryptPythonBackend(**kwargs)
+    elif algorithm == "x11":
+        if kind == "numpy":
+            return X11NumpyBackend(**kwargs)
     raise ValueError(f"no backend {kind!r} for algorithm {algorithm!r}")

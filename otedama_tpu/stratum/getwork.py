@@ -69,6 +69,7 @@ class GetworkServer:
         self.current_job: Job | None = None
         # issued work: header76 -> (job_id, issued_at)
         self._issued: dict[bytes, tuple[str, float]] = {}
+        self._seen_solutions: set[bytes] = set()
         self.stats = {"work_issued": 0, "shares_accepted": 0, "shares_rejected": 0}
 
     async def start(self) -> None:
@@ -146,14 +147,19 @@ class GetworkServer:
         if issued is None or time.time() - issued[1] > self.config.work_expiry:
             self.stats["shares_rejected"] += 1
             return Response.json({"result": False, "error": "stale or unknown work", "id": rid})
-        # one solution per issued work: consuming the entry makes duplicate
-        # resubmission of the same data reject as unknown
-        del self._issued[header[:76]]
+        if header in self._seen_solutions:
+            self.stats["shares_rejected"] += 1
+            return Response.json({"result": False, "error": "duplicate", "id": rid})
         algorithm = self.current_job.algorithm if self.current_job else "sha256d"
         digest = pow_digest(header, algorithm)
         if not tgt.hash_meets_target(digest, self._share_target()):
             self.stats["shares_rejected"] += 1
             return Response.json({"result": False, "error": "high-hash", "id": rid})
+        # dedup exact solutions only: the same work unit may legitimately
+        # yield several distinct share-target nonces
+        self._seen_solutions.add(header)
+        if len(self._seen_solutions) > 8192:
+            self._seen_solutions = set(list(self._seen_solutions)[-4096:])
         self.stats["shares_accepted"] += 1
         if self.on_share is not None:
             await self.on_share(request.peer, header, digest)

@@ -125,9 +125,18 @@ class MmapBlockCache:
         self._mm = mmap.mmap(self._f.fileno(), total)
         if create:
             self._mm[: self._HEADER.size] = self._HEADER.pack(slots, slot_size)
+        else:
+            stored_slots, stored_size = self._HEADER.unpack_from(self._mm, 0)
+            if (stored_slots, stored_size) != (slots, slot_size):
+                self._mm.close()
+                self._f.close()
+                raise ValueError(
+                    f"cache geometry mismatch: file has slots={stored_slots} "
+                    f"slot_size={stored_size}, requested {slots}/{slot_size}"
+                )
         self._index: dict[int, int] = {}   # key-hash -> slot
-        self._rebuild_index()
         self._clock = 0
+        self._rebuild_index()
 
     @staticmethod
     def _hash(key: bytes) -> int:
@@ -141,9 +150,12 @@ class MmapBlockCache:
     def _rebuild_index(self) -> None:
         for slot in range(self.slots):
             off = self._slot_off(slot)
-            kh, _, _ = self._SLOT_META.unpack_from(self._mm, off)
+            kh, used, _ = self._SLOT_META.unpack_from(self._mm, off)
             if kh:
                 self._index[kh] = slot
+                # resume the LRU clock past persisted stamps, or reopened
+                # caches would evict freshly-touched entries first
+                self._clock = max(self._clock, used)
 
     def put(self, key: bytes, value: bytes) -> None:
         if len(value) > self.payload_size:

@@ -81,8 +81,11 @@ def test_amm_swap_and_liquidity():
     assert 0 < out < 100_000
     # x*y=k (with fee, k grows slightly)
     assert pool.reserve_a * pool.reserve_b >= 10_000 * 1_000_000
+    before_a, before_b = pool.reserve_a, pool.reserve_b
     a, b = pool.remove_liquidity("alice", shares)
-    assert a == pool.reserve_a + a - pool.reserve_a  # got the full pool back
+    # sole LP redeems everything: pool drains completely
+    assert (a, b) == (before_a, before_b)
+    assert pool.reserve_a == 0 and pool.reserve_b == 0
     with pytest.raises(DexError):
         pool.swap("BTC", 100)  # empty now
 

@@ -155,9 +155,47 @@ def test_luffa_lane_batching_matches_scalar():
         assert got == luffa.luffa512_bytes(m), f"lane {lane}"
 
 
-# -- chain gating ------------------------------------------------------------
+# -- complete chain ----------------------------------------------------------
 
-def test_x11_chain_refuses_partial():
-    assert x11.missing_stages()  # groestl/jh/luffa/shavite/simd/echo pending
-    with pytest.raises(NotImplementedError):
-        x11.x11_digest(b"\x00" * 80)
+def test_x11_chain_complete():
+    assert x11.missing_stages() == []
+    d = x11.x11_digest(b"\x00" * 80)
+    assert len(d) == 32
+    assert d != x11.x11_digest(b"\x01" + b"\x00" * 79)
+
+
+def test_x11_batch_matches_scalar_chain():
+    headers = np.stack(
+        [np.frombuffer(os.urandom(80), dtype=np.uint8) for _ in range(4)]
+    )
+    batch = x11.x11_digest_batch(headers)
+    for i in range(4):
+        assert batch[i].tobytes() == x11.x11_digest(headers[i].tobytes()), i
+
+
+def test_x11_backend_finds_planted_winner():
+    from otedama_tpu.runtime.search import JobConstants, X11NumpyBackend
+
+    h76 = os.urandom(76)
+    import struct as _s
+
+    base, span = 500, 32
+    digests = {
+        n: x11.x11_digest(h76 + _s.pack(">I", n)) for n in range(base, base + span)
+    }
+    values = {n: int.from_bytes(d, "little") for n, d in digests.items()}
+    winner = min(values, key=values.get)
+    jc = JobConstants.from_header_prefix(h76, values[winner])
+    res = X11NumpyBackend(chunk=16).search(jc, base, span)
+    assert [w.nonce_word for w in res.winners] == [winner]
+    assert res.winners[0].digest == digests[winner]
+
+
+def test_x11_registered_and_pow_host_dispatch():
+    from otedama_tpu.engine import algos
+    from otedama_tpu.utils.pow_host import pow_digest
+
+    assert algos.supports("x11", "numpy")
+    h = os.urandom(80)
+    assert pow_digest(h, "x11") == x11.x11_digest(h)
+    assert pow_digest(h, "dash") == x11.x11_digest(h)
