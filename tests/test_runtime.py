@@ -66,8 +66,104 @@ def test_xla_backend_finds_exact_winners():
     )
 
 
+def test_kernel_math_host_eval_vs_hashlib():
+    """The Pallas kernel's compression math, evaluated at trace level.
+
+    ``compress_pe``/``sha256d_word7`` accept python ints, in which case the
+    partial evaluator computes the whole dataflow as host integers — the
+    exact expressions the kernel traces to the VPU. Checking digest word 7
+    against hashlib verifies the midstate split, the truncated second
+    compression (digest[7] = IV[7] + e-of-round-60) and the maj/schedule
+    rewrites without touching a device. (This is the test that catches
+    truncation off-by-ones: round 1 shipped a kernel that silently filtered
+    on digest word 6.)
+    """
+    from otedama_tpu.kernels import sha256_pallas as sp
+
+    jc = JobConstants.from_header_prefix(HEADER, EASY_TARGET)
+    ms = tuple(int(x) for x in jc.midstate)
+    tail = tuple(int(t) for t in jc.tail)
+    for nonce in (0, 1, 0x7FFFFFFF, 0xDEADBEEF, 0xFFFFFFFF):
+        word7 = sp.sha256d_word7(ms, tail, nonce)
+        ref = struct.unpack(">8I", jc.digest_for(nonce))[7]
+        assert word7 == ref, hex(nonce)
+        # the filter limb is the byte-reversed word 7
+        h0 = struct.unpack("<I", struct.pack(">I", word7))[0]
+        assert h0 == int.from_bytes(jc.digest_for(nonce)[28:32], "little")
+
+    # full (untruncated) compression against the reference midstate helper
+    msg = bytes(range(64))
+    full = sp.compress_pe(
+        tuple(int(v) for v in sh.SHA256_IV),
+        list(struct.unpack(">16I", msg)),
+    )
+    assert tuple(full) == tuple(sh.midstate(msg))
+
+
+def test_pallas_backend_host_logic(monkeypatch):
+    """PallasBackend's host-side paths, with the device launch stubbed.
+
+    Covers: flagged-tile exact rescan, table-overflow full-range fallback,
+    and overscan winner filtering — none of which need a TPU.
+    """
+    import jax.numpy as jnp
+
+    from otedama_tpu.kernels import sha256_pallas as sp
+    from otedama_tpu.runtime import search as rs
+
+    jc = JobConstants.from_header_prefix(HEADER, EASY_TARGET)
+    backend = rs.PallasBackend(sub=8)
+    tile = backend.tile  # 1024
+
+    # oracle winners for tiles 0 and 3 of range [0, 4*tile)
+    all_winners = _oracle_winners(jc, 0, 4 * tile)
+    hit_tiles = sorted({w // tile for w in all_winners})
+    assert hit_tiles, "easy target must produce winners in 4 tiles"
+
+    def fake_search(job_words, *, batch, sub, inner=None, unroll=4,
+                    interpret=None):
+        pad = sp.K_WINNERS - len(hit_tiles)
+        return sp.PallasSearchOut(
+            win_tile=jnp.asarray(hit_tiles + [0] * pad, dtype=jnp.uint32),
+            win_min=jnp.zeros((sp.K_WINNERS,), dtype=jnp.uint32),
+            stats=jnp.asarray([len(hit_tiles), 0, 123], dtype=jnp.uint32),
+        )
+
+    monkeypatch.setattr(sp, "sha256d_pallas_search", fake_search)
+    res = backend.search(jc, 0, 4 * tile)
+    assert sorted(w.nonce_word for w in res.winners) == all_winners
+    assert res.best_hash_hi == 123
+
+    # overscan: request a non-tile-multiple count; winners past it drop
+    res2 = backend.search(jc, 0, 4 * tile - 7)
+    expect2 = [w for w in all_winners if w < 4 * tile - 7]
+    assert sorted(w.nonce_word for w in res2.winners) == expect2
+
+    # overflow: stats[0] > K_WINNERS routes to the full-range fallback
+    def overflow_search(job_words, **kw):
+        return sp.PallasSearchOut(
+            win_tile=jnp.zeros((sp.K_WINNERS,), dtype=jnp.uint32),
+            win_min=jnp.zeros((sp.K_WINNERS,), dtype=jnp.uint32),
+            stats=jnp.asarray(
+                [sp.K_WINNERS + 5, 0, 0xFFFFFFFF], dtype=jnp.uint32
+            ),
+        )
+
+    monkeypatch.setattr(sp, "sha256d_pallas_search", overflow_search)
+    res3 = backend.search(jc, 0, 2 * tile)
+    assert sorted(w.nonce_word for w in res3.winners) == _oracle_winners(
+        jc, 0, 2 * tile
+    )
+
+
+@pytest.mark.slow
 def test_pallas_interpret_tiny():
-    """One tiny tile through the real Pallas kernel in interpret mode."""
+    """One tiny tile through the real Pallas kernel in interpret mode.
+
+    Interpret mode executes the ~5k-op unrolled kernel graph element-wise
+    and takes many minutes off-TPU — slow tier only. On-TPU correctness is
+    covered by the compiled-path winner tests in the bench/driver runs.
+    """
     from otedama_tpu.runtime.search import PallasBackend
 
     jc = JobConstants.from_header_prefix(HEADER, tgt.MAX_TARGET >> 6)
