@@ -76,7 +76,10 @@ class Application:
 
     def _build_engine(self) -> MiningEngine:
         cfg = self.config.mining
-        backend = self.algo_manager.backend_for(cfg.algorithm)
+        kwargs = {}
+        if cfg.backend == "pod" and cfg.pod_hosts:
+            kwargs["n_hosts"] = cfg.pod_hosts
+        backend = self.algo_manager.backend_for(cfg.algorithm, **kwargs)
         engine = MiningEngine(
             backends={getattr(backend, "name", "device0"): backend},
             on_share=self._on_share,

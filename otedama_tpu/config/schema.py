@@ -85,10 +85,13 @@ def _mini_yaml(text: str) -> dict:
 class MiningConfig:
     enabled: bool = True
     algorithm: str = "sha256d"
-    backend: str = "auto"              # auto|pallas-tpu|xla|native-cpu|python
+    backend: str = "auto"        # auto|pod|pallas-tpu|xla|native-cpu|python
     batch_size: int = 1 << 24
     worker_name: str = "otedama-tpu"
     devices: str = "all"               # all | count | comma list of indices
+    # pod backend: extranonce2 rows of the (host, chip) mesh; 0 = pick
+    # automatically (2 rows when the device count is even, else 1)
+    pod_hosts: int = 0
 
 
 @dataclasses.dataclass

@@ -43,7 +43,7 @@ class AlgorithmManager:
 
     # -- backend selection ---------------------------------------------------
 
-    def backend_for(self, algorithm: str, kind: str | None = None):
+    def backend_for(self, algorithm: str, kind: str | None = None, **kwargs):
         """Instantiate the best available backend for an algorithm."""
         algos._load_kernels()
         spec = algos.get(algorithm)
@@ -55,9 +55,15 @@ class AlgorithmManager:
                 import jax
 
                 on_tpu = jax.default_backend() == "tpu"
+                n_dev = len(jax.devices())
             except Exception:  # pragma: no cover
-                on_tpu = False
-            order = ("pallas-tpu", "xla") if on_tpu else ("xla",)
+                on_tpu, n_dev = False, 1
+            if on_tpu:
+                # multi-chip hosts drive every chip through the pod backend;
+                # a single chip goes straight to the Pallas kernel
+                order = ("pod", "pallas-tpu", "xla") if n_dev > 1 else ("pallas-tpu", "xla")
+            else:
+                order = ("xla",)
             for cand in order:
                 if cand in spec.backends:
                     kind = cand
@@ -69,7 +75,7 @@ class AlgorithmManager:
                 f"backend {kind!r} does not implement {algorithm!r} "
                 f"(available: {spec.backends})"
             )
-        return make_backend(kind, algorithm=algorithm)
+        return make_backend(kind, algorithm=algorithm, **kwargs)
 
     # -- benchmarking --------------------------------------------------------
 

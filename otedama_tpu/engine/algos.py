@@ -17,12 +17,13 @@ from typing import Callable
 
 # planning-assumption hashrates (H/s) for profitability estimates when no
 # measured rate exists yet — the reference hard-codes similar numbers
-# (internal/mining/engine.go:1092-1104); ours are per-v5e-chip estimates.
+# (internal/mining/engine.go:1092-1104); ours are per-v5e-chip MEASURED
+# rates where a kernel exists (sha256d: BENCH r2 pipelined e2e on v5e).
 _PLANNING = {
-    "sha256d": 5.0e8,
-    "sha256": 1.0e9,
+    "sha256d": 1.03e9,   # measured: Pallas kernel, v5e chip (bench.py r2)
+    "sha256": 1.9e9,     # one compression ~= 2x sha256d's two
     "scrypt": 2.0e5,
-    "x11": 5.0e7,
+    "x11": 7.0e2,        # measured: numpy host pipeline (until device port)
 }
 
 
@@ -114,7 +115,7 @@ def implemented(name: str) -> bool:
 register(AlgorithmSpec(
     name="sha256d",
     aliases=("sha256double", "bitcoin"),
-    backends=("pallas-tpu", "xla", "native-cpu"),
+    backends=("pallas-tpu", "pod", "xla", "native-cpu"),
     planning_hashrate=_PLANNING["sha256d"],
 ))
 register(AlgorithmSpec(

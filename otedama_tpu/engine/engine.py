@@ -102,10 +102,19 @@ class MiningEngine:
         self.state = EngineState.STARTING
         self._stop.clear()
         loop = asyncio.get_running_loop()
+        # extranonce2 block layout across heterogeneous backends: device i
+        # owns [sum(fanouts[:i]), ...+fanout_i) and strides by the total, so
+        # a pod (fanout=n_hosts) and a single-chip backend never overlap
+        fanouts = [getattr(b, "en2_fanout", 1) for b in self.backends.values()]
+        total_fanout = sum(fanouts)
+        offset = 0
         for i, (name, backend) in enumerate(self.backends.items()):
             self._tasks.append(
-                loop.create_task(self._search_loop(i, name, backend))
+                loop.create_task(
+                    self._search_loop(name, backend, offset, total_fanout)
+                )
             )
+            offset += fanouts[i]
         self.state = EngineState.RUNNING
         log.info("engine started with backends: %s", list(self.backends))
 
@@ -122,10 +131,11 @@ class MiningEngine:
 
     # -- the hot host loop --------------------------------------------------
 
-    async def _search_loop(self, index: int, name: str, backend) -> None:
+    async def _search_loop(
+        self, name: str, backend, en2_offset: int, en2_total: int
+    ) -> None:
         loop = asyncio.get_running_loop()
-        dstats = self.stats.devices[name]
-        n_dev = len(self.backends)
+        dstats = self.stats.devices.setdefault(name, DeviceStats())
         while not self._stop.is_set():
             job = self._job
             if job is None or job.is_expired(self.config.job_max_age):
@@ -137,27 +147,45 @@ class MiningEngine:
                 continue
 
             serial = self._job_serial
+            # a backend may consume several extranonce2 spaces per call (a
+            # pod's host rows — runtime.mesh.PodBackend.en2_fanout); devices
+            # own disjoint blocks laid out by the engine at start()
+            fanout = getattr(backend, "en2_fanout", 1)
             extranonce = ExtranonceCounter(size=job.extranonce2_size or self.config.extranonce2_size)
-            # device-disjoint extranonce spaces: stride by device count
-            extranonce.value = index
+            extranonce.value = en2_offset
             while not self._stop.is_set() and serial == self._job_serial:
-                en2 = extranonce.current()
-                jc = await loop.run_in_executor(None, job_constants, job, en2)
+                en2s = [extranonce.current()]
+                for _ in range(fanout - 1):
+                    en2s.append(extranonce.roll())
+                jcs = [
+                    await loop.run_in_executor(None, job_constants, job, en2)
+                    for en2 in en2s
+                ]
                 space = NonceRange(0, 1 << 32)
                 for base, count in space.batches(self.config.batch_size):
                     if self._stop.is_set() or serial != self._job_serial:
                         break
                     t0 = time.monotonic()
-                    result: SearchResult = await loop.run_in_executor(
-                        None, backend.search, jc, base, count
-                    )
+                    if fanout > 1:
+                        results: list[SearchResult] = await loop.run_in_executor(
+                            None, backend.search_multi, jcs, base, count
+                        )
+                    else:
+                        results = [
+                            await loop.run_in_executor(
+                                None, backend.search, jcs[0], base, count
+                            )
+                        ]
                     dt = time.monotonic() - t0
-                    dstats.record_batch(result.hashes, dt)
-                    self.stats.hashes += result.hashes
-                    await self._emit_shares(job, en2, result)
+                    hashes = sum(r.hashes for r in results)
+                    dstats.record_batch(hashes, dt)
+                    self.stats.hashes += hashes
+                    for en2, result in zip(en2s, results):
+                        await self._emit_shares(job, en2, result)
                 else:
-                    # nonce space exhausted: roll to the next extranonce2
-                    for _ in range(n_dev):
+                    # nonce spaces exhausted: stride to this device's next
+                    # extranonce2 block (counter sits at block start + f-1)
+                    for _ in range(en2_total - fanout + 1):
                         extranonce.roll()
                     continue
                 break  # job changed or stopping

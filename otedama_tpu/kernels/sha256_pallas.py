@@ -164,9 +164,10 @@ def compress_pe(state, w, *, truncate_to_word7: bool = False):
 
     ``state``/``w`` entries may be python ints, jax scalars, or jax arrays.
     With ``truncate_to_word7`` the rounds that only feed digest words 0..6
-    are dropped (rounds 58-60 lose their a-chain, 62-63 vanish) and the
-    return value is the final digest *word 7* only — exactly what the target
-    filter needs. Otherwise returns the full 8-word digest tuple.
+    are dropped (rounds 57-59 keep only their e-chain, the compression ends
+    at round 60, rounds 61-63 vanish) and the return value is the final
+    digest *word 7* only — exactly what the target filter needs. Otherwise
+    returns the full 8-word digest tuple.
 
     ``maj`` uses the xor form ``b ^ ((a^b) & (b^c))`` so that ``b^c`` can be
     reused from the previous round's ``a^b`` (the (a,b) pair shifts down the

@@ -345,6 +345,12 @@ class PythonBackend:
 
 def make_backend(kind: str, algorithm: str = "sha256d", **kwargs):
     if algorithm in ("sha256d", "sha256"):
+        if kind == "pod":
+            # every local chip behind one engine backend (runtime.mesh);
+            # late import: mesh itself imports this module
+            from otedama_tpu.runtime.mesh import PodBackend
+
+            return PodBackend(**kwargs)
         if kind == "pallas-tpu":
             return PallasBackend(**kwargs)
         if kind == "xla":
