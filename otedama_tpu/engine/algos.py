@@ -36,6 +36,12 @@ class AlgorithmSpec:
     backends: tuple[str, ...] = ()      # implemented search backends
     memory_hard: bool = False           # scrypt-family (VMEM/HBM scratch)
     chained: int = 1                    # number of chained hash rounds (x11=11)
+    # canonical = the implementation is certified bit-compatible with the
+    # real network's rules (KAT-verified). A non-canonical chain may be
+    # internally consistent (miner+pool share the code) but would produce
+    # INVALID work on the live network — the profit switcher and coin-name
+    # aliases refuse it.
+    canonical: bool = True
     planning_hashrate: float = 0.0      # H/s per chip, pre-measurement
     # hook: (header76, target) -> runtime JobConstants; None = sha256d scheme
     constants_builder: Callable | None = None
@@ -75,9 +81,29 @@ def register(spec: AlgorithmSpec) -> AlgorithmSpec:
     return spec
 
 
+# Coin-name aliases that imply the CANONICAL network rules. Resolving one
+# through a non-certified chain would hand the caller an algorithm that
+# produces invalid work on the real network, so the alias refuses until
+# the spec is marked canonical (mark_canonical after KAT parity).
+_CANONICAL_ALIASES = {"dash": "x11"}
+
+
 def get(name: str) -> AlgorithmSpec:
+    key = name.lower()
+    target = _CANONICAL_ALIASES.get(key)
+    if target is not None:
+        _load_kernels()
+        spec = _REGISTRY[target]
+        if not spec.canonical:
+            raise ValueError(
+                f"alias {key!r} names the live {target} network, but this "
+                f"{target} implementation is not certified canonical "
+                f"(KAT parity pending) — request {target!r} explicitly to "
+                f"use it as a framework-internal chain"
+            )
+        return spec
     try:
-        return _REGISTRY[name.lower()]
+        return _REGISTRY[key]
     except KeyError:
         raise KeyError(
             f"unknown algorithm {name!r}; known: {sorted(set(s.name for s in _REGISTRY.values()))}"
@@ -132,9 +158,11 @@ register(AlgorithmSpec(
 ))
 register(AlgorithmSpec(
     name="x11",
-    aliases=("dash",),
+    # NB: the "dash" coin alias lives in _CANONICAL_ALIASES, not here — it
+    # only resolves once the chain is KAT-certified (canonical=True).
     chained=11,
-    backends=(),  # filled in by kernels.x11 import-time registration
+    backends=(),   # filled in by kernels.x11 import-time registration
+    canonical=False,  # flipped by kernels.x11 once all 11 stages KAT-verify
     planning_hashrate=_PLANNING["x11"],
 ))
 # declared by the reference but unimplemented there too (stub registrations,
@@ -150,3 +178,22 @@ def mark_implemented(name: str, backend: str) -> None:
     spec = get(name)
     if backend not in spec.backends:
         register(dataclasses.replace(spec, backends=spec.backends + (backend,)))
+
+
+def mark_canonical(name: str) -> None:
+    """Kernel modules call this once their chain is KAT-certified against
+    the real network's test vectors — unlocks coin aliases + auto-switch."""
+    spec = _REGISTRY[name.lower()]
+    if not spec.canonical:
+        register(dataclasses.replace(spec, canonical=True))
+
+
+def switchable(name: str) -> bool:
+    """May the profit switcher move live mining onto this algorithm?
+    Requires both an implementation AND canonical (network-valid) status."""
+    _load_kernels()
+    try:
+        spec = _REGISTRY[name.lower()]
+    except KeyError:
+        return False
+    return spec.implemented() and spec.canonical

@@ -58,6 +58,8 @@ class ProfitSwitcher:
         (reference: engine.go:1092-1104 hard-coded assumptions)."""
         out = dict(self.hashrates)
         for name in algos.names(implemented_only=self.config.implemented_only):
+            if self.config.implemented_only and not algos.switchable(name):
+                continue  # non-canonical chains must never enter the race
             spec = algos.get(name)
             if name not in out and spec.planning_hashrate > 0:
                 out[name] = spec.planning_hashrate
@@ -72,7 +74,9 @@ class ProfitSwitcher:
         best = self.analyzer.best(self._effective_hashrates())
         if best is None or best.algorithm == self.current_algorithm:
             return None
-        if self.config.implemented_only and not algos.implemented(best.algorithm):
+        if self.config.implemented_only and not algos.switchable(best.algorithm):
+            # implemented-but-not-canonical (e.g. an uncertified x11 chain)
+            # would mine work the live network rejects — refuse the switch
             return None
         current_est = None
         for coin, m in self.analyzer.metrics.items():

@@ -67,8 +67,12 @@ class GetworkServer:
         self.http = HttpServer(self.config.host, self.config.port)
         self.http.route("POST", "/", self._rpc)
         self.current_job: Job | None = None
-        # issued work: header76 -> (job_id, issued_at)
-        self._issued: dict[bytes, tuple[str, float]] = {}
+        # issued work: header76 -> (job_id, issued_at, algorithm). The
+        # algorithm is captured at ISSUE time: work stays valid for
+        # work_expiry seconds, during which a profit switch may change
+        # current_job.algorithm — submitted solutions must be hashed with
+        # the algorithm the miner was actually told to mine.
+        self._issued: dict[bytes, tuple[str, float, str]] = {}
         self._seen_solutions: set[bytes] = set()
         self.stats = {"work_issued": 0, "shares_accepted": 0, "shares_rejected": 0}
 
@@ -113,11 +117,11 @@ class GetworkServer:
         extranonce2 = secrets.token_bytes(job.extranonce2_size)
         header76 = jobmod.build_header_prefix(job, extranonce2)
         now = time.time()
-        self._issued[header76] = (job.job_id, now)
+        self._issued[header76] = (job.job_id, now, job.algorithm)
         if len(self._issued) > 4096:
             cutoff = now - self.config.work_expiry
             self._issued = {
-                h: (j, t) for h, (j, t) in self._issued.items() if t > cutoff
+                h: rec for h, rec in self._issued.items() if rec[1] > cutoff
             }
             while len(self._issued) > 4096:  # hard cap: evict oldest
                 oldest = min(self._issued, key=lambda h: self._issued[h][1])
@@ -150,7 +154,7 @@ class GetworkServer:
         if header in self._seen_solutions:
             self.stats["shares_rejected"] += 1
             return Response.json({"result": False, "error": "duplicate", "id": rid})
-        algorithm = self.current_job.algorithm if self.current_job else "sha256d"
+        algorithm = issued[2]
         digest = pow_digest(header, algorithm)
         if not tgt.hash_meets_target(digest, self._share_target()):
             self.stats["shares_rejected"] += 1

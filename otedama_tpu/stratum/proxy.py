@@ -17,7 +17,6 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import logging
-import struct
 
 from otedama_tpu.engine.types import Job, Share
 from otedama_tpu.stratum.client import ClientConfig, StratumClient
@@ -56,6 +55,7 @@ class StratumProxy:
             "upstream_accepted": 0,
             "upstream_rejected": 0,
             "below_upstream_difficulty": 0,
+            "pruned_session_dropped": 0,
         }
         self._upstream_en1 = b""
         self._prefix_by_session: dict[int, bytes] = {}
@@ -135,12 +135,13 @@ class StratumProxy:
         )
         self.server.set_job(down, clean=job.clean)
 
-    def _session_prefix(self, session_id: int) -> bytes:
-        """Allocated prefix for a session (collision-free among live ones)."""
-        return self._prefix_by_session.get(
-            session_id,
-            struct.pack(">I", session_id)[-self.config.session_prefix_bytes:],
-        )
+    def _session_prefix(self, session_id: int) -> bytes | None:
+        """Allocated prefix for a session, or None if the allocation was
+        pruned. Reconstructing a prefix from the session id here would
+        rebuild a DIFFERENT coinbase than the one the miner actually hashed
+        (the allocator skips in-use values, so id != prefix), and the
+        upstream would reject the share — dropping it is the honest move."""
+        return self._prefix_by_session.get(session_id)
 
     def _alloc_prefix(self, session_id: int) -> bytes:
         """Pick a prefix no *live* session is using; the id counter alone
@@ -154,7 +155,10 @@ class StratumProxy:
         self._prefix_by_session = live
         in_use = set(live.values())
         for _ in range(space):
-            candidate = struct.pack(">I", self._next_prefix % space)[-size:]
+            # NB: to_bytes(0, ...) correctly yields b"" when the prefix is
+            # zero-width (upstream extranonce2_size == 1); a [-size:] slice
+            # would return the whole 4-byte pack at size 0.
+            candidate = (self._next_prefix % space).to_bytes(size, "big")
             self._next_prefix += 1
             if candidate not in in_use:
                 self._prefix_by_session[session_id] = candidate
@@ -173,6 +177,13 @@ class StratumProxy:
             self.stats["below_upstream_difficulty"] += 1
             return
         prefix = self._session_prefix(accepted.session_id)
+        if prefix is None:
+            self.stats["pruned_session_dropped"] += 1
+            log.warning(
+                "dropping share from session %d: extranonce prefix pruned",
+                accepted.session_id,
+            )
+            return
         share = Share(
             job_id=accepted.job_id,
             worker=self.config.upstream.username,

@@ -143,6 +143,72 @@ def test_switcher_never_picks_unimplemented():
     assert sw.evaluate() is None
 
 
+# -- canonical gating (ADVICE r1/r2 high-severity regression) ----------------
+
+def test_canonical_gating_machinery():
+    """A registered-but-uncertified chain: implemented yet NOT switchable,
+    its coin alias refuses to resolve, and mark_canonical unlocks both."""
+    from otedama_tpu.engine import algos
+
+    name, coin = "_testchain", "_testcoin"
+    algos.register(algos.AlgorithmSpec(
+        name=name, backends=("numpy",), canonical=False))
+    algos._CANONICAL_ALIASES[coin] = name
+    try:
+        assert algos.implemented(name)
+        assert not algos.switchable(name)
+        with pytest.raises(ValueError, match="not certified canonical"):
+            algos.get(coin)
+        # explicit name still resolves (framework-internal use is fine)
+        assert algos.get(name).name == name
+
+        algos.mark_canonical(name)
+        assert algos.switchable(name)
+        assert algos.get(coin).name == name
+    finally:
+        del algos._REGISTRY[name]
+        del algos._CANONICAL_ALIASES[coin]
+
+
+def test_x11_dash_alias_tracks_canonical_status():
+    """The 'dash' alias must resolve iff the x11 chain is certified."""
+    from otedama_tpu.engine import algos
+
+    algos._load_kernels()
+    spec = algos._REGISTRY["x11"]
+    if spec.canonical:
+        assert algos.get("dash").name == "x11"
+        assert algos.switchable("x11") == spec.implemented()
+    else:
+        with pytest.raises(ValueError):
+            algos.get("dash")
+        assert not algos.switchable("x11")
+
+
+def test_switcher_never_picks_non_canonical():
+    """Even a wildly profitable implemented-but-uncertified chain must not
+    win the auto-switch race (it would mine network-invalid work)."""
+    from otedama_tpu.engine import algos
+
+    name = "_testchain2"
+    algos.register(algos.AlgorithmSpec(
+        name=name, backends=("numpy",), canonical=False,
+        planning_hashrate=1e15))
+    try:
+        pa = ProfitAnalyzer()
+        pa.update_metrics(_metrics("FAKE", name, 1e9, 1.0, reward=1e6))
+
+        async def on_switch(a, e):
+            raise AssertionError("switched onto a non-canonical chain")
+
+        sw = ProfitSwitcher(pa, on_switch, SwitcherConfig(cooldown_seconds=0.0),
+                            current_algorithm="sha256d")
+        sw.record_hashrate(name, 1e15)
+        assert sw.evaluate() is None
+    finally:
+        del algos._REGISTRY[name]
+
+
 # -- algorithm manager -------------------------------------------------------
 
 def test_algorithm_manager_benchmarks_sha256d():

@@ -149,6 +149,122 @@ async def test_proxy_relays_shares_upstream():
     await upstream.stop()
 
 
+@pytest.mark.asyncio
+async def test_proxy_zero_width_prefix_upstream_en2_size_one():
+    """ADVICE r1 (medium) regression: with upstream extranonce2_size == 1
+    the session prefix collapses to 0 bytes; a `[-0:]` slice used to emit a
+    FOUR-byte prefix, so every relayed share carried a wrong-length
+    extranonce2 and died upstream. The relay must succeed end-to-end."""
+    from otedama_tpu.stratum.client import ClientConfig, StratumClient
+    from otedama_tpu.stratum.proxy import ProxyConfig, StratumProxy
+    from otedama_tpu.stratum.server import ServerConfig, StratumServer
+
+    upstream_accepted = []
+
+    async def on_up_share(s):
+        upstream_accepted.append(s)
+
+    upstream = StratumServer(
+        ServerConfig(port=0, initial_difficulty=0.001, extranonce2_size=1),
+        on_share=on_up_share,
+    )
+    await upstream.start()
+    upstream.set_job(_mkjob())
+
+    proxy = StratumProxy(ProxyConfig(
+        listen_host="127.0.0.1", listen_port=0,
+        upstream=ClientConfig(host="127.0.0.1", port=upstream.port,
+                              username="proxywallet.agg"),
+        session_prefix_bytes=2,  # impossible: must shrink to 0
+        downstream_difficulty=0.001,
+    ))
+    await proxy.start()
+    assert proxy.config.session_prefix_bytes == 0
+    await asyncio.sleep(0.2)
+
+    jobs = []
+    miner = StratumClient(
+        ClientConfig(host="127.0.0.1", port=proxy.port, username="w.rig"),
+        on_job=jobs.append,
+    )
+    await miner.start()
+    for _ in range(50):
+        if jobs:
+            break
+        await asyncio.sleep(0.05)
+    assert jobs, "miner never received a job through the proxy"
+    job = jobs[0]
+    assert job.extranonce2_size == 1  # whole upstream allocation passes through
+
+    en2 = b"\x00"
+    prefix76 = jobmod.build_header_prefix(job, en2)
+    target = tgt.difficulty_to_target(0.001)
+    nonce = next(
+        n for n in range(1 << 24)
+        if tgt.hash_meets_target(pow_digest(prefix76 + struct.pack(">I", n)), target)
+    )
+    from otedama_tpu.engine.types import Share
+
+    share = Share(
+        job_id=job.job_id, worker="w.rig", extranonce2=en2,
+        ntime=job.ntime, nonce_word=nonce,
+        digest=pow_digest(prefix76 + struct.pack(">I", nonce)),
+        difficulty=1.0,
+    )
+    result = await miner.submit(share)
+    assert result.accepted, result
+    for _ in range(50):
+        if upstream_accepted:
+            break
+        await asyncio.sleep(0.05)
+    assert upstream_accepted, "share never reached the upstream pool"
+    # the upstream saw an extranonce2 of exactly its advertised width
+    assert len(upstream_accepted[0].extranonce2) == 1
+
+    await miner.stop()
+    await proxy.stop()
+    await upstream.stop()
+
+
+@pytest.mark.asyncio
+async def test_proxy_drops_share_whose_prefix_was_pruned():
+    """ADVICE r1 (low) regression: a pruned session prefix must drop the
+    share, not reconstruct a (different) prefix from the session id."""
+    from otedama_tpu.stratum.proxy import ProxyConfig, StratumProxy
+    from otedama_tpu.stratum.server import AcceptedShare
+
+    proxy = StratumProxy(ProxyConfig(session_prefix_bytes=2))
+
+    submitted = []
+
+    class FakeUpstream:
+        difficulty = 0.0
+        username = "agg"
+
+        async def submit(self, share):
+            submitted.append(share)
+            return type("R", (), {"accepted": True})()
+
+    proxy.upstream = FakeUpstream()
+    proxy.server.set_job(_mkjob())
+    job_id = next(iter(proxy.server.jobs))
+    share = AcceptedShare(
+        session_id=42, worker_user="w", job_id=job_id, difficulty=1.0,
+        actual_difficulty=1.0, digest=b"\x00" * 32, header=b"\x00" * 80,
+        extranonce2=b"\x00\x01", ntime=0, nonce_word=0, is_block=False,
+        submitted_at=0.0,
+    )
+    await proxy._on_downstream_share(share)  # session 42 never allocated
+    assert not submitted
+    assert proxy.stats["pruned_session_dropped"] == 1
+
+    # an allocated session relays fine
+    proxy._alloc_prefix(42)
+    await proxy._on_downstream_share(share)
+    assert len(submitted) == 1
+    assert submitted[0].extranonce2 == proxy._session_prefix(42) + b"\x00\x01"
+
+
 # -- getwork -----------------------------------------------------------------
 
 @pytest.mark.asyncio
@@ -208,6 +324,65 @@ async def test_getwork_issue_and_submit():
                     "params": [encode_work_data(bogus)]},
     )
     assert res["result"] is False
+    await srv.stop()
+
+
+@pytest.mark.asyncio
+async def test_getwork_hashes_with_algorithm_at_issue_time():
+    """ADVICE r1 (low) regression: work issued under algorithm A must be
+    validated with A at submit time even if a profit switch moved
+    current_job to algorithm B inside the work-expiry window."""
+    import dataclasses
+
+    from otedama_tpu.stratum.getwork import (
+        GetworkConfig, GetworkServer, decode_work_data, encode_work_data,
+    )
+
+    shares = []
+
+    async def on_share(worker, hdr, digest):
+        shares.append((worker, hdr, digest))
+
+    srv = GetworkServer(
+        GetworkConfig(port=0, share_difficulty=0.001), on_share=on_share
+    )
+    await srv.start()
+    srv.set_job(_mkjob())  # algorithm defaults to sha256d
+    loop = asyncio.get_running_loop()
+
+    def rpc(obj):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/",
+            data=json.dumps(obj).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return json.loads(resp.read())
+
+    got = await loop.run_in_executor(
+        None, rpc, {"id": 1, "method": "getwork", "params": []}
+    )
+    work = got["result"]
+    header76 = decode_work_data(work["data"])[:76]
+    target = int.from_bytes(bytes.fromhex(work["target"]), "little")
+    nonce = next(
+        n for n in range(1 << 24)
+        if tgt.hash_meets_target(
+            pow_digest(header76 + struct.pack(">I", n), "sha256d"), target)
+    )
+    solved = header76 + struct.pack(">I", nonce)
+
+    # profit switch lands mid-window: current job is now a different algo
+    srv.set_job(dataclasses.replace(_mkjob(job_id="j2"), algorithm="sha256"))
+
+    res = await loop.run_in_executor(
+        None, rpc,
+        {"id": 2, "method": "submitwork", "params": [encode_work_data(solved)]},
+    )
+    # hashed with the issue-time sha256d; a current-job sha256 hash of the
+    # same nonce would (overwhelmingly likely) miss the target and reject
+    assert res["result"] is True, res
+    assert shares and shares[0][2] == pow_digest(solved, "sha256d")
     await srv.stop()
 
 
