@@ -123,7 +123,9 @@ def supports(name: str, backend: str) -> bool:
     _load_kernels()
     try:
         return backend in get(name).backends
-    except KeyError:
+    except (KeyError, ValueError):
+        # ValueError = gated canonical alias; a capability probe answers
+        # False rather than propagating the refusal
         return False
 
 
@@ -131,7 +133,7 @@ def implemented(name: str) -> bool:
     _load_kernels()
     try:
         return get(name).implemented()
-    except KeyError:
+    except (KeyError, ValueError):
         return False
 
 

@@ -12,26 +12,30 @@ a whole nonce batch). ``STAGES`` maps stage name -> module as stages land;
 ``x11_digest`` raises until all 11 exist, so nothing silently computes a
 non-x11 chain.
 
-External validation status (offline environment, no third-party oracles):
-- keccak512: VALIDATED (permutation+sponge reproduce hashlib.sha3_512 when
-  run with SHA3's domain byte; the 0x01-domain digest of b"" matches the
-  published Keccak KAT).
-- blake512: VALIDATED (matches the two known-answer vectors printed in the
-  BLAKE submission: 1 zero byte and 144 zero bytes).
-- cubehash512: VALIDATED IV (the 160-round parameter-derived IV reproduces
-  the published CubeHash16/32-512 IV table).
-- groestl512: VALIDATED (empty-string digest matches the published
-  Groestl-512 KAT; AES S-box derived from its GF(2^8) definition).
-- skein512, bmw512, jh512: spec-faithful, structurally tested, awaiting an
-  external KAT source (jh's round constants and IV are self-derived from
-  the spec's generation rules).
-- luffa512, shavite512, simd512, echo512: construction per the respective
-  submissions; table-level details documented in each module. Because
-  several stages lack offline oracles, the CHAIN's digests are internally
-  consistent (miner and pool share this code) but cross-implementation
-  parity with canonical Dash x11 is NOT certified — treat x11 here as the
-  framework's own end-to-end chained-kernel pipeline until external KATs
-  can be run against it.
+External validation status (offline environment; KATs encoded from the
+SHA-3 competition ShortMsgKAT_512 Len=0 vectors — see tests/test_x11.py):
+- VALIDATED (10 of 11): blake512, bmw512, groestl512, skein512, jh512,
+  keccak512, luffa512, cubehash512 (its 160-round parameter-derived IV
+  reproduces the published CubeHash16/32-512 IV table, which certifies the
+  round function transitively), shavite512, echo512.  Each matches its
+  published Len=0 KAT digest (shavite: first 48 of 64 bytes of the
+  remembered vector — a full-state feed-forward makes a partial match
+  impossible unless the implementation is exact).
+- UNVERIFIED (1 of 11): simd512.  Best-effort reconstruction of the
+  submission (see its module docstring); the exact expanded-message index
+  tables could not be confirmed offline, and an exhaustive search over the
+  plausible layout space against the Dash genesis block did not locate the
+  canonical configuration.
+
+Because simd512 is unverified, the CHAIN is internally consistent (miner
+and pool share this code) but cross-implementation parity with canonical
+Dash x11 is NOT certified: x11 registers with ``canonical=False``, the
+"dash" coin alias refuses to resolve, and the profit switcher will not
+auto-switch onto it (engine/algos.py).  Chain-level oracle for future
+certification: x11(Dash genesis header) must equal
+00000ffd590b1485b3caadc19b22e6379c733355108f107a430458cdb3407424
+(header: version=1, prev=0, merkle e0028eb9...a662c7, time=1390095618,
+bits=0x1e0ffff0, nonce=28917698).
 """
 
 from __future__ import annotations

@@ -4,16 +4,23 @@ Lane-axis implementation in the *grouped* domain of the JH spec: the
 1024-bit state is 256 four-bit elements ``[B, 256]`` (uint8), a round is
 S-box substitution (S0/S1 selected per element by the round-constant bit),
 the L transform over GF(2^4)/x^4+x+1 on element pairs, and the permutation
-P8 = phi ∘ P' ∘ pi. The 42 round constants are self-generated per the spec:
-C_0 = the first 256 bits of frac(sqrt(2)), C_{r+1} = R6(C_r) (the
-dimension-6 round with zero constants).
+P8 = phi ∘ P' ∘ pi.
 
-The IV is likewise derived: H(-1) = digest size (512) in the first 16 bits,
-H(0) = F8(H(-1), 0^512).
+Two layout details matter for cross-implementation parity (both bit this
+module in an earlier round):
+- E8's initial grouping makes q_i from state bits (i, i+256, i+512, i+768)
+  and then INTERLEAVES: A[2i] = q_i, A[2i+1] = q_{i+128} (inverse applied
+  at the final degroup).
+- The 42 round constants live natively as 64 NIBBLES (consecutive 4-bit
+  groups of the 256-bit constant, i.e. the hex digits of C_0): the schedule
+  C_{r+1} = R6(C_r) applies S0/L/P6 on that nibble array directly, and the
+  selector for element A[i] is flat bit i of the constant string.
+C_0 = the first 256 bits of frac(sqrt(2)).
 
-Validation status: no external oracle offline; constants/IV derivation at
-least forces the E6/E8 round structure to be self-consistent. Structural
-tests only.
+The IV is derived per spec: H(-1) = digest size (512) as 16-bit BE in the
+first two bytes, H(0) = F8(H(-1), 0^512).
+
+Validated against the JH-512 ShortMsgKAT Len=0 digest (90ecf2f7...).
 """
 
 from __future__ import annotations
@@ -97,20 +104,39 @@ def _bits_to_bytes(bits: np.ndarray) -> np.ndarray:
 
 
 @functools.lru_cache(maxsize=1)
+def _interleave() -> tuple[np.ndarray, np.ndarray]:
+    """E8 layout: A[2i] = q_i, A[2i+1] = q_{i+128}; plus its inverse."""
+    inter = np.empty(256, dtype=np.intp)
+    inter[0::2] = np.arange(128)
+    inter[1::2] = np.arange(128, 256)
+    return inter, np.argsort(inter)
+
+
+@functools.lru_cache(maxsize=1)
 def round_constants() -> np.ndarray:
-    """The 42 E8 round constants as ``[42, 256]`` bit arrays."""
+    """The 42 E8 round constants as ``[42, 256]`` selector-bit arrays.
+
+    The schedule runs on the constant's native 64-nibble representation
+    (nibble j = hex digit j of C_0): S0 on every nibble, L on pairs, P6.
+    Selector bit i for element A[i] is flat bit i of the 256-bit constant.
+    """
     c0_hex = (
         "6a09e667f3bcc908b2fb1366ea957d3e3adec17512775099da2f590b0667322a"
     )
-    c = np.unpackbits(np.frombuffer(bytes.fromhex(c0_hex), dtype=np.uint8))
+    nib = np.array([int(c, 16) for c in c0_hex], dtype=np.uint8)
     perm6 = _perm_indices(6)
-    zeros64 = np.zeros(64, dtype=np.uint8)
-    out = [c]
-    for _ in range(41):
-        A = _group_bits(c[None, :], 6)[0]
-        A = _round(A, zeros64, perm6)
-        c = _degroup_bits(A[None, :], 6)[0]
-        out.append(c)
+    out = []
+    for _ in range(42):
+        out.append(np.unpackbits(nib[:, None], axis=1)[:, 4:].reshape(-1))
+        A = S0[nib]
+        a = A[0::2]
+        b = A[1::2]
+        b = b ^ _MUL2[a]
+        a = a ^ _MUL2[b]
+        nxt = np.empty_like(A)
+        nxt[0::2] = a
+        nxt[1::2] = b
+        nib = nxt[perm6]
     return np.stack(out)
 
 
@@ -125,12 +151,13 @@ def _e8(A: np.ndarray) -> np.ndarray:
 def _f8(H_bytes: np.ndarray, M_bytes: np.ndarray) -> np.ndarray:
     """F8 compression: xor M into the first 512 state bits, E8, xor M into
     the last 512 bits. ``H_bytes``: ``[B, 128]``, ``M_bytes``: ``[B, 64]``."""
+    inter, deinter = _interleave()
     H = H_bytes.copy()
     H[:, :64] ^= M_bytes
     bits = _bytes_to_bits(H)
-    A = _group_bits(bits, 8)
+    A = _group_bits(bits, 8)[..., inter]
     A = _e8(A)
-    out = _bits_to_bytes(_degroup_bits(A, 8))
+    out = _bits_to_bytes(_degroup_bits(A[..., deinter], 8))
     out[:, 64:] ^= M_bytes
     return out
 

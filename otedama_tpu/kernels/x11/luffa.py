@@ -116,15 +116,35 @@ def _q(x: list, j: int) -> list:
 
 
 def _mi5(V: list, M: list) -> list:
-    """Message injection for w=5 (all states and the block are word lists)."""
+    """Luffa v2 message injection for w=5.
+
+    Four phases (v2 added the two M2-ring mixes over v1's simple form —
+    without them the five sub-states only interact through the xor-tree):
+      1. xor-tree feedback: t = M2(⊕_j V_j); V_j ^= t
+      2. ring mix up:   V_j = M2(V_j) ⊕ V_{j+1}  (parallel, from snapshot)
+      3. ring mix down: V_j = M2(V_j) ⊕ V_{j-1}  (parallel, from snapshot)
+      4. message chain: V_j ^= M2^j(M)
+    Verified against the Luffa-512 ShortMsgKAT Len=0 digest (6e7de450...).
+    """
     t = [V[0][i] ^ V[1][i] ^ V[2][i] ^ V[3][i] ^ V[4][i] for i in range(8)]
     t = _m2(t)
     V = [[V[j][i] ^ t[i] for i in range(8)] for j in range(5)]
+    doubled = [_m2(v) for v in V]
+    V = [
+        [doubled[j][i] ^ V[(j + 1) % 5][i] for i in range(8)]
+        for j in range(5)
+    ]
+    doubled = [_m2(v) for v in V]
+    V = [
+        [doubled[j][i] ^ V[(j - 1) % 5][i] for i in range(8)]
+        for j in range(5)
+    ]
     m = list(M)
+    out = []
     for j in range(5):
-        V[j] = [V[j][i] ^ m[i] for i in range(8)]
+        out.append([V[j][i] ^ m[i] for i in range(8)])
         m = _m2(m)
-    return V
+    return out
 
 
 def luffa512(data_words: np.ndarray, n_bytes: int) -> np.ndarray:

@@ -3,22 +3,28 @@
 Lane-axis implementation. C512 compression: 512-bit state as four 128-bit
 quarters (p0..p3), 14 Feistel rounds where each of the two branch updates
 runs a 4-AES-round keyed F function; 448 32-bit subkeys from the message
-expansion (initial 32 message words, then alternating nonlinear rounds —
-AES on the word-rotated previous subkey xored with the 32-words-back value
-— and linear rounds rk[i] = rk[i-32] ^ rk[i-4]), with the 128-bit bit
-counter folded into the four nonlinear expansion rounds under rotating
-word order and a complemented final word.
+expansion:
+
+- 13 expansion blocks of 32 words after the 32 message words, alternating
+  NONLINEAR and LINEAR starting nonlinear (7 NL + 6 L).
+- Nonlinear group appended at index u: AES round (keyless) of the one-word
+  rotation of the 32-back words — x = (rk[u-31], rk[u-30], rk[u-29],
+  rk[u-32]) — XORed with the last four words rk[u-4..u-1].
+- Linear: rk[u+j] = rk[u-32+j] ^ rk[u-7+j] (the -7 tap crosses group
+  boundaries on purpose).
+- The 128-bit bit counter is injected at subkey indices 32, 164, 316, 440
+  with word orders (c0,c1,c2,~c3), (c3,c2,c1,~c0), (c2,c3,c0,~c1),
+  (c1,c0,c3,~c2) — inside the expansion, so later subkeys depend on it.
+
+Padding: 0x80, zeros, the 16-byte LE bit counter at block bytes 110..125,
+the 2-byte digest size at 126..127. A block consisting only of padding is
+compressed with counter 0.
 
 Words are little-endian; AES rounds view each 128-bit quantity as the
 standard column-major AES state.
 
-Validation status: structure per the SHAvite-3 submission; the exact
-counter-injection offsets inside the expansion follow this module's
-documented layout (first 4 words of each nonlinear round) — no offline
-oracle exists to confirm the submission's exact offsets, so cross-
-implementation parity for this stage is unverified (see kernels/x11
-package docstring; miner and pool share this implementation, so in-framework
-behavior is consistent).
+Validated: the empty-message digest reproduces the SHAvite-3-512
+ShortMsgKAT Len=0 digest (a485c1b2...).
 """
 
 from __future__ import annotations
@@ -32,18 +38,21 @@ U32 = np.uint32
 ROUNDS = 14
 RK_WORDS = 448
 
-# expansion schedule: 13 rounds of 32 words after the message block;
-# nonlinear at expansion rounds 0, 3, 6, 9 (4 nonlinear total)
-_NONLINEAR_ROUNDS = (0, 3, 6, 9)
-
-# counter word order per nonlinear round (index into cnt[4]); the last
-# listed word is complemented
-_CNT_ORDERS = (
-    (0, 1, 2, 3),
-    (3, 2, 1, 0),
-    (2, 3, 0, 1),
-    (1, 0, 3, 2),
+# published SHAvite-3-512 initial value
+IV512 = (
+    0x72FCCDD8, 0x79CA4727, 0x128A077B, 0x40D55AEC,
+    0xD1901A06, 0x430AE307, 0xB29F5CD1, 0xDF07FBFC,
+    0x8E45D73D, 0x681AB538, 0xBDE86578, 0xDD577E47,
+    0xE275EADE, 0x502D9FCD, 0xB9357178, 0x022A4B9A,
 )
+
+# counter-injection points: subkey index -> word order (last complemented)
+_CNT_INJECT = {
+    32: (0, 1, 2, 3),
+    164: (3, 2, 1, 0),
+    316: (2, 3, 0, 1),
+    440: (1, 0, 3, 2),
+}
 
 
 def _words_to_aes_bytes(w: list[np.ndarray]) -> np.ndarray:
@@ -76,31 +85,31 @@ def _aes0_words(w: list[np.ndarray]) -> list[np.ndarray]:
 
 def expand_keys(m: list[np.ndarray], counter: int) -> list[np.ndarray]:
     """448 subkey words (lanes) from 32 message words + the bit counter."""
-    cnt = [(counter >> (32 * i)) & 0xFFFFFFFF for i in range(4)]
+    cnt = [U32((counter >> (32 * i)) & 0xFFFFFFFF) for i in range(4)]
     rk: list[np.ndarray] = list(m)
-    nl_index = 0
-    for e in range(13):
-        base = 32 * (e + 1)
-        if e in _NONLINEAR_ROUNDS:
-            for t in range(8):
-                i = base + 4 * t
-                prev = [rk[i - 4], rk[i - 3], rk[i - 2], rk[i - 1]]
-                # rotate the previous subkey by one word, then AES it
-                rot = [prev[1], prev[2], prev[3], prev[0]]
-                a = _aes0_words(rot)
+    u = 32
+    nonlinear = True
+    while u < RK_WORDS:
+        if nonlinear:
+            for _ in range(8):
+                x = [rk[u - 31], rk[u - 30], rk[u - 29], rk[u - 32]]
+                x = _aes0_words(x)
                 for j in range(4):
-                    rk.append(a[j] ^ rk[i - 32 + j])
-            order = _CNT_ORDERS[nl_index]
-            for j in range(4):
-                word = U32(cnt[order[j]])
-                if j == 3:
-                    word = ~word
-                rk[base + j] = rk[base + j] ^ word
-            nl_index += 1
+                    rk.append(x[j] ^ rk[u - 4 + j])
+                order = _CNT_INJECT.get(u)
+                if order is not None:
+                    for j in range(4):
+                        w = cnt[order[j]]
+                        if j == 3:
+                            w = ~w
+                        rk[u + j] = rk[u + j] ^ w
+                u += 4
         else:
-            for t in range(32):
-                i = base + t
-                rk.append(rk[i - 32] ^ rk[i - 4])
+            for _ in range(8):
+                for j in range(4):
+                    rk.append(rk[u - 32 + j] ^ rk[u - 7 + j])
+                u += 4
+        nonlinear = not nonlinear
     assert len(rk) == RK_WORDS
     return rk
 
@@ -135,42 +144,30 @@ def shavite512(data_words: np.ndarray, n_bytes: int) -> np.ndarray:
     data_words = np.atleast_2d(data_words)
     B = data_words.shape[0]
     bitlen = n_bytes * 8
-    # pad: 0x80, zeros, 16-byte LE counter, 2-byte LE digest size, to 128B
-    n_blocks = (n_bytes + 1 + 18 + 127) // 128
-    padded = np.zeros((B, n_blocks * 32), dtype=np.uint32)
+    # 0x80 + counter(16B @ offset 110) + size(2B @ 126) must fit the block
+    rem = n_bytes % 128
+    total = (n_bytes - rem) + (128 if rem < 110 else 256)
+    padded = np.zeros((B, total // 4), dtype=np.uint32)
     padded[:, : data_words.shape[1]] = data_words
     word_i, byte_i = divmod(n_bytes, 4)
     padded[:, word_i] |= U32(0x80) << U32(8 * byte_i)
     tail = bitlen.to_bytes(16, "little") + (512).to_bytes(2, "little")
-    tail_words = np.frombuffer(tail + b"\x00\x00", dtype="<u4")
-    padded[:, -5:] = tail_words[:5]
+    # bytes total-18 .. total-1 are word-aligned only in pairs: splice via bytes
+    tail_arr = np.frombuffer(tail, dtype="<u2").astype(np.uint32)
+    for k in range(9):  # 9 uint16 pieces at byte offsets total-18+2k
+        byte_off = total - 18 + 2 * k
+        wi, sh = divmod(byte_off, 4)
+        padded[:, wi] |= U32(tail_arr[k]) << U32(8 * sh)
 
-    # IV: generated per the spec style — C512 of a zero block from a state
-    # holding the digest size, counter 0 (precomputed once, deterministic)
-    h = _iv512(B)
-    for blk in range(n_blocks):
+    h = [np.full(B, U32(v), dtype=np.uint32) for v in IV512]
+    for blk in range(total // 128):
         m = [padded[:, blk * 32 + i] for i in range(32)]
         # counter: message bits processed incl. this block; 0 for pad-only
         c = min(bitlen, (blk + 1) * 1024)
-        if c - blk * 1024 <= 0:
+        if c <= blk * 1024:
             c = 0
         h = c512(h, m, c)
     return np.stack(h, axis=-1)
-
-
-_IV_CACHE: np.ndarray | None = None
-
-
-def _iv512(B: int) -> list[np.ndarray]:
-    global _IV_CACHE
-    if _IV_CACHE is None:
-        seed = [np.full(1, U32(512), dtype=np.uint32)] + [
-            np.zeros(1, dtype=np.uint32) for _ in range(15)
-        ]
-        zero_m = [np.zeros(1, dtype=np.uint32) for _ in range(32)]
-        out = c512(seed, zero_m, 0)
-        _IV_CACHE = np.array([int(w[0]) for w in out], dtype=np.uint32)
-    return [np.full(B, _IV_CACHE[i], dtype=np.uint32) for i in range(16)]
 
 
 def shavite512_bytes(data: bytes) -> bytes:

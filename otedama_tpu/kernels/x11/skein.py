@@ -19,7 +19,9 @@ import numpy as np
 
 U64 = np.uint64
 
-C240 = 0x1BD11BDABA1A22B5
+# Threefish key-schedule parity constant, Skein 1.3 (v1.1's 0x5555... was
+# tweaked to this value in the final-round submission x11 deployments use)
+C240 = 0x1BD11BDAA9FC1A22
 
 R512 = (
     (46, 36, 19, 37),

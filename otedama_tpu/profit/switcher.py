@@ -56,10 +56,19 @@ class ProfitSwitcher:
     def _effective_hashrates(self) -> dict[str, float]:
         """Measured rates, falling back to registry planning rates
         (reference: engine.go:1092-1104 hard-coded assumptions)."""
-        out = dict(self.hashrates)
+        if self.config.implemented_only:
+            # non-canonical chains must never enter the race — including
+            # measured rates (mining x11 framework-internally records one);
+            # a non-switchable winner would wedge evaluate() into returning
+            # None forever instead of taking the next-best canonical switch
+            out = {
+                n: h for n, h in self.hashrates.items() if algos.switchable(n)
+            }
+        else:
+            out = dict(self.hashrates)
         for name in algos.names(implemented_only=self.config.implemented_only):
             if self.config.implemented_only and not algos.switchable(name):
-                continue  # non-canonical chains must never enter the race
+                continue
             spec = algos.get(name)
             if name not in out and spec.planning_hashrate > 0:
                 out[name] = spec.planning_hashrate

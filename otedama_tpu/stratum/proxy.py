@@ -145,7 +145,11 @@ class StratumProxy:
 
     def _alloc_prefix(self, session_id: int) -> bytes:
         """Pick a prefix no *live* session is using; the id counter alone
-        wraps at 2^(8*prefix_bytes) and would collide under churn."""
+        wraps at 2^(8*prefix_bytes) and would collide under churn.
+
+        With a zero-width prefix (upstream extranonce2_size == 1) the space
+        is exactly one session; further miners are refused at connect time
+        (the server catches this and closes only that client)."""
         size = self.config.session_prefix_bytes
         space = 1 << (8 * size)
         live = {

@@ -184,10 +184,18 @@ class StratumServer:
         peer = writer.get_extra_info("peername")
         session_id = self._next_session
         self._next_session += 1
+        try:
+            extranonce1 = self._alloc_extranonce1(session_id)
+        except Exception as e:
+            # e.g. a proxy whose upstream allocation has no session space
+            # left — refuse this client, keep serving the others
+            log.warning("refusing client %s: %s", peer, e)
+            writer.close()
+            return
         session = Session(
             id=session_id,
             peer=f"{peer[0]}:{peer[1]}" if peer else "?",
-            extranonce1=self._alloc_extranonce1(session_id),
+            extranonce1=extranonce1,
             extranonce2_size=self.config.extranonce2_size,
             writer=writer,
         )

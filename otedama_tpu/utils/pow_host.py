@@ -33,6 +33,14 @@ def pow_digest(header: bytes, algorithm: str = "sha256d") -> bytes:
     if algorithm in ("scrypt", "litecoin"):
         return scrypt_1024_1_1(header)
     if algorithm in ("x11", "dash"):
+        if algorithm == "dash":
+            # the coin alias implies live-network rules: route through the
+            # registry so a non-canonical chain refuses here too, not just
+            # at algorithm resolution (the gate must cover the one path
+            # that actually computes digests)
+            from otedama_tpu.engine import algos
+
+            algos.get("dash")  # raises ValueError while x11 is uncertified
         from otedama_tpu.kernels.x11 import x11_digest
 
         return x11_digest(header)

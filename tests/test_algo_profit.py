@@ -205,6 +205,12 @@ def test_switcher_never_picks_non_canonical():
                             current_algorithm="sha256d")
         sw.record_hashrate(name, 1e15)
         assert sw.evaluate() is None
+        # a MEASURED non-canonical rate must not wedge the race either: with
+        # a canonical competitor on the board, that competitor must win
+        pa.update_metrics(_metrics("LTC", "scrypt", 80.0, 1e7, reward=6.25))
+        sw.record_hashrate("scrypt", 1e9)
+        best = sw.evaluate()
+        assert best is not None and best.algorithm == "scrypt"
     finally:
         del algos._REGISTRY[name]
 

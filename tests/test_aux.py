@@ -221,6 +221,13 @@ async def test_proxy_zero_width_prefix_upstream_en2_size_one():
     # the upstream saw an extranonce2 of exactly its advertised width
     assert len(upstream_accepted[0].extranonce2) == 1
 
+    # a SECOND miner exceeds the zero-width prefix space (1 session): it
+    # must be refused cleanly while the first keeps its session
+    r2, w2 = await asyncio.open_connection("127.0.0.1", proxy.port)
+    assert await r2.readline() == b""  # server closes without a response
+    w2.close()
+    assert len(proxy.server.sessions) == 1
+
     await miner.stop()
     await proxy.stop()
     await upstream.stop()
