@@ -53,6 +53,34 @@ class MetricsRegistry:
         with self._lock:
             self._slot(name, help_, "counter")[_fmt_labels(labels)] = float(value)
 
+    def histogram_set(
+        self,
+        name: str,
+        bucket_counts: dict[float, float],
+        sum_: float,
+        count: float,
+        labels: dict | None = None,
+        help_: str = "",
+    ) -> None:
+        """Mirror a histogram from an authoritative stats struct.
+
+        ``bucket_counts``: upper-bound -> CUMULATIVE count (le semantics);
+        the +Inf bucket is added automatically from ``count``.
+        """
+        import math
+
+        with self._lock:
+            slot = self._slot(name, help_, "histogram")
+            base = dict(labels or {})
+            # keys carry the numeric le so render can emit buckets in
+            # ascending order with +Inf last (required by the exposition
+            # format; a string sort would put "+Inf" first)
+            for le, v in sorted(bucket_counts.items()):
+                slot[("bucket", float(le), _fmt_labels({**base, "le": f"{le:g}"}))] = float(v)
+            slot[("bucket", math.inf, _fmt_labels({**base, "le": "+Inf"}))] = float(count)
+            slot[("sum", math.inf, _fmt_labels(base))] = float(sum_)
+            slot[("count", math.inf, _fmt_labels(base))] = float(count)
+
     def render(self) -> str:
         lines = []
         with self._lock:
@@ -61,11 +89,25 @@ class MetricsRegistry:
                 if help_:
                     lines.append(f"# HELP {name} {help_}")
                 lines.append(f"# TYPE {name} {type_}")
-                for labelstr, value in sorted(series.items()):
-                    if value == int(value) and abs(value) < 1e15:
-                        lines.append(f"{name}{labelstr} {int(value)}")
+                def _order(kv):
+                    key = kv[0]
+                    if isinstance(key, tuple):  # (suffix, le, labelstr)
+                        # buckets ascend by le with +Inf last, then _count,
+                        # then _sum (both carry le=inf)
+                        rank = {"bucket": 0, "count": 1, "sum": 2}[key[0]]
+                        return (1, key[1], rank, key[2])
+                    return (0, 0.0, 0, str(key))
+
+                for key, value in sorted(series.items(), key=_order):
+                    if isinstance(key, tuple):  # histogram component
+                        suffix, _le, labelstr = key
+                        full = f"{name}_{suffix}{labelstr}"
                     else:
-                        lines.append(f"{name}{labelstr} {value}")
+                        full = f"{name}{key}"
+                    if value == int(value) and abs(value) < 1e15:
+                        lines.append(f"{full} {int(value)}")
+                    else:
+                        lines.append(f"{full} {value}")
         return "\n".join(lines) + "\n"
 
 

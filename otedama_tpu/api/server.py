@@ -222,3 +222,16 @@ class ApiServer:
                             {"status": status}, help_="Share counters")
         reg.counter_set("otedama_blocks_found_total",
                         snapshot.get("blocks_found", 0), help_="Blocks found")
+
+    def sync_client_metrics(self, client) -> None:
+        """Export the stratum client's measured share-accept latency
+        distribution (BASELINE config 4; reference target <50 ms)."""
+        if getattr(client, "latency_count", 0) <= 0:
+            return
+        self.registry.histogram_set(
+            "otedama_share_latency_seconds",
+            dict(client.latency_buckets),
+            client.latency_sum,
+            client.latency_count,
+            help_="Share submit->verdict latency",
+        )

@@ -488,6 +488,8 @@ class Application:
             if self.api is not None and self.engine is not None:
                 snap = self.engine.snapshot()
                 self.api.sync_engine_metrics(snap)
+                if self.client is not None:
+                    self.api.sync_client_metrics(self.client)
                 if self.profit_analyzer is not None and self.profit_switcher is not None:
                     self.profit_switcher.record_hashrate(
                         snap.get("algorithm", ""), snap.get("hashrate", 0.0)

@@ -51,6 +51,16 @@ class EngineConfig:
     worker_name: str = "otedama-tpu"
     algorithm: str = "sha256d"
     batch_size: int = 1 << 22
+    # adopt a backend's preferred_batch when it exceeds batch_size: the
+    # Pallas kernel takes 2^30 nonces in ONE launch, and driving it with
+    # small batches leaves >90% of the chip idle on dispatch latency
+    auto_batch: bool = True
+    # in-flight device launches per backend: 3 = enqueue batches N+1, N+2
+    # while batch N computes, hiding host dispatch + result-transfer
+    # latency (the device serializes the compute; the overlap is
+    # host<->device). Deeper also covers the result-fetch + share-emit
+    # gap between drains on the tunneled platform.
+    pipeline_depth: int = 3
     extranonce2_size: int = 4
     # stop searching a job after this age even without a replacement
     job_max_age: float = 120.0
@@ -151,8 +161,28 @@ class MiningEngine:
             # pod's host rows — runtime.mesh.PodBackend.en2_fanout); devices
             # own disjoint blocks laid out by the engine at start()
             fanout = getattr(backend, "en2_fanout", 1)
+            batch_size = self.config.batch_size
+            if self.config.auto_batch:
+                batch_size = max(
+                    batch_size, getattr(backend, "preferred_batch", 0)
+                )
+            depth = max(1, self.config.pipeline_depth)
             extranonce = ExtranonceCounter(size=job.extranonce2_size or self.config.extranonce2_size)
             extranonce.value = en2_offset
+
+            # pipelined dispatch: keep up to `depth` searches in flight so
+            # the host's dispatch/transfer latency hides under device
+            # compute; in-flight work is always drained (winners from an
+            # already-running launch are still valid shares for its job)
+            pending: list[tuple[list[bytes], asyncio.Future]] = []
+
+            # grouped dispatch: backends that support it run `depth`
+            # launches per executor call with all dispatches issued before
+            # the first sync — thread-level overlap alone cannot hide the
+            # per-launch sync on tunneled platforms (a blocking transfer
+            # starves the next dispatch)
+            grouped = fanout == 1 and hasattr(backend, "search_group")
+
             while not self._stop.is_set() and serial == self._job_serial:
                 en2s = [extranonce.current()]
                 for _ in range(fanout - 1):
@@ -162,26 +192,41 @@ class MiningEngine:
                     for en2 in en2s
                 ]
                 space = NonceRange(0, 1 << 32)
-                for base, count in space.batches(self.config.batch_size):
+                t_last = time.monotonic()
+                all_batches = list(space.batches(batch_size))
+                if grouped:
+                    work_units = [
+                        all_batches[i : i + depth]
+                        for i in range(0, len(all_batches), depth)
+                    ]
+                else:
+                    work_units = [[b] for b in all_batches]
+                for unit in work_units:
                     if self._stop.is_set() or serial != self._job_serial:
                         break
-                    t0 = time.monotonic()
-                    if fanout > 1:
-                        results: list[SearchResult] = await loop.run_in_executor(
+                    if grouped:
+                        fut = loop.run_in_executor(
+                            None, backend.search_group, jcs[0], unit
+                        )
+                    elif fanout > 1:
+                        base, count = unit[0]
+                        fut = loop.run_in_executor(
                             None, backend.search_multi, jcs, base, count
                         )
                     else:
-                        results = [
-                            await loop.run_in_executor(
-                                None, backend.search, jcs[0], base, count
-                            )
-                        ]
-                    dt = time.monotonic() - t0
-                    hashes = sum(r.hashes for r in results)
-                    dstats.record_batch(hashes, dt)
-                    self.stats.hashes += hashes
-                    for en2, result in zip(en2s, results):
-                        await self._emit_shares(job, en2, result)
+                        base, count = unit[0]
+                        fut = loop.run_in_executor(
+                            None, backend.search, jcs[0], base, count
+                        )
+                    pending.append((en2s, fut))
+                    # grouped backends already overlap inside one call, so
+                    # two groups in flight suffice; depth=1 disables overlap
+                    pend_cap = min(2, depth) if grouped else depth
+                    if len(pending) >= pend_cap:
+                        p_en2s, p_fut = pending.pop(0)
+                        t_last = await self._consume(
+                            job, p_en2s, await p_fut, dstats, t_last
+                        )
                 else:
                     # nonce spaces exhausted: stride to this device's next
                     # extranonce2 block (counter sits at block start + f-1)
@@ -189,6 +234,38 @@ class MiningEngine:
                         extranonce.roll()
                     continue
                 break  # job changed or stopping
+            # drain whatever is still in flight for this job
+            for p_en2s, p_fut in pending:
+                try:
+                    results = await p_fut
+                except Exception:
+                    log.exception("in-flight search failed during drain")
+                    continue
+                await self._consume(job, p_en2s, results, dstats, None)
+
+    async def _consume(
+        self, job: Job, en2s: list[bytes], results, dstats, t_last: float | None
+    ) -> float:
+        """Account one drained search future and emit its shares.
+
+        ``results`` is one SearchResult (plain), a list of per-en2 results
+        (fanout backends), or a list of same-en2 slices (grouped backends —
+        distinguished by a single-entry ``en2s``). Returns the new t_last.
+        """
+        if not isinstance(results, list):
+            results = [results]
+        now = time.monotonic()
+        hashes = sum(r.hashes for r in results)
+        dstats.record_batch(hashes, 0.0 if t_last is None else now - t_last)
+        self.stats.hashes += hashes
+        if len(en2s) == 1:
+            # grouped: every result is a slice of the SAME extranonce space
+            for result in results:
+                await self._emit_shares(job, en2s[0], result)
+        else:
+            for en2, result in zip(en2s, results):
+                await self._emit_shares(job, en2, result)
+        return now
 
     async def _emit_shares(self, job: Job, en2: bytes, result: SearchResult) -> None:
         for w in result.winners:

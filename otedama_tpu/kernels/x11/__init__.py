@@ -20,7 +20,10 @@ SHA-3 competition ShortMsgKAT_512 Len=0 vectors — see tests/test_x11.py):
   round function transitively), shavite512, echo512.  Each matches its
   published Len=0 KAT digest (shavite: first 48 of 64 bytes of the
   remembered vector — a full-state feed-forward makes a partial match
-  impossible unless the implementation is exact).
+  impossible unless the implementation is exact; NB the Len=0 vector runs
+  with counter=0, so shavite's counter-word ORDERS are pinned by recall,
+  not by the KAT — see its module docstring before treating it as fully
+  certified on real, nonzero-counter inputs).
 - UNVERIFIED (1 of 11): simd512.  Best-effort reconstruction of the
   submission (see its module docstring); the exact expanded-message index
   tables could not be confirmed offline, and an exhaustive search over the

@@ -24,7 +24,14 @@ Words are little-endian; AES rounds view each 128-bit quantity as the
 standard column-major AES state.
 
 Validated: the empty-message digest reproduces the SHAvite-3-512
-ShortMsgKAT Len=0 digest (a485c1b2...).
+ShortMsgKAT Len=0 digest (a485c1b2...). Scope caveat: that vector runs
+with counter=0, so all four counter words are zero and the KAT pins the
+injection OFFSETS and the complement position but CANNOT distinguish the
+_CNT_INJECT word orders — the (c0,c1,c2,~c3)/(c3,c2,c1,~c0)/... orders are
+from this author's recall of the reference and remain unverified for
+nonzero counters (i.e. for every real x11 input). A nonzero-counter
+cross-check (or the Dash-genesis chain oracle once simd is canonical) is
+required before treating this stage as fully certified.
 """
 
 from __future__ import annotations

@@ -48,6 +48,12 @@ class SubmitResult:
     latency: float  # seconds from write to pool verdict
 
 
+# histogram upper bounds bracketing the reference's 50 ms target
+LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0
+)
+
+
 class StratumClient:
     """One upstream pool connection."""
 
@@ -72,6 +78,14 @@ class StratumClient:
             "reconnects": 0,
             "last_accept_latency": 0.0,
         }
+        # share-accept latency distribution (BASELINE config 4; the
+        # reference targets <50 ms, README.md:104): cumulative counts per
+        # upper bound, exported as otedama_share_latency_seconds
+        self.latency_buckets: dict[float, int] = {
+            le: 0 for le in LATENCY_BUCKETS
+        }
+        self.latency_sum = 0.0
+        self.latency_count = 0
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
         self._pending: dict[int, asyncio.Future] = {}
@@ -256,6 +270,7 @@ class StratumClient:
         """Submit a share and await the pool verdict."""
         self.stats["shares_submitted"] += 1
         t0 = time.monotonic()
+        verdict_arrived = True
         try:
             result = await self._call(
                 "mining.submit", sp.submit_params(self.config.username, share)
@@ -274,10 +289,19 @@ class StratumClient:
             # internal closure surfaces as ConnectionError via the future)
             latency = time.monotonic() - t0
             accepted = False
+            verdict_arrived = False
             err = [sp.ERR_OTHER, f"no pool response: {type(e).__name__}", None]
         if accepted:
             self.stats["shares_accepted"] += 1
             self.stats["last_accept_latency"] = latency
         else:
             self.stats["shares_rejected"] += 1
+        if verdict_arrived:
+            # timeouts/drops would record the CLIENT's timeout value, not
+            # pool latency — keep them out of the distribution
+            self.latency_sum += latency
+            self.latency_count += 1
+            for le in self.latency_buckets:
+                if latency <= le:
+                    self.latency_buckets[le] += 1
         return SubmitResult(accepted=accepted, error=err, latency=latency)

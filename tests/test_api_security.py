@@ -36,6 +36,26 @@ def test_metrics_render_prometheus_text():
     assert 'otedama_shares_total{status="accepted"} 3' in text
 
 
+def test_metrics_histogram_render():
+    """Share-accept latency exported as a real Prometheus histogram
+    (BASELINE config 4)."""
+    reg = MetricsRegistry()
+    reg.histogram_set(
+        "otedama_share_latency_seconds",
+        {0.005: 2, 0.05: 5, 1.0: 6},
+        sum_=0.123,
+        count=7,
+        help_="Share submit->verdict latency",
+    )
+    text = reg.render()
+    assert "# TYPE otedama_share_latency_seconds histogram" in text
+    assert 'otedama_share_latency_seconds_bucket{le="0.005"} 2' in text
+    assert 'otedama_share_latency_seconds_bucket{le="0.05"} 5' in text
+    assert 'otedama_share_latency_seconds_bucket{le="+Inf"} 7' in text
+    assert "otedama_share_latency_seconds_sum 0.123" in text
+    assert "otedama_share_latency_seconds_count 7" in text
+
+
 # -- rate limit --------------------------------------------------------------
 
 def test_token_bucket_refill():

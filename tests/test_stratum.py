@@ -211,6 +211,21 @@ async def test_mining_loopback_end_to_end():
 
     assert accepted, "no share accepted"
     assert any(r.accepted for r in results), "client saw no accept verdict"
-    assert all(r.latency < 5 for r in results if r.accepted)
+    # BASELINE config 4: share-accept latency in the reference's 50 ms
+    # frame (README.md:104). Loopback has no network jitter, so the whole
+    # submit->verdict path (encode, server validation incl. a host sha256d,
+    # response decode) must fit with margin.
+    lats = sorted(r.latency for r in results if r.accepted)
+    p50 = lats[len(lats) // 2]
+    p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))]
+    print(f"\nshare-accept latency loopback: p50={p50*1e3:.2f}ms "
+          f"p99={p99*1e3:.2f}ms n={len(lats)}")
+    # hard-assert the median (p99 with few samples = max sample, which one
+    # CI scheduler hiccup can blow past 50 ms); p99 gets a sanity ceiling
+    assert p50 < 0.05, f"p50 {p50*1e3:.1f}ms exceeds the 50ms frame"
+    assert p99 < 1.0, f"p99 {p99*1e3:.1f}ms absurd for loopback"
+    # the client's histogram recorded every submit
+    assert client.latency_count == len(results)
+    assert client.latency_buckets[5.0] == len(results)
     assert engine.stats.shares_found >= 1
     assert server.stats["shares_valid"] >= 1
