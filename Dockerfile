@@ -1,0 +1,43 @@
+# otedama_tpu — TPU-native mining framework
+# Reference parity: /root/reference Dockerfile (Go builder + alpine runtime);
+# redesigned for the Python/JAX stack: no build stage is needed, but the
+# image must carry the TPU-enabled jax wheel when targeting real chips.
+#
+# CPU image (default): functional for pool/proxy/API roles and CI.
+# TPU image:  build with --build-arg JAX_EXTRA=tpu on a TPU VM base so the
+#             libtpu wheel is pulled in; run with the TPU device plugin.
+
+FROM python:3.11-slim AS runtime
+
+ARG JAX_EXTRA=cpu
+
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends curl g++ make \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+
+COPY pyproject.toml ./
+COPY otedama_tpu ./otedama_tpu
+COPY bench.py ./
+
+RUN pip install --no-cache-dir "jax[${JAX_EXTRA}]" numpy \
+    && pip install --no-cache-dir -e . \
+    && python -m compileall -q otedama_tpu
+
+# build the optional native sha256d backend (ctypes, no pybind11)
+RUN cd otedama_tpu/native && make -s || true
+
+# non-root runtime user (reference runs as "otedama")
+RUN useradd -r -m otedama && mkdir -p /data && chown otedama /data
+USER otedama
+VOLUME /data
+
+# stratum server / API / getwork
+EXPOSE 3333 8080 8332
+
+HEALTHCHECK --interval=30s --timeout=5s --retries=3 \
+    CMD curl -sf http://127.0.0.1:8080/api/v1/status || exit 1
+
+ENTRYPOINT ["python", "-m", "otedama_tpu.cli"]
+CMD ["-c", "/data/otedama.yaml", "pool"]

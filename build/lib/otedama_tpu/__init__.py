@@ -1,0 +1,37 @@
+"""otedama_tpu — a TPU-native mining framework.
+
+A ground-up rebuild of the capabilities of shizukutanaka/Otedama (a Go
+mining application: miner + stratum pool + P2P pool + ops shell), designed
+TPU-first: the nonce-search hot loop runs as vectorized uint32 Pallas/XLA
+kernels over HBM-resident nonce batches, multi-chip scale goes through
+``jax.sharding.Mesh`` + ``shard_map`` with ICI collectives for counter
+reduction, and the host side is an asyncio orchestration layer speaking
+stratum V1 over TCP.
+
+Package map (reference parity noted per subpackage):
+
+- ``kernels``   — device hash kernels: sha256d / scrypt / x11 (reference:
+  ``internal/gpu/cuda_miner.go`` CUDA text + ``internal/mining/multi_algorithm.go``)
+- ``runtime``   — device census, nonce partitioner, batched search driver,
+  multi-chip mesh (reference: ``internal/mining/hardware_accelerated.go``,
+  ``internal/gpu/multi_gpu.go``, ``internal/hardware``)
+- ``engine``    — job/share pipeline, algorithm registry, difficulty
+  management (reference: ``internal/mining/engine.go``)
+- ``stratum``   — stratum V1 JSON-RPC client + server (reference:
+  ``internal/stratum/unified_stratum.go``)
+- ``pool``      — share validation, payouts, block submission, failover
+  (reference: ``internal/pool``)
+- ``p2p``       — binary TCP gossip overlay (reference: ``internal/p2p``)
+- ``api``       — REST/WS API + metrics endpoints (reference: ``internal/api``)
+- ``monitoring``— metric registry, health checks (reference: ``internal/monitoring``)
+- ``security``  — auth (JWT/TOTP/ZKP), rate limiting (reference:
+  ``internal/auth``, ``internal/security``)
+- ``persistence`` — sqlite repositories (reference: ``internal/database``)
+- ``native``    — C++ CPU mining backend via ctypes (reference:
+  ``internal/cpu`` ASM-intent tiers)
+- ``utils``     — host-side helpers (pure-python sha256, encoding, i18n)
+"""
+
+from otedama_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,96 @@
+"""Analytics: time-series aggregation over pool/worker/engine activity.
+
+Reference parity: internal/analytics/analytics_engine.go:15-139 (pool and
+worker statistics aggregation) and realtime_analytics.go:14 (live series
+for the WS dashboard). Bounded in-memory ring of samples per series with
+windowed aggregates (avg/min/max/rate) and a tick hook the app's metrics
+loop feeds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+
+
+@dataclasses.dataclass
+class SeriesPoint:
+    timestamp: float
+    value: float
+
+
+class TimeSeries:
+    def __init__(self, max_points: int = 2880):  # 4h at 5s ticks
+        self._points: deque[SeriesPoint] = deque(maxlen=max_points)
+
+    def add(self, value: float, timestamp: float | None = None) -> None:
+        self._points.append(SeriesPoint(
+            timestamp if timestamp is not None else time.time(), value
+        ))
+
+    def window(self, seconds: float, now: float | None = None) -> list[SeriesPoint]:
+        now = now if now is not None else time.time()
+        cutoff = now - seconds
+        return [p for p in self._points if p.timestamp >= cutoff]
+
+    def aggregate(self, seconds: float, now: float | None = None) -> dict:
+        points = self.window(seconds, now)
+        if not points:
+            return {"count": 0, "avg": 0.0, "min": 0.0, "max": 0.0, "last": 0.0}
+        values = [p.value for p in points]
+        return {
+            "count": len(values),
+            "avg": sum(values) / len(values),
+            "min": min(values),
+            "max": max(values),
+            "last": values[-1],
+        }
+
+    def rate_per_second(self, seconds: float, now: float | None = None) -> float:
+        """For monotonically-increasing counters: delta / elapsed."""
+        points = self.window(seconds, now)
+        if len(points) < 2:
+            return 0.0
+        dt = points[-1].timestamp - points[0].timestamp
+        return (points[-1].value - points[0].value) / dt if dt > 0 else 0.0
+
+
+class AnalyticsEngine:
+    """Named series + snapshot-driven ingestion."""
+
+    WINDOWS = {"1m": 60.0, "10m": 600.0, "1h": 3600.0}
+
+    def __init__(self):
+        self.series: dict[str, TimeSeries] = {}
+        self.started_at = time.time()
+
+    def track(self, name: str, value: float, timestamp: float | None = None) -> None:
+        self.series.setdefault(name, TimeSeries()).add(value, timestamp)
+
+    def ingest_engine(self, snap: dict, timestamp: float | None = None) -> None:
+        self.track("hashrate", snap.get("hashrate", 0.0), timestamp)
+        self.track("hashes", snap.get("hashes", 0), timestamp)
+        shares = snap.get("shares", {})
+        self.track("shares_found", shares.get("found", 0), timestamp)
+        self.track("shares_accepted", shares.get("accepted", 0), timestamp)
+
+    def ingest_pool(self, snap: dict, timestamp: float | None = None) -> None:
+        self.track("pool_workers", snap.get("workers", 0), timestamp)
+        self.track("pool_shares", snap.get("shares", 0), timestamp)
+
+    def report(self, now: float | None = None) -> dict:
+        out: dict = {"uptime_seconds": round(
+            (now if now is not None else time.time()) - self.started_at, 1
+        )}
+        for name, series in self.series.items():
+            out[name] = {
+                label: series.aggregate(seconds, now)
+                for label, seconds in self.WINDOWS.items()
+            }
+            if name in ("hashes", "shares_found", "shares_accepted", "pool_shares"):
+                out[name]["rate_per_second"] = series.rate_per_second(600.0, now)
+        return out
+
+    def snapshot(self) -> dict:
+        return self.report()

@@ -1,0 +1,4 @@
+from otedama_tpu.api.metrics import MetricsRegistry
+from otedama_tpu.api.server import ApiConfig, ApiServer
+
+__all__ = ["ApiConfig", "ApiServer", "MetricsRegistry"]

@@ -1,0 +1,23 @@
+from otedama_tpu.config.schema import (
+    ApiConfig,
+    AppConfig,
+    MiningConfig,
+    P2PConfig,
+    PoolSettings,
+    StratumSettings,
+    load_config,
+    validate_config,
+)
+from otedama_tpu.config.manager import ConfigManager
+
+__all__ = [
+    "AppConfig",
+    "MiningConfig",
+    "PoolSettings",
+    "StratumSettings",
+    "P2PConfig",
+    "ApiConfig",
+    "load_config",
+    "validate_config",
+    "ConfigManager",
+]

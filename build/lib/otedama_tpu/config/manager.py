@@ -1,0 +1,75 @@
+"""Config manager with hot reload.
+
+Reference parity: internal/config/manager.go + watcher.go (fsnotify watcher
+with change callbacks — cmd/otedama/main.go:337-354 reconnects the pool on
+change). No fsnotify in stdlib: a 1 Hz mtime poller gives the same
+semantics with zero dependencies.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+from typing import Callable
+
+from otedama_tpu.config.schema import AppConfig, load_config
+
+log = logging.getLogger("otedama.config")
+
+ChangeCallback = Callable[[AppConfig, AppConfig], None]
+
+
+class ConfigManager:
+    def __init__(self, path: str | None = None, poll_seconds: float = 1.0):
+        self.path = path
+        self.poll_seconds = poll_seconds
+        self.config = load_config(path)
+        self._callbacks: list[ChangeCallback] = []
+        self._mtime = self._stat()
+        self._task: asyncio.Task | None = None
+
+    def _stat(self) -> float:
+        if self.path and os.path.exists(self.path):
+            return os.stat(self.path).st_mtime
+        return 0.0
+
+    def on_change(self, cb: ChangeCallback) -> None:
+        self._callbacks.append(cb)
+
+    def reload(self) -> bool:
+        """Reload now; returns True if the config changed and was valid."""
+        try:
+            new = load_config(self.path)
+        except ValueError as e:
+            log.error("config reload rejected: %s", e)
+            return False
+        old, self.config = self.config, new
+        for cb in self._callbacks:
+            try:
+                cb(old, new)
+            except Exception:
+                log.exception("config change callback failed")
+        log.info("config reloaded from %s", self.path)
+        return True
+
+    def start_watching(self) -> None:
+        if self._task is None and self.path:
+            self._task = asyncio.get_running_loop().create_task(self._watch())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _watch(self) -> None:
+        while True:
+            await asyncio.sleep(self.poll_seconds)
+            m = self._stat()
+            if m != self._mtime:
+                self._mtime = m
+                self.reload()

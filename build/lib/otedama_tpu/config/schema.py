@@ -1,0 +1,300 @@
+"""Configuration schema: YAML file + env overrides + validation.
+
+Reference parity: internal/config/config.go:10-185 (full YAML schema),
+env.go (OTEDAMA_* overrides), validator.go. Precedence: explicit kwargs >
+env > file > defaults (reference app/application.go:174-233 has
+flags>env>file).
+
+YAML parsing: pyyaml when present, else a built-in minimal parser good for
+the flat two-level structure this schema uses (no pip installs in the
+image is a hard constraint).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+
+log = logging.getLogger("otedama.config")
+
+try:
+    import yaml as _yaml  # type: ignore
+
+    def _parse_yaml(text: str) -> dict:
+        return _yaml.safe_load(text) or {}
+
+except ImportError:  # pragma: no cover - exercised where pyyaml is absent
+
+    def _parse_yaml(text: str) -> dict:
+        return _mini_yaml(text)
+
+
+def _coerce_scalar(s: str):
+    s = s.strip()
+    if not s:
+        return None
+    if s.startswith(("'", '"')) and s.endswith(s[0]) and len(s) >= 2:
+        return s[1:-1]
+    low = s.lower()
+    if low in ("true", "yes", "on"):
+        return True
+    if low in ("false", "no", "off"):
+        return False
+    if low in ("null", "~"):
+        return None
+    try:
+        return int(s, 0)
+    except ValueError:
+        pass
+    try:
+        return float(s)
+    except ValueError:
+        pass
+    if s.startswith("[") and s.endswith("]"):
+        inner = s[1:-1].strip()
+        return [_coerce_scalar(x) for x in inner.split(",")] if inner else []
+    return s
+
+
+def _mini_yaml(text: str) -> dict:
+    """Two-level indented key/value YAML subset (enough for our schema)."""
+    root: dict = {}
+    stack: list[tuple[int, dict]] = [(0, root)]
+    for raw in text.splitlines():
+        line = raw.split("#", 1)[0].rstrip()
+        if not line.strip():
+            continue
+        indent = len(line) - len(line.lstrip())
+        key, _, value = line.strip().partition(":")
+        while stack and indent < stack[-1][0]:
+            stack.pop()
+        container = stack[-1][1]
+        if value.strip() == "":
+            child: dict = {}
+            container[key] = child
+            stack.append((indent + 2, child))
+        else:
+            container[key] = _coerce_scalar(value)
+    return root
+
+
+# -- schema ------------------------------------------------------------------
+
+@dataclasses.dataclass
+class MiningConfig:
+    enabled: bool = True
+    algorithm: str = "sha256d"
+    backend: str = "auto"        # auto|pod|pallas-tpu|xla|native-cpu|python
+    batch_size: int = 1 << 24
+    worker_name: str = "otedama-tpu"
+    devices: str = "all"               # all | count | comma list of indices
+    # pod backend: extranonce2 rows of the (host, chip) mesh; 0 = pick
+    # automatically (2 rows when the device count is even, else 1)
+    pod_hosts: int = 0
+
+
+@dataclasses.dataclass
+class StratumSettings:
+    enabled: bool = False
+    host: str = "0.0.0.0"
+    port: int = 3333
+    initial_difficulty: float = 1.0
+    extranonce2_size: int = 4
+    max_clients: int = 10000
+    vardiff_target_seconds: float = 10.0
+
+
+@dataclasses.dataclass
+class UpstreamConfig:
+    url: str = ""                      # host:port
+    username: str = ""
+    password: str = "x"
+    priority: int = 0
+
+
+@dataclasses.dataclass
+class PoolSettings:
+    enabled: bool = False
+    payout_scheme: str = "PPLNS"
+    pplns_window: int = 10000
+    fee_percent: float = 1.0
+    minimum_payout: int = 100_000
+    database: str = "otedama.db"
+    chain_rpc_url: str = ""
+    chain_rpc_user: str = ""
+    chain_rpc_password: str = ""
+
+
+@dataclasses.dataclass
+class P2PConfig:
+    enabled: bool = False
+    host: str = "0.0.0.0"
+    port: int = 4333
+    max_peers: int = 32
+    bootstrap: list = dataclasses.field(default_factory=list)  # ["host:port"]
+
+
+@dataclasses.dataclass
+class ApiConfig:
+    enabled: bool = True
+    host: str = "127.0.0.1"
+    port: int = 8080
+    metrics_enabled: bool = True
+    rate_limit_per_minute: int = 600
+    auth_secret: str = ""              # empty = admin endpoints disabled
+
+
+@dataclasses.dataclass
+class LoggingConfig:
+    level: str = "info"
+    file: str = ""
+
+
+@dataclasses.dataclass
+class AppConfig:
+    mining: MiningConfig = dataclasses.field(default_factory=MiningConfig)
+    stratum: StratumSettings = dataclasses.field(default_factory=StratumSettings)
+    pool: PoolSettings = dataclasses.field(default_factory=PoolSettings)
+    p2p: P2PConfig = dataclasses.field(default_factory=P2PConfig)
+    api: ApiConfig = dataclasses.field(default_factory=ApiConfig)
+    logging: LoggingConfig = dataclasses.field(default_factory=LoggingConfig)
+    upstreams: list = dataclasses.field(default_factory=list)  # [UpstreamConfig]
+
+
+_SECTIONS = {
+    "mining": MiningConfig,
+    "stratum": StratumSettings,
+    "pool": PoolSettings,
+    "p2p": P2PConfig,
+    "api": ApiConfig,
+    "logging": LoggingConfig,
+}
+
+
+def _apply_dict(cfg: AppConfig, data: dict) -> None:
+    for section, cls in _SECTIONS.items():
+        sub = data.get(section)
+        if not isinstance(sub, dict):
+            continue
+        target = getattr(cfg, section)
+        for f in dataclasses.fields(cls):
+            if f.name in sub and sub[f.name] is not None:
+                setattr(target, f.name, sub[f.name])
+    ups = data.get("upstreams")
+    if isinstance(ups, list):
+        cfg.upstreams = [
+            UpstreamConfig(**u) if isinstance(u, dict) else u for u in ups
+        ]
+    elif isinstance(ups, dict):
+        # mini-yaml parses "upstreams:" with nested named entries
+        cfg.upstreams = [
+            UpstreamConfig(**v) for v in ups.values() if isinstance(v, dict)
+        ]
+
+
+def _apply_env(cfg: AppConfig, environ=None) -> None:
+    """OTEDAMA_<SECTION>_<FIELD>=value overrides (reference config/env.go)."""
+    environ = environ if environ is not None else os.environ
+    for key, value in environ.items():
+        if not key.startswith("OTEDAMA_"):
+            continue
+        parts = key[len("OTEDAMA_"):].lower().split("_", 1)
+        if len(parts) != 2:
+            continue
+        section, field = parts
+        if section not in _SECTIONS:
+            continue
+        target = getattr(cfg, section)
+        if not hasattr(target, field):
+            continue
+        current = getattr(target, field)
+        coerced = _coerce_scalar(value)
+        if isinstance(current, bool):
+            coerced = bool(coerced)
+        elif isinstance(current, int) and not isinstance(coerced, int):
+            try:
+                coerced = int(float(coerced))
+            except (TypeError, ValueError):
+                continue
+        elif isinstance(current, float):
+            try:
+                coerced = float(coerced)
+            except (TypeError, ValueError):
+                continue
+        setattr(target, field, coerced)
+
+
+def load_config(path: str | None = None, environ=None) -> AppConfig:
+    cfg = AppConfig()
+    if path and os.path.exists(path):
+        with open(path) as f:
+            _apply_dict(cfg, _parse_yaml(f.read()))
+    _apply_env(cfg, environ)
+    errors = validate_config(cfg)
+    if errors:
+        raise ValueError("invalid config: " + "; ".join(errors))
+    return cfg
+
+
+def validate_config(cfg: AppConfig) -> list[str]:
+    """Reference parity: internal/config/validator.go."""
+    errors = []
+    from otedama_tpu.engine import algos
+
+    try:
+        algos.get(cfg.mining.algorithm)
+    except KeyError:
+        errors.append(f"unknown algorithm {cfg.mining.algorithm!r}")
+    if cfg.mining.batch_size <= 0 or cfg.mining.batch_size > (1 << 32):
+        errors.append("mining.batch_size out of range")
+    for name in ("stratum", "p2p", "api"):
+        port = getattr(cfg, name).port
+        if not (0 <= port <= 65535):
+            errors.append(f"{name}.port out of range")
+    if cfg.stratum.initial_difficulty <= 0:
+        errors.append("stratum.initial_difficulty must be positive")
+    if not (0 <= cfg.pool.fee_percent < 100):
+        errors.append("pool.fee_percent out of range")
+    if cfg.pool.pplns_window <= 0:
+        errors.append("pool.pplns_window must be positive")
+    return errors
+
+
+def example_yaml() -> str:
+    return """\
+# otedama-tpu configuration
+mining:
+  enabled: true
+  algorithm: sha256d
+  backend: auto
+  batch_size: 16777216
+  worker_name: tpu-pod
+
+stratum:
+  enabled: false
+  host: 0.0.0.0
+  port: 3333
+  initial_difficulty: 1.0
+
+pool:
+  enabled: false
+  payout_scheme: PPLNS
+  pplns_window: 10000
+  fee_percent: 1.0
+  database: otedama.db
+
+p2p:
+  enabled: false
+  port: 4333
+  max_peers: 32
+  bootstrap: []
+
+api:
+  enabled: true
+  host: 127.0.0.1
+  port: 8080
+
+logging:
+  level: info
+"""

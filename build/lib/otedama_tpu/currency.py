@@ -1,0 +1,100 @@
+"""Currency registry + per-chain client manager.
+
+Reference parity: internal/currency/ (currency registry, per-chain
+BlockchainClient construction, ClientManager :115). A currency definition
+binds an algorithm, address formats, units and chain parameters; the
+manager constructs and caches chain clients per configured currency.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from otedama_tpu.pool.blockchain import (
+    BitcoinRPCClient,
+    BlockchainClient,
+    MockChainClient,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Currency:
+    code: str
+    name: str
+    algorithm: str
+    atomic_per_coin: int = 100_000_000
+    block_time: float = 600.0
+    coinbase_maturity: int = 100
+    address_prefixes: tuple[str, ...] = ()
+
+
+_REGISTRY: dict[str, Currency] = {}
+
+
+def register(c: Currency) -> Currency:
+    _REGISTRY[c.code] = c
+    return c
+
+
+def get(code: str) -> Currency:
+    try:
+        return _REGISTRY[code.upper()]
+    except KeyError:
+        raise KeyError(
+            f"unknown currency {code!r}; known: {sorted(_REGISTRY)}"
+        ) from None
+
+
+def codes() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+register(Currency("BTC", "Bitcoin", "sha256d",
+                  address_prefixes=("1", "3", "bc1")))
+register(Currency("LTC", "Litecoin", "scrypt", block_time=150.0,
+                  address_prefixes=("L", "M", "ltc1")))
+register(Currency("DOGE", "Dogecoin", "scrypt", block_time=60.0,
+                  address_prefixes=("D",)))
+register(Currency("DASH", "Dash", "x11", block_time=150.0,
+                  address_prefixes=("X",)))
+register(Currency("BCH", "Bitcoin Cash", "sha256d",
+                  address_prefixes=("1", "q", "bitcoincash:")))
+
+
+@dataclasses.dataclass
+class ChainEndpoint:
+    currency: str
+    rpc_url: str = ""
+    rpc_user: str = ""
+    rpc_password: str = ""
+
+
+class ClientManager:
+    """Constructs and caches one chain client per configured currency."""
+
+    def __init__(self, endpoints: list[ChainEndpoint] | None = None):
+        self._endpoints = {e.currency.upper(): e for e in endpoints or []}
+        self._clients: dict[str, BlockchainClient] = {}
+
+    def client(self, code: str) -> BlockchainClient:
+        code = code.upper()
+        get(code)  # validate the currency exists
+        if code not in self._clients:
+            ep = self._endpoints.get(code)
+            if ep is not None and ep.rpc_url:
+                self._clients[code] = BitcoinRPCClient(
+                    ep.rpc_url, ep.rpc_user, ep.rpc_password
+                )
+            else:
+                self._clients[code] = MockChainClient()
+        return self._clients[code]
+
+    def snapshot(self) -> dict:
+        return {
+            code: {
+                "algorithm": get(code).algorithm,
+                "configured": code in self._endpoints,
+                "connected": code in self._clients,
+            }
+            for code in codes()
+        }

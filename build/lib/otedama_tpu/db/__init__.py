@@ -1,0 +1,15 @@
+from otedama_tpu.db.database import Database
+from otedama_tpu.db.repos import (
+    BlockRepository,
+    PayoutRepository,
+    ShareRepository,
+    WorkerRepository,
+)
+
+__all__ = [
+    "Database",
+    "WorkerRepository",
+    "ShareRepository",
+    "BlockRepository",
+    "PayoutRepository",
+]

@@ -1,0 +1,171 @@
+"""SQLite persistence manager.
+
+Reference parity: internal/database/{manager.go,connection_pool.go,migrate.go}
+— connection management, migrations, repositories over SQLite/Postgres.
+Python-native redesign: stdlib sqlite3 in WAL mode with a single writer
+thread affinity (sqlite serializes writers anyway; the reference's
+100-connection pool buys nothing on SQLite), versioned migrations applied
+transactionally, ``:memory:`` supported for tests.
+"""
+
+from __future__ import annotations
+
+import logging
+import sqlite3
+import threading
+import time
+
+log = logging.getLogger("otedama.db")
+
+MIGRATIONS: list[tuple[int, str]] = [
+    (1, """
+    CREATE TABLE workers (
+        id          INTEGER PRIMARY KEY AUTOINCREMENT,
+        name        TEXT NOT NULL UNIQUE,
+        wallet      TEXT NOT NULL DEFAULT '',
+        created_at  REAL NOT NULL,
+        last_seen   REAL NOT NULL,
+        hashrate    REAL NOT NULL DEFAULT 0,
+        shares_valid   INTEGER NOT NULL DEFAULT 0,
+        shares_invalid INTEGER NOT NULL DEFAULT 0,
+        balance     INTEGER NOT NULL DEFAULT 0,      -- atomic units
+        paid_total  INTEGER NOT NULL DEFAULT 0,
+        metadata    TEXT NOT NULL DEFAULT '{}'
+    );
+    CREATE TABLE shares (
+        id          INTEGER PRIMARY KEY AUTOINCREMENT,
+        worker      TEXT NOT NULL,
+        job_id      TEXT NOT NULL,
+        difficulty  REAL NOT NULL,
+        actual_difficulty REAL NOT NULL DEFAULT 0,
+        is_block    INTEGER NOT NULL DEFAULT 0,
+        created_at  REAL NOT NULL
+    );
+    CREATE INDEX idx_shares_worker_time ON shares(worker, created_at);
+    CREATE INDEX idx_shares_time ON shares(created_at);
+    CREATE TABLE blocks (
+        id          INTEGER PRIMARY KEY AUTOINCREMENT,
+        height      INTEGER NOT NULL DEFAULT 0,
+        hash        TEXT NOT NULL,
+        worker      TEXT NOT NULL,
+        reward      INTEGER NOT NULL DEFAULT 0,
+        status      TEXT NOT NULL DEFAULT 'pending', -- pending|confirmed|orphaned
+        confirmations INTEGER NOT NULL DEFAULT 0,
+        created_at  REAL NOT NULL
+    );
+    CREATE TABLE payouts (
+        id          INTEGER PRIMARY KEY AUTOINCREMENT,
+        worker      TEXT NOT NULL,
+        address     TEXT NOT NULL,
+        amount      INTEGER NOT NULL,
+        tx_id       TEXT NOT NULL DEFAULT '',
+        status      TEXT NOT NULL DEFAULT 'pending', -- pending|sent|confirmed|failed
+        created_at  REAL NOT NULL,
+        sent_at     REAL
+    );
+    CREATE INDEX idx_payouts_worker ON payouts(worker);
+    """),
+    (2, """
+    CREATE TABLE audit_log (
+        id         INTEGER PRIMARY KEY AUTOINCREMENT,
+        actor      TEXT NOT NULL,
+        action     TEXT NOT NULL,
+        detail     TEXT NOT NULL DEFAULT '',
+        created_at REAL NOT NULL
+    );
+    """),
+]
+
+
+class Database:
+    """Thread-safe sqlite3 wrapper with schema migrations."""
+
+    def __init__(self, path: str = ":memory:"):
+        self.path = path
+        self._lock = threading.RLock()
+        self._conn = sqlite3.connect(
+            path, check_same_thread=False, isolation_level=None
+        )
+        self._conn.row_factory = sqlite3.Row
+        if path != ":memory:":
+            self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA foreign_keys=ON")
+        self._conn.execute("PRAGMA synchronous=NORMAL")
+        self.migrate()
+
+    # -- migrations ---------------------------------------------------------
+
+    def schema_version(self) -> int:
+        with self._lock:
+            return int(self._conn.execute("PRAGMA user_version").fetchone()[0])
+
+    def migrate(self) -> None:
+        with self._lock:
+            current = self.schema_version()
+            for version, sql in MIGRATIONS:
+                if version <= current:
+                    continue
+                log.info("applying migration %d", version)
+                # NB: executescript() would implicitly commit, so split and
+                # run the statements inside one explicit transaction
+                self._conn.execute("BEGIN")
+                try:
+                    for stmt in sql.split(";"):
+                        if stmt.strip():
+                            self._conn.execute(stmt)
+                    self._conn.execute(f"PRAGMA user_version = {version}")
+                    self._conn.execute("COMMIT")
+                except Exception:
+                    self._conn.execute("ROLLBACK")
+                    raise
+
+    # -- access -------------------------------------------------------------
+
+    def execute(self, sql: str, params: tuple = ()) -> sqlite3.Cursor:
+        with self._lock:
+            return self._conn.execute(sql, params)
+
+    def executemany(self, sql: str, rows: list[tuple]) -> sqlite3.Cursor:
+        with self._lock:
+            return self._conn.executemany(sql, rows)
+
+    def query(self, sql: str, params: tuple = ()) -> list[sqlite3.Row]:
+        with self._lock:
+            return self._conn.execute(sql, params).fetchall()
+
+    def query_one(self, sql: str, params: tuple = ()) -> sqlite3.Row | None:
+        with self._lock:
+            return self._conn.execute(sql, params).fetchone()
+
+    def transaction(self):
+        return _Transaction(self)
+
+    def audit(self, actor: str, action: str, detail: str = "") -> None:
+        self.execute(
+            "INSERT INTO audit_log (actor, action, detail, created_at) VALUES (?,?,?,?)",
+            (actor, action, detail, time.time()),
+        )
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
+class _Transaction:
+    def __init__(self, db: Database):
+        self.db = db
+
+    def __enter__(self):
+        self.db._lock.acquire()
+        self.db._conn.execute("BEGIN")
+        return self.db
+
+    def __exit__(self, exc_type, exc, tb):
+        try:
+            if exc_type is None:
+                self.db._conn.execute("COMMIT")
+            else:
+                self.db._conn.execute("ROLLBACK")
+        finally:
+            self.db._lock.release()
+        return False

@@ -1,0 +1,171 @@
+"""DeFi side module: collateralized lending with liquidation.
+
+Reference parity: internal/defi/lending.go:14-98 (lending / collateral /
+liquidation engines). Integer atomic units; prices injected (oracle is a
+callable) so the engine is deterministic and testable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from typing import Callable
+
+PriceOracle = Callable[[str], float]   # asset -> price in reference units
+
+
+class DefiError(Exception):
+    pass
+
+
+@dataclasses.dataclass
+class LendingMarket:
+    asset: str
+    collateral_factor: float = 0.75    # borrowable fraction of collateral value
+    liquidation_threshold: float = 0.85
+    liquidation_bonus: float = 0.05    # discount for liquidators
+    borrow_rate_per_year: float = 0.08
+    total_deposits: int = 0
+    total_borrows: int = 0
+
+
+@dataclasses.dataclass
+class Position:
+    id: int
+    owner: str
+    collateral_asset: str
+    collateral_amount: int
+    debt_asset: str
+    debt_amount: int
+    opened_at: float = dataclasses.field(default_factory=time.time)
+    last_accrual: float = dataclasses.field(default_factory=time.time)
+
+
+class LendingEngine:
+    def __init__(self, oracle: PriceOracle):
+        self.oracle = oracle
+        self.markets: dict[str, LendingMarket] = {}
+        self.positions: dict[int, Position] = {}
+        self.deposits: dict[tuple[str, str], int] = {}   # (user, asset) -> amount
+        self.liquidations: list[dict] = []
+        self._ids = itertools.count(1)
+
+    def add_market(self, market: LendingMarket) -> None:
+        self.markets[market.asset] = market
+
+    # -- supply side ----------------------------------------------------------
+
+    def deposit(self, user: str, asset: str, amount: int) -> None:
+        if asset not in self.markets:
+            raise DefiError(f"no market for {asset}")
+        if amount <= 0:
+            raise DefiError("amount must be positive")
+        self.deposits[(user, asset)] = self.deposits.get((user, asset), 0) + amount
+        self.markets[asset].total_deposits += amount
+
+    def withdraw(self, user: str, asset: str, amount: int) -> None:
+        held = self.deposits.get((user, asset), 0)
+        if amount <= 0 or amount > held:
+            raise DefiError("insufficient deposit")
+        market = self.markets[asset]
+        if market.total_deposits - amount < market.total_borrows:
+            raise DefiError("market liquidity locked by borrows")
+        self.deposits[(user, asset)] = held - amount
+        market.total_deposits -= amount
+
+    # -- borrow side -----------------------------------------------------------
+
+    def _value(self, asset: str, amount: int) -> float:
+        return self.oracle(asset) * amount
+
+    def open_position(self, owner: str, collateral_asset: str,
+                      collateral_amount: int, debt_asset: str,
+                      debt_amount: int) -> Position:
+        for asset in (collateral_asset, debt_asset):
+            if asset not in self.markets:
+                raise DefiError(f"no market for {asset}")
+        market = self.markets[debt_asset]
+        if market.total_deposits - market.total_borrows < debt_amount:
+            raise DefiError("insufficient market liquidity")
+        max_debt_value = (
+            self._value(collateral_asset, collateral_amount)
+            * self.markets[collateral_asset].collateral_factor
+        )
+        if self._value(debt_asset, debt_amount) > max_debt_value:
+            raise DefiError("undercollateralized")
+        pos = Position(
+            next(self._ids), owner, collateral_asset, collateral_amount,
+            debt_asset, debt_amount,
+        )
+        self.positions[pos.id] = pos
+        market.total_borrows += debt_amount
+        return pos
+
+    def accrue(self, pos_id: int, now: float | None = None) -> int:
+        """Accrue simple interest on the debt; returns new debt amount."""
+        pos = self.positions[pos_id]
+        now = now if now is not None else time.time()
+        market = self.markets[pos.debt_asset]
+        elapsed = max(0.0, now - pos.last_accrual)
+        interest = int(
+            pos.debt_amount * market.borrow_rate_per_year * elapsed / (365 * 86400)
+        )
+        if interest == 0:
+            # sub-unit interest: leave last_accrual so the fraction keeps
+            # accumulating instead of being truncated away on every call
+            return pos.debt_amount
+        pos.debt_amount += interest
+        market.total_borrows += interest
+        pos.last_accrual = now
+        return pos.debt_amount
+
+    def health(self, pos_id: int) -> float:
+        """>1 healthy, <1 liquidatable."""
+        pos = self.positions[pos_id]
+        threshold = self.markets[pos.collateral_asset].liquidation_threshold
+        collateral_value = self._value(pos.collateral_asset, pos.collateral_amount)
+        debt_value = self._value(pos.debt_asset, pos.debt_amount)
+        if debt_value == 0:
+            return float("inf")
+        return collateral_value * threshold / debt_value
+
+    def repay(self, pos_id: int, amount: int) -> None:
+        pos = self.positions[pos_id]
+        amount = min(amount, pos.debt_amount)
+        pos.debt_amount -= amount
+        self.markets[pos.debt_asset].total_borrows -= amount
+        if pos.debt_amount == 0:
+            del self.positions[pos_id]
+
+    def liquidate(self, pos_id: int, liquidator: str) -> dict:
+        if self.health(pos_id) >= 1.0:
+            raise DefiError("position is healthy")
+        pos = self.positions.pop(pos_id)
+        market = self.markets[pos.collateral_asset]
+        debt_value = self._value(pos.debt_asset, pos.debt_amount)
+        seize_value = debt_value * (1.0 + market.liquidation_bonus)
+        price = self.oracle(pos.collateral_asset)
+        seize_amount = min(pos.collateral_amount, int(seize_value / price))
+        self.markets[pos.debt_asset].total_borrows -= pos.debt_amount
+        event = {
+            "position": pos_id,
+            "owner": pos.owner,
+            "liquidator": liquidator,
+            "repaid": pos.debt_amount,
+            "seized": seize_amount,
+            "leftover_collateral": pos.collateral_amount - seize_amount,
+            "ts": time.time(),
+        }
+        self.liquidations.append(event)
+        return event
+
+    def snapshot(self) -> dict:
+        return {
+            "markets": {
+                a: {"deposits": m.total_deposits, "borrows": m.total_borrows}
+                for a, m in self.markets.items()
+            },
+            "positions": len(self.positions),
+            "liquidations": len(self.liquidations),
+        }

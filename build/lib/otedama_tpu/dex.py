@@ -1,0 +1,211 @@
+"""DEX side module: constant-product AMM, order book, swap router.
+
+Reference parity: internal/dex/amm_engine.go:11 (AMM), enhanced_amm.go
+:15-92 (order book + positions), swap_router.go (multi-pool routing).
+Integer math in atomic units throughout (no float value drift); fees in
+basis points, taken on input like Uniswap-v2.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+
+
+class DexError(Exception):
+    pass
+
+
+@dataclasses.dataclass
+class LiquidityPool:
+    asset_a: str
+    asset_b: str
+    reserve_a: int = 0
+    reserve_b: int = 0
+    fee_bps: int = 30
+    total_lp_shares: int = 0
+    lp_shares: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def pair(self) -> tuple[str, str]:
+        return (self.asset_a, self.asset_b)
+
+    def add_liquidity(self, provider: str, amount_a: int, amount_b: int) -> int:
+        if amount_a <= 0 or amount_b <= 0:
+            raise DexError("amounts must be positive")
+        if self.total_lp_shares == 0:
+            shares = int((amount_a * amount_b) ** 0.5)
+        else:
+            shares = min(
+                amount_a * self.total_lp_shares // self.reserve_a,
+                amount_b * self.total_lp_shares // self.reserve_b,
+            )
+        if shares <= 0:
+            raise DexError("deposit too small")
+        self.reserve_a += amount_a
+        self.reserve_b += amount_b
+        self.total_lp_shares += shares
+        self.lp_shares[provider] = self.lp_shares.get(provider, 0) + shares
+        return shares
+
+    def remove_liquidity(self, provider: str, shares: int) -> tuple[int, int]:
+        held = self.lp_shares.get(provider, 0)
+        if shares <= 0 or shares > held:
+            raise DexError("not enough LP shares")
+        out_a = self.reserve_a * shares // self.total_lp_shares
+        out_b = self.reserve_b * shares // self.total_lp_shares
+        self.reserve_a -= out_a
+        self.reserve_b -= out_b
+        self.total_lp_shares -= shares
+        self.lp_shares[provider] = held - shares
+        return out_a, out_b
+
+    def quote(self, asset_in: str, amount_in: int) -> int:
+        """x*y=k output for a fee-adjusted input."""
+        if amount_in <= 0:
+            raise DexError("amount must be positive")
+        if asset_in == self.asset_a:
+            rin, rout = self.reserve_a, self.reserve_b
+        elif asset_in == self.asset_b:
+            rin, rout = self.reserve_b, self.reserve_a
+        else:
+            raise DexError(f"{asset_in} not in pool {self.pair}")
+        if rin == 0 or rout == 0:
+            raise DexError("empty pool")
+        effective = amount_in * (10_000 - self.fee_bps)
+        return effective * rout // (rin * 10_000 + effective)
+
+    def swap(self, asset_in: str, amount_in: int, min_out: int = 0) -> int:
+        out = self.quote(asset_in, amount_in)
+        if out < min_out:
+            raise DexError(f"slippage: {out} < {min_out}")
+        if asset_in == self.asset_a:
+            self.reserve_a += amount_in
+            self.reserve_b -= out
+        else:
+            self.reserve_b += amount_in
+            self.reserve_a -= out
+        return out
+
+
+@dataclasses.dataclass
+class Order:
+    id: int
+    trader: str
+    side: str            # "buy" | "sell" (of base asset, priced in quote)
+    price: float         # quote per base
+    amount: int          # base units remaining
+    created_at: float = dataclasses.field(default_factory=time.time)
+
+
+class OrderBook:
+    """Price-time-priority limit order book for one (base, quote) market."""
+
+    def __init__(self, base: str, quote: str):
+        self.base = base
+        self.quote = quote
+        self.bids: list[Order] = []   # sorted best (highest price) first
+        self.asks: list[Order] = []   # sorted best (lowest price) first
+        self.trades: list[dict] = []
+        self._ids = itertools.count(1)
+
+    def place(self, trader: str, side: str, price: float, amount: int) -> Order:
+        if side not in ("buy", "sell"):
+            raise DexError("side must be buy or sell")
+        if price <= 0 or amount <= 0:
+            raise DexError("price/amount must be positive")
+        order = Order(next(self._ids), trader, side, price, amount)
+        self._match(order)
+        if order.amount > 0:
+            book = self.bids if side == "buy" else self.asks
+            book.append(order)
+            book.sort(key=lambda o: (-o.price, o.created_at) if side == "buy"
+                      else (o.price, o.created_at))
+        return order
+
+    def cancel(self, order_id: int) -> bool:
+        for book in (self.bids, self.asks):
+            for i, o in enumerate(book):
+                if o.id == order_id:
+                    del book[i]
+                    return True
+        return False
+
+    def _match(self, order: Order) -> None:
+        opposite = self.asks if order.side == "buy" else self.bids
+        while order.amount > 0 and opposite:
+            best = opposite[0]
+            crosses = (
+                best.price <= order.price if order.side == "buy"
+                else best.price >= order.price
+            )
+            if not crosses:
+                break
+            fill = min(order.amount, best.amount)
+            self.trades.append({
+                "price": best.price, "amount": fill,
+                "maker": best.trader, "taker": order.trader,
+                "ts": time.time(),
+            })
+            order.amount -= fill
+            best.amount -= fill
+            if best.amount == 0:
+                opposite.pop(0)
+
+    def spread(self) -> float | None:
+        if not self.bids or not self.asks:
+            return None
+        return self.asks[0].price - self.bids[0].price
+
+
+class SwapRouter:
+    """Best-path routing across pools (direct or one intermediate hop)."""
+
+    def __init__(self):
+        self.pools: dict[tuple[str, str], LiquidityPool] = {}
+
+    def add_pool(self, pool: LiquidityPool) -> None:
+        self.pools[pool.pair] = pool
+        self.pools[(pool.asset_b, pool.asset_a)] = pool
+
+    def _direct(self, a: str, b: str) -> LiquidityPool | None:
+        return self.pools.get((a, b))
+
+    def best_route(self, asset_in: str, asset_out: str,
+                   amount_in: int) -> tuple[list[str], int]:
+        best_path: list[str] = []
+        best_out = 0
+        direct = self._direct(asset_in, asset_out)
+        if direct is not None:
+            try:
+                best_out = direct.quote(asset_in, amount_in)
+                best_path = [asset_in, asset_out]
+            except DexError:
+                pass
+        hops = {p[1] for p in self.pools if p[0] == asset_in}
+        for mid in hops:
+            second = self._direct(mid, asset_out)
+            if second is None or mid == asset_out:
+                continue
+            try:
+                mid_amount = self._direct(asset_in, mid).quote(asset_in, amount_in)
+                out = second.quote(mid, mid_amount)
+            except DexError:
+                continue
+            if out > best_out:
+                best_out = out
+                best_path = [asset_in, mid, asset_out]
+        if not best_path:
+            raise DexError(f"no route {asset_in} -> {asset_out}")
+        return best_path, best_out
+
+    def swap(self, asset_in: str, asset_out: str, amount_in: int,
+             min_out: int = 0) -> int:
+        path, quoted = self.best_route(asset_in, asset_out, amount_in)
+        if quoted < min_out:
+            raise DexError(f"slippage: {quoted} < {min_out}")
+        amount = amount_in
+        for a, b in zip(path, path[1:]):
+            amount = self.pools[(a, b)].swap(a, amount)
+        return amount

@@ -1,0 +1,4 @@
+"""Mining engine: job/share data model, header assembly, algorithm registry,
+difficulty management, and the async orchestration loop (reference parity:
+internal/mining/engine.go, types.go, difficulty_manager_unified.go —
+redesigned as asyncio + device-batch dispatch instead of goroutine workers)."""

@@ -1,0 +1,123 @@
+"""Algorithm manager: per-backend benchmarking + engine algorithm switching.
+
+Reference parity: internal/mining/algorithm_manager_unified.go:16-50
+(UnifiedAlgorithmManager), :633-715 (per-hardware benchmark loop). The
+TPU redesign: a benchmark is one timed ``backend.search`` batch (the device
+pipeline is already the production hot path, so there is no separate
+benchmark kernel), and "switching" rewires the engine's backend set since
+each algorithm compiles its own XLA program.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import struct
+import time
+
+from otedama_tpu.engine import algos
+from otedama_tpu.runtime.search import JobConstants, make_backend
+
+log = logging.getLogger("otedama.engine.algos")
+
+
+@dataclasses.dataclass
+class BenchmarkResult:
+    algorithm: str
+    backend: str
+    hashes: int
+    seconds: float
+
+    @property
+    def hashrate(self) -> float:
+        return self.hashes / self.seconds if self.seconds > 0 else 0.0
+
+
+class AlgorithmManager:
+    """Owns measured hashrates per (algorithm, backend) and builds backends."""
+
+    def __init__(self, preferred_backend: str = "auto"):
+        self.preferred_backend = preferred_backend
+        self.results: dict[tuple[str, str], BenchmarkResult] = {}
+
+    # -- backend selection ---------------------------------------------------
+
+    def backend_for(self, algorithm: str, kind: str | None = None, **kwargs):
+        """Instantiate the best available backend for an algorithm."""
+        algos._load_kernels()
+        spec = algos.get(algorithm)
+        if not spec.implemented():
+            raise ValueError(f"algorithm {algorithm!r} has no implemented backend")
+        kind = kind or self.preferred_backend
+        if kind == "auto":
+            try:
+                import jax
+
+                on_tpu = jax.default_backend() == "tpu"
+                n_dev = len(jax.devices())
+            except Exception:  # pragma: no cover
+                on_tpu, n_dev = False, 1
+            if on_tpu:
+                # multi-chip hosts drive every chip through the pod backend;
+                # a single chip goes straight to the Pallas kernel
+                order = ("pod", "pallas-tpu", "xla") if n_dev > 1 else ("pallas-tpu", "xla")
+            else:
+                order = ("xla",)
+            for cand in order:
+                if cand in spec.backends:
+                    kind = cand
+                    break
+            else:
+                kind = spec.backends[0]
+        if kind not in spec.backends:
+            raise ValueError(
+                f"backend {kind!r} does not implement {algorithm!r} "
+                f"(available: {spec.backends})"
+            )
+        return make_backend(kind, algorithm=algorithm, **kwargs)
+
+    # -- benchmarking --------------------------------------------------------
+
+    def benchmark(
+        self, algorithm: str, kind: str | None = None, budget_hashes: int | None = None
+    ) -> BenchmarkResult:
+        """Timed production-path search over a synthetic job."""
+        backend = self.backend_for(algorithm, kind)
+        header76 = bytes(range(64)) + struct.pack(
+            ">3I", 0x17034219, 0x6530D1B7, 0x1D00FFFF
+        )
+        jc = JobConstants.from_header_prefix(header76, target=0)  # no winners
+        if budget_hashes is None:
+            budget_hashes = 1 << 12 if algos.get(algorithm).memory_hard else 1 << 18
+        backend.search(jc, 0, min(budget_hashes, 1 << 10))  # warmup/compile
+        t0 = time.monotonic()
+        backend.search(jc, 1 << 20, budget_hashes)
+        dt = time.monotonic() - t0
+        result = BenchmarkResult(algorithm, getattr(backend, "name", "?"), budget_hashes, dt)
+        self.results[(algorithm, result.backend)] = result
+        log.info(
+            "benchmark %s/%s: %.0f H/s",
+            algorithm, result.backend, result.hashrate,
+        )
+        return result
+
+    async def benchmark_async(self, algorithm: str, kind: str | None = None,
+                              budget_hashes: int | None = None) -> BenchmarkResult:
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, self.benchmark, algorithm, kind, budget_hashes
+        )
+
+    def measured_hashrates(self) -> dict[str, float]:
+        """algorithm -> best measured rate (for the profit switcher)."""
+        out: dict[str, float] = {}
+        for (algorithm, _), r in self.results.items():
+            out[algorithm] = max(out.get(algorithm, 0.0), r.hashrate)
+        return out
+
+    def snapshot(self) -> dict:
+        return {
+            f"{a}/{b}": {"hashrate": r.hashrate, "hashes": r.hashes}
+            for (a, b), r in self.results.items()
+        }

@@ -1,0 +1,201 @@
+"""Algorithm registry with capability flags.
+
+Reference parity: internal/mining/multi_algorithm.go:22-40 (global registry
+keyed by name), algorithm_simple_impls.go (name-registered entries), and the
+15 algorithm name constants of types.go:11-27. Redesigned: an entry declares
+*which execution backends actually implement it* (pallas-tpu / xla /
+native-cpu) instead of the reference's pattern of registering stub engines
+that silently fall back to sha256 (reference: multi_algorithm.go:155-160
+"simplified" ethash) — asking for an unimplemented (algorithm, backend)
+pair here is a loud error.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+# planning-assumption hashrates (H/s) for profitability estimates when no
+# measured rate exists yet — the reference hard-codes similar numbers
+# (internal/mining/engine.go:1092-1104); ours are per-v5e-chip MEASURED
+# rates where a kernel exists (sha256d: BENCH r2 pipelined e2e on v5e).
+_PLANNING = {
+    "sha256d": 1.03e9,   # measured: Pallas kernel, v5e chip (bench.py r2)
+    "sha256": 1.9e9,     # one compression ~= 2x sha256d's two
+    "scrypt": 1.3e4,     # measured: XLA backend, v5e chip (BENCH_SCRYPT_r03)
+    "x11": 7.0e2,        # measured: numpy host pipeline (until device port)
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class AlgorithmSpec:
+    name: str
+    aliases: tuple[str, ...] = ()
+    header_size: int = 80
+    nonce_offset: int = 76
+    backends: tuple[str, ...] = ()      # implemented search backends
+    memory_hard: bool = False           # scrypt-family (VMEM/HBM scratch)
+    chained: int = 1                    # number of chained hash rounds (x11=11)
+    # canonical = the implementation is certified bit-compatible with the
+    # real network's rules (KAT-verified). A non-canonical chain may be
+    # internally consistent (miner+pool share the code) but would produce
+    # INVALID work on the live network — the profit switcher and coin-name
+    # aliases refuse it.
+    canonical: bool = True
+    planning_hashrate: float = 0.0      # H/s per chip, pre-measurement
+    # hook: (header76, target) -> runtime JobConstants; None = sha256d scheme
+    constants_builder: Callable | None = None
+
+    def implemented(self) -> bool:
+        return bool(self.backends)
+
+
+_REGISTRY: dict[str, AlgorithmSpec] = {}
+_KERNELS_LOADED = False
+
+
+def _load_kernels() -> None:
+    """Import kernel modules so their ``mark_implemented`` registrations run.
+
+    Capability queries must reflect what is actually loadable, not which
+    modules a caller happened to import first (the scrypt/x11 backends
+    register themselves at import time).
+    """
+    global _KERNELS_LOADED
+    if _KERNELS_LOADED:
+        return
+    _KERNELS_LOADED = True
+    import importlib
+
+    for mod in ("otedama_tpu.kernels.scrypt_jax", "otedama_tpu.kernels.x11"):
+        try:
+            importlib.import_module(mod)
+        except Exception:  # pragma: no cover - kernel import failure is loud elsewhere
+            pass
+
+
+def register(spec: AlgorithmSpec) -> AlgorithmSpec:
+    _REGISTRY[spec.name] = spec
+    for alias in spec.aliases:
+        _REGISTRY[alias] = spec
+    return spec
+
+
+# Coin-name aliases that imply the CANONICAL network rules. Resolving one
+# through a non-certified chain would hand the caller an algorithm that
+# produces invalid work on the real network, so the alias refuses until
+# the spec is marked canonical (mark_canonical after KAT parity).
+_CANONICAL_ALIASES = {"dash": "x11"}
+
+
+def get(name: str) -> AlgorithmSpec:
+    key = name.lower()
+    target = _CANONICAL_ALIASES.get(key)
+    if target is not None:
+        _load_kernels()
+        spec = _REGISTRY[target]
+        if not spec.canonical:
+            raise ValueError(
+                f"alias {key!r} names the live {target} network, but this "
+                f"{target} implementation is not certified canonical "
+                f"(KAT parity pending) — request {target!r} explicitly to "
+                f"use it as a framework-internal chain"
+            )
+        return spec
+    try:
+        return _REGISTRY[key]
+    except KeyError:
+        raise KeyError(
+            f"unknown algorithm {name!r}; known: {sorted(set(s.name for s in _REGISTRY.values()))}"
+        ) from None
+
+
+def names(implemented_only: bool = False) -> list[str]:
+    if implemented_only:
+        _load_kernels()
+    out = {s.name: s for s in _REGISTRY.values()}
+    return sorted(
+        n for n, s in out.items() if s.implemented() or not implemented_only
+    )
+
+
+def supports(name: str, backend: str) -> bool:
+    _load_kernels()
+    try:
+        return backend in get(name).backends
+    except (KeyError, ValueError):
+        # ValueError = gated canonical alias; a capability probe answers
+        # False rather than propagating the refusal
+        return False
+
+
+def implemented(name: str) -> bool:
+    _load_kernels()
+    try:
+        return get(name).implemented()
+    except (KeyError, ValueError):
+        return False
+
+
+# --- the algorithm surface of the reference (types.go:11-27), with honest
+# capability flags: implemented ones carry backends, planned ones don't. ---
+
+register(AlgorithmSpec(
+    name="sha256d",
+    aliases=("sha256double", "bitcoin"),
+    backends=("pallas-tpu", "pod", "xla", "native-cpu"),
+    planning_hashrate=_PLANNING["sha256d"],
+))
+register(AlgorithmSpec(
+    name="sha256",
+    backends=("xla", "native-cpu"),
+    planning_hashrate=_PLANNING["sha256"],
+))
+register(AlgorithmSpec(
+    name="scrypt",
+    aliases=("litecoin",),
+    memory_hard=True,
+    backends=(),  # filled in by kernels.scrypt import-time registration
+    planning_hashrate=_PLANNING["scrypt"],
+))
+register(AlgorithmSpec(
+    name="x11",
+    # NB: the "dash" coin alias lives in _CANONICAL_ALIASES, not here — it
+    # only resolves once the chain is KAT-certified (canonical=True).
+    chained=11,
+    backends=(),   # filled in by kernels.x11 import-time registration
+    canonical=False,  # flipped by kernels.x11 once all 11 stages KAT-verify
+    planning_hashrate=_PLANNING["x11"],
+))
+# declared by the reference but unimplemented there too (stub registrations,
+# reference: algorithm_simple_impls.go:84-101) — declared here for parity,
+# loudly unimplemented:
+for _name in ("ethash", "etchash", "randomx", "kawpow", "autolykos2",
+              "kheavyhash", "blake3", "equihash", "cuckatoo32", "x16r"):
+    register(AlgorithmSpec(name=_name))
+
+
+def mark_implemented(name: str, backend: str) -> None:
+    """Kernel modules call this when they load successfully."""
+    spec = get(name)
+    if backend not in spec.backends:
+        register(dataclasses.replace(spec, backends=spec.backends + (backend,)))
+
+
+def mark_canonical(name: str) -> None:
+    """Kernel modules call this once their chain is KAT-certified against
+    the real network's test vectors — unlocks coin aliases + auto-switch."""
+    spec = _REGISTRY[name.lower()]
+    if not spec.canonical:
+        register(dataclasses.replace(spec, canonical=True))
+
+
+def switchable(name: str) -> bool:
+    """May the profit switcher move live mining onto this algorithm?
+    Requires both an implementation AND canonical (network-valid) status."""
+    _load_kernels()
+    try:
+        spec = _REGISTRY[name.lower()]
+    except KeyError:
+        return False
+    return spec.implemented() and spec.canonical

@@ -1,0 +1,166 @@
+"""Network difficulty retargeting.
+
+Reference parity: internal/mining/difficulty_manager_unified.go:18-47
+(UnifiedDifficultyManager), :423-493 (retarget), :541+ (emergency monitor),
+:80-85 (pluggable DifficultyAlgorithm interface). Share-level vardiff lives
+in engine/vardiff.py; this module computes *network* difficulty — the next
+block target from recent block timestamps — with exact integer target math
+(the reference does float big.Float math; we stay in 256-bit ints).
+
+Algorithms: Bitcoin-style epoch retarget (2016 blocks, clamp 4x) and LWMA
+(linearly-weighted moving average, the scheme small chains use), plus an
+emergency monitor that loosens the target when block production stalls.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Protocol, Sequence
+
+from otedama_tpu.kernels import target as tgt
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockStamp:
+    height: int
+    timestamp: float
+    nbits: int
+
+
+class DifficultyAlgorithm(Protocol):
+    """Reference parity: difficulty_manager_unified.go:80-85."""
+
+    name: str
+
+    def next_target(self, history: Sequence[BlockStamp]) -> int | None:
+        """New network target, or None to keep the current one."""
+
+
+class EpochRetarget:
+    """Bitcoin-style: every ``interval`` blocks, scale the target by
+    actual/expected elapsed time, clamped to [1/4, 4]."""
+
+    name = "epoch"
+
+    def __init__(self, interval: int = 2016, block_time: float = 600.0):
+        self.interval = interval
+        self.block_time = block_time
+
+    def next_target(self, history: Sequence[BlockStamp]) -> int | None:
+        if len(history) < 2:
+            return None
+        tip = history[-1]
+        if (tip.height + 1) % self.interval != 0:
+            return None
+        window = [b for b in history if b.height > tip.height - self.interval]
+        if len(window) < 2:
+            return None
+        actual = max(1.0, window[-1].timestamp - window[0].timestamp)
+        expected = self.block_time * (len(window) - 1)
+        ratio = min(4.0, max(0.25, actual / expected))
+        current = tgt.bits_to_target(tip.nbits)
+        # integer-scaled multiply keeps the high limbs exact
+        scaled = (current * int(ratio * (1 << 32))) >> 32
+        return min(tgt.MAX_TARGET, max(1, scaled))
+
+
+class LWMARetarget:
+    """Linearly-weighted moving average over the last N solve times —
+    responds per-block instead of per-epoch."""
+
+    name = "lwma"
+
+    def __init__(self, window: int = 60, block_time: float = 600.0):
+        self.window = window
+        self.block_time = block_time
+
+    def next_target(self, history: Sequence[BlockStamp]) -> int | None:
+        if len(history) < 3:
+            return None
+        window = list(history)[-(self.window + 1):]
+        n = len(window) - 1
+        weighted = 0.0
+        weight_sum = 0
+        for i in range(1, n + 1):
+            solve = window[i].timestamp - window[i - 1].timestamp
+            solve = min(max(solve, -6 * self.block_time), 6 * self.block_time)
+            weighted += i * solve
+            weight_sum += i
+        avg_weighted = weighted / weight_sum if weight_sum else self.block_time
+        avg_weighted = max(avg_weighted, self.block_time / 100.0)
+        current = tgt.bits_to_target(window[-1].nbits)
+        scaled = (current * int((avg_weighted / self.block_time) * (1 << 32))) >> 32
+        return min(tgt.MAX_TARGET, max(1, scaled))
+
+
+@dataclasses.dataclass
+class DifficultyConfig:
+    algorithm: str = "epoch"
+    block_time: float = 600.0
+    epoch_interval: int = 2016
+    lwma_window: int = 60
+    # emergency: if no block for this many block-times, ease the target
+    emergency_multiplier: float = 6.0
+    emergency_ease_factor: float = 2.0
+
+
+class NetworkDifficultyManager:
+    """Tracks block history and produces nbits for new block templates."""
+
+    def __init__(self, initial_nbits: int, config: DifficultyConfig | None = None):
+        self.config = config or DifficultyConfig()
+        self.current_nbits = initial_nbits
+        self.history: list[BlockStamp] = []
+        self.retargets = 0
+        self.emergency_adjustments = 0
+        algos: dict[str, DifficultyAlgorithm] = {
+            "epoch": EpochRetarget(self.config.epoch_interval, self.config.block_time),
+            "lwma": LWMARetarget(self.config.lwma_window, self.config.block_time),
+        }
+        if self.config.algorithm not in algos:
+            raise ValueError(f"unknown difficulty algorithm {self.config.algorithm!r}")
+        self.algorithm = algos[self.config.algorithm]
+
+    @property
+    def current_target(self) -> int:
+        return tgt.bits_to_target(self.current_nbits)
+
+    @property
+    def current_difficulty(self) -> float:
+        return tgt.target_to_difficulty(self.current_target)
+
+    def record_block(self, height: int, timestamp: float | None = None) -> None:
+        self.history.append(
+            BlockStamp(height, timestamp or time.time(), self.current_nbits)
+        )
+        if len(self.history) > 4 * max(2016, self.config.lwma_window):
+            del self.history[: len(self.history) // 2]
+        new_target = self.algorithm.next_target(self.history)
+        if new_target is not None:
+            self.current_nbits = tgt.target_to_bits(new_target)
+            self.retargets += 1
+
+    def check_emergency(self, now: float | None = None) -> bool:
+        """Ease the target when block production has stalled (reference:
+        difficulty_manager_unified.go emergency monitor :541+)."""
+        if not self.history:
+            return False
+        now = now if now is not None else time.time()
+        stall = now - self.history[-1].timestamp
+        if stall < self.config.emergency_multiplier * self.config.block_time:
+            return False
+        eased = int(self.current_target * self.config.emergency_ease_factor)
+        self.current_nbits = tgt.target_to_bits(min(tgt.MAX_TARGET, eased))
+        self.emergency_adjustments += 1
+        return True
+
+    def snapshot(self) -> dict:
+        return {
+            "algorithm": self.algorithm.name,
+            "nbits": f"{self.current_nbits:08x}",
+            "difficulty": self.current_difficulty,
+            "blocks_tracked": len(self.history),
+            "retargets": self.retargets,
+            "emergency_adjustments": self.emergency_adjustments,
+        }

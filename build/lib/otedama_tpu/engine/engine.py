@@ -1,0 +1,301 @@
+"""The mining engine: async orchestration of device search.
+
+Reference parity: internal/mining/engine.go — job channel -> workers ->
+share channel -> submit (goroutines jobProcessor/shareProcessor/statsUpdater,
+engine.go:319-341). TPU-native redesign: goroutine-per-worker becomes one
+async searcher per device *backend* (a backend may itself be a whole pod via
+``runtime.mesh.PodSearch``), because device parallelism lives inside the
+compiled XLA program, not in host threads. The host loop's only jobs are to
+keep the device fed, roll extranonce spaces, and pump found shares to the
+submit callback.
+
+Flow per device task:
+  current job -> (extranonce2, ntime) -> JobConstants (host midstate) ->
+  backend.search(batch) in a worker thread -> winners -> Share -> on_share
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import time
+from typing import Awaitable, Callable, Protocol
+
+from otedama_tpu.engine import algos
+from otedama_tpu.engine.jobs import job_constants
+from otedama_tpu.engine.types import (
+    DeviceStats,
+    EngineState,
+    EngineStats,
+    Job,
+    Share,
+)
+from otedama_tpu.kernels import target as tgt
+from otedama_tpu.runtime.partition import ExtranonceCounter, NonceRange
+from otedama_tpu.runtime.search import JobConstants, SearchResult
+
+log = logging.getLogger("otedama.engine")
+
+ShareCallback = Callable[[Share], Awaitable[None]]
+
+
+class SearchBackendProtocol(Protocol):
+    name: str
+
+    def search(self, jc: JobConstants, base: int, count: int) -> SearchResult: ...
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    worker_name: str = "otedama-tpu"
+    algorithm: str = "sha256d"
+    batch_size: int = 1 << 22
+    # adopt a backend's preferred_batch when it exceeds batch_size: the
+    # Pallas kernel takes 2^30 nonces in ONE launch, and driving it with
+    # small batches leaves >90% of the chip idle on dispatch latency
+    auto_batch: bool = True
+    # in-flight device launches per backend: 3 = enqueue batches N+1, N+2
+    # while batch N computes, hiding host dispatch + result-transfer
+    # latency (the device serializes the compute; the overlap is
+    # host<->device). Deeper also covers the result-fetch + share-emit
+    # gap between drains on the tunneled platform.
+    pipeline_depth: int = 3
+    extranonce2_size: int = 4
+    # stop searching a job after this age even without a replacement
+    job_max_age: float = 120.0
+
+
+class MiningEngine:
+    """Owns device backends and turns jobs into shares."""
+
+    def __init__(
+        self,
+        backends: dict[str, SearchBackendProtocol],
+        on_share: ShareCallback | None = None,
+        config: EngineConfig | None = None,
+    ):
+        if not backends:
+            raise ValueError("need at least one search backend")
+        self.backends = backends
+        self.on_share = on_share
+        self.config = config or EngineConfig()
+        algos.get(self.config.algorithm)  # validate early
+        self.state = EngineState.IDLE
+        self.stats = EngineStats(algorithm=self.config.algorithm)
+        for name in backends:
+            self.stats.devices[name] = DeviceStats()
+        self._job: Job | None = None
+        self._job_event = asyncio.Event()
+        self._job_serial = 0
+        self._tasks: list[asyncio.Task] = []
+        self._stop = asyncio.Event()
+        self._seen_shares: set[tuple[str, bytes, int, int]] = set()
+
+    # -- job intake ---------------------------------------------------------
+
+    def set_job(self, job: Job) -> None:
+        """Replace the current job. Clean jobs invalidate in-flight work
+        (the searcher rechecks the serial between batches)."""
+        self._job = job
+        self._job_serial += 1
+        self.stats.current_job_id = job.job_id
+        self._seen_shares.clear()
+        self._job_event.set()
+        log.debug("job %s set (clean=%s)", job.job_id, job.clean)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def start(self) -> None:
+        if self.state == EngineState.RUNNING:
+            return
+        self.state = EngineState.STARTING
+        self._stop.clear()
+        loop = asyncio.get_running_loop()
+        # extranonce2 block layout across heterogeneous backends: device i
+        # owns [sum(fanouts[:i]), ...+fanout_i) and strides by the total, so
+        # a pod (fanout=n_hosts) and a single-chip backend never overlap
+        fanouts = [getattr(b, "en2_fanout", 1) for b in self.backends.values()]
+        total_fanout = sum(fanouts)
+        offset = 0
+        for i, (name, backend) in enumerate(self.backends.items()):
+            self._tasks.append(
+                loop.create_task(
+                    self._search_loop(name, backend, offset, total_fanout)
+                )
+            )
+            offset += fanouts[i]
+        self.state = EngineState.RUNNING
+        log.info("engine started with backends: %s", list(self.backends))
+
+    async def stop(self) -> None:
+        self.state = EngineState.STOPPING
+        self._stop.set()
+        self._job_event.set()
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks.clear()
+        self.state = EngineState.STOPPED
+        log.info("engine stopped")
+
+    # -- the hot host loop --------------------------------------------------
+
+    async def _search_loop(
+        self, name: str, backend, en2_offset: int, en2_total: int
+    ) -> None:
+        loop = asyncio.get_running_loop()
+        dstats = self.stats.devices.setdefault(name, DeviceStats())
+        while not self._stop.is_set():
+            job = self._job
+            if job is None or job.is_expired(self.config.job_max_age):
+                self._job_event.clear()
+                try:
+                    await asyncio.wait_for(self._job_event.wait(), timeout=1.0)
+                except asyncio.TimeoutError:
+                    pass
+                continue
+
+            serial = self._job_serial
+            # a backend may consume several extranonce2 spaces per call (a
+            # pod's host rows — runtime.mesh.PodBackend.en2_fanout); devices
+            # own disjoint blocks laid out by the engine at start()
+            fanout = getattr(backend, "en2_fanout", 1)
+            batch_size = self.config.batch_size
+            if self.config.auto_batch:
+                batch_size = max(
+                    batch_size, getattr(backend, "preferred_batch", 0)
+                )
+            depth = max(1, self.config.pipeline_depth)
+            extranonce = ExtranonceCounter(size=job.extranonce2_size or self.config.extranonce2_size)
+            extranonce.value = en2_offset
+
+            # pipelined dispatch: keep up to `depth` searches in flight so
+            # the host's dispatch/transfer latency hides under device
+            # compute; in-flight work is always drained (winners from an
+            # already-running launch are still valid shares for its job)
+            pending: list[tuple[list[bytes], asyncio.Future]] = []
+
+            # grouped dispatch: backends that support it run `depth`
+            # launches per executor call with all dispatches issued before
+            # the first sync — thread-level overlap alone cannot hide the
+            # per-launch sync on tunneled platforms (a blocking transfer
+            # starves the next dispatch)
+            grouped = fanout == 1 and hasattr(backend, "search_group")
+
+            while not self._stop.is_set() and serial == self._job_serial:
+                en2s = [extranonce.current()]
+                for _ in range(fanout - 1):
+                    en2s.append(extranonce.roll())
+                jcs = [
+                    await loop.run_in_executor(None, job_constants, job, en2)
+                    for en2 in en2s
+                ]
+                space = NonceRange(0, 1 << 32)
+                t_last = time.monotonic()
+                all_batches = list(space.batches(batch_size))
+                if grouped:
+                    work_units = [
+                        all_batches[i : i + depth]
+                        for i in range(0, len(all_batches), depth)
+                    ]
+                else:
+                    work_units = [[b] for b in all_batches]
+                for unit in work_units:
+                    if self._stop.is_set() or serial != self._job_serial:
+                        break
+                    if grouped:
+                        fut = loop.run_in_executor(
+                            None, backend.search_group, jcs[0], unit
+                        )
+                    elif fanout > 1:
+                        base, count = unit[0]
+                        fut = loop.run_in_executor(
+                            None, backend.search_multi, jcs, base, count
+                        )
+                    else:
+                        base, count = unit[0]
+                        fut = loop.run_in_executor(
+                            None, backend.search, jcs[0], base, count
+                        )
+                    pending.append((en2s, fut))
+                    # grouped backends already overlap inside one call, so
+                    # two groups in flight suffice; depth=1 disables overlap
+                    pend_cap = min(2, depth) if grouped else depth
+                    if len(pending) >= pend_cap:
+                        p_en2s, p_fut = pending.pop(0)
+                        t_last = await self._consume(
+                            job, p_en2s, await p_fut, dstats, t_last
+                        )
+                else:
+                    # nonce spaces exhausted: stride to this device's next
+                    # extranonce2 block (counter sits at block start + f-1)
+                    for _ in range(en2_total - fanout + 1):
+                        extranonce.roll()
+                    continue
+                break  # job changed or stopping
+            # drain whatever is still in flight for this job
+            for p_en2s, p_fut in pending:
+                try:
+                    results = await p_fut
+                except Exception:
+                    log.exception("in-flight search failed during drain")
+                    continue
+                await self._consume(job, p_en2s, results, dstats, None)
+
+    async def _consume(
+        self, job: Job, en2s: list[bytes], results, dstats, t_last: float | None
+    ) -> float:
+        """Account one drained search future and emit its shares.
+
+        ``results`` is one SearchResult (plain), a list of per-en2 results
+        (fanout backends), or a list of same-en2 slices (grouped backends —
+        distinguished by a single-entry ``en2s``). Returns the new t_last.
+        """
+        if not isinstance(results, list):
+            results = [results]
+        now = time.monotonic()
+        hashes = sum(r.hashes for r in results)
+        dstats.record_batch(hashes, 0.0 if t_last is None else now - t_last)
+        self.stats.hashes += hashes
+        if len(en2s) == 1:
+            # grouped: every result is a slice of the SAME extranonce space
+            for result in results:
+                await self._emit_shares(job, en2s[0], result)
+        else:
+            for en2, result in zip(en2s, results):
+                await self._emit_shares(job, en2, result)
+        return now
+
+    async def _emit_shares(self, job: Job, en2: bytes, result: SearchResult) -> None:
+        for w in result.winners:
+            key = (job.job_id, en2, job.ntime, w.nonce_word)
+            if key in self._seen_shares:
+                continue
+            self._seen_shares.add(key)
+            diff = tgt.difficulty_of_digest(w.digest)
+            share = Share(
+                job_id=job.job_id,
+                worker=self.config.worker_name,
+                extranonce2=en2,
+                ntime=job.ntime,
+                nonce_word=w.nonce_word,
+                digest=w.digest,
+                difficulty=diff,
+                algorithm=job.algorithm,
+            )
+            self.stats.shares_found += 1
+            self.stats.best_difficulty = max(self.stats.best_difficulty, diff)
+            network_target = tgt.bits_to_target(job.nbits)
+            if tgt.hash_meets_target(w.digest, network_target):
+                self.stats.blocks_found += 1
+                log.info("BLOCK candidate found: job=%s nonce=%s", job.job_id, w.nonce_hex)
+            if self.on_share is not None:
+                await self.on_share(share)
+
+    # -- reporting ----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        snap = self.stats.snapshot()
+        snap["state"] = self.state.value
+        return snap

@@ -1,0 +1,84 @@
+"""Header assembly: coinbase construction, merkle root, 80-byte header.
+
+Reference parity: internal/mining/unified_miner.go:441-489
+``convertStratumJob`` (coinbase = coinb1 || extranonce1 || extranonce2 ||
+coinb2, merkle root folded from the branch, 80-byte header assembly) and the
+stratum hex conventions of internal/stratum/unified_stratum.go:433-477.
+
+Wire conventions implemented (bitcoin/stratum V1 standards):
+- ``prevhash`` arrives as 64 hex chars in *word-swapped* order: every 4-byte
+  word is byte-reversed relative to the header layout (the classic stratum
+  quirk); ``decode_prevhash`` undoes it.
+- version / nbits / ntime arrive as big-endian hex values; the header stores
+  them little-endian.
+- merkle branch nodes arrive as plain hex (already in header byte order).
+- the header's merkle root field is the sha256d fold result as-is (internal
+  byte order).
+"""
+
+from __future__ import annotations
+
+import struct
+
+from otedama_tpu.engine.types import Job
+from otedama_tpu.runtime.search import JobConstants
+from otedama_tpu.utils.sha256_host import sha256d
+
+
+def decode_prevhash(hex_str: str) -> bytes:
+    """Stratum prevhash hex -> header byte order (undo per-word reversal)."""
+    raw = bytes.fromhex(hex_str)
+    if len(raw) != 32:
+        raise ValueError("prevhash must be 32 bytes")
+    return b"".join(raw[i : i + 4][::-1] for i in range(0, 32, 4))
+
+
+def encode_prevhash(header_order: bytes) -> str:
+    """Header byte order -> stratum prevhash hex (apply per-word reversal)."""
+    if len(header_order) != 32:
+        raise ValueError("prevhash must be 32 bytes")
+    return b"".join(
+        header_order[i : i + 4][::-1] for i in range(0, 32, 4)
+    ).hex()
+
+
+def build_coinbase(job: Job, extranonce2: bytes) -> bytes:
+    if len(extranonce2) != job.extranonce2_size:
+        raise ValueError(
+            f"extranonce2 must be {job.extranonce2_size} bytes, got {len(extranonce2)}"
+        )
+    return job.coinb1 + job.extranonce1 + extranonce2 + job.coinb2
+
+
+def merkle_root(coinbase: bytes, branch: list[bytes]) -> bytes:
+    """Fold the coinbase txid up the merkle branch (header byte order)."""
+    acc = sha256d(coinbase)
+    for node in branch:
+        acc = sha256d(acc + node)
+    return acc
+
+
+def build_header_prefix(job: Job, extranonce2: bytes, ntime: int | None = None) -> bytes:
+    """First 76 bytes of the block header for this (job, extranonce2)."""
+    root = merkle_root(build_coinbase(job, extranonce2), job.merkle_branch)
+    return (
+        struct.pack("<I", job.version)
+        + job.prev_hash
+        + root
+        + struct.pack("<I", ntime if ntime is not None else job.ntime)
+        + struct.pack("<I", job.nbits)
+    )
+
+
+def job_constants(job: Job, extranonce2: bytes, ntime: int | None = None) -> JobConstants:
+    """Device constants (midstate/tail/target limbs) for one search space."""
+    return JobConstants.from_header_prefix(
+        build_header_prefix(job, extranonce2, ntime), job.share_target
+    )
+
+
+def header_from_share(job: Job, extranonce2: bytes, ntime: int, nonce_word: int) -> bytes:
+    """Reconstruct the full 80-byte header a share claims to have hashed —
+    the validation path (pool side) re-derives everything from job data."""
+    prefix = build_header_prefix(job, extranonce2, ntime)
+    return prefix + struct.pack(">I", nonce_word)

@@ -1,0 +1,12 @@
+"""Device hash kernels (JAX/XLA + Pallas).
+
+Each algorithm ships two interchangeable implementations behind one ABI:
+
+- a vectorized pure-``jnp`` implementation (runs anywhere, is the
+  correctness reference, and is already fast under XLA fusion), and
+- a hand-tiled Pallas TPU kernel for the hot path.
+
+Kernel ABI (all algorithms): the host assembles per-job constants (midstate
+/ tail words / target limbs), the device maps a ``[B]``-lane nonce block to
+winner nonces + telemetry, never round-tripping full digests to the host.
+"""

@@ -1,0 +1,199 @@
+"""Vectorized scrypt (N=1024, r=1, p=1 — the Litecoin PoW parameters) in JAX.
+
+The reference implements scrypt on the host via ``golang.org/x/crypto/scrypt``
+(reference: internal/mining/multi_algorithm.go:100-140, ``ScryptEngine`` with
+N=1024,r=1,p=1) and never ships a device kernel for it. This module is the
+TPU-native realization: every lane of a ``[B]`` nonce block runs the full
+scrypt pipeline in parallel —
+
+  PBKDF2-HMAC-SHA256(P=header, S=header, c=1, dkLen=128)
+  -> ROMix (1024-step Salsa20/8 BlockMix write pass + gather pass)
+  -> PBKDF2-HMAC-SHA256(P=header, S=B', c=1, dkLen=32)
+
+SHA-256 compressions reuse ``sha256_jax.compress``; the ROMix V array lives in
+HBM as a ``[1024, B, 32]`` uint32 tensor (128 KiB per lane — SURVEY.md §5's
+"long-context analogue": state that doesn't fit in fast memory, streamed via
+XLA's dynamic-slice/gather machinery). The second ROMix pass is the
+memory-hard part: its per-lane data-dependent gather ``V[j(lane), lane, :]``
+is exactly the access pattern scrypt was designed to make bandwidth-bound.
+
+Word conventions: SHA-256 math is big-endian-word; Salsa20/8 math is
+little-endian-word. Buffers cross that boundary via ``bswap32`` exactly where
+the byte strings would be re-interpreted in a scalar implementation, so the
+result is bit-identical to ``hashlib.scrypt``.
+"""
+
+from __future__ import annotations
+
+import functools
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from otedama_tpu.kernels import sha256_jax as sj
+from otedama_tpu.utils.sha256_host import SHA256_IV
+
+_U32 = jnp.uint32
+
+SCRYPT_N = 1024
+SCRYPT_R = 1
+SCRYPT_P = 1
+
+
+def _rotl(x, n: int):
+    return (x << n) | (x >> (32 - n))
+
+
+def salsa20_8(x):
+    """Salsa20/8 core over 16 uint32 arrays (LE-word values). Returns 16."""
+    z = list(x)
+
+    def qr(a, b, c, n):
+        z[a] = z[a] ^ _rotl(z[b] + z[c], n)
+
+    for _ in range(4):  # 8 rounds = 4 double-rounds
+        qr(4, 0, 12, 7); qr(8, 4, 0, 9); qr(12, 8, 4, 13); qr(0, 12, 8, 18)
+        qr(9, 5, 1, 7); qr(13, 9, 5, 9); qr(1, 13, 9, 13); qr(5, 1, 13, 18)
+        qr(14, 10, 6, 7); qr(2, 14, 10, 9); qr(6, 2, 14, 13); qr(10, 6, 2, 18)
+        qr(3, 15, 11, 7); qr(7, 3, 15, 9); qr(11, 7, 3, 13); qr(15, 11, 7, 18)
+        qr(1, 0, 3, 7); qr(2, 1, 0, 9); qr(3, 2, 1, 13); qr(0, 3, 2, 18)
+        qr(6, 5, 4, 7); qr(7, 6, 5, 9); qr(4, 7, 6, 13); qr(5, 4, 7, 18)
+        qr(11, 10, 9, 7); qr(8, 11, 10, 9); qr(9, 8, 11, 13); qr(10, 9, 8, 18)
+        qr(12, 15, 14, 7); qr(13, 12, 15, 9); qr(14, 13, 12, 13); qr(15, 14, 13, 18)
+    return [z[i] + x[i] for i in range(16)]
+
+
+def blockmix_salsa8_r1(X):
+    """BlockMix for r=1 on ``[..., 32]`` LE words: two salsa'd 16-word halves."""
+    B0 = [X[..., i] for i in range(16)]
+    B1 = [X[..., 16 + i] for i in range(16)]
+    Y0 = salsa20_8([a ^ b for a, b in zip(B1, B0)])
+    Y1 = salsa20_8([a ^ b for a, b in zip(Y0, B1)])
+    return jnp.stack(Y0 + Y1, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# HMAC-SHA256 / PBKDF2 pieces, specialized to the mining message shapes.
+# All "words" below are big-endian word values of the underlying byte strings.
+# ---------------------------------------------------------------------------
+
+def _hmac_states(key8, comp):
+    """(inner, outer) chaining states for an HMAC whose key is 8 words
+    (= SHA256 of the >64-byte password), zero-padded to the 64-byte block."""
+    zero = jnp.zeros_like(key8[0])
+    ipad = [k ^ _U32(0x36363636) for k in key8] + [zero + _U32(0x36363636)] * 8
+    opad = [k ^ _U32(0x5C5C5C5C) for k in key8] + [zero + _U32(0x5C5C5C5C)] * 8
+    iv = tuple(zero + _U32(v) for v in SHA256_IV)
+    return comp(iv, ipad), comp(iv, opad)
+
+
+def _hmac_finish(ostate, digest8, comp):
+    """Outer compression: 32-byte inner digest + padding (96-byte message)."""
+    zero = jnp.zeros_like(digest8[0])
+    w = list(digest8) + [zero + _U32(0x80000000)] + [zero] * 6 + [zero + _U32(768)]
+    return comp(ostate, w)
+
+
+def scrypt_1024_1_1(header_words, nonces, *, rolled: bool = True):
+    """scrypt(header, header, N=1024, r=1, p=1, dkLen=32) across nonce lanes.
+
+    ``header_words``: 19 uint32 scalars — big-endian words of header[0:76].
+    ``nonces``: uint32 ``[B]`` — header word 19 (big-endian read of bytes
+    76:80, same convention as the sha256d kernels).
+
+    Returns 8 uint32 ``[B]`` big-endian digest words of the 32-byte output.
+    """
+    comp = sj.compress_rolled if rolled else sj.compress
+    zero = jnp.zeros_like(nonces)
+    hw = [zero + _U32(w) for w in header_words] + [nonces]  # 20 words
+
+    # key0 = SHA256(header80): block1 = words 0..15, block2 = tail + padding
+    iv = tuple(zero + _U32(v) for v in SHA256_IV)
+    st = comp(iv, hw[:16])
+    pad_tail = hw[16:20] + [zero + _U32(0x80000000)] + [zero] * 10 + [zero + _U32(640)]
+    key0 = comp(st, pad_tail)
+
+    istate, ostate = _hmac_states(key0, comp)
+
+    # PBKDF2 pass 1: B = T1..T4 (dkLen = p*128*r = 128 bytes).
+    # inner msg = header80 || INT(i); first 64 bytes of header are one block.
+    imid = comp(istate, hw[:16])
+    T = []
+    for i in range(1, 5):
+        blk = (
+            hw[16:20]
+            + [zero + _U32(i), zero + _U32(0x80000000)]
+            + [zero] * 9
+            + [zero + _U32(1184)]  # (64+80+4)*8
+        )
+        inner = comp(imid, blk)
+        T.extend(_hmac_finish(ostate, inner, comp))
+
+    # ROMix operates on LE words.
+    X = jnp.stack([sj.bswap32(w) for w in T], axis=-1)  # [B, 32]
+
+    def fill_step(X, _):
+        return blockmix_salsa8_r1(X), X
+
+    X, V = jax.lax.scan(fill_step, X, None, length=SCRYPT_N)  # V: [N, B, 32]
+
+    def mix_step(i, X):
+        j = X[..., 16] & _U32(SCRYPT_N - 1)  # Integerify: first LE word of B1
+        Vj = jnp.take_along_axis(
+            V, j[None, :, None].astype(jnp.int32), axis=0
+        )[0]
+        return blockmix_salsa8_r1(X ^ Vj)
+
+    X = jax.lax.fori_loop(0, SCRYPT_N, mix_step, X)
+
+    # PBKDF2 pass 2: output = HMAC(P, X_bytes || INT(1)) first 32 bytes.
+    bw = [sj.bswap32(X[..., i]) for i in range(32)]  # back to BE words
+    inner = comp(istate, bw[:16])
+    inner = comp(inner, bw[16:32])
+    blk = (
+        [zero + _U32(1), zero + _U32(0x80000000)]
+        + [zero] * 13
+        + [zero + _U32(1568)]  # (64+128+4)*8
+    )
+    inner = comp(inner, blk)
+    return _hmac_finish(ostate, inner, comp)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "rolled"))
+def scrypt_search_step(header19, base, limbs8, *, n: int, rolled: bool = True):
+    """Jittable scrypt nonce-search step.
+
+    ``header19``: uint32[19] array; ``base``: uint32 scalar; ``limbs8``:
+    uint32[8] target limbs most-significant-first. Returns ``(hits, h0)``.
+    """
+    nonces = base + jax.lax.iota(jnp.uint32, n)
+    d = scrypt_1024_1_1(
+        tuple(header19[i] for i in range(19)), nonces, rolled=rolled
+    )
+    h = sj.digest_words_to_compare_order(d)
+    hits = sj.le256(h, tuple(limbs8[i] for i in range(8)))
+    return hits, h[0]
+
+
+def scrypt_digest_host(header80: bytes) -> bytes:
+    """Scalar oracle via hashlib (OpenSSL scrypt) — the same host path the
+    validation side uses (utils.pow_host), so miner and pool can't diverge."""
+    from otedama_tpu.utils.pow_host import scrypt_1024_1_1
+
+    return scrypt_1024_1_1(header80)
+
+
+def header_words19(header76: bytes) -> tuple[int, ...]:
+    if len(header76) != 76:
+        raise ValueError(f"need 76 header bytes, got {len(header76)}")
+    return struct.unpack(">19I", header76)
+
+
+# registry: this module loading successfully means scrypt runs on xla (and
+# therefore on TPU through XLA; a hand-tiled Pallas variant can add itself
+# under a distinct backend name later).
+from otedama_tpu.engine import algos as _algos  # noqa: E402
+
+_algos.mark_implemented("scrypt", "xla")

@@ -1,0 +1,244 @@
+"""Vectorized SHA-256 / sha256d in JAX (uint32 lane math).
+
+This is the TPU-native realization of what the reference only ships as
+inert CUDA text (reference: internal/gpu/cuda_miner.go:141-192
+``sha256_mining_kernel``, :194-265 ``sha256_midstate_kernel``): every lane of
+a ``[B]``-shaped uint32 nonce block is hashed in parallel on the VPU. SHA-256's
+64-round dependency chain is sequential, so all throughput comes from the lane
+axis — the rounds are fully unrolled at trace time and XLA keeps the 24-ish
+live uint32 arrays in vector registers / VMEM.
+
+The functions here are shape-polymorphic: they run as plain jitted XLA (the
+correctness reference and a strong baseline) and are also called from inside
+the Pallas kernel bodies in ``sha256_pallas.py`` on (sublane, lane)-shaped
+tiles.
+
+Wire conventions (bitcoin family):
+- the 80-byte header is hashed as two 64-byte blocks; block 1 is constant per
+  job => host computes its midstate (``utils.sha256_host.midstate``);
+- the device hashes block 2 (merkle tail, ntime, nbits, nonce + padding),
+  then re-hashes the 32-byte digest (second sha256, one block);
+- the final digest is *byte-reversed* before comparison against the target
+  (hash-as-little-endian-int convention), which in word terms means comparing
+  ``bswap32(d[7]), bswap32(d[6]), ...`` most-significant-first.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from otedama_tpu.utils.sha256_host import SHA256_IV, SHA256_K
+
+_K_NP = np.array(SHA256_K, dtype=np.uint32)
+_IV_NP = np.array(SHA256_IV, dtype=np.uint32)
+
+_U32 = jnp.uint32
+
+
+def _rotr(x, n: int):
+    return (x >> n) | (x << (32 - n))
+
+
+def _small_sigma0(x):
+    return _rotr(x, 7) ^ _rotr(x, 18) ^ (x >> 3)
+
+
+def _small_sigma1(x):
+    return _rotr(x, 17) ^ _rotr(x, 19) ^ (x >> 10)
+
+
+def _big_sigma0(x):
+    return _rotr(x, 2) ^ _rotr(x, 13) ^ _rotr(x, 22)
+
+
+def _big_sigma1(x):
+    return _rotr(x, 6) ^ _rotr(x, 11) ^ _rotr(x, 25)
+
+
+def _ch(e, f, g):
+    # (e & f) ^ (~e & g)  ==  g ^ (e & (f ^ g))  — one op fewer
+    return g ^ (e & (f ^ g))
+
+
+def _maj(a, b, c):
+    # (a & b) ^ (a & c) ^ (b & c)  ==  (a & (b | c)) | (b & c)
+    return (a & (b | c)) | (b & c)
+
+
+def compress(state, w):
+    """One SHA-256 compression, fully unrolled.
+
+    ``state``: sequence of 8 uint32 arrays (broadcastable shapes).
+    ``w``: sequence of 16 uint32 arrays (message words w[0..15]).
+    Returns a tuple of 8 uint32 arrays.
+
+    The message schedule is expanded in-place over a 16-entry ring so only 16
+    schedule words are live at any round (mirrors the register budget a
+    hand-written kernel would use).
+    """
+    w = list(w)
+    a, b, c, d, e, f, g, h = state
+    for i in range(64):
+        if i >= 16:
+            j = i % 16
+            w[j] = (
+                w[j]
+                + _small_sigma0(w[(i - 15) % 16])
+                + w[(i - 7) % 16]
+                + _small_sigma1(w[(i - 2) % 16])
+            )
+        t1 = h + _big_sigma1(e) + _ch(e, f, g) + _U32(_K_NP[i]) + w[i % 16]
+        t2 = _big_sigma0(a) + _maj(a, b, c)
+        h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + t2
+    s = (a, b, c, d, e, f, g, h)
+    return tuple(x + y for x, y in zip(state, s))
+
+
+def compress_rolled(state, w):
+    """One SHA-256 compression as a ``lax.fori_loop`` — O(1) graph size.
+
+    Semantically identical to ``compress``; compiles in milliseconds where
+    the unrolled form costs XLA a 64x larger graph. The TPU hot path wants
+    ``compress`` (register allocation over the unrolled rounds); CPU-mesh
+    tests, dryruns and one-off hashing want this one.
+    """
+    W = jnp.stack([jnp.asarray(x, dtype=jnp.uint32) for x in w])  # (16, ...)
+    K = jnp.asarray(_K_NP)
+
+    def round_fn(i, carry):
+        a, b, c, d, e, f, g, h, W = carry
+        j = i % 16
+
+        def scheduled(W):
+            wj = (
+                W[j]
+                + _small_sigma0(W[(i - 15) % 16])
+                + W[(i - 7) % 16]
+                + _small_sigma1(W[(i - 2) % 16])
+            )
+            return W.at[j].set(wj), wj
+
+        W, wi = jax.lax.cond(
+            i < 16, lambda W: (W, W[j]), scheduled, W
+        )
+        t1 = h + _big_sigma1(e) + _ch(e, f, g) + K[i] + wi
+        t2 = _big_sigma0(a) + _maj(a, b, c)
+        return (t1 + t2, a, b, c, d + t1, e, f, g, W)
+
+    init = tuple(jnp.asarray(s, dtype=jnp.uint32) for s in state) + (W,)
+    out = jax.lax.fori_loop(0, 64, round_fn, init)
+    return tuple(x + y for x, y in zip(state, out[:8]))
+
+
+def bswap32(x):
+    """Byte-swap each uint32 lane."""
+    return (
+        ((x >> 24) & _U32(0xFF))
+        | ((x >> 8) & _U32(0xFF00))
+        | ((x << 8) & _U32(0xFF0000))
+        | (x << 24)
+    )
+
+
+def sha256d_from_midstate(midstate, tail, nonces, *, rolled: bool = False):
+    """double-SHA256 of an 80-byte header across a lane axis of nonces.
+
+    ``midstate``: 8 uint32 scalars/arrays — compression of header[0:64].
+    ``tail``: 3 uint32 scalars — header words 16,17,18 (merkle tail, ntime,
+    nbits), big-endian word values.
+    ``nonces``: uint32 array — header word 19, one lane per candidate.
+    ``rolled``: use the fori_loop compression (fast compile, CPU/test path).
+
+    Returns the 8 big-endian digest words ``d[0..8]`` of sha256d(header),
+    each with the shape of ``nonces``.
+    """
+    comp = compress_rolled if rolled else compress
+    zero = jnp.zeros_like(nonces)
+    pad1 = zero + _U32(0x80000000)
+    w = [
+        zero + _U32(tail[0]),
+        zero + _U32(tail[1]),
+        zero + _U32(tail[2]),
+        nonces,
+        pad1,
+        zero, zero, zero, zero, zero, zero, zero, zero, zero, zero,
+        zero + _U32(640),  # 80 bytes * 8 bits
+    ]
+    ms = tuple(zero + _U32(m) for m in midstate)
+    d = comp(ms, w)
+
+    # Second hash: one block = 32-byte digest + padding, from the IV.
+    w2 = [
+        d[0], d[1], d[2], d[3], d[4], d[5], d[6], d[7],
+        pad1,
+        zero, zero, zero, zero, zero, zero,
+        zero + _U32(256),  # 32 bytes * 8 bits
+    ]
+    iv = tuple(zero + _U32(v) for v in _IV_NP)
+    return comp(iv, w2)
+
+
+def digest_words_to_compare_order(d):
+    """Reorder/byte-swap digest words for target comparison.
+
+    Bitcoin compares the digest as a little-endian 256-bit integer; in
+    uint32-limb terms the most significant limb of that integer is
+    ``bswap32(d[7])``.
+    """
+    return tuple(bswap32(d[7 - i]) for i in range(8))
+
+
+def le256(h, t):
+    """Lexicographic ``h <= t`` over 8 most-significant-first uint32 limbs.
+
+    ``h``: tuple of 8 uint32 arrays (lanes); ``t``: tuple of 8 uint32
+    scalars. Returns a bool array shaped like the lanes.
+    """
+    t = tuple(x if isinstance(x, jax.Array) else _U32(np.uint32(x)) for x in t)
+    le = h[7] <= t[7]
+    for i in range(6, -1, -1):
+        le = (h[i] < t[i]) | ((h[i] == t[i]) & le)
+    return le
+
+
+def sha256d_search(midstate, tail, nonces, target_limbs):
+    """The jittable inner search step: hash a nonce block, flag winners.
+
+    Returns ``(hits, hash_hi)``:
+    - ``hits``: bool array, lane meets target;
+    - ``hash_hi``: uint32 array, most-significant compare limb per lane
+      (for best-share tracking / argmin without re-hashing).
+    """
+    d = sha256d_from_midstate(midstate, tail, nonces)
+    h = digest_words_to_compare_order(d)
+    t = tuple(_U32(x) for x in np.asarray(target_limbs, dtype=np.uint32))
+    return le256(h, t), h[0]
+
+
+# ---------------------------------------------------------------------------
+# Full-message SHA-256 in JAX — used by tests to validate `compress` against
+# hashlib on arbitrary messages, and by multi-round algorithms.
+# ---------------------------------------------------------------------------
+
+def _pad_message(data: bytes) -> np.ndarray:
+    bitlen = len(data) * 8
+    padded = data + b"\x80"
+    padded += b"\x00" * ((56 - len(padded)) % 64)
+    padded += bitlen.to_bytes(8, "big")
+    return np.frombuffer(padded, dtype=">u4").astype(np.uint32)
+
+
+def sha256_bytes_jax(data: bytes) -> bytes:
+    """SHA-256 of a byte string, computed with the JAX compression function.
+
+    Test/validation path (scalar lanes) — not a hot loop.
+    """
+    words = _pad_message(data)
+    state = tuple(_U32(v) for v in _IV_NP)
+    for off in range(0, len(words), 16):
+        w = [_U32(words[off + i]) for i in range(16)]
+        state = compress(state, w)
+    out = np.array([np.uint32(x) for x in state], dtype=">u4")
+    return out.tobytes()

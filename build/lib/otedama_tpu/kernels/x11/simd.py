@@ -1,0 +1,193 @@
+"""SIMD-512 (NTT/Reed-Muller-based SHA-3 candidate — x11 stage 10).
+
+Lane-axis implementation of the SIMD-512 construction:
+
+- Message expansion: the 128-byte block, zero-extended to 256 entries, is
+  lifted to Z_257 by a 256-point NTT (omega = 41, a generator of Z_257^* —
+  asserted at import), then twisted by the inner-code table 163^i
+  (163 = 41^-1; the final, length-carrying block uses a distinct table to
+  implement the round-2 tweak's domain separation) and centered to
+  [-128, 128].  Each expanded word W[k] packs the scaled points (k, k+128)
+  into 16-bit halves.
+- State: four 8-lane vectors (A, B, C, D) of uint32.  Compression XORs the
+  raw block into the state, then runs 4 rounds x 8 steps (IF x4 then MAJ x4
+  per round, rotation pairs cycling through the round's 4 constants) and a
+  4-step feed-forward keyed by the saved input chaining value.  Step:
+  A' = ROL(D + W + f(A,B,C), s) + ROL(A, r)[lane ^ p];  B' = ROL(A, r);
+  C' = B;  D' = C — with the per-step lane-XOR masks p cycling (1,6,2,3,
+  5,7,4) and step->word-group order given by the WSP table.
+
+Validation status: UNVERIFIED against the SIMD submission.  The skeleton
+above (IV constants, rotation table (3,23,17,27)/(28,19,22,7)/(29,9,15,5)/
+(4,13,10,25), NTT twist 163^i, register-file rotation) follows this
+author's best reconstruction of the reference implementation, but the exact
+W-index assignment and the final-block table could not be confirmed
+offline — an exhaustive search over the plausible layout space against the
+Dash genesis block (all other 10 stages being externally KAT-verified) did
+not locate the canonical configuration.  Consequently x11 as a whole is
+registered with ``canonical=False`` (see engine/algos.py): the chain is
+self-consistent between miner and pool inside this framework but MUST NOT
+be used against the live Dash network, and the profit switcher refuses it.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+U32 = np.uint32
+P = 257
+
+# 41 generates Z_257^* (order 256); 163 = 41^-1
+_OMEGA = 41
+assert pow(_OMEGA, 128, P) == P - 1 and pow(_OMEGA, 256, P) == 1
+_OMEGA_INV = pow(_OMEGA, P - 2, P)
+assert _OMEGA_INV == 163
+
+# published SIMD-512 IV (as recalled from the reference implementation)
+IV512 = (
+    0x0BA16B95, 0x72F999AD, 0x9FECC2AE, 0xBA3264FC,
+    0x5E894929, 0x8E9F30E5, 0x2F1DAA37, 0xF0F2C558,
+    0xAC506643, 0xA90635A5, 0xE25B878B, 0xAAB7878F,
+    0x88817F7A, 0x0A02892B, 0x559A7550, 0x598F657E,
+    0x7EEF60A1, 0x6B70E3E8, 0x9C1714D1, 0xB958E2A8,
+    0xAB02675E, 0xED1C014F, 0xCD8D65BB, 0xFDB7A257,
+    0x09254899, 0xD699C7BC, 0x9019B6DC, 0x2B9022E4,
+    0x8FA14956, 0x21BF9BD3, 0xB94D0943, 0x6FFDDC22,
+)
+
+# step -> 8-word group assignment in the expanded message
+WSP = (
+    4, 6, 0, 2, 7, 5, 3, 1,
+    15, 11, 12, 8, 9, 13, 10, 14,
+    17, 18, 23, 20, 22, 21, 16, 19,
+    30, 24, 25, 31, 27, 29, 28, 26,
+)
+
+# per-round rotation constants; step k uses (r, s) = (c[k%4], c[(k+1)%4])
+ROUND_ROTS = ((3, 23, 17, 27), (28, 19, 22, 7), (29, 9, 15, 5), (4, 13, 10, 25))
+
+# feed-forward steps: saved (A, B, C, D) as message, IF, these rotations
+FF_ROTS = ((4, 13), (13, 10), (10, 25), (25, 4))
+
+# per-step lane-permutation XOR masks
+PMASK = tuple((1, 6, 2, 3, 5, 7, 4)[i % 7] for i in range(36))
+
+
+@functools.lru_cache(maxsize=1)
+def _ntt_matrix() -> np.ndarray:
+    tab = np.array([pow(_OMEGA, k, P) for k in range(256)], dtype=np.int64)
+    return tab[np.outer(np.arange(256), np.arange(256)) % 256]
+
+
+@functools.lru_cache(maxsize=1)
+def _twist_tables() -> tuple[np.ndarray, np.ndarray]:
+    normal = np.array([pow(163, k, P) for k in range(256)], dtype=np.int64)
+    final = np.array([(2 * pow(233, k, P)) % P for k in range(256)], dtype=np.int64)
+    return normal, final
+
+
+def _rotl(x, n: int):
+    n &= 31
+    if n == 0:
+        return x
+    return (x << U32(n)) | (x >> U32(32 - n))
+
+
+def _if(a, b, c):
+    return ((b ^ c) & a) ^ c
+
+
+def _maj(a, b, c):
+    return (c & b) | ((c | b) & a)
+
+
+def _expand(block_bytes: np.ndarray, final: bool) -> np.ndarray:
+    """[B, 128] uint8 -> [B, 256] uint32 expanded message words."""
+    Bn = block_bytes.shape[0]
+    x = np.zeros((Bn, 256), dtype=np.int64)
+    x[:, :128] = block_bytes
+    y = (x @ _ntt_matrix().T) % P
+    normal, fin = _twist_tables()
+    s = (y * (fin if final else normal)) % P
+    s = np.where(s > 128, s - P, s)
+    lo = s
+    hi = np.roll(s, -128, axis=1)
+    W = (lo & 0xFFFF) | ((hi & 0xFFFF) << 16)
+    return (W & 0xFFFFFFFF).astype(np.uint32)
+
+
+def _compress(state: list, block_bytes: np.ndarray, final: bool) -> list:
+    """state: 32 lane-arrays [A0..7, B0..7, C0..7, D0..7]."""
+    W = _expand(block_bytes, final)
+    A = state[0:8]
+    Bv = state[8:16]
+    C = state[16:24]
+    D = state[24:32]
+    saved = [list(A), list(Bv), list(C), list(D)]
+    words = block_bytes.reshape(block_bytes.shape[0], 32, 4)
+    m32 = (
+        words[:, :, 0].astype(np.uint32)
+        | (words[:, :, 1].astype(np.uint32) << U32(8))
+        | (words[:, :, 2].astype(np.uint32) << U32(16))
+        | (words[:, :, 3].astype(np.uint32) << U32(24))
+    )
+    A = [A[j] ^ m32[:, j] for j in range(8)]
+    Bv = [Bv[j] ^ m32[:, 8 + j] for j in range(8)]
+    C = [C[j] ^ m32[:, 16 + j] for j in range(8)]
+    D = [D[j] ^ m32[:, 24 + j] for j in range(8)]
+
+    def step(A, Bv, C, D, w, fn, r, s, p):
+        tA = [_rotl(A[j], r) for j in range(8)]
+        newA = [
+            _rotl(D[j] + w[j] + fn(A[j], Bv[j], C[j]), s) + tA[j ^ p]
+            for j in range(8)
+        ]
+        return newA, tA, Bv, C
+
+    for st in range(32):
+        rnd, k = divmod(st, 8)
+        c = ROUND_ROTS[rnd]
+        r, s = c[k % 4], c[(k + 1) % 4]
+        fn = _if if k < 4 else _maj
+        base = WSP[st] * 8
+        w = [W[:, base + j] for j in range(8)]
+        A, Bv, C, D = step(A, Bv, C, D, w, fn, r, s, PMASK[st])
+    for fs in range(4):
+        r, s = FF_ROTS[fs]
+        A, Bv, C, D = step(A, Bv, C, D, saved[fs], _if, r, s, PMASK[32 + fs])
+    return A + Bv + C + D
+
+
+def simd512(data_bytes: np.ndarray, n_bytes: int) -> np.ndarray:
+    """SIMD-512 across lanes. ``data_bytes``: uint8 ``[B, n_bytes]``.
+    Returns ``[B, 64]`` digest bytes (A and B vectors, LE)."""
+    data_bytes = np.atleast_2d(data_bytes)
+    B = data_bytes.shape[0]
+    n_blocks = max(1, (n_bytes + 127) // 128)
+    padded = np.zeros((B, n_blocks * 128), dtype=np.uint8)
+    padded[:, :n_bytes] = data_bytes
+    state = [np.full(B, U32(v), dtype=np.uint32) for v in IV512]
+    for blk in range(n_blocks):
+        state = _compress(state, padded[:, blk * 128 : (blk + 1) * 128], final=False)
+    length_block = np.zeros((B, 128), dtype=np.uint8)
+    length_block[:, :8] = np.frombuffer(
+        (n_bytes * 8).to_bytes(8, "little"), dtype=np.uint8
+    )
+    state = _compress(state, length_block, final=True)
+    out = np.empty((B, 64), dtype=np.uint8)
+    for i in range(16):
+        w = state[i]
+        for b in range(4):
+            out[:, 4 * i + b] = ((w >> U32(8 * b)) & U32(0xFF)).astype(np.uint8)
+    return out
+
+
+def simd512_bytes(data: bytes) -> bytes:
+    arr = (
+        np.frombuffer(data, dtype=np.uint8)[None, :]
+        if data
+        else np.zeros((1, 0), dtype=np.uint8)
+    )
+    return simd512(arr, len(data))[0].tobytes()

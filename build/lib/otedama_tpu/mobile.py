@@ -1,0 +1,119 @@
+"""Mobile API model: device sessions, push notifications, summary feeds.
+
+Reference parity: internal/mobile/app.go:17-152 (UI/notification/wallet/
+session managers) and internal/api/mobile/mobile_api.go (mobile REST + push
+tokens). The transport is the main ApiServer; this module owns the mobile
+domain model: registered devices, notification fan-out with per-device
+acknowledgment, and condensed dashboard summaries sized for a phone.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+
+
+@dataclasses.dataclass
+class MobileDevice:
+    id: int
+    user: str
+    push_token: str
+    platform: str = "unknown"          # ios | android
+    registered_at: float = dataclasses.field(default_factory=time.time)
+    last_seen: float = dataclasses.field(default_factory=time.time)
+    notifications_enabled: bool = True
+
+
+@dataclasses.dataclass
+class Notification:
+    id: int
+    kind: str                          # block | payout | worker-down | alert
+    title: str
+    body: str
+    created_at: float = dataclasses.field(default_factory=time.time)
+    delivered_to: set = dataclasses.field(default_factory=set)
+
+
+class MobileService:
+    def __init__(self, max_notifications: int = 500):
+        self.devices: dict[int, MobileDevice] = {}
+        self.notifications: list[Notification] = []
+        self.max_notifications = max_notifications
+        self._dev_ids = itertools.count(1)
+        self._note_ids = itertools.count(1)
+
+    # -- devices --------------------------------------------------------------
+
+    def register_device(self, user: str, push_token: str,
+                        platform: str = "unknown") -> MobileDevice:
+        for d in self.devices.values():
+            if d.push_token == push_token:
+                d.user = user
+                d.last_seen = time.time()
+                return d
+        device = MobileDevice(next(self._dev_ids), user, push_token, platform)
+        self.devices[device.id] = device
+        return device
+
+    def unregister_device(self, device_id: int) -> bool:
+        return self.devices.pop(device_id, None) is not None
+
+    # -- notifications ---------------------------------------------------------
+
+    def notify(self, kind: str, title: str, body: str,
+               user: str | None = None) -> Notification:
+        note = Notification(next(self._note_ids), kind, title, body)
+        for device in self.devices.values():
+            if not device.notifications_enabled:
+                continue
+            if user is not None and device.user != user:
+                continue
+            # push transport is an integration point; delivery is recorded
+            note.delivered_to.add(device.id)
+        self.notifications.append(note)
+        del self.notifications[: -self.max_notifications]
+        return note
+
+    def feed(self, user: str, limit: int = 50) -> list[dict]:
+        device_ids = {d.id for d in self.devices.values() if d.user == user}
+        out = []
+        for note in reversed(self.notifications):
+            if note.delivered_to & device_ids:
+                out.append({
+                    "id": note.id, "kind": note.kind, "title": note.title,
+                    "body": note.body, "ts": note.created_at,
+                })
+                if len(out) >= limit:
+                    break
+        return out
+
+    # -- condensed dashboard ---------------------------------------------------
+
+    @staticmethod
+    def summarize(engine_snap: dict | None = None,
+                  pool_snap: dict | None = None) -> dict:
+        """Phone-sized summary of a full status snapshot."""
+        out: dict = {"generated_at": time.time()}
+        if engine_snap:
+            shares = engine_snap.get("shares", {})
+            out["miner"] = {
+                "hashrate": engine_snap.get("hashrate", 0.0),
+                "accepted": shares.get("accepted", 0),
+                "rejected": shares.get("rejected", 0),
+                "blocks": engine_snap.get("blocks_found", 0),
+                "algorithm": engine_snap.get("algorithm", ""),
+            }
+        if pool_snap:
+            out["pool"] = {
+                "workers": pool_snap.get("workers", 0),
+                "shares": pool_snap.get("shares", 0),
+                "blocks": pool_snap.get("blocks", 0),
+            }
+        return out
+
+    def snapshot(self) -> dict:
+        return {
+            "devices": len(self.devices),
+            "notifications": len(self.notifications),
+        }

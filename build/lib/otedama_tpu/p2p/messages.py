@@ -1,0 +1,92 @@
+"""P2P wire format: length-prefixed binary frames.
+
+Reference parity: internal/p2p/messages.go + protocol.go:21-45 (message
+schema: type/payload/timestamp/from/message_id) and optimized_network.go's
+length-prefixed TCP framing with a network magic. Frame layout:
+
+    magic   uint32 BE  (0x4F54504F "OTPO")
+    length  uint32 BE  (bytes after this field)
+    type    uint8
+    payload length-4-... JSON body
+
+JSON payloads keep the wire debuggable (the reference uses JSON inside its
+binary frames too); the hot mining path never touches P2P, so codec speed
+is not a constraint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import secrets
+import struct
+import time
+
+MAGIC = 0x4F54504F  # "OTPO"
+MAX_FRAME = 4 << 20  # 4 MiB
+
+
+class MessageType(enum.IntEnum):
+    HANDSHAKE = 1
+    HANDSHAKE_ACK = 2
+    PING = 3
+    PONG = 4
+    SHARE = 5           # share gossip (P2P pool share-chain)
+    JOB = 6             # job/work propagation
+    BLOCK = 7           # block found
+    PEER_LIST = 8       # discovery
+    GET_PEERS = 9
+    SYNC_REQUEST = 10   # share-chain sync
+    SYNC_RESPONSE = 11
+    TX = 12             # payout transaction gossip
+    LEDGER = 13         # balance snapshot gossip
+
+
+@dataclasses.dataclass
+class P2PMessage:
+    type: MessageType
+    payload: dict
+    sender: str = ""                 # hex node id
+    message_id: str = dataclasses.field(
+        default_factory=lambda: secrets.token_hex(16)
+    )
+    timestamp: float = dataclasses.field(default_factory=time.time)
+
+    def encode(self) -> bytes:
+        body = json.dumps(
+            {
+                "payload": self.payload,
+                "from": self.sender,
+                "message_id": self.message_id,
+                "ts": self.timestamp,
+            },
+            separators=(",", ":"),
+        ).encode()
+        frame = struct.pack(">B", int(self.type)) + body
+        return struct.pack(">II", MAGIC, len(frame)) + frame
+
+    @classmethod
+    def decode_frame(cls, frame: bytes) -> "P2PMessage":
+        if not frame:
+            raise ValueError("empty frame")
+        mtype = MessageType(frame[0])
+        obj = json.loads(frame[1:]) if len(frame) > 1 else {}
+        return cls(
+            type=mtype,
+            payload=obj.get("payload", {}),
+            sender=obj.get("from", ""),
+            message_id=obj.get("message_id", ""),
+            timestamp=obj.get("ts", 0.0),
+        )
+
+
+async def read_frame(reader) -> bytes:
+    """Read one frame body (type byte + JSON) from an asyncio reader."""
+    header = await reader.readexactly(8)
+    magic, length = struct.unpack(">II", header)
+    if magic != MAGIC:
+        raise ValueError(f"bad magic {magic:#x}")
+    if length > MAX_FRAME:
+        raise ValueError(f"frame too large: {length}")
+    return await reader.readexactly(length)

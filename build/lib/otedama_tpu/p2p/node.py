@@ -1,0 +1,404 @@
+"""P2P node: peer management, flood gossip, discovery, keepalive.
+
+Reference parity: internal/p2p/optimized_network.go:20-68 (Network with
+NodeID, peer map, max peers, handler registry, stats), node.go, handlers.go
+:58-447 (per-type handlers, flood propagation with exclude-origin),
+discovery via peer-list exchange (the reference's DHT reduces to this in
+its tests; loopback multi-node tests are the strategy —
+test/integration/p2p_integration_test.go:16-361).
+
+asyncio-native redesign: one reader task per peer, dedup by message_id with
+a bounded LRU window, broadcast excludes the origin peer, peer slots capped
+with graceful rejects.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import secrets
+import time
+from collections import OrderedDict
+from typing import Awaitable, Callable
+
+from otedama_tpu.p2p.messages import MessageType, P2PMessage, read_frame
+
+log = logging.getLogger("otedama.p2p")
+
+Handler = Callable[["P2PNode", "Peer", P2PMessage], Awaitable[None]]
+
+PROTOCOL_VERSION = 1
+
+
+@dataclasses.dataclass
+class NodeConfig:
+    host: str = "127.0.0.1"
+    port: int = 0                    # 0 = ephemeral
+    max_peers: int = 32
+    connect_timeout: float = 10.0
+    keepalive_seconds: float = 30.0
+    peer_timeout: float = 90.0
+    dedup_window: int = 4096
+    bootstrap: list[tuple[str, int]] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class Peer:
+    node_id: str                     # hex
+    addr: str
+    listen_port: int
+    reader: asyncio.StreamReader
+    writer: asyncio.StreamWriter
+    outbound: bool
+    connected_at: float = dataclasses.field(default_factory=time.time)
+    last_seen: float = dataclasses.field(default_factory=time.time)
+    latency: float = 0.0
+    messages_in: int = 0
+    messages_out: int = 0
+
+    def send(self, msg: P2PMessage) -> None:
+        self.writer.write(msg.encode())
+        self.messages_out += 1
+
+
+class P2PNode:
+    def __init__(self, config: NodeConfig | None = None):
+        self.config = config or NodeConfig()
+        self.node_id = secrets.token_hex(32)
+        self.peers: dict[str, Peer] = {}
+        self.handlers: dict[MessageType, Handler] = {}
+        self.stats = {
+            "messages_received": 0,
+            "messages_sent": 0,
+            "messages_deduped": 0,
+            "peers_connected_total": 0,
+        }
+        self._seen: OrderedDict[str, None] = OrderedDict()
+        self._server: asyncio.AbstractServer | None = None
+        self._tasks: list[asyncio.Task] = []
+        self._peer_tasks: dict[str, asyncio.Task] = {}
+        self._ping_sent: dict[str, float] = {}
+        self._dialing: set[tuple[str, int]] = set()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._on_inbound, self.config.host, self.config.port
+        )
+        self.config.port = self._server.sockets[0].getsockname()[1]
+        self._tasks.append(asyncio.create_task(self._keepalive_loop()))
+        log.info(
+            "p2p node %s listening on %s:%d",
+            self.node_id[:12], self.config.host, self.config.port,
+        )
+        for host, port in self.config.bootstrap:
+            try:
+                await self.connect(host, port)
+            except OSError as e:
+                log.warning("bootstrap %s:%d failed: %s", host, port, e)
+
+    async def stop(self) -> None:
+        for t in self._tasks + list(self._peer_tasks.values()):
+            t.cancel()
+        await asyncio.gather(
+            *self._tasks, *self._peer_tasks.values(), return_exceptions=True
+        )
+        self._tasks.clear()
+        self._peer_tasks.clear()
+        for p in list(self.peers.values()):
+            p.writer.close()
+        self.peers.clear()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    @property
+    def port(self) -> int:
+        return self.config.port
+
+    # -- connections --------------------------------------------------------
+
+    async def connect(self, host: str, port: int) -> Peer:
+        """Dial a peer and run the handshake."""
+        if len(self.peers) >= self.config.max_peers:
+            raise ConnectionError("peer slots full")
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, port), self.config.connect_timeout
+        )
+        try:
+            hello = P2PMessage(
+                MessageType.HANDSHAKE,
+                {
+                    "version": PROTOCOL_VERSION,
+                    "listen_port": self.config.port,
+                },
+                sender=self.node_id,
+            )
+            writer.write(hello.encode())
+            await writer.drain()
+            ack = P2PMessage.decode_frame(
+                await asyncio.wait_for(read_frame(reader), self.config.connect_timeout)
+            )
+        except BaseException:
+            writer.close()
+            raise
+        if ack.type != MessageType.HANDSHAKE_ACK:
+            writer.close()
+            raise ConnectionError(f"expected handshake ack, got {ack.type}")
+        if ack.sender == self.node_id:
+            writer.close()
+            raise ConnectionError("connected to self")
+        existing = self.peers.get(ack.sender)
+        if existing is not None:
+            # simultaneous mutual dial: keep the established connection
+            writer.close()
+            return existing
+        peer = self._register_peer(
+            ack.sender, reader, writer,
+            listen_port=int(ack.payload.get("listen_port", port)),
+            outbound=True,
+        )
+        return peer
+
+    async def _on_inbound(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            hello = P2PMessage.decode_frame(
+                await asyncio.wait_for(read_frame(reader), 10.0)
+            )
+        except (ValueError, asyncio.TimeoutError, asyncio.IncompleteReadError):
+            writer.close()
+            return
+        if hello.type != MessageType.HANDSHAKE or not hello.sender:
+            writer.close()
+            return
+        if len(self.peers) >= self.config.max_peers or hello.sender in self.peers:
+            writer.close()
+            return
+        ack = P2PMessage(
+            MessageType.HANDSHAKE_ACK,
+            {"version": PROTOCOL_VERSION, "listen_port": self.config.port},
+            sender=self.node_id,
+        )
+        writer.write(ack.encode())
+        await writer.drain()
+        if hello.sender in self.peers:
+            # a concurrent handshake for the same node won the race while we
+            # awaited the drain — keep the registered connection
+            writer.close()
+            return
+        self._register_peer(
+            hello.sender, reader, writer,
+            listen_port=int(hello.payload.get("listen_port", 0)),
+            outbound=False,
+        )
+
+    def _register_peer(
+        self,
+        node_id: str,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        listen_port: int,
+        outbound: bool,
+    ) -> Peer:
+        addr = writer.get_extra_info("peername")
+        peer = Peer(
+            node_id=node_id,
+            addr=f"{addr[0]}:{addr[1]}" if addr else "?",
+            listen_port=listen_port,
+            reader=reader,
+            writer=writer,
+            outbound=outbound,
+        )
+        self.peers[node_id] = peer
+        self.stats["peers_connected_total"] += 1
+        self._peer_tasks[node_id] = asyncio.create_task(self._peer_loop(peer))
+        log.info("peer %s connected (%s)", node_id[:12], "out" if outbound else "in")
+        return peer
+
+    def _drop_peer(self, peer: Peer) -> None:
+        # only unregister if this Peer object still owns the slot — a stale
+        # connection for a re-registered node_id must not evict the live one
+        if self.peers.get(peer.node_id) is peer:
+            self.peers.pop(peer.node_id, None)
+            task = self._peer_tasks.pop(peer.node_id, None)
+            if task is not None and task is not asyncio.current_task():
+                task.cancel()
+        peer.writer.close()
+        log.info("peer %s dropped", peer.node_id[:12])
+
+    # -- message pump -------------------------------------------------------
+
+    async def _peer_loop(self, peer: Peer) -> None:
+        try:
+            while True:
+                frame = await read_frame(peer.reader)
+                peer.last_seen = time.time()
+                peer.messages_in += 1
+                self.stats["messages_received"] += 1
+                try:
+                    msg = P2PMessage.decode_frame(frame)
+                except ValueError as e:
+                    log.warning("bad frame from %s: %s", peer.node_id[:12], e)
+                    continue
+                await self._handle(peer, msg)
+        except (
+            asyncio.IncompleteReadError, ConnectionError, ValueError,
+            asyncio.CancelledError,
+        ):
+            pass
+        finally:
+            self._drop_peer(peer)
+
+    def _dedup(self, message_id: str) -> bool:
+        """True if already seen (and should be dropped)."""
+        if not message_id:
+            return False
+        if message_id in self._seen:
+            self.stats["messages_deduped"] += 1
+            return True
+        self._seen[message_id] = None
+        while len(self._seen) > self.config.dedup_window:
+            self._seen.popitem(last=False)
+        return False
+
+    async def _handle(self, peer: Peer, msg: P2PMessage) -> None:
+        if msg.type == MessageType.PING:
+            peer.send(P2PMessage(
+                MessageType.PONG, {"nonce": msg.payload.get("nonce")},
+                sender=self.node_id,
+            ))
+            return
+        if msg.type == MessageType.PONG:
+            sent = self._ping_sent.pop(peer.node_id, None)
+            if sent is not None:
+                peer.latency = time.time() - sent
+            return
+        if msg.type == MessageType.GET_PEERS:
+            peer.send(P2PMessage(
+                MessageType.PEER_LIST,
+                {"peers": self.known_addresses(exclude=peer.node_id)},
+                sender=self.node_id,
+            ))
+            return
+        if msg.type == MessageType.PEER_LIST:
+            await self._maybe_connect_new(msg.payload.get("peers", []))
+            # fall through to user handler too, if any
+        if self._dedup(msg.message_id):
+            return
+        handler = self.handlers.get(msg.type)
+        if handler is not None:
+            try:
+                await handler(self, peer, msg)
+            except Exception:
+                log.exception("handler for %s failed", msg.type.name)
+
+    # -- gossip -------------------------------------------------------------
+
+    def on(self, mtype: MessageType, handler: Handler) -> None:
+        self.handlers[mtype] = handler
+
+    async def broadcast(
+        self, msg: P2PMessage, exclude: str | None = None
+    ) -> int:
+        """Flood a message to all peers except ``exclude`` (origin).
+        Marks the id as seen so our own flood doesn't bounce back in."""
+        msg.sender = msg.sender or self.node_id
+        self._dedup(msg.message_id)  # pre-mark
+        n = 0
+        for peer in list(self.peers.values()):
+            if peer.node_id == exclude:
+                continue
+            try:
+                peer.send(msg)
+                n += 1
+            except (ConnectionError, RuntimeError):
+                self._drop_peer(peer)
+        self.stats["messages_sent"] += n
+        # writer.drain on each would serialize; flush opportunistically
+        await asyncio.gather(
+            *(p.writer.drain() for p in self.peers.values() if p.node_id != exclude),
+            return_exceptions=True,
+        )
+        return n
+
+    async def propagate(self, peer: Peer, msg: P2PMessage) -> int:
+        """Re-flood a received message to everyone but its origin."""
+        return await self.broadcast(msg, exclude=peer.node_id)
+
+    # -- discovery ----------------------------------------------------------
+
+    def known_addresses(self, exclude: str | None = None) -> list[list]:
+        out = []
+        for p in self.peers.values():
+            if p.node_id == exclude or not p.listen_port:
+                continue
+            host = p.addr.rsplit(":", 1)[0]
+            out.append([host, p.listen_port, p.node_id])
+        return out
+
+    async def discover(self) -> None:
+        """Ask every peer for their peers."""
+        for peer in list(self.peers.values()):
+            peer.send(P2PMessage(MessageType.GET_PEERS, {}, sender=self.node_id))
+
+    async def _maybe_connect_new(self, addresses: list) -> None:
+        # dial in the background: one unroutable advertised address must not
+        # stall the advertising peer's message pump
+        for entry in addresses:
+            if len(self.peers) >= self.config.max_peers:
+                return
+            try:
+                host, port, node_id = entry[0], int(entry[1]), str(entry[2])
+            except (IndexError, ValueError, TypeError):
+                continue
+            if node_id == self.node_id or node_id in self.peers:
+                continue
+            self._tasks.append(asyncio.create_task(self._connect_quietly(host, port)))
+        self._tasks = [t for t in self._tasks if not t.done()]
+
+    async def _connect_quietly(self, host: str, port: int) -> None:
+        key = (host, port)
+        if key in self._dialing:
+            return
+        self._dialing.add(key)
+        try:
+            await self.connect(host, port)
+        except (OSError, ConnectionError, asyncio.TimeoutError, ValueError):
+            pass
+        finally:
+            self._dialing.discard(key)
+
+    # -- keepalive ----------------------------------------------------------
+
+    async def _keepalive_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.config.keepalive_seconds)
+            now = time.time()
+            for peer in list(self.peers.values()):
+                if now - peer.last_seen > self.config.peer_timeout:
+                    log.info("peer %s timed out", peer.node_id[:12])
+                    self._drop_peer(peer)
+                    continue
+                self._ping_sent[peer.node_id] = now
+                try:
+                    peer.send(P2PMessage(
+                        MessageType.PING, {"nonce": secrets.token_hex(4)},
+                        sender=self.node_id,
+                    ))
+                except (ConnectionError, RuntimeError):
+                    self._drop_peer(peer)
+
+    # -- reporting ----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        return {
+            "node_id": self.node_id,
+            "listen": f"{self.config.host}:{self.config.port}",
+            "peers": len(self.peers),
+            **self.stats,
+        }

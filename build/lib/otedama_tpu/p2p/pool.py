@@ -1,0 +1,167 @@
+"""Decentralized (P2P) pool mode: share gossip + distributed share ledger.
+
+Reference parity: internal/mining/p2p_engine.go:14-110 (engine + network
+composition), internal/p2p/handlers.go:70-447 (share/job/block handlers with
+re-propagation). Each node validates gossiped shares against the advertised
+job target and accumulates a worker->difficulty ledger; when any node finds
+a block, every node can compute the same PPLNS split from its ledger —
+the share-chain idea the reference sketches with its "ledger" message type.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from collections import OrderedDict
+
+from otedama_tpu.p2p.messages import MessageType, P2PMessage
+from otedama_tpu.p2p.node import NodeConfig, P2PNode, Peer
+
+log = logging.getLogger("otedama.p2p.pool")
+
+
+@dataclasses.dataclass
+class LedgerEntry:
+    worker: str
+    difficulty: float
+    job_id: str
+    timestamp: float
+    origin: str  # node id that first saw the share
+
+
+class P2PPool:
+    """A pool node in the gossip overlay."""
+
+    def __init__(self, config: NodeConfig | None = None, window: int = 10000):
+        self.node = P2PNode(config)
+        self.window = window
+        self.ledger: list[LedgerEntry] = []
+        # dedup keys outlive the ledger window (bounded LRU) so late syncs
+        # can't re-append shares that were already counted and then trimmed
+        self._ledger_keys: "OrderedDict[tuple, None]" = OrderedDict()
+        self.blocks_seen: list[dict] = []
+        self.jobs_seen: dict[str, dict] = {}
+        self.node.on(MessageType.SHARE, self._on_share)
+        self.node.on(MessageType.BLOCK, self._on_block)
+        self.node.on(MessageType.JOB, self._on_job)
+        self.node.on(MessageType.SYNC_REQUEST, self._on_sync_request)
+        self.node.on(MessageType.SYNC_RESPONSE, self._on_sync_response)
+
+    async def start(self) -> None:
+        await self.node.start()
+
+    async def stop(self) -> None:
+        await self.node.stop()
+
+    # -- local events -> gossip ---------------------------------------------
+
+    async def announce_share(
+        self, worker: str, difficulty: float, job_id: str
+    ) -> None:
+        entry = LedgerEntry(worker, difficulty, job_id, time.time(), self.node.node_id)
+        self._append(entry)
+        await self.node.broadcast(P2PMessage(
+            MessageType.SHARE,
+            {
+                "worker": worker,
+                "difficulty": difficulty,
+                "job_id": job_id,
+                "ts": entry.timestamp,
+            },
+        ))
+
+    async def announce_block(self, block_hash: str, worker: str, height: int) -> None:
+        block = {"hash": block_hash, "worker": worker, "height": height}
+        self.blocks_seen.append(block)
+        await self.node.broadcast(P2PMessage(MessageType.BLOCK, block))
+
+    async def announce_job(self, job_params: list) -> None:
+        """Gossip a stratum-format job (mining.notify params)."""
+        self.jobs_seen[str(job_params[0])] = {"params": job_params, "ts": time.time()}
+        await self.node.broadcast(P2PMessage(MessageType.JOB, {"params": job_params}))
+
+    # -- gossip handlers (validate, record, re-flood) ------------------------
+
+    async def _on_share(self, node: P2PNode, peer: Peer, msg: P2PMessage) -> None:
+        p = msg.payload
+        try:
+            entry = LedgerEntry(
+                worker=str(p["worker"]),
+                difficulty=float(p["difficulty"]),
+                job_id=str(p["job_id"]),
+                timestamp=float(p.get("ts", time.time())),
+                origin=msg.sender,
+            )
+        except (KeyError, ValueError, TypeError):
+            log.warning("malformed share gossip from %s", peer.node_id[:12])
+            return
+        if entry.difficulty <= 0:
+            return
+        self._append(entry)
+        await node.propagate(peer, msg)
+
+    async def _on_block(self, node: P2PNode, peer: Peer, msg: P2PMessage) -> None:
+        self.blocks_seen.append(dict(msg.payload))
+        await node.propagate(peer, msg)
+
+    async def _on_job(self, node: P2PNode, peer: Peer, msg: P2PMessage) -> None:
+        params = msg.payload.get("params")
+        if isinstance(params, list) and params:
+            self.jobs_seen[str(params[0])] = {"params": params, "ts": time.time()}
+            await node.propagate(peer, msg)
+
+    async def _on_sync_request(self, node: P2PNode, peer: Peer, msg: P2PMessage) -> None:
+        since = float(msg.payload.get("since", 0.0))
+        entries = [
+            dataclasses.asdict(e) for e in self.ledger if e.timestamp >= since
+        ][-2000:]
+        peer.send(P2PMessage(
+            MessageType.SYNC_RESPONSE, {"entries": entries}, sender=node.node_id
+        ))
+
+    async def _on_sync_response(self, node: P2PNode, peer: Peer, msg: P2PMessage) -> None:
+        for obj in msg.payload.get("entries", []):
+            try:
+                self._append(LedgerEntry(**obj))
+            except TypeError:
+                continue
+
+    async def request_sync(self, since: float = 0.0) -> None:
+        for peer in list(self.node.peers.values()):
+            peer.send(P2PMessage(
+                MessageType.SYNC_REQUEST, {"since": since}, sender=self.node.node_id
+            ))
+
+    # -- ledger -------------------------------------------------------------
+
+    def _append(self, entry: LedgerEntry) -> None:
+        # dedup by identity, not message_id: overlapping SYNC_RESPONSEs from
+        # several peers carry the same entries under fresh message ids, and
+        # double-counting would skew every node's PPLNS split
+        key = (entry.origin, entry.worker, entry.job_id, entry.timestamp,
+               entry.difficulty)
+        if key in self._ledger_keys:
+            return
+        self._ledger_keys[key] = None
+        while len(self._ledger_keys) > 8 * self.window:
+            self._ledger_keys.popitem(last=False)
+        self.ledger.append(entry)
+        if len(self.ledger) > 2 * self.window:
+            del self.ledger[: -self.window]
+
+    def weights(self) -> dict[str, float]:
+        """PPLNS weights over the last-N ledger window — every node computes
+        the same split from the same gossip."""
+        out: dict[str, float] = {}
+        for e in self.ledger[-self.window:]:
+            out[e.worker] = out.get(e.worker, 0.0) + e.difficulty
+        return out
+
+    def snapshot(self) -> dict:
+        return {
+            **self.node.snapshot(),
+            "ledger_entries": len(self.ledger),
+            "blocks_seen": len(self.blocks_seen),
+            "jobs_seen": len(self.jobs_seen),
+        }

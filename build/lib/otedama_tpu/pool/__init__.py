@@ -1,0 +1,32 @@
+from otedama_tpu.pool.payouts import (
+    FeeDistributor,
+    PayoutCalculator,
+    PayoutConfig,
+    PayoutScheme,
+    WorkerPayout,
+)
+from otedama_tpu.pool.blockchain import (
+    BlockchainClient,
+    BlockTemplate,
+    MockChainClient,
+)
+from otedama_tpu.pool.submitter import BlockSubmitter
+from otedama_tpu.pool.failover import FailoverManager, FailoverStrategy, UpstreamPool
+from otedama_tpu.pool.manager import PoolManager, PoolConfig
+
+__all__ = [
+    "PayoutCalculator",
+    "PayoutConfig",
+    "PayoutScheme",
+    "WorkerPayout",
+    "FeeDistributor",
+    "BlockchainClient",
+    "BlockTemplate",
+    "MockChainClient",
+    "BlockSubmitter",
+    "FailoverManager",
+    "FailoverStrategy",
+    "UpstreamPool",
+    "PoolManager",
+    "PoolConfig",
+]

@@ -1,0 +1,178 @@
+"""Blockchain client interface + implementations.
+
+Reference parity: internal/pool/blockchain_client.go:15-240 (interface,
+Bitcoin JSON-RPC client), internal/currency/blockchain_client.go:92-107
+(``BlockTemplate``). The mock client is a regtest-style in-process chain:
+it hands out templates, verifies submitted headers against its own nbits,
+and advances height — the loopback analogue the reference never ships
+(its tests stop at the pool layer).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import logging
+import secrets
+import time
+import urllib.request
+from typing import Protocol
+
+from otedama_tpu.kernels import target as tgt
+from otedama_tpu.utils.sha256_host import sha256d
+
+log = logging.getLogger("otedama.pool.chain")
+
+
+@dataclasses.dataclass
+class BlockTemplate:
+    height: int
+    prev_hash: bytes            # header byte order
+    coinb1: bytes
+    coinb2: bytes
+    merkle_branch: list[bytes]
+    version: int
+    nbits: int
+    ntime: int
+    reward: int                 # atomic units (coinbase value)
+
+
+@dataclasses.dataclass
+class SubmitOutcome:
+    accepted: bool
+    block_hash: str = ""
+    reason: str = ""
+
+
+class BlockchainClient(Protocol):
+    """What the pool needs from a chain node (reference iface
+    internal/pool/block_submitter.go:52-58)."""
+
+    async def get_block_template(self) -> BlockTemplate: ...
+    async def submit_block(self, header: bytes) -> SubmitOutcome: ...
+    async def get_confirmations(self, block_hash: str) -> int: ...
+    async def get_network_difficulty(self) -> float: ...
+
+
+class MockChainClient:
+    """In-process regtest-style chain for tests and solo-mode dry runs."""
+
+    def __init__(self, nbits: int = 0x207FFFFF, reward: int = 50 * 100_000_000):
+        self.nbits = nbits
+        self.reward = reward
+        self.height = 100
+        self.tip = b"\x00" * 32
+        self.submitted: list[tuple[int, bytes, str]] = []
+        self.confirmations: dict[str, int] = {}
+
+    async def get_block_template(self) -> BlockTemplate:
+        return BlockTemplate(
+            height=self.height + 1,
+            prev_hash=self.tip,
+            coinb1=bytes.fromhex("01000000010000000000000000") + secrets.token_bytes(4),
+            coinb2=bytes.fromhex("ffffffff0100f2052a01000000"),
+            merkle_branch=[],
+            version=0x20000000,
+            nbits=self.nbits,
+            ntime=int(time.time()),
+            reward=self.reward,
+        )
+
+    async def submit_block(self, header: bytes) -> SubmitOutcome:
+        if len(header) != 80:
+            return SubmitOutcome(False, reason="bad header size")
+        digest = sha256d(header)
+        if not tgt.hash_meets_target(digest, tgt.bits_to_target(self.nbits)):
+            return SubmitOutcome(False, reason="high-hash")
+        block_hash = digest[::-1].hex()
+        self.height += 1
+        self.tip = digest
+        self.submitted.append((self.height, header, block_hash))
+        self.confirmations[block_hash] = 1
+        log.info("mock chain accepted block %d %s", self.height, block_hash[:16])
+        return SubmitOutcome(True, block_hash=block_hash)
+
+    async def get_confirmations(self, block_hash: str) -> int:
+        if block_hash not in self.confirmations:
+            return -1  # orphaned / unknown
+        self.confirmations[block_hash] += 1
+        return self.confirmations[block_hash]
+
+    async def get_network_difficulty(self) -> float:
+        return tgt.target_to_difficulty(tgt.bits_to_target(self.nbits))
+
+
+class BitcoinRPCClient:
+    """JSON-RPC client for bitcoind-compatible nodes.
+
+    Reference parity: internal/pool/blockchain_client.go BitcoinClient and
+    internal/currency/bitcoin_client.go. Runs stdlib urllib in a thread so
+    the event loop never blocks (no aiohttp in the image).
+    """
+
+    def __init__(self, url: str, user: str = "", password: str = "", timeout: float = 10.0):
+        self.url = url
+        self.timeout = timeout
+        self._auth = None
+        if user:
+            import base64
+
+            self._auth = "Basic " + base64.b64encode(
+                f"{user}:{password}".encode()
+            ).decode()
+        self._id = 0
+
+    async def _rpc(self, method: str, params: list | None = None):
+        self._id += 1
+        payload = json.dumps(
+            {"jsonrpc": "1.0", "id": self._id, "method": method, "params": params or []}
+        ).encode()
+
+        def do_request():
+            req = urllib.request.Request(
+                self.url, data=payload, headers={"Content-Type": "application/json"}
+            )
+            if self._auth:
+                req.add_header("Authorization", self._auth)
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read())
+
+        obj = await asyncio.get_running_loop().run_in_executor(None, do_request)
+        if obj.get("error"):
+            raise RuntimeError(f"rpc {method}: {obj['error']}")
+        return obj["result"]
+
+    async def get_block_template(self) -> BlockTemplate:
+        t = await self._rpc("getblocktemplate", [{"rules": ["segwit"]}])
+        # NOTE: coinbase construction from template transactions is chain-
+        # specific; here we expose the raw template fields the stratum job
+        # builder consumes (serving a real chain requires a coinbase builder
+        # configured with the pool's payout script).
+        return BlockTemplate(
+            height=int(t["height"]),
+            prev_hash=bytes.fromhex(t["previousblockhash"])[::-1],
+            coinb1=b"",
+            coinb2=b"",
+            merkle_branch=[],
+            version=int(t["version"]),
+            nbits=int(t["bits"], 16),
+            ntime=int(t["curtime"]),
+            reward=int(t.get("coinbasevalue", 0)),
+        )
+
+    async def submit_block(self, header: bytes) -> SubmitOutcome:
+        res = await self._rpc("submitblock", [header.hex()])
+        if res is None:
+            return SubmitOutcome(True, block_hash=sha256d(header)[::-1].hex())
+        return SubmitOutcome(False, reason=str(res))
+
+    async def get_confirmations(self, block_hash: str) -> int:
+        try:
+            block = await self._rpc("getblock", [block_hash])
+            return int(block.get("confirmations", 0))
+        except RuntimeError:
+            return -1
+
+    async def get_network_difficulty(self) -> float:
+        return float(await self._rpc("getdifficulty"))

@@ -1,0 +1,237 @@
+"""PoolManager: composes validator/jobs/payouts/submitter over persistence.
+
+Reference parity: internal/pool/pool_manager.go:17-160 (composition root),
+payout_processor.go:19-76 (batch payouts via WalletInterface). The stratum
+server handles wire-level validation; the manager owns pool policy: share
+accounting, block lifecycle, reward distribution, worker balances, payout
+batching.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import itertools
+import logging
+import time
+from typing import Protocol
+
+from otedama_tpu.db import (
+    BlockRepository,
+    Database,
+    PayoutRepository,
+    ShareRepository,
+    WorkerRepository,
+)
+from otedama_tpu.engine.types import Job
+from otedama_tpu.pool.blockchain import BlockchainClient, BlockTemplate
+from otedama_tpu.pool.payouts import PayoutCalculator, PayoutConfig, PayoutScheme
+from otedama_tpu.pool.submitter import BlockSubmitter, SubmitterConfig
+from otedama_tpu.stratum.server import AcceptedShare
+
+log = logging.getLogger("otedama.pool.manager")
+
+
+class WalletInterface(Protocol):
+    """Reference parity: internal/pool/payout_processor.go:59-66."""
+
+    async def send_many(self, outputs: dict[str, int]) -> str: ...
+    async def get_balance(self) -> int: ...
+
+
+class MockWallet:
+    """In-memory wallet (reference test MockWallet, payout_system_test.go:265)."""
+
+    def __init__(self, balance: int = 10**12):
+        self.balance = balance
+        self.sent: list[dict[str, int]] = []
+        self._tx = itertools.count(1)
+
+    async def send_many(self, outputs: dict[str, int]) -> str:
+        total = sum(outputs.values())
+        if total > self.balance:
+            raise RuntimeError("insufficient funds")
+        self.balance -= total
+        self.sent.append(dict(outputs))
+        return f"mock-tx-{next(self._tx):08d}"
+
+    async def get_balance(self) -> int:
+        return self.balance
+
+
+@dataclasses.dataclass
+class PoolConfig:
+    payout: PayoutConfig = dataclasses.field(default_factory=PayoutConfig)
+    payout_interval: float = 3600.0
+    template_poll_seconds: float = 5.0
+    share_retention_seconds: float = 7 * 86400.0
+
+
+class PoolManager:
+    def __init__(
+        self,
+        db: Database,
+        chain: BlockchainClient,
+        wallet: WalletInterface | None = None,
+        config: PoolConfig | None = None,
+    ):
+        self.db = db
+        self.chain = chain
+        self.wallet = wallet or MockWallet()
+        self.config = config or PoolConfig()
+        self.workers = WorkerRepository(db)
+        self.shares = ShareRepository(db)
+        self.blocks = BlockRepository(db)
+        self.payout_repo = PayoutRepository(db)
+        self.calculator = PayoutCalculator(self.config.payout)
+        self.submitter = BlockSubmitter(chain, self.blocks, SubmitterConfig())
+        self._job_counter = itertools.count(1)
+        self._round_start = time.time()     # PROP round boundary
+        self._current_reward = 0
+        # reward is credited per found job, not per latest template: a
+        # template refresh mid-round must not change the split of a block
+        # found on the previous job
+        self._job_rewards: dict[str, int] = {}
+        self._tasks: list[asyncio.Task] = []
+
+    # -- job production -----------------------------------------------------
+
+    def job_from_template(self, t: BlockTemplate, algorithm: str = "sha256d") -> Job:
+        self._current_reward = t.reward
+        job_id = f"{next(self._job_counter):x}"
+        self._job_rewards[job_id] = t.reward
+        if len(self._job_rewards) > 512:
+            for jid in list(self._job_rewards)[:-256]:
+                del self._job_rewards[jid]
+        return Job(
+            job_id=job_id,
+            prev_hash=t.prev_hash,
+            coinb1=t.coinb1,
+            coinb2=t.coinb2,
+            merkle_branch=t.merkle_branch,
+            version=t.version,
+            nbits=t.nbits,
+            ntime=t.ntime,
+            clean=True,
+            algorithm=algorithm,
+        )
+
+    async def next_job(self) -> Job:
+        return self.job_from_template(await self.chain.get_block_template())
+
+    # -- share intake (stratum server hook) ---------------------------------
+
+    async def on_share(self, share: AcceptedShare) -> None:
+        worker = share.worker_user
+        self.workers.upsert(worker)
+        self.workers.record_share(worker, True)
+        self.shares.create(
+            worker,
+            share.job_id,
+            share.difficulty,
+            share.actual_difficulty,
+            share.is_block,
+            share.submitted_at,
+        )
+        credit = self.calculator.pps_credit(share.difficulty)
+        if credit:
+            self.workers.credit(worker, credit)
+
+    async def on_block(self, header: bytes, job: Job, share: AcceptedShare) -> None:
+        reward = self._job_rewards.get(job.job_id, self._current_reward)
+        outcome = await self.submitter.submit(header, share.worker_user, reward)
+        if not outcome.accepted:
+            return
+        self.distribute_block(reward, finder=share.worker_user)
+
+    # -- reward distribution ------------------------------------------------
+
+    def distribute_block(self, reward: int, finder: str | None = None) -> None:
+        if self.config.payout.scheme == PayoutScheme.PROP:
+            window = self.shares.since(self._round_start)
+            self._round_start = time.time()
+        else:
+            window = self.shares.last_n(self.config.payout.pplns_window)
+        result = self.calculator.calculate_block(reward, window, finder=finder)
+        with self.db.transaction():
+            for p in result.payouts:
+                self.workers.upsert(p.worker)
+                self.workers.credit(p.worker, p.amount)
+        self.db.audit(
+            "pool", "distribute_block",
+            f"reward={reward} fee={result.pool_fee} workers={len(result.payouts)}",
+        )
+        log.info(
+            "distributed block reward %d to %d workers (fee %d)",
+            reward, len(result.payouts), result.pool_fee,
+        )
+
+    # -- payout processing --------------------------------------------------
+
+    async def process_payouts(self) -> int:
+        """Pay out all balances above the minimum. Returns count paid."""
+        cfg = self.config.payout
+        outputs: dict[str, int] = {}
+        entries: list[tuple[str, str, int, int]] = []  # worker,address,amount,payout_id
+        for w in self.workers.list():
+            payable = w["balance"] - cfg.payout_fee
+            if w["balance"] >= cfg.minimum_payout and payable > 0:
+                address = w["wallet"] or w["name"].split(".")[0]
+                pid = self.payout_repo.create(w["name"], address, payable)
+                entries.append((w["name"], address, payable, pid))
+                outputs[address] = outputs.get(address, 0) + payable
+        if not outputs:
+            return 0
+        try:
+            tx_id = await self.wallet.send_many(outputs)
+        except Exception as e:
+            log.error("payout batch failed: %s", e)
+            for _, _, _, pid in entries:
+                self.payout_repo.mark_failed(pid)
+            return 0
+        with self.db.transaction():
+            for worker, _, amount, pid in entries:
+                self.payout_repo.mark_sent(pid, tx_id)
+                self.workers.debit_for_payout(worker, amount + cfg.payout_fee)
+        self.db.audit("pool", "payout_batch", f"tx={tx_id} outputs={len(outputs)}")
+        log.info("paid %d workers in tx %s", len(entries), tx_id)
+        return len(entries)
+
+    # -- background loops ---------------------------------------------------
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        self._tasks.append(loop.create_task(self._payout_loop()))
+        self._tasks.append(loop.create_task(self._prune_loop()))
+        self.submitter.start_confirmation_tracking()
+
+    async def stop(self) -> None:
+        await self.submitter.stop()
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks.clear()
+
+    async def _payout_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.config.payout_interval)
+            await self.process_payouts()
+
+    async def _prune_loop(self) -> None:
+        while True:
+            await asyncio.sleep(3600.0)
+            pruned = self.shares.prune_before(
+                time.time() - self.config.share_retention_seconds
+            )
+            if pruned:
+                log.info("pruned %d old shares", pruned)
+
+    # -- reporting ----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        return {
+            "workers": len(self.workers.list()),
+            "shares": self.shares.count(),
+            "blocks": len(self.blocks.list()),
+            "scheme": self.config.payout.scheme.value,
+        }

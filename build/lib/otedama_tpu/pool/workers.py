@@ -1,0 +1,156 @@
+"""Pool-side live worker registry: registration, wallet validation, stats.
+
+Reference parity: internal/worker/unified_worker.go:213-268 (registration
+with wallet validation), :44-86 (per-worker share history and earnings),
+stats/cleanup loops. The db repositories persist; this registry tracks the
+*live* population (connected sessions, rolling hashrate estimated from
+share difficulty, ban scoring for misbehaving miners).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import re
+import time
+
+log = logging.getLogger("otedama.pool.workers")
+
+# base58 (legacy/P2SH) or bech32 mainnet/testnet-style addresses
+_ADDR_RE = re.compile(
+    r"^([13mn2][1-9A-HJ-NP-Za-km-z]{25,34}|(bc1|tb1|ltc1)[02-9ac-hj-np-z]{11,71})$"
+)
+
+
+def validate_wallet(address: str) -> bool:
+    return bool(_ADDR_RE.match(address))
+
+
+@dataclasses.dataclass
+class WorkerSession:
+    name: str                      # wallet.worker_name
+    wallet: str
+    session_id: int
+    connected_at: float = dataclasses.field(default_factory=time.time)
+    last_share_at: float = 0.0
+    shares_accepted: int = 0
+    shares_rejected: int = 0
+    difficulty_sum: float = 0.0    # sum of accepted share difficulties
+    banned_until: float = 0.0
+    # rolling window of (timestamp, difficulty) for hashrate estimation
+    recent: list = dataclasses.field(default_factory=list)
+
+    def record(self, accepted: bool, difficulty: float, now: float | None = None) -> None:
+        now = now if now is not None else time.time()
+        if accepted:
+            self.shares_accepted += 1
+            self.difficulty_sum += difficulty
+            self.last_share_at = now
+            self.recent.append((now, difficulty))
+            cutoff = now - 600.0
+            while self.recent and self.recent[0][0] < cutoff:
+                self.recent.pop(0)
+        else:
+            self.shares_rejected += 1
+
+    def hashrate(self, now: float | None = None) -> float:
+        """Estimated H/s from accepted share difficulty over the window
+        (each diff-1 share represents ~2^32 hashes)."""
+        now = now if now is not None else time.time()
+        if not self.recent:
+            return 0.0
+        window = max(now - self.recent[0][0], 1.0)
+        total_diff = sum(d for _, d in self.recent)
+        return total_diff * 4294967296.0 / window
+
+    @property
+    def reject_rate(self) -> float:
+        total = self.shares_accepted + self.shares_rejected
+        return self.shares_rejected / total if total else 0.0
+
+
+@dataclasses.dataclass
+class RegistryConfig:
+    require_valid_wallet: bool = False
+    inactive_timeout: float = 3600.0
+    ban_reject_rate: float = 0.9        # ban when >90% rejects (and enough shares)
+    ban_min_shares: int = 50
+    ban_seconds: float = 600.0
+
+
+class WorkerRegistry:
+    def __init__(self, config: RegistryConfig | None = None):
+        self.config = config or RegistryConfig()
+        self.workers: dict[str, WorkerSession] = {}
+        self.registrations_rejected = 0
+
+    def register(self, name: str, session_id: int) -> WorkerSession:
+        """Register (or re-attach) a worker. Name format: wallet[.rig]."""
+        wallet = name.split(".", 1)[0]
+        if self.config.require_valid_wallet and not validate_wallet(wallet):
+            self.registrations_rejected += 1
+            raise ValueError(f"invalid wallet address {wallet!r}")
+        worker = self.workers.get(name)
+        if worker is None:
+            worker = WorkerSession(name=name, wallet=wallet, session_id=session_id)
+            self.workers[name] = worker
+            log.info("worker %s registered (session %d)", name, session_id)
+        else:
+            worker.session_id = session_id
+        return worker
+
+    def is_banned(self, name: str, now: float | None = None) -> bool:
+        worker = self.workers.get(name)
+        if worker is None:
+            return False
+        return (now if now is not None else time.time()) < worker.banned_until
+
+    def record_share(self, name: str, accepted: bool, difficulty: float,
+                     now: float | None = None) -> None:
+        worker = self.workers.get(name)
+        if worker is None:
+            return
+        now = now if now is not None else time.time()
+        worker.record(accepted, difficulty, now)
+        total = worker.shares_accepted + worker.shares_rejected
+        if (
+            total >= self.config.ban_min_shares
+            and worker.reject_rate > self.config.ban_reject_rate
+        ):
+            worker.banned_until = now + self.config.ban_seconds
+            log.warning("worker %s banned for %ds (reject rate %.0f%%)",
+                        name, self.config.ban_seconds, worker.reject_rate * 100)
+
+    def cleanup(self, now: float | None = None) -> int:
+        """Drop workers idle past the timeout. Returns count removed."""
+        now = now if now is not None else time.time()
+        stale = [
+            n for n, w in self.workers.items()
+            if now - max(w.last_share_at, w.connected_at) > self.config.inactive_timeout
+        ]
+        for n in stale:
+            del self.workers[n]
+        return len(stale)
+
+    def total_hashrate(self, now: float | None = None) -> float:
+        return sum(w.hashrate(now) for w in self.workers.values())
+
+    def snapshot(self) -> dict:
+        now = time.time()
+        return {
+            "workers": len(self.workers),
+            "total_hashrate": self.total_hashrate(now),
+            "registrations_rejected": self.registrations_rejected,
+            "top": sorted(
+                (
+                    {
+                        "name": w.name,
+                        "hashrate": w.hashrate(now),
+                        "accepted": w.shares_accepted,
+                        "rejected": w.shares_rejected,
+                    }
+                    for w in self.workers.values()
+                ),
+                key=lambda x: -x["hashrate"],
+            )[:10],
+        }

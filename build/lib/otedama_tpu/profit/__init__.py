@@ -1,0 +1,14 @@
+from otedama_tpu.profit.analyzer import (
+    CoinMetrics,
+    ProfitAnalyzer,
+    ProfitEstimate,
+)
+from otedama_tpu.profit.switcher import ProfitSwitcher, SwitcherConfig
+
+__all__ = [
+    "CoinMetrics",
+    "ProfitAnalyzer",
+    "ProfitEstimate",
+    "ProfitSwitcher",
+    "SwitcherConfig",
+]

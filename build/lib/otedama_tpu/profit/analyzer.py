@@ -1,0 +1,138 @@
+"""Profitability analysis: per-coin revenue estimates, trends, forecasts.
+
+Reference parity: internal/profit/analyzer.go:14-135 (ProfitAnalyzer with
+trend windows) and internal/mining/algorithm_manager_unified.go:582-631
+(profitability calculation). Market data is injected (``update_metrics``),
+never fetched — the reference polls price APIs; in this framework the data
+source is a caller-supplied feed so the analyzer stays deterministic and
+testable (and the zero-egress environment stays happy).
+
+Revenue model per coin: expected coins/day for a hashrate h on a network
+with difficulty D and block reward R is ``h / (D * 2^32) * 86400 * R`` for
+bitcoin-family PoW (shares-per-block convention), times price, minus power
+cost.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+
+@dataclasses.dataclass
+class CoinMetrics:
+    coin: str
+    algorithm: str
+    price: float                  # fiat per coin
+    network_difficulty: float
+    block_reward: float
+    updated_at: float = dataclasses.field(default_factory=time.time)
+
+
+@dataclasses.dataclass
+class ProfitEstimate:
+    coin: str
+    algorithm: str
+    hashrate: float
+    coins_per_day: float
+    revenue_per_day: float        # fiat
+    power_cost_per_day: float
+    profit_per_day: float
+
+    @property
+    def margin(self) -> float:
+        if self.revenue_per_day <= 0:
+            return 0.0
+        return self.profit_per_day / self.revenue_per_day
+
+
+class ProfitAnalyzer:
+    def __init__(self, power_watts: float = 0.0, power_price_kwh: float = 0.0,
+                 history_window: int = 288):
+        self.power_watts = power_watts
+        self.power_price_kwh = power_price_kwh
+        self.history_window = history_window
+        self.metrics: dict[str, CoinMetrics] = {}
+        self._history: dict[str, list[tuple[float, float]]] = {}  # coin -> [(ts, profit/day)]
+
+    def update_metrics(self, m: CoinMetrics) -> None:
+        self.metrics[m.coin] = m
+
+    def estimate(self, coin: str, hashrate: float) -> ProfitEstimate | None:
+        """Pure estimate — no history side effect (probes from best()/the
+        switcher must not pollute the trend series); use ``sample`` for the
+        periodic recording path."""
+        m = self.metrics.get(coin)
+        if m is None or m.network_difficulty <= 0:
+            return None
+        coins_per_day = (
+            hashrate / (m.network_difficulty * 4294967296.0) * 86400.0 * m.block_reward
+        )
+        revenue = coins_per_day * m.price
+        power_cost = self.power_watts / 1000.0 * 24.0 * self.power_price_kwh
+        return ProfitEstimate(
+            coin=coin,
+            algorithm=m.algorithm,
+            hashrate=hashrate,
+            coins_per_day=coins_per_day,
+            revenue_per_day=revenue,
+            power_cost_per_day=power_cost,
+            profit_per_day=revenue - power_cost,
+        )
+
+    def sample(self, coin: str, hashrate: float) -> ProfitEstimate | None:
+        """Estimate AND record into the trend/forecast history."""
+        est = self.estimate(coin, hashrate)
+        if est is not None:
+            hist = self._history.setdefault(coin, [])
+            hist.append((time.time(), est.profit_per_day))
+            del hist[: -self.history_window]
+        return est
+
+    def best(self, hashrates: dict[str, float]) -> ProfitEstimate | None:
+        """Most profitable coin given per-algorithm hashrates
+        (algorithm -> H/s)."""
+        best: ProfitEstimate | None = None
+        for coin, m in self.metrics.items():
+            h = hashrates.get(m.algorithm)
+            if not h:
+                continue
+            est = self.estimate(coin, h)
+            if est and (best is None or est.profit_per_day > best.profit_per_day):
+                best = est
+        return best
+
+    def trend(self, coin: str) -> float:
+        """Linear-regression slope of profit/day over the history window
+        (reference: analyzer.go trend windows). Positive = improving."""
+        hist = self._history.get(coin, [])
+        if len(hist) < 2:
+            return 0.0
+        n = len(hist)
+        t0 = hist[0][0]
+        xs = [t - t0 for t, _ in hist]
+        ys = [p for _, p in hist]
+        mean_x = sum(xs) / n
+        mean_y = sum(ys) / n
+        denom = sum((x - mean_x) ** 2 for x in xs)
+        if denom == 0:
+            return 0.0
+        return sum((x - mean_x) * (y - mean_y) for x, y in zip(xs, ys)) / denom
+
+    def forecast(self, coin: str, horizon_seconds: float = 3600.0) -> float | None:
+        """Naive linear forecast of profit/day at now+horizon."""
+        hist = self._history.get(coin, [])
+        if not hist:
+            return None
+        return hist[-1][1] + self.trend(coin) * horizon_seconds
+
+    def snapshot(self) -> dict:
+        return {
+            coin: {
+                "algorithm": m.algorithm,
+                "price": m.price,
+                "difficulty": m.network_difficulty,
+                "age_seconds": round(time.time() - m.updated_at, 1),
+            }
+            for coin, m in self.metrics.items()
+        }

@@ -1,0 +1,147 @@
+"""Profit-driven algorithm switching.
+
+Reference parity: internal/mining/algorithm_manager_unified.go:502-560
+(auto-switch loop with hysteresis) and internal/profit/profit_switcher.go
+:22-89. The switcher periodically asks the analyzer for the best coin given
+measured (or planning) hashrates and tells the engine to change algorithm —
+but only when the improvement clears a threshold and a cooldown has passed,
+so marginal price wiggles don't thrash the device pipeline (every switch
+costs a recompile on TPU).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import time
+from typing import Awaitable, Callable
+
+from otedama_tpu.engine import algos
+from otedama_tpu.profit.analyzer import ProfitAnalyzer, ProfitEstimate
+
+log = logging.getLogger("otedama.profit.switcher")
+
+SwitchCallback = Callable[[str, ProfitEstimate], Awaitable[None]]
+
+
+@dataclasses.dataclass
+class SwitcherConfig:
+    interval_seconds: float = 300.0
+    min_improvement_percent: float = 10.0
+    cooldown_seconds: float = 1800.0
+    implemented_only: bool = True      # never switch to a stub algorithm
+
+
+class ProfitSwitcher:
+    def __init__(
+        self,
+        analyzer: ProfitAnalyzer,
+        on_switch: SwitchCallback,
+        config: SwitcherConfig | None = None,
+        current_algorithm: str = "sha256d",
+    ):
+        self.analyzer = analyzer
+        self.on_switch = on_switch
+        self.config = config or SwitcherConfig()
+        self.current_algorithm = current_algorithm
+        self.hashrates: dict[str, float] = {}   # algorithm -> measured H/s
+        self.switches = 0
+        self.last_switch = 0.0
+        self._task: asyncio.Task | None = None
+
+    def record_hashrate(self, algorithm: str, hashrate: float) -> None:
+        self.hashrates[algorithm] = hashrate
+
+    def _effective_hashrates(self) -> dict[str, float]:
+        """Measured rates, falling back to registry planning rates
+        (reference: engine.go:1092-1104 hard-coded assumptions)."""
+        if self.config.implemented_only:
+            # non-canonical chains must never enter the race — including
+            # measured rates (mining x11 framework-internally records one);
+            # a non-switchable winner would wedge evaluate() into returning
+            # None forever instead of taking the next-best canonical switch
+            out = {
+                n: h for n, h in self.hashrates.items() if algos.switchable(n)
+            }
+        else:
+            out = dict(self.hashrates)
+        for name in algos.names(implemented_only=self.config.implemented_only):
+            if self.config.implemented_only and not algos.switchable(name):
+                continue
+            spec = algos.get(name)
+            if name not in out and spec.planning_hashrate > 0:
+                out[name] = spec.planning_hashrate
+        return out
+
+    def evaluate(self, now: float | None = None) -> ProfitEstimate | None:
+        """One switch decision. Returns the estimate if a switch should
+        happen, None otherwise."""
+        now = now if now is not None else time.time()
+        if now - self.last_switch < self.config.cooldown_seconds:
+            return None
+        best = self.analyzer.best(self._effective_hashrates())
+        if best is None or best.algorithm == self.current_algorithm:
+            return None
+        if self.config.implemented_only and not algos.switchable(best.algorithm):
+            # implemented-but-not-canonical (e.g. an uncertified x11 chain)
+            # would mine work the live network rejects — refuse the switch
+            return None
+        current_est = None
+        for coin, m in self.analyzer.metrics.items():
+            if m.algorithm == self.current_algorithm:
+                h = self._effective_hashrates().get(m.algorithm)
+                if h:
+                    est = self.analyzer.estimate(coin, h)
+                    if est and (current_est is None or est.profit_per_day > current_est.profit_per_day):
+                        current_est = est
+        if current_est is not None and current_est.profit_per_day > 0:
+            improvement = (
+                (best.profit_per_day - current_est.profit_per_day)
+                / current_est.profit_per_day * 100.0
+            )
+            if improvement < self.config.min_improvement_percent:
+                return None
+        return best
+
+    async def maybe_switch(self) -> bool:
+        best = self.evaluate()
+        if best is None:
+            return False
+        log.info(
+            "switching %s -> %s (%s, %.2f/day)",
+            self.current_algorithm, best.algorithm, best.coin, best.profit_per_day,
+        )
+        await self.on_switch(best.algorithm, best)
+        self.current_algorithm = best.algorithm
+        self.switches += 1
+        self.last_switch = time.time()
+        return True
+
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.config.interval_seconds)
+            try:
+                await self.maybe_switch()
+            except Exception:
+                log.exception("switch evaluation failed")
+
+    def snapshot(self) -> dict:
+        return {
+            "current_algorithm": self.current_algorithm,
+            "switches": self.switches,
+            "last_switch": self.last_switch,
+            "hashrates": dict(self.hashrates),
+        }

@@ -1,0 +1,4 @@
+"""Device runtime: census, nonce partitioning, batched search drivers, and
+the multi-chip mesh layer (reference parity: internal/hardware detection,
+internal/mining/hardware_accelerated.go batch pipeline, internal/gpu/multi_gpu.go
+load balancing — redesigned around XLA dispatch instead of worker threads)."""

@@ -1,0 +1,76 @@
+"""Device census and backend selection.
+
+The reference enumerates CPUs/GPUs/ASICs with vendor heuristics
+(reference: internal/mining/hardware_detector.go:43 ``DetectHardware``, with
+per-model compute-unit tables :150-233, and internal/hardware monitors).
+TPU-native equivalent: ask the XLA backend for its device list, classify by
+platform, and expose capability hints (which search backend to use, how many
+lanes a batch should have) instead of clock tables.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Literal
+
+BackendKind = Literal["pallas-tpu", "xla", "native-cpu"]
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceInfo:
+    """One usable compute device."""
+
+    index: int
+    platform: str          # "tpu" | "cpu" | "gpu"
+    kind: str              # device_kind string from XLA (e.g. "TPU v5 lite")
+    backend: BackendKind   # preferred search backend
+    # sizing hint: nonces per dispatch that keep the device busy ~100ms
+    preferred_batch: int
+
+
+def detect_devices() -> list[DeviceInfo]:
+    """Enumerate JAX devices; never raises (returns a CPU fallback entry)."""
+    import jax
+
+    out: list[DeviceInfo] = []
+    try:
+        devices = jax.devices()
+    except Exception:
+        devices = []
+    for d in devices:
+        if d.platform == "tpu":
+            out.append(
+                DeviceInfo(
+                    index=d.id,
+                    platform="tpu",
+                    kind=getattr(d, "device_kind", "tpu"),
+                    backend="pallas-tpu",
+                    preferred_batch=1 << 26,
+                )
+            )
+        else:
+            out.append(
+                DeviceInfo(
+                    index=d.id,
+                    platform=d.platform,
+                    kind=getattr(d, "device_kind", d.platform),
+                    backend="xla",
+                    preferred_batch=1 << 18,
+                )
+            )
+    if not out:
+        out.append(
+            DeviceInfo(
+                index=0,
+                platform="cpu",
+                kind="host",
+                backend="native-cpu",
+                preferred_batch=1 << 16,
+            )
+        )
+    return out
+
+
+def host_cpu_count() -> int:
+    return os.cpu_count() or 1

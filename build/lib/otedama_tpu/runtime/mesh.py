@@ -1,0 +1,330 @@
+"""Multi-chip pod search over a ``jax.sharding.Mesh`` — the product path.
+
+The reference scales across devices with a load balancer handing nonce
+ranges to GPU workers (reference: internal/gpu/multi_gpu.go:15-112
+``MultiGPUManager``/``LoadBalancer``) and across hosts by stratum extranonce
+partitioning (internal/stratum/unified_stratum.go:690-714). The TPU-native
+design collapses both into one SPMD program over a 2D ``(host, chip)`` mesh:
+
+- the **chip axis** strides the nonce space: chip ``c`` of a row searches
+  ``[base + c*per_chip, ...)`` — a static partition (the search is perfectly
+  uniform, so no load balancer is needed). On TPU each chip runs the Pallas
+  kernel (``kernels.sha256_pallas``); off-TPU an exact jnp twin with the
+  same flagged-tile output contract runs instead, so the SPMD program
+  compiles and executes on virtual CPU meshes in CI;
+- the **host axis** is the extranonce partition *for real*: each row
+  searches a different extranonce2's header — the caller supplies one
+  ``JobConstants`` per row (midstate genuinely rebuilt per extranonce2 by
+  ``engine.jobs.job_constants``), stacked and sharded along ``host``;
+- per-chip telemetry reduces over **ICI** (``psum``/``pmin`` across both
+  axes) inside the compiled step, so the pod reports one aggregate best
+  hash / flag count — the BASELINE north star of the pod surfacing as a
+  single worker;
+- winner recovery mirrors the single-chip driver: the device flags *tiles*,
+  the host re-scans each flagged tile exactly against that row's job.
+
+``PodBackend`` adapts this to the engine's backend protocol: it advertises
+``en2_fanout = n_hosts`` so the engine rolls that many extranonce2 spaces
+per search call and gets one ``SearchResult`` per space back.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from otedama_tpu.kernels import sha256_jax as sj
+from otedama_tpu.kernels import sha256_pallas as sp
+from otedama_tpu.kernels import target as tgt
+from otedama_tpu.runtime.search import (
+    JobConstants,
+    SearchResult,
+    Winner,
+    XlaBackend,
+)
+
+NO_WINNER = np.uint32(0xFFFFFFFF)
+_SIGN = np.uint32(0x80000000)
+K = sp.K_WINNERS
+
+
+def _flip(x):
+    """uint32 -> order-isomorphic int32 (for signed min/compare lowering)."""
+    return (x ^ jnp.uint32(_SIGN)).astype(jnp.int32)
+
+
+def _unflip(x):
+    return x.astype(jnp.uint32) ^ jnp.uint32(_SIGN)
+
+
+def _local_tiles_jnp(midstate8, tail3, t0_limb, base, *, batch: int,
+                     tile: int, rolled: bool):
+    """Exact jnp search with the same flagged-tile contract as the Pallas
+    kernel: returns ``(win_tile[K], win_min[K], stats[3])`` where stats =
+    [n_flagged_tiles, 0, min_hash_hi]."""
+    nonces = base + jax.lax.iota(jnp.uint32, batch)
+    d = sj.sha256d_from_midstate(
+        tuple(midstate8[i] for i in range(8)),
+        (tail3[0], tail3[1], tail3[2]),
+        nonces,
+        rolled=rolled,
+    )
+    h = sj.digest_words_to_compare_order(d)
+    mins = _flip(h[0]).reshape(batch // tile, tile).min(axis=1)
+    flags = mins <= _flip(t0_limb)
+    n = jnp.sum(flags.astype(jnp.uint32))
+    masked = jnp.where(flags, mins, jnp.int32(np.int32(0x7FFFFFFF)))
+    if masked.shape[0] < K:  # fewer tiles than table slots: pad
+        masked = jnp.pad(
+            masked, (0, K - masked.shape[0]),
+            constant_values=np.int32(0x7FFFFFFF),
+        )
+    order = jnp.argsort(masked)[:K]
+    return (
+        order.astype(jnp.uint32),
+        _unflip(masked[order]),
+        jnp.stack([n, jnp.uint32(0), _unflip(jnp.min(mins))]),
+    )
+
+
+def _local_tiles_pallas(midstate8, tail3, limbs8, base, *, batch: int,
+                        sub: int):
+    """TPU per-chip local: the production Pallas kernel under shard_map."""
+    job_words = jnp.concatenate([
+        midstate8.astype(jnp.uint32),
+        tail3.astype(jnp.uint32),
+        base[None].astype(jnp.uint32),
+        limbs8.astype(jnp.uint32),
+    ])
+    out = sp.sha256d_pallas_search(
+        job_words, batch=batch, sub=sub, interpret=False
+    )
+    return out.win_tile, out.win_min, out.stats
+
+
+def make_pod_mesh(devices=None, n_hosts: int = 1) -> Mesh:
+    """(host, chip) mesh over the given devices. ``n_hosts`` rows model
+    DCN-connected slices (each row = one extranonce2 space); on real
+    hardware rows map to slices, in tests both axes live on the virtual
+    CPU mesh."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_hosts <= 0 or len(devices) % n_hosts:
+        raise ValueError(
+            f"{len(devices)} devices do not split into {n_hosts} host rows"
+        )
+    arr = np.array(devices).reshape(n_hosts, len(devices) // n_hosts)
+    return Mesh(arr, ("host", "chip"))
+
+
+def make_chip_mesh(devices=None, axis: str = "chips") -> Mesh:
+    """1D chip mesh (kept for single-row pods / tests)."""
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.array(devices), (axis,))
+
+
+@dataclasses.dataclass
+class PodSearch:
+    """SPMD nonce search across a (host, chip) mesh.
+
+    ``search_jobs(jcs, base, count)`` searches the nonce range
+    ``[base, base+count)`` of EVERY row's job (one job per host row, each a
+    different extranonce2 header), the range split across that row's chips,
+    and returns one ``SearchResult`` per row. 1D meshes are treated as a
+    single row.
+    """
+
+    mesh: Mesh
+    sub: int = 32               # Pallas tile second-minor (TPU path)
+    jnp_tile: int = 1024        # flagged-tile granularity (CPU/jnp path)
+    use_pallas: bool | None = None  # None = pallas iff running on TPU
+    rolled: bool | None = None      # jnp path: rolled rounds off-TPU
+
+    def __post_init__(self):
+        names = self.mesh.axis_names
+        if len(names) == 1:
+            self._axes = (names[0],)
+            self.n_hosts, self.n_chips = 1, self.mesh.shape[names[0]]
+        elif len(names) == 2:
+            self._axes = tuple(names)
+            self.n_hosts = self.mesh.shape[names[0]]
+            self.n_chips = self.mesh.shape[names[1]]
+        else:
+            raise ValueError("PodSearch wants a 1D (chip) or 2D (host, chip) mesh")
+        if self.use_pallas is None:
+            self.use_pallas = jax.default_backend() == "tpu"
+        if self.rolled is None:
+            self.rolled = jax.default_backend() != "tpu"
+        self.tile = self.sub * 128 if self.use_pallas else self.jnp_tile
+        self._steps: dict[int, callable] = {}
+        self._rescan = XlaBackend(chunk=min(max(self.tile, 1 << 10), 1 << 14))
+        self._rescan_full = XlaBackend(chunk=1 << 18)
+
+    # -- compiled step -------------------------------------------------------
+
+    def _build_step(self, per_chip: int):
+        axes = self._axes
+        chip_axis = axes[-1]
+        host_spec = P(axes[0]) if len(axes) == 2 else P()
+        use_pallas, sub = self.use_pallas, self.sub
+        tile, rolled = self.tile, self.rolled
+
+        @functools.partial(
+            shard_map,
+            mesh=self.mesh,
+            in_specs=(host_spec, host_spec, P(), P()),
+            out_specs=(
+                P(*axes), P(*axes), P(*axes),  # per-(row,chip) K-tables
+                P(), P(),                      # pod-aggregated telemetry
+            ),
+            # vma-typing is off: pallas_call's out_shape structs carry no
+            # vma, and the host-sharded job words legitimately meet
+            # chip-varying nonces inside the local search
+            check_vma=False,
+        )
+        def _step(midstates, tails, limbs8, base):
+            # midstates: (1, 8) local row slice; tails: (1, 3)
+            ms = midstates[0]
+            tl = tails[0]
+            chip = jax.lax.axis_index(chip_axis).astype(jnp.uint32)
+            my_base = base + chip * jnp.uint32(per_chip)
+            if use_pallas:
+                wt, wm, st = _local_tiles_pallas(
+                    ms, tl, limbs8, my_base, batch=per_chip, sub=sub
+                )
+            else:
+                wt, wm, st = _local_tiles_jnp(
+                    ms, tl, limbs8[0], my_base, batch=per_chip,
+                    tile=tile, rolled=rolled,
+                )
+            # ICI reductions: the pod reports aggregate telemetry as ONE
+            # worker (psum/pmin ride the interconnect, never the host)
+            pod_flagged = jax.lax.psum(st[0], axes)
+            pod_best = _unflip(jax.lax.pmin(_flip(st[2]), axes))
+            shape = (1, 1, K) if len(axes) == 2 else (1, K)
+            sshape = (1, 1, 3) if len(axes) == 2 else (1, 3)
+            return (
+                wt.reshape(shape), wm.reshape(shape), st.reshape(sshape),
+                pod_flagged, pod_best,
+            )
+
+        return jax.jit(_step)
+
+    def _step_for(self, per_chip: int):
+        step = self._steps.get(per_chip)
+        if step is None:
+            step = self._steps[per_chip] = self._build_step(per_chip)
+        return step
+
+    # -- public API ----------------------------------------------------------
+
+    def search_jobs(
+        self, jcs: list[JobConstants], base: int, count: int
+    ) -> list[SearchResult]:
+        if len(jcs) != self.n_hosts:
+            raise ValueError(f"need {self.n_hosts} jobs (one per host row), got {len(jcs)}")
+        # all rows share one target (same job difficulty across extranonces)
+        limbs = jcs[0].limbs
+        per_chip = -(-count // self.n_chips)              # ceil
+        per_chip = -(-per_chip // self.tile) * self.tile  # round up to tiles
+        scanned = per_chip * self.n_chips                 # >= count (overscan)
+
+        ms = jnp.asarray(
+            np.stack([np.array(jc.midstate, dtype=np.uint32) for jc in jcs])
+        )
+        tl = jnp.asarray(
+            np.stack([np.array(jc.tail, dtype=np.uint32) for jc in jcs])
+        )
+        out = self._step_for(per_chip)(
+            ms, tl, jnp.asarray(limbs), jnp.uint32(base & 0xFFFFFFFF)
+        )
+        wt, wm, st, pod_flagged, pod_best = (np.asarray(o) for o in out)
+        if wt.ndim == 2:  # 1D mesh: add the row axis
+            wt, wm, st = wt[None], wm[None], st[None]
+        self.last_pod_flagged = int(pod_flagged)
+        self.last_pod_best = int(pod_best)
+
+        results: list[SearchResult] = []
+        for r, jc in enumerate(jcs):
+            winners: list[Winner] = []
+            row_best = 0xFFFFFFFF
+            for c in range(self.n_chips):
+                n_flagged = int(st[r, c, 0])
+                row_best = min(row_best, int(st[r, c, 2]))
+                chip_base = (base + c * per_chip) & 0xFFFFFFFF
+                if n_flagged > K:
+                    res = self._rescan_full.search(jc, chip_base, per_chip)
+                    winners.extend(res.winners)
+                    continue
+                for s in range(n_flagged):
+                    tile_base = (chip_base + int(wt[r, c, s]) * self.tile) & 0xFFFFFFFF
+                    res = self._rescan.search(jc, tile_base, self.tile)
+                    winners.extend(res.winners)
+            if scanned != count:
+                winners = [
+                    w for w in winners
+                    if ((w.nonce_word - base) & 0xFFFFFFFF) < count
+                ]
+            # dedupe (overscan rescans can overlap across chip boundaries)
+            seen: set[int] = set()
+            uniq = []
+            for w in winners:
+                if w.nonce_word not in seen:
+                    seen.add(w.nonce_word)
+                    uniq.append(w)
+            results.append(SearchResult(uniq, count, row_best))
+        return results
+
+    def search(self, jc: JobConstants, base: int, count: int | None = None) -> SearchResult:
+        """Single-job convenience (1-row meshes)."""
+        if self.n_hosts != 1:
+            raise ValueError("search() is for 1-row meshes; use search_jobs()")
+        if count is None:
+            count = self.n_chips * self.tile
+        return self.search_jobs([jc], base, count)[0]
+
+
+class PodBackend:
+    """Engine-facing pod device: every chip of the mesh behind ONE backend.
+
+    Advertises ``en2_fanout`` so the engine hands it one job-constants per
+    host row (each a different extranonce2 header with a freshly built
+    midstate) and receives per-row results — reference parity with the
+    extranonce partition of internal/stratum/unified_stratum.go:690-714 and
+    the multi-device fan-out of internal/gpu/multi_gpu.go:15-112.
+    """
+
+    algorithm = "sha256d"
+
+    def __init__(self, mesh: Mesh | None = None, n_hosts: int | None = None,
+                 **pod_kwargs):
+        if mesh is None:
+            devices = jax.devices()
+            if n_hosts is None:
+                n_hosts = 2 if len(devices) % 2 == 0 and len(devices) > 1 else 1
+            mesh = make_pod_mesh(devices, n_hosts)
+        self.pod = PodSearch(mesh, **pod_kwargs)
+        self.en2_fanout = self.pod.n_hosts
+        self.name = f"pod{self.pod.n_hosts}x{self.pod.n_chips}"
+
+    def search_multi(
+        self, jcs: list[JobConstants], base: int, count: int
+    ) -> list[SearchResult]:
+        return self.pod.search_jobs(jcs, base, count)
+
+    def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
+        if self.en2_fanout != 1:
+            raise ValueError(
+                f"{self.name} searches {self.en2_fanout} extranonce spaces "
+                "per call; use search_multi()"
+            )
+        return self.pod.search_jobs([jc], base, count)[0]

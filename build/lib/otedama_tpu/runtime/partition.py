@@ -1,0 +1,80 @@
+"""Nonce-space partitioning.
+
+Two nested levels, mirroring the reference's scheme:
+
+1. **Intra-job nonce ranges** — the 2^32 nonce space of one header split
+   across workers/devices (reference: internal/mining/hardware_accelerated.go
+   :305-321 ``distributeNonceRanges``). On TPU a "worker" is a chip and a
+   range is consumed in kernel-batch strides.
+2. **Extranonce partitioning** — disjoint search spaces across hosts/pods by
+   varying extranonce2 in the coinbase, which changes the merkle root and
+   therefore the whole header (reference: the stratum server assigns each
+   client a unique extranonce1, internal/stratum/unified_stratum.go:690-714).
+   Exhausting the 32-bit nonce space rolls extranonce2.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections.abc import Iterator
+
+NONCE_SPACE = 1 << 32
+
+
+@dataclasses.dataclass(frozen=True)
+class NonceRange:
+    """A half-open range [start, start+count) in the uint32 nonce space."""
+
+    start: int
+    count: int
+
+    def batches(self, batch: int) -> Iterator[tuple[int, int]]:
+        """Yield (base, n) strides of at most ``batch`` nonces."""
+        off = self.start
+        remaining = self.count
+        while remaining > 0:
+            n = min(batch, remaining)
+            yield off & 0xFFFFFFFF, n
+            off += n
+            remaining -= n
+
+
+def split_nonce_space(parts: int, *, space: int = NONCE_SPACE) -> list[NonceRange]:
+    """Split the nonce space into ``parts`` contiguous, disjoint, covering
+    ranges. Remainders go to the leading ranges so sizes differ by <= 1."""
+    if parts <= 0:
+        raise ValueError("parts must be positive")
+    base, extra = divmod(space, parts)
+    out = []
+    start = 0
+    for i in range(parts):
+        count = base + (1 if i < extra else 0)
+        out.append(NonceRange(start, count))
+        start += count
+    return out
+
+
+@dataclasses.dataclass
+class ExtranonceCounter:
+    """Rolls extranonce2 values for a worker; each value opens a fresh
+    2^32 nonce space. ``size`` is the extranonce2 byte width from the pool's
+    subscribe response."""
+
+    size: int = 4
+    value: int = 0
+
+    def current(self) -> bytes:
+        return self.value.to_bytes(self.size, "big")
+
+    def roll(self) -> bytes:
+        self.value = (self.value + 1) % (1 << (8 * self.size))
+        return self.current()
+
+
+def pod_partition(
+    n_chips: int, *, chip_index: int, batch: int
+) -> tuple[int, int]:
+    """Static per-chip stride partition: chip ``i`` of ``n`` searches bases
+    ``i*batch, i*batch + n*batch, ...`` — disjoint by construction and
+    contiguous per dispatch so the on-device iota stays dense."""
+    return chip_index * batch, n_chips * batch

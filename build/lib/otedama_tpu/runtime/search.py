@@ -1,0 +1,413 @@
+"""Batched nonce-search drivers (single device).
+
+The TPU replacement for the reference's per-worker hot loop
+(reference: internal/mining/workers.go:330-401 ``processJobReal`` assembles an
+80-byte header and hashes nonce-by-nonce; internal/mining/hardware_accelerated.go
+:51-114 batches headers through pools). Here the host prepares per-job
+constants once (midstate, tail words, target limbs) and the device consumes
+the nonce space in large strides:
+
+- ``PallasBackend`` — the TPU hot path (``kernels.sha256_pallas``): device
+  returns per-tile candidate winners under a top-limb filter; the host
+  validates candidates exactly against the 256-bit target (hashlib) and
+  rescans a tile with the XLA path when several candidates landed in it.
+- ``XlaBackend`` — pure-jnp exact search; correctness oracle, CPU/GPU
+  fallback, and the path used inside the multi-chip CPU-mesh tests.
+
+Winner nonces use the kernel word convention: ``nonce_word`` is the
+big-endian read of header bytes 76:80 (wire bytes = pack(">I", nonce_word)).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from otedama_tpu.kernels import sha256_jax as sj
+from otedama_tpu.kernels import sha256_pallas as sp
+from otedama_tpu.kernels import target as tgt
+from otedama_tpu.utils import sha256_host as sh
+
+
+@dataclasses.dataclass(frozen=True)
+class JobConstants:
+    """Per-job device constants, derived from the first 76 header bytes."""
+
+    header76: bytes
+    target: int
+    midstate: tuple[int, ...]
+    tail: tuple[int, int, int]
+    limbs: np.ndarray  # uint32[8], most-significant-first
+
+    @classmethod
+    def from_header_prefix(cls, header76: bytes, target: int) -> "JobConstants":
+        if len(header76) != 76:
+            raise ValueError(f"need 76 header bytes, got {len(header76)}")
+        return cls(
+            header76=bytes(header76),
+            target=target,
+            midstate=sh.midstate(header76[:64]),
+            tail=struct.unpack(">3I", header76[64:76]),
+            limbs=tgt.target_to_limbs(target),
+        )
+
+    def header_for(self, nonce_word: int) -> bytes:
+        return self.header76 + struct.pack(">I", nonce_word)
+
+    def digest_for(self, nonce_word: int) -> bytes:
+        return sh.sha256d(self.header_for(nonce_word))
+
+
+@dataclasses.dataclass(frozen=True)
+class Winner:
+    nonce_word: int
+    digest: bytes  # 32-byte sha256d of the full header
+
+    @property
+    def nonce_hex(self) -> str:
+        return struct.pack(">I", self.nonce_word).hex()
+
+
+@dataclasses.dataclass
+class SearchResult:
+    winners: list[Winner]
+    hashes: int
+    best_hash_hi: int  # min top compare limb observed (best-share telemetry)
+
+    def merge(self, other: "SearchResult") -> "SearchResult":
+        return SearchResult(
+            winners=self.winners + other.winners,
+            hashes=self.hashes + other.hashes,
+            best_hash_hi=min(self.best_hash_hi, other.best_hash_hi),
+        )
+
+
+@functools.partial(jax.jit, static_argnames=("n", "rolled"))
+def _xla_search_step(midstate8, tail3, base, limbs8, *, n: int, rolled: bool):
+    nonces = base + jax.lax.iota(jnp.uint32, n)
+    d = sj.sha256d_from_midstate(
+        tuple(midstate8[i] for i in range(8)),
+        (tail3[0], tail3[1], tail3[2]),
+        nonces,
+        rolled=rolled,
+    )
+    h = sj.digest_words_to_compare_order(d)
+    hits = sj.le256(h, tuple(limbs8[i] for i in range(8)))
+    return hits, h[0]
+
+
+def _default_rolled() -> bool:
+    """Unrolled rounds on TPU (throughput), rolled elsewhere (compile time —
+    the single-core CI box pays ~minutes per unrolled XLA-CPU compile)."""
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:  # pragma: no cover
+        return True
+
+
+def _chunked_search(
+    jc: JobConstants,
+    base: int,
+    count: int,
+    chunk: int,
+    step,
+    digest_fn,
+    verify: bool = False,
+) -> SearchResult:
+    """Shared chunked-search driver: fixed-shape device steps with overscan,
+    best-limb telemetry, and host-side winner digestion.
+
+    ``step(base) -> (hits, h0)`` runs one device batch of ``chunk`` lanes;
+    ``digest_fn(nonce_word) -> bytes`` produces the candidate's digest on the
+    host; ``verify`` re-checks candidates against the exact 256-bit target
+    (for steps whose device filter is approximate).
+    """
+    winners: list[Winner] = []
+    best = 0xFFFFFFFF
+    done = 0
+    while done < count:
+        hits, h0 = step((base + done) & 0xFFFFFFFF)
+        hits = np.asarray(hits)
+        h0 = np.asarray(h0)
+        valid = min(chunk, count - done)
+        best = min(best, int(h0[:valid].min()))
+        for idx in np.nonzero(hits[:valid])[0].tolist():
+            w = (base + done + idx) & 0xFFFFFFFF
+            digest = digest_fn(w)
+            if not verify or tgt.hash_meets_target(digest, jc.target):
+                winners.append(Winner(w, digest))
+        done += valid
+    return SearchResult(winners, count, best)
+
+
+def _scalar_search(
+    jc: JobConstants, base: int, count: int, digest_fn
+) -> SearchResult:
+    """Shared pure-host search loop (protocol-test oracles)."""
+    winners: list[Winner] = []
+    best = 0xFFFFFFFF
+    for i in range(count):
+        w = (base + i) & 0xFFFFFFFF
+        digest = digest_fn(w)
+        best = min(best, int.from_bytes(digest[28:32], "little"))
+        if tgt.hash_meets_target(digest, jc.target):
+            winners.append(Winner(w, digest))
+    return SearchResult(winners, count, best)
+
+
+class XlaBackend:
+    """Exact jnp/XLA search; works on any JAX backend."""
+
+    name = "xla"
+
+    def __init__(self, chunk: int = 1 << 16, rolled: bool | None = None):
+        self.chunk = chunk
+        self.rolled = _default_rolled() if rolled is None else rolled
+
+    def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
+        ms = jnp.asarray(np.array(jc.midstate, dtype=np.uint32))
+        tl = jnp.asarray(np.array(jc.tail, dtype=np.uint32))
+        lb = jnp.asarray(jc.limbs)
+
+        def step(b):
+            return _xla_search_step(
+                ms, tl, jnp.uint32(b), lb, n=self.chunk, rolled=self.rolled
+            )
+
+        return _chunked_search(
+            jc, base, count, self.chunk, step, jc.digest_for
+        )
+
+
+class PallasBackend:
+    """TPU hot path: Pallas kernel + host-side exact validation.
+
+    One device launch covers the whole requested range (the kernel walks
+    tiles with an in-kernel loop and returns a K-deep winner table), so the
+    engine can use 2^28..2^30 batches without per-chunk dispatch overhead.
+    """
+
+    name = "pallas-tpu"
+    # one launch absorbs a huge range with O(1) dispatch overhead; the
+    # engine auto-sizes its batches to this (EngineConfig.auto_batch).
+    # Measured engine-path rates vs the kernel's 1.03 GH/s e2e:
+    #   2^30 thread-pipelined: 0.75   2^31: 0.86   2^32: 0.72
+    # — thread-level pipelining cannot hide the per-launch sync on this
+    # platform (the blocking host transfer starves the next dispatch), so
+    # the engine instead calls search_group(), which dispatches a whole
+    # group of launches BEFORE the first sync (the pattern the raw bench
+    # uses); 2^31 x groups of 4 is the sweet spot
+    preferred_batch = 1 << 31
+
+    def __init__(self, sub: int = 32, interpret: bool | None = None):
+        self.sub = sub
+        self.interpret = interpret
+        self._rescan = XlaBackend(chunk=min(sub * 128, 1 << 14))
+        # overflow fallback covers the WHOLE batch: use big chunks so a
+        # 2^28-count rescan is hundreds of dispatches, not tens of thousands
+        self._rescan_full = XlaBackend(chunk=1 << 18)
+
+    @property
+    def tile(self) -> int:
+        return self.sub * 128
+
+    def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
+        return self.search_group(jc, [(base, count)])[0]
+
+    def search_group(
+        self, jc: JobConstants, batches: list[tuple[int, int]]
+    ) -> list[SearchResult]:
+        """Run several launches with ALL dispatches issued before the first
+        sync. On the tunneled platform a blocking transfer starves the next
+        dispatch (thread-level pipelining cannot hide it), so grouping is
+        what keeps the chip busy: per-group overhead is one sync instead of
+        one per launch. The engine feeds whole groups via one executor call.
+        """
+        outs = []
+        for base, count in batches:
+            tile = self.tile
+            batch = (count + tile - 1) // tile * tile  # overscan to tiles
+            jw = sp.pack_job_words(jc.midstate, jc.tail, base, jc.limbs)
+            outs.append(
+                sp.sha256d_pallas_search(
+                    jw, batch=batch, sub=self.sub, interpret=self.interpret
+                )
+            )
+        return [
+            self._collect(jc, base, count, out)
+            for (base, count), out in zip(batches, outs)
+        ]
+
+    def _collect(self, jc: JobConstants, base: int, count: int, out) -> SearchResult:
+        tile = self.tile
+        batch = (count + tile - 1) // tile * tile
+        # one host transfer on the common path: the tunneled platform pays
+        # a full RTT per fetch, so win_tile is only pulled when a tile
+        # actually hit (at production difficulty most launches have none)
+        st = np.asarray(out.stats)
+        n_hit_tiles, min_hash = int(st[0]), int(st[2])
+        wt = np.asarray(out.win_tile) if n_hit_tiles > 0 else None
+
+        winners: list[Winner] = []
+        if n_hit_tiles > sp.K_WINNERS:
+            # hit-tile table overflowed (only plausible at test-easy
+            # targets): fall back to an exact scan of the whole range
+            return self._rescan_full.search(jc, base, count)
+        for i in range(n_hit_tiles):
+            # the kernel flags tiles; winners come from an exact rescan of
+            # each flagged tile (sub*128 nonces — cheap on the XLA path)
+            tile_base = (base + int(wt[i]) * tile) & 0xFFFFFFFF
+            res = self._rescan.search(jc, tile_base, tile)
+            winners.extend(res.winners)
+        # drop overscan winners beyond the requested range
+        if batch != count:
+            winners = [
+                w
+                for w in winners
+                if ((w.nonce_word - base) & 0xFFFFFFFF) < count
+            ]
+        return SearchResult(winners, count, min_hash)
+
+
+class ScryptXlaBackend:
+    """Vectorized scrypt (N=1024,r=1,p=1) search on any JAX backend.
+
+    Consumes the same ``JobConstants`` as the sha256d backends but reads only
+    ``header76``/``target``/``limbs`` (scrypt has no midstate trick: the nonce
+    sits inside the PBKDF2 password, so the whole pipeline runs per lane).
+    Memory budget: the ROMix V tensor is 128 KiB/lane, so ``chunk`` lanes cost
+    ``chunk * 128 KiB`` of HBM (default 4096 lanes = 512 MiB).
+    """
+
+    name = "scrypt-xla"
+    algorithm = "scrypt"
+
+    def __init__(self, chunk: int = 1 << 12, rolled: bool | None = None):
+        self.chunk = chunk
+        self.rolled = _default_rolled() if rolled is None else rolled
+
+    def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
+        from otedama_tpu.kernels import scrypt_jax as sc
+
+        h19 = jnp.asarray(
+            np.array(sc.header_words19(jc.header76), dtype=np.uint32)
+        )
+        lb = jnp.asarray(jc.limbs)
+
+        def step(b):
+            return sc.scrypt_search_step(
+                h19, jnp.uint32(b), lb, n=self.chunk, rolled=self.rolled
+            )
+
+        return _chunked_search(
+            jc, base, count, self.chunk, step,
+            lambda w: sc.scrypt_digest_host(jc.header_for(w)),
+            verify=True,
+        )
+
+
+class ScryptPythonBackend:
+    """Scalar hashlib.scrypt search — protocol-test oracle."""
+
+    name = "scrypt-python"
+    algorithm = "scrypt"
+
+    def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
+        from otedama_tpu.kernels import scrypt_jax as sc
+
+        return _scalar_search(
+            jc, base, count, lambda w: sc.scrypt_digest_host(jc.header_for(w))
+        )
+
+
+class X11NumpyBackend:
+    """Vectorized x11 chained-hash search (lane-axis numpy pipeline).
+
+    The 11 stages run as batched numpy kernels; winner checks happen on the
+    final 32-byte digest with the usual LE-int target compare. P4 of
+    SURVEY.md's parallelism map: the multi-kernel pipeline executes as a
+    chain over the whole nonce batch, not per nonce.
+    """
+
+    name = "x11-numpy"
+    algorithm = "x11"
+
+    def __init__(self, chunk: int = 1 << 10):
+        self.chunk = chunk
+
+    def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
+        from otedama_tpu.kernels import x11
+
+        winners: list[Winner] = []
+        best = 0xFFFFFFFF
+        done = 0
+        prefix = np.frombuffer(jc.header76, dtype=np.uint8)
+        while done < count:
+            n = min(self.chunk, count - done)
+            headers = np.empty((n, 80), dtype=np.uint8)
+            headers[:, :76] = prefix
+            nonces = (base + done + np.arange(n, dtype=np.uint64)) & 0xFFFFFFFF
+            headers[:, 76:] = (
+                nonces.astype(">u4").view(np.uint8).reshape(n, 4)
+            )
+            digests = x11.x11_digest_batch(headers)
+            # LE-int compare: top limb = last 4 digest bytes, little-endian
+            hi = digests[:, 28:32].copy().view("<u4").reshape(n)
+            best = min(best, int(hi.min()))
+            top_limb = (jc.target >> 224) & 0xFFFFFFFF
+            for idx in np.nonzero(hi <= top_limb)[0].tolist():
+                digest = digests[idx].tobytes()
+                if tgt.hash_meets_target(digest, jc.target):
+                    winners.append(Winner(int(nonces[idx]), digest))
+            done += n
+        return SearchResult(winners, count, best)
+
+
+class PythonBackend:
+    """Pure-python hashlib search. Slow; the zero-dependency oracle used by
+    protocol-level tests and as a last-resort host fallback (the analogue of
+    the reference's stdlib-crypto CPU path, internal/mining/workers.go:330)."""
+
+    name = "python"
+
+    def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
+        return _scalar_search(jc, base, count, jc.digest_for)
+
+
+def make_backend(kind: str, algorithm: str = "sha256d", **kwargs):
+    if algorithm in ("sha256d", "sha256"):
+        if kind == "pod":
+            # every local chip behind one engine backend (runtime.mesh);
+            # late import: mesh itself imports this module
+            from otedama_tpu.runtime.mesh import PodBackend
+
+            return PodBackend(**kwargs)
+        if kind == "pallas-tpu":
+            return PallasBackend(**kwargs)
+        if kind == "xla":
+            return XlaBackend(**kwargs)
+        if kind == "python":
+            return PythonBackend(**kwargs)
+        if kind == "native-cpu":
+            try:
+                from otedama_tpu.native import NativeCpuBackend
+            except ImportError as e:
+                raise ValueError(
+                    "native-cpu backend unavailable (C++ extension not built; "
+                    f"run `make -C otedama_tpu/native`): {e}"
+                ) from None
+            return NativeCpuBackend(**kwargs)
+    elif algorithm == "scrypt":
+        if kind == "xla":
+            return ScryptXlaBackend(**kwargs)
+        if kind == "python":
+            return ScryptPythonBackend(**kwargs)
+    elif algorithm == "x11":
+        if kind == "numpy":
+            return X11NumpyBackend(**kwargs)
+    raise ValueError(f"no backend {kind!r} for algorithm {algorithm!r}")

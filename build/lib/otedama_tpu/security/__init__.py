@@ -1,0 +1,21 @@
+from otedama_tpu.security.auth import (
+    AuthManager,
+    Role,
+    TokenError,
+    totp_code,
+    totp_verify,
+)
+from otedama_tpu.security.ratelimit import RateLimiter, TokenBucket
+from otedama_tpu.security.zkp import SchnorrProver, SchnorrVerifier
+
+__all__ = [
+    "AuthManager",
+    "RateLimiter",
+    "Role",
+    "SchnorrProver",
+    "SchnorrVerifier",
+    "TokenBucket",
+    "TokenError",
+    "totp_code",
+    "totp_verify",
+]

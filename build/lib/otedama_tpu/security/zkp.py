@@ -1,0 +1,76 @@
+"""Schnorr zero-knowledge identification (discrete log in a Schnorr group).
+
+Reference parity: internal/auth/zkp.go:21-100 — the reference implements a
+Schnorr-style challenge/response so a miner can prove wallet ownership
+without sending a password. Here: the standard interactive Schnorr protocol
+made non-interactive with a Fiat-Shamir hash challenge, over a 2048-bit MODP
+group (RFC 3526 group 14, generator 2 — a public, nothing-up-my-sleeve
+modulus).
+
+Prover knows x with y = g^x mod p; proof of knowledge for a message m:
+  k random, r = g^k, c = H(r || y || m), s = k + c*x mod q  ->  (r, s)
+Verifier checks g^s == r * y^c (mod p).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import secrets
+
+# RFC 3526 MODP group 14 (2048-bit), generator 2
+P_HEX = (
+    "FFFFFFFFFFFFFFFFC90FDAA22168C234C4C6628B80DC1CD129024E088A67CC74"
+    "020BBEA63B139B22514A08798E3404DDEF9519B3CD3A431B302B0A6DF25F1437"
+    "4FE1356D6D51C245E485B576625E7EC6F44C42E9A637ED6B0BFF5CB6F406B7ED"
+    "EE386BFB5A899FA5AE9F24117C4B1FE649286651ECE45B3DC2007CB8A163BF05"
+    "98DA48361C55D39A69163FA8FD24CF5F83655D23DCA3AD961C62F356208552BB"
+    "9ED529077096966D670C354E4ABC9804F1746C08CA18217C32905E462E36CE3B"
+    "E39E772C180E86039B2783A2EC07A28FB5C55DF06F4C52C9DE2BCBF695581718"
+    "3995497CEA956AE515D2261898FA051015728E5A8AACAA68FFFFFFFFFFFFFFFF"
+)
+P = int(P_HEX, 16)
+G = 2
+Q = (P - 1) // 2  # group 14 is a safe-prime group
+
+
+def _challenge(r: int, y: int, message: bytes) -> int:
+    h = hashlib.sha256()
+    for part in (r, y):
+        h.update(part.to_bytes(256, "big"))
+    h.update(message)
+    return int.from_bytes(h.digest(), "big") % Q
+
+
+class SchnorrProver:
+    def __init__(self, secret: int | None = None):
+        self.x = secret if secret is not None else secrets.randbelow(Q - 1) + 1
+        self.y = pow(G, self.x, P)
+
+    @classmethod
+    def from_passphrase(cls, passphrase: str, salt: bytes = b"otedama-zkp") -> "SchnorrProver":
+        digest = hashlib.scrypt(
+            passphrase.encode(), salt=salt, n=16384, r=8, p=1,
+            maxmem=64 * 1024 * 1024, dklen=64,
+        )
+        return cls(int.from_bytes(digest, "big") % (Q - 1) + 1)
+
+    def prove(self, message: bytes) -> tuple[int, int]:
+        k = secrets.randbelow(Q - 1) + 1
+        r = pow(G, k, P)
+        c = _challenge(r, self.y, message)
+        s = (k + c * self.x) % Q
+        return r, s
+
+
+class SchnorrVerifier:
+    def __init__(self, public: int):
+        if not (1 < public < P):
+            raise ValueError("public key out of range")
+        self.y = public
+
+    def verify(self, message: bytes, proof: tuple[int, int]) -> bool:
+        r, s = proof
+        if not (1 < r < P) or not (0 <= s < Q):
+            return False
+        c = _challenge(r, self.y, message)
+        return pow(G, s, P) == (r * pow(self.y, c, P)) % P

@@ -1,0 +1,25 @@
+from otedama_tpu.stratum.protocol import (
+    Message,
+    StratumError,
+    decode_line,
+    encode_line,
+    job_from_notify,
+    notify_params,
+    submit_params,
+)
+from otedama_tpu.stratum.client import StratumClient, ClientConfig
+from otedama_tpu.stratum.server import StratumServer, ServerConfig
+
+__all__ = [
+    "Message",
+    "StratumError",
+    "decode_line",
+    "encode_line",
+    "job_from_notify",
+    "notify_params",
+    "submit_params",
+    "StratumClient",
+    "ClientConfig",
+    "StratumServer",
+    "ServerConfig",
+]

@@ -1,0 +1,173 @@
+"""HTTP getwork server for legacy miners.
+
+Reference parity: internal/protocol/getwork.go:133-244 (getwork /
+submitwork JSON-RPC over HTTP). The legacy getwork protocol hands a miner
+the full 128-byte padded header (hex, with the SHA-256 padding baked in)
+and a target; the miner returns the header with its nonce filled in.
+
+Data layout quirk (bitcoin getwork heritage): the "data" field is the
+80-byte header + SHA-256 padding, with every 4-byte word byte-swapped.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import secrets
+import struct
+import time
+from typing import Awaitable, Callable
+
+from otedama_tpu.api.http import HttpServer, Request, Response
+from otedama_tpu.engine import jobs as jobmod
+from otedama_tpu.engine.types import Job
+from otedama_tpu.kernels import target as tgt
+from otedama_tpu.utils.pow_host import pow_digest
+
+log = logging.getLogger("otedama.stratum.getwork")
+
+
+def _swap_words(data: bytes) -> bytes:
+    return b"".join(
+        data[i : i + 4][::-1] for i in range(0, len(data), 4)
+    )
+
+
+def encode_work_data(header80: bytes) -> str:
+    # 128 bytes total: header + 0x80 marker + zeros + 64-bit BE bit length
+    padding = b"\x80" + b"\x00" * 39 + (640).to_bytes(8, "big")
+    padded = header80 + padding
+    assert len(padded) == 128
+    return _swap_words(padded).hex()
+
+
+def decode_work_data(data_hex: str) -> bytes:
+    raw = _swap_words(bytes.fromhex(data_hex))
+    return raw[:80]
+
+
+@dataclasses.dataclass
+class GetworkConfig:
+    host: str = "127.0.0.1"
+    port: int = 8332
+    share_difficulty: float = 1.0
+    work_expiry: float = 300.0
+
+
+ShareHook = Callable[[str, bytes, bytes], Awaitable[None]]  # worker, header, digest
+
+
+class GetworkServer:
+    """Legacy HTTP work server bridging into the job pipeline."""
+
+    def __init__(self, config: GetworkConfig | None = None,
+                 on_share: ShareHook | None = None):
+        self.config = config or GetworkConfig()
+        self.on_share = on_share
+        self.http = HttpServer(self.config.host, self.config.port)
+        self.http.route("POST", "/", self._rpc)
+        self.current_job: Job | None = None
+        # issued work: header76 -> (job_id, issued_at, algorithm). The
+        # algorithm is captured at ISSUE time: work stays valid for
+        # work_expiry seconds, during which a profit switch may change
+        # current_job.algorithm — submitted solutions must be hashed with
+        # the algorithm the miner was actually told to mine.
+        self._issued: dict[bytes, tuple[str, float, str]] = {}
+        self._seen_solutions: set[bytes] = set()
+        self.stats = {"work_issued": 0, "shares_accepted": 0, "shares_rejected": 0}
+
+    async def start(self) -> None:
+        await self.http.start()
+
+    async def stop(self) -> None:
+        await self.http.stop()
+
+    @property
+    def port(self) -> int:
+        return self.http.port
+
+    def set_job(self, job: Job) -> None:
+        self.current_job = job
+
+    def _share_target(self) -> int:
+        return tgt.difficulty_to_target(self.config.share_difficulty)
+
+    async def _rpc(self, request: Request) -> Response:
+        try:
+            body = request.json() or {}
+        except ValueError:
+            return Response.json({"error": "bad json", "result": None, "id": None}, 400)
+        rid = body.get("id")
+        method = body.get("method", "getwork")
+        params = body.get("params") or []
+        if method not in ("getwork", "submitwork"):
+            return Response.json(
+                {"result": None, "error": f"unknown method {method}", "id": rid}, 404
+            )
+        if method == "submitwork" or params:
+            return await self._submit(params, rid, request)
+        return self._getwork(rid)
+
+    def _getwork(self, rid) -> Response:
+        job = self.current_job
+        if job is None:
+            return Response.json(
+                {"result": None, "error": "no work available", "id": rid}, 503
+            )
+        extranonce2 = secrets.token_bytes(job.extranonce2_size)
+        header76 = jobmod.build_header_prefix(job, extranonce2)
+        now = time.time()
+        self._issued[header76] = (job.job_id, now, job.algorithm)
+        if len(self._issued) > 4096:
+            cutoff = now - self.config.work_expiry
+            self._issued = {
+                h: rec for h, rec in self._issued.items() if rec[1] > cutoff
+            }
+            while len(self._issued) > 4096:  # hard cap: evict oldest
+                oldest = min(self._issued, key=lambda h: self._issued[h][1])
+                del self._issued[oldest]
+        self.stats["work_issued"] += 1
+        return Response.json({
+            "result": {
+                "data": encode_work_data(header76 + b"\x00\x00\x00\x00"),
+                "target": self._share_target().to_bytes(32, "little").hex(),
+            },
+            "error": None,
+            "id": rid,
+        })
+
+    async def _submit(self, params: list, rid, request: Request) -> Response:
+        if not params or not isinstance(params[0], str):
+            return Response.json(
+                {"result": False, "error": "missing work data", "id": rid}, 400
+            )
+        try:
+            header = decode_work_data(params[0])
+        except ValueError:
+            return Response.json(
+                {"result": False, "error": "malformed work data", "id": rid}, 400
+            )
+        issued = self._issued.get(header[:76])
+        if issued is None or time.time() - issued[1] > self.config.work_expiry:
+            self.stats["shares_rejected"] += 1
+            return Response.json({"result": False, "error": "stale or unknown work", "id": rid})
+        if header in self._seen_solutions:
+            self.stats["shares_rejected"] += 1
+            return Response.json({"result": False, "error": "duplicate", "id": rid})
+        algorithm = issued[2]
+        digest = pow_digest(header, algorithm)
+        if not tgt.hash_meets_target(digest, self._share_target()):
+            self.stats["shares_rejected"] += 1
+            return Response.json({"result": False, "error": "high-hash", "id": rid})
+        # dedup exact solutions only: the same work unit may legitimately
+        # yield several distinct share-target nonces
+        self._seen_solutions.add(header)
+        if len(self._seen_solutions) > 8192:
+            self._seen_solutions = set(list(self._seen_solutions)[-4096:])
+        self.stats["shares_accepted"] += 1
+        if self.on_share is not None:
+            await self.on_share(request.peer, header, digest)
+        return Response.json({"result": True, "error": None, "id": rid})
+
+    def snapshot(self) -> dict:
+        return dict(self.stats)

@@ -1,0 +1,214 @@
+"""Stratum proxy: aggregate many downstream miners behind one upstream slot.
+
+Reference parity: internal/proxy/proxy.go (stratum proxy/aggregator). The
+proxy runs a full StratumServer toward downstream miners and a single
+StratumClient toward the upstream pool; upstream jobs re-broadcast
+downstream with the *proxy's* extranonce1 replaced per-session (the proxy
+claims extranonce2 space from the upstream and carves it into
+(session_prefix || miner_extranonce2) so downstream search spaces stay
+disjoint inside the upstream's allocation).
+
+Share flow: downstream submit -> local validation (server-side, cheap
+reject of junk) -> re-submit upstream with the reconstructed extranonce2.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+
+from otedama_tpu.engine.types import Job, Share
+from otedama_tpu.stratum.client import ClientConfig, StratumClient
+from otedama_tpu.stratum.server import AcceptedShare, ServerConfig, StratumServer
+
+log = logging.getLogger("otedama.stratum.proxy")
+
+
+@dataclasses.dataclass
+class ProxyConfig:
+    listen_host: str = "0.0.0.0"
+    listen_port: int = 3334
+    upstream: ClientConfig = dataclasses.field(default_factory=ClientConfig)
+    # bytes of upstream extranonce2 used as the per-downstream-session prefix
+    session_prefix_bytes: int = 2
+    downstream_difficulty: float = 1.0
+
+
+class StratumProxy:
+    def __init__(self, config: ProxyConfig | None = None):
+        self.config = config or ProxyConfig()
+        self.upstream = StratumClient(
+            self.config.upstream, on_job=self._on_upstream_job
+        )
+        self.server = StratumServer(
+            ServerConfig(
+                host=self.config.listen_host,
+                port=self.config.listen_port,
+                initial_difficulty=self.config.downstream_difficulty,
+                extranonce1_factory=self._downstream_extranonce1,
+            ),
+            on_share=self._on_downstream_share,
+        )
+        self.stats = {
+            "upstream_submitted": 0,
+            "upstream_accepted": 0,
+            "upstream_rejected": 0,
+            "below_upstream_difficulty": 0,
+            "pruned_session_dropped": 0,
+        }
+        self._upstream_en1 = b""
+        self._prefix_by_session: dict[int, bytes] = {}
+        self._next_prefix = 0
+
+    # -- lifecycle ------------------------------------------------------------
+
+    async def start(self) -> None:
+        # learn the upstream's extranonce allocation first: downstream
+        # sessions are told extranonce2_size at subscribe time
+        await self.upstream.start()
+        self._adopt_upstream_sizes()
+        await self.server.start()
+        log.info(
+            "proxy listening on %s:%d -> upstream %s:%d",
+            self.config.listen_host, self.server.port,
+            self.config.upstream.host, self.config.upstream.port,
+        )
+
+    async def stop(self) -> None:
+        await self.upstream.stop()
+        await self.server.stop()
+
+    @property
+    def port(self) -> int:
+        return self.server.port
+
+    # -- job fan-out ----------------------------------------------------------
+
+    def _adopt_upstream_sizes(self) -> None:
+        """Fit the session prefix inside the upstream's extranonce2
+        allocation — a prefix as large as the whole allocation would leave
+        downstream miners no search space and shares of the wrong length."""
+        if self.upstream.extranonce2_size <= self.config.session_prefix_bytes:
+            new_prefix = max(0, self.upstream.extranonce2_size - 1)
+            log.warning(
+                "upstream extranonce2_size=%d too small for prefix=%d; using %d",
+                self.upstream.extranonce2_size,
+                self.config.session_prefix_bytes, new_prefix,
+            )
+            self.config.session_prefix_bytes = new_prefix
+        self.server.config = dataclasses.replace(
+            self.server.config, extranonce2_size=self._downstream_en2_size()
+        )
+
+    def _downstream_en2_size(self) -> int:
+        return self.upstream.extranonce2_size - self.config.session_prefix_bytes
+
+    def _downstream_extranonce1(self, session_id: int) -> bytes:
+        """Downstream extranonce1 = upstream_en1 || session prefix — the
+        downstream coinbase bytes equal an upstream coinbase whose en2 is
+        (prefix || downstream_en2)."""
+        return self.upstream.extranonce1 + self._alloc_prefix(session_id)
+
+    def _on_upstream_job(self, job: Job) -> None:
+        """Re-issue the upstream job downstream. Each downstream session's
+        extranonce1 = upstream_extranonce1 || session_prefix, so coinbases
+        stay inside the upstream's allocation and remain per-miner disjoint."""
+        alloc = (self.upstream.extranonce1, self.upstream.extranonce2_size)
+        if alloc != (self._upstream_en1, self.server.config.extranonce2_size
+                     + self.config.session_prefix_bytes):
+            # upstream reconnect / set_extranonce: every downstream session's
+            # baked-in extranonce1 (and told en2 size) is now wrong — refresh
+            # the server config and force miners to resubscribe
+            if self._upstream_en1:
+                log.warning(
+                    "upstream extranonce allocation changed; disconnecting %d downstream sessions",
+                    len(self.server.sessions),
+                )
+                for s in list(self.server.sessions.values()):
+                    s.writer.close()
+            self._adopt_upstream_sizes()
+            self._upstream_en1 = self.upstream.extranonce1
+        down = dataclasses.replace(
+            job,
+            extranonce2_size=self._downstream_en2_size(),
+        )
+        self.server.set_job(down, clean=job.clean)
+
+    def _session_prefix(self, session_id: int) -> bytes | None:
+        """Allocated prefix for a session, or None if the allocation was
+        pruned. Reconstructing a prefix from the session id here would
+        rebuild a DIFFERENT coinbase than the one the miner actually hashed
+        (the allocator skips in-use values, so id != prefix), and the
+        upstream would reject the share — dropping it is the honest move."""
+        return self._prefix_by_session.get(session_id)
+
+    def _alloc_prefix(self, session_id: int) -> bytes:
+        """Pick a prefix no *live* session is using; the id counter alone
+        wraps at 2^(8*prefix_bytes) and would collide under churn.
+
+        With a zero-width prefix (upstream extranonce2_size == 1) the space
+        is exactly one session; further miners are refused at connect time
+        (the server catches this and closes only that client)."""
+        size = self.config.session_prefix_bytes
+        space = 1 << (8 * size)
+        live = {
+            sid: p for sid, p in self._prefix_by_session.items()
+            if sid in self.server.sessions
+        }
+        self._prefix_by_session = live
+        in_use = set(live.values())
+        for _ in range(space):
+            # NB: to_bytes(0, ...) correctly yields b"" when the prefix is
+            # zero-width (upstream extranonce2_size == 1); a [-size:] slice
+            # would return the whole 4-byte pack at size 0.
+            candidate = (self._next_prefix % space).to_bytes(size, "big")
+            self._next_prefix += 1
+            if candidate not in in_use:
+                self._prefix_by_session[session_id] = candidate
+                return candidate
+        raise RuntimeError("extranonce prefix space exhausted")
+
+    # -- share relay ----------------------------------------------------------
+
+    async def _on_downstream_share(self, accepted: AcceptedShare) -> None:
+        job = self.server.jobs.get(accepted.job_id)
+        if job is None:
+            return
+        # only shares that also satisfy the upstream's difficulty are worth
+        # relaying; the rest would be rejected low-diff and burn reputation
+        if accepted.actual_difficulty < self.upstream.difficulty:
+            self.stats["below_upstream_difficulty"] += 1
+            return
+        prefix = self._session_prefix(accepted.session_id)
+        if prefix is None:
+            self.stats["pruned_session_dropped"] += 1
+            log.warning(
+                "dropping share from session %d: extranonce prefix pruned",
+                accepted.session_id,
+            )
+            return
+        share = Share(
+            job_id=accepted.job_id,
+            worker=self.config.upstream.username,
+            # upstream extranonce2 = session prefix || downstream extranonce2
+            extranonce2=prefix + accepted.extranonce2,
+            ntime=accepted.ntime,
+            nonce_word=accepted.nonce_word,
+            digest=accepted.digest,
+            difficulty=accepted.actual_difficulty,
+            algorithm=job.algorithm,
+        )
+        self.stats["upstream_submitted"] += 1
+        result = await self.upstream.submit(share)
+        if result.accepted:
+            self.stats["upstream_accepted"] += 1
+        else:
+            self.stats["upstream_rejected"] += 1
+
+    def snapshot(self) -> dict:
+        return {
+            **self.stats,
+            "downstream": self.server.snapshot(),
+            "upstream": dict(self.upstream.stats),
+        }

@@ -1,0 +1,401 @@
+"""Asyncio stratum V1 pool server.
+
+Reference parity: internal/stratum/unified_stratum.go:517-913 — accept loop
+(:598), per-client handler (:616-670), subscribe/authorize/submit handlers
+(:690-791), job broadcast (:869-886), per-client vardiff (:950-1003).
+
+Redesigned where the reference is weak:
+- extranonce1 is a per-session unique counter (the reference derives it from
+  the Unix second, :1009 — every client connecting in the same second would
+  collide and search identical nonce spaces);
+- ``validateShare`` actually validates (the reference checks only job
+  existence/age, :888-913): duplicate window, ntime sanity, exact header
+  reconstruction, sha256d, 256-bit target compare, block detection;
+- accepted shares flow to an async ``on_share`` hook (pool backend /
+  persistence) instead of vanishing.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import struct
+import time
+from typing import Awaitable, Callable
+
+from otedama_tpu.engine import jobs as jobmod
+from otedama_tpu.engine.types import Job, ShareOutcome
+from otedama_tpu.engine.vardiff import VardiffConfig, VardiffManager
+from otedama_tpu.kernels import target as tgt
+from otedama_tpu.stratum import protocol as sp
+from otedama_tpu.utils.pow_host import pow_digest
+
+log = logging.getLogger("otedama.stratum.server")
+
+
+@dataclasses.dataclass
+class ServerConfig:
+    host: str = "127.0.0.1"
+    port: int = 3333
+    extranonce2_size: int = 4
+    initial_difficulty: float = 1.0
+    job_max_age: float = 300.0           # submits against older jobs are stale
+    ntime_slack: int = 600               # seconds of ntime roll allowed
+    max_clients: int = 10000
+    vardiff: VardiffConfig = dataclasses.field(default_factory=VardiffConfig)
+    # optional custom extranonce1 allocator (session_id -> bytes); the proxy
+    # uses this to nest downstream sessions inside an upstream allocation
+    extranonce1_factory: Callable[[int], bytes] | None = None
+
+
+@dataclasses.dataclass
+class AcceptedShare:
+    """What the pool backend receives for every accepted share."""
+
+    session_id: int
+    worker_user: str
+    job_id: str
+    difficulty: float        # difficulty credited (session difficulty at job time)
+    actual_difficulty: float # difficulty the digest actually achieved
+    digest: bytes
+    header: bytes            # the 80-byte header the share hashed
+    extranonce2: bytes       # as submitted by the miner
+    ntime: int
+    nonce_word: int
+    is_block: bool
+    submitted_at: float
+
+
+ShareHook = Callable[[AcceptedShare], Awaitable[None]]
+BlockHook = Callable[[bytes, Job, AcceptedShare], Awaitable[None]]
+
+
+@dataclasses.dataclass
+class Session:
+    id: int
+    peer: str
+    extranonce1: bytes
+    extranonce2_size: int
+    writer: asyncio.StreamWriter
+    subscribed: bool = False
+    authorized: bool = False
+    worker_user: str = ""
+    difficulty: float = 1.0
+    prev_difficulty: float | None = None
+    connected_at: float = dataclasses.field(default_factory=time.time)
+    shares_valid: int = 0
+    shares_invalid: int = 0
+    seen: set[tuple[str, bytes, int, int]] = dataclasses.field(default_factory=set)
+
+    @property
+    def vardiff_key(self) -> str:
+        return f"{self.id}"
+
+
+class StratumServer:
+    """One listening pool endpoint."""
+
+    def __init__(
+        self,
+        config: ServerConfig | None = None,
+        on_share: ShareHook | None = None,
+        on_block: BlockHook | None = None,
+    ):
+        self.config = config or ServerConfig()
+        self.on_share = on_share
+        self.on_block = on_block
+        self.vardiff = VardiffManager(
+            self.config.vardiff, self.config.initial_difficulty
+        )
+        self.sessions: dict[int, Session] = {}
+        self.jobs: dict[str, Job] = {}
+        self.current_job: Job | None = None
+        self.stats = {
+            "connections_total": 0,
+            "shares_total": 0,
+            "shares_valid": 0,
+            "shares_invalid": 0,
+            "blocks_found": 0,
+        }
+        self._server: asyncio.AbstractServer | None = None
+        self._next_session = 1
+        self._next_extranonce1 = 1
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_client, self.config.host, self.config.port
+        )
+        addr = self._server.sockets[0].getsockname()
+        self.config = dataclasses.replace(self.config, port=addr[1])
+        log.info("stratum server listening on %s:%d", addr[0], addr[1])
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        for s in list(self.sessions.values()):
+            s.writer.close()
+        self.sessions.clear()
+
+    @property
+    def port(self) -> int:
+        return self.config.port
+
+    # -- jobs ---------------------------------------------------------------
+
+    def set_job(self, job: Job, clean: bool = True) -> None:
+        """Register a job and broadcast it to all subscribed sessions."""
+        self.jobs[job.job_id] = job
+        self.current_job = job
+        self._expire_jobs()
+        notify = sp.Message(
+            method="mining.notify", params=sp.notify_params(job, clean)
+        )
+        line = sp.encode_line(notify)
+        for s in self.sessions.values():
+            if s.subscribed:
+                s.writer.write(line)
+        log.info("job %s broadcast to %d sessions", job.job_id, len(self.sessions))
+
+    def _expire_jobs(self) -> None:
+        cutoff = time.time() - 2 * self.config.job_max_age
+        for jid in [j for j, job in self.jobs.items() if job.received_at < cutoff]:
+            del self.jobs[jid]
+
+    # -- connection handling ------------------------------------------------
+
+    def _alloc_extranonce1(self, session_id: int) -> bytes:
+        if self.config.extranonce1_factory is not None:
+            return self.config.extranonce1_factory(session_id)
+        v = self._next_extranonce1
+        self._next_extranonce1 += 1
+        return struct.pack(">I", v & 0xFFFFFFFF)
+
+    async def _handle_client(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        if len(self.sessions) >= self.config.max_clients:
+            writer.close()
+            return
+        peer = writer.get_extra_info("peername")
+        session_id = self._next_session
+        self._next_session += 1
+        try:
+            extranonce1 = self._alloc_extranonce1(session_id)
+        except Exception as e:
+            # e.g. a proxy whose upstream allocation has no session space
+            # left — refuse this client, keep serving the others
+            log.warning("refusing client %s: %s", peer, e)
+            writer.close()
+            return
+        session = Session(
+            id=session_id,
+            peer=f"{peer[0]}:{peer[1]}" if peer else "?",
+            extranonce1=extranonce1,
+            extranonce2_size=self.config.extranonce2_size,
+            writer=writer,
+        )
+        self.sessions[session.id] = session
+        self.stats["connections_total"] += 1
+        log.info("client %d connected from %s", session.id, session.peer)
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break
+                if not line.strip():
+                    continue
+                try:
+                    msg = sp.decode_line(line)
+                except ValueError:
+                    log.warning("client %d sent invalid JSON", session.id)
+                    continue
+                await self._handle_message(session, msg)
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            self.sessions.pop(session.id, None)
+            self.vardiff.forget(session.vardiff_key)
+            writer.close()
+            log.info("client %d disconnected", session.id)
+
+    # -- message handling ---------------------------------------------------
+
+    async def _handle_message(self, session: Session, msg: sp.Message) -> None:
+        method = msg.method or ""
+        try:
+            if method == "mining.subscribe":
+                await self._on_subscribe(session, msg)
+            elif method == "mining.authorize":
+                await self._on_authorize(session, msg)
+            elif method == "mining.submit":
+                await self._on_submit(session, msg)
+            elif method == "mining.get_transactions":
+                await self._reply(session, msg.id, [])
+            elif method == "mining.extranonce.subscribe":
+                await self._reply(session, msg.id, True)
+            elif method == "mining.ping":
+                await self._reply(session, msg.id, "pong")
+            else:
+                await self._reply_error(
+                    session, msg.id, sp.StratumError(sp.ERR_OTHER, f"unknown method {method!r}")
+                )
+        except sp.StratumError as e:
+            await self._reply_error(session, msg.id, e)
+
+    async def _reply(self, session: Session, msg_id, result) -> None:
+        session.writer.write(sp.encode_line(sp.Message(id=msg_id, result=result)))
+        await session.writer.drain()
+
+    async def _reply_error(self, session: Session, msg_id, err: sp.StratumError) -> None:
+        session.writer.write(
+            sp.encode_line(sp.Message(id=msg_id, result=None, error=err.as_triple()))
+        )
+        await session.writer.drain()
+
+    def _send_notification(self, session: Session, method: str, params: list) -> None:
+        session.writer.write(sp.encode_line(sp.Message(method=method, params=params)))
+
+    def _send_difficulty(self, session: Session, difficulty: float) -> None:
+        session.prev_difficulty = session.difficulty
+        session.difficulty = difficulty
+        self._send_notification(session, "mining.set_difficulty", [difficulty])
+
+    async def _on_subscribe(self, session: Session, msg: sp.Message) -> None:
+        session.subscribed = True
+        result = [
+            [
+                ["mining.set_difficulty", str(session.id)],
+                ["mining.notify", str(session.id)],
+            ],
+            session.extranonce1.hex(),
+            session.extranonce2_size,
+        ]
+        await self._reply(session, msg.id, result)
+        self._send_difficulty(session, self.config.initial_difficulty)
+        session.prev_difficulty = None
+        if self.current_job is not None:
+            self._send_notification(
+                session, "mining.notify", sp.notify_params(self.current_job, True)
+            )
+        await session.writer.drain()
+
+    async def _on_authorize(self, session: Session, msg: sp.Message) -> None:
+        params = msg.params or []
+        if not params:
+            raise sp.StratumError(sp.ERR_OTHER, "missing worker name")
+        session.worker_user = str(params[0])
+        session.authorized = True
+        await self._reply(session, msg.id, True)
+        log.info("client %d authorized as %s", session.id, session.worker_user)
+
+    # -- share validation (the real thing) ----------------------------------
+
+    async def _on_submit(self, session: Session, msg: sp.Message) -> None:
+        if not session.authorized:
+            raise sp.StratumError(sp.ERR_UNAUTHORIZED, "not authorized")
+        sub = sp.ShareSubmission.from_params(msg.params or [])
+        self.stats["shares_total"] += 1
+        outcome, accepted = self._validate(session, sub)
+        if outcome in (ShareOutcome.ACCEPTED, ShareOutcome.BLOCK_FOUND):
+            session.shares_valid += 1
+            self.stats["shares_valid"] += 1
+            self.vardiff.record_share(session.vardiff_key)
+            await self._reply(session, msg.id, True)
+            if accepted is not None:
+                if accepted.is_block:
+                    self.stats["blocks_found"] += 1
+                    job = self.jobs.get(sub.job_id)
+                    if self.on_block is not None and job is not None:
+                        await self.on_block(accepted.header, job, accepted)
+                if self.on_share is not None:
+                    await self.on_share(accepted)
+        else:
+            session.shares_invalid += 1
+            self.stats["shares_invalid"] += 1
+            code = {
+                ShareOutcome.REJECTED_STALE: sp.ERR_STALE,
+                ShareOutcome.REJECTED_DUPLICATE: sp.ERR_DUPLICATE,
+                ShareOutcome.REJECTED_LOW_DIFF: sp.ERR_LOW_DIFF,
+                ShareOutcome.REJECTED_BAD_JOB: sp.ERR_STALE,
+            }.get(outcome, sp.ERR_OTHER)
+            await self._reply_error(
+                session, msg.id, sp.StratumError(code, outcome.value)
+            )
+        new_diff = self.vardiff.maybe_retarget(session.vardiff_key)
+        if new_diff is not None and new_diff != session.difficulty:
+            self._send_difficulty(session, new_diff)
+            await session.writer.drain()
+
+    def _validate(
+        self, session: Session, sub: sp.ShareSubmission
+    ) -> tuple[ShareOutcome, AcceptedShare | None]:
+        job = self.jobs.get(sub.job_id)
+        if job is None:
+            return ShareOutcome.REJECTED_BAD_JOB, None
+        if job.is_expired(self.config.job_max_age):
+            return ShareOutcome.REJECTED_STALE, None
+        if len(sub.extranonce2) != session.extranonce2_size:
+            return ShareOutcome.REJECTED_INVALID, None
+        if abs(sub.ntime - job.ntime) > self.config.ntime_slack:
+            return ShareOutcome.REJECTED_INVALID, None
+        key = (sub.job_id, sub.extranonce2, sub.ntime, sub.nonce_word)
+        if key in session.seen:
+            return ShareOutcome.REJECTED_DUPLICATE, None
+        session.seen.add(key)
+
+        try:
+            header = jobmod.header_from_share(
+                dataclasses.replace(
+                    job,
+                    extranonce1=session.extranonce1,
+                    extranonce2_size=session.extranonce2_size,
+                ),
+                sub.extranonce2, sub.ntime, sub.nonce_word,
+            )
+        except ValueError:
+            return ShareOutcome.REJECTED_INVALID, None
+        digest = pow_digest(header, job.algorithm)
+        # credit at the difficulty the session was mining at; allow the
+        # previous difficulty during a retarget window
+        credit_diff = session.difficulty
+        share_target = tgt.difficulty_to_target(credit_diff)
+        if not tgt.hash_meets_target(digest, share_target):
+            if session.prev_difficulty is not None and tgt.hash_meets_target(
+                digest, tgt.difficulty_to_target(session.prev_difficulty)
+            ):
+                credit_diff = session.prev_difficulty
+            else:
+                return ShareOutcome.REJECTED_LOW_DIFF, None
+
+        is_block = tgt.hash_meets_target(digest, tgt.bits_to_target(job.nbits))
+        accepted = AcceptedShare(
+            session_id=session.id,
+            worker_user=session.worker_user,
+            job_id=sub.job_id,
+            difficulty=credit_diff,
+            actual_difficulty=tgt.difficulty_of_digest(digest),
+            digest=digest,
+            header=header,
+            extranonce2=sub.extranonce2,
+            ntime=sub.ntime,
+            nonce_word=sub.nonce_word,
+            is_block=is_block,
+            submitted_at=time.time(),
+        )
+        outcome = ShareOutcome.BLOCK_FOUND if is_block else ShareOutcome.ACCEPTED
+        return outcome, accepted
+
+    # -- reporting ----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        return {
+            **self.stats,
+            "sessions": len(self.sessions),
+            "jobs_cached": len(self.jobs),
+            "current_job": self.current_job.job_id if self.current_job else None,
+        }

@@ -1,0 +1,99 @@
+"""Auto-tuner: searches the device-knob space for the best hashrate.
+
+Reference parity: internal/ai/optimization_engine.go:17-173 (from-scratch
+NN + genetic algorithm over threads/intensity/frequency knobs) and
+internal/optimization/advanced_mining.go:15-78. The TPU knob surface is
+different — batch size, sublane tiling, host thread count — but the search
+machinery is the same shape: a genetic loop over knob vectors scored by a
+measured (or injected) objective, with elitism, crossover and mutation.
+Deterministic under a seeded RNG so tuning runs are reproducible.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Callable, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    name: str
+    choices: tuple          # discrete values (TPU knobs are power-of-two-ish)
+
+
+DEFAULT_KNOBS = (
+    Knob("batch_size", tuple(1 << p for p in range(18, 27))),
+    Knob("sublanes", (64, 128, 256, 512)),
+    Knob("host_threads", (1, 2, 4, 8)),
+)
+
+
+@dataclasses.dataclass
+class TunerConfig:
+    population: int = 12
+    generations: int = 8
+    elite: int = 3
+    mutation_rate: float = 0.25
+    seed: int = 7
+
+
+class GeneticTuner:
+    def __init__(
+        self,
+        objective: Callable[[dict], float],
+        knobs: Sequence[Knob] = DEFAULT_KNOBS,
+        config: TunerConfig | None = None,
+    ):
+        self.objective = objective
+        self.knobs = list(knobs)
+        self.config = config or TunerConfig()
+        self.rng = random.Random(self.config.seed)
+        self.history: list[tuple[dict, float]] = []
+        self._cache: dict[tuple, float] = {}
+
+    def _random_genome(self) -> dict:
+        return {k.name: self.rng.choice(k.choices) for k in self.knobs}
+
+    def _score(self, genome: dict) -> float:
+        key = tuple(genome[k.name] for k in self.knobs)
+        if key not in self._cache:
+            self._cache[key] = self.objective(genome)
+            self.history.append((dict(genome), self._cache[key]))
+        return self._cache[key]
+
+    def _crossover(self, a: dict, b: dict) -> dict:
+        return {
+            k.name: (a if self.rng.random() < 0.5 else b)[k.name]
+            for k in self.knobs
+        }
+
+    def _mutate(self, genome: dict) -> dict:
+        out = dict(genome)
+        for k in self.knobs:
+            if self.rng.random() < self.config.mutation_rate:
+                out[k.name] = self.rng.choice(k.choices)
+        return out
+
+    def run(self) -> tuple[dict, float]:
+        cfg = self.config
+        population = [self._random_genome() for _ in range(cfg.population)]
+        for _ in range(cfg.generations):
+            scored = sorted(
+                population, key=self._score, reverse=True
+            )
+            elite = scored[: cfg.elite]
+            children = []
+            while len(children) < cfg.population - cfg.elite:
+                a, b = self.rng.sample(scored[: max(cfg.elite * 2, 4)], 2)
+                children.append(self._mutate(self._crossover(a, b)))
+            population = elite + children
+        best = max(population, key=self._score)
+        return best, self._score(best)
+
+    def snapshot(self) -> dict:
+        best = max(self.history, key=lambda x: x[1]) if self.history else None
+        return {
+            "evaluations": len(self._cache),
+            "best": {"genome": best[0], "score": best[1]} if best else None,
+        }

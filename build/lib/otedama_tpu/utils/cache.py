@@ -1,0 +1,202 @@
+"""Two-tier cache with bloom-filter negative lookups + mmap block cache.
+
+Reference parity: internal/memory/advanced_cache.go:15-105 (L1/L2 cache
+with bloom filter), bloom_filter.go, and internal/storage/mmap_cache.go
+:20-96,673-723 (mmap'd block cache with LRU + index). The L1 is a hot
+dict with LRU eviction; the L2 holds more entries with TTL; the bloom
+filter short-circuits misses without touching either tier.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import mmap
+import os
+import struct
+import time
+from collections import OrderedDict
+
+
+class BloomFilter:
+    """Classic k-hash bloom filter over a bit array."""
+
+    def __init__(self, capacity: int = 100_000, error_rate: float = 0.01):
+        import math
+
+        self.capacity = capacity
+        m = int(-capacity * math.log(error_rate) / (math.log(2) ** 2))
+        self.bits = max(64, (m + 7) // 8 * 8)
+        self.k = max(1, round(m / capacity * math.log(2)))
+        self._array = bytearray(self.bits // 8)
+        self.count = 0
+
+    def _hashes(self, key: bytes):
+        h = hashlib.blake2b(key, digest_size=16).digest()
+        a, b = struct.unpack("<QQ", h)
+        for i in range(self.k):
+            yield (a + i * b) % self.bits
+
+    def add(self, key: bytes) -> None:
+        for bit in self._hashes(key):
+            self._array[bit >> 3] |= 1 << (bit & 7)
+        self.count += 1
+
+    def __contains__(self, key: bytes) -> bool:
+        return all(
+            self._array[bit >> 3] & (1 << (bit & 7)) for bit in self._hashes(key)
+        )
+
+
+class TieredCache:
+    """L1 (small, hot) over L2 (large, TTL'd) with bloom negative lookups."""
+
+    def __init__(self, l1_size: int = 1024, l2_size: int = 65536,
+                 l2_ttl: float = 3600.0):
+        self.l1: OrderedDict = OrderedDict()
+        self.l2: OrderedDict = OrderedDict()
+        self.l1_size = l1_size
+        self.l2_size = l2_size
+        self.l2_ttl = l2_ttl
+        self.bloom = BloomFilter(l2_size * 2)
+        self.stats = {"hits_l1": 0, "hits_l2": 0, "misses": 0, "bloom_skips": 0}
+
+    @staticmethod
+    def _key(key) -> bytes:
+        return key if isinstance(key, bytes) else str(key).encode()
+
+    def put(self, key, value) -> None:
+        k = self._key(key)
+        self.l1[k] = value
+        self.l1.move_to_end(k)
+        if len(self.l1) > self.l1_size:
+            old_k, old_v = self.l1.popitem(last=False)
+            self.l2[old_k] = (old_v, time.monotonic())
+            if len(self.l2) > self.l2_size:
+                self.l2.popitem(last=False)
+        self.bloom.add(k)
+
+    def get(self, key, default=None):
+        k = self._key(key)
+        if k not in self.bloom:
+            self.stats["bloom_skips"] += 1
+            return default
+        if k in self.l1:
+            self.stats["hits_l1"] += 1
+            self.l1.move_to_end(k)
+            return self.l1[k]
+        entry = self.l2.get(k)
+        if entry is not None:
+            value, stored = entry
+            if time.monotonic() - stored <= self.l2_ttl:
+                self.stats["hits_l2"] += 1
+                del self.l2[k]
+                self.put(k, value)  # promote
+                return value
+            del self.l2[k]
+        self.stats["misses"] += 1
+        return default
+
+    def snapshot(self) -> dict:
+        return {**self.stats, "l1": len(self.l1), "l2": len(self.l2)}
+
+
+class MmapBlockCache:
+    """Fixed-slot mmap-backed cache for block-sized blobs with LRU reuse.
+
+    Layout: header (slot count, slot size) then slots of
+    [8B key-hash][8B last-used][4B length][payload]. The OS page cache does
+    the heavy lifting; the index lives in memory and is rebuilt on open.
+    """
+
+    _HEADER = struct.Struct("<QQ")
+    _SLOT_META = struct.Struct("<QQI")
+
+    def __init__(self, path: str, slots: int = 256, slot_size: int = 4096):
+        self.path = path
+        create = not os.path.exists(path)
+        self.slots = slots
+        self.payload_size = slot_size
+        self.slot_stride = self._SLOT_META.size + slot_size
+        total = self._HEADER.size + self.slot_stride * slots
+        with open(path, "a+b") as f:
+            if create or os.path.getsize(path) < total:
+                f.truncate(total)
+        self._f = open(path, "r+b")
+        self._mm = mmap.mmap(self._f.fileno(), total)
+        if create:
+            self._mm[: self._HEADER.size] = self._HEADER.pack(slots, slot_size)
+        else:
+            stored_slots, stored_size = self._HEADER.unpack_from(self._mm, 0)
+            if (stored_slots, stored_size) != (slots, slot_size):
+                self._mm.close()
+                self._f.close()
+                raise ValueError(
+                    f"cache geometry mismatch: file has slots={stored_slots} "
+                    f"slot_size={stored_size}, requested {slots}/{slot_size}"
+                )
+        self._index: dict[int, int] = {}   # key-hash -> slot
+        self._clock = 0
+        self._rebuild_index()
+
+    @staticmethod
+    def _hash(key: bytes) -> int:
+        return struct.unpack(
+            "<Q", hashlib.blake2b(key, digest_size=8).digest()
+        )[0] or 1
+
+    def _slot_off(self, slot: int) -> int:
+        return self._HEADER.size + slot * self.slot_stride
+
+    def _rebuild_index(self) -> None:
+        for slot in range(self.slots):
+            off = self._slot_off(slot)
+            kh, used, _ = self._SLOT_META.unpack_from(self._mm, off)
+            if kh:
+                self._index[kh] = slot
+                # resume the LRU clock past persisted stamps, or reopened
+                # caches would evict freshly-touched entries first
+                self._clock = max(self._clock, used)
+
+    def put(self, key: bytes, value: bytes) -> None:
+        if len(value) > self.payload_size:
+            raise ValueError(f"value exceeds slot size {self.payload_size}")
+        kh = self._hash(key)
+        slot = self._index.get(kh)
+        if slot is None:
+            slot = self._pick_victim()
+        off = self._slot_off(slot)
+        old_kh, _, _ = self._SLOT_META.unpack_from(self._mm, off)
+        if old_kh and old_kh != kh:
+            self._index.pop(old_kh, None)
+        self._clock += 1
+        self._SLOT_META.pack_into(self._mm, off, kh, self._clock, len(value))
+        start = off + self._SLOT_META.size
+        self._mm[start : start + len(value)] = value
+        self._index[kh] = slot
+
+    def _pick_victim(self) -> int:
+        # free slot if any, else least recently used
+        best_slot, best_used = 0, None
+        for slot in range(self.slots):
+            kh, used, _ = self._SLOT_META.unpack_from(self._mm, self._slot_off(slot))
+            if kh == 0:
+                return slot
+            if best_used is None or used < best_used:
+                best_slot, best_used = slot, used
+        return best_slot
+
+    def get(self, key: bytes) -> bytes | None:
+        slot = self._index.get(self._hash(key))
+        if slot is None:
+            return None
+        off = self._slot_off(slot)
+        kh, _, length = self._SLOT_META.unpack_from(self._mm, off)
+        self._clock += 1
+        self._SLOT_META.pack_into(self._mm, off, kh, self._clock, length)
+        start = off + self._SLOT_META.size
+        return bytes(self._mm[start : start + length])
+
+    def close(self) -> None:
+        self._mm.flush()
+        self._mm.close()
+        self._f.close()

@@ -1,0 +1,71 @@
+"""i18n: message catalog with en/ja locales.
+
+Reference parity: internal/utils/i18n.go:20-38 (en/ja manager). Messages
+use str.format placeholders; unknown keys fall back to english, then to
+the key itself (never raises in a log path).
+"""
+
+from __future__ import annotations
+
+_CATALOG: dict[str, dict[str, str]] = {
+    "en": {
+        "app.started": "Otedama-TPU started",
+        "app.stopped": "Otedama-TPU stopped",
+        "mining.started": "Mining started: {algorithm} on {backend}",
+        "mining.stopped": "Mining stopped",
+        "mining.hashrate": "Hashrate: {rate}",
+        "share.accepted": "Share accepted ({difficulty})",
+        "share.rejected": "Share rejected: {reason}",
+        "block.found": "Block found! height={height} hash={hash}",
+        "pool.connected": "Connected to pool {host}:{port}",
+        "pool.disconnected": "Disconnected from pool; reconnecting",
+        "worker.banned": "Worker {name} banned: {reason}",
+        "payout.sent": "Payout sent: {amount} to {count} workers",
+        "backup.done": "Backup complete: {path}",
+        "error.config": "Configuration error: {detail}",
+    },
+    "ja": {
+        "app.started": "Otedama-TPU を起動しました",
+        "app.stopped": "Otedama-TPU を停止しました",
+        "mining.started": "マイニング開始: {algorithm}({backend})",
+        "mining.stopped": "マイニングを停止しました",
+        "mining.hashrate": "ハッシュレート: {rate}",
+        "share.accepted": "シェアが承認されました ({difficulty})",
+        "share.rejected": "シェアが拒否されました: {reason}",
+        "block.found": "ブロック発見! 高さ={height} ハッシュ={hash}",
+        "pool.connected": "プールに接続しました {host}:{port}",
+        "pool.disconnected": "プールから切断されました。再接続します",
+        "worker.banned": "ワーカー {name} を禁止しました: {reason}",
+        "payout.sent": "支払い完了: {amount} を {count} 人のワーカーへ",
+        "backup.done": "バックアップ完了: {path}",
+        "error.config": "設定エラー: {detail}",
+    },
+}
+
+
+class I18n:
+    def __init__(self, locale: str = "en"):
+        self.locale = locale if locale in _CATALOG else "en"
+
+    def t(self, key: str, **kwargs) -> str:
+        msg = _CATALOG.get(self.locale, {}).get(key) or _CATALOG["en"].get(key) or key
+        try:
+            return msg.format(**kwargs)
+        except (KeyError, IndexError):
+            return msg
+
+    @staticmethod
+    def locales() -> list[str]:
+        return sorted(_CATALOG)
+
+
+_default = I18n()
+
+
+def t(key: str, **kwargs) -> str:
+    return _default.t(key, **kwargs)
+
+
+def set_locale(locale: str) -> None:
+    global _default
+    _default = I18n(locale)

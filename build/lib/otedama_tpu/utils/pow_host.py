@@ -1,0 +1,47 @@
+"""Host-side (scalar) proof-of-work digests, keyed by algorithm name.
+
+The validation path — stratum server share checks, pool-side revalidation,
+block submission — re-hashes one candidate header at a time on the host, so
+these are plain python/OpenSSL implementations, not device kernels. Device
+kernels (otedama_tpu.kernels.*) must agree bit-for-bit with these; tests
+enforce it. Reference parity: internal/mining/multi_algorithm.go:93-140
+(SHA256dEngine / ScryptEngine — the two genuinely implemented host hashes).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+
+def sha256d(data: bytes) -> bytes:
+    return hashlib.sha256(hashlib.sha256(data).digest()).digest()
+
+
+def scrypt_1024_1_1(data: bytes) -> bytes:
+    return hashlib.scrypt(
+        data, salt=data, n=1024, r=1, p=1, maxmem=64 * 1024 * 1024, dklen=32
+    )
+
+
+def pow_digest(header: bytes, algorithm: str = "sha256d") -> bytes:
+    """The 32-byte PoW digest a miner's share claims for this header."""
+    algorithm = (algorithm or "sha256d").lower()
+    if algorithm in ("sha256d", "sha256double", "bitcoin"):
+        return sha256d(header)
+    if algorithm == "sha256":
+        return hashlib.sha256(header).digest()
+    if algorithm in ("scrypt", "litecoin"):
+        return scrypt_1024_1_1(header)
+    if algorithm in ("x11", "dash"):
+        if algorithm == "dash":
+            # the coin alias implies live-network rules: route through the
+            # registry so a non-canonical chain refuses here too, not just
+            # at algorithm resolution (the gate must cover the one path
+            # that actually computes digests)
+            from otedama_tpu.engine import algos
+
+            algos.get("dash")  # raises ValueError while x11 is uncertified
+        from otedama_tpu.kernels.x11 import x11_digest
+
+        return x11_digest(header)
+    raise ValueError(f"no host PoW digest for algorithm {algorithm!r}")

@@ -1,0 +1,90 @@
+"""Ring-buffer sampling profiler with per-operation timing.
+
+Reference parity: internal/performance/lockfree_profiler.go:18-187 (lock-
+free circular-buffer profiler) and the per-op timing histograms of the
+monitoring layer. Records are (op, duration) samples in a bounded ring;
+aggregation computes count/mean/p50/p95/max per op. Uses the native
+lock-free ring when the C++ library is loadable, else a deque.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+
+_RECORD = struct.Struct("<Id")  # op_id, seconds
+
+
+class Profiler:
+    def __init__(self, capacity_pow2: int = 4096, use_native: bool = True):
+        self._ops: dict[str, int] = {}
+        self._names: list[str] = []
+        self._lock = threading.Lock()
+        self._native = None
+        if use_native:
+            try:
+                from otedama_tpu.native import NativeRing
+
+                self._native = NativeRing(capacity_pow2, _RECORD.size)
+            except ImportError:
+                pass
+        self._ring: deque = deque(maxlen=capacity_pow2)
+        self.dropped = 0
+
+    def _op_id(self, op: str) -> int:
+        with self._lock:
+            if op not in self._ops:
+                self._ops[op] = len(self._names)
+                self._names.append(op)
+            return self._ops[op]
+
+    def record(self, op: str, seconds: float) -> None:
+        oid = self._op_id(op)
+        if self._native is not None:
+            if not self._native.push(_RECORD.pack(oid, seconds)):
+                # ring full: drop oldest to keep the newest samples
+                self._native.pop()
+                if not self._native.push(_RECORD.pack(oid, seconds)):
+                    self.dropped += 1
+        else:
+            self._ring.append((oid, seconds))
+
+    @contextmanager
+    def span(self, op: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(op, time.perf_counter() - t0)
+
+    def _drain(self) -> list[tuple[int, float]]:
+        if self._native is not None:
+            out = []
+            while True:
+                rec = self._native.pop()
+                if rec is None:
+                    return out
+                out.append(_RECORD.unpack(rec))
+        out = list(self._ring)
+        self._ring.clear()
+        return out
+
+    def report(self) -> dict[str, dict]:
+        samples: dict[int, list[float]] = {}
+        for oid, seconds in self._drain():
+            samples.setdefault(oid, []).append(seconds)
+        out = {}
+        for oid, values in samples.items():
+            values.sort()
+            n = len(values)
+            out[self._names[oid]] = {
+                "count": n,
+                "mean_ms": sum(values) / n * 1000,
+                "p50_ms": values[n // 2] * 1000,
+                "p95_ms": values[min(n - 1, int(n * 0.95))] * 1000,
+                "max_ms": values[-1] * 1000,
+            }
+        return out

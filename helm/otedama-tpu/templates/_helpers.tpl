@@ -1,0 +1,3 @@
+{{- define "otedama-tpu.fullname" -}}
+{{- printf "%s" .Release.Name | trunc 52 | trimSuffix "-" -}}
+{{- end -}}
