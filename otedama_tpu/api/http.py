@@ -40,7 +40,19 @@ class Request:
         self.params: dict[str, str] = {}   # route captures
 
     def json(self):
-        return json.loads(self.body.decode() or "null")
+        """Parse the request body with resource caps (size/depth/key-count,
+        security.validation) — handlers must never see a RecursionError or
+        a multi-hundred-MB allocation from a hostile body. Raises
+        json.JSONDecodeError for malformed/oversized input so existing
+        handlers' except clauses keep working."""
+        if not self.body:
+            return None
+        from otedama_tpu.security import validation as val
+
+        try:
+            return val.validate_json_body(self.body)
+        except val.ValidationError as e:
+            raise json.JSONDecodeError(str(e), "", 0) from None
 
 
 class Response:

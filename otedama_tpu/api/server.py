@@ -169,12 +169,21 @@ class ApiServer:
         )
 
     async def _login(self, request: Request) -> Response:
+        from otedama_tpu.security import validation as val
+
         if self.auth is None:
             return Response.error(403, "auth disabled (no api.auth_secret)")
         try:
             body = request.json() or {}
+            username = str(body.get("username", ""))
+            # defense in depth ahead of auth/db: a username carrying an
+            # injection payload is rejected without reaching the registry
+            # (the threat class is reported, never the payload)
+            threat = val.contains_injection(username)
+            if threat is not None or len(username) > 128:
+                return Response.error(401, f"bad username ({threat or 'length'})")
             token = self.auth.login(
-                str(body.get("username", "")),
+                username,
                 str(body.get("password", "")),
                 str(body.get("totp", "")),
             )

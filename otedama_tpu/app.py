@@ -399,6 +399,8 @@ class Application:
                 """Admin override: force the engine onto an algorithm (same
                 path the auto-switcher takes; canonical gate still applies
                 via backend_for -> algos)."""
+                if "algorithm" not in params:
+                    raise ValueError("missing 'algorithm' parameter")
                 algorithm = str(params["algorithm"])
                 from otedama_tpu.engine import algos as _algos
 
@@ -409,10 +411,19 @@ class Application:
                     )
                 # point the switcher BEFORE the (awaited) restart so a
                 # concurrent auto-evaluation can't compare against the old
-                # algorithm and immediately revert the admin's override
+                # algorithm and immediately revert the admin's override;
+                # roll back if the restart fails so the switcher baseline
+                # matches what the engine actually runs
+                prev_algo = self.profit_switcher.current_algorithm
+                prev_switch = self.profit_switcher.last_switch
                 self.profit_switcher.current_algorithm = algorithm
                 self.profit_switcher.last_switch = time.time()
-                await on_switch(algorithm, None)
+                try:
+                    await on_switch(algorithm, None)
+                except Exception:
+                    self.profit_switcher.current_algorithm = prev_algo
+                    self.profit_switcher.last_switch = prev_switch
+                    raise
                 return {"algorithm": algorithm}
 
             self.api.add_control("switch_algorithm", switch_algorithm)

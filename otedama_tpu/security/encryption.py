@@ -1,0 +1,145 @@
+"""At-rest encryption for secrets (wallet keys, pool credentials, backups).
+
+Reference parity: internal/security/encryption.go (EncryptionManager; its
+at-rest path is AES-GCM with a derived key — the TLS/libp2p transport parts
+map to this framework's own stratum/P2P layers and are not reproduced here).
+
+Envelope format (versioned, self-describing):
+    b"OTE1" || scrypt_salt(16) || gcm_nonce(12) || ciphertext+tag
+
+Key derivation: scrypt(N=2^14, r=8, p=1) from a passphrase — the same
+memory-hard KDF family the auth layer uses for passwords. A raw 32-byte
+key can be supplied instead to skip derivation (key files, KMS output).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+MAGIC = b"OTE1"
+_SALT_LEN = 16
+_NONCE_LEN = 12
+_KEY_LEN = 32
+_SCRYPT_N = 1 << 14
+_SCRYPT_R = 8
+_SCRYPT_P = 1
+
+
+class DecryptionError(Exception):
+    """Wrong passphrase, truncated envelope, or tampered ciphertext."""
+
+
+def derive_key(passphrase: str | bytes, salt: bytes) -> bytes:
+    if isinstance(passphrase, str):
+        passphrase = passphrase.encode()
+    return hashlib.scrypt(
+        passphrase, salt=salt, n=_SCRYPT_N, r=_SCRYPT_R, p=_SCRYPT_P,
+        maxmem=64 * 1024 * 1024, dklen=_KEY_LEN,
+    )
+
+
+def _aesgcm(key: bytes):
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+    return AESGCM(key)
+
+
+def encrypt_bytes(plaintext: bytes, passphrase: str | bytes = "",
+                  *, key: bytes | None = None, aad: bytes = b"") -> bytes:
+    """Seal ``plaintext``. Provide a passphrase (derived key) or a raw
+    32-byte ``key``. ``aad`` binds context (e.g. a filename) without
+    storing it."""
+    salt = os.urandom(_SALT_LEN)
+    if key is None:
+        if not passphrase:
+            raise ValueError("need a passphrase or a raw key")
+        key = derive_key(passphrase, salt)
+    elif len(key) != _KEY_LEN:
+        raise ValueError(f"raw key must be {_KEY_LEN} bytes")
+    nonce = os.urandom(_NONCE_LEN)
+    ct = _aesgcm(key).encrypt(nonce, plaintext, MAGIC + aad)
+    return MAGIC + salt + nonce + ct
+
+
+def decrypt_bytes(envelope: bytes, passphrase: str | bytes = "",
+                  *, key: bytes | None = None, aad: bytes = b"") -> bytes:
+    if len(envelope) < len(MAGIC) + _SALT_LEN + _NONCE_LEN + 16:
+        raise DecryptionError("envelope truncated")
+    if envelope[: len(MAGIC)] != MAGIC:
+        raise DecryptionError("not an OTE1 envelope")
+    off = len(MAGIC)
+    salt = envelope[off : off + _SALT_LEN]
+    nonce = envelope[off + _SALT_LEN : off + _SALT_LEN + _NONCE_LEN]
+    ct = envelope[off + _SALT_LEN + _NONCE_LEN :]
+    if key is None:
+        if not passphrase:
+            raise DecryptionError("need a passphrase or a raw key")
+        key = derive_key(passphrase, salt)
+    try:
+        return _aesgcm(key).decrypt(nonce, ct, MAGIC + aad)
+    except Exception as e:  # cryptography raises InvalidTag
+        raise DecryptionError("authentication failed") from e
+
+
+def encrypt_file(path: str, passphrase: str, out_path: str | None = None) -> str:
+    out_path = out_path or path + ".enc"
+    with open(path, "rb") as f:
+        data = f.read()
+    sealed = encrypt_bytes(
+        data, passphrase, aad=os.path.basename(out_path).encode()
+    )
+    tmp = out_path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(sealed)
+    os.replace(tmp, out_path)
+    return out_path
+
+
+def decrypt_file(path: str, passphrase: str) -> bytes:
+    with open(path, "rb") as f:
+        sealed = f.read()
+    return decrypt_bytes(
+        sealed, passphrase, aad=os.path.basename(path).encode()
+    )
+
+
+class SecretStore:
+    """Tiny encrypted key-value store for wallet/pool credentials
+    (reference: wallet_security.go's encrypted wallet storage)."""
+
+    def __init__(self, path: str, passphrase: str):
+        self.path = path
+        self._passphrase = passphrase
+        self._data: dict[str, str] = {}
+        if os.path.exists(path):
+            import json
+
+            raw = decrypt_bytes(
+                open(path, "rb").read(), passphrase,
+                aad=os.path.basename(path).encode(),
+            )
+            self._data = json.loads(raw)
+
+    def get(self, name: str, default: str | None = None) -> str | None:
+        return self._data.get(name, default)
+
+    def set(self, name: str, value: str) -> None:
+        self._data[name] = value
+        self._save()
+
+    def delete(self, name: str) -> None:
+        self._data.pop(name, None)
+        self._save()
+
+    def _save(self) -> None:
+        import json
+
+        sealed = encrypt_bytes(
+            json.dumps(self._data).encode(), self._passphrase,
+            aad=os.path.basename(self.path).encode(),
+        )
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(sealed)
+        os.replace(tmp, self.path)

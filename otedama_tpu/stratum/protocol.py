@@ -164,18 +164,33 @@ class ShareSubmission:
 
     @classmethod
     def from_params(cls, params: list) -> "ShareSubmission":
+        from otedama_tpu.security import validation as val
+
         if not isinstance(params, list) or len(params) < 5:
             raise StratumError(ERR_OTHER, "mining.submit needs 5 params")
         user, job_id, en2, ntime, nonce = params[:5]
         try:
+            # shape-check untrusted fields BEFORE decoding: a multi-MB
+            # "hex" extranonce2 or non-string job id must die cheaply
+            # (reference: internal/security/input_validation.go)
+            if not isinstance(job_id, str) or len(job_id) > 128:
+                raise val.ValidationError("job id: bad shape")
             return cls(
-                worker_user=str(user),
-                job_id=str(job_id),
-                extranonce2=bytes.fromhex(en2),
-                ntime=int(ntime, 16),
-                nonce_word=int(nonce, 16),
+                worker_user=val.validate_worker_name(str(user)),
+                job_id=job_id,
+                extranonce2=val.validate_hex(
+                    en2, max_bytes=16, field="extranonce2"
+                ),
+                ntime=int.from_bytes(
+                    val.validate_hex(ntime, exact_bytes=4, field="ntime"),
+                    "big",
+                ),
+                nonce_word=int.from_bytes(
+                    val.validate_hex(nonce, exact_bytes=4, field="nonce"),
+                    "big",
+                ),
             )
-        except (ValueError, TypeError) as e:
+        except (val.ValidationError, ValueError, TypeError) as e:
             raise StratumError(ERR_OTHER, f"malformed submit params: {e}") from None
 
     @property

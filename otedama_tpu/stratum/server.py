@@ -47,6 +47,10 @@ class ServerConfig:
     # optional custom extranonce1 allocator (session_id -> bytes); the proxy
     # uses this to nest downstream sessions inside an upstream allocation
     extranonce1_factory: Callable[[int], bytes] | None = None
+    # per-IP DDoS protection (reference: internal/security/ddos_protection.go);
+    # None = build one from defaults, False-like via ddos_enabled to disable
+    ddos_enabled: bool = True
+    max_line_bytes: int = 16 * 1024      # one JSON-RPC line cap
 
 
 @dataclasses.dataclass
@@ -121,6 +125,11 @@ class StratumServer:
         self._server: asyncio.AbstractServer | None = None
         self._next_session = 1
         self._next_extranonce1 = 1
+        from otedama_tpu.security.ddos import DDoSProtection
+
+        self.ddos: DDoSProtection | None = (
+            DDoSProtection() if self.config.ddos_enabled else None
+        )
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -182,6 +191,11 @@ class StratumServer:
             writer.close()
             return
         peer = writer.get_extra_info("peername")
+        ip = peer[0] if peer else "?"
+        if self.ddos is not None and not self.ddos.allow_connect(ip):
+            log.warning("ddos guard refused connect from %s", ip)
+            writer.close()
+            return
         session_id = self._next_session
         self._next_session += 1
         try:
@@ -190,6 +204,8 @@ class StratumServer:
             # e.g. a proxy whose upstream allocation has no session space
             # left — refuse this client, keep serving the others
             log.warning("refusing client %s: %s", peer, e)
+            if self.ddos is not None:
+                self.ddos.release(ip)
             writer.close()
             return
         session = Session(
@@ -204,8 +220,30 @@ class StratumServer:
         log.info("client %d connected from %s", session.id, session.peer)
         try:
             while True:
-                line = await reader.readline()
+                try:
+                    line = await reader.readuntil(b"\n")
+                except asyncio.LimitOverrunError:
+                    # oversized line: a 64 MB "json" must not buffer — cut
+                    # the connection and strike the IP
+                    log.warning("client %d line overrun", session.id)
+                    if self.ddos is not None:
+                        self.ddos.strike(session.peer.rsplit(":", 1)[0], "overrun")
+                    break
+                except asyncio.IncompleteReadError as e:
+                    if e.partial:
+                        line = e.partial
+                    else:
+                        break
                 if not line:
+                    break
+                if len(line) > self.config.max_line_bytes:
+                    # the line cap holds with or without the ddos layer
+                    if self.ddos is not None:
+                        self.ddos.strike(ip, "oversized-line")
+                    log.warning("client %d oversized line dropped", session.id)
+                    break
+                if self.ddos is not None and not self.ddos.track_bytes(ip, len(line)):
+                    log.warning("client %d cut: bandwidth budget", session.id)
                     break
                 if not line.strip():
                     continue
@@ -213,6 +251,9 @@ class StratumServer:
                     msg = sp.decode_line(line)
                 except ValueError:
                     log.warning("client %d sent invalid JSON", session.id)
+                    if self.ddos is not None and self.ddos.strike(ip, "bad-json"):
+                        log.warning("client %d banned: junk flood", session.id)
+                        break
                     continue
                 await self._handle_message(session, msg)
         except (ConnectionError, asyncio.IncompleteReadError):
@@ -220,6 +261,8 @@ class StratumServer:
         finally:
             self.sessions.pop(session.id, None)
             self.vardiff.forget(session.vardiff_key)
+            if self.ddos is not None:
+                self.ddos.release(ip)
             writer.close()
             log.info("client %d disconnected", session.id)
 
@@ -285,10 +328,15 @@ class StratumServer:
         await session.writer.drain()
 
     async def _on_authorize(self, session: Session, msg: sp.Message) -> None:
+        from otedama_tpu.security import validation as val
+
         params = msg.params or []
         if not params:
             raise sp.StratumError(sp.ERR_OTHER, "missing worker name")
-        session.worker_user = str(params[0])
+        try:
+            session.worker_user = val.validate_worker_name(str(params[0]))
+        except val.ValidationError as e:
+            raise sp.StratumError(sp.ERR_UNAUTHORIZED, str(e)) from None
         session.authorized = True
         await self._reply(session, msg.id, True)
         log.info("client %d authorized as %s", session.id, session.worker_user)

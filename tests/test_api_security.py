@@ -366,3 +366,172 @@ async def test_app_pool_mode_with_local_miner_finds_blocks():
         assert "engine" in obj and "stratum" in obj and "pool" in obj
     finally:
         await app.stop()
+
+
+# -- input validation (reference: internal/security/input_validation.go) -----
+
+def test_validation_rules():
+    from otedama_tpu.security import validation as val
+
+    assert val.validate_hex("deadbeef", exact_bytes=4) == b"\xde\xad\xbe\xef"
+    for bad in ("xyz", "abc", "a" * 4096, 123, "aabb\x00"):
+        with pytest.raises(val.ValidationError):
+            val.validate_hex(bad, max_bytes=16)
+    assert val.validate_worker_name("wallet.rig-1_a") == "wallet.rig-1_a"
+    for bad in ("", "a" * 129, "wal let", "rig;rm -rf", "w\x00x"):
+        with pytest.raises(val.ValidationError):
+            val.validate_worker_name(bad)
+    assert val.contains_injection("1' OR 1=1") == "sql"
+    assert val.contains_injection("../../etc/passwd") == "path-traversal"
+    assert val.contains_injection("x; rm -rf /") == "command"
+    assert val.contains_injection("plain text") is None
+    assert val.sanitize_filename("../../../etc/passwd") == "passwd"
+    assert val.sanitize_filename("a b/c:d.db") == "c_d.db"
+
+
+def test_validation_json_body_caps():
+    from otedama_tpu.security import validation as val
+
+    assert val.validate_json_body(b'{"a": 1}') == {"a": 1}
+    with pytest.raises(val.ValidationError):
+        val.validate_json_body(b"x" * (val.MAX_JSON_BYTES + 1))
+    deep = b'[' * 40 + b']' * 40
+    with pytest.raises(val.ValidationError):
+        val.validate_json_body(deep)
+    many = ("{" + ",".join(f'"k{i}": 1' for i in range(500)) + "}").encode()
+    with pytest.raises(val.ValidationError):
+        val.validate_json_body(many)
+
+
+def test_submit_params_reject_malformed():
+    """Stratum submit fields are shape-checked before decoding."""
+    from otedama_tpu.stratum import protocol as sp
+
+    good = ["w.x", "j1", "0000002a", "68000000", "deadbeef"]
+    sp.ShareSubmission.from_params(good)
+    bad_cases = [
+        ["w x", "j1", "0000002a", "68000000", "deadbeef"],   # bad worker
+        ["w.x", "j" * 200, "0000002a", "68000000", "deadbeef"],  # long job id
+        ["w.x", "j1", "ff" * 64, "68000000", "deadbeef"],    # oversized en2
+        ["w.x", "j1", "0000002a", "6800", "deadbeef"],       # short ntime
+        ["w.x", "j1", "0000002a", "68000000", "deadbeefaa"], # long nonce
+        ["w.x", "j1", "zz00002a", "68000000", "deadbeef"],   # non-hex
+    ]
+    for params in bad_cases:
+        with pytest.raises(sp.StratumError):
+            sp.ShareSubmission.from_params(params)
+
+
+# -- DDoS protection (reference: internal/security/ddos_protection.go) -------
+
+def test_ddos_strike_ban_and_expiry():
+    from otedama_tpu.security.ddos import DDoSConfig, DDoSProtection
+
+    d = DDoSProtection(DDoSConfig(strikes_before_ban=3, ban_seconds=100.0))
+    now = 1000.0
+    assert not d.strike("1.2.3.4", now=now)
+    assert not d.strike("1.2.3.4", now=now + 1)
+    assert d.strike("1.2.3.4", now=now + 2)       # third strike bans
+    assert d.banned("1.2.3.4", now=now + 3)
+    assert not d.allow_connect("1.2.3.4", now=now + 3)
+    assert d.banned("5.6.7.8", now=now) is False
+    assert not d.banned("1.2.3.4", now=now + 200)  # ban expired
+    assert d.allow_connect("1.2.3.4", now=now + 200)
+
+
+def test_ddos_bandwidth_budget():
+    from otedama_tpu.security.ddos import DDoSConfig, DDoSProtection
+
+    d = DDoSProtection(DDoSConfig(bytes_per_window=1000, window_seconds=10.0))
+    now = 50.0
+    assert d.track_bytes("9.9.9.9", 600, now=now)
+    assert not d.track_bytes("9.9.9.9", 600, now=now + 1)  # over budget
+    # window slides: old bytes age out
+    assert d.track_bytes("9.9.9.9", 600, now=now + 20)
+
+
+@pytest.mark.asyncio
+async def test_stratum_junk_flood_trips_guard():
+    """A client spraying malformed JSON gets struck and banned; a
+    legitimate session on another IP keeps working (the flood test the
+    verdict asked for)."""
+    import dataclasses as _dc
+
+    from otedama_tpu.security.ddos import DDoSConfig, DDoSProtection
+    from otedama_tpu.stratum.server import ServerConfig, StratumServer
+    from otedama_tpu.stratum import protocol as sp
+
+    server = StratumServer(ServerConfig(port=0))
+    server.ddos = DDoSProtection(DDoSConfig(
+        strikes_before_ban=5, ban_seconds=60.0,
+        max_concurrent_per_ip=64, connects_per_minute=1000,
+    ))
+    await server.start()
+    try:
+        reader, writer = await asyncio.open_connection("127.0.0.1", server.port)
+        for _ in range(6):
+            writer.write(b'this is not json at all{{{\n')
+        await writer.drain()
+        # server strikes each line; at 5 it bans and cuts the connection
+        assert await reader.read() == b""
+        assert server.ddos.stats["bans"] == 1
+        # banned: immediate reconnect refused
+        r2, w2 = await asyncio.open_connection("127.0.0.1", server.port)
+        assert await r2.read() == b""
+        assert server.ddos.stats["refused_banned"] >= 1
+        w2.close()
+    finally:
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_stratum_oversized_line_cut():
+    from otedama_tpu.stratum.server import ServerConfig, StratumServer
+
+    server = StratumServer(ServerConfig(port=0, max_line_bytes=1024))
+    await server.start()
+    try:
+        reader, writer = await asyncio.open_connection("127.0.0.1", server.port)
+        writer.write(b"A" * 4096 + b"\n")
+        await writer.drain()
+        assert await reader.read() == b""   # cut, not buffered forever
+        assert server.ddos.stats["strikes"] >= 1
+    finally:
+        await server.stop()
+
+
+# -- at-rest encryption (reference: internal/security/encryption.go) ---------
+
+def test_encryption_roundtrip_and_tamper():
+    from otedama_tpu.security import encryption as enc
+
+    sealed = enc.encrypt_bytes(b"wallet seed material", "pass-phrase")
+    assert sealed[:4] == b"OTE1"
+    assert enc.decrypt_bytes(sealed, "pass-phrase") == b"wallet seed material"
+    with pytest.raises(enc.DecryptionError):
+        enc.decrypt_bytes(sealed, "wrong")
+    tampered = sealed[:-1] + bytes([sealed[-1] ^ 1])
+    with pytest.raises(enc.DecryptionError):
+        enc.decrypt_bytes(tampered, "pass-phrase")
+    with pytest.raises(enc.DecryptionError):
+        enc.decrypt_bytes(b"OTE1tooshort", "pass-phrase")
+    # raw-key mode + aad binding
+    key = bytes(range(32))
+    sealed = enc.encrypt_bytes(b"x", key=key, aad=b"ctx")
+    assert enc.decrypt_bytes(sealed, key=key, aad=b"ctx") == b"x"
+    with pytest.raises(enc.DecryptionError):
+        enc.decrypt_bytes(sealed, key=key, aad=b"other")
+
+
+def test_secret_store(tmp_path):
+    from otedama_tpu.security.encryption import SecretStore, DecryptionError
+
+    p = str(tmp_path / "secrets.enc")
+    store = SecretStore(p, "hunter2")
+    store.set("wallet", "xprv123")
+    store.set("pool_pass", "pw")
+    # fresh open with the right passphrase sees the data
+    again = SecretStore(p, "hunter2")
+    assert again.get("wallet") == "xprv123"
+    with pytest.raises(DecryptionError):
+        SecretStore(p, "wrong")

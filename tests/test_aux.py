@@ -292,7 +292,7 @@ async def test_getwork_issue_and_submit():
         shares.append((worker, hdr, digest))
 
     srv = GetworkServer(
-        GetworkConfig(port=0, share_difficulty=0.001), on_share=on_share
+        GetworkConfig(port=0, share_difficulty=0.0001), on_share=on_share
     )
     await srv.start()
     srv.set_job(_mkjob())
@@ -351,7 +351,7 @@ async def test_getwork_hashes_with_algorithm_at_issue_time():
         shares.append((worker, hdr, digest))
 
     srv = GetworkServer(
-        GetworkConfig(port=0, share_difficulty=0.001), on_share=on_share
+        GetworkConfig(port=0, share_difficulty=0.0001), on_share=on_share
     )
     await srv.start()
     srv.set_job(_mkjob())  # algorithm defaults to sha256d
