@@ -43,8 +43,11 @@ class DDoSProtection:
             max_concurrent_per_ip=self.config.max_concurrent_per_ip,
             connects_per_minute=self.config.connects_per_minute,
         )
-        # ip -> deque[(timestamp, nbytes)]
+        # ip -> deque[(timestamp, nbytes)]; running totals kept alongside so
+        # per-line accounting stays O(1) amortized (re-summing the deque
+        # would make the guard itself a quadratic CPU-exhaustion vector)
         self._bytes: dict[str, deque] = {}
+        self._bytes_total: dict[str, int] = {}
         # ip -> deque[timestamp] of strikes
         self._strikes: dict[str, deque] = {}
         self._bans: dict[str, float] = {}  # ip -> ban expiry
@@ -86,12 +89,11 @@ class DDoSProtection:
         now = time.monotonic() if now is None else now
         dq = self._bytes.setdefault(ip, deque())
         dq.append((now, n))
+        total = self._bytes_total.get(ip, 0) + n
         cutoff = now - self.config.window_seconds
-        total = 0
         while dq and dq[0][0] < cutoff:
-            dq.popleft()
-        for _, nb in dq:
-            total += nb
+            total -= dq.popleft()[1]
+        self._bytes_total[ip] = total
         if total > self.config.bytes_per_window:
             self.stats["bandwidth_cut"] += 1
             self.strike(ip, "bandwidth", now=now)
@@ -133,13 +135,22 @@ class DDoSProtection:
         now = time.monotonic() if now is None else now
         cutoff = now - max(self.config.window_seconds * 2,
                            self.config.strike_decay_seconds)
-        for table in (self._bytes, self._strikes):
-            for ip in list(table):
-                dq = table[ip]
-                while dq and (dq[0][0] if isinstance(dq[0], tuple) else dq[0]) < cutoff:
-                    dq.popleft()
-                if not dq:
-                    del table[ip]
+        for ip in list(self._bytes):
+            dq = self._bytes[ip]
+            total = self._bytes_total.get(ip, 0)
+            while dq and dq[0][0] < cutoff:
+                total -= dq.popleft()[1]
+            if dq:
+                self._bytes_total[ip] = total
+            else:
+                del self._bytes[ip]
+                self._bytes_total.pop(ip, None)
+        for ip in list(self._strikes):
+            dq = self._strikes[ip]
+            while dq and dq[0] < cutoff:
+                dq.popleft()
+            if not dq:
+                del self._strikes[ip]
         for ip in list(self._bans):
             if now >= self._bans[ip]:
                 del self._bans[ip]

@@ -47,9 +47,11 @@ class ServerConfig:
     # optional custom extranonce1 allocator (session_id -> bytes); the proxy
     # uses this to nest downstream sessions inside an upstream allocation
     extranonce1_factory: Callable[[int], bytes] | None = None
-    # per-IP DDoS protection (reference: internal/security/ddos_protection.go);
-    # None = build one from defaults, False-like via ddos_enabled to disable
+    # per-IP DDoS protection (reference: internal/security/ddos_protection.go).
+    # Tunable like vardiff: operators behind NAT-heavy farms raise the
+    # per-IP caps here instead of patching the guard after construction.
     ddos_enabled: bool = True
+    ddos: "DDoSConfig | None" = None     # None = DDoSConfig() defaults
     max_line_bytes: int = 16 * 1024      # one JSON-RPC line cap
 
 
@@ -128,7 +130,7 @@ class StratumServer:
         from otedama_tpu.security.ddos import DDoSProtection
 
         self.ddos: DDoSProtection | None = (
-            DDoSProtection() if self.config.ddos_enabled else None
+            DDoSProtection(self.config.ddos) if self.config.ddos_enabled else None
         )
 
     # -- lifecycle ----------------------------------------------------------
@@ -227,7 +229,7 @@ class StratumServer:
                     # the connection and strike the IP
                     log.warning("client %d line overrun", session.id)
                     if self.ddos is not None:
-                        self.ddos.strike(session.peer.rsplit(":", 1)[0], "overrun")
+                        self.ddos.strike(ip, "overrun")
                     break
                 except asyncio.IncompleteReadError as e:
                     if e.partial:
