@@ -61,15 +61,20 @@ def bench_sha256d() -> dict:
 
     if on_tpu:
         from otedama_tpu.kernels import sha256_pallas as sp
+        from otedama_tpu.tuner import load_tuned
 
-        sub, unroll = 32, 4
+        tuned = load_tuned() or {}
+        sub = tuned.get("sub", 32)
+        unroll = tuned.get("unroll", 4)
+        inner = tuned.get("inner")
         jw = sp.pack_job_words(jc.midstate, jc.tail, 0, jc.limbs)
 
         def launch(batch: int, base: int):
             j = jw.copy()
             j[11] = np.uint32(base & 0xFFFFFFFF)
             return sp.sha256d_pallas_search(
-                j, batch=batch, sub=sub, unroll=unroll, interpret=False
+                j, batch=batch, sub=sub, unroll=unroll, inner=inner,
+                interpret=False,
             )
 
         def timed(batch: int, iters: int) -> float:
@@ -157,21 +162,33 @@ def bench_scrypt() -> dict:
     }
 
 
-def bench_x11() -> dict:
-    """BASELINE.md config 3: x11 chained 11-hash pipeline rate."""
-    import numpy as np
+def bench_x11(backend_kind: str = "numpy") -> dict:
+    """BASELINE.md config 3: x11 chained 11-hash pipeline rate.
 
-    from otedama_tpu.runtime.search import X11NumpyBackend
+    ``--x11-backend jax`` drives the DEVICE chain (kernels/x11/jnp_chain —
+    one jitted XLA program for all 11 stages); expect a multi-minute
+    one-off compile before the measured window.
+    """
+    from otedama_tpu.runtime.search import X11JaxBackend, X11NumpyBackend
 
     jc = _job_constants()
-    backend = X11NumpyBackend(chunk=1 << 10)
-    backend.search(jc, 0, 1 << 10)  # warmup
+    if backend_kind == "jax":
+        chunk = 1 << 13
+        backend = X11JaxBackend(chunk=chunk)
+        log("bench: compiling the 11-stage device chain (minutes) ...")
+        t0 = time.monotonic()
+        backend.search(jc, 0, chunk)  # compile + warmup
+        log(f"bench: compile+warmup {time.monotonic() - t0:.1f}s")
+        count = chunk * 8
+    else:
+        backend = X11NumpyBackend(chunk=1 << 10)
+        backend.search(jc, 0, 1 << 10)  # warmup
+        count = 1 << 12
     t0 = time.monotonic()
-    count = 1 << 12
-    backend.search(jc, 1 << 10, count)
+    backend.search(jc, 1 << 14, count)
     dt = time.monotonic() - t0
     hs = count / dt
-    log(f"bench: x11 {count} hashes in {dt:.2f}s -> {hs:.1f} H/s")
+    log(f"bench: x11[{backend.name}] {count} hashes in {dt:.2f}s -> {hs:.1f} H/s")
     return {
         "metric": "x11_hashrate_per_chip",
         "value": round(hs, 1),
@@ -248,14 +265,17 @@ def main() -> None:
                     choices=("sha256d", "scrypt", "x11"))
     ap.add_argument("--engine-path", action="store_true",
                     help="measure through the live engine loop")
+    ap.add_argument("--x11-backend", default="numpy", choices=("numpy", "jax"),
+                    help="x11 execution tier (jax = device chain)")
     args = ap.parse_args()
     if args.engine_path:
         out = bench_engine_path()
+    elif args.algo == "x11":
+        out = bench_x11(args.x11_backend)
     else:
         out = {
             "sha256d": bench_sha256d,
             "scrypt": bench_scrypt,
-            "x11": bench_x11,
         }[args.algo]()
     print(json.dumps(out))
 

@@ -139,8 +139,11 @@ def x11_digest(data: bytes) -> bytes:
     return h[:32]
 
 
-# registry: all 11 stages loaded -> the numpy chained pipeline is live
+# registry: all 11 stages loaded -> the numpy chained pipeline is live,
+# and so is its device twin (kernels.x11.jnp_chain via runtime.search's
+# X11JaxBackend — every stage is tested bit-identical to the numpy oracle)
 from otedama_tpu.engine import algos as _algos  # noqa: E402
 
 if not missing_stages():
     _algos.mark_implemented("x11", "numpy")
+    _algos.mark_implemented("x11", "jax")

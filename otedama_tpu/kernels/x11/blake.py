@@ -63,7 +63,7 @@ def blake512_compress(h: list, m: list, t0: int, t1: int = 0) -> list:
     ``h``: 8 uint64 lanes; ``m``: 16 uint64 lanes (big-endian words of the
     128-byte block); ``t0``/``t1``: bit counter. Returns the new 8-word h.
     """
-    zero = np.zeros_like(h[0])
+    zero = h[0] ^ h[0]  # works for numpy lanes AND jax tracers
     t0w = U64(t0 & 0xFFFFFFFFFFFFFFFF)
     t1w = U64(t1 & 0xFFFFFFFFFFFFFFFF)
     v = list(h) + [

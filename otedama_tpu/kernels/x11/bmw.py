@@ -86,8 +86,9 @@ def bmw512_compress(H: list, M: list) -> list:
 
     Q = []
     for i in range(16):
-        # first term of every row is +1, so start from a copy of it
-        w = T[_W_TERMS[i][0][1]].copy()
+        # first term of every row is +1, so start from it (xor-0 copy works
+        # for numpy lanes AND jax tracers)
+        w = T[_W_TERMS[i][0][1]] ^ U64(0)
         for sign, j in _W_TERMS[i][1:]:
             w = w + T[j] if sign > 0 else w - T[j]
         Q.append(_S_ORDER[i % 5](w) + H[(i + 1) % 16])

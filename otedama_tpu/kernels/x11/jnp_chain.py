@@ -1,0 +1,786 @@
+"""x11 chained pipeline on the device (JAX/XLA — BASELINE config 3).
+
+The host numpy chain (this package's stage modules) is the correctness
+oracle; this module re-expresses every stage in jnp so the WHOLE 11-stage
+chain jits into one XLA program over a nonce batch.
+
+Design notes:
+- Round loops are ``lax.scan`` with the round body compiled ONCE and
+  per-round constants fed as scan inputs (gathered sigma rows, round
+  constants, subkeys, AES keys). Unrolled python loops are NOT an option
+  here: XLA:CPU's elemental fusion emitter re-evaluates shared
+  subexpressions, and an unrolled 16-round blake compress showed measured
+  EXPONENTIAL runtime in the round count (2 rounds: instant; 4 rounds:
+  6 s; 8 rounds: minutes+). Scan bounds fusion to one round body and
+  keeps compile time linear.
+- simd's 256-point NTT over Z_257 runs as an f32 matmul on the MXU
+  (values < 2^23, exact in f32).
+- x11 inputs are fixed-shape — an 80-byte header into blake512, 64-byte
+  digests after — so padding is baked at trace time; no dynamic shapes.
+- 64-bit stages run under the scoped ``jax.enable_x64`` context (TPU
+  emulates u64 as 32-bit pairs).
+
+Every stage is tested bit-identical to its numpy twin, and the chain to
+the host ``x11.x11_digest`` oracle (tests/test_x11.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from otedama_tpu.kernels.x11 import (
+    blake,
+    bmw,
+    cubehash,
+    echo,
+    groestl,
+    jh,
+    keccak,
+    luffa,
+    shavite,
+    simd,
+    skein,
+)
+
+U8 = jnp.uint8
+U32 = jnp.uint32
+U64 = jnp.uint64
+
+
+# -- byte <-> word helpers (static shapes, no .view tricks) -------------------
+
+def _bytes_to_words(b, width: int, endian: str):
+    """[B, n] uint8 -> [B, n/width] uint{32,64} words."""
+    Bn, n = b.shape
+    dt = U32 if width == 4 else U64
+    w = b.reshape(Bn, n // width, width).astype(dt)
+    out = jnp.zeros((Bn, n // width), dtype=dt)
+    for k in range(width):
+        sh = 8 * (k if endian == "little" else width - 1 - k)
+        out = out | (w[:, :, k] << dt(sh))
+    return out
+
+
+def _words_to_bytes(w, width: int, endian: str):
+    Bn, n = w.shape
+    outs = []
+    for k in range(width):
+        sh = 8 * (k if endian == "little" else width - 1 - k)
+        outs.append(((w >> w.dtype.type(sh)) & w.dtype.type(0xFF)).astype(U8))
+    return jnp.stack(outs, axis=-1).reshape(Bn, n * width)
+
+
+def _const_rows(byts: bytes) -> np.ndarray:
+    return np.frombuffer(byts, dtype=np.uint8)
+
+
+def _rotl64(x, n: int):
+    n &= 63
+    if n == 0:
+        return x
+    return (x << U64(n)) | (x >> U64(64 - n))
+
+
+def _rotl32(x, n: int):
+    n &= 31
+    if n == 0:
+        return x
+    return (x << U32(n)) | (x >> U32(32 - n))
+
+
+# -- stage 1: blake512 of the 80-byte header ---------------------------------
+
+@functools.lru_cache(maxsize=1)
+def _blake_tables():
+    # NB: cached tables are NUMPY — a jnp array materialized inside a jit
+    # trace is that trace's constant, and caching it leaks the tracer
+    sig = np.array([blake.SIGMA[r % 10] for r in range(16)], dtype=np.int32)
+    c = np.asarray(blake.C512, dtype=np.uint64)
+    return sig, c
+
+
+def blake512_80(headers):
+    """[B, 80] uint8 -> [B, 64] digest bytes."""
+    Bn = headers.shape[0]
+    sig, c512 = _blake_tables()
+    m = jnp.zeros((Bn, 16), dtype=U64)
+    m = m.at[:, :10].set(_bytes_to_words(headers, 8, "big"))
+    m = m.at[:, 10].set(U64(0x8000000000000000))
+    m = m.at[:, 13].set(U64(0x01))
+    m = m.at[:, 15].set(U64(640))
+
+    h = jnp.broadcast_to(
+        jnp.asarray(np.asarray(blake.IV512, dtype=np.uint64)), (Bn, 8)
+    )
+    t0 = np.uint64(640)
+    vtail = np.array(
+        [
+            blake.C512[0], blake.C512[1], blake.C512[2], blake.C512[3],
+            t0 ^ blake.C512[4], t0 ^ blake.C512[5],
+            blake.C512[6], blake.C512[7],
+        ],
+        dtype=np.uint64,
+    )
+    vinit = jnp.concatenate(
+        [h, jnp.broadcast_to(jnp.asarray(vtail), (Bn, 8))], axis=1
+    )
+
+    def round_body(v, sig_row):
+        ms = jnp.take(m, sig_row, axis=1)          # [B, 16]
+        cs = jnp.take(c512, sig_row)               # [16]
+        vl = [v[:, i] for i in range(16)]
+
+        def G(a, b, cc, d, i):
+            vl[a] = vl[a] + vl[b] + (ms[:, 2 * i] ^ cs[2 * i + 1])
+            vl[d] = _rotl64(vl[d] ^ vl[a], 64 - 32)
+            vl[cc] = vl[cc] + vl[d]
+            vl[b] = _rotl64(vl[b] ^ vl[cc], 64 - 25)
+            vl[a] = vl[a] + vl[b] + (ms[:, 2 * i + 1] ^ cs[2 * i])
+            vl[d] = _rotl64(vl[d] ^ vl[a], 64 - 16)
+            vl[cc] = vl[cc] + vl[d]
+            vl[b] = _rotl64(vl[b] ^ vl[cc], 64 - 11)
+
+        G(0, 4, 8, 12, 0)
+        G(1, 5, 9, 13, 1)
+        G(2, 6, 10, 14, 2)
+        G(3, 7, 11, 15, 3)
+        G(0, 5, 10, 15, 4)
+        G(1, 6, 11, 12, 5)
+        G(2, 7, 8, 13, 6)
+        G(3, 4, 9, 14, 7)
+        return jnp.stack(vl, axis=1), None
+
+    v, _ = lax.scan(round_body, vinit, jnp.asarray(sig))
+    out = h ^ v[:, :8] ^ v[:, 8:]
+    return _words_to_bytes(out, 8, "big")
+
+
+# -- bmw512 (two compress calls; wide, not deep — direct core reuse) ---------
+
+def bmw512_64(data):
+    Bn = data.shape[0]
+    w = _bytes_to_words(data, 8, "little")
+    M = [w[:, i] for i in range(8)]
+    M.append(jnp.full((Bn,), U64(0x80), dtype=U64))
+    for _ in range(9, 15):
+        M.append(jnp.zeros((Bn,), dtype=U64))
+    M.append(jnp.full((Bn,), U64(512), dtype=U64))
+    H = [jnp.full((Bn,), U64(int(v)), dtype=U64) for v in bmw.IV512]
+    H = bmw.bmw512_compress(H, M)
+    Hf = [jnp.full((Bn,), U64(int(v)), dtype=U64) for v in bmw.FINAL512]
+    H = bmw.bmw512_compress(Hf, H)
+    return _words_to_bytes(jnp.stack(H[8:], axis=-1), 8, "little")
+
+
+# -- groestl512 ---------------------------------------------------------------
+
+@functools.lru_cache(maxsize=1)
+def _groestl_tables():
+    return groestl.aes_sbox(), groestl._gf_tables()
+
+
+def _groestl_permute(state, variant: str):
+    """P1024/Q1024 over [B, 8, 16] uint8 via a 14-round scan."""
+    sbox, gf = _groestl_tables()
+    shifts = groestl._SHIFT_P if variant == "P" else groestl._SHIFT_Q
+    cols = jnp.arange(16, dtype=U8) << U8(4)
+    rounds = jnp.arange(14, dtype=U8)
+
+    def body(st, r):
+        if variant == "P":
+            st = st.at[:, 0, :].set(st[:, 0, :] ^ cols ^ r)
+        else:
+            st = st ^ U8(0xFF)
+            st = st.at[:, 7, :].set(st[:, 7, :] ^ cols ^ r)
+        st = jnp.take(sbox, st)
+        st = jnp.stack(
+            [jnp.roll(st[:, i, :], -shifts[i], axis=-1) for i in range(8)],
+            axis=1,
+        )
+        out = jnp.zeros_like(st)
+        for m, mult in enumerate(groestl._MIX):
+            rolled = jnp.roll(st, -m, axis=1)
+            out = out ^ (jnp.take(gf[mult], rolled) if mult != 1 else rolled)
+        return out, None
+
+    state, _ = lax.scan(body, state, rounds)
+    return state
+
+
+def groestl512_64(data):
+    Bn = data.shape[0]
+    pad = _const_rows(bytes([0x80] + [0] * 55 + list((1).to_bytes(8, "big"))))
+    block = jnp.concatenate(
+        [data, jnp.broadcast_to(jnp.asarray(pad), (Bn, 64))], axis=1
+    )
+    M = block.reshape(Bn, 16, 8).transpose(0, 2, 1)
+    H = jnp.zeros((Bn, 8, 16), dtype=U8).at[:, 6, 15].set(U8(0x02))
+    H = _groestl_permute(H ^ M, "P") ^ _groestl_permute(M, "Q") ^ H
+    out = _groestl_permute(H, "P") ^ H
+    return out.transpose(0, 2, 1).reshape(Bn, 128)[:, 64:]
+
+
+# -- skein512 (Threefish-512 via an 18-group scan) ---------------------------
+
+def _threefish_scan(key, tweak, block):
+    """key/block: [B, 8] u64; tweak: (t0, t1) python ints."""
+    k8 = jnp.full((key.shape[0],), U64(skein.C240), dtype=U64)
+    klanes = [key[:, i] for i in range(8)]
+    for kk in klanes:
+        k8 = k8 ^ kk
+    klist = klanes + [k8]
+    t = [
+        np.uint64(tweak[0] & 0xFFFFFFFFFFFFFFFF),
+        np.uint64(tweak[1] & 0xFFFFFFFFFFFFFFFF),
+        np.uint64((tweak[0] ^ tweak[1]) & 0xFFFFFFFFFFFFFFFF),
+    ]
+    subkeys = []
+    for s in range(19):
+        ks = [klist[(s + i) % 9] for i in range(8)]
+        ks[5] = ks[5] + t[s % 3]
+        ks[6] = ks[6] + t[(s + 1) % 3]
+        ks[7] = ks[7] + U64(s)
+        subkeys.append(jnp.stack(ks, axis=1))        # [B, 8]
+    subkeys = jnp.stack(subkeys, axis=0)             # [19, B, 8]
+
+    # rotation table per group: group g runs rounds 4g..4g+3 -> R512 rows
+    rot = np.array(
+        [[skein.R512[(4 * g + i) % 8] for i in range(4)] for g in range(18)],
+        dtype=np.uint32,
+    )                                                 # [18, 4, 4]
+
+    def rotl_traced(x, n):
+        n = n.astype(U64) & U64(63)
+        return (x << n) | (x >> (U64(64) - n))
+
+    perm = list(skein.PERM)
+
+    def group(v, xs):
+        sk, rots = xs                                # [B, 8], [4, 4]
+        v = v + sk
+        vl = [v[:, i] for i in range(8)]
+        for rr in range(4):
+            for j in range(4):
+                a, b = vl[2 * j], vl[2 * j + 1]
+                a = a + b
+                b = rotl_traced(b, rots[rr, j]) ^ a
+                vl[2 * j], vl[2 * j + 1] = a, b
+            vl = [vl[perm[i]] for i in range(8)]
+        return jnp.stack(vl, axis=1), None
+
+    v, _ = lax.scan(group, block, (subkeys[:18], jnp.asarray(rot)))
+    return v + subkeys[18]
+
+
+def skein512_64(data):
+    Bn = data.shape[0]
+    m = _bytes_to_words(data, 8, "little")
+    iv = jnp.broadcast_to(
+        jnp.asarray(np.array(skein.IV512, dtype=np.uint64)), (Bn, 8)
+    )
+    t1 = (skein.T_MSG << 56) | (1 << 62) | (1 << 63)
+    G = _threefish_scan(iv, (64, t1), m) ^ m
+    zero = jnp.zeros((Bn, 8), dtype=U64)
+    t1o = (skein.T_OUT << 56) | (1 << 62) | (1 << 63)
+    out = _threefish_scan(G, (8, t1o), zero)
+    return _words_to_bytes(out, 8, "little")
+
+
+# -- jh512 --------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=1)
+def _jh_tables():
+    inter, deinter = jh._interleave()
+    return (jh.S0, jh.S1, jh._MUL2, jh.round_constants().astype(bool),
+            inter, deinter, jh._perm_indices(8))
+
+
+def jh512_64(data):
+    Bn = data.shape[0]
+    S0, S1, MUL2, C, inter, deinter, perm8 = _jh_tables()
+    iv = jh._iv512()
+    H = jnp.broadcast_to(iv, (Bn, 128))
+    pad = _const_rows(bytes([0x80] + [0] * 61 + [0x02, 0x00]))
+    blocks = [data, jnp.broadcast_to(jnp.asarray(pad), (Bn, 64))]
+
+    def bits_of(bytes_arr):  # msb-first
+        shifts = jnp.arange(7, -1, -1, dtype=U8)
+        return ((bytes_arr[:, :, None] >> shifts) & U8(1)).reshape(
+            bytes_arr.shape[0], -1
+        )
+
+    def bytes_of(bits):
+        b = bits.reshape(bits.shape[0], -1, 8)
+        out = jnp.zeros(b.shape[:2], dtype=U8)
+        for k in range(8):
+            out = out | (b[:, :, k] << U8(7 - k))
+        return out
+
+    def round_body(A, cbits):
+        A = jnp.where(cbits[None, :], jnp.take(S1, A), jnp.take(S0, A))
+        a = A[:, 0::2]
+        b = A[:, 1::2]
+        b = b ^ jnp.take(MUL2, a)
+        a = a ^ jnp.take(MUL2, b)
+        A = jnp.stack([a, b], axis=-1).reshape(A.shape[0], 256)
+        return A[:, perm8], None
+
+    for M in blocks:
+        H = jnp.concatenate([H[:, :64] ^ M, H[:, 64:]], axis=1)
+        bits = bits_of(H)
+        q = (
+            (bits[:, 0:256] << U8(3))
+            | (bits[:, 256:512] << U8(2))
+            | (bits[:, 512:768] << U8(1))
+            | bits[:, 768:1024]
+        )
+        A, _ = lax.scan(round_body, q[:, inter], jnp.asarray(C))
+        A = A[:, deinter]
+        bits = jnp.concatenate(
+            [(A >> U8(3)) & U8(1), (A >> U8(2)) & U8(1),
+             (A >> U8(1)) & U8(1), A & U8(1)],
+            axis=1,
+        )
+        out = bytes_of(bits)
+        H = jnp.concatenate([out[:, :64], out[:, 64:] ^ M], axis=1)
+    return H[:, 64:]
+
+
+# -- keccak512 ----------------------------------------------------------------
+
+def keccak512_64(data):
+    Bn = data.shape[0]
+    w = _bytes_to_words(data, 8, "little")
+    state = jnp.zeros((Bn, 25), dtype=U64)
+    state = state.at[:, :8].set(w)
+    state = state.at[:, 8].set(U64(0x8000000000000001))
+    rc = jnp.asarray(np.asarray(keccak.RC, dtype=np.uint64))
+
+    def round_body(A, rck):
+        Al = [A[:, i] for i in range(25)]
+        Cl = [Al[x] ^ Al[x + 5] ^ Al[x + 10] ^ Al[x + 15] ^ Al[x + 20]
+              for x in range(5)]
+        Dl = [Cl[(x - 1) % 5] ^ _rotl64(Cl[(x + 1) % 5], 1) for x in range(5)]
+        Al = [Al[x + 5 * y] ^ Dl[x] for y in range(5) for x in range(5)]
+        Bl = [None] * 25
+        for x in range(5):
+            for y in range(5):
+                Bl[y + 5 * ((2 * x + 3 * y) % 5)] = _rotl64(
+                    Al[x + 5 * y], keccak.RHO[x][y]
+                )
+        Al = [
+            Bl[x + 5 * y]
+            ^ ((~Bl[(x + 1) % 5 + 5 * y]) & Bl[(x + 2) % 5 + 5 * y])
+            for y in range(5)
+            for x in range(5)
+        ]
+        Al[0] = Al[0] ^ rck
+        return jnp.stack(Al, axis=1), None
+
+    state, _ = lax.scan(round_body, state, rc)
+    return _words_to_bytes(state[:, :8], 8, "little")
+
+
+# -- luffa512 -----------------------------------------------------------------
+
+@functools.lru_cache(maxsize=1)
+def _luffa_tables():
+    return [np.array(luffa.CNS[j], dtype=np.uint32) for j in range(5)]
+
+
+def _luffa_q(x, j):
+    """Permutation Q_j over [B, 8] u32 via an 8-step scan."""
+    cns = _luffa_tables()[j]
+    if j:
+        x = x.at[:, 4:].set(
+            jnp.stack([_rotl32(x[:, i], j) for i in range(4, 8)], axis=1)
+        )
+
+    def step(xc, c):
+        xl = [xc[:, i] for i in range(8)]
+        xl[0], xl[1], xl[2], xl[3] = luffa._sub_crumb(
+            xl[0], xl[1], xl[2], xl[3]
+        )
+        xl[5], xl[6], xl[7], xl[4] = luffa._sub_crumb(
+            xl[5], xl[6], xl[7], xl[4]
+        )
+        for i in range(4):
+            xl[i], xl[i + 4] = luffa._mix_word(xl[i], xl[i + 4])
+        xl[0] = xl[0] ^ c[0]
+        xl[4] = xl[4] ^ c[1]
+        return jnp.stack(xl, axis=1), None
+
+    x, _ = lax.scan(step, x, jnp.asarray(cns))
+    return x
+
+
+def luffa512_64(data):
+    Bn = data.shape[0]
+    w = _bytes_to_words(data, 4, "big")
+    V = [
+        jnp.broadcast_to(
+            jnp.asarray(np.array(luffa.IV[j], dtype=np.uint32)), (Bn, 8)
+        )
+        for j in range(5)
+    ]
+
+    def mi5(V, M):
+        Vl = [[v[:, i] for i in range(8)] for v in V]
+        Ml = [M[:, i] for i in range(8)]
+        out = luffa._mi5(Vl, Ml)
+        return [jnp.stack(o, axis=1) for o in out]
+
+    zero = jnp.zeros((Bn, 8), dtype=U32)
+    pad = jnp.zeros((Bn, 8), dtype=U32).at[:, 0].set(U32(0x80000000))
+    outs = []
+    for M in (w[:, :8], w[:, 8:], pad, None, None):
+        V = mi5(V, zero if M is None else M)
+        V = [_luffa_q(V[j], j) for j in range(5)]
+        if M is None:
+            outs.append(V[0] ^ V[1] ^ V[2] ^ V[3] ^ V[4])
+    return _words_to_bytes(jnp.concatenate(outs, axis=1), 4, "big")
+
+
+# -- cubehash512 --------------------------------------------------------------
+
+def _cubehash_scan(x, n_rounds: int):
+    def body(xc, _):
+        xl = [xc[:, i] for i in range(32)]
+        xl = cubehash.cubehash_rounds(xl, 1)
+        return jnp.stack(xl, axis=1), None
+
+    x, _ = lax.scan(body, x, None, length=n_rounds)
+    return x
+
+
+def cubehash512_64(data):
+    Bn = data.shape[0]
+    w = _bytes_to_words(data, 4, "little")
+    iv = cubehash._iv512()
+    x = jnp.broadcast_to(
+        jnp.asarray(np.asarray(iv, dtype=np.uint32)), (Bn, 32)
+    )
+    for blk in range(2):
+        x = x.at[:, :8].set(x[:, :8] ^ w[:, blk * 8 : blk * 8 + 8])
+        x = _cubehash_scan(x, 16)
+    x = x.at[:, 0].set(x[:, 0] ^ U32(0x80))
+    x = _cubehash_scan(x, 16)
+    x = x.at[:, 31].set(x[:, 31] ^ U32(1))
+    x = _cubehash_scan(x, 160)
+    return _words_to_bytes(x[:, :16], 4, "little")
+
+
+# -- AES helpers (shared by shavite/echo) -------------------------------------
+
+@functools.lru_cache(maxsize=1)
+def _aes_tables():
+    gf = groestl._gf_tables()
+    return groestl.aes_sbox(), gf[2], gf[3], echo._AES_SHIFT
+
+
+def _aes_round_j(w, key):
+    """One AES round on [B, 16] byte states (column-major); key [..., 16]."""
+    sbox, m2, m3, shift = _aes_tables()
+    s = jnp.take(sbox, w)[:, shift]
+    a = s.reshape(s.shape[0], 4, 4)  # [B, col, row]
+    a0, a1, a2, a3 = a[:, :, 0], a[:, :, 1], a[:, :, 2], a[:, :, 3]
+    out = jnp.stack(
+        [
+            jnp.take(m2, a0) ^ jnp.take(m3, a1) ^ a2 ^ a3,
+            a0 ^ jnp.take(m2, a1) ^ jnp.take(m3, a2) ^ a3,
+            a0 ^ a1 ^ jnp.take(m2, a2) ^ jnp.take(m3, a3),
+            jnp.take(m3, a0) ^ a1 ^ a2 ^ jnp.take(m2, a3),
+        ],
+        axis=-1,
+    ).reshape(w.shape)
+    return out ^ key
+
+
+# -- shavite512 ---------------------------------------------------------------
+
+def _aes0_words_j(w4):
+    """Keyless AES round over [B, 4] u32 LE quadruple."""
+    return _bytes_to_words(
+        _aes_round_j(
+            _words_to_bytes(w4, 4, "little"), jnp.zeros(16, dtype=U8)
+        ),
+        4,
+        "little",
+    )
+
+
+def shavite512_64(data):
+    Bn = data.shape[0]
+    tail = _const_rows(bytes(
+        [0x80] + [0] * 45 + list((512).to_bytes(16, "little"))
+        + list((512).to_bytes(2, "little"))
+    ))
+    block = jnp.concatenate(
+        [data, jnp.broadcast_to(jnp.asarray(tail), (Bn, 64))], axis=1
+    )
+    w = _bytes_to_words(block, 4, "little")
+    cnt = [np.uint32(x) for x in (512, 0, 0, 0)]
+    rk = [w[:, i] for i in range(32)]
+    u = 32
+    nonlinear = True
+    while u < shavite.RK_WORDS:
+        if nonlinear:
+            for _ in range(8):
+                x4 = jnp.stack(
+                    [rk[u - 31], rk[u - 30], rk[u - 29], rk[u - 32]], axis=1
+                )
+                x4 = _aes0_words_j(x4)
+                for j in range(4):
+                    rk.append(x4[:, j] ^ rk[u - 4 + j])
+                order = shavite._CNT_INJECT.get(u)
+                if order is not None:
+                    for j in range(4):
+                        wv = cnt[order[j]]
+                        if j == 3:
+                            wv = ~wv
+                        rk[u + j] = rk[u + j] ^ U32(int(wv))
+                u += 4
+        else:
+            for _ in range(8):
+                for j in range(4):
+                    rk.append(rk[u - 32 + j] ^ rk[u - 7 + j])
+                u += 4
+        nonlinear = not nonlinear
+
+    rk_all = jnp.stack(rk, axis=1).reshape(Bn, 14, 32).transpose(1, 0, 2)
+    h = jnp.broadcast_to(
+        jnp.asarray(np.array(shavite.IV512, dtype=np.uint32)), (Bn, 16)
+    )
+
+    def f4(x4, keys):
+        t = x4 ^ keys[:, 0:4]
+        for r in range(1, 4):
+            t = _aes0_words_j(t)
+            t = t ^ keys[:, 4 * r : 4 * r + 4]
+        return _aes0_words_j(t)
+
+    def round_body(p, k):
+        # quarters p0..p3 = columns [0:4],[4:8],[8:12],[12:16]
+        f1 = f4(p[:, 4:8], k[:, :16])
+        f2 = f4(p[:, 12:16], k[:, 16:])
+        p0 = p[:, 0:4] ^ f1
+        p2 = p[:, 8:12] ^ f2
+        newp = jnp.concatenate([p[:, 12:16], p0, p[:, 4:8], p2], axis=1)
+        return newp, None
+
+    p, _ = lax.scan(round_body, h, rk_all)
+    return _words_to_bytes(h ^ p, 4, "little")
+
+
+# -- simd512 ------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=1)
+def _simd_tables():
+    ntt = simd._ntt_matrix().astype(np.float32)  # [256, 256], exact in f32
+    normal, final = simd._twist_tables()
+    rs, ss, is_if, permrows, wbase = [], [], [], [], []
+    for st in range(32):
+        rnd, k = divmod(st, 8)
+        c = simd.ROUND_ROTS[rnd]
+        rs.append(c[k % 4])
+        ss.append(c[(k + 1) % 4])
+        is_if.append(1 if k < 4 else 0)
+        p = simd.PMASK[st]
+        permrows.append([j ^ p for j in range(8)])
+        wbase.append(simd.WSP[st] * 8)
+    return (
+        ntt,
+        np.asarray(normal, dtype=np.int32),
+        np.asarray(final, dtype=np.int32),
+        np.array(rs, dtype=np.uint32),
+        np.array(ss, dtype=np.uint32),
+        np.array(is_if, dtype=np.uint32),
+        np.array(permrows, dtype=np.int32),
+        np.array(wbase, dtype=np.int64),
+    )
+
+
+def _simd_expand_j(block_bytes, final: bool):
+    Bn = block_bytes.shape[0]
+    ntt, tw_n, tw_f, *_ = _simd_tables()
+    x = jnp.zeros((Bn, 256), dtype=jnp.float32).at[:, :128].set(
+        block_bytes.astype(jnp.float32)
+    )
+    y = jnp.dot(x, jnp.asarray(ntt).T, precision=lax.Precision.HIGHEST)
+    y = jnp.mod(y, 257.0).astype(jnp.int32)
+    tw = tw_f if final else tw_n
+    s = (y * jnp.asarray(tw)) % 257
+    s = jnp.where(s > 128, s - 257, s)
+    lo = s
+    hi = jnp.roll(s, -128, axis=1)
+    W = (lo & 0xFFFF) | ((hi & 0xFFFF) << 16)
+    return W.astype(U32)
+
+
+def _simd_compress_j(state, block_bytes, final: bool):
+    """state: [B, 32] u32 (A|B|C|D rows of 8)."""
+    _, _, _, rs, ss, is_if, permrows, wbase = _simd_tables()
+    W = _simd_expand_j(block_bytes, final)
+    saved = state
+    m32 = _bytes_to_words(block_bytes, 4, "little")
+    state = state ^ m32
+
+    widx = np.stack([np.arange(8) + b for b in wbase])  # [32, 8]
+    Wsteps = jnp.take(W, jnp.asarray(widx), axis=1)     # [B, 32, 8]
+    Wsteps = jnp.transpose(Wsteps, (1, 0, 2))           # [32, B, 8]
+
+    def rotl_traced(x, n):
+        n = n.astype(U32) & U32(31)
+        return (x << n) | (x >> (U32(32) - n))
+
+    def step_body(st, xs):
+        w, r, s, flag, prow = xs
+        A, Bv, C, D = st[:, 0:8], st[:, 8:16], st[:, 16:24], st[:, 24:32]
+        tA = rotl_traced(A, r)
+        fIF = ((Bv ^ C) & A) ^ C
+        fMAJ = (C & Bv) | ((C | Bv) & A)
+        f = jnp.where(flag.astype(bool), fIF, fMAJ)
+        newA = rotl_traced(D + w + f, s) + jnp.take(tA, prow, axis=1)
+        return jnp.concatenate([newA, tA, Bv, C], axis=1), None
+
+    state, _ = lax.scan(
+        step_body,
+        state,
+        (
+            Wsteps,
+            jnp.asarray(rs),
+            jnp.asarray(ss),
+            jnp.asarray(is_if),
+            jnp.asarray(permrows),
+        ),
+    )
+
+    # final 4 feed-forward steps (static, small)
+    for fs in range(4):
+        r, s = simd.FF_ROTS[fs]
+        p = simd.PMASK[32 + fs]
+        A, Bv, C, D = (
+            state[:, 0:8], state[:, 8:16], state[:, 16:24], state[:, 24:32]
+        )
+        w = saved[:, 8 * fs : 8 * fs + 8]
+        tA = jnp.stack([_rotl32(A[:, j], r) for j in range(8)], axis=1)
+        f = ((Bv ^ C) & A) ^ C
+        acc = D + w + f
+        newA = jnp.stack(
+            [_rotl32(acc[:, j], s) for j in range(8)], axis=1
+        ) + tA[:, [j ^ p for j in range(8)]]
+        state = jnp.concatenate([newA, tA, Bv, C], axis=1)
+    return state
+
+
+def simd512_64(data):
+    Bn = data.shape[0]
+    block = jnp.concatenate([data, jnp.zeros((Bn, 64), dtype=U8)], axis=1)
+    state = jnp.broadcast_to(
+        jnp.asarray(np.array(simd.IV512, dtype=np.uint32)), (Bn, 32)
+    )
+    state = _simd_compress_j(state, block, final=False)
+    lb = jnp.broadcast_to(
+        jnp.asarray(_const_rows((512).to_bytes(8, "little") + bytes(120))),
+        (Bn, 128),
+    )
+    state = _simd_compress_j(state, lb, final=True)
+    return _words_to_bytes(state[:, :16], 4, "little")
+
+
+# -- echo512 ------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=1)
+def _echo_keys():
+    # counter keys for 10 rounds x 16 words; counter starts at the block's
+    # bit count (512 for the single 64-byte-message block)
+    keys = np.zeros((10, 16, 16), dtype=np.uint8)
+    k = 512
+    for r in range(10):
+        for i in range(16):
+            keys[r, i] = np.frombuffer(
+                int(k).to_bytes(16, "little"), dtype=np.uint8
+            )
+            k += 1
+    return keys, np.asarray(echo._BIG_SHIFT)
+
+
+def echo512_64(data):
+    Bn = data.shape[0]
+    pad = _const_rows(bytes(
+        [0x80] + [0] * 45 + list((512).to_bytes(2, "little"))
+        + list((512).to_bytes(16, "little"))
+    ))
+    M = jnp.concatenate(
+        [data, jnp.broadcast_to(jnp.asarray(pad), (Bn, 64))], axis=1
+    ).reshape(Bn, 8, 16)
+    iv_word = jnp.asarray(_const_rows((512).to_bytes(16, "little")))
+    V = jnp.broadcast_to(iv_word, (Bn, 8, 16))
+    state = jnp.concatenate([V, M], axis=1)  # [B, 16, 16]
+    keys, big_shift = _echo_keys()
+    _, m2, m3, _ = _aes_tables()
+    zero_key = jnp.zeros(16, dtype=U8)
+
+    def round_body(st, kround):
+        words = []
+        for i in range(16):
+            w = _aes_round_j(st[:, i, :], kround[i])
+            words.append(_aes_round_j(w, zero_key))
+        st = jnp.stack(words, axis=1)[:, big_shift, :]
+        cols = st.reshape(st.shape[0], 4, 4, 16)
+        a0, a1 = cols[:, :, 0], cols[:, :, 1]
+        a2, a3 = cols[:, :, 2], cols[:, :, 3]
+        st = jnp.stack(
+            [
+                jnp.take(m2, a0) ^ jnp.take(m3, a1) ^ a2 ^ a3,
+                a0 ^ jnp.take(m2, a1) ^ jnp.take(m3, a2) ^ a3,
+                a0 ^ a1 ^ jnp.take(m2, a2) ^ jnp.take(m3, a3),
+                jnp.take(m3, a0) ^ a1 ^ a2 ^ jnp.take(m2, a3),
+            ],
+            axis=2,
+        ).reshape(st.shape[0], 16, 16)
+        return st, None
+
+    state, _ = lax.scan(round_body, state, jnp.asarray(keys))
+    out = V ^ M ^ state[:, :8, :] ^ state[:, 8:, :]
+    return out[:, :4, :].reshape(Bn, 64)
+
+
+# -- the chain ----------------------------------------------------------------
+
+def x11_digest_chain(headers):
+    """[B, 80] uint8 -> [B, 32] x11 digests (jit-friendly)."""
+    h = blake512_80(headers)
+    h = bmw512_64(h)
+    h = groestl512_64(h)
+    h = skein512_64(h)
+    h = jh512_64(h)
+    h = keccak512_64(h)
+    h = luffa512_64(h)
+    h = cubehash512_64(h)
+    h = shavite512_64(h)
+    h = simd512_64(h)
+    h = echo512_64(h)
+    return h[:, :32]
+
+
+# one shared jit wrapper: jax caches the compiled executable per input
+# shape internally, and a single wrapper means a new batch size never
+# evicts another's multi-minute XLA compile
+_jitted_chain = jax.jit(x11_digest_chain)
+
+
+def compiled_chain(batch: int = 0):
+    """The jitted digest fn (shape-polymorphic; jax caches per shape)."""
+    return _jitted_chain
+
+
+def x11_digest_device(headers_np: np.ndarray) -> np.ndarray:
+    """Convenience host API: numpy [B, 80] -> numpy [B, 32]."""
+    with jax.enable_x64():
+        return np.asarray(_jitted_chain(jnp.asarray(headers_np, dtype=U8)))

@@ -55,7 +55,7 @@ def _rotl(x, n: int):
 def threefish512(key: list, tweak: tuple[int, int], block: list) -> list:
     """Threefish-512 encryption. ``key``/``block``: 8 uint64 lanes each;
     ``tweak``: two python ints. Returns ciphertext (8 lanes)."""
-    zero = np.zeros_like(block[0])
+    zero = block[0] ^ block[0]  # works for numpy lanes AND jax tracers
     k = [kk for kk in key]
     k8 = zero + U64(C240)
     for kk in k:

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import logging
 import struct
 
 import jax
@@ -32,6 +33,8 @@ from otedama_tpu.kernels import sha256_jax as sj
 from otedama_tpu.kernels import sha256_pallas as sp
 from otedama_tpu.kernels import target as tgt
 from otedama_tpu.utils import sha256_host as sh
+
+log = logging.getLogger("otedama.runtime.search")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -204,8 +207,27 @@ class PallasBackend:
     # uses); 2^31 x groups of 4 is the sweet spot
     preferred_batch = 1 << 31
 
-    def __init__(self, sub: int = 32, interpret: bool | None = None):
+    def __init__(self, sub: int | None = None, unroll: int | None = None,
+                 inner: int | None = None, interpret: bool | None = None):
+        # With no explicit knobs, adopt the persisted tuner winner as a
+        # COMPLETE record (tuner.py tune_kernel) — the knobs were measured
+        # jointly, so mixing one explicit override with tuned values for
+        # the rest would run a configuration nobody measured. Any explicit
+        # knob therefore switches the remaining ones to the static
+        # defaults (the measured r2 config), not the tuned record.
+        if sub is None and unroll is None and inner is None:
+            from otedama_tpu.tuner import load_tuned
+
+            tuned = load_tuned() or {}
+            sub = tuned.get("sub", 32)
+            unroll = tuned.get("unroll", 4)
+            inner = tuned.get("inner")
+        else:
+            sub = 32 if sub is None else sub
+            unroll = 4 if unroll is None else unroll
         self.sub = sub
+        self.unroll = unroll
+        self.inner = inner
         self.interpret = interpret
         self._rescan = XlaBackend(chunk=min(sub * 128, 1 << 14))
         # overflow fallback covers the WHOLE batch: use big chunks so a
@@ -235,7 +257,8 @@ class PallasBackend:
             jw = sp.pack_job_words(jc.midstate, jc.tail, base, jc.limbs)
             outs.append(
                 sp.sha256d_pallas_search(
-                    jw, batch=batch, sub=self.sub, interpret=self.interpret
+                    jw, batch=batch, sub=self.sub, unroll=self.unroll,
+                    inner=self.inner, interpret=self.interpret,
                 )
             )
         return [
@@ -343,34 +366,123 @@ class X11NumpyBackend:
     def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
         from otedama_tpu.kernels import x11
 
-        winners: list[Winner] = []
-        best = 0xFFFFFFFF
-        done = 0
-        prefix = np.frombuffer(jc.header76, dtype=np.uint8)
-        while done < count:
-            n = min(self.chunk, count - done)
-            headers = np.empty((n, 80), dtype=np.uint8)
-            headers[:, :76] = prefix
-            nonces = (base + done + np.arange(n, dtype=np.uint64)) & 0xFFFFFFFF
-            headers[:, 76:] = (
-                nonces.astype(">u4").view(np.uint8).reshape(n, 4)
-            )
-            digests = x11.x11_digest_batch(headers)
-            # LE-int compare: top limb = last 4 digest bytes, little-endian
-            hi = digests[:, 28:32].copy().view("<u4").reshape(n)
-            best = min(best, int(hi.min()))
-            top_limb = (jc.target >> 224) & 0xFFFFFFFF
-            for idx in np.nonzero(hi <= top_limb)[0].tolist():
-                digest = digests[idx].tobytes()
-                if tgt.hash_meets_target(digest, jc.target):
-                    winners.append(Winner(int(nonces[idx]), digest))
-            done += n
-        return SearchResult(winners, count, best)
+        def digest_batch(headers: np.ndarray) -> np.ndarray:
+            return x11.x11_digest_batch(headers)
+
+        return _x11_chunk_search(
+            jc, base, count, self.chunk, digest_batch, fixed_shape=False
+        )
+
+
+class X11JaxBackend:
+    """x11 chained-hash search on the DEVICE (kernels.x11.jnp_chain).
+
+    The full 11-stage chain jits into one XLA program per chunk shape
+    (scan-based round loops — see jnp_chain's docstring for why). Per
+    chunk: headers are built on the host, digests computed on device, only
+    the top LE limb is transferred for the prefilter; candidate digests are
+    gathered device-side and exact-verified against the 256-bit target on
+    the host (and re-verified through the numpy oracle chain, which shares
+    no code with the jnp path beyond constants).
+
+    NB: first call per chunk shape pays a large XLA compile (~4 min on
+    CPU); subsequent calls are cached. Choose one chunk and keep it.
+    """
+
+    name = "x11-jax"
+    algorithm = "x11"
+
+    def __init__(self, chunk: int = 1 << 12):
+        self.chunk = chunk
+        self._fn = None
+
+    def _compiled(self):
+        if self._fn is None:
+            import jax
+
+            from otedama_tpu.kernels.x11 import jnp_chain
+
+            with jax.enable_x64():
+                self._fn = jnp_chain.compiled_chain(self.chunk)
+        return self._fn
+
+    def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
+        import jax
+        import jax.numpy as jnp
+
+        fn = self._compiled()
+
+        def digest_batch(headers: np.ndarray) -> np.ndarray:
+            with jax.enable_x64():
+                return np.asarray(fn(jnp.asarray(headers)))
+
+        return _x11_chunk_search(
+            jc, base, count, self.chunk, digest_batch,
+            fixed_shape=True, cross_check=True,
+        )
+
+
+def _x11_chunk_search(
+    jc: JobConstants,
+    base: int,
+    count: int,
+    chunk: int,
+    digest_batch,
+    fixed_shape: bool,
+    cross_check: bool = False,
+) -> SearchResult:
+    """Shared x11 chunk walk: header assembly, top-LE-limb prefilter, exact
+    256-bit verification — one copy for the numpy and device backends.
+
+    ``fixed_shape``: always submit full-``chunk`` batches (jit shape
+    stability); overscan lanes wrap and are masked from results.
+    ``cross_check``: re-verify each winner through the independent host
+    oracle chain. A mismatch means the DEVICE KERNEL IS BROKEN — the
+    winner is recovered from the oracle digest and the corruption is
+    logged loudly rather than silently dropping a block-winning share.
+    """
+    from otedama_tpu.kernels import x11
+
+    winners: list[Winner] = []
+    best = 0xFFFFFFFF
+    done = 0
+    prefix = np.frombuffer(jc.header76, dtype=np.uint8)
+    top_limb = (jc.target >> 224) & 0xFFFFFFFF
+    while done < count:
+        n = min(chunk, count - done)
+        rows = chunk if fixed_shape else n
+        headers = np.empty((rows, 80), dtype=np.uint8)
+        headers[:, :76] = prefix
+        nonces = (base + done + np.arange(rows, dtype=np.uint64)) & 0xFFFFFFFF
+        headers[:, 76:] = nonces.astype(">u4").view(np.uint8).reshape(rows, 4)
+        digests = digest_batch(headers)
+        # LE-int compare: top limb = last 4 digest bytes, little-endian
+        hi = np.ascontiguousarray(digests[:n, 28:32]).view("<u4").reshape(n)
+        best = min(best, int(hi.min()))
+        for idx in np.nonzero(hi <= top_limb)[0].tolist():
+            digest = digests[idx].tobytes()
+            if not tgt.hash_meets_target(digest, jc.target):
+                continue
+            if cross_check:
+                oracle = x11.x11_digest(headers[idx].tobytes())
+                if oracle != digest:
+                    log.error(
+                        "x11 DEVICE/ORACLE DIGEST MISMATCH at nonce %#010x "
+                        "— the device chain is corrupt; using the oracle "
+                        "digest (device=%s oracle=%s)",
+                        int(nonces[idx]), digest.hex(), oracle.hex(),
+                    )
+                    if not tgt.hash_meets_target(oracle, jc.target):
+                        continue
+                    digest = oracle
+            winners.append(Winner(int(nonces[idx]), digest))
+        done += n
+    return SearchResult(winners, count, best)
 
 
 class PythonBackend:
     """Pure-python hashlib search. Slow; the zero-dependency oracle used by
-    protocol-level tests and as a last-resort host fallback (the analogue of
+    protocol-test path and as a last-resort host fallback (the analogue of
     the reference's stdlib-crypto CPU path, internal/mining/workers.go:330)."""
 
     name = "python"
@@ -410,4 +522,6 @@ def make_backend(kind: str, algorithm: str = "sha256d", **kwargs):
     elif algorithm == "x11":
         if kind == "numpy":
             return X11NumpyBackend(**kwargs)
+        if kind in ("jax", "xla"):
+            return X11JaxBackend(**kwargs)
     raise ValueError(f"no backend {kind!r} for algorithm {algorithm!r}")

@@ -282,3 +282,46 @@ def test_x11_registered_and_pow_host_dispatch():
             pow_digest(h, "dash")
         # but probes answer False instead of raising
         assert not algos.implemented("dash") or algos._REGISTRY["x11"].canonical
+
+
+# -- device chain (kernels/x11/jnp_chain.py) ---------------------------------
+
+def test_jnp_chain_matches_numpy_oracle():
+    """Every digest from the device-oriented jnp chain must be bit-identical
+    to the host numpy oracle (eager mode — jit compile of the full chain
+    is minutes on CPU and exercised by the slow-marked backend test)."""
+    import jax
+    import jax.numpy as jnp
+
+    from otedama_tpu.kernels.x11 import jnp_chain as jc
+
+    rng = np.random.default_rng(11)
+    hdr = rng.integers(0, 256, size=(2, 80), dtype=np.uint8)
+    want = np.stack([
+        np.frombuffer(x11.x11_digest(row.tobytes()), dtype=np.uint8)
+        for row in hdr
+    ])
+    with jax.enable_x64():
+        got = np.asarray(jc.x11_digest_chain(jnp.asarray(hdr)))
+    assert np.array_equal(got, want)
+
+
+@pytest.mark.slow
+def test_x11_jax_backend_finds_planted_winner():
+    """Compiled end-to-end: the device backend reproduces the numpy
+    backend's winners for a planted easy-target window. Slow tier: the
+    one-off XLA compile of the whole chain takes minutes on CPU."""
+    from otedama_tpu.runtime.search import JobConstants, X11JaxBackend
+
+    h76 = bytes(range(76))
+    base, span = 900, 64
+    digests = {
+        n: x11.x11_digest(h76 + n.to_bytes(4, "big"))
+        for n in range(base, base + span)
+    }
+    values = {n: int.from_bytes(d, "little") for n, d in digests.items()}
+    winner = min(values, key=values.get)
+    jc = JobConstants.from_header_prefix(h76, values[winner])
+    res = X11JaxBackend(chunk=64).search(jc, base, span)
+    assert [w.nonce_word for w in res.winners] == [winner]
+    assert res.winners[0].digest == digests[winner]
