@@ -146,4 +146,8 @@ from otedama_tpu.engine import algos as _algos  # noqa: E402
 
 if not missing_stages():
     _algos.mark_implemented("x11", "numpy")
+    # the device chain registers as BOTH names: "xla" is what the auto
+    # backend-probe order checks (so a TPU host actually reaches the
+    # device tier), "jax" is the explicit alias make_backend also accepts
+    _algos.mark_implemented("x11", "xla")
     _algos.mark_implemented("x11", "jax")

@@ -230,12 +230,23 @@ def load_tuned(path: str | None = None) -> dict | None:
     import json
     import os
 
+    import logging
+
     for candidate in (os.environ.get("OTEDAMA_TUNED"), path, TUNED_PATH):
         if candidate and os.path.exists(candidate):
             try:
                 with open(candidate) as f:
                     rec = json.load(f)
                 if isinstance(rec, dict) and "sub" in rec and "unroll" in rec:
+                    # adoption is visible: tuned records are machine-local
+                    # (CWD or $OTEDAMA_TUNED), so the log line is the only
+                    # way to tell which kernel config a process is running
+                    logging.getLogger("otedama.tuner").info(
+                        "adopted tuned kernel config from %s: sub=%s "
+                        "unroll=%s inner=%s",
+                        os.path.abspath(candidate), rec.get("sub"),
+                        rec.get("unroll"), rec.get("inner"),
+                    )
                     return rec
             except (OSError, ValueError):
                 return None

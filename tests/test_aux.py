@@ -429,3 +429,103 @@ def test_currency_registry_and_clients():
     assert mgr.client("LTC") is client  # cached
     snap = mgr.snapshot()
     assert snap["LTC"]["connected"] and not snap["BTC"]["connected"]
+
+
+# -- smart contracts / gas oracle (reference: blockchain/smart_contracts.go) --
+
+def test_keccak256_and_selector_known_answers():
+    from otedama_tpu import contracts as sc
+
+    # keccak256("") is the canonical Ethereum empty hash
+    assert sc.keccak256(b"").hex() == (
+        "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"
+    )
+    # the most famous selector on Ethereum
+    assert sc.function_selector("transfer(address,uint256)").hex() == "a9059cbb"
+    assert sc.function_selector("balanceOf(address)").hex() == "70a08231"
+
+
+def test_abi_encode_transfer():
+    from otedama_tpu import contracts as sc
+
+    to = "0x" + "11" * 20
+    data = sc.encode_erc20_transfer(to, 10**18)
+    assert data[:4].hex() == "a9059cbb"
+    assert data[4:36] == bytes(12) + bytes.fromhex("11" * 20)
+    assert int.from_bytes(data[36:68], "big") == 10**18
+    batch = sc.encode_batch_payout([to, to], [1, 2])
+    assert len(batch) == 2 and batch[0] != batch[1]
+
+
+def test_gas_oracle_eip1559():
+    from otedama_tpu.contracts import GasOracle
+
+    o = GasOracle()
+    # full block -> base fee rises by 1/8; empty -> falls by 1/8
+    o.observe_block(base_fee=8_000_000_000, gas_used_ratio=1.0,
+                    tips=[10**9, 2 * 10**9, 5 * 10**9])
+    assert o.next_base_fee() == 9_000_000_000
+    o.observe_block(base_fee=8_000_000_000, gas_used_ratio=0.0)
+    assert o.next_base_fee() == 7_000_000_000
+    # at target fullness the fee holds
+    o.observe_block(base_fee=8_000_000_000, gas_used_ratio=0.5)
+    assert o.next_base_fee() == 8_000_000_000
+    est = o.estimate("fast")
+    assert est.max_fee > est.base_fee + est.priority_fee // 2
+    slow, fast = o.estimate("slow"), o.estimate("fast")
+    assert slow.priority_fee <= fast.priority_fee
+
+
+def test_nonce_manager_gap_release():
+    from otedama_tpu.contracts import NonceManager
+
+    nm = NonceManager()
+    nm.sync("a", 5)
+    assert nm.allocate("a") == 5
+    assert nm.allocate("a") == 6
+    n7 = nm.allocate("a")
+    nm.release("a", 6)
+    assert nm.allocate("a") == 6      # gap refilled first
+    assert nm.allocate("a") == 8
+    assert n7 == 7
+
+
+def test_transaction_manager_retry_bumps_fees():
+    from otedama_tpu.contracts import (
+        GasOracle, TransactionManager, TxManagerConfig,
+    )
+
+    submitted = []
+
+    def submit(tx):
+        submitted.append((tx.nonce, tx.max_fee, tx.priority_fee))
+        return f"tx{len(submitted)}"
+
+    o = GasOracle()
+    o.observe_block(10**9, 0.5, tips=[10**9])
+    mgr = TransactionManager(
+        submit, oracle=o,
+        config=TxManagerConfig(retry_after_seconds=10.0, max_retries=2),
+        sender="0xme",
+    )
+    tx = mgr.send("0x" + "22" * 20, value=123)
+    assert tx.tx_id == "tx1" and mgr.snapshot()["pending"] == 1
+
+    # stale -> bump: same nonce, fees raised >= 10% (replace-by-fee)
+    bumped = mgr.tick(now=tx.submitted_at + 11.0)
+    assert len(bumped) == 1
+    n0, f0, p0 = submitted[0]
+    n1, f1, p1 = submitted[1]
+    assert n1 == n0 and f1 >= f0 * 1.10 and p1 >= p0 * 1.10
+    assert mgr.stats["bumped"] == 1
+
+    # retries exhaust -> failed, nonce released for reuse
+    mgr.tick(now=tx.submitted_at + 30.0)
+    mgr.tick(now=tx.submitted_at + 60.0)
+    assert mgr.stats["failed"] == 1 and mgr.snapshot()["pending"] == 0
+    tx2 = mgr.send("0x" + "33" * 20)
+    assert tx2.nonce == n0               # released nonce reused
+
+    # happy path confirmation
+    mgr.confirm(tx2.tx_id)
+    assert mgr.stats["confirmed"] == 1
