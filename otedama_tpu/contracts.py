@@ -33,21 +33,8 @@ from otedama_tpu.kernels.x11 import keccak as _keccak
 # -- keccak-256 (Ethereum's: rate 136, original 0x01 domain) ------------------
 
 def keccak256(data: bytes) -> bytes:
-    rate = 136
-    padded = bytearray(data)
-    padded.append(0x01)
-    while len(padded) % rate:
-        padded.append(0)
-    padded[-1] |= 0x80
-    state = [np.zeros(1, dtype=np.uint64) for _ in range(25)]
-    for blk in range(0, len(padded), rate):
-        block = bytes(padded[blk : blk + rate])
-        for i in range(rate // 8):
-            w = int.from_bytes(block[8 * i : 8 * i + 8], "little")
-            state[i] = state[i] ^ np.uint64(w)
-        state = _keccak.keccak_f1600(state)
-    out = b"".join(int(state[i][0]).to_bytes(8, "little") for i in range(4))
-    return out
+    """One sponge implementation serves 512 and 256 — see kernels/x11/keccak."""
+    return _keccak.keccak256_bytes(data)
 
 
 @functools.lru_cache(maxsize=256)
@@ -126,6 +113,13 @@ class GasOracle:
         pct = self.SPEED_PERCENTILES.get(speed)
         if pct is None:
             raise ValueError(f"unknown speed {speed!r}")
+        if not self._base_fees:
+            # base_fee=0 would sign underpriced txs that never mine and
+            # then "fail" after bumping from nothing — refuse loudly
+            raise RuntimeError(
+                "gas oracle has no observations; feed observe_block() "
+                "from the chain client before estimating"
+            )
         base = self.next_base_fee()
         if self._tips:
             tip = int(np.percentile(np.array(list(self._tips)), pct))
@@ -222,6 +216,10 @@ class TransactionManager:
         self.config = config or TxManagerConfig()
         self.sender = sender
         self.pending: dict[str, PendingTx] = {}
+        # every tx id ever broadcast for a payout -> that payout: a bumped
+        # replacement does NOT guarantee the original never mines, so a
+        # confirmation may arrive under any superseded id
+        self._ids: dict[str, PendingTx] = {}
         self.stats = {"submitted": 0, "confirmed": 0, "failed": 0, "bumped": 0}
 
     def send(self, to: str, value: int = 0, data: bytes = b"",
@@ -241,14 +239,21 @@ class TransactionManager:
             self.nonces.release(self.sender, nonce)
             raise
         self.pending[tx.tx_id] = tx
+        self._ids[tx.tx_id] = tx
         self.stats["submitted"] += 1
         return tx
 
     def confirm(self, tx_id: str) -> None:
-        tx = self.pending.pop(tx_id, None)
-        if tx is not None:
-            tx.status = "confirmed"
-            self.stats["confirmed"] += 1
+        """A confirmation under ANY id this payout ever broadcast (the
+        original can mine even after a replace-by-fee bump)."""
+        tx = self._ids.get(tx_id)
+        if tx is None or tx.status == "confirmed":
+            return
+        tx.status = "confirmed"
+        self.pending.pop(tx.tx_id, None)
+        for known_id in [k for k, v in self._ids.items() if v is tx]:
+            del self._ids[known_id]
+        self.stats["confirmed"] += 1
 
     def tick(self, now: float | None = None) -> list[PendingTx]:
         """Retry stale pending txs with bumped fees (same nonce =
@@ -262,7 +267,11 @@ class TransactionManager:
                 tx.status = "failed"
                 tx.error = "retries exhausted"
                 self.pending.pop(tx.tx_id, None)
-                self.nonces.release(self.sender, tx.nonce)
+                # the nonce is NOT auto-released: any of this payout's
+                # broadcasts may still mine, and re-allocating a consumed
+                # nonce strands every later payout ('nonce too low'
+                # forever). nonces.sync() from the chain's confirmed count
+                # is the recovery path.
                 self.stats["failed"] += 1
                 continue
             factor = 1.0 + self.config.bump_percent / 100.0
@@ -281,6 +290,7 @@ class TransactionManager:
                 continue
             self.pending.pop(old_id, None)
             self.pending[tx.tx_id] = tx
+            self._ids[tx.tx_id] = tx
             self.stats["bumped"] += 1
             bumped.append(tx)
         return bumped

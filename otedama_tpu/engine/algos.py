@@ -67,7 +67,8 @@ def _load_kernels() -> None:
     _KERNELS_LOADED = True
     import importlib
 
-    for mod in ("otedama_tpu.kernels.scrypt_jax", "otedama_tpu.kernels.x11"):
+    for mod in ("otedama_tpu.kernels.scrypt_jax", "otedama_tpu.kernels.x11",
+                "otedama_tpu.kernels.ethash"):
         try:
             importlib.import_module(mod)
         except Exception:  # pragma: no cover - kernel import failure is loud elsewhere
@@ -188,6 +189,16 @@ def mark_canonical(name: str) -> None:
     spec = _REGISTRY[name.lower()]
     if not spec.canonical:
         register(dataclasses.replace(spec, canonical=True))
+
+
+def mark_uncanonical(name: str) -> None:
+    """The reverse gate: a kernel module that implements an algorithm
+    WITHOUT external vector certification must refuse auto-switch (the
+    stub registrations default to canonical=True because they have no
+    backends at all; gaining a backend makes the flag load-bearing)."""
+    spec = _REGISTRY[name.lower()]
+    if spec.canonical:
+        register(dataclasses.replace(spec, canonical=False))
 
 
 def switchable(name: str) -> bool:

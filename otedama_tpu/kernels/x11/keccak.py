@@ -75,18 +75,21 @@ def keccak_f1600(A: list) -> list:
     return A
 
 
-def _absorb(data_words: np.ndarray, n_bytes: int, domain: int) -> list:
+def _absorb(data_words: np.ndarray, n_bytes: int, domain: int,
+            rate_bytes: int = RATE_512) -> list:
     """Sponge absorb of a fixed-size message across lanes.
 
     ``data_words``: uint64 array ``[B, ceil(n_bytes/8)]`` — little-endian
     64-bit words of the message (trailing partial word zero-padded).
     ``domain``: padding domain byte (0x01 = original Keccak, 0x06 = SHA3).
+    ``rate_bytes``: sponge rate (72 = keccak-512, 136 = keccak-256).
     Returns the 25-word state after absorbing all padded blocks.
     """
     B = data_words.shape[0]
-    rate_words = RATE_512 // 8
+    RATE = rate_bytes
+    rate_words = RATE // 8
     # build padded message as word array
-    n_blocks = n_bytes // RATE_512 + 1
+    n_blocks = n_bytes // RATE + 1
     total_words = n_blocks * rate_words
     padded = np.zeros((B, total_words), dtype=np.uint64)
     padded[:, :data_words.shape[1]] = data_words
@@ -121,3 +124,17 @@ def keccak512_bytes(data: bytes, domain: int = 0x01) -> bytes:
     words = np.frombuffer(padded, dtype="<u8").astype(np.uint64)[None, :]
     out = keccak512(words, n, domain)
     return out[0].astype("<u8").tobytes()
+
+
+def keccak256_bytes(data: bytes, domain: int = 0x01) -> bytes:
+    """Keccak-256 (rate 136) through the same certified sponge — the
+    Ethereum hash (selectors, ethash seals)."""
+    n = len(data)
+    padded = data + b"\x00" * ((-n) % 8)
+    words = (
+        np.frombuffer(padded, dtype="<u8").astype(np.uint64)[None, :]
+        if padded
+        else np.zeros((1, 0), dtype=np.uint64)
+    )
+    state = _absorb(words, n, domain, rate_bytes=136)
+    return np.stack(state[:4], axis=-1)[0].astype("<u8").tobytes()

@@ -480,6 +480,80 @@ def _x11_chunk_search(
     return SearchResult(winners, count, best)
 
 
+class EthashLightBackend:
+    """Ethash light-verification search (kernels/ethash).
+
+    Adapts ethash to the engine's job model: the 76-byte job prefix is
+    hashed to the 32-byte ethash header hash, the nonce window maps onto
+    ethash's 64-bit nonce space, and winners carry ``result[::-1]`` so the
+    framework's little-endian target helpers apply unchanged. The epoch
+    cache is built once at construction (HBM-resident on device).
+
+    Defaults use a miniature epoch (tests/CI); pass ``block_number`` for
+    real epoch sizing — cache generation for a real epoch is a one-off
+    minutes-scale host computation, exactly like every ethash client.
+    """
+
+    name = "ethash-light"
+    algorithm = "ethash"
+
+    def __init__(self, cache_rows: int = 251, full_pages: int = 509,
+                 block_number: int | None = None, device: bool = True,
+                 chunk: int = 256):
+        from otedama_tpu.kernels import ethash as eth
+
+        self._eth = eth
+        self.device = device
+        self.chunk = chunk
+        if block_number is not None:
+            cache_bytes = eth.cache_size(block_number)
+            self.full_size = eth.dataset_size(block_number)
+            seed = eth.seed_hash(block_number)
+        else:
+            cache_bytes = cache_rows * eth.HASH_BYTES
+            self.full_size = full_pages * eth.MIX_BYTES
+            seed = eth.seed_hash(0)
+        self.cache = eth.make_cache(cache_bytes, seed)
+
+    def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
+        eth = self._eth
+        header_hash = eth.keccak256(jc.header76)
+        winners: list[Winner] = []
+        best = 0xFFFFFFFF
+        done = 0
+        while done < count:
+            n = min(self.chunk, count - done)
+            nonces = (
+                base + done + np.arange(n, dtype=np.uint64)
+            ) & 0xFFFFFFFF
+            if self.device:
+                _, results = eth.hashimoto_light_device(
+                    self.full_size, self.cache, header_hash, nonces
+                )
+            else:
+                results = np.stack([
+                    np.frombuffer(
+                        eth.hashimoto_light(
+                            self.full_size, self.cache, header_hash, int(v)
+                        )[1],
+                        dtype=np.uint8,
+                    )
+                    for v in nonces
+                ])
+            # framework convention: digests compare as LE integers, so the
+            # BE ethash result is byte-reversed once here
+            digests = results[:, ::-1]
+            hi = np.ascontiguousarray(digests[:, 28:32]).view("<u4").reshape(n)
+            best = min(best, int(hi.min()))
+            top_limb = (jc.target >> 224) & 0xFFFFFFFF
+            for idx in np.nonzero(hi <= top_limb)[0].tolist():
+                digest = digests[idx].tobytes()
+                if tgt.hash_meets_target(digest, jc.target):
+                    winners.append(Winner(int(nonces[idx]), digest))
+            done += n
+        return SearchResult(winners, count, best)
+
+
 class PythonBackend:
     """Pure-python hashlib search. Slow; the zero-dependency oracle used by
     protocol-test path and as a last-resort host fallback (the analogue of
@@ -524,4 +598,9 @@ def make_backend(kind: str, algorithm: str = "sha256d", **kwargs):
             return X11NumpyBackend(**kwargs)
         if kind in ("jax", "xla"):
             return X11JaxBackend(**kwargs)
+    elif algorithm == "ethash":
+        if kind in ("jax", "xla"):
+            return EthashLightBackend(device=True, **kwargs)
+        if kind == "numpy":
+            return EthashLightBackend(device=False, **kwargs)
     raise ValueError(f"no backend {kind!r} for algorithm {algorithm!r}")

@@ -519,13 +519,49 @@ def test_transaction_manager_retry_bumps_fees():
     assert n1 == n0 and f1 >= f0 * 1.10 and p1 >= p0 * 1.10
     assert mgr.stats["bumped"] == 1
 
-    # retries exhaust -> failed, nonce released for reuse
+    # retries exhaust -> failed; the nonce is NOT auto-released (any old
+    # broadcast may still mine — sync() from the chain is the recovery)
     mgr.tick(now=tx.submitted_at + 30.0)
     mgr.tick(now=tx.submitted_at + 60.0)
     assert mgr.stats["failed"] == 1 and mgr.snapshot()["pending"] == 0
     tx2 = mgr.send("0x" + "33" * 20)
-    assert tx2.nonce == n0               # released nonce reused
+    assert tx2.nonce == n0 + 1           # next nonce, no unsafe reuse
 
     # happy path confirmation
     mgr.confirm(tx2.tx_id)
     assert mgr.stats["confirmed"] == 1
+
+
+def test_transaction_manager_confirm_under_superseded_id():
+    """Replace-by-fee does not guarantee the replacement mines: a
+    confirmation arriving under the ORIGINAL tx id must resolve the
+    payout (not be a silent no-op)."""
+    from otedama_tpu.contracts import (
+        GasOracle, TransactionManager, TxManagerConfig,
+    )
+
+    ids = iter(f"tx{i}" for i in range(10))
+
+    def submit(tx):
+        return next(ids)
+
+    o = GasOracle()
+    o.observe_block(10**9, 0.5, tips=[10**9])
+    mgr = TransactionManager(
+        submit, oracle=o, config=TxManagerConfig(retry_after_seconds=1.0),
+    )
+    tx = mgr.send("0x" + "44" * 20)
+    first_id = tx.tx_id
+    mgr.tick(now=tx.submitted_at + 2.0)   # bumped -> new id
+    assert tx.tx_id != first_id
+    mgr.confirm(first_id)                  # the ORIGINAL mined anyway
+    assert mgr.stats["confirmed"] == 1 and mgr.snapshot()["pending"] == 0
+    mgr.confirm(tx.tx_id)                  # replacement id is now inert
+    assert mgr.stats["confirmed"] == 1
+
+
+def test_gas_oracle_refuses_blind_estimates():
+    from otedama_tpu.contracts import GasOracle
+
+    with pytest.raises(RuntimeError, match="no observations"):
+        GasOracle().estimate()

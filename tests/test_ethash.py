@@ -1,0 +1,116 @@
+"""Ethash: epoch machinery, cache/dataset construction, hashimoto, and
+host-vs-device agreement (reference only stubs this algorithm —
+internal/mining/multi_algorithm.go:155-160)."""
+
+import numpy as np
+import pytest
+
+from otedama_tpu.kernels import ethash
+
+
+def test_epoch_sizes_follow_prime_rules():
+    # epoch 0 values derived from the published constants + prime search
+    cs0 = ethash.cache_size(0)
+    ds0 = ethash.dataset_size(0)
+    assert cs0 == 16776896          # the well-known epoch-0 cache size
+    assert ds0 == 1073739904        # the well-known epoch-0 dataset size
+    assert ethash._is_prime(cs0 // ethash.HASH_BYTES)
+    assert ethash._is_prime(ds0 // ethash.MIX_BYTES)
+    # growth across epochs is monotonic
+    assert ethash.cache_size(ethash.EPOCH_LENGTH) > cs0
+    assert ethash.dataset_size(ethash.EPOCH_LENGTH) > ds0
+
+
+def test_seed_chain():
+    assert ethash.seed_hash(0) == b"\x00" * 32
+    s1 = ethash.seed_hash(ethash.EPOCH_LENGTH)
+    assert s1 == ethash.keccak256(b"\x00" * 32)
+    assert ethash.seed_hash(2 * ethash.EPOCH_LENGTH) == ethash.keccak256(s1)
+
+
+# tiny parameters so cache generation is test-fast; rows stays prime
+TINY_ROWS = 251
+TINY_CACHE_BYTES = TINY_ROWS * ethash.HASH_BYTES
+TINY_FULL_SIZE = 509 * ethash.MIX_BYTES   # prime page count
+
+
+@pytest.fixture(scope="module")
+def tiny_cache():
+    return ethash.make_cache(TINY_CACHE_BYTES, b"\x42" * 32)
+
+
+def test_cache_properties(tiny_cache):
+    assert tiny_cache.shape == (TINY_ROWS, 16)
+    assert tiny_cache.dtype == np.uint32
+    # RandMemoHash actually ran: rows differ and depend on the seed
+    assert not np.array_equal(tiny_cache[0], tiny_cache[1])
+    other = ethash.make_cache(TINY_CACHE_BYTES, b"\x43" * 32)
+    assert not np.array_equal(tiny_cache, other)
+
+
+def test_dataset_item_depends_on_index(tiny_cache):
+    a = ethash.calc_dataset_item(tiny_cache, 0)
+    b = ethash.calc_dataset_item(tiny_cache, 1)
+    assert a.shape == (16,) and not np.array_equal(a, b)
+    # deterministic
+    assert np.array_equal(a, ethash.calc_dataset_item(tiny_cache, 0))
+
+
+def test_hashimoto_light_host(tiny_cache):
+    header = bytes(range(32))
+    mix1, res1 = ethash.hashimoto_light(TINY_FULL_SIZE, tiny_cache, header, 7)
+    mix2, res2 = ethash.hashimoto_light(TINY_FULL_SIZE, tiny_cache, header, 8)
+    assert len(mix1) == 32 and len(res1) == 32
+    assert res1 != res2                      # nonce matters
+    _, res3 = ethash.hashimoto_light(
+        TINY_FULL_SIZE, tiny_cache, bytes(32), 7
+    )
+    assert res1 != res3                      # header matters
+
+
+def test_hashimoto_device_matches_host(tiny_cache):
+    """The HBM-gather device path must agree bit-for-bit with the host
+    oracle for a batch of nonces."""
+    header = bytes(range(32))
+    nonces = np.array([0, 1, 7, 0xDEADBEEF, 2**40 + 3], dtype=np.uint64)
+    mixes_d, results_d = ethash.hashimoto_light_device(
+        TINY_FULL_SIZE, tiny_cache, header, nonces
+    )
+    for i, n in enumerate(nonces):
+        mix_h, res_h = ethash.hashimoto_light(
+            TINY_FULL_SIZE, tiny_cache, header, int(n)
+        )
+        assert mixes_d[i].tobytes() == mix_h, f"mix lane {i}"
+        assert results_d[i].tobytes() == res_h, f"result lane {i}"
+
+
+def test_ethash_registered_but_gated():
+    from otedama_tpu.engine import algos
+
+    algos._load_kernels()
+    assert algos.implemented("ethash")
+    assert "xla" in algos.get("ethash").backends
+    # no offline vector -> must not be auto-switchable
+    assert not algos.switchable("ethash")
+
+
+def test_ethash_backend_finds_planted_winner(tiny_cache):
+    """Engine-protocol backend: winners agree with the host oracle and
+    carry framework-convention (LE) digests."""
+    from otedama_tpu.kernels import ethash as eth
+    from otedama_tpu.runtime.search import EthashLightBackend, JobConstants
+
+    backend = EthashLightBackend(cache_rows=TINY_ROWS, full_pages=509,
+                                 device=True, chunk=32)
+    h76 = bytes(range(76))
+    header_hash = eth.keccak256(h76)
+    base, span = 40, 32
+    vals = {}
+    for n in range(base, base + span):
+        _, res = eth.hashimoto_light(TINY_FULL_SIZE, backend.cache,
+                                     header_hash, n)
+        vals[n] = int.from_bytes(res[::-1], "little")
+    winner = min(vals, key=vals.get)
+    jc = JobConstants.from_header_prefix(h76, vals[winner])
+    res = backend.search(jc, base, span)
+    assert [w.nonce_word for w in res.winners] == [winner]
