@@ -259,6 +259,40 @@ def bench_engine_path() -> dict:
     }
 
 
+def _guard_platform(probe_timeout: float = 90.0) -> None:
+    """Refuse to hang forever on a wedged TPU tunnel.
+
+    The axon plugin can wedge such that ``jax.devices()`` blocks
+    indefinitely in every new process (observed after a killed mid-RPC
+    job). Probe device initialization in a SUBPROCESS with a timeout; on
+    failure, pin this process to CPU before jax initializes so the bench
+    records a (CPU) number instead of no number at all.
+    """
+    import os
+    import subprocess
+
+    # only an EXPLICIT cpu pin skips the probe: an unset env is exactly
+    # when jax auto-selects an installed (possibly wedged) TPU plugin
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        return
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=probe_timeout,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            check=True,
+        )
+    except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
+        log(
+            f"bench: TPU platform probe failed/hung (> {probe_timeout:.0f}s)"
+            " — falling back to CPU so a result is still recorded"
+        )
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--algo", default="sha256d",
@@ -268,6 +302,7 @@ def main() -> None:
     ap.add_argument("--x11-backend", default="numpy", choices=("numpy", "jax"),
                     help="x11 execution tier (jax = device chain)")
     args = ap.parse_args()
+    _guard_platform()
     if args.engine_path:
         out = bench_engine_path()
     elif args.algo == "x11":

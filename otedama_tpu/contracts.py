@@ -267,6 +267,10 @@ class TransactionManager:
                 tx.status = "failed"
                 tx.error = "retries exhausted"
                 self.pending.pop(tx.tx_id, None)
+                # drop this payout's id aliases: a long-lived manager with
+                # intermittent failures must not grow _ids without bound
+                for known_id in [k for k, v in self._ids.items() if v is tx]:
+                    del self._ids[known_id]
                 # the nonce is NOT auto-released: any of this payout's
                 # broadcasts may still mine, and re-allocating a consumed
                 # nonce strands every later payout ('nonce too low'

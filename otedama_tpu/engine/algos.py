@@ -168,10 +168,17 @@ register(AlgorithmSpec(
     canonical=False,  # flipped by kernels.x11 once all 11 stages KAT-verify
     planning_hashrate=_PLANNING["x11"],
 ))
+register(AlgorithmSpec(
+    name="ethash",
+    aliases=("etchash",),
+    memory_hard=True,   # DAG-class: benchmark budgets must treat it like scrypt
+    backends=(),        # filled in by kernels.ethash import-time registration
+    canonical=False,    # no offline vector — kernels.ethash re-asserts this
+))
 # declared by the reference but unimplemented there too (stub registrations,
 # reference: algorithm_simple_impls.go:84-101) — declared here for parity,
 # loudly unimplemented:
-for _name in ("ethash", "etchash", "randomx", "kawpow", "autolykos2",
+for _name in ("randomx", "kawpow", "autolykos2",
               "kheavyhash", "blake3", "equihash", "cuckatoo32", "x16r"):
     register(AlgorithmSpec(name=_name))
 

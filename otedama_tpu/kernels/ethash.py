@@ -98,9 +98,7 @@ def keccak512_words(data: bytes) -> np.ndarray:
 
 
 def keccak256(data: bytes) -> bytes:
-    from otedama_tpu.contracts import keccak256 as k256
-
-    return k256(data)
+    return _keccak.keccak256_bytes(data)
 
 
 def _fnv(a, b):
@@ -302,6 +300,9 @@ def hashimoto_light_device(
         rows = cache.shape[0]
         n_pages = full_size // MIX_BYTES
         B = len(nonces)
+        # jnp.asarray is a no-op when the caller already holds a device
+        # array (EthashLightBackend keeps the epoch cache HBM-resident);
+        # a numpy cache uploads here
         cache_d = jnp.asarray(cache)
 
         # s = keccak512(header || nonce_le): 40-byte input per lane

@@ -497,7 +497,8 @@ class EthashLightBackend:
     name = "ethash-light"
     algorithm = "ethash"
 
-    def __init__(self, cache_rows: int = 251, full_pages: int = 509,
+    def __init__(self, cache_rows: int | None = None,
+                 full_pages: int | None = None,
                  block_number: int | None = None, device: bool = True,
                  chunk: int = 256):
         from otedama_tpu.kernels import ethash as eth
@@ -509,11 +510,27 @@ class EthashLightBackend:
             cache_bytes = eth.cache_size(block_number)
             self.full_size = eth.dataset_size(block_number)
             seed = eth.seed_hash(block_number)
-        else:
+        elif cache_rows is not None and full_pages is not None:
+            # explicit miniature epoch (tests / self-consistency drills)
             cache_bytes = cache_rows * eth.HASH_BYTES
             self.full_size = full_pages * eth.MIX_BYTES
             seed = eth.seed_hash(0)
+        else:
+            # shares mined against a silently toy-sized DAG would be
+            # invalid for any real verifier — make the choice explicit
+            raise ValueError(
+                "ethash needs block_number= for a real epoch, or BOTH "
+                "cache_rows= and full_pages= for an explicit test epoch"
+            )
+        # numpy stays the canonical copy (the host oracle mutates rows);
+        # the device path gets an HBM-resident twin so per-chunk calls
+        # don't re-upload the epoch cache
         self.cache = eth.make_cache(cache_bytes, seed)
+        self._cache_dev = None
+        if device:
+            import jax.numpy as jnp
+
+            self._cache_dev = jnp.asarray(self.cache)
 
     def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
         eth = self._eth
@@ -528,7 +545,7 @@ class EthashLightBackend:
             ) & 0xFFFFFFFF
             if self.device:
                 _, results = eth.hashimoto_light_device(
-                    self.full_size, self.cache, header_hash, nonces
+                    self.full_size, self._cache_dev, header_hash, nonces
                 )
             else:
                 results = np.stack([
