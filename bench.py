@@ -259,14 +259,15 @@ def bench_engine_path() -> dict:
     }
 
 
-def _guard_platform(probe_timeout: float = 90.0) -> None:
+def _guard_platform(probe_timeout: float = 90.0) -> bool:
     """Refuse to hang forever on a wedged TPU tunnel.
 
     The axon plugin can wedge such that ``jax.devices()`` blocks
     indefinitely in every new process (observed after a killed mid-RPC
     job). Probe device initialization in a SUBPROCESS with a timeout; on
     failure, pin this process to CPU before jax initializes so the bench
-    records a (CPU) number instead of no number at all.
+    records a (CPU) number instead of no number at all. Returns True when
+    the fallback engaged (callers annotate their output with it).
     """
     import os
     import subprocess
@@ -274,7 +275,7 @@ def _guard_platform(probe_timeout: float = 90.0) -> None:
     # only an EXPLICIT cpu pin skips the probe: an unset env is exactly
     # when jax auto-selects an installed (possibly wedged) TPU plugin
     if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
-        return
+        return False
     try:
         subprocess.run(
             [sys.executable, "-c", "import jax; jax.devices()"],
@@ -291,6 +292,8 @@ def _guard_platform(probe_timeout: float = 90.0) -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+        return True
+    return False
 
 
 def main() -> None:
@@ -302,7 +305,7 @@ def main() -> None:
     ap.add_argument("--x11-backend", default="numpy", choices=("numpy", "jax"),
                     help="x11 execution tier (jax = device chain)")
     args = ap.parse_args()
-    _guard_platform()
+    fell_back = _guard_platform()
     if args.engine_path:
         out = bench_engine_path()
     elif args.algo == "x11":
@@ -312,6 +315,12 @@ def main() -> None:
             "sha256d": bench_sha256d,
             "scrypt": bench_scrypt,
         }[args.algo]()
+    if fell_back:
+        out["note"] = (
+            "TPU tunnel unavailable (device init hung); this is the CPU "
+            "fallback so a number exists at all — previously recorded "
+            "device rates live in the committed BENCH_*_r03.json artifacts"
+        )
     print(json.dumps(out))
 
 
