@@ -40,6 +40,20 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def _timed_backend_rate(backend, jc, count: int, iters: int = 4) -> float:
+    """Hashes/sec through ``backend.search`` after a warmup call.
+
+    ``SearchResult`` construction forces a host transfer of each chunk's
+    output, so timing the call is an honest device sync (the round-2
+    methodology; see module docstring).
+    """
+    backend.search(jc, 0, count)  # compile + warmup
+    t0 = time.monotonic()
+    for i in range(iters):
+        backend.search(jc, (i + 1) * count, count)
+    return iters * count / (time.monotonic() - t0)
+
+
 def _job_constants(target: int = 0):
     from otedama_tpu.runtime.search import JobConstants
 
@@ -102,25 +116,18 @@ def bench_sha256d() -> dict:
         for o in outs:
             np.asarray(o.stats)
         dt = time.monotonic() - t0
-        hashes = N * batch
+        rate = N * batch / dt
         name = f"pallas-tpu(sub={sub},unroll={unroll})"
     else:
         from otedama_tpu.runtime.search import XlaBackend
 
         backend = XlaBackend(chunk=1 << 18)
         log("bench: compiling xla fallback ...")
-        backend.search(jc, 0, backend.chunk)  # warmup
-        iters = 4
-        count = backend.chunk * 8
-        t0 = time.monotonic()
-        for i in range(iters):
-            backend.search(jc, (i + 1) * count, count)
-        dt = time.monotonic() - t0
-        hashes = iters * count
+        rate = _timed_backend_rate(backend, jc, backend.chunk * 8)
         name = "xla-" + platform
 
-    ghs = hashes / dt / 1e9
-    log(f"bench: {name} {hashes} hashes in {dt:.2f}s -> {ghs:.3f} GH/s e2e")
+    ghs = rate / 1e9
+    log(f"bench: {name} -> {ghs:.3f} GH/s e2e")
     return {
         "metric": "sha256d_hashrate_per_chip",
         "value": round(ghs, 4),
@@ -146,14 +153,8 @@ def bench_scrypt() -> dict:
     backend = ScryptXlaBackend(chunk=chunk)
 
     log("bench: compiling scrypt ...")
-    backend.search(jc, 0, chunk)  # warmup
-    iters = 4
-    t0 = time.monotonic()
-    for i in range(iters):
-        backend.search(jc, (i + 1) * chunk, chunk)
-    dt = time.monotonic() - t0
-    khs = iters * chunk / dt / 1e3
-    log(f"bench: scrypt {iters * chunk} hashes in {dt:.2f}s -> {khs:.2f} kH/s")
+    khs = _timed_backend_rate(backend, jc, chunk) / 1e3
+    log(f"bench: scrypt -> {khs:.2f} kH/s")
     return {
         "metric": "scrypt_hashrate_per_chip",
         "value": round(khs, 3),
@@ -194,6 +195,47 @@ def bench_x11(backend_kind: str = "numpy") -> dict:
         "value": round(hs, 1),
         "unit": "H/s",
         "vs_baseline": None,
+    }
+
+
+def bench_ethash() -> dict:
+    """Ethash (DAG-class memory-hard) light-search rate, H/s/chip.
+
+    Drives the production ``EthashLightBackend`` device path: epoch cache
+    HBM-resident, per-nonce dataset items derived on device via FNV folds
+    over cache gathers (64 accesses x 2 pages x 256 parents = 32k random
+    64-byte gathers per hash — deliberately HBM-bound, SURVEY §5's
+    DAG-algorithm shape). The epoch is an explicit scaled-down one (cache
+    generation is a sequential host-side keccak chain — a real epoch-0
+    16 MiB cache costs ~1M python keccaks; the measured inner loop's
+    gather/FNV work per hash is identical regardless of cache rows).
+    """
+    import jax
+
+    from otedama_tpu.runtime.search import EthashLightBackend
+
+    platform = jax.devices()[0].platform
+    log(f"bench: ethash on platform={platform}")
+    # 8191 rows (prime, 512 KiB cache) keeps host-side cache build ~tens
+    # of seconds while staying far beyond any cache-resident toy size
+    rows, pages = 8191, 4194301
+    chunk = 1 << 12 if platform == "tpu" else 1 << 7
+    log(f"bench: building explicit epoch cache ({rows} rows) ...")
+    t0 = time.monotonic()
+    backend = EthashLightBackend(
+        cache_rows=rows, full_pages=pages, chunk=chunk,
+        device=True,
+    )
+    log(f"bench: cache built in {time.monotonic() - t0:.1f}s; compiling ...")
+    jc = _job_constants()
+    hs = _timed_backend_rate(backend, jc, chunk)
+    log(f"bench: ethash -> {hs:.1f} H/s")
+    return {
+        "metric": "ethash_hashrate_per_chip",
+        "value": round(hs, 1),
+        "unit": "H/s",
+        "vs_baseline": None,
+        "epoch": {"cache_rows": rows, "full_pages": pages},
     }
 
 
@@ -299,7 +341,7 @@ def _guard_platform(probe_timeout: float = 90.0) -> bool:
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--algo", default="sha256d",
-                    choices=("sha256d", "scrypt", "x11"))
+                    choices=("sha256d", "scrypt", "x11", "ethash"))
     ap.add_argument("--engine-path", action="store_true",
                     help="measure through the live engine loop")
     ap.add_argument("--x11-backend", default="numpy", choices=("numpy", "jax"),
@@ -314,6 +356,7 @@ def main() -> None:
         out = {
             "sha256d": bench_sha256d,
             "scrypt": bench_scrypt,
+            "ethash": bench_ethash,
         }[args.algo]()
     if fell_back:
         out["note"] = (

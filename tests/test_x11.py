@@ -132,30 +132,30 @@ def test_shavite512_published_empty_kat_prefix():
 
 
 def test_dash_genesis_oracle_documented():
-    """The chain-level certification oracle: once simd512 is canonical,
-    this must equal the Dash genesis hash. Until then it must NOT (a
-    surprise pass would mean the gate can be lifted)."""
-    import struct
-
-    merkle = bytes.fromhex(
-        "e0028eb9648db56b1ac77cf090b99048a8007e2bb64b68f092c03c7f56a662c7"
-    )[::-1]
-    hdr = (
-        struct.pack("<I", 1)
-        + b"\x00" * 32
-        + merkle
-        + struct.pack("<III", 1390095618, 0x1E0FFFF0, 28917698)
-    )
-    digest = x11.x11_digest(hdr)[::-1].hex()
-    genesis = "00000ffd590b1485b3caadc19b22e6379c733355108f107a430458cdb3407424"
+    """The chain-level certification oracle. Both genesis-hash candidates
+    are OFFLINE RECOLLECTIONS (see kernels/x11 docstring), so even a
+    chain match must not auto-lift the canonical gate — it makes the
+    configuration a finalist pending one out-of-band verification of the
+    true genesis hash. Until then x11 must stay non-canonical."""
+    digest = x11.x11_digest(x11.DASH_GENESIS_HEADER)[::-1].hex()
     from otedama_tpu.engine import algos
 
-    if digest == genesis:
-        assert algos._REGISTRY["x11"].canonical, (
-            "chain matches Dash genesis — lift the canonical gate!"
+    assert not algos._REGISTRY["x11"].canonical, (
+        "x11 may only become canonical after an out-of-band check of the "
+        "genesis hash (both in-repo candidates are unverified recall)"
+    )
+    if digest in x11.DASH_GENESIS_ORACLES.values():
+        matched = [k for k, v in x11.DASH_GENESIS_ORACLES.items()
+                   if v == digest]
+        # the one event this test exists to surface — fail loudly rather
+        # than bury a FINALIST in captured stdout
+        pytest.fail(
+            f"x11 chain digest matches genesis candidate {matched}: "
+            "FINALIST — verify the true Dash genesis hash out-of-band, "
+            "then lift the canonical gate in engine/algos.py and update "
+            "this test",
+            pytrace=False,
         )
-    else:
-        assert not algos._REGISTRY["x11"].canonical
 
 
 # -- structural tests for every stage ---------------------------------------

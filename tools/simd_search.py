@@ -1,0 +1,217 @@
+"""Search for the canonical SIMD-512 configuration via the Dash-genesis
+chain oracle.
+
+All 10 other x11 stages are externally KAT-verified, so if a candidate
+simd512 is canonical, the full chain digest of the Dash genesis header
+must equal the genesis block hash. Two oracle values are checked:
+
+- the one documented in kernels/x11/__init__.py (round-2 recall), and
+- 00000ffd590b1485b3caadc19b22e6379c733355108f107a430458cdf3407ab6
+  (this round's independent recall of dash chainparams.cpp).
+
+Candidate space (mechanism variants around the round-2 reconstruction):
+
+- twist: how yoff_b_n = 163^k (normal) / yoff_b_f = 2*233^k (final)
+  enters the NTT output: ``add`` (tq = q[k] + yoff[k], i.e. an extra
+  marker input point — matches sph_simd.c's ``tq = q[i] + yoff_b_n[i]``)
+  vs ``mul`` (round-2's shipped choice).
+- mm: post-centering 16-bit lift multiplier applied as PLAIN signed
+  integer product (NOT mod 257): 1 (none), 185 both blocks, or
+  185 normal / 233 final.
+- pair: 16-bit packing partner: (k, k+128) vs (2k, 2k+1).
+- pad80: whether the zero-padded partial block carries a 0x80 marker.
+"""
+
+from __future__ import annotations
+
+import itertools
+import pathlib
+import struct
+import sys
+
+import numpy as np
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+from otedama_tpu.kernels.x11 import (  # noqa: E402
+    DASH_GENESIS_HEADER,
+    DASH_GENESIS_ORACLES,
+    ORDER,
+    STAGES_BYTES,
+)
+from otedama_tpu.kernels.x11 import simd as simd_mod  # noqa: E402
+
+P = 257
+U32 = np.uint32
+MASK32 = 0xFFFFFFFF
+
+ORACLES = DASH_GENESIS_ORACLES
+
+
+def ntt256(x: np.ndarray) -> np.ndarray:
+    return (x @ simd_mod._ntt_matrix().T) % P
+
+
+YOFF_N = np.array([pow(163, k, P) for k in range(256)], dtype=np.int64)
+YOFF_F = np.array([(2 * pow(233, k, P)) % P for k in range(256)], dtype=np.int64)
+
+
+def expand(block: np.ndarray, final: bool, twist: str, mm: str,
+           pair: str) -> np.ndarray:
+    """[128] uint8 -> [256] uint32 expanded W words (pair != window modes)
+    or the centered+scaled q for window modes (length 256 int64)."""
+    x = np.zeros(256, dtype=np.int64)
+    x[:128] = block
+    y = ntt256(x[None, :])[0]
+    yoff = YOFF_F if final else YOFF_N
+    if twist == "add":
+        s = (y + yoff) % P
+    else:
+        s = (y * yoff) % P
+    s = np.where(s > 128, s - P, s)  # centered representative
+    if mm == "none":
+        m = 1
+    elif mm == "185":
+        m = 185
+    else:  # 185/233
+        m = 233 if final else 185
+    s = s * m  # plain integer product, NOT mod 257
+    if pair.startswith("win"):
+        return s  # window modes index q per step; see step_w()
+    if pair == "k128":
+        lo, hi = s, np.roll(s, -128)
+    elif pair == "2k":
+        # (2k, 2k+1) pairing produces 128 pairs used twice (groups repeat)
+        lo = np.concatenate([s[0::2], s[0::2]])
+        hi = np.concatenate([s[1::2], s[1::2]])
+    W = (lo.astype(np.int64) & 0xFFFF) | ((hi.astype(np.int64) & 0xFFFF) << 16)
+    return (W & MASK32).astype(np.uint32)
+
+
+def step_words(q: np.ndarray, t: int, pair: str, seen: dict) -> list[int]:
+    """Window modes: step t reads a 16-value q window ``16*(WSP[t] % 16)``.
+
+    - win-even: lo=q[w+2j], hi=q[w+2j+1]; second visit of a window swaps
+      lo/hi (sph's W_BIG o1/o2 args).
+    - win-half: lo=q[w+j], hi=q[w+8+j]; second visit swaps halves.
+    - win-even-ns / win-half-ns: same without the second-visit swap.
+    """
+    sb = simd_mod.WSP[t] % 16
+    w = 16 * sb
+    second = seen.get(sb, False)
+    seen[sb] = True
+    swap = second and not pair.endswith("-ns")
+    out = []
+    for j in range(8):
+        if pair.startswith("win-even"):
+            lo, hi = int(q[w + 2 * j]), int(q[w + 2 * j + 1])
+        else:  # win-half
+            lo, hi = int(q[w + j]), int(q[w + 8 + j])
+        if swap:
+            lo, hi = hi, lo
+        out.append(((lo & 0xFFFF) | ((hi & 0xFFFF) << 16)) & MASK32)
+    return out
+
+
+def rotl(x: int, n: int) -> int:
+    n &= 31
+    return ((x << n) | (x >> (32 - n))) & MASK32 if n else x
+
+
+def f_if(a, b, c):
+    return ((b ^ c) & a) ^ c
+
+
+def f_maj(a, b, c):
+    return (c & b) | ((c | b) & a)
+
+
+def compress(state: list, block: np.ndarray, final: bool, twist: str,
+             mm: str, pair: str) -> list:
+    W = expand(block, final, twist, mm, pair)
+    saved = [state[0:8], state[8:16], state[16:24], state[24:32]]
+    m32 = block.view("<u4").astype(np.int64)
+    st = [int(state[i]) ^ int(m32[i]) for i in range(32)]
+    A, Bv, C, D = st[0:8], st[8:16], st[16:24], st[24:32]
+
+    def step(A, Bv, C, D, w, fn, r, s, p):
+        tA = [rotl(A[j], r) for j in range(8)]
+        newA = [
+            (rotl((D[j] + w[j] + fn(A[j], Bv[j], C[j])) & MASK32, s)
+             + tA[j ^ p]) & MASK32
+            for j in range(8)
+        ]
+        return newA, tA, Bv, C
+
+    seen: dict = {}
+    for t in range(32):
+        rnd, k = divmod(t, 8)
+        c = simd_mod.ROUND_ROTS[rnd]
+        r, s = c[k % 4], c[(k + 1) % 4]
+        fn = f_if if k < 4 else f_maj
+        if pair.startswith("win"):
+            w = step_words(W, t, pair, seen)
+        else:
+            base = simd_mod.WSP[t] * 8
+            w = [int(W[base + j]) for j in range(8)]
+        A, Bv, C, D = step(A, Bv, C, D, w, fn, r, s, simd_mod.PMASK[t])
+    for fs in range(4):
+        r, s = simd_mod.FF_ROTS[fs]
+        w = [int(v) for v in saved[fs]]
+        A, Bv, C, D = step(A, Bv, C, D, w, f_if, r, s, simd_mod.PMASK[32 + fs])
+    return A + Bv + C + D
+
+
+def simd512_variant(data: bytes, twist: str, mm: str, pair: str,
+                    pad80: bool) -> bytes:
+    n = len(data)
+    n_blocks = max(1, (n + 127) // 128)
+    padded = bytearray(n_blocks * 128)
+    padded[:n] = data
+    if pad80 and n % 128 != 0:
+        padded[n] = 0x80
+    state = [int(v) for v in simd_mod.IV512]
+    for b in range(n_blocks):
+        blk = np.frombuffer(bytes(padded[b * 128:(b + 1) * 128]), np.uint8)
+        state = compress(state, blk, False, twist, mm, pair)
+    length_block = bytearray(128)
+    length_block[:8] = struct.pack("<Q", n * 8)
+    blk = np.frombuffer(bytes(length_block), np.uint8)
+    state = compress(state, blk, True, twist, mm, pair)
+    return b"".join(struct.pack("<I", state[i]) for i in range(16))
+
+
+def chain_with(simd_fn, data: bytes) -> bytes:
+    h = data
+    for name in ORDER:
+        fn = simd_fn if name == "simd512" else STAGES_BYTES[name]
+        h = fn(h)
+    return h[:32]
+
+
+def main() -> None:
+    header = DASH_GENESIS_HEADER
+    combos = list(itertools.product(
+        ("add", "mul"), ("none", "185", "185/233"),
+        ("k128", "2k", "win-even", "win-even-ns", "win-half", "win-half-ns"),
+        (False, True),
+    ))
+    for twist, mm, pair, pad80 in combos:
+        def fn(d, twist=twist, mm=mm, pair=pair, pad80=pad80):
+            return simd512_variant(d, twist, mm, pair, pad80)
+
+        digest = chain_with(fn, header)[::-1].hex()
+        tag = f"twist={twist} mm={mm} pair={pair} pad80={pad80}"
+        for oname, oval in ORACLES.items():
+            if digest == oval:
+                print(
+                    f"*** FINALIST [{oname}] {tag} — verify the true "
+                    "genesis hash out-of-band before lifting the gate"
+                )
+                return
+        print(f"    {tag} -> {digest[:24]}...")
+    print("no match in mechanism space")
+
+
+if __name__ == "__main__":
+    main()
