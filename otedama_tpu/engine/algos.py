@@ -22,7 +22,7 @@ from typing import Callable
 _PLANNING = {
     "sha256d": 1.03e9,   # measured: Pallas kernel, v5e chip (bench.py r2)
     "sha256": 1.9e9,     # one compression ~= 2x sha256d's two
-    "scrypt": 1.3e4,     # measured: XLA backend, v5e chip (BENCH_SCRYPT_r03)
+    "scrypt": 2.4e4,     # measured: pallas BlockMix, v5e chip (BENCH_SCRYPT_r03)
     "x11": 7.0e2,        # measured: numpy host pipeline (until device port)
 }
 
@@ -67,7 +67,9 @@ def _load_kernels() -> None:
     _KERNELS_LOADED = True
     import importlib
 
-    for mod in ("otedama_tpu.kernels.scrypt_jax", "otedama_tpu.kernels.x11",
+    for mod in ("otedama_tpu.kernels.scrypt_jax",
+                "otedama_tpu.kernels.scrypt_pallas",
+                "otedama_tpu.kernels.x11",
                 "otedama_tpu.kernels.ethash"):
         try:
             importlib.import_module(mod)

@@ -46,22 +46,31 @@ def _rotl(x, n: int):
     return (x << n) | (x >> (32 - n))
 
 
-def salsa20_8(x):
-    """Salsa20/8 core over 16 uint32 arrays (LE-word values). Returns 16."""
+def salsa_double_round(x):
+    """One Salsa20 double round (column round + row round) over 16 word
+    arrays. Shared by the XLA tier (unrolled here) and the Pallas kernel
+    (rolled via in-kernel fori_loop — kernels/scrypt_pallas)."""
     z = list(x)
 
     def qr(a, b, c, n):
         z[a] = z[a] ^ _rotl(z[b] + z[c], n)
 
+    qr(4, 0, 12, 7); qr(8, 4, 0, 9); qr(12, 8, 4, 13); qr(0, 12, 8, 18)
+    qr(9, 5, 1, 7); qr(13, 9, 5, 9); qr(1, 13, 9, 13); qr(5, 1, 13, 18)
+    qr(14, 10, 6, 7); qr(2, 14, 10, 9); qr(6, 2, 14, 13); qr(10, 6, 2, 18)
+    qr(3, 15, 11, 7); qr(7, 3, 15, 9); qr(11, 7, 3, 13); qr(15, 11, 7, 18)
+    qr(1, 0, 3, 7); qr(2, 1, 0, 9); qr(3, 2, 1, 13); qr(0, 3, 2, 18)
+    qr(6, 5, 4, 7); qr(7, 6, 5, 9); qr(4, 7, 6, 13); qr(5, 4, 7, 18)
+    qr(11, 10, 9, 7); qr(8, 11, 10, 9); qr(9, 8, 11, 13); qr(10, 9, 8, 18)
+    qr(12, 15, 14, 7); qr(13, 12, 15, 9); qr(14, 13, 12, 13); qr(15, 14, 13, 18)
+    return z
+
+
+def salsa20_8(x):
+    """Salsa20/8 core over 16 uint32 arrays (LE-word values). Returns 16."""
+    z = list(x)
     for _ in range(4):  # 8 rounds = 4 double-rounds
-        qr(4, 0, 12, 7); qr(8, 4, 0, 9); qr(12, 8, 4, 13); qr(0, 12, 8, 18)
-        qr(9, 5, 1, 7); qr(13, 9, 5, 9); qr(1, 13, 9, 13); qr(5, 1, 13, 18)
-        qr(14, 10, 6, 7); qr(2, 14, 10, 9); qr(6, 2, 14, 13); qr(10, 6, 2, 18)
-        qr(3, 15, 11, 7); qr(7, 3, 15, 9); qr(11, 7, 3, 13); qr(15, 11, 7, 18)
-        qr(1, 0, 3, 7); qr(2, 1, 0, 9); qr(3, 2, 1, 13); qr(0, 3, 2, 18)
-        qr(6, 5, 4, 7); qr(7, 6, 5, 9); qr(4, 7, 6, 13); qr(5, 4, 7, 18)
-        qr(11, 10, 9, 7); qr(8, 11, 10, 9); qr(9, 8, 11, 13); qr(10, 9, 8, 18)
-        qr(12, 15, 14, 7); qr(13, 12, 15, 9); qr(14, 13, 12, 13); qr(15, 14, 13, 18)
+        z = salsa_double_round(z)
     return [z[i] + x[i] for i in range(16)]
 
 
@@ -96,12 +105,17 @@ def _hmac_finish(ostate, digest8, comp):
     return comp(ostate, w)
 
 
-def scrypt_1024_1_1(header_words, nonces, *, rolled: bool = True):
+def scrypt_1024_1_1(header_words, nonces, *, rolled: bool = True,
+                    blockmix: str = "xla"):
     """scrypt(header, header, N=1024, r=1, p=1, dkLen=32) across nonce lanes.
 
     ``header_words``: 19 uint32 scalars — big-endian words of header[0:76].
     ``nonces``: uint32 ``[B]`` — header word 19 (big-endian read of bytes
     76:80, same convention as the sha256d kernels).
+
+    ``blockmix``: "xla" (portable) or "pallas" (TPU: the fused BlockMix
+    kernel in kernels/scrypt_pallas — same math, VMEM-resident
+    intermediates; bit-identical output).
 
     Returns 8 uint32 ``[B]`` big-endian digest words of the 32-byte output.
     """
@@ -134,19 +148,45 @@ def scrypt_1024_1_1(header_words, nonces, *, rolled: bool = True):
     # ROMix operates on LE words.
     X = jnp.stack([sj.bswap32(w) for w in T], axis=-1)  # [B, 32]
 
-    def fill_step(X, _):
-        return blockmix_salsa8_r1(X), X
+    if blockmix not in ("xla", "pallas"):
+        # a typo here would silently run the slower tier under the faster
+        # tier's name — fail loudly instead
+        raise ValueError(f"unknown blockmix tier {blockmix!r}")
+    if blockmix == "pallas":
+        # word-major [32, B] through the ROMix loops (the kernel's native
+        # layout); V stays lane-major [N, B, 32] for the row gather, at the
+        # cost of one cheap layout change per step
+        from otedama_tpu.kernels import scrypt_pallas as sp
 
-    X, V = jax.lax.scan(fill_step, X, None, length=SCRYPT_N)  # V: [N, B, 32]
+        Xt = X.T
 
-    def mix_step(i, X):
-        j = X[..., 16] & _U32(SCRYPT_N - 1)  # Integerify: first LE word of B1
-        Vj = jnp.take_along_axis(
-            V, j[None, :, None].astype(jnp.int32), axis=0
-        )[0]
-        return blockmix_salsa8_r1(X ^ Vj)
+        def fill_step_t(Xt, _):
+            return sp.blockmix_pallas(Xt), Xt.T
 
-    X = jax.lax.fori_loop(0, SCRYPT_N, mix_step, X)
+        Xt, V = jax.lax.scan(fill_step_t, Xt, None, length=SCRYPT_N)
+
+        def mix_step_t(i, Xt):
+            j = Xt[16, :] & _U32(SCRYPT_N - 1)  # Integerify: 1st word of B1
+            Vj = jnp.take_along_axis(
+                V, j[None, :, None].astype(jnp.int32), axis=0
+            )[0]
+            return sp.blockmix_xor_pallas(Xt, Vj.T)
+
+        X = jax.lax.fori_loop(0, SCRYPT_N, mix_step_t, Xt).T
+    else:
+        def fill_step(X, _):
+            return blockmix_salsa8_r1(X), X
+
+        X, V = jax.lax.scan(fill_step, X, None, length=SCRYPT_N)
+
+        def mix_step(i, X):
+            j = X[..., 16] & _U32(SCRYPT_N - 1)  # Integerify: 1st word of B1
+            Vj = jnp.take_along_axis(
+                V, j[None, :, None].astype(jnp.int32), axis=0
+            )[0]
+            return blockmix_salsa8_r1(X ^ Vj)
+
+        X = jax.lax.fori_loop(0, SCRYPT_N, mix_step, X)
 
     # PBKDF2 pass 2: output = HMAC(P, X_bytes || INT(1)) first 32 bytes.
     bw = [sj.bswap32(X[..., i]) for i in range(32)]  # back to BE words
@@ -161,8 +201,9 @@ def scrypt_1024_1_1(header_words, nonces, *, rolled: bool = True):
     return _hmac_finish(ostate, inner, comp)
 
 
-@functools.partial(jax.jit, static_argnames=("n", "rolled"))
-def scrypt_search_step(header19, base, limbs8, *, n: int, rolled: bool = True):
+@functools.partial(jax.jit, static_argnames=("n", "rolled", "blockmix"))
+def scrypt_search_step(header19, base, limbs8, *, n: int, rolled: bool = True,
+                       blockmix: str = "xla"):
     """Jittable scrypt nonce-search step.
 
     ``header19``: uint32[19] array; ``base``: uint32 scalar; ``limbs8``:
@@ -170,7 +211,8 @@ def scrypt_search_step(header19, base, limbs8, *, n: int, rolled: bool = True):
     """
     nonces = base + jax.lax.iota(jnp.uint32, n)
     d = scrypt_1024_1_1(
-        tuple(header19[i] for i in range(19)), nonces, rolled=rolled
+        tuple(header19[i] for i in range(19)), nonces, rolled=rolled,
+        blockmix=blockmix,
     )
     h = sj.digest_words_to_compare_order(d)
     hits = sj.le256(h, tuple(limbs8[i] for i in range(8)))

@@ -1,0 +1,162 @@
+"""Fused Pallas BlockMix for scrypt ROMix (N=1024, r=1, p=1).
+
+Why this kernel exists: profiling the pure-XLA scrypt path
+(``kernels/scrypt_jax.py``) on the v5e showed it bound not by HBM
+bandwidth but by materialization — each ROMix iteration's Salsa20/8 chain
+is ~256 dependent ops over ``[B, 32]`` u32, and XLA materializes enough of
+the intermediates that per-chunk traffic is hundreds of times the
+algorithmic minimum (13-19 kH/s measured at 4k-32k lanes, vs a ~1 MB/hash
+algorithmic footprint). This module fuses one whole BlockMix — both
+Salsa20/8 cores, their feed-forward adds, and the leading ``X ^ V[j]``
+XOR — into a single Pallas kernel: every intermediate lives in
+VMEM/vector registers, and the only HBM traffic per ROMix step is the
+``[B]``-lane read(s) and write the algorithm actually requires.
+
+The ROMix loop structure (scan for the fill pass, fori_loop + XLA gather
+for the mix pass) stays in ``scrypt_jax``: XLA's native row gather on the
+``[N, B, 32]`` V tensor is exactly the 128-byte-row random-access pattern
+scrypt's Integerify demands, and Pallas cannot beat it with per-lane DMAs
+(millions of scalar-issued 128-byte copies per chunk). Hybrid ownership:
+XLA moves the memory, Pallas does the math.
+
+Kernel-shape lessons baked in (the first attempt OOM'd Mosaic's 16 MiB
+scoped VMEM at 52.65 MiB):
+
+- WORD-MAJOR refs ``[32, B]``: word i is a natural row read
+  (``x_ref[i, :]``), no minor-axis relayout per extraction. The XLA side
+  pays one cheap layout change per ROMix step instead (V stays lane-major
+  for the gather).
+- ROLLED rounds: the 4 Salsa double-rounds run as an in-kernel
+  ``fori_loop`` with a 16-vector carry, capping the live set at ~50
+  vectors instead of the ~1000 of a fully unrolled chain.
+
+Reference for the scrypt parameters: internal/mining/multi_algorithm.go:
+100-140 (N=1024, r=1, p=1). The Salsa20 double-round is imported from
+``scrypt_jax`` — one definition, two execution tiers.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+
+from otedama_tpu.kernels.scrypt_jax import salsa_double_round
+
+_U32 = jnp.uint32
+
+LANE_TILE = 8192  # lanes per grid step: 3 x (32*8192*4) = 3 MiB VMEM blocks
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # pragma: no cover - no backend at all
+        return False
+
+
+def _salsa8_rolled(x16: list) -> list:
+    """Salsa20/8 with the double-round rolled into a fori_loop (keeps the
+    Mosaic live-set small; the python-level loop in scrypt_jax.salsa20_8
+    would unroll at trace time)."""
+
+    def body(_, z):
+        return tuple(salsa_double_round(list(z)))
+
+    z = jax.lax.fori_loop(0, 4, body, tuple(x16))
+    return [z[i] + x16[i] for i in range(16)]
+
+
+def _blockmix_words(xw: list) -> list:
+    """BlockMix r=1 on 32 word vectors: returns 32 word vectors."""
+    B0, B1 = xw[:16], xw[16:]
+    Y0 = _salsa8_rolled([a ^ b for a, b in zip(B1, B0)])
+    Y1 = _salsa8_rolled([a ^ b for a, b in zip(Y0, B1)])
+    return Y0 + Y1
+
+
+def _bm_kernel(x_ref, o_ref):
+    y = _blockmix_words([x_ref[i, :] for i in range(32)])
+    for i in range(32):
+        o_ref[i, :] = y[i]
+
+
+def _bmx_kernel(x_ref, v_ref, o_ref):
+    y = _blockmix_words([x_ref[i, :] ^ v_ref[i, :] for i in range(32)])
+    for i in range(32):
+        o_ref[i, :] = y[i]
+
+
+def _tile(B: int) -> int:
+    t = min(LANE_TILE, B)
+    if B % t:
+        raise ValueError(f"batch {B} not a multiple of lane tile {t}")
+    return t
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def blockmix_pallas(Xt, *, interpret: bool | None = None):
+    """BlockMix over word-major ``[32, B]`` uint32 lanes (fill-pass step)."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    B = Xt.shape[1]
+    T = _tile(B)
+    return pl.pallas_call(
+        _bm_kernel,
+        grid=(B // T,),
+        in_specs=[pl.BlockSpec((32, T), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((32, T), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((32, B), jnp.uint32),
+        interpret=interpret,
+    )(Xt)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def blockmix_xor_pallas(Xt, Vjt, *, interpret: bool | None = None):
+    """BlockMix(X ^ Vj) on word-major ``[32, B]`` (mix-pass step, XOR
+    fused into the kernel)."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    B = Xt.shape[1]
+    T = _tile(B)
+    return pl.pallas_call(
+        _bmx_kernel,
+        grid=(B // T,),
+        in_specs=[
+            pl.BlockSpec((32, T), lambda i: (0, i)),
+            pl.BlockSpec((32, T), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((32, T), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((32, B), jnp.uint32),
+        interpret=interpret,
+    )(Xt, Vjt)
+
+
+# registry: loading this module makes the fused-BlockMix tier selectable;
+# algo_manager's single-chip TPU order ("pallas-tpu", "xla") then prefers it
+from otedama_tpu.engine import algos as _algos  # noqa: E402
+
+_algos.mark_implemented("scrypt", "pallas-tpu")
+
+
+def self_check(B: int = 4, *, interpret: bool = True) -> None:
+    """Kernel vs the XLA blockmix on random words — used by tests."""
+    from otedama_tpu.kernels.scrypt_jax import blockmix_salsa8_r1
+
+    rng = np.random.default_rng(7)
+    X = jnp.asarray(rng.integers(0, 1 << 32, (B, 32), dtype=np.uint32))
+    V = jnp.asarray(rng.integers(0, 1 << 32, (B, 32), dtype=np.uint32))
+    want = np.asarray(blockmix_salsa8_r1(X))
+    got = np.asarray(blockmix_pallas(X.T, interpret=interpret)).T
+    if not np.array_equal(want, got):
+        raise AssertionError("blockmix_pallas != blockmix_salsa8_r1")
+    want2 = np.asarray(blockmix_salsa8_r1(X ^ V))
+    got2 = np.asarray(
+        blockmix_xor_pallas(X.T, V.T, interpret=interpret)
+    ).T
+    if not np.array_equal(want2, got2):
+        raise AssertionError("blockmix_xor_pallas != blockmix(X^V)")

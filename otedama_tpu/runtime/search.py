@@ -310,9 +310,11 @@ class ScryptXlaBackend:
     name = "scrypt-xla"
     algorithm = "scrypt"
 
-    def __init__(self, chunk: int = 1 << 12, rolled: bool | None = None):
+    def __init__(self, chunk: int = 1 << 12, rolled: bool | None = None,
+                 blockmix: str = "xla"):
         self.chunk = chunk
         self.rolled = _default_rolled() if rolled is None else rolled
+        self.blockmix = blockmix
 
     def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
         from otedama_tpu.kernels import scrypt_jax as sc
@@ -324,7 +326,8 @@ class ScryptXlaBackend:
 
         def step(b):
             return sc.scrypt_search_step(
-                h19, jnp.uint32(b), lb, n=self.chunk, rolled=self.rolled
+                h19, jnp.uint32(b), lb, n=self.chunk, rolled=self.rolled,
+                blockmix=self.blockmix,
             )
 
         return _chunked_search(
@@ -332,6 +335,22 @@ class ScryptXlaBackend:
             lambda w: sc.scrypt_digest_host(jc.header_for(w)),
             verify=True,
         )
+
+
+class ScryptPallasBackend(ScryptXlaBackend):
+    """Scrypt search with the fused Pallas BlockMix (kernels/scrypt_pallas):
+    identical pipeline and bit-identical output to ``scrypt-xla``, but every
+    ROMix step's Salsa20/8 chain runs as one VMEM-resident kernel. TPU-only
+    (falls back to interpret mode off-TPU, which is far slower than xla —
+    callers should select it only on TPU)."""
+
+    name = "scrypt-pallas"
+
+    def __init__(self, chunk: int = 1 << 13, rolled: bool | None = None):
+        from otedama_tpu.kernels import scrypt_pallas as sp
+
+        sp._tile(chunk)  # fail fast here, not deep inside the first trace
+        super().__init__(chunk=chunk, rolled=rolled, blockmix="pallas")
 
 
 class ScryptPythonBackend:
@@ -606,6 +625,8 @@ def make_backend(kind: str, algorithm: str = "sha256d", **kwargs):
                 ) from None
             return NativeCpuBackend(**kwargs)
     elif algorithm == "scrypt":
+        if kind == "pallas-tpu":
+            return ScryptPallasBackend(**kwargs)
         if kind == "xla":
             return ScryptXlaBackend(**kwargs)
         if kind == "python":

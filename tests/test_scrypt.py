@@ -66,3 +66,25 @@ def test_scrypt_registered_as_implemented():
 
     assert algos.supports("scrypt", "xla")
     assert "scrypt" in algos.names(implemented_only=True)
+
+
+def test_blockmix_pallas_matches_xla_blockmix():
+    """The fused Pallas BlockMix (interpret mode off-TPU) is bit-identical
+    to the XLA blockmix it replaces — both the plain and XOR-fused forms."""
+    from otedama_tpu.kernels import scrypt_pallas as sp
+
+    sp.self_check(B=4, interpret=True)
+
+
+def test_scrypt_pallas_pipeline_matches_hashlib_tiny():
+    """Full scrypt with blockmix='pallas' (interpret) vs hashlib on one
+    lane — certifies the kernel inside the real pipeline, not just alone."""
+    h76 = _header76(seed=3)
+    words = sc.header_words19(h76)
+    nonces = np.array([7], dtype=np.uint32)
+    d8 = sc.scrypt_1024_1_1(words, jnp.asarray(nonces), blockmix="pallas")
+    got = np.stack([np.asarray(x) for x in d8], axis=-1)[0]
+    want = np.frombuffer(
+        _oracle(h76 + struct.pack(">I", 7)), dtype=">u4"
+    ).astype(np.uint32)
+    assert np.array_equal(got, want)
