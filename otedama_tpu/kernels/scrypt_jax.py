@@ -234,8 +234,11 @@ def header_words19(header76: bytes) -> tuple[int, ...]:
 
 
 # registry: this module loading successfully means scrypt runs on xla (and
-# therefore on TPU through XLA; a hand-tiled Pallas variant can add itself
-# under a distinct backend name later).
+# therefore on TPU through XLA). The fused-Pallas tier registers itself in
+# kernels/scrypt_pallas; "pod" (runtime.mesh.ScryptPodBackend, the
+# multi-chip SPMD path) needs only this module plus the generic mesh
+# machinery, so it registers here.
 from otedama_tpu.engine import algos as _algos  # noqa: E402
 
 _algos.mark_implemented("scrypt", "xla")
+_algos.mark_implemented("scrypt", "pod")

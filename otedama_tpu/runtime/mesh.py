@@ -126,6 +126,17 @@ def make_pod_mesh(devices=None, n_hosts: int = 1) -> Mesh:
     return Mesh(arr, ("host", "chip"))
 
 
+def parse_mesh_axes(mesh: Mesh, what: str) -> tuple[tuple, int, int]:
+    """(axes, n_hosts, n_chips) of a 1D (chip) or 2D (host, chip) mesh —
+    shared by every pod-search flavor so axis handling cannot drift."""
+    names = mesh.axis_names
+    if len(names) == 1:
+        return (names[0],), 1, mesh.shape[names[0]]
+    if len(names) == 2:
+        return tuple(names), mesh.shape[names[0]], mesh.shape[names[1]]
+    raise ValueError(f"{what} wants a 1D (chip) or 2D (host, chip) mesh")
+
+
 def make_chip_mesh(devices=None, axis: str = "chips") -> Mesh:
     """1D chip mesh (kept for single-row pods / tests)."""
     devices = devices if devices is not None else jax.devices()
@@ -150,16 +161,9 @@ class PodSearch:
     rolled: bool | None = None      # jnp path: rolled rounds off-TPU
 
     def __post_init__(self):
-        names = self.mesh.axis_names
-        if len(names) == 1:
-            self._axes = (names[0],)
-            self.n_hosts, self.n_chips = 1, self.mesh.shape[names[0]]
-        elif len(names) == 2:
-            self._axes = tuple(names)
-            self.n_hosts = self.mesh.shape[names[0]]
-            self.n_chips = self.mesh.shape[names[1]]
-        else:
-            raise ValueError("PodSearch wants a 1D (chip) or 2D (host, chip) mesh")
+        self._axes, self.n_hosts, self.n_chips = parse_mesh_axes(
+            self.mesh, "PodSearch"
+        )
         if self.use_pallas is None:
             self.use_pallas = jax.default_backend() == "tpu"
         if self.rolled is None:
@@ -233,6 +237,8 @@ class PodSearch:
         if len(jcs) != self.n_hosts:
             raise ValueError(f"need {self.n_hosts} jobs (one per host row), got {len(jcs)}")
         # all rows share one target (same job difficulty across extranonces)
+        if any(jc.target != jcs[0].target for jc in jcs):
+            raise ValueError("all pod rows must share one share target")
         limbs = jcs[0].limbs
         per_chip = -(-count // self.n_chips)              # ceil
         per_chip = -(-per_chip // self.tile) * self.tile  # round up to tiles
@@ -315,6 +321,170 @@ class PodBackend:
         self.pod = PodSearch(mesh, **pod_kwargs)
         self.en2_fanout = self.pod.n_hosts
         self.name = f"pod{self.pod.n_hosts}x{self.pod.n_chips}"
+
+    def search_multi(
+        self, jcs: list[JobConstants], base: int, count: int
+    ) -> list[SearchResult]:
+        return self.pod.search_jobs(jcs, base, count)
+
+    def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
+        if self.en2_fanout != 1:
+            raise ValueError(
+                f"{self.name} searches {self.en2_fanout} extranonce spaces "
+                "per call; use search_multi()"
+            )
+        return self.pod.search_jobs([jc], base, count)[0]
+
+
+@dataclasses.dataclass
+class ScryptPodSearch:
+    """SPMD scrypt (N=1024,r=1,p=1) search across a (host, chip) mesh.
+
+    Same shape as ``PodSearch`` — host rows are real extranonce2 spaces
+    (one ``JobConstants`` per row), the chip axis strides each row's nonce
+    range, telemetry reduces over ICI so the pod reports as one worker —
+    but the per-chip local is the full scrypt pipeline (PBKDF2 -> ROMix ->
+    PBKDF2, kernels/scrypt_jax; the fused Pallas BlockMix on TPU). scrypt
+    has no midstate trick, so rows ship 19 header words instead of
+    midstate+tail, and winner recovery pulls each chip's hit MASK (scrypt
+    counts are small — tens of kH per call — so a dense bool per lane is
+    cheap) with exact host-side digest verification per hit.
+
+    Reference parity: the extranonce partition of
+    internal/stratum/unified_stratum.go:690-714 applied to the scrypt
+    engine of internal/mining/multi_algorithm.go:100-140, executed as one
+    SPMD program instead of a worker pool.
+    """
+
+    mesh: Mesh
+    blockmix: str | None = None  # None = "pallas" iff running on TPU
+    rolled: bool | None = None
+
+    def __post_init__(self):
+        self._axes, self.n_hosts, self.n_chips = parse_mesh_axes(
+            self.mesh, "ScryptPodSearch"
+        )
+        on_tpu = jax.default_backend() == "tpu"
+        if self.blockmix is None:
+            self.blockmix = "pallas" if on_tpu else "xla"
+        if self.rolled is None:
+            self.rolled = not on_tpu
+        self._steps: dict[int, callable] = {}
+
+    def _build_step(self, per_chip: int):
+        from otedama_tpu.kernels import scrypt_jax as sc
+
+        axes = self._axes
+        chip_axis = axes[-1]
+        host_spec = P(axes[0]) if len(axes) == 2 else P()
+        rolled, blockmix = self.rolled, self.blockmix
+
+        @functools.partial(
+            shard_map,
+            mesh=self.mesh,
+            in_specs=(host_spec, P(), P()),
+            out_specs=(P(*axes), P(*axes), P()),
+            check_vma=False,
+        )
+        def _step(h19_rows, limbs8, base):
+            hw = h19_rows[0]  # this row's 19 header words
+            chip = jax.lax.axis_index(chip_axis).astype(jnp.uint32)
+            my_base = base + chip * jnp.uint32(per_chip)
+            nonces = my_base + jax.lax.iota(jnp.uint32, per_chip)
+            d = sc.scrypt_1024_1_1(
+                tuple(hw[i] for i in range(19)), nonces,
+                rolled=rolled, blockmix=blockmix,
+            )
+            h = sj.digest_words_to_compare_order(d)
+            hits = sj.le256(h, tuple(limbs8[i] for i in range(8)))
+            local_best = _flip(h[0]).min()
+            pod_best = _unflip(jax.lax.pmin(local_best, axes))
+            shape = (1, 1, per_chip) if len(axes) == 2 else (1, per_chip)
+            return hits.reshape(shape), h[0].reshape(shape), pod_best
+
+        return jax.jit(_step)
+
+    def _step_for(self, per_chip: int):
+        step = self._steps.get(per_chip)
+        if step is None:
+            step = self._steps[per_chip] = self._build_step(per_chip)
+        return step
+
+    def search_jobs(
+        self, jcs: list[JobConstants], base: int, count: int
+    ) -> list[SearchResult]:
+        from otedama_tpu.kernels import scrypt_jax as sc
+
+        if len(jcs) != self.n_hosts:
+            raise ValueError(
+                f"need {self.n_hosts} jobs (one per host row), got {len(jcs)}"
+            )
+        # the device hit mask is computed against ONE target for the whole
+        # pod (same job difficulty across extranonce rows); a silently
+        # different per-row target would drop that row's winners
+        if any(jc.target != jcs[0].target for jc in jcs):
+            raise ValueError("all pod rows must share one share target")
+        limbs = jcs[0].limbs
+        per_chip = max(-(-count // self.n_chips), 1)
+        if self.blockmix == "pallas":
+            # scrypt_pallas._tile accepts any B <= LANE_TILE, else only
+            # multiples of it — round up (overscan lanes are filtered on
+            # extraction, same as PodSearch's tile rounding)
+            from otedama_tpu.kernels.scrypt_pallas import LANE_TILE
+
+            if per_chip > LANE_TILE and per_chip % LANE_TILE:
+                per_chip = -(-per_chip // LANE_TILE) * LANE_TILE
+        scanned = per_chip * self.n_chips
+
+        h19 = jnp.asarray(np.stack([
+            np.array(sc.header_words19(jc.header76), dtype=np.uint32)
+            for jc in jcs
+        ]))
+        out = self._step_for(per_chip)(
+            h19, jnp.asarray(limbs), jnp.uint32(base & 0xFFFFFFFF)
+        )
+        hits, h0, pod_best = (np.asarray(o) for o in out)
+        if hits.ndim == 2:  # 1D mesh: add the row axis
+            hits, h0 = hits[None], h0[None]
+        self.last_pod_best = int(pod_best)
+
+        results: list[SearchResult] = []
+        for r, jc in enumerate(jcs):
+            winners: list[Winner] = []
+            row = hits[r].reshape(-1)  # chip-major concatenation
+            row_best = int(h0[r].reshape(-1).min())
+            for idx in np.nonzero(row)[0].tolist():
+                nonce = (base + idx) & 0xFFFFFFFF
+                if scanned != count and idx >= count:
+                    continue  # overscan lane beyond the requested range
+                digest = sc.scrypt_digest_host(jc.header_for(nonce))
+                if tgt.hash_meets_target(digest, jc.target):
+                    winners.append(Winner(nonce, digest))
+            results.append(SearchResult(winners, count, row_best))
+        return results
+
+    def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
+        if self.n_hosts != 1:
+            raise ValueError("search() is for 1-row meshes; use search_jobs()")
+        return self.search_jobs([jc], base, count)[0]
+
+
+class ScryptPodBackend:
+    """Engine-facing scrypt pod device (see ``PodBackend``): every chip of
+    the mesh behind one backend, host rows advertised via ``en2_fanout``."""
+
+    algorithm = "scrypt"
+
+    def __init__(self, mesh: Mesh | None = None, n_hosts: int | None = None,
+                 **pod_kwargs):
+        if mesh is None:
+            devices = jax.devices()
+            if n_hosts is None:
+                n_hosts = 2 if len(devices) % 2 == 0 and len(devices) > 1 else 1
+            mesh = make_pod_mesh(devices, n_hosts)
+        self.pod = ScryptPodSearch(mesh, **pod_kwargs)
+        self.en2_fanout = self.pod.n_hosts
+        self.name = f"scrypt-pod{self.pod.n_hosts}x{self.pod.n_chips}"
 
     def search_multi(
         self, jcs: list[JobConstants], base: int, count: int

@@ -346,7 +346,10 @@ class ScryptPallasBackend(ScryptXlaBackend):
 
     name = "scrypt-pallas"
 
-    def __init__(self, chunk: int = 1 << 13, rolled: bool | None = None):
+    # default = the benchmarked configuration (BENCH_SCRYPT_r03: 24.17 kH/s
+    # at chunk=2^15, the gather-bound sweet spot; V = chunk * 128 KiB HBM) —
+    # the engine's no-kwargs auto construction must run what was measured
+    def __init__(self, chunk: int = 1 << 15, rolled: bool | None = None):
         from otedama_tpu.kernels import scrypt_pallas as sp
 
         sp._tile(chunk)  # fail fast here, not deep inside the first trace
@@ -625,6 +628,10 @@ def make_backend(kind: str, algorithm: str = "sha256d", **kwargs):
                 ) from None
             return NativeCpuBackend(**kwargs)
     elif algorithm == "scrypt":
+        if kind == "pod":
+            from otedama_tpu.runtime.mesh import ScryptPodBackend
+
+            return ScryptPodBackend(**kwargs)
         if kind == "pallas-tpu":
             return ScryptPallasBackend(**kwargs)
         if kind == "xla":
