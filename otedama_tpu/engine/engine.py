@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import itertools
 import logging
 import time
 from typing import Awaitable, Callable, Protocol
@@ -166,6 +167,14 @@ class MiningEngine:
                 batch_size = max(
                     batch_size, getattr(backend, "preferred_batch", 0)
                 )
+            # slow-algorithm backends (scrypt/x11/ethash — kH/s, not GH/s)
+            # cap their batch so one search call stays seconds long: a
+            # clean-job invalidation mid-call must not strand minutes of
+            # stale work. A backend-advertised hard cap, independent of
+            # auto_batch tuning.
+            max_batch = getattr(backend, "max_batch", None)
+            if max_batch:
+                batch_size = min(batch_size, max_batch)
             depth = max(1, self.config.pipeline_depth)
             extranonce = ExtranonceCounter(size=job.extranonce2_size or self.config.extranonce2_size)
             extranonce.value = en2_offset
@@ -193,15 +202,20 @@ class MiningEngine:
                 ]
                 space = NonceRange(0, 1 << 32)
                 t_last = time.monotonic()
-                all_batches = list(space.batches(batch_size))
-                if grouped:
-                    work_units = [
-                        all_batches[i : i + depth]
-                        for i in range(0, len(all_batches), depth)
-                    ]
-                else:
-                    work_units = [[b] for b in all_batches]
-                for unit in work_units:
+                # lazy batching: at clamped (slow-algorithm) batch sizes the
+                # full 2^32 space is millions of batches — materializing
+                # them up front blocks the event loop for the very window
+                # the max_batch clamp exists to shrink
+                batches_iter = iter(space.batches(batch_size))
+
+                def _units(it=batches_iter, k=depth if grouped else 1):
+                    while True:
+                        unit = list(itertools.islice(it, k))
+                        if not unit:
+                            return
+                        yield unit
+
+                for unit in _units():
                     if self._stop.is_set() or serial != self._job_serial:
                         break
                     if grouped:

@@ -485,6 +485,9 @@ class ScryptPodBackend:
         self.pod = ScryptPodSearch(mesh, **pod_kwargs)
         self.en2_fanout = self.pod.n_hosts
         self.name = f"scrypt-pod{self.pod.n_hosts}x{self.pod.n_chips}"
+        # slow-algorithm cap (see engine._search_loop): ~1-2 s of scrypt
+        # per chip per call at the measured per-chip rate
+        self.max_batch = (1 << 15) * self.pod.n_chips
 
     def search_multi(
         self, jcs: list[JobConstants], base: int, count: int

@@ -313,6 +313,9 @@ class ScryptXlaBackend:
     def __init__(self, chunk: int = 1 << 12, rolled: bool | None = None,
                  blockmix: str = "xla"):
         self.chunk = chunk
+        # engine batch cap: at tens of kH/s one search call must stay
+        # seconds-long so clean-job invalidation doesn't strand stale work
+        self.max_batch = 4 * chunk
         self.rolled = _default_rolled() if rolled is None else rolled
         self.blockmix = blockmix
 
@@ -384,6 +387,7 @@ class X11NumpyBackend:
 
     def __init__(self, chunk: int = 1 << 10):
         self.chunk = chunk
+        self.max_batch = 4 * chunk  # see ScryptXlaBackend.max_batch
 
     def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
         from otedama_tpu.kernels import x11
@@ -416,6 +420,7 @@ class X11JaxBackend:
 
     def __init__(self, chunk: int = 1 << 12):
         self.chunk = chunk
+        self.max_batch = 4 * chunk  # see ScryptXlaBackend.max_batch
         self._fn = None
 
     def _compiled(self):
@@ -528,6 +533,7 @@ class EthashLightBackend:
         self._eth = eth
         self.device = device
         self.chunk = chunk
+        self.max_batch = 4 * chunk  # see ScryptXlaBackend.max_batch
         if block_number is not None:
             cache_bytes = eth.cache_size(block_number)
             self.full_size = eth.dataset_size(block_number)

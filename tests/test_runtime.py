@@ -390,6 +390,49 @@ async def test_engine_pipelines_and_adopts_preferred_batch():
     assert engine.stats.hashes >= 6 * 4096
 
 
+@pytest.mark.asyncio
+async def test_engine_clamps_batch_for_slow_backends():
+    """A slow-algorithm backend (scrypt/x11/ethash tiers) advertises
+    max_batch; under auto_batch the engine must clamp the configured batch
+    DOWN to it so one search call stays seconds-long and a clean-job
+    invalidation cannot strand minutes of stale work."""
+    import asyncio
+
+    from otedama_tpu.engine.engine import EngineConfig, MiningEngine
+    from otedama_tpu.engine.types import Job
+    from otedama_tpu.runtime.search import SearchResult
+
+    class SlowAlgoBackend:
+        name = "slowalgo"
+        max_batch = 512
+
+        def __init__(self):
+            self.batches: list[int] = []
+
+        def search(self, jc, base, count):
+            self.batches.append(count)
+            return SearchResult([], count, 0xFFFFFFFF)
+
+    backend = SlowAlgoBackend()
+    engine = MiningEngine(
+        {backend.name: backend},
+        config=EngineConfig(batch_size=1 << 22, pipeline_depth=1),
+    )
+    job = Job(
+        job_id="clamp", prev_hash=bytes(32), coinb1=b"\x01", coinb2=b"\x02",
+        merkle_branch=[], version=0x20000000, nbits=0x1D00FFFF,
+        ntime=1700000000, share_target=1, algorithm="sha256d",
+    )
+    await engine.start()
+    engine.set_job(job)
+    for _ in range(100):
+        await asyncio.sleep(0.02)
+        if backend.batches:
+            break
+    await engine.stop()
+    assert backend.batches and backend.batches[0] == 512
+
+
 def test_scrypt_pod_search_rows_and_winners():
     """Scrypt through the SPMD pod path on the virtual 2x4 mesh: per-row
     extranonce headers, chip-strided nonce ranges, planted winner recovered
