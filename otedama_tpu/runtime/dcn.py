@@ -1,0 +1,116 @@
+"""Multi-host (DCN) bootstrap — groundwork for a fused multi-process pod.
+
+How this framework scales across hosts TODAY: at the STRATUM layer. Each
+host runs its own single-controller engine over its local chips, and the
+pool's per-connection extranonce1 makes every host's search space disjoint
+(reference: internal/stratum/unified_stratum.go:690-714) — the same way
+physical mining farms scale. No cross-host jax runtime is required for
+that, and it is the supported production mode (``k8s/hpa.yaml`` scales
+exactly these independent workers).
+
+This module is the bootstrap for the FUTURE fused mode, where one SPMD
+program spans a multi-host slice (`jax.distributed.initialize` makes
+`jax.devices()` global; XLA routes collectives over ICI within a slice
+and DCN across slices). What the fused mode still needs before it can be
+wired into the engine — and why this module is NOT called from app
+startup yet:
+
+- multi-controller input discipline: every process must build identical
+  per-step inputs for its addressable shard (host-local ``jnp.asarray``
+  of globally-shaped arrays is rejected by multi-controller jax);
+- lockstep job dispatch: a clean-job must reach every process before any
+  re-enters the compiled step, else the laggard blocks in the cross-host
+  psum/pmin while the leader has moved on (deadlock);
+- synchronized batch counts/extranonce state across processes.
+
+``maybe_initialize()`` is exposed for explicit operator use (e.g. a
+future ``--fused-pod`` flag) and is a no-op unless ``OTEDAMA_COORDINATOR``
+is set. Blocking caveat: `jax.distributed.initialize` blocks until every
+process joins — call it before serving, never on a live event loop.
+
+Env contract (StatefulSet-shaped):
+
+- ``OTEDAMA_COORDINATOR``   host:port of process 0 (required to opt in)
+- ``OTEDAMA_NUM_PROCESSES`` world size
+- ``OTEDAMA_PROCESS_ID``    this process's rank; defaults to the ordinal
+  suffix of the pod hostname (StatefulSet convention, e.g. "miner-3")
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import re
+
+log = logging.getLogger("otedama.runtime.dcn")
+
+_INITIALIZED = False
+
+
+@dataclasses.dataclass(frozen=True)
+class DcnConfig:
+    coordinator: str       # "host:port" of process 0
+    num_processes: int
+    process_id: int
+
+    @classmethod
+    def from_env(cls, env: dict | None = None) -> "DcnConfig | None":
+        """None when multi-host is not requested (no coordinator set)."""
+        env = os.environ if env is None else env
+        coord = env.get("OTEDAMA_COORDINATOR", "").strip()
+        if not coord:
+            return None
+        if ":" not in coord:
+            raise ValueError(
+                f"OTEDAMA_COORDINATOR must be host:port, got {coord!r}"
+            )
+        n = int(env.get("OTEDAMA_NUM_PROCESSES", "0"))
+        if n <= 0:
+            raise ValueError(
+                "OTEDAMA_NUM_PROCESSES must be a positive integer when "
+                "OTEDAMA_COORDINATOR is set"
+            )
+        pid_s = env.get("OTEDAMA_PROCESS_ID", "").strip()
+        if pid_s:
+            pid = int(pid_s)
+        else:
+            pid = _rank_from_hostname(env.get("HOSTNAME", ""))
+            if pid is None:
+                raise ValueError(
+                    "set OTEDAMA_PROCESS_ID (no ordinal suffix in "
+                    f"HOSTNAME={env.get('HOSTNAME', '')!r})"
+                )
+        if not 0 <= pid < n:
+            raise ValueError(f"process_id {pid} out of range [0, {n})")
+        return cls(coordinator=coord, num_processes=n, process_id=pid)
+
+
+def _rank_from_hostname(hostname: str) -> int | None:
+    """StatefulSet convention: 'name-<ordinal>' -> ordinal."""
+    m = re.search(r"-(\d+)$", hostname)
+    return int(m.group(1)) if m else None
+
+
+def maybe_initialize(env: dict | None = None) -> DcnConfig | None:
+    """Join the multi-host jax runtime if configured; idempotent no-op
+    otherwise. Must run before any ``jax.devices()``/backend query."""
+    global _INITIALIZED
+    cfg = DcnConfig.from_env(env)
+    if cfg is None:
+        return None
+    if _INITIALIZED:
+        return cfg
+    import jax
+
+    log.info(
+        "joining multi-host runtime: coordinator=%s rank=%d/%d",
+        cfg.coordinator, cfg.process_id, cfg.num_processes,
+    )
+    jax.distributed.initialize(
+        coordinator_address=cfg.coordinator,
+        num_processes=cfg.num_processes,
+        process_id=cfg.process_id,
+    )
+    _INITIALIZED = True
+    return cfg

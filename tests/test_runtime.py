@@ -483,3 +483,40 @@ def test_scrypt_pod_search_rows_and_winners():
     # telemetry: row best == oracle min top limb; pod best == min of rows
     assert results[0].best_hash_hi == min(v >> 224 for v in vals0.values())
     assert pod.last_pod_best == min(r.best_hash_hi for r in results)
+
+
+def test_dcn_config_from_env():
+    """Multi-host bootstrap config: opt-in, validation, and the
+    StatefulSet hostname-ordinal rank default (runtime/dcn.py)."""
+    from otedama_tpu.runtime.dcn import DcnConfig
+
+    # not requested -> None (single-host users never pay the path)
+    assert DcnConfig.from_env({}) is None
+
+    cfg = DcnConfig.from_env({
+        "OTEDAMA_COORDINATOR": "miner-0.miners:8476",
+        "OTEDAMA_NUM_PROCESSES": "4",
+        "OTEDAMA_PROCESS_ID": "2",
+    })
+    assert (cfg.coordinator, cfg.num_processes, cfg.process_id) == (
+        "miner-0.miners:8476", 4, 2
+    )
+
+    # rank from the StatefulSet hostname ordinal
+    cfg = DcnConfig.from_env({
+        "OTEDAMA_COORDINATOR": "miner-0.miners:8476",
+        "OTEDAMA_NUM_PROCESSES": "4",
+        "HOSTNAME": "miner-3",
+    })
+    assert cfg.process_id == 3
+
+    for bad in (
+        {"OTEDAMA_COORDINATOR": "noport"},
+        {"OTEDAMA_COORDINATOR": "h:1"},  # missing world size
+        {"OTEDAMA_COORDINATOR": "h:1", "OTEDAMA_NUM_PROCESSES": "2",
+         "HOSTNAME": "nodigit"},
+        {"OTEDAMA_COORDINATOR": "h:1", "OTEDAMA_NUM_PROCESSES": "2",
+         "OTEDAMA_PROCESS_ID": "5"},  # rank out of range
+    ):
+        with pytest.raises(ValueError):
+            DcnConfig.from_env(bad)
