@@ -45,7 +45,7 @@ import re
 
 log = logging.getLogger("otedama.runtime.dcn")
 
-_INITIALIZED = False
+_INITIALIZED: "DcnConfig | None" = None  # the config actually joined with
 
 
 @dataclasses.dataclass(frozen=True)
@@ -99,8 +99,15 @@ def maybe_initialize(env: dict | None = None) -> DcnConfig | None:
     cfg = DcnConfig.from_env(env)
     if cfg is None:
         return None
-    if _INITIALIZED:
-        return cfg
+    if _INITIALIZED is not None:
+        # return the config the LIVE runtime was joined with — env may
+        # have mutated since, and sharding math must match reality
+        if cfg != _INITIALIZED:
+            raise RuntimeError(
+                f"distributed runtime already initialized with "
+                f"{_INITIALIZED}, but the environment now describes {cfg}"
+            )
+        return _INITIALIZED
     import jax
 
     log.info(
@@ -112,5 +119,5 @@ def maybe_initialize(env: dict | None = None) -> DcnConfig | None:
         num_processes=cfg.num_processes,
         process_id=cfg.process_id,
     )
-    _INITIALIZED = True
+    _INITIALIZED = cfg
     return cfg

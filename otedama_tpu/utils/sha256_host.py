@@ -1,4 +1,4 @@
-"""Host-side SHA-256 primitives (pure python).
+"""Host-side SHA-256 primitives.
 
 The device kernels (``otedama_tpu.kernels``) hash only the *second* 64-byte
 block of an 80-byte block header: the first block is constant per job, so its
@@ -8,7 +8,11 @@ in its CUDA kernel text (reference: internal/gpu/cuda_miner.go:194-265
 ``sha256_midstate_kernel`` and the host helper ``CalculateMidstate``
 cuda_miner.go:353-372), implemented here from the FIPS 180-4 spec.
 
-Everything here is per-job (not per-nonce), so pure python is fine.
+Everything here is per-job (not per-nonce). The pure-python compression is
+the reference implementation and always present; ``midstate()`` lazily
+upgrades itself to the native C extension when available because pods
+consume ``en2_fanout`` freshly-built jobs per search call (measured 51x:
+tools/microbench.py ``midstate``).
 """
 
 from __future__ import annotations
@@ -65,8 +69,46 @@ def sha256_compress(state: tuple[int, ...], block: bytes) -> tuple[int, ...]:
 
 
 def midstate(header64: bytes) -> tuple[int, ...]:
-    """Midstate of the first 64 bytes of an 80-byte block header."""
+    """Midstate of the first 64 bytes of an 80-byte block header.
+
+    Prefers the native C extension once it loads (~50x the pure-python
+    compression; this sits on the per-extranonce2 job-build path, which a
+    pod consumes at ``en2_fanout`` jobs per search call). Loading is LAZY
+    — first call, not module import — because importing otedama_tpu.native
+    may spawn a C++ build; a stratum-only process that never builds a job
+    must not pay (or hang on) that. The python compression stays as the
+    zero-dependency fallback and oracle."""
+    global _native_midstate
+    if _native_midstate is None:
+        _native_midstate = _load_native_midstate()
+    if _native_midstate is not False:
+        return _native_midstate(header64)
     return sha256_compress(SHA256_IV, header64)
+
+
+def _load_native_midstate():
+    """The native fn, or False (sentinel: don't retry). Rejections log —
+    a silently-absent fast path is undiagnosable from the outside."""
+    import logging
+
+    log = logging.getLogger("otedama.utils.sha256_host")
+    try:
+        from otedama_tpu.native import midstate as nm
+    except Exception as e:
+        log.info("native midstate unavailable (%s); using python path", e)
+        return False
+    # trust, but verify once against the pure-python compression
+    probe = bytes(range(64))
+    if tuple(nm(probe)) != sha256_compress(SHA256_IV, probe):
+        log.warning(
+            "native midstate FAILED the correctness probe (stale/ABI-"
+            "mismatched libotedama_native?); using python path"
+        )
+        return False
+    return nm
+
+
+_native_midstate = None  # lazy: resolved on first midstate() call
 
 
 def sha256d(data: bytes) -> bytes:

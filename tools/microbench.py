@@ -1,0 +1,214 @@
+"""Host-side microbenchmark suite.
+
+The reference ships a 12-benchmark CLI (reference: cmd/benchmark/main.go:
+44-61 — sha256 single/double/parallel, CPU mining, job queue, share
+validation, stratum codec, zero-copy, cache-aligned counter, ring buffer,
+mem pool, NUMA). This is the equivalent for the host side of this
+framework: every hot host-path that wraps the device kernels, measured in
+isolation. Device rates live in bench.py (the headline harness); these are
+the paths that must keep up with the device.
+
+Run: ``python tools/microbench.py [--seconds 0.5]``
+Prints one JSON line per benchmark: {"bench": ..., "rate": ..., "unit": ...}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import struct
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+
+def timed(fn, seconds: float, batch: int = 1) -> float:
+    """ops/sec of fn() (which performs ``batch`` ops per call)."""
+    fn()  # warmup
+    n = 0
+    t0 = time.perf_counter()
+    while (dt := time.perf_counter() - t0) < seconds:
+        fn()
+        n += batch
+    return n / dt
+
+
+def bench_sha256d_host(s: float) -> dict:
+    from otedama_tpu.utils.sha256_host import sha256d
+
+    hdr = bytes(range(80))
+    return {
+        "bench": "sha256d_host_oracle",
+        "rate": timed(lambda: sha256d(hdr), s),
+        "unit": "H/s",
+    }
+
+
+def bench_midstate(s: float) -> dict:
+    from otedama_tpu.utils.sha256_host import midstate
+
+    block = bytes(range(64))
+    return {
+        "bench": "midstate",
+        "rate": timed(lambda: midstate(block), s),
+        "unit": "ops/s",
+    }
+
+
+def bench_scrypt_host(s: float) -> dict:
+    from otedama_tpu.utils.pow_host import scrypt_1024_1_1
+
+    hdr = bytes(range(80))
+    return {
+        "bench": "scrypt_host_oracle",
+        "rate": timed(lambda: scrypt_1024_1_1(hdr), s),
+        "unit": "H/s",
+    }
+
+
+def bench_x11_numpy(s: float) -> dict:
+    import numpy as np
+
+    from otedama_tpu.kernels.x11 import x11_digest_batch
+
+    headers = np.frombuffer(bytes(range(256)) * 10, dtype=np.uint8)[
+        : 32 * 80
+    ].reshape(32, 80).copy()
+    return {
+        "bench": "x11_numpy_pipeline",
+        "rate": timed(lambda: x11_digest_batch(headers), s, batch=32),
+        "unit": "H/s",
+    }
+
+
+def bench_job_constants(s: float) -> dict:
+    """Coinbase assembly + merkle fold + midstate — the per-extranonce2
+    host cost that precedes every device launch."""
+    from otedama_tpu.engine.jobs import job_constants
+    from otedama_tpu.engine.types import Job
+
+    job = Job(
+        job_id="mb", prev_hash=bytes(32), coinb1=b"\x01" * 42,
+        coinb2=b"\x02" * 100, merkle_branch=[bytes(range(32))] * 12,
+        version=0x20000000, nbits=0x1D00FFFF, ntime=1700000000,
+        extranonce1=b"\x00\x01", extranonce2_size=4,
+        share_target=1 << 220, algorithm="sha256d",
+    )
+    counter = [0]
+
+    def one():
+        counter[0] += 1
+        job_constants(job, struct.pack(">I", counter[0]))
+
+    return {"bench": "job_constants", "rate": timed(one, s), "unit": "jobs/s"}
+
+
+def bench_stratum_codec(s: float) -> dict:
+    from otedama_tpu.stratum.protocol import Message, decode_line, encode_line
+
+    msg = Message(
+        id=7, method="mining.submit",
+        params=["worker.1", "job-42", "00000001", "6530d1b7", "17034219"],
+    )
+    line = encode_line(msg)
+
+    def one():
+        decode_line(encode_line(msg))
+
+    out = {"bench": "stratum_codec_roundtrip", "rate": timed(one, s),
+           "unit": "msgs/s"}
+    assert decode_line(line).method == "mining.submit"
+    return out
+
+
+def bench_target_check(s: float) -> dict:
+    from otedama_tpu.kernels.target import bits_to_target, hash_meets_target
+
+    target = bits_to_target(0x1D00FFFF)
+    digest = bytes(31) + b"\x01"
+
+    def one():
+        for _ in range(64):
+            hash_meets_target(digest, target)
+
+    return {"bench": "target_check", "rate": timed(one, s, batch=64),
+            "unit": "checks/s"}
+
+
+def bench_tiered_cache(s: float) -> dict:
+    from otedama_tpu.utils.cache import TieredCache
+
+    c = TieredCache(l1_size=256, l2_size=4096)
+    for i in range(512):
+        c.put(i, i)
+    k = [0]
+
+    def one():
+        for _ in range(64):
+            k[0] = (k[0] + 1) % 512
+            c.get(k[0])
+
+    return {"bench": "tiered_cache_get", "rate": timed(one, s, batch=64),
+            "unit": "ops/s"}
+
+
+def bench_db_share_insert(s: float) -> dict:
+    from otedama_tpu.db.database import Database
+    from otedama_tpu.db.repos import ShareRepository
+
+    db = Database(":memory:")
+    repo = ShareRepository(db)
+
+    def one():
+        repo.create("worker.1", "job-42", 16.0, 17.5)
+
+    return {"bench": "db_share_insert", "rate": timed(one, s),
+            "unit": "rows/s"}
+
+
+def bench_extranonce_roll(s: float) -> dict:
+    from otedama_tpu.runtime.partition import ExtranonceCounter
+
+    c = ExtranonceCounter(size=4)
+
+    def one():
+        for _ in range(256):
+            c.roll()
+
+    return {"bench": "extranonce_roll", "rate": timed(one, s, batch=256),
+            "unit": "rolls/s"}
+
+
+BENCHES = [
+    bench_sha256d_host,
+    bench_midstate,
+    bench_scrypt_host,
+    bench_x11_numpy,
+    bench_job_constants,
+    bench_stratum_codec,
+    bench_target_check,
+    bench_tiered_cache,
+    bench_db_share_insert,
+    bench_extranonce_roll,
+]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=0.5,
+                    help="measurement window per bench")
+    ap.add_argument("--only", default=None,
+                    help="substring filter on bench name")
+    args = ap.parse_args()
+    for fn in BENCHES:
+        if args.only and args.only not in fn.__name__:
+            continue
+        out = fn(args.seconds)
+        out["rate"] = round(out["rate"], 1)
+        print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
