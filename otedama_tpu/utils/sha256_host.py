@@ -82,7 +82,16 @@ def midstate(header64: bytes) -> tuple[int, ...]:
     if _native_midstate is None:
         _native_midstate = _load_native_midstate()
     if _native_midstate is not False:
-        return _native_midstate(header64)
+        try:
+            return _native_midstate(header64)
+        except Exception:  # a runtime fault must DEGRADE, never crash jobs
+            import logging
+
+            logging.getLogger("otedama.utils.sha256_host").warning(
+                "native midstate raised at call time; pinning python path",
+                exc_info=True,
+            )
+            _native_midstate = False
     return sha256_compress(SHA256_IV, header64)
 
 
@@ -94,16 +103,19 @@ def _load_native_midstate():
     log = logging.getLogger("otedama.utils.sha256_host")
     try:
         from otedama_tpu.native import midstate as nm
+
+        # trust, but verify once against the pure-python compression (the
+        # probe CALL is inside the try: a loaded-but-broken .so raising
+        # here must select the fallback, not crash every job build)
+        probe = bytes(range(64))
+        if tuple(nm(probe)) != sha256_compress(SHA256_IV, probe):
+            log.warning(
+                "native midstate FAILED the correctness probe (stale/ABI-"
+                "mismatched libotedama_native?); using python path"
+            )
+            return False
     except Exception as e:
         log.info("native midstate unavailable (%s); using python path", e)
-        return False
-    # trust, but verify once against the pure-python compression
-    probe = bytes(range(64))
-    if tuple(nm(probe)) != sha256_compress(SHA256_IV, probe):
-        log.warning(
-            "native midstate FAILED the correctness probe (stale/ABI-"
-            "mismatched libotedama_native?); using python path"
-        )
         return False
     return nm
 

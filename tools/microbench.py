@@ -181,17 +181,19 @@ def bench_extranonce_roll(s: float) -> dict:
             "unit": "rolls/s"}
 
 
+# (reported bench name, fn) — the name here is the one each fn reports in
+# its JSON line, so --only matches what users copy from the output
 BENCHES = [
-    bench_sha256d_host,
-    bench_midstate,
-    bench_scrypt_host,
-    bench_x11_numpy,
-    bench_job_constants,
-    bench_stratum_codec,
-    bench_target_check,
-    bench_tiered_cache,
-    bench_db_share_insert,
-    bench_extranonce_roll,
+    ("sha256d_host_oracle", bench_sha256d_host),
+    ("midstate", bench_midstate),
+    ("scrypt_host_oracle", bench_scrypt_host),
+    ("x11_numpy_pipeline", bench_x11_numpy),
+    ("job_constants", bench_job_constants),
+    ("stratum_codec_roundtrip", bench_stratum_codec),
+    ("target_check", bench_target_check),
+    ("tiered_cache_get", bench_tiered_cache),
+    ("db_share_insert", bench_db_share_insert),
+    ("extranonce_roll", bench_extranonce_roll),
 ]
 
 
@@ -202,12 +204,18 @@ def main() -> None:
     ap.add_argument("--only", default=None,
                     help="substring filter on bench name")
     args = ap.parse_args()
-    for fn in BENCHES:
-        if args.only and args.only not in fn.__name__:
+    matched = False
+    for name, fn in BENCHES:
+        if args.only and args.only not in name:
             continue
+        matched = True
         out = fn(args.seconds)
+        assert out["bench"] == name, (out["bench"], name)
         out["rate"] = round(out["rate"], 1)
         print(json.dumps(out), flush=True)
+    if args.only and not matched:
+        print(json.dumps({"error": f"no bench matches {args.only!r}"}))
+        sys.exit(2)
 
 
 if __name__ == "__main__":
