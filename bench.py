@@ -221,10 +221,11 @@ def bench_ethash() -> dict:
     HBM-resident, per-nonce dataset items derived on device via FNV folds
     over cache gathers (64 accesses x 2 pages x 256 parents = 32k random
     64-byte gathers per hash — deliberately HBM-bound, SURVEY §5's
-    DAG-algorithm shape). The epoch is an explicit scaled-down one (cache
-    generation is a sequential host-side keccak chain — a real epoch-0
-    16 MiB cache costs ~1M python keccaks; the measured inner loop's
-    gather/FNV work per hash is identical regardless of cache rows).
+    DAG-algorithm shape). The epoch is an explicit scaled-down one: the
+    native C generator (kernels/ethash.make_cache) makes real epochs
+    sub-second, but an explicit epoch keeps the bench deterministic even
+    without the native library, and the measured inner loop's gather/FNV
+    work per hash is identical regardless of cache rows.
     """
     import jax
 
@@ -232,8 +233,8 @@ def bench_ethash() -> dict:
 
     platform = jax.devices()[0].platform
     log(f"bench: ethash on platform={platform}")
-    # 8191 rows (prime, 512 KiB cache) keeps host-side cache build ~tens
-    # of seconds while staying far beyond any cache-resident toy size
+    # 8191 rows (prime, 512 KiB cache): cheap to build even on the python
+    # fallback path, far beyond any cache-resident toy size
     rows, pages = 8191, 4194301
     chunk = 1 << 12 if platform == "tpu" else 1 << 7
     log(f"bench: building explicit epoch cache ({rows} rows) ...")

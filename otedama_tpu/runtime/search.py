@@ -517,8 +517,8 @@ class EthashLightBackend:
     cache is built once at construction (HBM-resident on device).
 
     Defaults use a miniature epoch (tests/CI); pass ``block_number`` for
-    real epoch sizing — cache generation for a real epoch is a one-off
-    minutes-scale host computation, exactly like every ethash client.
+    real epoch sizing — the native C cache generator builds a real
+    epoch-0 cache in under a second (kernels/ethash.make_cache).
     """
 
     name = "ethash-light"

@@ -114,3 +114,21 @@ def test_ethash_backend_finds_planted_winner(tiny_cache):
     jc = JobConstants.from_header_prefix(h76, vals[winner])
     res = backend.search(jc, base, span)
     assert [w.nonce_word for w in res.winners] == [winner]
+
+
+def test_native_cache_generator_matches_python_oracle():
+    """The native C epoch-cache chain must be bit-identical to the python
+    spec oracle (kernels/ethash.make_cache prefers the native path; this
+    is the cross-check that keeps that substitution honest)."""
+    native = ethash._native_make_cache()
+    if native is None:
+        import pytest
+
+        pytest.skip("native library unavailable")
+    rows = 251
+    seed = ethash.seed_hash(0)
+    # the ONE python oracle definition, called directly (bypassing the
+    # native preference in make_cache)
+    cache = ethash._python_make_cache(rows, seed)
+    got = native(rows, seed)
+    assert (got == cache).all()

@@ -61,49 +61,22 @@ def expand(block: np.ndarray, final: bool, twist: str, mm: str) -> np.ndarray:
     return (W & MASK32).astype(np.uint32)
 
 
-def rotl(x: int, n: int) -> int:
-    n &= 31
-    return ((x << n) | (x >> (32 - n))) & MASK32 if n else x
-
-
-def f_if(a, b, c):
-    return ((b ^ c) & a) ^ c
-
-
-def f_maj(a, b, c):
-    return (c & b) | ((c | b) & a)
-
-
 def compress(state: list, block: np.ndarray, final: bool, twist: str,
              mm: str) -> list:
-    W = expand(block, final, twist, mm)
-    saved = [state[0:8], state[8:16], state[16:24], state[24:32]]
-    m32 = block.view("<u4").astype(np.int64)
-    st = [int(state[i]) ^ int(m32[i]) for i in range(32)]
-    A, Bv, C, D = st[0:8], st[8:16], st[16:24], st[24:32]
+    """One compression through the PACKAGE's step ladder (simd._compress
+    with the expansion swapped per variant) — a future fix to the round
+    core in kernels/x11/simd.py automatically applies to this search."""
+    st = [np.full(1, np.uint32(v), dtype=np.uint32) for v in state]
 
-    def step(A, Bv, C, D, w, fn, r, s, p):
-        tA = [rotl(A[j], r) for j in range(8)]
-        newA = [
-            (rotl((D[j] + w[j] + fn(A[j], Bv[j], C[j])) & MASK32, s)
-             + tA[j ^ p]) & MASK32
-            for j in range(8)
-        ]
-        return newA, tA, Bv, C
+    def expand_fn(block_rows, fin):
+        W = expand(np.asarray(block_rows)[0], fin, twist, mm)
+        return W[None, :]
 
-    for t in range(32):
-        rnd, k = divmod(t, 8)
-        c = simd_mod.ROUND_ROTS[rnd]
-        r, s = c[k % 4], c[(k + 1) % 4]
-        fn = f_if if k < 4 else f_maj
-        base = simd_mod.WSP[t] * 8
-        w = [int(W[(base + j) % 256]) for j in range(8)]
-        A, Bv, C, D = step(A, Bv, C, D, w, fn, r, s, simd_mod.PMASK[t])
-    for fs in range(4):
-        r, s = simd_mod.FF_ROTS[fs]
-        w = [int(v) for v in saved[fs]]
-        A, Bv, C, D = step(A, Bv, C, D, w, f_if, r, s, simd_mod.PMASK[32 + fs])
-    return A + Bv + C + D
+    out = simd_mod._compress(
+        st, np.asarray(block, dtype=np.uint8)[None, :], final,
+        expand_fn=expand_fn,
+    )
+    return [int(w[0]) for w in out]
 
 
 def derive_iv(seed: bytes, mode: str, twist: str, mm: str) -> list:
