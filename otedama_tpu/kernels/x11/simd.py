@@ -123,10 +123,12 @@ def _compress(state: list, block_bytes: np.ndarray, final: bool,
     """state: 32 lane-arrays [A0..7, B0..7, C0..7, D0..7].
 
     ``expand_fn(block_bytes, final) -> [B, 256] uint32`` overrides the
-    message expansion — the certification harnesses (tools/simd_search,
-    tools/simd_iv_search) sweep expansion variants through the ONE copy of
-    the step ladder here, so a future fix to the round core automatically
-    applies to every search."""
+    message expansion. tools/simd_iv_search sweeps expansion variants
+    through THIS step ladder (a round-core fix applies to it
+    automatically); tools/simd_search deliberately keeps a private ladder
+    because its per-step W-window variants change the ladder's own W
+    indexing, which this hook cannot express — re-sync that copy when
+    touching the ladder."""
     W = (expand_fn or _expand)(block_bytes, final)
     A = state[0:8]
     Bv = state[8:16]

@@ -119,7 +119,8 @@ def ethash_make_cache(rows: int, seed: bytes) -> "np.ndarray":
     """Epoch cache [rows, 16] u32 — the sequential ~4N-keccak chain at C
     speed (measured: epoch-0's 262139 rows in ~0.5 s vs ~an hour of numpy
     keccaks)."""
-    assert len(seed) == 32
+    if len(seed) != 32:  # a short buffer would be an out-of-bounds C read
+        raise ValueError(f"seed must be 32 bytes, got {len(seed)}")
     out = np.empty((rows, 16), dtype=np.uint32)
     _lib.otedama_ethash_make_cache(
         rows, _u8(seed), out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))

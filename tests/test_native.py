@@ -79,3 +79,31 @@ def test_native_registered_in_algos():
     from otedama_tpu.engine import algos
 
     assert algos.supports("sha256d", "native-cpu")
+
+
+def test_native_keccak_matches_certified_python():
+    """Both native keccak rates vs the KAT-certified python keccak —
+    including the rate-136 keccak256 path that nothing else exercises —
+    plus the canonical empty-string keccak-256 vector."""
+    import numpy as np
+
+    from otedama_tpu import native
+    from otedama_tpu.kernels.x11 import keccak as pyk
+
+    assert native.keccak256(b"").hex() == (
+        "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"
+    )
+    rng = np.random.default_rng(23)
+    for n in (0, 1, 71, 72, 73, 135, 136, 137, 300):
+        data = rng.bytes(n)
+        assert native.keccak512(data) == pyk.keccak512_bytes(data), n
+        assert native.keccak256(data) == pyk.keccak256_bytes(data), n
+
+
+def test_native_cache_seed_validation():
+    import pytest
+
+    from otedama_tpu import native
+
+    with pytest.raises(ValueError):
+        native.ethash_make_cache(4, b"short")
