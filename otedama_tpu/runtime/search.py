@@ -527,13 +527,18 @@ class EthashLightBackend:
     def __init__(self, cache_rows: int | None = None,
                  full_pages: int | None = None,
                  block_number: int | None = None, device: bool = True,
-                 chunk: int = 256):
+                 chunk: int = 256, full_dataset: bool = False):
         from otedama_tpu.kernels import ethash as eth
 
         self._eth = eth
         self.device = device
         self.chunk = chunk
         self.max_batch = 4 * chunk  # see ScryptXlaBackend.max_batch
+        if full_dataset and not device:
+            # silently measuring the light tier under the full tier's name
+            # would be exactly the mislabeling this ctor refuses elsewhere
+            raise ValueError("full_dataset=True requires device=True")
+        self.full_dataset = full_dataset
         if block_number is not None:
             cache_bytes = eth.cache_size(block_number)
             self.full_size = eth.dataset_size(block_number)
@@ -555,10 +560,22 @@ class EthashLightBackend:
         # don't re-upload the epoch cache
         self.cache = eth.make_cache(cache_bytes, seed)
         self._cache_dev = None
+        self._dataset_dev = None
         if device:
             import jax.numpy as jnp
 
             self._cache_dev = jnp.asarray(self.cache)
+        if self.full_dataset:
+            # one-off per-epoch: the whole DAG generated on device and
+            # kept HBM-resident; per-hash work then drops to 64x2 direct
+            # row gathers (no in-loop cache folds or keccaks). Hand the
+            # builder the already-uploaded cache and drop our copy after —
+            # full-mode search never touches the cache again
+            self._dataset_dev = eth.build_dataset_device(
+                self._cache_dev, self.full_size
+            )
+            self._cache_dev = None
+            self.name = "ethash-full"
 
     def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
         eth = self._eth
@@ -571,7 +588,11 @@ class EthashLightBackend:
             nonces = (
                 base + done + np.arange(n, dtype=np.uint64)
             ) & 0xFFFFFFFF
-            if self.device:
+            if self._dataset_dev is not None:
+                _, results = eth.hashimoto_full_device(
+                    self.full_size, self._dataset_dev, header_hash, nonces
+                )
+            elif self.device:
                 _, results = eth.hashimoto_light_device(
                     self.full_size, self._cache_dev, header_hash, nonces
                 )
@@ -650,6 +671,9 @@ def make_backend(kind: str, algorithm: str = "sha256d", **kwargs):
         if kind in ("jax", "xla"):
             return X11JaxBackend(**kwargs)
     elif algorithm == "ethash":
+        if kind == "full":
+            return EthashLightBackend(device=True, full_dataset=True,
+                                      **kwargs)
         if kind in ("jax", "xla"):
             return EthashLightBackend(device=True, **kwargs)
         if kind == "numpy":

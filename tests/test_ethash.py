@@ -132,3 +132,58 @@ def test_native_cache_generator_matches_python_oracle():
     cache = ethash._python_make_cache(rows, seed)
     got = native(rows, seed)
     assert (got == cache).all()
+
+
+def test_full_dataset_mode_matches_light(tiny_cache):
+    """Full-DAG mode end-to-end at a tiny epoch: the device-built dataset
+    must make hashimoto_full (host + device) byte-identical to
+    hashimoto_light — the light path derives exactly the rows the full
+    path looks up."""
+    import numpy as np
+
+    full_size = 509 * ethash.MIX_BYTES
+    n_items = full_size // ethash.HASH_BYTES
+    ds = np.asarray(ethash.build_dataset_device(tiny_cache, full_size))
+    assert ds.shape == (n_items, 16)
+    # device-built rows == the python per-item derivation
+    for i in (0, 1, 7, n_items - 1):
+        want = ethash.calc_dataset_item(tiny_cache, i)
+        assert np.array_equal(ds[i], want), i
+
+    h = bytes(range(32))
+    for nonce in (0, 12345):
+        mix_l, res_l = ethash.hashimoto_light(full_size, tiny_cache, h, nonce)
+        mix_f, res_f = ethash.hashimoto_full(full_size, ds, h, nonce)
+        assert (mix_f, res_f) == (mix_l, res_l)
+    import jax.numpy as jnp
+
+    mix_d, res_d = ethash.hashimoto_full_device(
+        full_size, jnp.asarray(ds), h, np.array([0, 12345], dtype=np.uint64)
+    )
+    assert bytes(res_d[0]) == ethash.hashimoto_light(full_size, tiny_cache, h, 0)[1]
+    assert bytes(res_d[1]) == ethash.hashimoto_light(full_size, tiny_cache, h, 12345)[1]
+
+
+def test_full_backend_finds_same_winners_as_light():
+    from otedama_tpu.runtime.search import EthashLightBackend, JobConstants
+
+    h76 = bytes(range(64)) + __import__("struct").pack(
+        ">3I", 0x2222, 0x6530D1B7, 5
+    )
+    kw = dict(cache_rows=TINY_ROWS, full_pages=509, chunk=64)
+    light = EthashLightBackend(device=True, **kw)
+    full = EthashLightBackend(device=True, full_dataset=True, **kw)
+    assert full.name == "ethash-full"
+    # pick the target from the light tier's best over the window, then
+    # both tiers must agree exactly on winners
+    probe = light.search(
+        JobConstants.from_header_prefix(h76, (1 << 256) - 1), 0, 64
+    )
+    target = min(int.from_bytes(w.digest, "little") for w in probe.winners)
+    jc = JobConstants.from_header_prefix(h76, target)
+    rl = light.search(jc, 0, 64)
+    rf = full.search(jc, 0, 64)
+    assert [w.nonce_word for w in rl.winners] == [
+        w.nonce_word for w in rf.winners
+    ]
+    assert rl.winners and rl.winners[0].digest == rf.winners[0].digest
