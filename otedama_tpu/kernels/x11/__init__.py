@@ -182,3 +182,4 @@ if not missing_stages():
     # device tier), "jax" is the explicit alias make_backend also accepts
     _algos.mark_implemented("x11", "xla")
     _algos.mark_implemented("x11", "jax")
+    _algos.mark_implemented("x11", "pod")  # runtime.mesh.X11PodBackend

@@ -501,3 +501,178 @@ class ScryptPodBackend:
                 "per call; use search_multi()"
             )
         return self.pod.search_jobs([jc], base, count)[0]
+
+
+@dataclasses.dataclass
+class X11PodSearch:
+    """SPMD x11 search across a (host, chip) mesh.
+
+    Third instantiation of the pod shape (PodSearch: sha256d,
+    ScryptPodSearch: scrypt): host rows are extranonce2 spaces, the chip
+    axis strides each row's nonce range, pmin telemetry rides ICI. The
+    per-chip local is the full 11-stage device chain
+    (kernels/x11/jnp_chain — one XLA program), with the 80-byte headers
+    assembled ON DEVICE (fixed 76-byte prefix broadcast + big-endian
+    nonce bytes), since host-side header building cannot reach inside a
+    shard_map. The device applies the no-false-negative top-limb
+    prefilter; flagged lanes are exact-verified on the host through the
+    independent numpy oracle chain (cross-implementation check, same as
+    X11JaxBackend).
+
+    NB compile cost: the chain costs minutes per (mesh, per_chip) shape —
+    production picks one chunk and keeps it (the persistent compilation
+    cache makes later processes cheap).
+    """
+
+    mesh: Mesh
+    chain_fn: callable = None  # tests inject a cheap stand-in
+    chunk: int = 1 << 12       # per-chip lanes per step — ONE compiled shape
+
+    def __post_init__(self):
+        self._axes, self.n_hosts, self.n_chips = parse_mesh_axes(
+            self.mesh, "X11PodSearch"
+        )
+        if self.chain_fn is None:
+            from otedama_tpu.kernels.x11 import jnp_chain
+
+            self.chain_fn = jnp_chain.x11_digest_chain
+        self._steps: dict[int, callable] = {}
+
+    def _build_step(self, per_chip: int):
+        axes = self._axes
+        chip_axis = axes[-1]
+        host_spec = P(axes[0]) if len(axes) == 2 else P()
+        chain = self.chain_fn
+
+        @functools.partial(
+            shard_map,
+            mesh=self.mesh,
+            in_specs=(host_spec, P(), P()),
+            out_specs=(P(*axes), P(*axes), P()),
+            check_vma=False,
+        )
+        def _step(h76_rows, t0_limb, base):
+            h76 = h76_rows[0]  # this row's 76 header bytes, uint8
+            chip = jax.lax.axis_index(chip_axis).astype(jnp.uint32)
+            my_base = base + chip * jnp.uint32(per_chip)
+            nonces = my_base + jax.lax.iota(jnp.uint32, per_chip)
+            nb = jnp.stack(
+                [(nonces >> s).astype(jnp.uint8) for s in (24, 16, 8, 0)],
+                axis=-1,
+            )  # big-endian wire bytes 76:80
+            headers = jnp.concatenate(
+                [jnp.broadcast_to(h76[None, :], (per_chip, 76)), nb], axis=1
+            )
+            d = chain(headers)  # [per_chip, 32] uint8 digests
+            h0 = (
+                d[:, 28].astype(jnp.uint32)
+                | (d[:, 29].astype(jnp.uint32) << 8)
+                | (d[:, 30].astype(jnp.uint32) << 16)
+                | (d[:, 31].astype(jnp.uint32) << 24)
+            )
+            hits = h0 <= t0_limb  # prefilter: no false negatives
+            local_best = _flip(h0).min()
+            pod_best = _unflip(jax.lax.pmin(local_best, axes))
+            shape = (1, 1, per_chip) if len(axes) == 2 else (1, per_chip)
+            return hits.reshape(shape), h0.reshape(shape), pod_best
+
+        return jax.jit(_step)
+
+    def _step_for(self, per_chip: int):
+        step = self._steps.get(per_chip)
+        if step is None:
+            step = self._steps[per_chip] = self._build_step(per_chip)
+        return step
+
+    def search_jobs(
+        self, jcs: list[JobConstants], base: int, count: int
+    ) -> list[SearchResult]:
+        from otedama_tpu.kernels import x11 as x11_mod
+
+        if len(jcs) != self.n_hosts:
+            raise ValueError(
+                f"need {self.n_hosts} jobs (one per host row), got {len(jcs)}"
+            )
+        if any(jc.target != jcs[0].target for jc in jcs):
+            raise ValueError("all pod rows must share one share target")
+        t0_limb = int(jcs[0].limbs[0])
+        # FIXED compiled shape: per_chip is always self.chunk (the chain
+        # costs minutes per shape — X11JaxBackend's fixed_shape lesson);
+        # the last window overscans and extraction filters idx >= count
+        per_chip = self.chunk
+        window = per_chip * self.n_chips
+
+        h76 = jnp.asarray(np.stack([
+            np.frombuffer(jc.header76, dtype=np.uint8) for jc in jcs
+        ]))
+        winners_per_row: list[list[Winner]] = [[] for _ in jcs]
+        best_per_row = [0xFFFFFFFF] * len(jcs)
+        pod_best = 0xFFFFFFFF
+        done = 0
+        while done < count:
+            wbase = (base + done) & 0xFFFFFFFF
+            valid = min(window, count - done)
+            with jax.enable_x64():
+                out = self._step_for(per_chip)(
+                    h76, jnp.uint32(t0_limb), jnp.uint32(wbase)
+                )
+                hits, h0, wpod_best = (np.asarray(o) for o in out)
+            if hits.ndim == 2:
+                hits, h0 = hits[None], h0[None]
+            pod_best = min(pod_best, int(wpod_best))
+            for r, jc in enumerate(jcs):
+                row = hits[r].reshape(-1)
+                best_per_row[r] = min(
+                    best_per_row[r], int(h0[r].reshape(-1).min())
+                )
+                for idx in np.nonzero(row)[0].tolist():
+                    if idx >= valid:
+                        continue  # overscan lane beyond the request
+                    nonce = (wbase + idx) & 0xFFFFFFFF
+                    # exact verify via the INDEPENDENT numpy oracle chain
+                    digest = x11_mod.x11_digest(jc.header_for(nonce))
+                    if tgt.hash_meets_target(digest, jc.target):
+                        winners_per_row[r].append(Winner(nonce, digest))
+            done += valid
+        self.last_pod_best = pod_best
+        return [
+            SearchResult(winners_per_row[r], count, best_per_row[r])
+            for r in range(len(jcs))
+        ]
+
+    def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
+        if self.n_hosts != 1:
+            raise ValueError("search() is for 1-row meshes; use search_jobs()")
+        return self.search_jobs([jc], base, count)[0]
+
+
+class X11PodBackend:
+    """Engine-facing x11 pod device (see ``PodBackend``)."""
+
+    algorithm = "x11"
+
+    def __init__(self, mesh: Mesh | None = None, n_hosts: int | None = None,
+                 **pod_kwargs):
+        if mesh is None:
+            devices = jax.devices()
+            if n_hosts is None:
+                n_hosts = 2 if len(devices) % 2 == 0 and len(devices) > 1 else 1
+            mesh = make_pod_mesh(devices, n_hosts)
+        self.pod = X11PodSearch(mesh, **pod_kwargs)
+        self.en2_fanout = self.pod.n_hosts
+        self.name = f"x11-pod{self.pod.n_hosts}x{self.pod.n_chips}"
+        # slow-algorithm cap (see engine._search_loop)
+        self.max_batch = (1 << 12) * self.pod.n_chips
+
+    def search_multi(
+        self, jcs: list[JobConstants], base: int, count: int
+    ) -> list[SearchResult]:
+        return self.pod.search_jobs(jcs, base, count)
+
+    def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
+        if self.en2_fanout != 1:
+            raise ValueError(
+                f"{self.name} searches {self.en2_fanout} extranonce spaces "
+                "per call; use search_multi()"
+            )
+        return self.pod.search_jobs([jc], base, count)[0]

@@ -666,6 +666,10 @@ def make_backend(kind: str, algorithm: str = "sha256d", **kwargs):
         if kind == "python":
             return ScryptPythonBackend(**kwargs)
     elif algorithm == "x11":
+        if kind == "pod":
+            from otedama_tpu.runtime.mesh import X11PodBackend
+
+            return X11PodBackend(**kwargs)
         if kind == "numpy":
             return X11NumpyBackend(**kwargs)
         if kind in ("jax", "xla"):

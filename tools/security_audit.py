@@ -41,10 +41,9 @@ CHECKS: list[tuple[str, re.Pattern, str]] = [
      "dynamic code execution on a non-literal"),
     ("pickle-load", re.compile(r"\b(?:pickle|marshal)\.loads?\("),
      "deserializing attacker-controllable bytes"),
-    ("shell-true", re.compile(r"subprocess\.\w+\([^)]*shell\s*=\s*True"),
-     "shell injection surface"),
-    ("yaml-unsafe", re.compile(r"yaml\.load\((?![^)]*SafeLoader)"),
-     "yaml.load without SafeLoader executes arbitrary tags"),
+    # NB shell-true / yaml-unsafe are TWO-PHASE checks (see _WINDOWED):
+    # a regex [^)]* stops at the first nested ')' and would let
+    # subprocess.run(shlex.split(cmd), shell=True) hide the keyword
     ("weak-hash", re.compile(r"hashlib\.(?:md5|sha1)\("),
      "weak digest in a security-sensitive codebase"),
     ("bind-all", re.compile(r"[\"']0\.0\.0\.0[\"']"),
@@ -57,6 +56,20 @@ CHECKS: list[tuple[str, re.Pattern, str]] = [
     ("secret-literal", re.compile(
         r"(?i)\b(?:password|secret|api_key|token)\s*=\s*[\"'][A-Za-z0-9+/]{16,}[\"']"),
      "hardcoded credential-shaped literal"),
+]
+
+# Two-phase windowed checks: (name, call-site regex, must/must-not regex
+# within the CALL'S OWN argument span, why). The span is found by paren
+# balancing from the call's open paren (bounded at 800 chars), so nested
+# calls can't hide a keyword and the window can't leak into the next
+# statement's text.
+_WINDOWED: list[tuple[str, re.Pattern, re.Pattern, bool, str]] = [
+    ("shell-true", re.compile(r"subprocess\.\w+\("),
+     re.compile(r"shell\s*=\s*True"), True,
+     "shell injection surface"),
+    ("yaml-unsafe", re.compile(r"yaml\.load\("),
+     re.compile(r"SafeLoader"), False,
+     "yaml.load without SafeLoader executes arbitrary tags"),
 ]
 
 # (check, path-suffix, snippet substring) — pinned so one accepted finding
@@ -72,6 +85,45 @@ ALLOWLIST: set[tuple[str, str, str]] = {
     ("bind-all", "otedama_tpu/stratum/proxy.py",
      'listen_host: str = "0.0.0.0"'),
 }
+
+
+def _call_span(text: str, open_end: int, limit: int = 800) -> str:
+    """The argument text of the call whose open paren ends at ``open_end``
+    (paren-balanced, bounded at ``limit`` chars)."""
+    depth = 1
+    i = open_end
+    stop = min(len(text), open_end + limit)
+    while i < stop:
+        c = text[i]
+        if c == "(":
+            depth += 1
+        elif c == ")":
+            depth -= 1
+            if depth == 0:
+                return text[open_end:i]
+        i += 1
+    return text[open_end:stop]
+
+
+def _comment_col(line: str) -> int:
+    """Column of the real comment start, or -1 — tracks quote state so a
+    '#' inside a string literal is not mistaken for a comment."""
+    quote = None
+    i = 0
+    while i < len(line):
+        c = line[i]
+        if quote:
+            if c == "\\":
+                i += 2
+                continue
+            if c == quote:
+                quote = None
+        elif c in "\"'":
+            quote = c
+        elif c == "#":
+            return i
+        i += 1
+    return -1
 
 
 def _allowed(check: str, rel: str, snippet: str) -> bool:
@@ -100,12 +152,32 @@ def scan() -> list[dict]:
             })
             continue
         lines = text.splitlines()
+        for name, call_rx, win_rx, must_match, why in _WINDOWED:
+            for m in call_rx.finditer(text):
+                lineno = text.count("\n", 0, m.start()) + 1
+                line = lines[lineno - 1] if lineno <= len(lines) else ""
+                col = m.start() - (text.rfind("\n", 0, m.start()) + 1)
+                cc = _comment_col(line)
+                if 0 <= cc <= col:
+                    continue
+                window = _call_span(text, m.end())
+                hit = bool(win_rx.search(window))
+                if hit != must_match:
+                    continue
+                snippet = line.strip()[:120]
+                if _allowed(name, rel, snippet):
+                    continue
+                findings.append({
+                    "check": name, "file": rel, "line": lineno,
+                    "why": why, "snippet": snippet,
+                })
         for name, rx, why in CHECKS:
             for m in rx.finditer(text):
                 lineno = text.count("\n", 0, m.start()) + 1
                 line = lines[lineno - 1] if lineno <= len(lines) else ""
                 col = m.start() - (text.rfind("\n", 0, m.start()) + 1)
-                if "#" in line[:col]:
+                cc = _comment_col(line)
+                if 0 <= cc <= col:
                     continue  # match sits inside a trailing comment
                 snippet = line.strip()[:120]
                 if _allowed(name, rel, snippet):
