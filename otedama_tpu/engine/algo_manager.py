@@ -51,13 +51,13 @@ class AlgorithmManager:
             raise ValueError(f"algorithm {algorithm!r} has no implemented backend")
         kind = kind or self.preferred_backend
         if kind == "auto":
-            try:
-                import jax
+            # hang-safe: a dead/wedged TPU tunnel makes jax.devices()
+            # block forever — the app must degrade to cpu, not hang at
+            # startup (utils/platform_probe)
+            from otedama_tpu.utils.platform_probe import safe_backend_info
 
-                on_tpu = jax.default_backend() == "tpu"
-                n_dev = len(jax.devices())
-            except Exception:  # pragma: no cover
-                on_tpu, n_dev = False, 1
+            platform, n_dev = safe_backend_info()
+            on_tpu = platform == "tpu"
             if on_tpu:
                 # multi-chip hosts drive every chip through the pod backend;
                 # a single chip goes straight to the Pallas kernel

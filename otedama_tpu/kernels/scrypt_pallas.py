@@ -53,10 +53,9 @@ LANE_TILE = 8192  # lanes per grid step: 3 x (32*8192*4) = 3 MiB VMEM blocks
 
 
 def _on_tpu() -> bool:
-    try:
-        return jax.devices()[0].platform == "tpu"
-    except Exception:  # pragma: no cover - no backend at all
-        return False
+    from otedama_tpu.utils.platform_probe import safe_default_backend
+
+    return safe_default_backend() == "tpu"  # hang-safe platform query
 
 
 def _salsa8_rolled(x16: list) -> list:

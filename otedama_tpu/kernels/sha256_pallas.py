@@ -354,10 +354,9 @@ def _search_call(job_words, *, num_tiles: int, sub: int, inner: int,
 
 
 def _on_tpu() -> bool:
-    try:
-        return jax.devices()[0].platform == "tpu"
-    except Exception:  # pragma: no cover - no backend at all
-        return False
+    from otedama_tpu.utils.platform_probe import safe_default_backend
+
+    return safe_default_backend() == "tpu"  # hang-safe platform query
 
 
 def sha256d_pallas_search(

@@ -164,10 +164,14 @@ class PodSearch:
         self._axes, self.n_hosts, self.n_chips = parse_mesh_axes(
             self.mesh, "PodSearch"
         )
-        if self.use_pallas is None:
-            self.use_pallas = jax.default_backend() == "tpu"
-        if self.rolled is None:
-            self.rolled = jax.default_backend() != "tpu"
+        if self.use_pallas is None or self.rolled is None:
+            from otedama_tpu.utils.platform_probe import safe_default_backend
+
+            on_tpu = safe_default_backend() == "tpu"  # hang-safe
+            if self.use_pallas is None:
+                self.use_pallas = on_tpu
+            if self.rolled is None:
+                self.rolled = not on_tpu
         self.tile = self.sub * 128 if self.use_pallas else self.jnp_tile
         self._steps: dict[int, callable] = {}
         self._rescan = XlaBackend(chunk=min(max(self.tile, 1 << 10), 1 << 14))
@@ -364,7 +368,9 @@ class ScryptPodSearch:
         self._axes, self.n_hosts, self.n_chips = parse_mesh_axes(
             self.mesh, "ScryptPodSearch"
         )
-        on_tpu = jax.default_backend() == "tpu"
+        from otedama_tpu.utils.platform_probe import safe_default_backend
+
+        on_tpu = safe_default_backend() == "tpu"  # hang-safe
         if self.blockmix is None:
             self.blockmix = "pallas" if on_tpu else "xla"
         if self.rolled is None:

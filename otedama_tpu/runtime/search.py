@@ -106,11 +106,12 @@ def _xla_search_step(midstate8, tail3, base, limbs8, *, n: int, rolled: bool):
 
 def _default_rolled() -> bool:
     """Unrolled rounds on TPU (throughput), rolled elsewhere (compile time —
-    the single-core CI box pays ~minutes per unrolled XLA-CPU compile)."""
-    try:
-        return jax.default_backend() != "tpu"
-    except Exception:  # pragma: no cover
-        return True
+    the single-core CI box pays ~minutes per unrolled XLA-CPU compile).
+    Hang-safe: a dead TPU tunnel blocks jax.default_backend() forever
+    (utils/platform_probe)."""
+    from otedama_tpu.utils.platform_probe import safe_default_backend
+
+    return safe_default_backend() != "tpu"
 
 
 def _chunked_search(

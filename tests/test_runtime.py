@@ -611,3 +611,48 @@ def test_x11_pod_real_chain_tiny():
     )
     for w in r0.winners:
         assert w.digest == x11_mod.x11_digest(jc0.header_for(w.nonce_word))
+
+
+def test_platform_probe_hang_safe(monkeypatch):
+    """safe_backend_info: env pin wins; initialized-jax short path works;
+    a hanging probe degrades to cpu instead of blocking startup."""
+    from otedama_tpu.utils import platform_probe as pp
+
+    monkeypatch.setattr(pp, "_CACHED", None)
+    monkeypatch.setenv("OTEDAMA_PLATFORM", "tpu")
+    assert pp.safe_backend_info() == ("tpu", 1)
+
+    # live-jax short path: force backend init first (without it the
+    # probe would go to a subprocess, where the axon sitecustomize
+    # re-pin applies — exactly the hang class this module guards)
+    import jax.numpy as jnp
+
+    import jax
+
+    jnp.zeros(()).block_until_ready()
+    monkeypatch.setattr(pp, "_CACHED", None)
+    monkeypatch.delenv("OTEDAMA_PLATFORM", raising=False)
+    platform, n = pp.safe_backend_info()
+    # compare against the LIVE backend, not literals (holds on any host)
+    assert (platform, n) == (jax.default_backend(), len(jax.devices()))
+
+    # multi-chip pin syntax carries a device count
+    monkeypatch.setattr(pp, "_CACHED", None)
+    monkeypatch.setenv("OTEDAMA_PLATFORM", "tpu:4")
+    assert pp.safe_backend_info() == ("tpu", 4)
+    monkeypatch.delenv("OTEDAMA_PLATFORM", raising=False)
+
+    # hung probe -> cpu fallback (simulate via a subprocess that times out)
+    import subprocess
+
+    monkeypatch.setattr(pp, "_CACHED", None)
+
+    def fake_run(*a, **k):
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=1)
+
+    monkeypatch.setattr(pp.subprocess, "run", fake_run)
+    # force the slow path by pretending jax is uninitialized
+    import jax._src.xla_bridge as xb
+
+    monkeypatch.setattr(xb, "backends_are_initialized", lambda: False)
+    assert pp.safe_backend_info(timeout=1) == ("cpu", 1)
