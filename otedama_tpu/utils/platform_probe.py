@@ -43,17 +43,25 @@ def safe_backend_info(timeout: float = 90.0) -> tuple[str, int]:
     global _CACHED, _FAILED_AT
     import time
 
+    retry = False
     if _CACHED is not None:
         if _FAILED_AT is None or time.monotonic() - _FAILED_AT < _FAIL_TTL:
             return _CACHED
         _CACHED = None  # failed verdict expired: re-probe
-        _FAILED_AT = None
+        retry = True    # ...but with a SHORT timeout: re-probes can sit on
+        # hot paths (_on_tpu per search call) and must not stall them for
+        # the full first-probe budget every TTL period
     pinned = os.environ.get("OTEDAMA_PLATFORM", "").strip().lower()
     if pinned:
         # "tpu" or "tpu:4" (count channel for multi-chip pins, so a pinned
         # pod host still auto-selects the pod backend)
         plat, _, cnt = pinned.partition(":")
-        _CACHED = (plat, int(cnt) if cnt else 1)
+        try:
+            n = int(cnt) if cnt else 1
+        except ValueError:  # an operator typo must degrade, not crash
+            log.warning("bad OTEDAMA_PLATFORM count %r; assuming 1", cnt)
+            n = 1
+        _CACHED, _FAILED_AT = (plat, n), None
         return _CACHED
     # already-initialized jax answers instantly and truthfully
     try:
@@ -62,16 +70,24 @@ def safe_backend_info(timeout: float = 90.0) -> tuple[str, int]:
 
         if xla_bridge.backends_are_initialized():
             _CACHED = (jax.default_backend(), len(jax.devices()))
+            _FAILED_AT = None
             return _CACHED
     except Exception:  # pragma: no cover - very old jax
         pass
     try:
-        out = subprocess.run(
+        raw = subprocess.run(
             [sys.executable, "-c",
              "import jax; print(jax.default_backend(), len(jax.devices()))"],
-            timeout=timeout, capture_output=True, text=True, check=True,
-        ).stdout.split()
-        _CACHED = (out[0], int(out[1])) if len(out) == 2 else ("cpu", 1)
+            timeout=min(timeout, 10.0) if retry else timeout,
+            capture_output=True, text=True, check=True,
+        ).stdout
+        # parse the LAST line (plugins print banners on stdout in some
+        # environments); anything unparseable is a FAILURE, not a silent
+        # permanent cpu verdict
+        out = raw.strip().splitlines()[-1].split() if raw.strip() else []
+        if len(out) != 2:
+            raise ValueError(f"unparseable probe output {raw!r}")
+        _CACHED, _FAILED_AT = (out[0], int(out[1])), None
     except Exception as e:  # degrade, never die: this guards startup paths
         log.warning(
             "device platform probe failed/hung (%s) — assuming cpu so the "

@@ -1,0 +1,20 @@
+#!/bin/bash
+# Test runner with tiers — parity with the reference's run_tests.sh /
+# cmd/test-runner. Tiers:
+#   fast (default)  everything but slow-marked tests (~4 min, CPU mesh)
+#   slow            only the slow tier (interpret-mode kernels, real-chain
+#                   x11 pod; expect many minutes of XLA compile)
+#   all             both
+#   audit           static security self-audit only
+# Extra args pass through to pytest (e.g. ./run_tests.sh fast -k scrypt).
+set -euo pipefail
+cd "$(dirname "$0")"
+tier="${1:-fast}"
+shift || true
+case "$tier" in
+  fast)  exec python -m pytest tests/ -q "$@" ;;
+  slow)  exec python -m pytest tests/ -q -m slow "$@" ;;
+  all)   exec python -m pytest tests/ -q -m '' "$@" ;;
+  audit) exec python tools/security_audit.py ;;
+  *) echo "usage: $0 [fast|slow|all|audit] [pytest args...]" >&2; exit 2 ;;
+esac

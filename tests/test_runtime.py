@@ -646,6 +646,7 @@ def test_platform_probe_hang_safe(monkeypatch):
     import subprocess
 
     monkeypatch.setattr(pp, "_CACHED", None)
+    monkeypatch.setattr(pp, "_FAILED_AT", None)
 
     def fake_run(*a, **k):
         raise subprocess.TimeoutExpired(cmd="probe", timeout=1)
