@@ -102,17 +102,49 @@ def midstate(header64: bytes) -> tuple[int, ...]:
     return tuple(out)
 
 
-def keccak512(data: bytes) -> bytes:
+def _native_keccak512(data: bytes) -> bytes:
     """Original-padding keccak-512 (the ethash/x11 convention)."""
     out = (ctypes.c_uint8 * 64)()
     _lib.otedama_keccak512(_u8(data), len(data), out)
     return bytes(out)
 
 
-def keccak256(data: bytes) -> bytes:
+def _native_keccak256(data: bytes) -> bytes:
     out = (ctypes.c_uint8 * 32)()
     _lib.otedama_keccak256(_u8(data), len(data), out)
     return bytes(out)
+
+
+def _keccak_probe() -> bool:
+    """One-time self-check against the word-based (endian-neutral) python
+    sponge: the C absorb/squeeze XORs raw bytes into u64 lanes and
+    memcpy's them out, which is only correct on a little-endian host
+    (advisor r3 — the other native callers are probe-guarded; the exported
+    keccak helpers were not). Probed, not assumed, so a big-endian host
+    degrades to the python path instead of silently hashing wrong."""
+    from otedama_tpu.kernels.x11 import keccak as _pyk
+
+    try:
+        for v in (b"", b"otedama", bytes(range(137))):
+            if (_native_keccak512(v) != _pyk.keccak512_bytes(v)
+                    or _native_keccak256(v) != _pyk.keccak256_bytes(v)):
+                return False
+        return True
+    except Exception:
+        return False
+
+
+if _keccak_probe():
+    keccak512, keccak256 = _native_keccak512, _native_keccak256
+else:  # pragma: no cover - non-LE or miscompiled host
+    log.warning(
+        "native keccak failed its KAT probe (big-endian host or bad "
+        "build) — exporting the python sponge instead"
+    )
+    from otedama_tpu.kernels.x11.keccak import (  # noqa: F401
+        keccak256_bytes as keccak256,
+        keccak512_bytes as keccak512,
+    )
 
 
 def ethash_make_cache(rows: int, seed: bytes) -> "np.ndarray":

@@ -14,9 +14,20 @@ fails — a degraded-but-alive miner beats a hung one. The subprocess costs
 one python+jax startup (~5-15 s) once; steady-state callers pay a dict
 lookup.
 
+Recovery: after a FAILED probe the degraded-to-cpu verdict expires every
+``_FAIL_TTL`` seconds. The re-probe runs in a BACKGROUND thread with at
+least ``_RECOVERY_TIMEOUT`` seconds of budget — independent of which
+caller's (possibly tight) timeout observed the staleness — so a TPU whose
+runtime init takes 15 s can recover (a 10 s-capped synchronous retry
+could never see it), while the hot path keeps returning the cached cpu
+verdict instantly. When the
+background probe lands a healthy verdict, the cache flips and subsequent
+callers see the recovered platform.
+
 Escape hatches: ``OTEDAMA_PLATFORM`` pins the answer outright (no probe;
-operators and tests), and when jax is ALREADY initialized in this process
-the live backend is returned directly (no subprocess).
+operators and tests — consulted on EVERY call, before the cache, so late
+pin changes take effect), and when jax is ALREADY initialized in this
+process the live backend is returned directly (no subprocess).
 """
 
 from __future__ import annotations
@@ -25,12 +36,71 @@ import logging
 import os
 import subprocess
 import sys
+import threading
 
 log = logging.getLogger("otedama.utils.platform_probe")
 
+_LOCK = threading.Lock()
 _CACHED: tuple[str, int] | None = None
 _FAILED_AT: float | None = None  # monotonic ts of a failed probe
 _FAIL_TTL = 300.0  # re-probe failures after this many seconds
+_REPROBE: threading.Thread | None = None  # in-flight background re-probe
+# recovery probes always get this much, regardless of which caller's
+# (possibly tight) timeout happened to observe the stale verdict: a TPU
+# whose runtime init takes 15 s must be recoverable even if the trigger
+# was a hot-path call with timeout=5
+_RECOVERY_TIMEOUT = 90.0
+
+_PROBE_SRC = "import jax; print(jax.default_backend(), len(jax.devices()))"
+
+
+def _parse_pin(pinned: str) -> tuple[str, int]:
+    """Parse "tpu" / "tpu:4" (count channel for multi-chip pins, so a
+    pinned pod host still auto-selects the pod backend)."""
+    plat, _, cnt = pinned.partition(":")
+    try:
+        n = int(cnt) if cnt else 1
+    except ValueError:  # an operator typo must degrade, not crash
+        log.warning("bad OTEDAMA_PLATFORM count %r; assuming 1", cnt)
+        n = 1
+    return plat, n
+
+
+def _run_probe(timeout: float, cmd: list[str] | None = None) -> tuple[str, int]:
+    """One subprocess probe. Raises on hang/failure/unparseable output.
+    ``cmd`` is injectable (bench.py's retry harness and tests)."""
+    raw = subprocess.run(
+        cmd or [sys.executable, "-c", _PROBE_SRC],
+        timeout=timeout, capture_output=True, text=True, check=True,
+    ).stdout
+    # parse the LAST line (plugins print banners on stdout in some
+    # environments); anything unparseable is a FAILURE, not a silent
+    # permanent cpu verdict
+    out = raw.strip().splitlines()[-1].split() if raw.strip() else []
+    if len(out) != 2:
+        raise ValueError(f"unparseable probe output {raw!r}")
+    return out[0], int(out[1])
+
+
+def _reprobe_worker(timeout: float) -> None:
+    """Background recovery probe: full timeout, off the hot path."""
+    global _CACHED, _FAILED_AT, _REPROBE
+    import time
+
+    try:
+        verdict = _run_probe(timeout)
+    except Exception as e:
+        with _LOCK:
+            _FAILED_AT = time.monotonic()  # restart the TTL clock
+            _REPROBE = None
+        log.warning("background re-probe failed (%s); still cpu",
+                    e.__class__.__name__)
+        return
+    with _LOCK:
+        _CACHED, _FAILED_AT = verdict, None
+        _REPROBE = None
+    log.info("background re-probe recovered platform=%s devices=%d",
+             *verdict)
 
 
 def safe_backend_info(timeout: float = 90.0) -> tuple[str, int]:
@@ -38,65 +108,73 @@ def safe_backend_info(timeout: float = 90.0) -> tuple[str, int]:
 
     Successful verdicts cache for the process lifetime; a FAILED probe
     (degraded-to-cpu) re-checks after ``_FAIL_TTL`` seconds so a slow or
-    recovering TPU is not misclassified as cpu forever.
+    recovering TPU is not misclassified as cpu forever. The re-check runs
+    asynchronously with the FULL timeout; this call never blocks once a
+    verdict (even a degraded one) exists.
     """
-    global _CACHED, _FAILED_AT
+    global _CACHED, _FAILED_AT, _REPROBE
     import time
 
-    retry = False
-    if _CACHED is not None:
-        if _FAILED_AT is None or time.monotonic() - _FAILED_AT < _FAIL_TTL:
-            return _CACHED
-        _CACHED = None  # failed verdict expired: re-probe
-        retry = True    # ...but with a SHORT timeout: re-probes can sit on
-        # hot paths (_on_tpu per search call) and must not stall them for
-        # the full first-probe budget every TTL period
+    # the pin outranks the cache: operators/tests must be able to change
+    # OTEDAMA_PLATFORM after a first probe and have it take effect
     pinned = os.environ.get("OTEDAMA_PLATFORM", "").strip().lower()
     if pinned:
-        # "tpu" or "tpu:4" (count channel for multi-chip pins, so a pinned
-        # pod host still auto-selects the pod backend)
-        plat, _, cnt = pinned.partition(":")
-        try:
-            n = int(cnt) if cnt else 1
-        except ValueError:  # an operator typo must degrade, not crash
-            log.warning("bad OTEDAMA_PLATFORM count %r; assuming 1", cnt)
-            n = 1
-        _CACHED, _FAILED_AT = (plat, n), None
-        return _CACHED
+        verdict = _parse_pin(pinned)
+        with _LOCK:
+            _CACHED, _FAILED_AT = verdict, None
+        return verdict
+    with _LOCK:
+        if _CACHED is not None:
+            stale = (
+                _FAILED_AT is not None
+                and time.monotonic() - _FAILED_AT >= _FAIL_TTL
+            )
+            if stale and _REPROBE is None:
+                # kick the recovery probe; keep serving the cpu verdict
+                # meanwhile (hot paths like _on_tpu-per-search must not
+                # stall for a probe's full budget)
+                _FAILED_AT = time.monotonic()  # one probe per TTL window
+                _REPROBE = threading.Thread(
+                    target=_reprobe_worker,
+                    args=(max(timeout, _RECOVERY_TIMEOUT),),
+                    name="otedama-platform-reprobe", daemon=True,
+                )
+                _REPROBE.start()
+            return _CACHED
+    # no verdict yet: first probe. Do NOT hold the lock across the
+    # subprocess (that would serialize-and-stall concurrent first callers
+    # behind one probe's full budget — by design: one probe, many waiters
+    # would be ideal, but a second concurrent probe is merely wasteful,
+    # while blocking a startup path is the bug this module exists to fix).
     # already-initialized jax answers instantly and truthfully
     try:
         import jax
         from jax._src import xla_bridge
 
         if xla_bridge.backends_are_initialized():
-            _CACHED = (jax.default_backend(), len(jax.devices()))
-            _FAILED_AT = None
-            return _CACHED
+            verdict = (jax.default_backend(), len(jax.devices()))
+            with _LOCK:
+                _CACHED, _FAILED_AT = verdict, None
+            return verdict
     except Exception:  # pragma: no cover - very old jax
         pass
     try:
-        raw = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.default_backend(), len(jax.devices()))"],
-            timeout=min(timeout, 10.0) if retry else timeout,
-            capture_output=True, text=True, check=True,
-        ).stdout
-        # parse the LAST line (plugins print banners on stdout in some
-        # environments); anything unparseable is a FAILURE, not a silent
-        # permanent cpu verdict
-        out = raw.strip().splitlines()[-1].split() if raw.strip() else []
-        if len(out) != 2:
-            raise ValueError(f"unparseable probe output {raw!r}")
-        _CACHED, _FAILED_AT = (out[0], int(out[1])), None
+        verdict = _run_probe(timeout)
+        with _LOCK:
+            if _CACHED is None or _FAILED_AT is not None:
+                _CACHED, _FAILED_AT = verdict, None
+            return _CACHED
     except Exception as e:  # degrade, never die: this guards startup paths
         log.warning(
             "device platform probe failed/hung (%s) — assuming cpu so the "
             "app starts instead of hanging; will re-probe in %.0fs",
             e.__class__.__name__, _FAIL_TTL,
         )
-        _CACHED = ("cpu", 1)
-        _FAILED_AT = time.monotonic()
-    return _CACHED
+        with _LOCK:
+            if _CACHED is None:  # a concurrent success outranks our failure
+                _CACHED = ("cpu", 1)
+                _FAILED_AT = time.monotonic()
+            return _CACHED
 
 
 def safe_default_backend(timeout: float = 90.0) -> str:

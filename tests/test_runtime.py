@@ -657,3 +657,47 @@ def test_platform_probe_hang_safe(monkeypatch):
 
     monkeypatch.setattr(xb, "backends_are_initialized", lambda: False)
     assert pp.safe_backend_info(timeout=1) == ("cpu", 1)
+
+
+def test_platform_probe_pin_outranks_cache(monkeypatch):
+    """Setting/changing OTEDAMA_PLATFORM AFTER a first probe must take
+    effect (advisor r3: the pin was only read when no verdict was cached)."""
+    from otedama_tpu.utils import platform_probe as pp
+
+    monkeypatch.setattr(pp, "_CACHED", ("cpu", 1))
+    monkeypatch.setattr(pp, "_FAILED_AT", None)
+    monkeypatch.setenv("OTEDAMA_PLATFORM", "tpu:8")
+    assert pp.safe_backend_info() == ("tpu", 8)
+    monkeypatch.setenv("OTEDAMA_PLATFORM", "cpu")
+    assert pp.safe_backend_info() == ("cpu", 1)
+
+
+def test_platform_probe_background_recovery(monkeypatch):
+    """An expired failure verdict triggers an ASYNC full-timeout re-probe:
+    the call itself returns the degraded verdict instantly, and once the
+    background probe lands, callers see the recovered platform (advisor
+    r3: the old 10s-capped sync retry could never see a 15s TPU init)."""
+    import time as _t
+
+    from otedama_tpu.utils import platform_probe as pp
+
+    monkeypatch.delenv("OTEDAMA_PLATFORM", raising=False)
+    monkeypatch.setattr(pp, "_CACHED", ("cpu", 1))
+    monkeypatch.setattr(pp, "_FAILED_AT",
+                        _t.monotonic() - pp._FAIL_TTL - 1)
+    monkeypatch.setattr(pp, "_REPROBE", None)
+    seen_timeouts = []
+
+    def fake_probe(timeout):
+        seen_timeouts.append(timeout)
+        return ("tpu", 4)
+
+    monkeypatch.setattr(pp, "_run_probe", fake_probe)
+    # hot-path call with a TIGHT timeout: degraded verdict, no blocking,
+    # and the background probe still gets the full recovery budget
+    assert pp.safe_backend_info(timeout=5.0) == ("cpu", 1)
+    t = pp._REPROBE
+    assert t is not None
+    t.join(timeout=10)
+    assert seen_timeouts == [pp._RECOVERY_TIMEOUT]  # not the 5s trigger
+    assert pp.safe_backend_info() == ("tpu", 4)
