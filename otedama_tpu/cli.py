@@ -70,9 +70,48 @@ async def _run_app(cfg) -> int:
     return 0
 
 
+def _maybe_fused(args, cfg) -> int | None:
+    """``--fused-pod``: join the multi-host jax runtime (runtime.dcn env
+    contract) BEFORE any jax backend query. Follower processes never run
+    the app — they execute the lockstep compute loop until the leader
+    stops the pod — so this returns their exit code; the leader (and
+    non-fused runs) get None and proceed into the app with the
+    ``fused-pod`` engine backend."""
+    if not getattr(args, "fused_pod", False):
+        return None
+    from otedama_tpu.runtime import dcn
+
+    dcn_cfg = dcn.maybe_initialize()
+    if dcn_cfg is None:
+        print(
+            "--fused-pod needs OTEDAMA_COORDINATOR (and "
+            "OTEDAMA_NUM_PROCESSES / OTEDAMA_PROCESS_ID) in the "
+            "environment — see otedama_tpu/runtime/dcn.py",
+            file=sys.stderr,
+        )
+        return 2
+    cfg.mining.backend = "fused-pod"
+    if dcn_cfg.process_id != 0:
+        from otedama_tpu.runtime.fused import FusedPodDriver, follower_loop
+
+        logging.getLogger("otedama.cli").info(
+            "fused-pod follower rank %d/%d: entering lockstep loop",
+            dcn_cfg.process_id, dcn_cfg.num_processes,
+        )
+        steps = follower_loop(FusedPodDriver())
+        logging.getLogger("otedama.cli").info(
+            "fused-pod follower done after %d steps", steps
+        )
+        return 0
+    return None
+
+
 def cmd_start(args) -> int:
     cfg = _load_config(args)
     _setup_logging(cfg.logging.level, cfg.logging.file)
+    rc = _maybe_fused(args, cfg)
+    if rc is not None:
+        return rc
     return asyncio.run(_run_app(cfg))
 
 
@@ -84,6 +123,9 @@ def cmd_solo(args) -> int:
     if args.algorithm:
         cfg.mining.algorithm = args.algorithm
     _setup_logging(cfg.logging.level, cfg.logging.file)
+    rc = _maybe_fused(args, cfg)
+    if rc is not None:
+        return rc
     return asyncio.run(_run_app(cfg))
 
 
@@ -150,10 +192,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(fn=cmd_init)
 
     p = sub.add_parser("start", help="start with the config file as-is")
+    p.add_argument("--fused-pod", action="store_true",
+                   help="join a multi-host fused pod (OTEDAMA_COORDINATOR "
+                        "env contract; followers run compute-only)")
     p.set_defaults(fn=cmd_start)
 
     p = sub.add_parser("solo", help="solo-mine against a chain node (or the mock chain)")
     p.add_argument("-a", "--algorithm", default=None)
+    p.add_argument("--fused-pod", action="store_true",
+                   help="join a multi-host fused pod (OTEDAMA_COORDINATOR "
+                        "env contract; followers run compute-only)")
     p.set_defaults(fn=cmd_solo)
 
     p = sub.add_parser("pool", help="run a stratum pool server")

@@ -146,7 +146,7 @@ def implemented(name: str) -> bool:
 register(AlgorithmSpec(
     name="sha256d",
     aliases=("sha256double", "bitcoin"),
-    backends=("pallas-tpu", "pod", "xla", "native-cpu"),
+    backends=("pallas-tpu", "pod", "fused-pod", "xla", "native-cpu"),
     planning_hashrate=_PLANNING["sha256d"],
 ))
 register(AlgorithmSpec(

@@ -137,6 +137,19 @@ class MiningEngine:
             t.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)
         self._tasks.clear()
+        # backends with teardown needs (fused-pod: release the follower
+        # processes blocked in their lockstep broadcast). Off the loop
+        # thread: a close may block on cross-host coordination (bounded
+        # internally), and the event loop must keep serving meanwhile.
+        loop = asyncio.get_running_loop()
+        for backend in self.backends.values():
+            close = getattr(backend, "close", None)
+            if close is not None:
+                try:
+                    await loop.run_in_executor(None, close)
+                except Exception:
+                    log.exception("backend %s close failed",
+                                  getattr(backend, "name", "?"))
         self.state = EngineState.STOPPED
         log.info("engine stopped")
 

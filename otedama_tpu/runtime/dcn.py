@@ -8,25 +8,26 @@ physical mining farms scale. No cross-host jax runtime is required for
 that, and it is the supported production mode (``k8s/hpa.yaml`` scales
 exactly these independent workers).
 
-This module is the bootstrap for the FUTURE fused mode, where one SPMD
-program spans a multi-host slice (`jax.distributed.initialize` makes
-`jax.devices()` global; XLA routes collectives over ICI within a slice
-and DCN across slices). What the fused mode still needs before it can be
-wired into the engine — and why this module is NOT called from app
-startup yet:
+This module is the bootstrap for the FUSED mode — ``runtime/fused.py`` —
+where one SPMD program spans a multi-host slice
+(`jax.distributed.initialize` makes `jax.devices()` global; XLA routes
+collectives over ICI within a slice and DCN across slices). The three
+disciplines the fused mode required are implemented there:
 
-- multi-controller input discipline: every process must build identical
-  per-step inputs for its addressable shard (host-local ``jnp.asarray``
-  of globally-shaped arrays is rejected by multi-controller jax);
-- lockstep job dispatch: a clean-job must reach every process before any
-  re-enters the compiled step, else the laggard blocks in the cross-host
-  psum/pmin while the leader has moved on (deadlock);
-- synchronized batch counts/extranonce state across processes.
+- multi-controller input discipline: identical host (numpy) inputs on
+  every process + device-side all_gather of winner tables so outputs are
+  replicated (``PodSearch(multiprocess=True)``);
+- lockstep job dispatch: every fused step begins with a
+  ``broadcast_one_to_all`` of the leader's job state — the broadcast is
+  the barrier, so a clean-job cannot split the pod across different
+  compiled steps (the deadlock case; tested in tests/test_fused.py);
+- synchronized batch counts/extranonce state: they ride the same
+  broadcast payload.
 
-``maybe_initialize()`` is exposed for explicit operator use (e.g. a
-future ``--fused-pod`` flag) and is a no-op unless ``OTEDAMA_COORDINATOR``
-is set. Blocking caveat: `jax.distributed.initialize` blocks until every
-process joins — call it before serving, never on a live event loop.
+``maybe_initialize()`` is called by the CLI's ``--fused-pod`` path
+(cli._maybe_fused) and is a no-op unless ``OTEDAMA_COORDINATOR`` is set.
+Blocking caveat: `jax.distributed.initialize` blocks until every process
+joins — call it before serving, never on a live event loop.
 
 Env contract (StatefulSet-shaped):
 

@@ -1,0 +1,247 @@
+"""Fused multi-host pod: ONE SPMD search program spanning DCN-connected
+hosts — the running code that ``runtime/dcn.py``'s bootstrap promises.
+
+Reference parity: the reference's scale story is 1-10,000 devices behind
+one logical miner (/root/reference/README.md:27,107), realized there as a
+coordinator handing work to NCCL/MPI worker ranks. The TPU-native design
+instead joins every host into one multi-controller jax runtime
+(``jax.distributed.initialize`` — ``dcn.maybe_initialize``) and runs the
+SAME compiled (host, chip) pod program on all of them: XLA routes the
+pod's collectives over ICI within a slice and DCN across slices; no
+hand-written socket fabric.
+
+The three disciplines ``dcn.py`` names, and how this module implements
+them:
+
+- **multi-controller input discipline**: every process passes IDENTICAL
+  host (numpy) values into the jitted step; winner tables are
+  all-gathered ON DEVICE (``PodSearch(multiprocess=True)``) so outputs
+  come back fully replicated and every process's host-side winner
+  extraction sees the same bytes;
+- **lockstep job dispatch**: every ``step()`` begins with
+  ``broadcast_one_to_all`` of the leader's (generation, jobs, window)
+  payload. The broadcast is itself a collective barrier, so a clean-job
+  can never split the pod: a follower cannot re-enter the compiled
+  search with a stale job while the leader has moved on — the exact
+  deadlock ``dcn.py:20-24`` warns about (regression-tested in
+  tests/test_fused.py with a mid-run job swap);
+- **synchronized extranonce state**: host row ``r`` of the mesh searches
+  the job the leader published for row ``r``; followers never invent
+  jobs. The leader (process 0) owns the stratum connection and submits
+  every row's shares (results are replicated, so it has them all).
+
+Payload layout (fixed shape — broadcast_one_to_all requires it):
+``[stop u32 | generation u32 | base u32 | count u32]`` then per host row
+``header76 (76 bytes) + share target (32 bytes, big-endian)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+
+import numpy as np
+
+from otedama_tpu.runtime.mesh import PodSearch, make_pod_mesh
+from otedama_tpu.runtime.search import JobConstants, SearchResult
+
+log = logging.getLogger("otedama.runtime.fused")
+
+_HDR = 16          # stop, generation, base, count (4 x u32, little-endian)
+_ROW = 76 + 32     # header76 + target
+
+
+def _encode(stop: int, generation: int, base: int, count: int,
+            jcs: list[JobConstants] | None, n_rows: int) -> np.ndarray:
+    buf = np.zeros(_HDR + n_rows * _ROW, dtype=np.uint8)
+    buf[:_HDR] = np.frombuffer(
+        np.array([stop, generation, base, count], dtype="<u4").tobytes(),
+        dtype=np.uint8,
+    )
+    if jcs is not None:
+        if len(jcs) != n_rows:
+            raise ValueError(f"need {n_rows} jobs, got {len(jcs)}")
+        for r, jc in enumerate(jcs):
+            o = _HDR + r * _ROW
+            buf[o:o + 76] = np.frombuffer(jc.header76, dtype=np.uint8)
+            buf[o + 76:o + _ROW] = np.frombuffer(
+                jc.target.to_bytes(32, "big"), dtype=np.uint8
+            )
+    return buf
+
+
+def _decode(buf: np.ndarray, n_rows: int):
+    stop, generation, base, count = np.frombuffer(
+        buf[:_HDR].tobytes(), dtype="<u4"
+    )
+    rows = []
+    for r in range(n_rows):
+        o = _HDR + r * _ROW
+        rows.append((
+            buf[o:o + 76].tobytes(),
+            int.from_bytes(buf[o + 76:o + _ROW].tobytes(), "big"),
+        ))
+    return int(stop), int(generation), int(base), int(count), rows
+
+
+class FusedPodDriver:
+    """Lockstep driver for one fused multi-host pod.
+
+    Leader (process 0) drives: ``step(jcs, base, count)`` publishes the
+    window and searches it. Followers loop ``step()`` — each call blocks
+    in the broadcast until the leader publishes, then executes the same
+    compiled search. ``step`` returns the per-row ``SearchResult`` list
+    (identical on every process), or None when the leader said stop.
+    """
+
+    def __init__(self, mesh=None, **pod_kwargs):
+        import jax
+
+        self.world = jax.process_count()
+        self.rank = jax.process_index()
+        if mesh is None:
+            # row r = process r's local devices, so each host feeds the
+            # mesh row it physically owns
+            devs = sorted(
+                jax.devices(), key=lambda d: (d.process_index, d.id)
+            )
+            mesh = make_pod_mesh(devs, n_hosts=self.world)
+        self.pod = PodSearch(
+            mesh, multiprocess=self.world > 1, **pod_kwargs
+        )
+        self.n_rows = self.pod.n_hosts
+        self.generation = 0       # last generation this process executed
+        self._jcs: list[JobConstants] | None = None
+        self._pub_key = None      # leader: identity of last published jobs
+        self._pub_gen = 0
+        # one collective in flight per process, ever: a stop broadcast
+        # issued while a search step's collectives are still running
+        # would give two concurrent collectives with undefined
+        # cross-process ordering (deadlock class)
+        self._step_lock = threading.Lock()
+
+    @property
+    def is_leader(self) -> bool:
+        return self.rank == 0
+
+    def step(
+        self,
+        jcs: list[JobConstants] | None = None,
+        base: int = 0,
+        count: int = 0,
+        *,
+        generation: int | None = None,
+        stop: bool = False,
+    ) -> list[SearchResult] | None:
+        """One lockstep pod step. Leader passes the job set + window (and
+        bumps ``generation`` on clean jobs — or passes it explicitly);
+        followers pass nothing. Returns None when the pod is stopping."""
+        from jax.experimental import multihost_utils as mu
+
+        if self.is_leader:
+            if not stop and jcs is None:
+                raise ValueError("leader must pass jcs (or stop=True)")
+            if generation is None:
+                if jcs is not None:
+                    # bump only on a CHANGED job set, so followers
+                    # rebuild midstates exactly when a clean job lands
+                    key = tuple((jc.header76, jc.target) for jc in jcs)
+                    if key != self._pub_key:
+                        self._pub_key = key
+                        self._pub_gen += 1
+                generation = self._pub_gen
+            payload = _encode(
+                int(stop), generation, base & 0xFFFFFFFF, count,
+                jcs, self.n_rows,
+            )
+        else:
+            if jcs is not None or stop:
+                raise ValueError("only the leader publishes jobs/stop")
+            payload = _encode(0, 0, 0, 0, None, self.n_rows)
+
+        # THE lockstep point: a collective barrier carrying the job state.
+        # Every process blocks here until all have arrived, so no process
+        # can be inside the compiled search with a stale job while
+        # another has already moved to the next one.
+        with self._step_lock:
+            payload = np.asarray(mu.broadcast_one_to_all(payload))
+            stop_f, gen, base, count, rows = _decode(payload, self.n_rows)
+            if stop_f:
+                log.info("rank %d: stop received", self.rank)
+                return None
+            if self._jcs is None or gen != self.generation:
+                self._jcs = [
+                    JobConstants.from_header_prefix(h76, target)
+                    for h76, target in rows
+                ]
+                self.generation = gen
+                log.info("rank %d: job generation %d", self.rank, gen)
+            return self.pod.search_jobs(self._jcs, base, count)
+
+    def stop(self) -> None:
+        """Leader: release every follower from its broadcast wait."""
+        if not self.is_leader:
+            raise ValueError("only the leader stops the pod")
+        self.step(stop=True)
+
+
+def follower_loop(driver: FusedPodDriver) -> int:
+    """Run a follower process until the leader stops the pod. Returns the
+    number of steps executed (for tests/telemetry)."""
+    steps = 0
+    while driver.step() is not None:
+        steps += 1
+    return steps
+
+
+@dataclasses.dataclass
+class FusedPodBackend:
+    """Engine-facing backend for the LEADER process of a fused pod.
+
+    Same protocol as ``PodBackend``: advertises ``en2_fanout`` so the
+    engine hands one JobConstants per host row; each ``search_multi``
+    call is one lockstep pod step (followers mirror it in
+    ``follower_loop``)."""
+
+    driver: FusedPodDriver
+    algorithm: str = "sha256d"
+
+    def __post_init__(self):
+        if not self.driver.is_leader:
+            raise ValueError("FusedPodBackend runs on the leader only; "
+                             "followers run follower_loop()")
+        self.en2_fanout = self.driver.n_rows
+        self.name = (
+            f"fused-pod{self.driver.n_rows}x{self.driver.pod.n_chips}"
+        )
+
+    def search_multi(self, jcs, base: int, count: int):
+        return self.driver.step(jcs, base, count)
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Engine teardown hook: release followers from their broadcast.
+
+        Bounded: the stop broadcast itself is a collective, so a crashed
+        follower would otherwise hang shutdown forever. The broadcast
+        runs on a daemon thread (serialized against any in-flight step
+        by the driver's step lock) and is abandoned after ``timeout`` —
+        a dead pod member means there is no one left to release."""
+        t = threading.Thread(
+            target=self.driver.stop, name="fused-pod-stop", daemon=True
+        )
+        t.start()
+        t.join(timeout)
+        if t.is_alive():
+            logging.getLogger("otedama.runtime.fused").warning(
+                "fused pod stop broadcast did not complete within %.0fs "
+                "(a follower is gone?) — abandoning it", timeout,
+            )
+
+    def search(self, jc, base: int, count: int):
+        if self.en2_fanout != 1:
+            raise ValueError(
+                f"{self.name} searches {self.en2_fanout} extranonce "
+                "spaces per call; use search_multi()"
+            )
+        return self.driver.step([jc], base, count)[0]

@@ -159,11 +159,18 @@ class PodSearch:
     jnp_tile: int = 1024        # flagged-tile granularity (CPU/jnp path)
     use_pallas: bool | None = None  # None = pallas iff running on TPU
     rolled: bool | None = None      # jnp path: rolled rounds off-TPU
+    multiprocess: bool = False  # fused multi-controller mode (runtime.fused):
+    # winner tables are all-gathered on device so every process reads
+    # identical REPLICATED outputs — multi-controller jax cannot np.asarray
+    # a host-sharded output, and replicated results keep every process's
+    # host-side winner extraction in lockstep
 
     def __post_init__(self):
         self._axes, self.n_hosts, self.n_chips = parse_mesh_axes(
             self.mesh, "PodSearch"
         )
+        if self.multiprocess and len(self._axes) != 2:
+            raise ValueError("multiprocess PodSearch needs a (host, chip) mesh")
         if self.use_pallas is None or self.rolled is None:
             from otedama_tpu.utils.platform_probe import safe_default_backend
 
@@ -185,21 +192,27 @@ class PodSearch:
         host_spec = P(axes[0]) if len(axes) == 2 else P()
         use_pallas, sub = self.use_pallas, self.sub
         tile, rolled = self.tile, self.rolled
+        replicate_out = self.multiprocess
+
+        table_specs = (
+            (P(), P(), P()) if replicate_out
+            else (P(*axes), P(*axes), P(*axes))
+        )
 
         @functools.partial(
             shard_map,
             mesh=self.mesh,
-            in_specs=(host_spec, host_spec, P(), P()),
+            in_specs=(host_spec, host_spec, P(), P(), P()),
             out_specs=(
-                P(*axes), P(*axes), P(*axes),  # per-(row,chip) K-tables
-                P(), P(),                      # pod-aggregated telemetry
+                *table_specs,  # per-(row,chip) K-tables
+                P(), P(),      # pod-aggregated telemetry
             ),
             # vma-typing is off: pallas_call's out_shape structs carry no
             # vma, and the host-sharded job words legitimately meet
             # chip-varying nonces inside the local search
             check_vma=False,
         )
-        def _step(midstates, tails, limbs8, base):
+        def _step(midstates, tails, limbs8, base, n_full):
             # midstates: (1, 8) local row slice; tails: (1, 3)
             ms = midstates[0]
             tl = tails[0]
@@ -215,9 +228,28 @@ class PodSearch:
                     tile=tile, rolled=rolled,
                 )
             # ICI reductions: the pod reports aggregate telemetry as ONE
-            # worker (psum/pmin ride the interconnect, never the host)
+            # worker (psum/pmin ride the interconnect, never the host).
+            # best-hash telemetry only counts chips FULLY inside the
+            # requested range (chip < n_full): a chip whose batch extends
+            # past count would leak out-of-range nonces into
+            # share-difficulty stats (chip granularity is conservative —
+            # the partial chip's in-range lanes are simply not reported)
             pod_flagged = jax.lax.psum(st[0], axes)
-            pod_best = _unflip(jax.lax.pmin(_flip(st[2]), axes))
+            best = jnp.where(
+                chip < n_full, _flip(st[2]), jnp.int32(np.int32(0x7FFFFFFF))
+            )
+            pod_best = _unflip(jax.lax.pmin(best, axes))
+            if replicate_out:
+                # fused mode: gather the (tiny) K-tables across the pod so
+                # every device — hence every PROCESS — holds the full
+                # (n_hosts, n_chips, ...) result; the gathers ride
+                # ICI/DCN and keep multi-controller host code in lockstep
+                wt, wm, st = (
+                    jax.lax.all_gather(jax.lax.all_gather(x, chip_axis),
+                                       axes[0])
+                    for x in (wt, wm, st)
+                )
+                return wt, wm, st, pod_flagged, pod_best
             shape = (1, 1, K) if len(axes) == 2 else (1, K)
             sshape = (1, 1, 3) if len(axes) == 2 else (1, 3)
             return (
@@ -243,19 +275,24 @@ class PodSearch:
         # all rows share one target (same job difficulty across extranonces)
         if any(jc.target != jcs[0].target for jc in jcs):
             raise ValueError("all pod rows must share one share target")
+        if count <= 0:
+            self.last_pod_flagged, self.last_pod_best = 0, 0xFFFFFFFF
+            return [SearchResult([], 0, 0xFFFFFFFF) for _ in jcs]
         limbs = jcs[0].limbs
         per_chip = -(-count // self.n_chips)              # ceil
         per_chip = -(-per_chip // self.tile) * self.tile  # round up to tiles
         scanned = per_chip * self.n_chips                 # >= count (overscan)
 
-        ms = jnp.asarray(
-            np.stack([np.array(jc.midstate, dtype=np.uint32) for jc in jcs])
-        )
-        tl = jnp.asarray(
-            np.stack([np.array(jc.tail, dtype=np.uint32) for jc in jcs])
-        )
+        # numpy (uncommitted) inputs: in multi-controller mode every
+        # process passes identical host values and jit shards them per the
+        # shard_map specs — a committed single-device jnp array would be
+        # rejected there; single-controller behavior is unchanged
+        ms = np.stack([np.array(jc.midstate, dtype=np.uint32) for jc in jcs])
+        tl = np.stack([np.array(jc.tail, dtype=np.uint32) for jc in jcs])
+        n_full = count // per_chip  # chips fully inside the request
         out = self._step_for(per_chip)(
-            ms, tl, jnp.asarray(limbs), jnp.uint32(base & 0xFFFFFFFF)
+            ms, tl, np.asarray(limbs, dtype=np.uint32),
+            np.uint32(base & 0xFFFFFFFF), np.uint32(n_full),
         )
         wt, wm, st, pod_flagged, pod_best = (np.asarray(o) for o in out)
         if wt.ndim == 2:  # 1D mesh: add the row axis
@@ -269,7 +306,11 @@ class PodSearch:
             row_best = 0xFFFFFFFF
             for c in range(self.n_chips):
                 n_flagged = int(st[r, c, 0])
-                row_best = min(row_best, int(st[r, c, 2]))
+                if c < n_full:
+                    # same chip-granular mask as the device pmin: chips
+                    # extending past `count` must not leak out-of-range
+                    # nonces into best-share telemetry
+                    row_best = min(row_best, int(st[r, c, 2]))
                 chip_base = (base + c * per_chip) & 0xFFFFFFFF
                 if n_flagged > K:
                     res = self._rescan_full.search(jc, chip_base, per_chip)
@@ -389,7 +430,7 @@ class ScryptPodSearch:
             shard_map,
             mesh=self.mesh,
             in_specs=(host_spec, P(), P()),
-            out_specs=(P(*axes), P(*axes), P()),
+            out_specs=(P(*axes), P(*axes)),
             check_vma=False,
         )
         def _step(h19_rows, limbs8, base):
@@ -403,10 +444,10 @@ class ScryptPodSearch:
             )
             h = sj.digest_words_to_compare_order(d)
             hits = sj.le256(h, tuple(limbs8[i] for i in range(8)))
-            local_best = _flip(h[0]).min()
-            pod_best = _unflip(jax.lax.pmin(local_best, axes))
+            # (no device-side pmin: host telemetry over requested lanes
+            # only — overscan-safe and one less cross-pod collective)
             shape = (1, 1, per_chip) if len(axes) == 2 else (1, per_chip)
-            return hits.reshape(shape), h[0].reshape(shape), pod_best
+            return hits.reshape(shape), h[0].reshape(shape)
 
         return jax.jit(_step)
 
@@ -430,6 +471,9 @@ class ScryptPodSearch:
         # different per-row target would drop that row's winners
         if any(jc.target != jcs[0].target for jc in jcs):
             raise ValueError("all pod rows must share one share target")
+        if count <= 0:
+            self.last_pod_best = 0xFFFFFFFF
+            return [SearchResult([], 0, 0xFFFFFFFF) for _ in jcs]
         limbs = jcs[0].limbs
         per_chip = max(-(-count // self.n_chips), 1)
         if self.blockmix == "pallas":
@@ -449,16 +493,18 @@ class ScryptPodSearch:
         out = self._step_for(per_chip)(
             h19, jnp.asarray(limbs), jnp.uint32(base & 0xFFFFFFFF)
         )
-        hits, h0, pod_best = (np.asarray(o) for o in out)
+        hits, h0 = (np.asarray(o) for o in out)
         if hits.ndim == 2:  # 1D mesh: add the row axis
             hits, h0 = hits[None], h0[None]
-        self.last_pod_best = int(pod_best)
 
         results: list[SearchResult] = []
         for r, jc in enumerate(jcs):
             winners: list[Winner] = []
             row = hits[r].reshape(-1)  # chip-major concatenation
-            row_best = int(h0[r].reshape(-1).min())
+            # best-hash telemetry over REQUESTED lanes only: overscan
+            # lanes hash nonces outside [base, base+count) and must not
+            # leak into share-difficulty stats (advisor r3)
+            row_best = int(h0[r].reshape(-1)[:count].min())
             for idx in np.nonzero(row)[0].tolist():
                 nonce = (base + idx) & 0xFFFFFFFF
                 if scanned != count and idx >= count:
@@ -467,6 +513,7 @@ class ScryptPodSearch:
                 if tgt.hash_meets_target(digest, jc.target):
                     winners.append(Winner(nonce, digest))
             results.append(SearchResult(winners, count, row_best))
+        self.last_pod_best = min(r.best_hash_hi for r in results)
         return results
 
     def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
@@ -554,7 +601,7 @@ class X11PodSearch:
             shard_map,
             mesh=self.mesh,
             in_specs=(host_spec, P(), P()),
-            out_specs=(P(*axes), P(*axes), P()),
+            out_specs=(P(*axes), P(*axes)),
             check_vma=False,
         )
         def _step(h76_rows, t0_limb, base):
@@ -577,10 +624,11 @@ class X11PodSearch:
                 | (d[:, 31].astype(jnp.uint32) << 24)
             )
             hits = h0 <= t0_limb  # prefilter: no false negatives
-            local_best = _flip(h0).min()
-            pod_best = _unflip(jax.lax.pmin(local_best, axes))
+            # (no device-side pmin telemetry: best-hash stats come from
+            # the host over requested lanes only, so overscan lanes can't
+            # leak in and the chain avoids a dead cross-pod collective)
             shape = (1, 1, per_chip) if len(axes) == 2 else (1, per_chip)
-            return hits.reshape(shape), h0.reshape(shape), pod_best
+            return hits.reshape(shape), h0.reshape(shape)
 
         return jax.jit(_step)
 
@@ -601,6 +649,9 @@ class X11PodSearch:
             )
         if any(jc.target != jcs[0].target for jc in jcs):
             raise ValueError("all pod rows must share one share target")
+        if count <= 0:
+            self.last_pod_best = 0xFFFFFFFF
+            return [SearchResult([], 0, 0xFFFFFFFF) for _ in jcs]
         t0_limb = int(jcs[0].limbs[0])
         # FIXED compiled shape: per_chip is always self.chunk (the chain
         # costs minutes per shape — X11JaxBackend's fixed_shape lesson);
@@ -613,7 +664,6 @@ class X11PodSearch:
         ]))
         winners_per_row: list[list[Winner]] = [[] for _ in jcs]
         best_per_row = [0xFFFFFFFF] * len(jcs)
-        pod_best = 0xFFFFFFFF
         done = 0
         while done < count:
             wbase = (base + done) & 0xFFFFFFFF
@@ -622,14 +672,15 @@ class X11PodSearch:
                 out = self._step_for(per_chip)(
                     h76, jnp.uint32(t0_limb), jnp.uint32(wbase)
                 )
-                hits, h0, wpod_best = (np.asarray(o) for o in out)
+                hits, h0 = (np.asarray(o) for o in out)
             if hits.ndim == 2:
                 hits, h0 = hits[None], h0[None]
-            pod_best = min(pod_best, int(wpod_best))
             for r, jc in enumerate(jcs):
                 row = hits[r].reshape(-1)
+                # telemetry over requested lanes only (advisor r3): lanes
+                # >= valid hash nonces outside the asked-for range
                 best_per_row[r] = min(
-                    best_per_row[r], int(h0[r].reshape(-1).min())
+                    best_per_row[r], int(h0[r].reshape(-1)[:valid].min())
                 )
                 for idx in np.nonzero(row)[0].tolist():
                     if idx >= valid:
@@ -640,7 +691,7 @@ class X11PodSearch:
                     if tgt.hash_meets_target(digest, jc.target):
                         winners_per_row[r].append(Winner(nonce, digest))
             done += valid
-        self.last_pod_best = pod_best
+        self.last_pod_best = min(best_per_row)
         return [
             SearchResult(winners_per_row[r], count, best_per_row[r])
             for r in range(len(jcs))

@@ -640,6 +640,15 @@ def make_backend(kind: str, algorithm: str = "sha256d", **kwargs):
             from otedama_tpu.runtime.mesh import PodBackend
 
             return PodBackend(**kwargs)
+        if kind == "fused-pod":
+            # LEADER of a multi-host fused pod (runtime.fused); followers
+            # run fused.follower_loop instead of an engine
+            from otedama_tpu.runtime.fused import (
+                FusedPodBackend,
+                FusedPodDriver,
+            )
+
+            return FusedPodBackend(FusedPodDriver(**kwargs))
         if kind == "pallas-tpu":
             return PallasBackend(**kwargs)
         if kind == "xla":

@@ -1,0 +1,113 @@
+"""Worker process for tests/test_fused.py — one rank of a 2-process
+fused pod over a virtual CPU mesh (4 devices per process).
+
+Run: python fused_worker.py <rank> <port>
+
+Verifies, ON EVERY RANK, that the fused pod's replicated results match a
+host-side sha256d oracle for both extranonce rows, across a mid-run
+clean-job swap (the dcn.py deadlock case: the leader changes jobs while
+the follower is already blocked in its next step's broadcast).
+"""
+
+import hashlib
+import struct
+import sys
+
+
+def sha256d(b: bytes) -> bytes:
+    return hashlib.sha256(hashlib.sha256(b).digest()).digest()
+
+
+def oracle(h76: bytes, base: int, count: int) -> dict[int, int]:
+    """nonce-word -> compare-order value of the digest's top limb."""
+    out = {}
+    for n in range(base, base + count):
+        d = sha256d(h76 + struct.pack(">I", n & 0xFFFFFFFF))
+        out[n & 0xFFFFFFFF] = int.from_bytes(d, "little")
+    return out
+
+
+def jobset(tag: int, target_quantile: float, base: int, count: int):
+    """Two extranonce-row headers + a target putting ~quantile of lanes
+    under it, plus the expected winner sets."""
+    from otedama_tpu.runtime.search import JobConstants
+
+    rows = [
+        bytes([tag, r]) * 32 + struct.pack(">3I", 0x17034219, 0x6530D1B7, r)
+        for r in range(2)
+    ]
+    vals = [oracle(h, base, count) for h in rows]
+    allv = sorted(v for m in vals for v in m.values())
+    target = allv[int(len(allv) * target_quantile)]
+    jcs = [JobConstants.from_header_prefix(h, target) for h in rows]
+    expected = [
+        sorted(n for n, v in m.items() if v <= target) for m in vals
+    ]
+    return jcs, expected
+
+
+def main() -> None:
+    rank, port = int(sys.argv[1]), sys.argv[2]
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        f"127.0.0.1:{port}", num_processes=2, process_id=rank
+    )
+    assert jax.process_count() == 2 and len(jax.devices()) == 8
+
+    from otedama_tpu.runtime.fused import FusedPodDriver
+
+    driver = FusedPodDriver(use_pallas=False, rolled=True, jnp_tile=64)
+    assert driver.n_rows == 2 and driver.pod.n_chips == 4
+
+    base, count = 0x0100, 512
+    jcs1, exp1 = jobset(0xA1, 0.05, base, count)
+    jcs2, exp2 = jobset(0xB7, 0.05, base, count)
+
+    def check(results, expected, label):
+        assert results is not None, f"{label}: unexpected stop"
+        for r, res in enumerate(results):
+            got = sorted(w.nonce_word for w in res.winners)
+            assert got == expected[r], (
+                f"{label} row {r}: {got} != {expected[r]}"
+            )
+            for w in res.winners:
+                jc = driver._jcs[r]
+                assert w.digest == sha256d(jc.header_for(w.nonce_word))
+
+    if rank == 0:
+        # steps 1-3: generation 1 (step 2 walks a different window)
+        check(driver.step(jcs1, base, count), exp1, "gen1/s1")
+        driver.step(jcs1, base + count, count)
+        check(driver.step(jcs1, base, count), exp1, "gen1/s3")
+        assert driver.generation == 1
+        # CLEAN JOB mid-run: the follower is already blocked in its next
+        # broadcast with the old job — the swap must reach it atomically
+        check(driver.step(jcs2, base, count), exp2, "gen2/s1")
+        assert driver.generation == 2
+        check(driver.step(jcs2, base, count), exp2, "gen2/s2")
+        driver.stop()
+        print(f"OK rank=0 generation={driver.generation}", flush=True)
+    else:
+        steps = 0
+        while True:
+            results = driver.step()
+            if results is None:
+                break
+            steps += 1
+            # the follower verifies against ITS OWN oracle for whichever
+            # generation the leader says is live — proving job state and
+            # results really did propagate in lockstep
+            expected = exp1 if driver.generation == 1 else exp2
+            # step 2's second window searched a different base; only
+            # windows at `base` are oracle-checked (count matches)
+            if results[0].hashes == count and steps != 2:
+                check(results, expected, f"follower/gen{driver.generation}")
+        assert steps == 5, steps
+        assert driver.generation == 2
+        print(f"OK rank=1 steps={steps}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
