@@ -522,3 +522,42 @@ _algos.mark_implemented("ethash", "full")  # HBM-resident-DAG tier
 # composition is from recall with no offline vector: the switcher and coin
 # aliases must refuse it until one is run (same honesty gate as x11)
 _algos.mark_uncanonical("ethash")
+
+
+def composition_fingerprint() -> str:
+    """Deterministic mini-trace of the full composition (cache build ->
+    dataset derivation -> hashimoto) on a tiny synthetic epoch — the
+    certification fingerprint (utils/certification.py): recomputed at
+    import when an artifact exists, so code drift after certification
+    un-certifies instead of shipping silently-changed rules."""
+    cache = _python_make_cache(149, b"\x5a" * 32)
+    mix, result = hashimoto_light(
+        1021 * MIX_BYTES, cache, b"\xa5" * 32, 0x0123456789ABCDEF
+    )
+    return (mix + result).hex()
+
+
+def _maybe_certify() -> bool:
+    """Flip the canonical gate from the out-of-band artifact written by
+    tools/certify.py after real network vectors passed (same two-layer
+    trust model as kernels.x11._maybe_certify)."""
+    import logging
+
+    from otedama_tpu.utils import certification
+
+    cert = certification.get("ethash")
+    if not cert:
+        return False
+    want = str(cert.get("fingerprint", "")).lower()
+    if want and composition_fingerprint() == want:
+        _algos.mark_canonical("ethash")
+        return True
+    logging.getLogger("otedama.kernels.ethash").warning(
+        "ethash certification artifact present but the composition "
+        "fingerprint no longer matches — the kernel changed since "
+        "certification; keeping canonical=False",
+    )
+    return False
+
+
+_maybe_certify()

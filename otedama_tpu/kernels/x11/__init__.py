@@ -183,3 +183,33 @@ if not missing_stages():
     _algos.mark_implemented("x11", "xla")
     _algos.mark_implemented("x11", "jax")
     _algos.mark_implemented("x11", "pod")  # runtime.mesh.X11PodBackend
+
+
+def _maybe_certify() -> bool:
+    """Flip the canonical gate from the out-of-band certification
+    artifact (tools/certify.py), guarded by a fingerprint RECHECK: the
+    artifact stores the full-chain Dash-genesis digest observed when the
+    real-network vectors passed; we recompute it now so a kernel edited
+    after certification un-certifies itself instead of shipping a
+    drifted chain as canonical (utils/certification.py)."""
+    import logging
+
+    from otedama_tpu.utils import certification
+
+    cert = certification.get("x11")
+    if not cert or missing_stages():
+        return False
+    want = str(cert.get("genesis_hash", "")).lower()
+    got = x11_digest(DASH_GENESIS_HEADER)[::-1].hex()
+    if want and got == want:
+        _algos.mark_canonical("x11")
+        return True
+    logging.getLogger("otedama.kernels.x11").warning(
+        "x11 certification artifact present but the chain fingerprint "
+        "no longer matches (%s != %s) — the kernel changed since "
+        "certification; keeping canonical=False", got[:16], want[:16],
+    )
+    return False
+
+
+_maybe_certify()

@@ -1,0 +1,128 @@
+"""Certification harness (tools/certify.py + utils/certification.py):
+the out-of-band vector flow that flips the x11/ethash canonical gates.
+
+The TRUE network vectors are unobtainable in this offline environment, so
+these tests certify the MACHINERY with self-generated vectors (the chain's
+own digests standing in for network truth): a full pass writes the
+artifact, the kernels' import-time fingerprint recheck flips the gate,
+the coin alias unlocks — and a post-certification implementation drift
+(simulated by a wrong fingerprint) refuses to certify.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import pathlib
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture
+def certify():
+    spec = importlib.util.spec_from_file_location(
+        "certify", REPO / "tools" / "certify.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def cert_env(tmp_path, monkeypatch):
+    path = tmp_path / "certification.json"
+    monkeypatch.setenv("OTEDAMA_CERT_PATH", str(path))
+    yield path
+    # never leak canonical state into other tests
+    from otedama_tpu.engine import algos
+
+    algos.mark_uncanonical("x11")
+    algos.mark_uncanonical("ethash")
+
+
+def test_x11_certify_roundtrip(cert_env, tmp_path, certify, monkeypatch):
+    from otedama_tpu.engine import algos
+    from otedama_tpu.kernels import x11 as x11_mod
+
+    # before: the dash alias refuses (canonical gate down)
+    with pytest.raises(ValueError, match="not certified canonical"):
+        algos.get("dash")
+
+    # the chain's own genesis digest stands in for the network truth
+    genesis = x11_mod.x11_digest(x11_mod.DASH_GENESIS_HEADER)[::-1].hex()
+    vf = tmp_path / "vectors.json"
+    vf.write_text(json.dumps({
+        "dash_genesis_hash": genesis,
+        "shavite512_vectors": [{
+            # 200-byte message: multi-block, nonzero counter — the r3
+            # verdict's weak #4 coverage shape (self-generated)
+            "msg_hex": (bytes(range(200))).hex(),
+            "digest_hex": __import__(
+                "otedama_tpu.kernels.x11.shavite", fromlist=["shavite"]
+            ).shavite512_bytes(bytes(range(200))).hex(),
+        }],
+    }))
+    monkeypatch.setattr(sys, "argv", ["certify.py", str(vf), "--apply"])
+    assert certify.main() == 0
+    assert cert_env.exists()
+    data = json.loads(cert_env.read_text())
+    assert data["x11"]["genesis_hash"] == genesis
+
+    # the import-time gate hook now flips canonical + unlocks the alias
+    assert x11_mod._maybe_certify() is True
+    assert algos.get("dash").name == "x11"
+    assert algos.get("x11").canonical
+
+
+def test_x11_drifted_kernel_refuses(cert_env):
+    """An artifact whose fingerprint no longer matches the code must NOT
+    certify (kernel edited after certification)."""
+    from otedama_tpu.engine import algos
+    from otedama_tpu.kernels import x11 as x11_mod
+    from otedama_tpu.utils import certification
+
+    certification.record("x11", {"genesis_hash": "ab" * 32})
+    assert x11_mod._maybe_certify() is False
+    assert not algos.get("x11").canonical
+
+
+def test_ethash_certify_roundtrip(cert_env, tmp_path, certify, monkeypatch):
+    from otedama_tpu.engine import algos
+    from otedama_tpu.kernels import ethash as eth
+
+    # scaled epoch sizes so the light vector runs in test budget; the
+    # harness derives everything through the same (patched) entry points
+    monkeypatch.setattr(eth, "cache_size", lambda bn: 149 * 64)
+    monkeypatch.setattr(eth, "dataset_size", lambda bn: 1021 * 128)
+    cache = eth.make_cache(eth.cache_size(31), eth.seed_hash(31))
+    header = bytes(range(32))
+    mix, result = eth.hashimoto_light(
+        eth.dataset_size(31), cache, header, 0xDEADBEEF
+    )
+    vf = tmp_path / "vectors.json"
+    vf.write_text(json.dumps({"ethash_vectors": [{
+        "block_number": 31, "header_hash_hex": header.hex(),
+        "nonce": "0xdeadbeef", "mix_hex": mix.hex(),
+        "result_hex": result.hex(),
+    }]}))
+    monkeypatch.setattr(sys, "argv", ["certify.py", str(vf), "--apply"])
+    assert certify.main() == 0
+    data = json.loads(cert_env.read_text())
+    assert data["ethash"]["fingerprint"] == eth.composition_fingerprint()
+
+    assert eth._maybe_certify() is True
+    assert algos.get("ethash").canonical
+
+
+def test_certify_rejects_bad_vectors(cert_env, tmp_path, certify,
+                                     monkeypatch, capsys):
+    vf = tmp_path / "vectors.json"
+    vf.write_text(json.dumps({"dash_genesis_hash": "00" * 32}))
+    monkeypatch.setattr(sys, "argv", ["certify.py", str(vf), "--apply"])
+    assert certify.main() == 1
+    assert not cert_env.exists()  # nothing certified
+    report = json.loads(capsys.readouterr().out)
+    assert report["x11_pass"] is False

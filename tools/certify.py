@@ -1,0 +1,156 @@
+"""One-command certification harness for the gated algorithms (x11, ethash).
+
+This offline environment cannot reach the real networks, so x11 and
+ethash register ``canonical=False`` (engine/algos.py) and the "dash" /
+"etchash" aliases + profit auto-switch refuse them. When an operator CAN
+obtain real vectors, they drop a JSON file here and run:
+
+    python tools/certify.py vectors.json          # check only
+    python tools/certify.py vectors.json --apply  # check + write artifact
+
+On a full pass, ``--apply`` writes ``certification.json``
+(utils/certification.py) and the kernel gates flip at next import —
+after re-verifying an implementation fingerprint, so a post-certification
+kernel edit un-certifies itself.
+
+Vector file format (all sections optional; any failing check in a
+section blocks that algorithm's certification):
+
+{
+  "dash_genesis_hash": "00000ffd...b6",        // display (big-endian) hex
+  "x11_vectors":     [{"header_hex": ..., "hash_hex": ...}],
+  "shavite512_vectors": [{"msg_hex": ..., "digest_hex": ...}],
+  "ethash_vectors":  [{"block_number": N, "header_hash_hex": ...,
+                       "nonce": N-or-hex, "mix_hex": ..., "result_hex": ...}]
+}
+
+x11 certification requires the genesis check (and any extra vectors) to
+pass — the genesis chain exercises every stage including simd512 and
+shavite's nonzero-counter path (all inter-stage messages are 64 bytes,
+so shavite runs with counter=512). The shavite section additionally
+exercises arbitrary lengths (the r3 verdict's weak #4: multi-block /
+nonzero-counter coverage beyond the chain's fixed shape).
+
+Also resolves which of the two conflicting offline recollections of the
+Dash genesis hash (kernels.x11.DASH_GENESIS_ORACLES) was correct.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+
+def check_x11(vectors: dict, report: dict) -> bool:
+    from otedama_tpu.kernels import x11 as x11_mod
+
+    checks = []
+    genesis = vectors.get("dash_genesis_hash")
+    chain_genesis_hex = None
+    if genesis:
+        got = x11_mod.x11_digest(x11_mod.DASH_GENESIS_HEADER)[::-1].hex()
+        chain_genesis_hex = got
+        ok = got == str(genesis).lower()
+        checks.append({"check": "dash_genesis", "ok": ok,
+                       "got": got, "want": genesis})
+        # settle the two-recall conflict for the record
+        for name, val in x11_mod.DASH_GENESIS_ORACLES.items():
+            if val == str(genesis).lower():
+                report["genesis_recall_resolved"] = name
+    for i, v in enumerate(vectors.get("x11_vectors", [])):
+        got = x11_mod.x11_digest(bytes.fromhex(v["header_hex"]))[::-1].hex()
+        checks.append({"check": f"x11_vector[{i}]",
+                       "ok": got == v["hash_hex"].lower(),
+                       "got": got, "want": v["hash_hex"]})
+    for i, v in enumerate(vectors.get("shavite512_vectors", [])):
+        from otedama_tpu.kernels.x11 import shavite
+
+        got = shavite.shavite512_bytes(bytes.fromhex(v["msg_hex"])).hex()
+        checks.append({"check": f"shavite512_vector[{i}]",
+                       "ok": got == v["digest_hex"].lower(),
+                       "got": got, "want": v["digest_hex"]})
+    report["x11_checks"] = checks
+    ran_genesis = any(c["check"] == "dash_genesis" for c in checks)
+    ok = bool(checks) and all(c["ok"] for c in checks) and ran_genesis
+    if ok:
+        report["x11_certifiable"] = {
+            "genesis_hash": str(genesis).lower(),
+            "chain_digest": chain_genesis_hex,
+        }
+    return ok
+
+
+def check_ethash(vectors: dict, report: dict) -> bool:
+    from otedama_tpu.kernels import ethash as eth
+
+    checks = []
+    caches: dict[int, object] = {}
+    for i, v in enumerate(vectors.get("ethash_vectors", [])):
+        bn = int(v["block_number"])
+        epoch = bn // eth.EPOCH_LENGTH
+        if epoch not in caches:
+            caches[epoch] = eth.make_cache(
+                eth.cache_size(bn), eth.seed_hash(bn)
+            )
+        nonce = v["nonce"]
+        nonce = int(nonce, 16) if isinstance(nonce, str) else int(nonce)
+        mix, result = eth.hashimoto_light(
+            eth.dataset_size(bn), caches[epoch],
+            bytes.fromhex(v["header_hash_hex"]), nonce,
+        )
+        ok = (mix.hex() == v["mix_hex"].lower()
+              and result.hex() == v["result_hex"].lower())
+        checks.append({"check": f"ethash_vector[{i}]", "ok": ok,
+                       "got_mix": mix.hex(), "got_result": result.hex(),
+                       "want_mix": v["mix_hex"],
+                       "want_result": v["result_hex"]})
+    report["ethash_checks"] = checks
+    ok = bool(checks) and all(c["ok"] for c in checks)
+    if ok:
+        report["ethash_certifiable"] = {
+            "fingerprint": eth.composition_fingerprint(),
+            "vectors_passed": len(checks),
+        }
+    return ok
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("vectors", help="JSON vector file (see module docstring)")
+    ap.add_argument("--apply", action="store_true",
+                    help="write certification.json on full pass")
+    args = ap.parse_args()
+    vectors = json.loads(pathlib.Path(args.vectors).read_text())
+
+    report: dict = {"vectors_file": args.vectors}
+    x11_ok = check_x11(vectors, report)
+    eth_ok = check_ethash(vectors, report)
+    report["x11_pass"] = x11_ok
+    report["ethash_pass"] = eth_ok
+
+    if args.apply:
+        from otedama_tpu.utils import certification
+
+        applied = []
+        if x11_ok:
+            certification.record("x11", report["x11_certifiable"])
+            applied.append("x11")
+        if eth_ok:
+            certification.record("ethash", report["ethash_certifiable"])
+            applied.append("ethash")
+        report["applied"] = applied
+        report["artifact"] = str(certification.artifact_path())
+
+    print(json.dumps(report, indent=2))
+    # exit 0 iff every section PRESENT in the file passed
+    failed = (("dash_genesis_hash" in vectors or "x11_vectors" in vectors)
+              and not x11_ok) or ("ethash_vectors" in vectors and not eth_ok)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
