@@ -27,6 +27,10 @@ from otedama_tpu.security.ratelimit import RateLimiter
 log = logging.getLogger("otedama.api")
 
 
+class _BadQuery(ValueError):
+    """Malformed query-string parameter (rendered as a 400)."""
+
+
 @dataclasses.dataclass
 class ApiConfig:
     host: str = "127.0.0.1"
@@ -44,6 +48,11 @@ class ApiServer:
         self.system_collector = SystemCollector(self.registry)
         self.providers: dict[str, Callable[[], dict]] = {}
         self.controls: dict[str, Callable] = {}   # name -> async control fn
+        # fn(actor, action, limit) -> list[dict]; the app wires the pool
+        # db's query_audit here (utils.logging_setup.AuditLogger.query is
+        # signature-compatible if a file-based trail is ever configured);
+        # unwired -> /api/v1/logs/audit answers 404
+        self.audit_source: Callable | None = None
         self.auth: AuthManager | None = (
             AuthManager(self.config.auth_secret) if self.config.auth_secret else None
         )
@@ -83,6 +92,11 @@ class ApiServer:
         h.route("GET", "/api/v1/stats/{name}", self._stats_one)
         h.route("GET", "/api/v1/algorithms", self._algorithms)
         h.route("GET", "/api/v1/controls", self._list_controls)
+        # log query surface (reference parity: internal/api/log_routes.go
+        # over internal/logging/analyzer.go)
+        h.route("GET", "/api/v1/logs", self._logs)
+        h.route("GET", "/api/v1/logs/analyze", self._logs_analyze)
+        h.route("GET", "/api/v1/logs/audit", self._logs_audit)
         h.route("GET", "/metrics", self._metrics)
         h.route("POST", "/api/v1/auth/login", self._login)
         h.route("POST", "/api/v1/control/{name}", self._control)
@@ -167,6 +181,100 @@ class ApiServer:
             200, self.registry.render(),
             "text/plain; version=0.0.4; charset=utf-8",
         )
+
+    # -- log query surface ----------------------------------------------------
+
+    def _authorize_logs(self, request: Request) -> Response | None:
+        """Logs and the audit trail carry actor names and operational
+        detail: when auth is configured, they require a ``logs.read``
+        token (operator/admin). With no auth_secret the API is a
+        loopback-default single-user surface and stays open — same
+        posture as /api/v1/status."""
+        if self.auth is None:
+            return None
+        header = request.headers.get("authorization", "")
+        token = header[7:] if header.lower().startswith("bearer ") else ""
+        try:
+            self.auth.authorize(token, "logs.read")
+        except TokenError as e:
+            return Response.error(401, str(e))
+        return None
+
+    @staticmethod
+    def _float_q(request: Request, key: str) -> float | None:
+        raw = request.query.get(key)
+        if raw is None or raw == "":
+            return None
+        try:
+            return float(raw)
+        except ValueError:
+            raise _BadQuery(f"{key} must be a unix timestamp, got {raw!r}")
+
+    async def _logs(self, request: Request) -> Response:
+        """Structured log tail with filters:
+        ?level=warning&component=otedama.stratum&since=<ts>&until=<ts>
+        &q=<substring>&limit=200."""
+        from otedama_tpu.utils.logging_setup import memory_log
+
+        denied = self._authorize_logs(request)
+        if denied is not None:
+            return denied
+        q = request.query
+        try:
+            since = self._float_q(request, "since")
+            until = self._float_q(request, "until")
+            limit = int(q.get("limit", "200"))
+        except (_BadQuery, ValueError) as e:
+            return Response.error(400, str(e))
+        records = memory_log().query(
+            level=q.get("level"),
+            component=q.get("component"),
+            since=since,
+            until=until,
+            contains=q.get("q"),
+            limit=min(max(limit, 1), 2000),
+        )
+        return Response.json({"count": len(records), "logs": records})
+
+    async def _logs_analyze(self, request: Request) -> Response:
+        """Pattern/burst analysis over the in-memory tail
+        (internal/logging/analyzer.go parity)."""
+        from otedama_tpu.utils.logging_setup import LogAnalyzer, memory_log
+
+        denied = self._authorize_logs(request)
+        if denied is not None:
+            return denied
+        records = memory_log().query(limit=4096)
+        lines = (
+            f"x x {e['level']}    {e['component']}: {e['message']}"
+            for e in records
+        )
+        out = LogAnalyzer().analyze(lines)
+        out["window_records"] = len(records)
+        return Response.json(out)
+
+    async def _logs_audit(self, request: Request) -> Response:
+        """Audit-trail query (?actor=&action=&limit=) over the wired
+        audit source (the pool db's audit_log; 404 when no source is
+        wired — miner mode keeps no audit trail)."""
+        denied = self._authorize_logs(request)
+        if denied is not None:
+            return denied
+        if self.audit_source is None:
+            return Response.error(404, "no audit source wired")
+        q = request.query
+        try:
+            limit = min(max(int(q.get("limit", "100")), 1), 2000)
+        except ValueError:
+            return Response.error(400, "limit must be an integer")
+        try:
+            entries = self.audit_source(
+                q.get("actor") or None, q.get("action") or None, limit
+            )
+        except Exception as e:
+            log.exception("audit source failed")
+            return Response.error(500, f"audit source failed: {e}")
+        return Response.json({"count": len(entries), "audit": entries})
 
     async def _login(self, request: Request) -> Response:
         from otedama_tpu.security import validation as val

@@ -361,6 +361,9 @@ class Application:
         if self.p2p is not None:
             self.api.add_provider("p2p", self.p2p.snapshot)
         self.api.add_provider("benchmarks", self.algo_manager.snapshot)
+        if self.db is not None:
+            # /api/v1/logs/audit reads the pool db's audit trail
+            self.api.audit_source = self.db.query_audit
         self._wire_profit()
         await self.api.start()
         self._started.append(self.api)

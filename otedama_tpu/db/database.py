@@ -146,6 +146,25 @@ class Database:
             (actor, action, detail, time.time()),
         )
 
+    def query_audit(self, actor: str | None = None, action: str | None = None,
+                    limit: int = 100) -> list[dict]:
+        """Filtered audit-trail read (newest first) — the /api/v1/logs/audit
+        source (reference parity: internal/api/log_routes.go)."""
+        sql = "SELECT actor, action, detail, created_at FROM audit_log"
+        conds: list[str] = []
+        params: list = []
+        if actor:
+            conds.append("actor = ?")
+            params.append(actor)
+        if action:
+            conds.append("action = ?")
+            params.append(action)
+        if conds:
+            sql += " WHERE " + " AND ".join(conds)
+        sql += " ORDER BY created_at DESC, id DESC LIMIT ?"
+        params.append(int(limit))
+        return [dict(r) for r in self.query(sql, tuple(params))]
+
     def close(self) -> None:
         with self._lock:
             self._conn.close()

@@ -119,9 +119,10 @@ class Role(enum.Enum):
 
 _PERMISSIONS: dict[Role, set[str]] = {
     Role.VIEWER: {"stats.read"},
-    Role.OPERATOR: {"stats.read", "mining.control", "pool.read"},
+    Role.OPERATOR: {"stats.read", "mining.control", "pool.read",
+                    "logs.read"},
     Role.ADMIN: {"stats.read", "mining.control", "pool.read", "pool.admin",
-                 "config.write", "users.manage"},
+                 "config.write", "users.manage", "logs.read"},
 }
 
 

@@ -1,17 +1,22 @@
-"""Logging factory: rotation, module levels, audit trail, log analyzer.
+"""Logging factory: rotation, module levels, audit trail, log analyzer,
+queryable in-memory tail.
 
 Reference parity: internal/logging/config.go:8-70 (zap factory with
 rotation + sampling + per-module levels), audit.go:13 (audit logger),
-analyzer.go:16 (log pattern analyzer). Stdlib logging equivalents.
+analyzer.go:16 (log pattern analyzer), api/log_routes.go (the query
+surface — served here by ``MemoryLogHandler`` + api/server's
+``/api/v1/logs`` routes). Stdlib logging equivalents.
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import json
 import logging
 import logging.handlers
 import re
+import threading
 import time
 from collections import Counter
 
@@ -46,6 +51,85 @@ class _SamplingFilter(logging.Filter):
         return self._counts[key] <= self.after
 
 
+class MemoryLogHandler(logging.Handler):
+    """Bounded in-memory tail of structured records — the data source for
+    the ``/api/v1/logs`` query route (reference parity:
+    internal/api/log_routes.go over internal/logging's buffer). One
+    process-wide instance is installed by ``setup_logging`` and reachable
+    via ``memory_log()``; cost per record is one dict append."""
+
+    def __init__(self, capacity: int = 4096):
+        super().__init__()
+        self._records: collections.deque = collections.deque(maxlen=capacity)
+        self._rlock = threading.Lock()
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            entry = {
+                "ts": record.created,
+                "level": record.levelname,
+                "component": record.name,
+                "message": record.getMessage(),
+            }
+        except Exception:  # a bad %-format must never kill the app
+            entry = {
+                "ts": record.created,
+                "level": record.levelname,
+                "component": record.name,
+                "message": str(record.msg),
+            }
+        with self._rlock:
+            self._records.append(entry)
+
+    def query(
+        self,
+        level: str | None = None,
+        component: str | None = None,
+        since: float | None = None,
+        until: float | None = None,
+        contains: str | None = None,
+        limit: int = 200,
+    ) -> list[dict]:
+        """Newest-last filtered slice. ``level`` is a MINIMUM severity
+        ("warning" returns warnings and errors); ``component`` matches
+        the logger-name prefix ("otedama.stratum" catches its children)."""
+        min_no = (
+            logging.getLevelName(level.upper()) if level else 0
+        )
+        if not isinstance(min_no, int):  # unknown name -> no level filter
+            min_no = 0
+        needle = contains.lower() if contains else None
+        with self._rlock:
+            records = list(self._records)
+        out = []
+        for e in records:
+            if logging.getLevelName(e["level"]) < min_no:
+                continue
+            if component and not e["component"].startswith(component):
+                continue
+            if since is not None and e["ts"] < since:
+                continue
+            if until is not None and e["ts"] > until:
+                continue
+            if needle and needle not in e["message"].lower():
+                continue
+            out.append(e)
+        return out[-max(limit, 0):]
+
+
+_MEMORY_HANDLER: MemoryLogHandler | None = None
+
+
+def memory_log() -> MemoryLogHandler:
+    """The process-wide log tail (installed on the root logger on first
+    use, so the query API works even before ``setup_logging`` ran)."""
+    global _MEMORY_HANDLER
+    if _MEMORY_HANDLER is None:
+        _MEMORY_HANDLER = MemoryLogHandler()
+        logging.getLogger().addHandler(_MEMORY_HANDLER)
+    return _MEMORY_HANDLER
+
+
 def setup_logging(config: LogConfig | None = None) -> logging.Logger:
     config = config or LogConfig()
     root = logging.getLogger()
@@ -63,6 +147,7 @@ def setup_logging(config: LogConfig | None = None) -> logging.Logger:
         if config.sample_after > 0:
             h.addFilter(_SamplingFilter(config.sample_after, config.sample_interval))
         root.addHandler(h)
+    memory_log()  # queryable tail rides along unconditionally
     for module, level in config.module_levels.items():
         logging.getLogger(module).setLevel(
             getattr(logging, str(level).upper(), logging.INFO)

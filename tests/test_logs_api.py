@@ -1,0 +1,165 @@
+"""Log query surface: /api/v1/logs (+ /analyze, /audit) over the
+in-memory structured tail and the db audit trail — the
+internal/logging/analyzer.go + internal/api/log_routes.go parity gap
+(VERDICT r3 missing #7)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+import urllib.request
+
+import pytest
+
+from otedama_tpu.api.server import ApiConfig, ApiServer
+from otedama_tpu.utils.logging_setup import MemoryLogHandler, memory_log
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=5.0) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def test_memory_log_query_filters():
+    h = MemoryLogHandler(capacity=8)
+    lg = logging.getLogger("otedama.test.memlog")
+    lg.setLevel(logging.DEBUG)
+    lg.addHandler(h)
+    try:
+        t0 = time.time()
+        lg.info("hello %d", 1)
+        lg.warning("trouble brewing")
+        logging.getLogger("otedama.test.memlog.child").error(
+            "exploded", exc_info=False)
+        # minimum-severity semantics: warning+ returns warning AND error
+        assert [e["level"] for e in h.query(level="warning")] == \
+            ["WARNING", "ERROR"]
+        # component prefix catches children
+        assert len(h.query(component="otedama.test.memlog")) == 3
+        assert len(h.query(component="otedama.test.memlog.child")) == 1
+        assert h.query(contains="HELLO")[0]["message"] == "hello 1"
+        assert h.query(since=t0 - 1, until=time.time() + 1, limit=2)
+        # capacity bound: the ring never grows past maxlen
+        for i in range(20):
+            lg.info("flood %d", i)
+        assert len(h.query(limit=1000)) == 8
+    finally:
+        lg.removeHandler(h)
+
+
+@pytest.mark.asyncio
+async def test_logs_api_end_to_end():
+    api = ApiServer(ApiConfig(port=0))
+    audit_rows = [
+        {"actor": "admin", "action": "switch", "detail": "x11",
+         "created_at": 1.0},
+        {"actor": "eve", "action": "login", "detail": "", "created_at": 2.0},
+    ]
+    api.audit_source = lambda actor, action, limit: [
+        r for r in audit_rows
+        if (not actor or r["actor"] == actor)
+        and (not action or r["action"] == action)
+    ][:limit]
+    await api.start()
+    base = f"http://127.0.0.1:{api.port}"
+    loop = asyncio.get_running_loop()
+
+    marker = f"logsapi-{time.time_ns()}"
+    memory_log()  # ensure the tail is installed on the root logger
+    logging.getLogger("otedama.test.api").warning("wobble %s", marker)
+    other = logging.getLogger("otedama.other")
+    other.setLevel(logging.INFO)  # root defaults to WARNING in bare tests
+    other.info("calm %s", marker)
+
+    status, obj = await loop.run_in_executor(
+        None, _get, f"{base}/api/v1/logs?q={marker}")
+    assert status == 200 and obj["count"] == 2
+
+    status, obj = await loop.run_in_executor(
+        None, _get,
+        f"{base}/api/v1/logs?level=warning&component=otedama.test&q={marker}",
+    )
+    assert status == 200 and obj["count"] == 1
+    assert obj["logs"][0]["message"] == f"wobble {marker}"
+    assert obj["logs"][0]["level"] == "WARNING"
+
+    status, obj = await loop.run_in_executor(
+        None, _get, f"{base}/api/v1/logs?since=not-a-ts")
+    assert status == 400
+
+    status, obj = await loop.run_in_executor(
+        None, _get, f"{base}/api/v1/logs/analyze")
+    assert status == 200
+    assert obj["window_records"] >= 2
+    assert "WARNING" in obj["levels"]
+
+    status, obj = await loop.run_in_executor(
+        None, _get, f"{base}/api/v1/logs/audit?actor=admin")
+    assert status == 200 and obj["count"] == 1
+    assert obj["audit"][0]["action"] == "switch"
+    await api.stop()
+
+
+@pytest.mark.asyncio
+async def test_logs_require_auth_when_configured():
+    """Logs/audit expose actor names and operational detail: with an
+    auth_secret set they demand a logs.read token (code-review r4)."""
+    from otedama_tpu.security.auth import Role
+
+    api = ApiServer(ApiConfig(port=0, auth_secret="s3cret"))
+    api.auth.add_user("op", "pw", Role.OPERATOR)
+    api.audit_source = lambda actor, action, limit: []
+    await api.start()
+    base = f"http://127.0.0.1:{api.port}"
+    loop = asyncio.get_running_loop()
+
+    for path in ("/api/v1/logs", "/api/v1/logs/analyze",
+                 "/api/v1/logs/audit"):
+        status, _ = await loop.run_in_executor(None, _get, f"{base}{path}")
+        assert status == 401, path
+
+    token = api.auth.login("op", "pw")
+
+    def _get_auth(url):
+        req = urllib.request.Request(
+            url, headers={"Authorization": f"Bearer {token}"})
+        with urllib.request.urlopen(req, timeout=5.0) as resp:
+            return resp.status, json.loads(resp.read())
+
+    status, obj = await loop.run_in_executor(
+        None, _get_auth, f"{base}/api/v1/logs?limit=5")
+    assert status == 200 and "logs" in obj
+    status, _ = await loop.run_in_executor(
+        None, _get_auth, f"{base}/api/v1/logs/audit")
+    assert status == 200
+    await api.stop()
+
+
+@pytest.mark.asyncio
+async def test_logs_audit_404_when_unwired():
+    api = ApiServer(ApiConfig(port=0))
+    await api.start()
+    loop = asyncio.get_running_loop()
+    status, _ = await loop.run_in_executor(
+        None, _get, f"http://127.0.0.1:{api.port}/api/v1/logs/audit")
+    assert status == 404
+    await api.stop()
+
+
+def test_db_query_audit(tmp_path):
+    from otedama_tpu.db.database import Database
+
+    db = Database(str(tmp_path / "t.db"))
+    db.audit("admin", "switch", "x11")
+    db.audit("admin", "backup", "daily")
+    db.audit("eve", "login", "")
+    rows = db.query_audit()
+    assert [r["actor"] for r in rows] == ["eve", "admin", "admin"]  # newest first
+    assert db.query_audit(actor="admin", limit=1)[0]["action"] == "backup"
+    assert db.query_audit(action="login")[0]["actor"] == "eve"
+    db.close()
