@@ -142,14 +142,14 @@ class Application:
         ))
 
     async def _start_pool_side(self) -> None:
-        from otedama_tpu.db import Database
+        from otedama_tpu.db import connect_database
         from otedama_tpu.pool.blockchain import BitcoinRPCClient, MockChainClient
         from otedama_tpu.pool.manager import PoolConfig, PoolManager
         from otedama_tpu.pool.payouts import PayoutConfig, PayoutScheme
         from otedama_tpu.stratum.server import ServerConfig, StratumServer
 
         cfg = self.config
-        self.db = Database(cfg.pool.database)
+        self.db = connect_database(cfg.pool.database)
         chain = (
             BitcoinRPCClient(cfg.pool.chain_rpc_url, cfg.pool.chain_rpc_user,
                              cfg.pool.chain_rpc_password)

@@ -120,6 +120,7 @@ class PoolSettings:
     pplns_window: int = 10000
     fee_percent: float = 1.0
     minimum_payout: int = 100_000
+    # SQLite path, or a postgres://user:pw@host/db DSN (db.postgres)
     database: str = "otedama.db"
     chain_rpc_url: str = ""
     chain_rpc_user: str = ""

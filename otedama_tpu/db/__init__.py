@@ -1,4 +1,4 @@
-from otedama_tpu.db.database import Database
+from otedama_tpu.db.database import Database, connect_database
 from otedama_tpu.db.repos import (
     BlockRepository,
     PayoutRepository,
@@ -8,6 +8,7 @@ from otedama_tpu.db.repos import (
 
 __all__ = [
     "Database",
+    "connect_database",
     "WorkerRepository",
     "ShareRepository",
     "BlockRepository",

@@ -1,11 +1,13 @@
-"""SQLite persistence manager.
+"""SQLite persistence manager (+ the backend-selection factory).
 
 Reference parity: internal/database/{manager.go,connection_pool.go,migrate.go}
 — connection management, migrations, repositories over SQLite/Postgres.
 Python-native redesign: stdlib sqlite3 in WAL mode with a single writer
 thread affinity (sqlite serializes writers anyway; the reference's
 100-connection pool buys nothing on SQLite), versioned migrations applied
-transactionally, ``:memory:`` supported for tests.
+transactionally, ``:memory:`` supported for tests. ``connect_database``
+routes ``postgres://`` URLs to the PostgreSQL backend (db.postgres,
+driver-gated) behind the identical surface.
 """
 
 from __future__ import annotations
@@ -77,7 +79,40 @@ MIGRATIONS: list[tuple[int, str]] = [
 ]
 
 
-class Database:
+class AuditMixin:
+    """Audit-trail read/write over the shared execute/query surface —
+    ONE definition for both backends (each translates placeholders in
+    its own execute/query), so the /api/v1/logs/audit behavior cannot
+    drift between SQLite and Postgres deployments."""
+
+    def audit(self, actor: str, action: str, detail: str = "") -> None:
+        self.execute(
+            "INSERT INTO audit_log (actor, action, detail, created_at) "
+            "VALUES (?,?,?,?)",
+            (actor, action, detail, time.time()),
+        )
+
+    def query_audit(self, actor: str | None = None, action: str | None = None,
+                    limit: int = 100) -> list[dict]:
+        """Filtered audit-trail read (newest first) — the /api/v1/logs/audit
+        source (reference parity: internal/api/log_routes.go)."""
+        sql = "SELECT actor, action, detail, created_at FROM audit_log"
+        conds: list[str] = []
+        params: list = []
+        if actor:
+            conds.append("actor = ?")
+            params.append(actor)
+        if action:
+            conds.append("action = ?")
+            params.append(action)
+        if conds:
+            sql += " WHERE " + " AND ".join(conds)
+        sql += " ORDER BY created_at DESC, id DESC LIMIT ?"
+        params.append(int(limit))
+        return [dict(r) for r in self.query(sql, tuple(params))]
+
+
+class Database(AuditMixin):
     """Thread-safe sqlite3 wrapper with schema migrations."""
 
     def __init__(self, path: str = ":memory:"):
@@ -140,31 +175,6 @@ class Database:
     def transaction(self):
         return _Transaction(self)
 
-    def audit(self, actor: str, action: str, detail: str = "") -> None:
-        self.execute(
-            "INSERT INTO audit_log (actor, action, detail, created_at) VALUES (?,?,?,?)",
-            (actor, action, detail, time.time()),
-        )
-
-    def query_audit(self, actor: str | None = None, action: str | None = None,
-                    limit: int = 100) -> list[dict]:
-        """Filtered audit-trail read (newest first) — the /api/v1/logs/audit
-        source (reference parity: internal/api/log_routes.go)."""
-        sql = "SELECT actor, action, detail, created_at FROM audit_log"
-        conds: list[str] = []
-        params: list = []
-        if actor:
-            conds.append("actor = ?")
-            params.append(actor)
-        if action:
-            conds.append("action = ?")
-            params.append(action)
-        if conds:
-            sql += " WHERE " + " AND ".join(conds)
-        sql += " ORDER BY created_at DESC, id DESC LIMIT ?"
-        params.append(int(limit))
-        return [dict(r) for r in self.query(sql, tuple(params))]
-
     def close(self) -> None:
         with self._lock:
             self._conn.close()
@@ -188,3 +198,28 @@ class _Transaction:
         finally:
             self.db._lock.release()
         return False
+
+
+def connect_database(url: str):
+    """Backend selection by URL: ``postgres://`` / ``postgresql://`` DSNs
+    get the PostgreSQL backend (db.postgres — driver-gated with a clear
+    install hint); ``sqlite:///path`` and bare paths (including
+    ``:memory:``) get SQLite. Any OTHER ``scheme://`` fails loudly — a
+    typo'd or unsupported DSN must not silently become a throwaway
+    SQLite file named after the URL. Reference parity:
+    internal/database/manager.go's driver switch."""
+    if "://" in url:
+        scheme = url.split("://", 1)[0].lower()
+        if scheme in ("postgres", "postgresql"):
+            from otedama_tpu.db.postgres import PostgresDatabase
+
+            return PostgresDatabase(url)
+        if scheme == "sqlite":
+            # sqlite:///absolute/path or sqlite://relative/path
+            path = url.split("://", 1)[1]
+            return Database(path or ":memory:")
+        raise ValueError(
+            f"unsupported database scheme {scheme!r} in {url!r} "
+            "(supported: a sqlite path, sqlite://, postgres://)"
+        )
+    return Database(url)

