@@ -1,0 +1,266 @@
+"""Systematic enumeration of the REMAINING simd512 mechanism space.
+
+Rounds 2-3 swept twist/multiplier/pairing/padding variants against the
+Dash-genesis chain oracle (tools/simd_search.py) and IV regeneration
+(tools/simd_iv_search.py) — both negative. The r3 verdict names the
+unexplored axes: **FFT output ordering**, the W-group table, and the IV.
+This harness enumerates the FFT-ordering axis (the sph-style recursive
+FFT emits its output in revbin-flavored orders, which a natural-order
+matrix NTT must permute to match) CROSSED with every previously-swept
+axis — a permutation changes every digest, so the old sweeps only ever
+covered the identity ordering.
+
+Every candidate is expressed as a STATIC expansion table and driven
+through the package's own step ladder (kernels/x11/simd._compress via
+its expand_fn hook): window pairings with second-visit swaps are
+step-static because WSP assigns each step a distinct W group, so the
+(lo, hi, multiplier) triple for every W slot is known up front. Two
+oracles per candidate:
+
+- chain: x11(Dash genesis header) against BOTH recalled genesis hashes
+  (kernels/x11.DASH_GENESIS_ORACLES — a match is a FINALIST, not a
+  certification; see that module's docstring);
+- IV regeneration: compress(zero, seed-block) against the recalled
+  IV512 table, counting per-word matches (any nonzero count is beyond
+  chance and localizes the divergence).
+
+Writes a machine-readable coverage artifact (SIMD_ENUM_r04.json) so the
+next round extends the enumeration instead of re-sweeping it.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import pathlib
+import struct
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+from otedama_tpu.kernels.x11 import (  # noqa: E402
+    DASH_GENESIS_HEADER,
+    DASH_GENESIS_ORACLES,
+    ORDER,
+    STAGES_BYTES,
+)
+from otedama_tpu.kernels.x11 import simd as simd_mod  # noqa: E402
+
+P = 257
+MASK32 = 0xFFFFFFFF
+
+YOFF_N = np.array([pow(163, k, P) for k in range(256)], dtype=np.int64)
+YOFF_F = np.array([(2 * pow(233, k, P)) % P for k in range(256)],
+                  dtype=np.int64)
+
+
+# -- axis: FFT output orderings ----------------------------------------------
+
+def _revbin(i: int, bits: int) -> int:
+    out = 0
+    for _ in range(bits):
+        out = (out << 1) | (i & 1)
+        i >>= 1
+    return out
+
+
+def _perms() -> dict[str, np.ndarray]:
+    idx = np.arange(256)
+    return {
+        # natural order (what the matrix NTT emits; the axes already swept)
+        "id": idx,
+        # full 8-bit bit-reversal (radix-2 DIT FFT output order)
+        "revbin8": np.array([_revbin(i, 8) for i in range(256)]),
+        # halves preserved, 7-bit reversal inside each (split-radix /
+        # half-size recursion: final twist separates halves first)
+        "revbin7h": np.array(
+            [(i & 0x80) | _revbin(i & 0x7F, 7) for i in range(256)]
+        ),
+        # radix-16 outer natural, 4-bit reversal inside each 16-group
+        "revbin4g": np.array(
+            [(i & 0xF0) | _revbin(i & 0x0F, 4) for i in range(256)]
+        ),
+    }
+
+
+# -- axis: pairing (lo, hi) index schemes per W slot -------------------------
+
+def _pair_indices(pair: str) -> list[tuple[int, int, bool]]:
+    """For each step t (0..31): (window-base info resolved statically).
+    Returns per-step lists of 8 (lo, hi) q-index pairs."""
+    out = []
+    seen: dict[int, bool] = {}
+    for t in range(32):
+        g = simd_mod.WSP[t]
+        pairs = []
+        if pair == "k128":
+            for j in range(8):
+                k = g * 8 + j
+                pairs.append((k % 256, (k + 128) % 256))
+        elif pair == "2k":
+            for j in range(8):
+                k = (g * 8 + j) % 128
+                pairs.append((2 * k, 2 * k + 1))
+        else:  # window modes: 16 q-values per window, visited twice
+            sb = g % 16
+            w = 16 * sb
+            second = seen.get(sb, False)
+            seen[sb] = True
+            swap = second and not pair.endswith("-ns")
+            for j in range(8):
+                if pair.startswith("win-even"):
+                    lo, hi = w + 2 * j, w + 2 * j + 1
+                else:  # win-half
+                    lo, hi = w + j, w + 8 + j
+                if swap:
+                    lo, hi = hi, lo
+                pairs.append((lo, hi))
+        out.append(pairs)
+    return out
+
+
+# -- axis: 16-bit lift multiplier schedules ----------------------------------
+
+def _mult(msched: str, rnd: int, final: bool) -> int:
+    if msched == "none":
+        return 1
+    if msched == "185":
+        return 185
+    if msched == "185/233-final":
+        return 233 if final else 185
+    # "r01-185-r23-233": the sph_simd W macros' per-round constants
+    return 185 if rnd < 2 else 233
+
+
+def make_expand_fn(perm: np.ndarray, twist: str, msched: str, pair: str):
+    pair_idx = _pair_indices(pair)
+
+    def expand_fn(block_rows: np.ndarray, final: bool) -> np.ndarray:
+        x = np.zeros(256, dtype=np.int64)
+        x[:128] = np.asarray(block_rows)[0]
+        y = (x @ simd_mod._ntt_matrix().T) % P
+        y = y[perm]
+        yoff = YOFF_F if final else YOFF_N
+        s = (y + yoff) % P if twist == "add" else (y * yoff) % P
+        s = np.where(s > 128, s - P, s)
+        W = np.zeros(256, dtype=np.uint32)
+        for t in range(32):
+            m = _mult(msched, t // 8, final)
+            base = simd_mod.WSP[t] * 8
+            for j, (lo, hi) in enumerate(pair_idx[t]):
+                W[base + j] = (
+                    (int(s[lo]) * m & 0xFFFF)
+                    | ((int(s[hi]) * m & 0xFFFF) << 16)
+                ) & MASK32
+        return W[None, :]
+
+    return expand_fn
+
+
+def simd512_variant(data: bytes, expand_fn, pad80: bool) -> bytes:
+    n = len(data)
+    n_blocks = max(1, (n + 127) // 128)
+    padded = bytearray(n_blocks * 128)
+    padded[:n] = data
+    if pad80 and n % 128 != 0:
+        padded[n] = 0x80
+    state = [np.full(1, np.uint32(v), dtype=np.uint32)
+             for v in simd_mod.IV512]
+    for b in range(n_blocks):
+        blk = np.frombuffer(bytes(padded[b * 128:(b + 1) * 128]), np.uint8)
+        state = simd_mod._compress(state, blk[None, :], False,
+                                   expand_fn=expand_fn)
+    lb = bytearray(128)
+    lb[:8] = struct.pack("<Q", n * 8)
+    state = simd_mod._compress(
+        state, np.frombuffer(bytes(lb), np.uint8)[None, :], True,
+        expand_fn=expand_fn,
+    )
+    return b"".join(struct.pack("<I", int(state[i][0])) for i in range(16))
+
+
+def iv_match_count(expand_fn) -> int:
+    """IV oracle: compress(zero-state, b"SIMD-512" block) vs the recalled
+    IV512 — per-word match count (any nonzero is a signal)."""
+    blk = np.zeros(128, dtype=np.uint8)
+    blk[:8] = np.frombuffer(b"SIMD-512", dtype=np.uint8)
+    zero = [np.zeros(1, dtype=np.uint32) for _ in range(32)]
+    best = 0
+    for final in (False, True):
+        out = simd_mod._compress(zero, blk[None, :], final,
+                                 expand_fn=expand_fn)
+        got = [int(w[0]) for w in out]
+        best = max(best, sum(1 for a, b in zip(got, simd_mod.IV512)
+                             if a == b))
+    return best
+
+
+def main() -> None:
+    # the simd input on the genesis chain is fixed by the 9 certified
+    # stages before it — compute the prefix once
+    prefix = DASH_GENESIS_HEADER
+    for name in ORDER[:ORDER.index("simd512")]:
+        prefix = STAGES_BYTES[name](prefix)
+    echo = STAGES_BYTES["echo512"]
+    oracles = {k: v for k, v in DASH_GENESIS_ORACLES.items()}
+
+    perms = _perms()
+    axes = {
+        "perm": list(perms),
+        "twist": ["mul", "add"],
+        "msched": ["none", "185", "185/233-final", "r01-185-r23-233"],
+        "pair": ["k128", "2k", "win-even", "win-even-ns",
+                 "win-half", "win-half-ns"],
+        "pad80": [False, True],
+    }
+    combos = list(itertools.product(*axes.values()))
+    t0 = time.monotonic()
+    finalists = []
+    best_iv = (0, None)
+    for i, (pname, twist, msched, pair, pad80) in enumerate(combos):
+        fn = make_expand_fn(perms[pname], twist, msched, pair)
+        digest = echo(simd512_variant(prefix, fn, pad80))[:32][::-1].hex()
+        tag = dict(perm=pname, twist=twist, msched=msched, pair=pair,
+                   pad80=pad80)
+        for oname, oval in oracles.items():
+            if digest == oval:
+                finalists.append({"oracle": oname, **tag})
+                print(f"*** FINALIST [{oname}] {tag} — needs out-of-band "
+                      "genesis-hash confirmation")
+        # IV oracle only where the identity axes were never swept (a
+        # permuted ordering), or on the new multiplier schedule
+        if pname != "id" or msched == "r01-185-r23-233":
+            n = iv_match_count(fn)
+            if n > best_iv[0]:
+                best_iv = (n, tag)
+            if n:
+                print(f"!!! IV signal {n}/32 at {tag}")
+        if (i + 1) % 64 == 0:
+            print(f"  {i + 1}/{len(combos)} ({time.monotonic() - t0:.0f}s)")
+
+    artifact = {
+        "round": 4,
+        "axes": {k: [str(v) for v in vs] for k, vs in axes.items()},
+        "combos_evaluated": len(combos),
+        "finalists": finalists,
+        "best_iv_partial": {"words": best_iv[0], "at": best_iv[1]},
+        "negative_space_note": (
+            "W-group table (WSP) permutations and full IV candidates "
+            "remain un-enumerated: both are unbounded without an "
+            "authoritative reference; the decisive unblock stays one "
+            "copy of the SIMD submission or its KAT file "
+            "(tools/certify.py applies it in minutes)."
+        ),
+        "seconds": round(time.monotonic() - t0, 1),
+    }
+    out = pathlib.Path(__file__).resolve().parents[1] / "SIMD_ENUM_r04.json"
+    out.write_text(json.dumps(artifact, indent=2) + "\n")
+    print(f"{len(finalists)} finalist(s); best IV partial "
+          f"{best_iv[0]}/32; wrote {out.name}")
+
+
+if __name__ == "__main__":
+    main()
