@@ -52,6 +52,48 @@ U32 = jnp.uint32
 U64 = jnp.uint64
 
 
+def _default_sbox_mode() -> str:
+    """"compute" (gather-free bitplane AES, kernels/x11/aes_bitslice) on
+    TPU — the 256-entry byte-table gathers are what made the device
+    chain gather-bound there (VERDICT r3 weak #2) — "table" elsewhere
+    (CPU L1 makes the gather form faster). ``OTEDAMA_X11_SBOX`` pins
+    either form for A/B measurement (resolved BEFORE the jit boundary —
+    x11_digest_device — so each pin is its own compiled program, never a
+    stale cache hit)."""
+    import os
+
+    pinned = os.environ.get("OTEDAMA_X11_SBOX", "").strip().lower()
+    if pinned in ("table", "compute"):
+        return pinned
+    if pinned:
+        import logging
+
+        logging.getLogger("otedama.kernels.x11").warning(
+            "unrecognized OTEDAMA_X11_SBOX=%r (want table|compute); "
+            "using the platform default", pinned,
+        )
+    from otedama_tpu.utils.platform_probe import safe_default_backend
+
+    return "compute" if safe_default_backend() == "tpu" else "table"
+
+
+def _resolve_sbox(sbox_mode: str | None):
+    """(sbox_fn, mul_fns) for the requested mode; the compute forms are
+    exhaustively certified against the tables on first use."""
+    mode = sbox_mode or _default_sbox_mode()
+    if mode == "compute":
+        from otedama_tpu.kernels.x11 import aes_bitslice as ab
+
+        ab.certified()
+        return ab.sbox_bytes, ab.MULS
+    sbox, gf = _groestl_tables()
+    return (
+        lambda x: jnp.take(sbox, x),
+        {m: (lambda x, _t=gf[m]: jnp.take(_t, x)) if m != 1 else (lambda x: x)
+         for m in (1, 2, 3, 4, 5, 7)},
+    )
+
+
 # -- byte <-> word helpers (static shapes, no .view tricks) -------------------
 
 def _bytes_to_words(b, width: int, endian: str):
@@ -184,9 +226,9 @@ def _groestl_tables():
     return groestl.aes_sbox(), groestl._gf_tables()
 
 
-def _groestl_permute(state, variant: str):
+def _groestl_permute(state, variant: str, sbox_mode: str | None = None):
     """P1024/Q1024 over [B, 8, 16] uint8 via a 14-round scan."""
-    sbox, gf = _groestl_tables()
+    sbox_fn, muls = _resolve_sbox(sbox_mode)
     shifts = groestl._SHIFT_P if variant == "P" else groestl._SHIFT_Q
     cols = jnp.arange(16, dtype=U8) << U8(4)
     rounds = jnp.arange(14, dtype=U8)
@@ -197,7 +239,7 @@ def _groestl_permute(state, variant: str):
         else:
             st = st ^ U8(0xFF)
             st = st.at[:, 7, :].set(st[:, 7, :] ^ cols ^ r)
-        st = jnp.take(sbox, st)
+        st = sbox_fn(st)
         st = jnp.stack(
             [jnp.roll(st[:, i, :], -shifts[i], axis=-1) for i in range(8)],
             axis=1,
@@ -205,14 +247,14 @@ def _groestl_permute(state, variant: str):
         out = jnp.zeros_like(st)
         for m, mult in enumerate(groestl._MIX):
             rolled = jnp.roll(st, -m, axis=1)
-            out = out ^ (jnp.take(gf[mult], rolled) if mult != 1 else rolled)
+            out = out ^ muls[mult](rolled)
         return out, None
 
     state, _ = lax.scan(body, state, rounds)
     return state
 
 
-def groestl512_64(data):
+def groestl512_64(data, sbox_mode: str | None = None):
     Bn = data.shape[0]
     pad = _const_rows(bytes([0x80] + [0] * 55 + list((1).to_bytes(8, "big"))))
     block = jnp.concatenate(
@@ -220,8 +262,9 @@ def groestl512_64(data):
     )
     M = block.reshape(Bn, 16, 8).transpose(0, 2, 1)
     H = jnp.zeros((Bn, 8, 16), dtype=U8).at[:, 6, 15].set(U8(0x02))
-    H = _groestl_permute(H ^ M, "P") ^ _groestl_permute(M, "Q") ^ H
-    out = _groestl_permute(H, "P") ^ H
+    H = (_groestl_permute(H ^ M, "P", sbox_mode)
+         ^ _groestl_permute(M, "Q", sbox_mode) ^ H)
+    out = _groestl_permute(H, "P", sbox_mode) ^ H
     return out.transpose(0, 2, 1).reshape(Bn, 128)[:, 64:]
 
 
@@ -483,18 +526,20 @@ def _aes_tables():
     return groestl.aes_sbox(), gf[2], gf[3], echo._AES_SHIFT
 
 
-def _aes_round_j(w, key):
+def _aes_round_j(w, key, sbox_mode: str | None = None):
     """One AES round on [B, 16] byte states (column-major); key [..., 16]."""
-    sbox, m2, m3, shift = _aes_tables()
-    s = jnp.take(sbox, w)[:, shift]
+    _, _, _, shift = _aes_tables()
+    sbox_fn, muls = _resolve_sbox(sbox_mode)
+    m2f, m3f = muls[2], muls[3]
+    s = sbox_fn(w)[:, shift]
     a = s.reshape(s.shape[0], 4, 4)  # [B, col, row]
     a0, a1, a2, a3 = a[:, :, 0], a[:, :, 1], a[:, :, 2], a[:, :, 3]
     out = jnp.stack(
         [
-            jnp.take(m2, a0) ^ jnp.take(m3, a1) ^ a2 ^ a3,
-            a0 ^ jnp.take(m2, a1) ^ jnp.take(m3, a2) ^ a3,
-            a0 ^ a1 ^ jnp.take(m2, a2) ^ jnp.take(m3, a3),
-            jnp.take(m3, a0) ^ a1 ^ a2 ^ jnp.take(m2, a3),
+            m2f(a0) ^ m3f(a1) ^ a2 ^ a3,
+            a0 ^ m2f(a1) ^ m3f(a2) ^ a3,
+            a0 ^ a1 ^ m2f(a2) ^ m3f(a3),
+            m3f(a0) ^ a1 ^ a2 ^ m2f(a3),
         ],
         axis=-1,
     ).reshape(w.shape)
@@ -503,18 +548,19 @@ def _aes_round_j(w, key):
 
 # -- shavite512 ---------------------------------------------------------------
 
-def _aes0_words_j(w4):
+def _aes0_words_j(w4, sbox_mode: str | None = None):
     """Keyless AES round over [B, 4] u32 LE quadruple."""
     return _bytes_to_words(
         _aes_round_j(
-            _words_to_bytes(w4, 4, "little"), jnp.zeros(16, dtype=U8)
+            _words_to_bytes(w4, 4, "little"), jnp.zeros(16, dtype=U8),
+            sbox_mode,
         ),
         4,
         "little",
     )
 
 
-def shavite512_64(data):
+def shavite512_64(data, sbox_mode: str | None = None):
     Bn = data.shape[0]
     tail = _const_rows(bytes(
         [0x80] + [0] * 45 + list((512).to_bytes(16, "little"))
@@ -534,7 +580,7 @@ def shavite512_64(data):
                 x4 = jnp.stack(
                     [rk[u - 31], rk[u - 30], rk[u - 29], rk[u - 32]], axis=1
                 )
-                x4 = _aes0_words_j(x4)
+                x4 = _aes0_words_j(x4, sbox_mode)
                 for j in range(4):
                     rk.append(x4[:, j] ^ rk[u - 4 + j])
                 order = shavite._CNT_INJECT.get(u)
@@ -560,9 +606,9 @@ def shavite512_64(data):
     def f4(x4, keys):
         t = x4 ^ keys[:, 0:4]
         for r in range(1, 4):
-            t = _aes0_words_j(t)
+            t = _aes0_words_j(t, sbox_mode)
             t = t ^ keys[:, 4 * r : 4 * r + 4]
-        return _aes0_words_j(t)
+        return _aes0_words_j(t, sbox_mode)
 
     def round_body(p, k):
         # quarters p0..p3 = columns [0:4],[4:8],[8:12],[12:16]
@@ -710,7 +756,7 @@ def _echo_keys():
     return keys, np.asarray(echo._BIG_SHIFT)
 
 
-def echo512_64(data):
+def echo512_64(data, sbox_mode: str | None = None):
     Bn = data.shape[0]
     pad = _const_rows(bytes(
         [0x80] + [0] * 45 + list((512).to_bytes(2, "little"))
@@ -723,24 +769,28 @@ def echo512_64(data):
     V = jnp.broadcast_to(iv_word, (Bn, 8, 16))
     state = jnp.concatenate([V, M], axis=1)  # [B, 16, 16]
     keys, big_shift = _echo_keys()
-    _, m2, m3, _ = _aes_tables()
+    sbox_fn, muls = _resolve_sbox(sbox_mode)
+    m2f, m3f = muls[2], muls[3]
     zero_key = jnp.zeros(16, dtype=U8)
 
     def round_body(st, kround):
-        words = []
-        for i in range(16):
-            w = _aes_round_j(st[:, i, :], kround[i])
-            words.append(_aes_round_j(w, zero_key))
-        st = jnp.stack(words, axis=1)[:, big_shift, :]
+        # SubBytes+MixColumns for all 16 big-words in ONE call (the
+        # compute-form S-box amortizes its circuit across every lane)
+        flat = st.reshape(Bn * 16, 16)
+        krows = jnp.broadcast_to(kround[None], (Bn, 16, 16)).reshape(
+            Bn * 16, 16)
+        w = _aes_round_j(flat, krows, sbox_mode)
+        w = _aes_round_j(w, jnp.zeros(16, dtype=U8), sbox_mode)
+        st = w.reshape(Bn, 16, 16)[:, big_shift, :]
         cols = st.reshape(st.shape[0], 4, 4, 16)
         a0, a1 = cols[:, :, 0], cols[:, :, 1]
         a2, a3 = cols[:, :, 2], cols[:, :, 3]
         st = jnp.stack(
             [
-                jnp.take(m2, a0) ^ jnp.take(m3, a1) ^ a2 ^ a3,
-                a0 ^ jnp.take(m2, a1) ^ jnp.take(m3, a2) ^ a3,
-                a0 ^ a1 ^ jnp.take(m2, a2) ^ jnp.take(m3, a3),
-                jnp.take(m3, a0) ^ a1 ^ a2 ^ jnp.take(m2, a3),
+                m2f(a0) ^ m3f(a1) ^ a2 ^ a3,
+                a0 ^ m2f(a1) ^ m3f(a2) ^ a3,
+                a0 ^ a1 ^ m2f(a2) ^ m3f(a3),
+                m3f(a0) ^ a1 ^ a2 ^ m2f(a3),
             ],
             axis=2,
         ).reshape(st.shape[0], 16, 16)
@@ -753,26 +803,32 @@ def echo512_64(data):
 
 # -- the chain ----------------------------------------------------------------
 
-def x11_digest_chain(headers):
-    """[B, 80] uint8 -> [B, 32] x11 digests (jit-friendly)."""
+def x11_digest_chain(headers, sbox_mode: str | None = None):
+    """[B, 80] uint8 -> [B, 32] x11 digests (jit-friendly).
+
+    ``sbox_mode``: "table" (byte-table gathers), "compute" (gather-free
+    bitplane AES — the TPU form; kernels/x11/aes_bitslice), or None =
+    resolve by platform/env at trace time (see _default_sbox_mode)."""
     h = blake512_80(headers)
     h = bmw512_64(h)
-    h = groestl512_64(h)
+    h = groestl512_64(h, sbox_mode)
     h = skein512_64(h)
     h = jh512_64(h)
     h = keccak512_64(h)
     h = luffa512_64(h)
     h = cubehash512_64(h)
-    h = shavite512_64(h)
+    h = shavite512_64(h, sbox_mode)
     h = simd512_64(h)
-    h = echo512_64(h)
+    h = echo512_64(h, sbox_mode)
     return h[:, :32]
 
 
 # one shared jit wrapper: jax caches the compiled executable per input
 # shape internally, and a single wrapper means a new batch size never
-# evicts another's multi-minute XLA compile
-_jitted_chain = jax.jit(x11_digest_chain)
+# evicts another's multi-minute XLA compile. sbox_mode is static: each
+# mode is a different program (and a different cache entry), so A/B
+# measurement never reuses a stale trace.
+_jitted_chain = jax.jit(x11_digest_chain, static_argnames=("sbox_mode",))
 
 
 def compiled_chain(batch: int = 0):
@@ -780,7 +836,14 @@ def compiled_chain(batch: int = 0):
     return _jitted_chain
 
 
-def x11_digest_device(headers_np: np.ndarray) -> np.ndarray:
+def x11_digest_device(headers_np: np.ndarray,
+                      sbox_mode: str | None = None) -> np.ndarray:
     """Convenience host API: numpy [B, 80] -> numpy [B, 32]."""
+    # resolve env/platform defaults HERE, outside jit, so the jit cache
+    # key always carries the ACTUAL mode (an env flip between calls must
+    # recompile, not hit the stale None-keyed trace)
+    mode = sbox_mode or _default_sbox_mode()
     with jax.enable_x64():
-        return np.asarray(_jitted_chain(jnp.asarray(headers_np, dtype=U8)))
+        return np.asarray(_jitted_chain(
+            jnp.asarray(headers_np, dtype=U8), sbox_mode=mode
+        ))

@@ -588,7 +588,12 @@ class X11PodSearch:
         if self.chain_fn is None:
             from otedama_tpu.kernels.x11 import jnp_chain
 
-            self.chain_fn = jnp_chain.x11_digest_chain
+            # mode pinned at construction (outside any jit trace) so the
+            # pod's compiled-step cache always reflects the real mode
+            self.chain_fn = functools.partial(
+                jnp_chain.x11_digest_chain,
+                sbox_mode=jnp_chain._default_sbox_mode(),
+            )
         self._steps: dict[int, callable] = {}
 
     def _build_step(self, per_chip: int):

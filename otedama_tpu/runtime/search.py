@@ -426,12 +426,19 @@ class X11JaxBackend:
 
     def _compiled(self):
         if self._fn is None:
+            import functools
+
             import jax
 
             from otedama_tpu.kernels.x11 import jnp_chain
 
             with jax.enable_x64():
-                self._fn = jnp_chain.compiled_chain(self.chunk)
+                # resolve the sbox mode OUTSIDE jit so the compile cache
+                # is keyed on the actual mode (see x11_digest_device)
+                self._fn = functools.partial(
+                    jnp_chain.compiled_chain(self.chunk),
+                    sbox_mode=jnp_chain._default_sbox_mode(),
+                )
         return self._fn
 
     def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:

@@ -306,6 +306,40 @@ def test_jnp_chain_matches_numpy_oracle():
     assert np.array_equal(got, want)
 
 
+def test_aes_bitslice_certified_against_tables():
+    """The gather-free compute-form AES primitives (the TPU path for the
+    6 AES-flavored stages) must match their tables on the FULL domain —
+    the same exhaustive check the kernels run before first use."""
+    from otedama_tpu.kernels.x11 import aes_bitslice as ab
+
+    ab.selftest()  # raises on any of the 256x6 divergences
+    # plane round-trip is lossless on arbitrary bytes
+    x = np.arange(256, dtype=np.uint8).reshape(16, 16)
+    assert np.array_equal(ab._unplanes(ab._planes(x)), x)
+
+
+def test_jnp_chain_compute_sbox_matches_numpy_oracle():
+    """sbox_mode="compute" (bitplane AES, zero gathers — what the TPU
+    runs) must be bit-identical to the host oracle. Eager mode: the
+    jitted A/B compile is exercised by the slow tier."""
+    import jax
+    import jax.numpy as jnp
+
+    from otedama_tpu.kernels.x11 import jnp_chain as jc
+
+    rng = np.random.default_rng(13)
+    hdr = rng.integers(0, 256, size=(2, 80), dtype=np.uint8)
+    want = np.stack([
+        np.frombuffer(x11.x11_digest(row.tobytes()), dtype=np.uint8)
+        for row in hdr
+    ])
+    with jax.enable_x64():
+        got = np.asarray(
+            jc.x11_digest_chain(jnp.asarray(hdr), sbox_mode="compute")
+        )
+    assert np.array_equal(got, want)
+
+
 @pytest.mark.slow
 def test_x11_jax_backend_finds_planted_winner():
     """Compiled end-to-end: the device backend reproduces the numpy
