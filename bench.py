@@ -146,30 +146,38 @@ def bench_sha256d() -> dict:
     }
 
 
-def _scrypt_backend(on_tpu: bool):
+def _scrypt_backend(on_tpu: bool, tier: str = "pallas"):
     """Production scrypt backend selection — shared by the kernel bench
-    and the engine-path bench so both measure the SAME configuration."""
+    and the engine-path bench so both measure the SAME configuration.
+    ``tier``: "pallas" (HBM V + XLA gather, the r3-measured config) or
+    "fused"/"fused-half" (whole ROMix in-kernel, V in VMEM — the r4
+    gather-free experiment; smaller chunks, VMEM-bounded tiles)."""
     from otedama_tpu.runtime.search import ScryptPallasBackend, ScryptXlaBackend
 
     if on_tpu:
+        if tier != "pallas":
+            # fused tiles are 128 lanes; a few tiles per launch suffice
+            return ScryptPallasBackend(chunk=1 << 12, tier=tier)
         # 2^15 lanes = 4 GiB V tensor; the gather-bound sweet spot
         return ScryptPallasBackend(chunk=1 << 15)
     return ScryptXlaBackend(chunk=1 << 8)
 
 
-def bench_scrypt() -> dict:
+def bench_scrypt(tier: str = "pallas") -> dict:
     """BASELINE.md config 2: scrypt (N=1024,r=1,p=1) kH/s/chip (report).
 
     Drives the production path: on TPU the fused-Pallas-BlockMix backend
     (``ScryptPallasBackend``; V = chunk * 128 KiB of HBM), elsewhere the
     portable XLA tier — the same selection the engine makes.
+    ``--scrypt-tier fused``/``fused-half`` measures the r4 VMEM-resident
+    ROMix experiment instead.
     """
     import jax
 
     platform = jax.devices()[0].platform
-    log(f"bench: scrypt on platform={platform}")
+    log(f"bench: scrypt on platform={platform} tier={tier}")
     jc = _job_constants()
-    backend = _scrypt_backend(platform == "tpu")
+    backend = _scrypt_backend(platform == "tpu", tier)
     chunk = backend.chunk
 
     log(f"bench: compiling scrypt[{backend.name}] ...")
@@ -295,7 +303,8 @@ def bench_ethash() -> dict:
     }
 
 
-def bench_engine_path(algo: str = "sha256d") -> dict:
+def bench_engine_path(algo: str = "sha256d",
+                      scrypt_tier: str = "pallas") -> dict:
     """Effective rate through the LIVE mining pipeline (engine loop +
     pipelined dispatch + share path), not a bare kernel loop — the number
     the verdict's weak #2 asked for. Uses the same backend auto-selection
@@ -311,7 +320,7 @@ def bench_engine_path(algo: str = "sha256d") -> dict:
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
     if algo == "scrypt":
-        backend = _scrypt_backend(on_tpu)
+        backend = _scrypt_backend(on_tpu, scrypt_tier)
         window = 20.0 if on_tpu else 8.0
     elif algo != "sha256d":
         raise SystemExit(
@@ -497,16 +506,20 @@ def main() -> None:
     ap.add_argument("--x11-chunk", type=int, default=None,
                     help="x11 lanes per launch (device tier; NB a new "
                          "chunk shape pays the chain's full compile)")
+    ap.add_argument("--scrypt-tier", default="pallas",
+                    choices=("pallas", "fused", "fused-half"),
+                    help="scrypt kernel tier (fused = VMEM-resident ROMix)")
     args = ap.parse_args()
     fell_back = _guard_platform()
     if args.engine_path:
-        out = bench_engine_path(args.algo)
+        out = bench_engine_path(args.algo, args.scrypt_tier)
     elif args.algo == "x11":
         out = bench_x11(args.x11_backend, args.x11_chunk)
+    elif args.algo == "scrypt":
+        out = bench_scrypt(args.scrypt_tier)
     else:
         out = {
             "sha256d": bench_sha256d,
-            "scrypt": bench_scrypt,
             "ethash": bench_ethash,
         }[args.algo]()
     if fell_back:

@@ -148,11 +148,19 @@ def scrypt_1024_1_1(header_words, nonces, *, rolled: bool = True,
     # ROMix operates on LE words.
     X = jnp.stack([sj.bswap32(w) for w in T], axis=-1)  # [B, 32]
 
-    if blockmix not in ("xla", "pallas"):
+    if blockmix not in ("xla", "pallas", "fused", "fused-half"):
         # a typo here would silently run the slower tier under the faster
         # tier's name — fail loudly instead
         raise ValueError(f"unknown blockmix tier {blockmix!r}")
-    if blockmix == "pallas":
+    if blockmix in ("fused", "fused-half"):
+        # whole ROMix in one Pallas kernel, V in VMEM (no HBM gather at
+        # all); "fused-half" stores half of V and recomputes odd rows
+        from otedama_tpu.kernels import scrypt_pallas as sp
+
+        X = sp.romix_fused_pallas(
+            X.T, half_v=(blockmix == "fused-half")
+        ).T
+    elif blockmix == "pallas":
         # word-major [32, B] through the ROMix loops (the kernel's native
         # layout); V stays lane-major [N, B, 32] for the row gather, at the
         # cost of one cheap layout change per step

@@ -353,11 +353,26 @@ class ScryptPallasBackend(ScryptXlaBackend):
     # default = the benchmarked configuration (BENCH_SCRYPT_r03: 24.17 kH/s
     # at chunk=2^15, the gather-bound sweet spot; V = chunk * 128 KiB HBM) —
     # the engine's no-kwargs auto construction must run what was measured
-    def __init__(self, chunk: int = 1 << 15, rolled: bool | None = None):
+    def __init__(self, chunk: int = 1 << 15, rolled: bool | None = None,
+                 tier: str = "pallas"):
+        """``tier``: "pallas" (fused BlockMix, HBM V + XLA gather) or
+        "fused"/"fused-half" (whole ROMix in-kernel, V in VMEM — the
+        gather-free experiment; kernels/scrypt_pallas.romix_fused_pallas)."""
         from otedama_tpu.kernels import scrypt_pallas as sp
 
-        sp._tile(chunk)  # fail fast here, not deep inside the first trace
-        super().__init__(chunk=chunk, rolled=rolled, blockmix="pallas")
+        if tier == "pallas":
+            sp._tile(chunk)  # fail fast here, not deep inside the 1st trace
+        elif tier in ("fused", "fused-half"):
+            t = min(sp.FUSED_LANE_TILE, chunk)
+            if chunk % t:  # same fail-fast contract as the pallas tier
+                raise ValueError(
+                    f"chunk {chunk} not a multiple of fused lane tile {t}"
+                )
+        else:
+            raise ValueError(f"unknown scrypt pallas tier {tier!r}")
+        super().__init__(chunk=chunk, rolled=rolled, blockmix=tier)
+        if tier != "pallas":
+            self.name = f"scrypt-{tier}"
 
 
 class ScryptPythonBackend:

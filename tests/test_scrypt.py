@@ -88,3 +88,23 @@ def test_scrypt_pallas_pipeline_matches_hashlib_tiny():
         _oracle(h76 + struct.pack(">I", 7)), dtype=">u4"
     ).astype(np.uint32)
     assert np.array_equal(got, want)
+
+
+def test_scrypt_fused_romix_matches_hashlib():
+    """The fully-fused ROMix kernel (V in VMEM scratch, zero HBM gathers
+    — kernels/scrypt_pallas.romix_fused_pallas) is bit-identical to
+    hashlib.scrypt through the real pipeline, in both the full-V and
+    half-V (recompute odd rows) modes."""
+    h76 = _header76(seed=5)
+    words = sc.header_words19(h76)
+    nonces = np.arange(40, 44, dtype=np.uint32)
+    want = np.stack([
+        np.frombuffer(
+            _oracle(h76 + struct.pack(">I", int(n))), dtype=">u4"
+        ).astype(np.uint32)
+        for n in nonces
+    ])
+    for tier in ("fused", "fused-half"):
+        d8 = sc.scrypt_1024_1_1(words, jnp.asarray(nonces), blockmix=tier)
+        got = np.stack([np.asarray(x) for x in d8], axis=-1)
+        assert np.array_equal(got, want), tier
