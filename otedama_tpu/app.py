@@ -55,6 +55,7 @@ class Application:
         self.client = None          # stratum client (miner mode)
         self.chain = None           # chain client (solo mode)
         self.server = None          # stratum server (pool mode)
+        self.server_v2 = None       # stratum V2 server (optional, pool mode)
         self.pool = None            # pool manager
         self.db = None
         self.p2p = None
@@ -180,6 +181,20 @@ class Application:
             on_block=self.pool.on_block,
         )
         await self.server.start()
+        if cfg.stratum.v2_enabled:
+            from otedama_tpu.stratum.v2 import Sv2MiningServer, Sv2ServerConfig
+
+            self.server_v2 = Sv2MiningServer(
+                Sv2ServerConfig(
+                    host=cfg.stratum.host,
+                    port=cfg.stratum.v2_port,
+                    initial_difficulty=cfg.stratum.initial_difficulty,
+                ),
+                on_share=self.pool.on_share,
+                on_block=self.pool.on_block,
+            )
+            await self.server_v2.start()
+            self._started.append(self.server_v2)
         await self.pool.start()
         self._started += [self.pool, self.server]
         self._tasks.append(asyncio.create_task(self._template_loop(chain)))
@@ -197,6 +212,8 @@ class Application:
                     last_height = t.height
                     if self.server is not None:
                         self.server.set_job(job, clean=True)
+                    if self.server_v2 is not None:
+                        self.server_v2.set_job(job, clean=True)
             except Exception:
                 log.exception("template poll failed")
             await asyncio.sleep(self.pool.config.template_poll_seconds if self.pool else 5.0)
@@ -356,6 +373,8 @@ class Application:
             self.api.add_provider("upstream", lambda: dict(self.client.stats))
         if self.server is not None:
             self.api.add_provider("stratum", self.server.snapshot)
+        if self.server_v2 is not None:
+            self.api.add_provider("stratum_v2", self.server_v2.snapshot)
         if self.pool is not None:
             self.api.add_provider("pool", self.pool.snapshot)
         if self.p2p is not None:

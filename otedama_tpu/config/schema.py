@@ -103,6 +103,10 @@ class StratumSettings:
     extranonce2_size: int = 4
     max_clients: int = 10000
     vardiff_target_seconds: float = 10.0
+    # Stratum V2 (binary protocol, standard channels — stratum/v2.py);
+    # served alongside V1 on its own port when enabled
+    v2_enabled: bool = False
+    v2_port: int = 3336
 
 
 @dataclasses.dataclass
@@ -277,6 +281,8 @@ stratum:
   host: 0.0.0.0
   port: 3333
   initial_difficulty: 1.0
+  v2_enabled: false   # Stratum V2 binary protocol on its own port
+  v2_port: 3336
 
 pool:
   enabled: false

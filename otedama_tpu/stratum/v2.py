@@ -1,0 +1,855 @@
+"""Stratum V2 (binary) mining protocol — framing, messages, server, client.
+
+Reference parity and beyond: the reference DECLARES Stratum V2 and never
+implements a byte of it (/root/reference/internal/stratum/
+unified_stratum.go:22-25 — version constants only). This module
+implements the real thing for the mining subprotocol's standard-channel
+core: the 6-byte binary frame header, the SV2 field codecs (STR0_255,
+B0_*, U256), the connection handshake, channel open, job delivery
+(NewMiningJob + SetNewPrevHash + SetTarget), and share submission with
+FULL validation (exact header reconstruction, sha256d/pow digest,
+256-bit target compare, duplicate window — the same discipline as the
+V1 server, which validates harder than the reference's job-existence
+check at unified_stratum.go:888-913).
+
+Scope notes (stated, not hidden):
+
+- **Transport security**: the SV2 spec mounts this protocol behind a
+  Noise-NX encrypted transport. Curve25519/ChaCha20-Poly1305 primitives
+  are not available in this offline environment, so the transport here
+  is cleartext TCP; the framing/messages are transport-independent and
+  a noise wrapper slots between ``_read_frame``/``_send`` when the
+  primitives exist.
+- **Message-type ids** follow the public SV2 spec as recalled offline
+  (SetupConnection 0x00/0x01/0x02, OpenStandardMiningChannel
+  0x10/0x11/0x12, SubmitSharesStandard 0x1A with 0x1C/0x1D results,
+  NewMiningJob 0x1E, SetNewPrevHash 0x20, SetTarget 0x21). Both ends
+  here share these tables so the implementation is self-consistent;
+  interop with third-party SV2 endpoints should first run a one-frame
+  vector check (the same certify-before-claiming-canonical discipline
+  as kernels/x11).
+- Standard channels only (header-only mining: the channel's extranonce
+  is fixed by the server; shares vary nonce/ntime/version) — the mode
+  ASIC-style devices use and the one that maps onto this framework's
+  fixed-prefix search kernels.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import struct
+import time
+
+from otedama_tpu.engine import jobs as jobmod
+from otedama_tpu.engine.types import Job
+from otedama_tpu.kernels import target as tgt
+from otedama_tpu.utils.pow_host import pow_digest
+
+log = logging.getLogger("otedama.stratum.v2")
+
+PROTOCOL_MINING = 0
+SV2_VERSION = 2
+
+# message type ids (see scope note in the module docstring)
+MSG_SETUP_CONNECTION = 0x00
+MSG_SETUP_CONNECTION_SUCCESS = 0x01
+MSG_SETUP_CONNECTION_ERROR = 0x02
+MSG_OPEN_STANDARD_MINING_CHANNEL = 0x10
+MSG_OPEN_STANDARD_MINING_CHANNEL_SUCCESS = 0x11
+MSG_OPEN_STANDARD_MINING_CHANNEL_ERROR = 0x12
+MSG_SUBMIT_SHARES_STANDARD = 0x1A
+MSG_SUBMIT_SHARES_SUCCESS = 0x1C
+MSG_SUBMIT_SHARES_ERROR = 0x1D
+MSG_NEW_MINING_JOB = 0x1E
+MSG_SET_NEW_PREV_HASH = 0x20
+MSG_SET_TARGET = 0x21
+
+MAX_FRAME_PAYLOAD = 1 << 24  # u24 length field
+
+
+# -- field codecs -------------------------------------------------------------
+
+class Sv2DecodeError(ValueError):
+    pass
+
+
+class Reader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.off = 0
+
+    def take(self, n: int) -> bytes:
+        if self.off + n > len(self.data):
+            raise Sv2DecodeError(
+                f"truncated field at {self.off}+{n}/{len(self.data)}"
+            )
+        out = self.data[self.off:self.off + n]
+        self.off += n
+        return out
+
+    def u8(self) -> int:
+        return self.take(1)[0]
+
+    def u16(self) -> int:
+        return struct.unpack("<H", self.take(2))[0]
+
+    def u32(self) -> int:
+        return struct.unpack("<I", self.take(4))[0]
+
+    def u64(self) -> int:
+        return struct.unpack("<Q", self.take(8))[0]
+
+    def f32(self) -> float:
+        return struct.unpack("<f", self.take(4))[0]
+
+    def str0_255(self) -> str:
+        return self.take(self.u8()).decode("utf-8", "replace")
+
+    def b0_255(self) -> bytes:
+        return self.take(self.u8())
+
+    def u256(self) -> int:
+        return int.from_bytes(self.take(32), "little")
+
+    def done(self) -> None:
+        if self.off != len(self.data):
+            raise Sv2DecodeError(
+                f"{len(self.data) - self.off} trailing bytes"
+            )
+
+
+def _str0_255(s: str) -> bytes:
+    b = s.encode()
+    if len(b) > 255:
+        raise ValueError("STR0_255 overflow")
+    return bytes([len(b)]) + b
+
+
+def _b0_255(b: bytes) -> bytes:
+    if len(b) > 255:
+        raise ValueError("B0_255 overflow")
+    return bytes([len(b)]) + b
+
+
+def _u256(v: int) -> bytes:
+    return int(v).to_bytes(32, "little")
+
+
+# -- frames -------------------------------------------------------------------
+
+def pack_frame(msg_type: int, payload: bytes, extension_type: int = 0) -> bytes:
+    """SV2 frame: u16 extension_type | u8 msg_type | u24 length | payload."""
+    if len(payload) >= MAX_FRAME_PAYLOAD:
+        raise ValueError("frame payload overflows u24 length")
+    return (
+        struct.pack("<HB", extension_type, msg_type)
+        + len(payload).to_bytes(3, "little")
+        + payload
+    )
+
+
+async def read_frame(reader: asyncio.StreamReader) -> tuple[int, int, bytes]:
+    head = await reader.readexactly(6)
+    ext, mtype = struct.unpack("<HB", head[:3])
+    length = int.from_bytes(head[3:6], "little")
+    payload = await reader.readexactly(length) if length else b""
+    return ext, mtype, payload
+
+
+# -- messages (the standard-channel mining core) ------------------------------
+
+@dataclasses.dataclass
+class SetupConnection:
+    protocol: int = PROTOCOL_MINING
+    min_version: int = SV2_VERSION
+    max_version: int = SV2_VERSION
+    flags: int = 0
+    endpoint_host: str = ""
+    endpoint_port: int = 0
+    vendor: str = "otedama-tpu"
+    hardware_version: str = ""
+    firmware: str = ""
+    device_id: str = ""
+
+    MSG = MSG_SETUP_CONNECTION
+
+    def encode(self) -> bytes:
+        return (
+            struct.pack("<BHHI", self.protocol, self.min_version,
+                        self.max_version, self.flags)
+            + _str0_255(self.endpoint_host)
+            + struct.pack("<H", self.endpoint_port)
+            + _str0_255(self.vendor)
+            + _str0_255(self.hardware_version)
+            + _str0_255(self.firmware)
+            + _str0_255(self.device_id)
+        )
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "SetupConnection":
+        r = Reader(payload)
+        out = cls(
+            protocol=r.u8(), min_version=r.u16(), max_version=r.u16(),
+            flags=r.u32(), endpoint_host=r.str0_255(),
+            endpoint_port=r.u16(), vendor=r.str0_255(),
+            hardware_version=r.str0_255(), firmware=r.str0_255(),
+            device_id=r.str0_255(),
+        )
+        r.done()
+        return out
+
+
+@dataclasses.dataclass
+class SetupConnectionSuccess:
+    used_version: int = SV2_VERSION
+    flags: int = 0
+
+    MSG = MSG_SETUP_CONNECTION_SUCCESS
+
+    def encode(self) -> bytes:
+        return struct.pack("<HI", self.used_version, self.flags)
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "SetupConnectionSuccess":
+        r = Reader(payload)
+        out = cls(used_version=r.u16(), flags=r.u32())
+        r.done()
+        return out
+
+
+@dataclasses.dataclass
+class SetupConnectionError:
+    flags: int = 0
+    error_code: str = ""
+
+    MSG = MSG_SETUP_CONNECTION_ERROR
+
+    def encode(self) -> bytes:
+        return struct.pack("<I", self.flags) + _str0_255(self.error_code)
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "SetupConnectionError":
+        r = Reader(payload)
+        out = cls(flags=r.u32(), error_code=r.str0_255())
+        r.done()
+        return out
+
+
+@dataclasses.dataclass
+class OpenStandardMiningChannel:
+    request_id: int
+    user_identity: str
+    nominal_hash_rate: float = 0.0
+    max_target: int = (1 << 256) - 1
+
+    MSG = MSG_OPEN_STANDARD_MINING_CHANNEL
+
+    def encode(self) -> bytes:
+        return (
+            struct.pack("<I", self.request_id)
+            + _str0_255(self.user_identity)
+            + struct.pack("<f", self.nominal_hash_rate)
+            + _u256(self.max_target)
+        )
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "OpenStandardMiningChannel":
+        r = Reader(payload)
+        out = cls(
+            request_id=r.u32(), user_identity=r.str0_255(),
+            nominal_hash_rate=r.f32(), max_target=r.u256(),
+        )
+        r.done()
+        return out
+
+
+@dataclasses.dataclass
+class OpenStandardMiningChannelError:
+    request_id: int
+    error_code: str
+
+    MSG = MSG_OPEN_STANDARD_MINING_CHANNEL_ERROR
+
+    def encode(self) -> bytes:
+        return struct.pack("<I", self.request_id) + _str0_255(self.error_code)
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "OpenStandardMiningChannelError":
+        r = Reader(payload)
+        out = cls(request_id=r.u32(), error_code=r.str0_255())
+        r.done()
+        return out
+
+
+@dataclasses.dataclass
+class OpenStandardMiningChannelSuccess:
+    request_id: int
+    channel_id: int
+    target: int
+    extranonce_prefix: bytes
+    group_channel_id: int = 0
+
+    MSG = MSG_OPEN_STANDARD_MINING_CHANNEL_SUCCESS
+
+    def encode(self) -> bytes:
+        return (
+            struct.pack("<II", self.request_id, self.channel_id)
+            + _u256(self.target)
+            + _b0_255(self.extranonce_prefix)
+            + struct.pack("<I", self.group_channel_id)
+        )
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "OpenStandardMiningChannelSuccess":
+        r = Reader(payload)
+        out = cls(
+            request_id=r.u32(), channel_id=r.u32(), target=r.u256(),
+            extranonce_prefix=r.b0_255(), group_channel_id=r.u32(),
+        )
+        r.done()
+        return out
+
+
+@dataclasses.dataclass
+class NewMiningJob:
+    channel_id: int
+    job_id: int
+    future_job: bool
+    version: int
+    merkle_root: bytes  # 32 bytes, header order
+
+    MSG = MSG_NEW_MINING_JOB
+
+    def encode(self) -> bytes:
+        if len(self.merkle_root) != 32:
+            raise ValueError("merkle_root must be 32 bytes")
+        return (
+            struct.pack("<IIBI", self.channel_id, self.job_id,
+                        int(self.future_job), self.version)
+            + self.merkle_root
+        )
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "NewMiningJob":
+        r = Reader(payload)
+        out = cls(
+            channel_id=r.u32(), job_id=r.u32(), future_job=bool(r.u8()),
+            version=r.u32(), merkle_root=r.take(32),
+        )
+        r.done()
+        return out
+
+
+@dataclasses.dataclass
+class SetNewPrevHash:
+    channel_id: int
+    job_id: int
+    prev_hash: bytes  # 32 bytes, header order
+    min_ntime: int
+    nbits: int
+
+    MSG = MSG_SET_NEW_PREV_HASH
+
+    def encode(self) -> bytes:
+        if len(self.prev_hash) != 32:
+            raise ValueError("prev_hash must be 32 bytes")
+        return (
+            struct.pack("<II", self.channel_id, self.job_id)
+            + self.prev_hash
+            + struct.pack("<II", self.min_ntime, self.nbits)
+        )
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "SetNewPrevHash":
+        r = Reader(payload)
+        out = cls(
+            channel_id=r.u32(), job_id=r.u32(), prev_hash=r.take(32),
+            min_ntime=r.u32(), nbits=r.u32(),
+        )
+        r.done()
+        return out
+
+
+@dataclasses.dataclass
+class SetTarget:
+    channel_id: int
+    maximum_target: int
+
+    MSG = MSG_SET_TARGET
+
+    def encode(self) -> bytes:
+        return struct.pack("<I", self.channel_id) + _u256(self.maximum_target)
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "SetTarget":
+        r = Reader(payload)
+        out = cls(channel_id=r.u32(), maximum_target=r.u256())
+        r.done()
+        return out
+
+
+@dataclasses.dataclass
+class SubmitSharesStandard:
+    channel_id: int
+    sequence_number: int
+    job_id: int
+    nonce: int
+    ntime: int
+    version: int
+
+    MSG = MSG_SUBMIT_SHARES_STANDARD
+
+    def encode(self) -> bytes:
+        return struct.pack(
+            "<IIIIII", self.channel_id, self.sequence_number, self.job_id,
+            self.nonce, self.ntime, self.version,
+        )
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "SubmitSharesStandard":
+        r = Reader(payload)
+        out = cls(*struct.unpack("<IIIIII", r.take(24)))
+        r.done()
+        return out
+
+
+@dataclasses.dataclass
+class SubmitSharesSuccess:
+    channel_id: int
+    last_sequence_number: int
+    new_submits_accepted_count: int
+    new_shares_sum: int
+
+    MSG = MSG_SUBMIT_SHARES_SUCCESS
+
+    def encode(self) -> bytes:
+        return struct.pack(
+            "<IIIQ", self.channel_id, self.last_sequence_number,
+            self.new_submits_accepted_count, self.new_shares_sum,
+        )
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "SubmitSharesSuccess":
+        r = Reader(payload)
+        out = cls(*struct.unpack("<IIIQ", r.take(20)))
+        r.done()
+        return out
+
+
+@dataclasses.dataclass
+class SubmitSharesError:
+    channel_id: int
+    sequence_number: int
+    error_code: str
+
+    MSG = MSG_SUBMIT_SHARES_ERROR
+
+    def encode(self) -> bytes:
+        return (
+            struct.pack("<II", self.channel_id, self.sequence_number)
+            + _str0_255(self.error_code)
+        )
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "SubmitSharesError":
+        r = Reader(payload)
+        out = cls(channel_id=r.u32(), sequence_number=r.u32(),
+                  error_code=r.str0_255())
+        r.done()
+        return out
+
+
+MESSAGE_TYPES = {
+    m.MSG: m for m in (
+        SetupConnection, SetupConnectionSuccess, SetupConnectionError,
+        OpenStandardMiningChannel, OpenStandardMiningChannelSuccess,
+        OpenStandardMiningChannelError,
+        NewMiningJob, SetNewPrevHash, SetTarget,
+        SubmitSharesStandard, SubmitSharesSuccess, SubmitSharesError,
+    )
+}
+
+
+def decode_message(msg_type: int, payload: bytes):
+    cls = MESSAGE_TYPES.get(msg_type)
+    if cls is None:
+        raise Sv2DecodeError(f"unknown message type 0x{msg_type:02x}")
+    return cls.decode(payload)
+
+
+# -- server -------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Sv2ServerConfig:
+    host: str = "127.0.0.1"
+    port: int = 3336
+    initial_difficulty: float = 1.0
+    job_max_age: float = 300.0
+    ntime_slack: int = 600
+    max_channels_per_conn: int = 16
+    # a stalled peer must not buffer unbounded job broadcasts in process
+    # memory: past this transport backlog the channel stops receiving
+    # (and a dead TCP peer gets reaped by its read loop)
+    max_write_backlog: int = 1 << 20
+
+
+@dataclasses.dataclass
+class Sv2Channel:
+    channel_id: int
+    user: str
+    extranonce2: bytes     # the channel's FIXED rolled space (standard mode)
+    target: int
+    seen_shares: set = dataclasses.field(default_factory=set)
+    accepted: int = 0
+    shares_sum: int = 0
+
+
+class Sv2MiningServer:
+    """Standard-channel SV2 pool endpoint sharing the V1 server's job,
+    validation, and ACCOUNTING semantics: accepted shares flow to the
+    same ``on_share``/``on_block`` hooks (stratum/server.AcceptedShare)
+    the V1 server feeds the pool manager with — a share earns the same
+    credit and a block gets submitted to the chain regardless of which
+    protocol carried it."""
+
+    def __init__(self, config: Sv2ServerConfig | None = None,
+                 on_share=None, on_block=None):
+        from otedama_tpu.stratum.server import AcceptedShare  # noqa: F401
+
+        self.config = config or Sv2ServerConfig()
+        self.on_share = on_share   # async fn(AcceptedShare)
+        self.on_block = on_block   # async fn(header, Job, AcceptedShare)
+        self._server: asyncio.AbstractServer | None = None
+        self._channels: dict[int, tuple[Sv2Channel, asyncio.StreamWriter]] = {}
+        self._jobs: dict[int, tuple[Job, float]] = {}
+        self._job_seq = 0
+        self._chan_seq = 0
+        self.stats = {"connections": 0, "shares_accepted": 0,
+                      "shares_rejected": 0, "blocks": 0}
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, self.config.host, self.config.port
+        )
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        self._channels.clear()
+
+    @property
+    def port(self) -> int:
+        return self._server.sockets[0].getsockname()[1]
+
+    # -- job flow ------------------------------------------------------------
+
+    def set_job(self, job: Job, clean: bool = True) -> int:
+        """Publish a V1-shaped Job to every open channel as
+        NewMiningJob + SetNewPrevHash; returns the SV2 job id."""
+        self._job_seq += 1
+        jid = self._job_seq
+        self._jobs[jid] = (job, time.time())
+        cutoff = time.time() - self.config.job_max_age
+        self._jobs = {k: v for k, v in self._jobs.items() if v[1] >= cutoff}
+        for chan, writer in list(self._channels.values()):
+            # duplicate window stays bounded: drop keys of pruned jobs
+            chan.seen_shares = {
+                k for k in chan.seen_shares if k[0] in self._jobs
+            }
+            try:
+                self._send_job(chan, writer, jid, job)
+            except (ConnectionError, RuntimeError):
+                pass  # reaped on the connection's read loop exit
+        return jid
+
+    def _write(self, writer: asyncio.StreamWriter, msg_type: int,
+               payload: bytes) -> None:
+        """Bounded write: a peer that stopped reading must not grow the
+        transport buffer forever (the V1 server drains per write; sync
+        broadcast paths here enforce a backlog cap instead)."""
+        transport = writer.transport
+        if (transport is not None
+                and transport.get_write_buffer_size()
+                > self.config.max_write_backlog):
+            raise ConnectionError("write backlog over cap (stalled peer)")
+        writer.write(pack_frame(msg_type, payload))
+
+    @staticmethod
+    def _channel_extranonce2(chan: Sv2Channel, job: Job) -> bytes:
+        """Standard channels mine a server-FIXED extranonce space: the
+        channel id, sized to this job's extranonce2 width."""
+        return chan.channel_id.to_bytes(job.extranonce2_size, "big")
+
+    def _send_job(self, chan: Sv2Channel, writer: asyncio.StreamWriter,
+                  jid: int, job: Job) -> None:
+        # header-only mining: the server resolves the coinbase/merkle for
+        # the channel's fixed extranonce and ships the ROOT — the SV2
+        # standard-channel contract (and exactly what the pod kernels
+        # want: a fixed 76-byte prefix per channel)
+        en2 = self._channel_extranonce2(chan, job)
+        root = jobmod.merkle_root(
+            jobmod.build_coinbase(job, en2), job.merkle_branch
+        )
+        self._write(writer, MSG_NEW_MINING_JOB, NewMiningJob(
+            channel_id=chan.channel_id, job_id=jid, future_job=False,
+            version=job.version, merkle_root=root,
+        ).encode())
+        self._write(writer, MSG_SET_NEW_PREV_HASH, SetNewPrevHash(
+            channel_id=chan.channel_id, job_id=jid, prev_hash=job.prev_hash,
+            min_ntime=job.ntime, nbits=job.nbits,
+        ).encode())
+
+    # -- connection handling -------------------------------------------------
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        self.stats["connections"] += 1
+        conn_channels: list[int] = []
+        try:
+            ext, mtype, payload = await read_frame(reader)
+            if mtype != MSG_SETUP_CONNECTION:
+                self._write(writer, MSG_SETUP_CONNECTION_ERROR,
+                            SetupConnectionError(
+                                error_code="setup-connection-expected"
+                            ).encode())
+                await writer.drain()
+                return
+            setup = SetupConnection.decode(payload)
+            if (setup.protocol != PROTOCOL_MINING
+                    or setup.min_version > SV2_VERSION
+                    or setup.max_version < SV2_VERSION):
+                self._write(writer, MSG_SETUP_CONNECTION_ERROR,
+                            SetupConnectionError(
+                                error_code="unsupported-protocol").encode())
+                await writer.drain()
+                return
+            self._write(writer, MSG_SETUP_CONNECTION_SUCCESS,
+                        SetupConnectionSuccess().encode())
+            await writer.drain()
+            while True:
+                ext, mtype, payload = await read_frame(reader)
+                try:
+                    msg = decode_message(mtype, payload)
+                except Sv2DecodeError as e:
+                    # frames are length-delimited, so sync survives any
+                    # unknown/undecodable message — a benign UpdateChannel
+                    # or extension frame must not drop a working miner
+                    log.debug("sv2: ignoring frame 0x%02x (%s)", mtype, e)
+                    continue
+                if isinstance(msg, OpenStandardMiningChannel):
+                    await self._on_open_channel(
+                        msg, writer, conn_channels)
+                elif isinstance(msg, SubmitSharesStandard):
+                    await self._on_submit(msg, writer)
+                else:
+                    log.debug("sv2: ignoring %s", type(msg).__name__)
+        except (asyncio.IncompleteReadError, ConnectionError) as e:
+            log.debug("sv2 connection closed: %s", e)
+        finally:
+            for cid in conn_channels:
+                self._channels.pop(cid, None)
+            writer.close()
+
+    async def _on_open_channel(self, msg: OpenStandardMiningChannel,
+                               writer: asyncio.StreamWriter,
+                               conn_channels: list[int]) -> None:
+        if len(conn_channels) >= self.config.max_channels_per_conn:
+            self._write(writer, MSG_OPEN_STANDARD_MINING_CHANNEL_ERROR,
+                        OpenStandardMiningChannelError(
+                            msg.request_id, "too-many-channels").encode())
+            await writer.drain()
+            return
+        self._chan_seq += 1
+        cid = self._chan_seq
+        target = min(
+            tgt.difficulty_to_target(self.config.initial_difficulty),
+            msg.max_target,
+        )
+        # the advertised prefix and the mined space derive from the SAME
+        # source (_channel_extranonce2): the Job model's extranonce2
+        # width, 4 bytes for every job the pool manager builds
+        latest = self._jobs[max(self._jobs)][0] if self._jobs else None
+        en2_size = latest.extranonce2_size if latest is not None else 4
+        chan = Sv2Channel(
+            channel_id=cid, user=msg.user_identity,
+            extranonce2=cid.to_bytes(en2_size, "big"),
+            target=target,
+        )
+        self._channels[cid] = (chan, writer)
+        conn_channels.append(cid)
+        self._write(writer, MSG_OPEN_STANDARD_MINING_CHANNEL_SUCCESS,
+                    OpenStandardMiningChannelSuccess(
+                        request_id=msg.request_id, channel_id=cid,
+                        target=target, extranonce_prefix=chan.extranonce2,
+                    ).encode())
+        # the freshest job goes out immediately (SV2 channels are useless
+        # until the first NewMiningJob + SetNewPrevHash pair lands)
+        if latest is not None:
+            self._send_job(chan, writer, max(self._jobs), latest)
+        await writer.drain()
+
+    async def _on_submit(self, msg: SubmitSharesStandard,
+                         writer: asyncio.StreamWriter) -> None:
+        from otedama_tpu.stratum.server import AcceptedShare
+
+        entry = self._channels.get(msg.channel_id)
+
+        async def reject(code: str) -> None:
+            self.stats["shares_rejected"] += 1
+            self._write(writer, MSG_SUBMIT_SHARES_ERROR,
+                        SubmitSharesError(msg.channel_id,
+                                          msg.sequence_number,
+                                          code).encode())
+            await writer.drain()
+
+        if entry is None:
+            await reject("invalid-channel-id")
+            return
+        chan, _ = entry
+        jobent = self._jobs.get(msg.job_id)
+        if jobent is None:
+            await reject("stale-job")
+            return
+        job, born = jobent
+        if time.time() - born > self.config.job_max_age:
+            await reject("stale-job")
+            return
+        if abs(int(msg.ntime) - job.ntime) > self.config.ntime_slack:
+            await reject("invalid-ntime")
+            return
+        key = (msg.job_id, msg.nonce, msg.ntime, msg.version)
+        if key in chan.seen_shares:
+            await reject("duplicate-share")
+            return
+        chan.seen_shares.add(key)
+        # exact reconstruction: channel-fixed extranonce2, share-rolled
+        # version word (SV2 version-rolling is first-class)
+        en2 = self._channel_extranonce2(chan, job)
+        header = jobmod.header_from_share(job, en2, msg.ntime, msg.nonce)
+        header = struct.pack("<I", msg.version) + header[4:]
+        digest = pow_digest(header, job.algorithm)
+        if not tgt.hash_meets_target(digest, chan.target):
+            await reject("difficulty-too-low")
+            return
+        chan.accepted += 1
+        chan.shares_sum += 1
+        self.stats["shares_accepted"] += 1
+        is_block = tgt.hash_meets_target(digest, tgt.bits_to_target(job.nbits))
+        # SAME accounting surface as the V1 server: the pool manager
+        # credits shares and submits blocks identically for both wires
+        accepted = AcceptedShare(
+            session_id=chan.channel_id,
+            worker_user=chan.user,
+            job_id=str(msg.job_id),
+            difficulty=tgt.target_to_difficulty(chan.target),
+            actual_difficulty=tgt.difficulty_of_digest(digest),
+            digest=digest,
+            header=header,
+            extranonce2=en2,
+            ntime=msg.ntime,
+            nonce_word=msg.nonce,
+            is_block=is_block,
+            submitted_at=time.time(),
+        )
+        if is_block:
+            self.stats["blocks"] += 1
+            log.info("sv2: BLOCK candidate on channel %d", chan.channel_id)
+            if self.on_block is not None:
+                await self.on_block(header, job, accepted)
+        if self.on_share is not None:
+            await self.on_share(accepted)
+        self._write(writer, MSG_SUBMIT_SHARES_SUCCESS,
+                    SubmitSharesSuccess(
+                        channel_id=chan.channel_id,
+                        last_sequence_number=msg.sequence_number,
+                        new_submits_accepted_count=1,
+                        new_shares_sum=chan.shares_sum,
+                    ).encode())
+        await writer.drain()
+
+    def snapshot(self) -> dict:
+        return {
+            **self.stats,
+            "channels": len(self._channels),
+            "jobs": len(self._jobs),
+        }
+
+
+# -- client -------------------------------------------------------------------
+
+class Sv2MiningClient:
+    """Minimal standard-channel client: handshake, open one channel,
+    receive jobs, submit shares — enough to drive the server end-to-end
+    (tests) and to act as the upstream leg of a future SV2 proxy."""
+
+    def __init__(self, host: str, port: int, user: str = "worker"):
+        self.host, self.port, self.user = host, port, user
+        self.reader: asyncio.StreamReader | None = None
+        self.writer: asyncio.StreamWriter | None = None
+        self.channel: OpenStandardMiningChannelSuccess | None = None
+        self.jobs: dict[int, NewMiningJob] = {}
+        self.prevhash: SetNewPrevHash | None = None
+        self.target: int | None = None
+        self._seq = 0
+        self._results: asyncio.Queue = asyncio.Queue()
+
+    async def connect(self, request_id: int = 1) -> None:
+        self.reader, self.writer = await asyncio.open_connection(
+            self.host, self.port
+        )
+        self.writer.write(pack_frame(
+            MSG_SETUP_CONNECTION, SetupConnection().encode()
+        ))
+        _, mtype, payload = await read_frame(self.reader)
+        msg = decode_message(mtype, payload)
+        if not isinstance(msg, SetupConnectionSuccess):
+            raise ConnectionError(f"setup rejected: {msg}")
+        self.writer.write(pack_frame(
+            MSG_OPEN_STANDARD_MINING_CHANNEL,
+            OpenStandardMiningChannel(
+                request_id=request_id, user_identity=self.user
+            ).encode(),
+        ))
+        _, mtype, payload = await read_frame(self.reader)
+        msg = decode_message(mtype, payload)
+        if not isinstance(msg, OpenStandardMiningChannelSuccess):
+            raise ConnectionError(f"channel rejected: {msg}")
+        self.channel = msg
+        self.target = msg.target
+
+    async def pump(self) -> None:
+        """Read one frame and update local state (jobs/prevhash/results)."""
+        _, mtype, payload = await read_frame(self.reader)
+        msg = decode_message(mtype, payload)
+        if isinstance(msg, NewMiningJob):
+            self.jobs[msg.job_id] = msg
+        elif isinstance(msg, SetNewPrevHash):
+            self.prevhash = msg
+        elif isinstance(msg, SetTarget):
+            self.target = msg.maximum_target
+        elif isinstance(msg, (SubmitSharesSuccess, SubmitSharesError)):
+            await self._results.put(msg)
+        return msg
+
+    async def submit(self, job_id: int, nonce: int, ntime: int,
+                     version: int):
+        """Send one share and pump frames until its result arrives."""
+        self._seq += 1
+        self.writer.write(pack_frame(
+            MSG_SUBMIT_SHARES_STANDARD,
+            SubmitSharesStandard(
+                channel_id=self.channel.channel_id,
+                sequence_number=self._seq, job_id=job_id,
+                nonce=nonce, ntime=ntime, version=version,
+            ).encode(),
+        ))
+        while self._results.empty():
+            await self.pump()
+        return await self._results.get()
+
+    async def close(self) -> None:
+        if self.writer is not None:
+            self.writer.close()

@@ -1,0 +1,230 @@
+"""Stratum V2 (stratum/v2.py): frame/field codec roundtrips and a REAL
+loopback server<->client session — handshake, channel open, job delivery,
+share mining (computed against the server's own validation math) and
+accept/reject flows. The reference only declares the SV2 version constant
+(unified_stratum.go:22-25); this is the implemented upgrade."""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+import time
+
+import pytest
+
+from otedama_tpu.engine import jobs as jobmod
+from otedama_tpu.engine.types import Job
+from otedama_tpu.kernels import target as tgt
+from otedama_tpu.stratum import v2
+from otedama_tpu.utils.pow_host import pow_digest
+
+
+def _roundtrip(msg):
+    frame = v2.pack_frame(msg.MSG, msg.encode())
+    ext, mtype = struct.unpack("<HB", frame[:3])
+    length = int.from_bytes(frame[3:6], "little")
+    assert ext == 0 and mtype == msg.MSG and length == len(frame) - 6
+    return v2.decode_message(mtype, frame[6:])
+
+
+def test_codec_roundtrips():
+    msgs = [
+        v2.SetupConnection(endpoint_host="pool.example", endpoint_port=3336,
+                           device_id="tpu-0"),
+        v2.SetupConnectionSuccess(used_version=2, flags=1),
+        v2.SetupConnectionError(error_code="unsupported-protocol"),
+        v2.OpenStandardMiningChannel(request_id=7, user_identity="w.1",
+                                     nominal_hash_rate=1e9,
+                                     max_target=(1 << 250)),
+        v2.OpenStandardMiningChannelSuccess(
+            request_id=7, channel_id=3, target=(1 << 240),
+            extranonce_prefix=b"\x00\x00\x00\x03"),
+        v2.NewMiningJob(channel_id=3, job_id=11, future_job=False,
+                        version=0x20000000, merkle_root=bytes(range(32))),
+        v2.SetNewPrevHash(channel_id=3, job_id=11,
+                          prev_hash=bytes(range(32, 64)),
+                          min_ntime=1700000000, nbits=0x1D00FFFF),
+        v2.SetTarget(channel_id=3, maximum_target=(1 << 200) - 1),
+        v2.SubmitSharesStandard(channel_id=3, sequence_number=1, job_id=11,
+                                nonce=0xDEADBEEF, ntime=1700000001,
+                                version=0x20000000),
+        v2.SubmitSharesSuccess(channel_id=3, last_sequence_number=1,
+                               new_submits_accepted_count=1,
+                               new_shares_sum=5),
+        v2.SubmitSharesError(channel_id=3, sequence_number=2,
+                             error_code="duplicate-share"),
+    ]
+    for m in msgs:
+        assert _roundtrip(m) == m
+
+
+def test_decode_rejects_garbage():
+    with pytest.raises(v2.Sv2DecodeError):
+        v2.decode_message(0x7F, b"")
+    with pytest.raises(v2.Sv2DecodeError):
+        v2.SetupConnection.decode(b"\x00\x02")  # truncated
+    with pytest.raises(v2.Sv2DecodeError):
+        # trailing bytes after a full message must not pass silently
+        v2.SetTarget.decode(v2.SetTarget(1, 2).encode() + b"\x00")
+
+
+def _test_job(share_target: int) -> Job:
+    return Job(
+        job_id="j1", prev_hash=bytes(32), coinb1=b"\x01\x02",
+        coinb2=b"\x03\x04", merkle_branch=[b"\x05" * 32],
+        version=0x20000000, nbits=0x1D00FFFF, ntime=int(time.time()),
+        extranonce1=b"", extranonce2_size=4, share_target=share_target,
+    )
+
+
+def _mine(job: Job, en2: bytes, target: int, version: int,
+          start: int = 0) -> int:
+    """Find a nonce meeting the channel target using the same math the
+    server validates with — so an accept proves both ends agree."""
+    ntime = job.ntime
+    for nonce in range(start, start + 200000):
+        header = jobmod.header_from_share(job, en2, ntime, nonce)
+        header = struct.pack("<I", version) + header[4:]
+        if tgt.hash_meets_target(pow_digest(header, "sha256d"), target):
+            return nonce
+    raise AssertionError("no share found in window (target too hard?)")
+
+
+@pytest.mark.asyncio
+async def test_sv2_loopback_end_to_end():
+    accepted = []
+
+    async def on_share(share):  # stratum.server.AcceptedShare
+        accepted.append(share)
+
+    # target ~2^248 (p = 1/256 per hash) so the mining loop is instant
+    cfg = v2.Sv2ServerConfig(port=0, initial_difficulty=1 / (1 << 24))
+    server = v2.Sv2MiningServer(cfg, on_share=on_share)
+    await server.start()
+    job = _test_job(share_target=tgt.difficulty_to_target(
+        cfg.initial_difficulty))
+    server.set_job(job)
+
+    client = v2.Sv2MiningClient("127.0.0.1", server.port, user="w.sv2")
+    await client.connect()
+    assert client.channel is not None and client.target is not None
+
+    # job + prevhash arrive on channel open (freshest job auto-sent)
+    while not (client.jobs and client.prevhash):
+        await client.pump()
+    jid = max(client.jobs)
+    nm = client.jobs[jid]
+    assert nm.version == job.version
+    assert client.prevhash.nbits == job.nbits
+
+    # the advertised merkle root must equal the channel-extranonce root
+    en2 = server._channel_extranonce2(
+        server._channels[client.channel.channel_id][0], job
+    )
+    want_root = jobmod.merkle_root(
+        jobmod.build_coinbase(job, en2), job.merkle_branch
+    )
+    assert nm.merkle_root == want_root
+
+    # mine a real share against the channel target and submit it
+    nonce = _mine(job, en2, client.target, job.version)
+    res = await client.submit(jid, nonce, job.ntime, job.version)
+    assert isinstance(res, v2.SubmitSharesSuccess)
+    assert server.stats["shares_accepted"] == 1
+    assert len(accepted) == 1
+    # the hook got the V1-shaped AcceptedShare with the exact header
+    assert pow_digest(accepted[0].header, "sha256d") == accepted[0].digest
+    assert accepted[0].worker_user == "w.sv2"
+    assert accepted[0].actual_difficulty >= accepted[0].difficulty
+
+    # duplicate -> rejected
+    res = await client.submit(jid, nonce, job.ntime, job.version)
+    assert isinstance(res, v2.SubmitSharesError)
+    assert res.error_code == "duplicate-share"
+
+    # garbage nonce -> difficulty-too-low
+    res = await client.submit(jid, nonce ^ 0x5A5A5A5A, job.ntime,
+                              job.version)
+    assert isinstance(res, v2.SubmitSharesError)
+    assert res.error_code == "difficulty-too-low"
+
+    # unknown job id -> stale
+    res = await client.submit(9999, nonce, job.ntime, job.version)
+    assert isinstance(res, v2.SubmitSharesError)
+    assert res.error_code == "stale-job"
+
+    # a clean job broadcast reaches the open channel
+    job2 = _test_job(job.share_target)
+    jid2 = server.set_job(job2)
+    while jid2 not in client.jobs:
+        await client.pump()
+
+    await client.close()
+    await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_sv2_rides_pool_mode():
+    """stratum.v2_enabled serves SV2 alongside V1 from the same app,
+    fed by the same template loop (mock chain)."""
+    from otedama_tpu.app import Application
+    from otedama_tpu.config.schema import AppConfig
+
+    cfg = AppConfig()
+    cfg.pool.enabled = True
+    cfg.pool.database = ":memory:"
+    cfg.stratum.enabled = True
+    cfg.stratum.host = "127.0.0.1"
+    cfg.stratum.port = 0
+    cfg.stratum.v2_enabled = True
+    cfg.stratum.v2_port = 0
+    cfg.stratum.initial_difficulty = 1 / (1 << 24)  # minable in-test
+    cfg.mining.enabled = False
+    cfg.api.enabled = False
+    cfg.p2p.enabled = False
+    app = Application(cfg)
+    await app.start()
+    try:
+        assert app.server_v2 is not None
+        # template loop publishes the same job to both servers
+        for _ in range(100):
+            if app.server_v2._jobs:
+                break
+            await asyncio.sleep(0.05)
+        assert app.server_v2._jobs, "no SV2 job from the template loop"
+        client = v2.Sv2MiningClient("127.0.0.1", app.server_v2.port)
+        await client.connect()
+        while not (client.jobs and client.prevhash):
+            await client.pump()
+
+        # mine + submit a real share: it must land in POOL ACCOUNTING
+        # (same on_share hook as the V1 wire), not just a success frame
+        jid = max(client.jobs)
+        job = app.server_v2._jobs[jid][0]
+        chan = app.server_v2._channels[client.channel.channel_id][0]
+        en2 = app.server_v2._channel_extranonce2(chan, job)
+        nonce = _mine(job, en2, client.target, job.version)
+        res = await client.submit(jid, nonce, job.ntime, job.version)
+        assert isinstance(res, v2.SubmitSharesSuccess)
+        rows = app.db.query("SELECT worker, difficulty FROM shares")
+        assert len(rows) == 1
+        await client.close()
+    finally:
+        await app.stop()
+
+
+@pytest.mark.asyncio
+async def test_sv2_rejects_non_mining_protocol():
+    server = v2.Sv2MiningServer(v2.Sv2ServerConfig(port=0))
+    await server.start()
+    reader, writer = await asyncio.open_connection("127.0.0.1", server.port)
+    writer.write(v2.pack_frame(
+        v2.MSG_SETUP_CONNECTION,
+        v2.SetupConnection(protocol=1).encode(),  # job-negotiation, not mining
+    ))
+    _, mtype, payload = await v2.read_frame(reader)
+    msg = v2.decode_message(mtype, payload)
+    assert isinstance(msg, v2.SetupConnectionError)
+    assert msg.error_code == "unsupported-protocol"
+    writer.close()
+    await server.stop()
