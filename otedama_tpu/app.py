@@ -189,6 +189,7 @@ class Application:
                     host=cfg.stratum.host,
                     port=cfg.stratum.v2_port,
                     initial_difficulty=cfg.stratum.initial_difficulty,
+                    max_clients=cfg.stratum.max_clients,
                 ),
                 on_share=self.pool.on_share,
                 on_block=self.pool.on_block,

@@ -489,6 +489,10 @@ class Sv2ServerConfig:
     job_max_age: float = 300.0
     ntime_slack: int = 600
     max_channels_per_conn: int = 16
+    max_clients: int = 10000   # same listener cap the V1 server enforces
+    # BIP320: only bits 13..28 of the header version are miner-rollable;
+    # anything outside would make a solved block invalid on the network
+    version_rolling_mask: int = 0x1FFFE000
     # a stalled peer must not buffer unbounded job broadcasts in process
     # memory: past this transport backlog the channel stops receiving
     # (and a dead TCP peer gets reaped by its read loop)
@@ -523,6 +527,7 @@ class Sv2MiningServer:
         self.on_block = on_block   # async fn(header, Job, AcceptedShare)
         self._server: asyncio.AbstractServer | None = None
         self._channels: dict[int, tuple[Sv2Channel, asyncio.StreamWriter]] = {}
+        self._conns: set[asyncio.StreamWriter] = set()
         self._jobs: dict[int, tuple[Job, float]] = {}
         self._job_seq = 0
         self._chan_seq = 0
@@ -538,6 +543,14 @@ class Sv2MiningServer:
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
+        # release established peers too (their read loops would otherwise
+        # linger until the remote hangs up — V1 server parity)
+        for writer in list(self._conns):
+            try:
+                writer.close()
+            except Exception:
+                pass
+        self._conns.clear()
         self._channels.clear()
 
     @property
@@ -606,6 +619,10 @@ class Sv2MiningServer:
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
+        if len(self._conns) >= self.config.max_clients:
+            writer.close()  # listener cap — V1 server parity
+            return
+        self._conns.add(writer)
         self.stats["connections"] += 1
         conn_channels: list[int] = []
         try:
@@ -617,7 +634,14 @@ class Sv2MiningServer:
                             ).encode())
                 await writer.drain()
                 return
-            setup = SetupConnection.decode(payload)
+            try:
+                setup = SetupConnection.decode(payload)
+            except Sv2DecodeError:
+                self._write(writer, MSG_SETUP_CONNECTION_ERROR,
+                            SetupConnectionError(
+                                error_code="malformed-setup").encode())
+                await writer.drain()
+                return
             if (setup.protocol != PROTOCOL_MINING
                     or setup.min_version > SV2_VERSION
                     or setup.max_version < SV2_VERSION):
@@ -651,6 +675,7 @@ class Sv2MiningServer:
         finally:
             for cid in conn_channels:
                 self._channels.pop(cid, None)
+            self._conns.discard(writer)
             writer.close()
 
     async def _on_open_channel(self, msg: OpenStandardMiningChannel,
@@ -720,11 +745,15 @@ class Sv2MiningServer:
         if abs(int(msg.ntime) - job.ntime) > self.config.ntime_slack:
             await reject("invalid-ntime")
             return
+        # BIP320 discipline: only the rollable bits may differ from the
+        # job's version, or a solved block would be invalid on-chain
+        if (msg.version ^ job.version) & ~self.config.version_rolling_mask:
+            await reject("invalid-version")
+            return
         key = (msg.job_id, msg.nonce, msg.ntime, msg.version)
         if key in chan.seen_shares:
             await reject("duplicate-share")
             return
-        chan.seen_shares.add(key)
         # exact reconstruction: channel-fixed extranonce2, share-rolled
         # version word (SV2 version-rolling is first-class)
         en2 = self._channel_extranonce2(chan, job)
@@ -732,8 +761,11 @@ class Sv2MiningServer:
         header = struct.pack("<I", msg.version) + header[4:]
         digest = pow_digest(header, job.algorithm)
         if not tgt.hash_meets_target(digest, chan.target):
+            # NOT remembered: garbage submissions must cost the submitter
+            # a recompute, not this process unbounded dedup memory
             await reject("difficulty-too-low")
             return
+        chan.seen_shares.add(key)
         chan.accepted += 1
         chan.shares_sum += 1
         self.stats["shares_accepted"] += 1

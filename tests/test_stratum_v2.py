@@ -153,6 +153,17 @@ async def test_sv2_loopback_end_to_end():
     assert isinstance(res, v2.SubmitSharesError)
     assert res.error_code == "stale-job"
 
+    # version bits outside the BIP320 rollable mask -> rejected before
+    # any PoW (a solved block with them would be invalid on-chain)
+    res = await client.submit(jid, nonce, job.ntime, job.version ^ 0x1)
+    assert isinstance(res, v2.SubmitSharesError)
+    assert res.error_code == "invalid-version"
+    # rolling WITHIN the mask is legal (re-mined for the new version)
+    rolled = job.version ^ 0x2000
+    nonce2 = _mine(job, en2, client.target, rolled)
+    res = await client.submit(jid, nonce2, job.ntime, rolled)
+    assert isinstance(res, v2.SubmitSharesSuccess)
+
     # a clean job broadcast reaches the open channel
     job2 = _test_job(job.share_target)
     jid2 = server.set_job(job2)
