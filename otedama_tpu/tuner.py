@@ -261,7 +261,9 @@ def main() -> None:  # pragma: no cover - device entry point
     ap.add_argument("--out", default=TUNED_PATH)
     args = ap.parse_args()
     rec = tune_kernel(batch=args.batch, out_path=args.out)
-    print(rec)
+    import json
+
+    print(json.dumps(rec))  # one JSON line: harvested by tools/tpu_battery
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -1,0 +1,132 @@
+"""Round-4 TPU measurement battery.
+
+One command produces every artifact the round needs once the device is
+reachable, in priority order, each step isolated in its OWN subprocess
+(a wedged tunnel mid-battery must not take down the later steps — the
+r3 post-mortem) with a per-step timeout and the JSON line captured to a
+BENCH_*_r04.json artifact:
+
+  1. sha256d headline (bench.py)                 -> BENCH_R04_sha256d.json
+  2. scrypt pallas tier (r3 baseline config)     -> BENCH_R04_scrypt_pallas.json
+  3. scrypt fused + fused-half (gather-free A/B) -> BENCH_R04_scrypt_fused*.json
+  4. x11 device chain, table vs compute S-box    -> BENCH_R04_x11_*.json
+  5. ethash light + full-DAG                     -> BENCH_R04_ethash.json
+  6. engine-path e2e                             -> BENCH_R04_engine.json
+  7. tuner finalist validation at 2^31           -> BENCH_R04_tune.json
+
+Run: python tools/tpu_battery.py [--only step,step] [--skip step,...]
+Steps run even if earlier ones fail; the summary JSON (BATTERY_r04.json)
+records per-step status/duration so a partial battery is still evidence.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _env(extra: dict | None = None) -> dict:
+    env = dict(os.environ)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", str(REPO / ".jax_cache"))
+    env.update(extra or {})
+    return env
+
+
+STEPS: list[tuple[str, list[str], dict, int]] = [
+    # (name, argv, extra_env, timeout_seconds)
+    ("sha256d",
+     [sys.executable, "bench.py"], {}, 2400),
+    ("scrypt_pallas",
+     [sys.executable, "bench.py", "--algo", "scrypt"], {}, 2400),
+    ("scrypt_fused",
+     [sys.executable, "bench.py", "--algo", "scrypt",
+      "--scrypt-tier", "fused"], {}, 2400),
+    ("scrypt_fused_half",
+     [sys.executable, "bench.py", "--algo", "scrypt",
+      "--scrypt-tier", "fused-half"], {}, 2400),
+    ("x11_compute",
+     [sys.executable, "bench.py", "--algo", "x11", "--x11-backend", "jax"],
+     {"OTEDAMA_X11_SBOX": "compute"}, 3600),
+    ("x11_table",
+     [sys.executable, "bench.py", "--algo", "x11", "--x11-backend", "jax"],
+     {"OTEDAMA_X11_SBOX": "table"}, 3600),
+    ("ethash",
+     [sys.executable, "bench.py", "--algo", "ethash"], {}, 3000),
+    ("engine",
+     [sys.executable, "bench.py", "--engine-path"], {}, 1800),
+    # full grid + finalist validation at 2^31 (the run the r3 tunnel
+    # outage interrupted)
+    ("tune",
+     [sys.executable, "-m", "otedama_tpu.tuner"], {}, 5400),
+]
+
+
+def run_step(name: str, argv: list[str], extra_env: dict,
+             timeout: int) -> dict:
+    t0 = time.monotonic()
+    print(f"=== {name}: {' '.join(argv)}", flush=True)
+    try:
+        proc = subprocess.run(
+            argv, cwd=REPO, env=_env(extra_env), timeout=timeout,
+            capture_output=True, text=True,
+        )
+        out_lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+        last_json = None
+        for ln in reversed(out_lines):
+            try:
+                last_json = json.loads(ln)
+                break
+            except json.JSONDecodeError:
+                continue
+        status = "ok" if proc.returncode == 0 and last_json else "failed"
+        result = {
+            "status": status, "returncode": proc.returncode,
+            "seconds": round(time.monotonic() - t0, 1),
+            "result": last_json,
+            "stderr_tail": proc.stderr.strip().splitlines()[-8:],
+        }
+    except subprocess.TimeoutExpired:
+        result = {"status": "timeout",
+                  "seconds": round(time.monotonic() - t0, 1)}
+    if result.get("result"):
+        (REPO / f"BENCH_R04_{name}.json").write_text(
+            json.dumps(result["result"]) + "\n"
+        )
+    print(f"=== {name}: {result['status']} "
+          f"({result['seconds']:.0f}s)", flush=True)
+    return result
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--only", default="",
+                    help="comma-separated step names to run")
+    ap.add_argument("--skip", default="", help="steps to skip")
+    args = ap.parse_args()
+    only = set(filter(None, args.only.split(",")))
+    skip = set(filter(None, args.skip.split(",")))
+
+    summary: dict = {"started": time.time(), "steps": {}}
+    for name, argv, extra_env, timeout in STEPS:
+        if (only and name not in only) or name in skip:
+            summary["steps"][name] = {"status": "skipped"}
+            continue
+        summary["steps"][name] = run_step(name, argv, extra_env, timeout)
+        # keep the partial battery on disk after every step
+        (REPO / "BATTERY_r04.json").write_text(
+            json.dumps(summary, indent=2) + "\n"
+        )
+    ok = sum(1 for s in summary["steps"].values() if s["status"] == "ok")
+    print(f"battery done: {ok}/{len(summary['steps'])} ok")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
