@@ -374,10 +374,12 @@ def _derive_items_device(cache_d, rows: int, idx):
     return _keccak512_words_device(mix, 64)
 
 
-def _hashimoto_device(full_size: int, item_fn, header_hash: bytes,
+def _hashimoto_device(full_size: int, page_fn, header_hash: bytes,
                       nonces: np.ndarray):
-    """Batched hashimoto given ``item_fn(p) -> [B, 16]`` page items — ONE
-    device copy of the access loop, cmix fold, and keccak-256 seal.
+    """Batched hashimoto given ``page_fn(page) -> [B, 32]`` — one CALL
+    per 128-byte mix page (so a resident-DAG tier pays ONE row gather
+    per access, not two 64-byte ones) — with ONE device copy of the
+    access loop, cmix fold, and keccak-256 seal.
     Returns (mix_digests [B, 32] u8, results [B, 32] u8)."""
     import jax.numpy as jnp
     from jax import lax
@@ -389,9 +391,8 @@ def _hashimoto_device(full_size: int, item_fn, header_hash: bytes,
 
     def access(mix, i):
         col = jnp.take(mix, i % 32, axis=1)
-        p = (_fnv_device(i ^ s_words[:, 0], col) % jnp.uint32(n_pages)) * 2
-        nd = jnp.concatenate([item_fn(p), item_fn(p + 1)], axis=1)
-        return _fnv_device(mix, nd), None
+        page = _fnv_device(i ^ s_words[:, 0], col) % jnp.uint32(n_pages)
+        return _fnv_device(mix, page_fn(page)), None
 
     mix, _ = lax.scan(access, mix, jnp.arange(ACCESSES, dtype=jnp.uint32))
     cmix = _fnv_device(
@@ -434,11 +435,16 @@ def hashimoto_light_device(
         # array (EthashLightBackend keeps the epoch cache HBM-resident);
         # a numpy cache uploads here
         cache_d = jnp.asarray(cache)
-        return _hashimoto_device(
-            full_size,
-            lambda p: _derive_items_device(cache_d, rows, p),
-            header_hash, nonces,
-        )
+
+        def page_fn(page):
+            p = page * jnp.uint32(2)
+            return jnp.concatenate(
+                [_derive_items_device(cache_d, rows, p),
+                 _derive_items_device(cache_d, rows, p + 1)],
+                axis=1,
+            )
+
+        return _hashimoto_device(full_size, page_fn, header_hash, nonces)
 
 
 def hashimoto_full(
@@ -498,16 +504,22 @@ def hashimoto_full_device(
     header_hash: bytes,
     nonces: np.ndarray,
 ) -> tuple[np.ndarray, np.ndarray]:
-    """Batched full-dataset hashimoto: per access, two DIRECT 64-byte row
-    gathers from the HBM-resident DAG — no cache folds, no keccaks inside
-    the access loop. Returns (mix_digests [B,32] u8, results [B,32] u8)."""
+    """Batched full-dataset hashimoto: per access, ONE direct 128-byte
+    PAGE gather from the HBM-resident DAG — no cache folds, no keccaks
+    inside the access loop. ``dataset_d`` may be item-major
+    ``[n_items, 16]`` or already page-major ``[n_pages, 32]``; callers
+    with a long-lived DAG should store it page-major once
+    (EthashLightBackend does) so per-chunk calls never reshape the
+    multi-GB tensor. Returns (mix_digests [B,32], results [B,32]) u8."""
     import jax
     import jax.numpy as jnp
 
     with jax.enable_x64():
+        pages_d = (dataset_d if dataset_d.shape[-1] == 32
+                   else jnp.reshape(dataset_d, (-1, 32)))
         return _hashimoto_device(
             full_size,
-            lambda p: jnp.take(dataset_d, p, axis=0),
+            lambda page: jnp.take(pages_d, page, axis=0),
             header_hash, nonces,
         )
 

@@ -590,12 +590,15 @@ class EthashLightBackend:
             self._cache_dev = jnp.asarray(self.cache)
         if self.full_dataset:
             # one-off per-epoch: the whole DAG generated on device and
-            # kept HBM-resident; per-hash work then drops to 64x2 direct
-            # row gathers (no in-loop cache folds or keccaks). Hand the
-            # builder the already-uploaded cache and drop our copy after —
-            # full-mode search never touches the cache again
-            self._dataset_dev = eth.build_dataset_device(
-                self._cache_dev, self.full_size
+            # kept HBM-resident; per-hash work then drops to one direct
+            # 128-byte PAGE gather per access (no in-loop cache folds or
+            # keccaks). Stored page-major [n_pages, 32] ONCE here so
+            # search chunks never pay a reshape of the multi-GB tensor.
+            # Hand the builder the already-uploaded cache and drop our
+            # copy after — full-mode search never touches it again
+            self._dataset_dev = jnp.reshape(
+                eth.build_dataset_device(self._cache_dev, self.full_size),
+                (-1, 32),
             )
             self._cache_dev = None
             self.name = "ethash-full"

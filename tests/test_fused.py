@@ -30,6 +30,54 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def test_cli_fused_pod_routing(monkeypatch):
+    """--fused-pod: followers run the lockstep compute loop and exit;
+    misconfiguration (no coordinator env) fails with a clear message
+    instead of starting a silently-unfused app."""
+    from types import SimpleNamespace
+
+    from otedama_tpu import cli
+    from otedama_tpu.config.schema import AppConfig
+    from otedama_tpu.runtime import dcn
+
+    # no env contract -> explicit error exit
+    cfg = AppConfig()
+    monkeypatch.setattr(dcn, "maybe_initialize", lambda: None)
+    rc = cli._maybe_fused(SimpleNamespace(fused_pod=True), cfg)
+    assert rc == 2
+
+    # follower rank: runs follower_loop, never the app
+    ran = {}
+    monkeypatch.setattr(
+        dcn, "maybe_initialize",
+        lambda: dcn.DcnConfig("h:1", num_processes=2, process_id=1),
+    )
+    import otedama_tpu.runtime.fused as fused
+
+    monkeypatch.setattr(fused, "FusedPodDriver", lambda: "driver")
+    monkeypatch.setattr(
+        fused, "follower_loop",
+        lambda d: ran.setdefault("steps", 3) or 3,
+    )
+    rc = cli._maybe_fused(SimpleNamespace(fused_pod=True), cfg)
+    assert rc == 0 and ran["steps"] == 3
+
+    # leader rank: returns None (proceed into the app) with the
+    # fused-pod backend selected
+    monkeypatch.setattr(
+        dcn, "maybe_initialize",
+        lambda: dcn.DcnConfig("h:1", num_processes=2, process_id=0),
+    )
+    cfg2 = AppConfig()
+    assert cli._maybe_fused(SimpleNamespace(fused_pod=True), cfg2) is None
+    assert cfg2.mining.backend == "fused-pod"
+
+    # flag off -> untouched
+    cfg3 = AppConfig()
+    assert cli._maybe_fused(SimpleNamespace(fused_pod=False), cfg3) is None
+    assert cfg3.mining.backend != "fused-pod"
+
+
 def test_fused_pod_two_processes():
     port = _free_port()
     env = dict(os.environ)

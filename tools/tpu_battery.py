@@ -92,9 +92,20 @@ def run_step(name: str, argv: list[str], extra_env: dict,
             "result": last_json,
             "stderr_tail": proc.stderr.strip().splitlines()[-8:],
         }
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        def _tail(raw) -> list[str]:
+            if not raw:
+                return []
+            if isinstance(raw, bytes):
+                raw = raw.decode("utf-8", "replace")
+            return raw.strip().splitlines()[-8:]
+
+        # the partial output says WHERE the step wedged — exactly what a
+        # post-mortem of a hung tunnel needs
         result = {"status": "timeout",
-                  "seconds": round(time.monotonic() - t0, 1)}
+                  "seconds": round(time.monotonic() - t0, 1),
+                  "stdout_tail": _tail(e.stdout),
+                  "stderr_tail": _tail(e.stderr)}
     if result.get("result"):
         (REPO / f"BENCH_R04_{name}.json").write_text(
             json.dumps(result["result"]) + "\n"
@@ -112,6 +123,13 @@ def main() -> int:
     args = ap.parse_args()
     only = set(filter(None, args.only.split(",")))
     skip = set(filter(None, args.skip.split(",")))
+    known = {s[0] for s in STEPS}
+    unknown = (only | skip) - known
+    if unknown:
+        # a typo'd step name must not silently skip a hard-won device
+        # session's whole battery
+        ap.error(f"unknown step(s) {sorted(unknown)}; "
+                 f"known: {sorted(known)}")
 
     summary: dict = {"started": time.time(), "steps": {}}
     for name, argv, extra_env, timeout in STEPS:
