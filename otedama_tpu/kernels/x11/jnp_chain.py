@@ -769,7 +769,7 @@ def echo512_64(data, sbox_mode: str | None = None):
     V = jnp.broadcast_to(iv_word, (Bn, 8, 16))
     state = jnp.concatenate([V, M], axis=1)  # [B, 16, 16]
     keys, big_shift = _echo_keys()
-    sbox_fn, muls = _resolve_sbox(sbox_mode)
+    _, muls = _resolve_sbox(sbox_mode)
     m2f, m3f = muls[2], muls[3]
     zero_key = jnp.zeros(16, dtype=U8)
 
@@ -780,7 +780,7 @@ def echo512_64(data, sbox_mode: str | None = None):
         krows = jnp.broadcast_to(kround[None], (Bn, 16, 16)).reshape(
             Bn * 16, 16)
         w = _aes_round_j(flat, krows, sbox_mode)
-        w = _aes_round_j(w, jnp.zeros(16, dtype=U8), sbox_mode)
+        w = _aes_round_j(w, zero_key, sbox_mode)
         st = w.reshape(Bn, 16, 16)[:, big_shift, :]
         cols = st.reshape(st.shape[0], 4, 4, 16)
         a0, a1 = cols[:, :, 0], cols[:, :, 1]
