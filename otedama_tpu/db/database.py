@@ -13,11 +13,90 @@ driver-gated) behind the identical surface.
 from __future__ import annotations
 
 import logging
+import re
 import sqlite3
 import threading
 import time
 
 log = logging.getLogger("otedama.db")
+
+def split_statements(script: str) -> list[str]:
+    """Split a multi-statement SQL script on ``;`` — but never inside a
+    single-quoted literal or a dollar-quoted body ($$...$$ / $tag$...),
+    so a migration carrying either cannot be mis-split (advisor r4).
+    Shared by the sqlite and postgres migrate() paths (sqlite never emits
+    dollar quotes, where ``$tag$`` is just ordinary text — but treating
+    it as a quote is harmless for this schema's DDL and keeps ONE
+    splitter for one MIGRATIONS list). Returns non-empty statements,
+    quotes left intact."""
+    stmts: list[str] = []
+    buf: list[str] = []
+    i, n = 0, len(script)
+    dollar_tag: str | None = None
+    body_start = 0  # first index past the opening tag (close must not overlap)
+    in_squote = False
+    while i < n:
+        ch = script[i]
+        if dollar_tag is not None:
+            buf.append(ch)
+            if (ch == "$"
+                    and i - len(dollar_tag) + 1 >= body_start
+                    and script[i - len(dollar_tag) + 1:i + 1] == dollar_tag):
+                dollar_tag = None
+            i += 1
+            continue
+        if in_squote:
+            buf.append(ch)
+            if ch == "'":
+                # '' is an escaped quote, stay inside the literal
+                if i + 1 < n and script[i + 1] == "'":
+                    buf.append("'")
+                    i += 1
+                else:
+                    in_squote = False
+            i += 1
+            continue
+        if ch == "-" and script[i:i + 2] == "--":
+            # -- line comment: an apostrophe in it must not flip quote
+            # state (MIGRATIONS carry such comments today)
+            end = script.find("\n", i)
+            end = n if end == -1 else end
+            buf.append(script[i:end])
+            i = end
+            continue
+        if ch == "/" and script[i:i + 2] == "/*":
+            end = script.find("*/", i + 2)
+            end = n if end == -1 else end + 2
+            buf.append(script[i:end])
+            i = end
+            continue
+        if ch == "'":
+            in_squote = True
+            buf.append(ch)
+        elif ch == "$":
+            # postgres tag rule: empty ($$) or letter/underscore first,
+            # then letters/digits/underscores
+            m = re.match(r"\$(?:[A-Za-z_][A-Za-z0-9_]*)?\$", script[i:])
+            if m:
+                dollar_tag = m.group(0)
+                buf.append(dollar_tag)
+                i += len(dollar_tag)
+                body_start = i
+                continue
+            buf.append(ch)
+        elif ch == ";":
+            stmt = "".join(buf).strip()
+            if stmt:
+                stmts.append(stmt)
+            buf = []
+        else:
+            buf.append(ch)
+        i += 1
+    tail = "".join(buf).strip()
+    if tail:
+        stmts.append(tail)
+    return stmts
+
 
 MIGRATIONS: list[tuple[int, str]] = [
     (1, """
@@ -145,9 +224,8 @@ class Database(AuditMixin):
                 # run the statements inside one explicit transaction
                 self._conn.execute("BEGIN")
                 try:
-                    for stmt in sql.split(";"):
-                        if stmt.strip():
-                            self._conn.execute(stmt)
+                    for stmt in split_statements(sql):
+                        self._conn.execute(stmt)
                     self._conn.execute(f"PRAGMA user_version = {version}")
                     self._conn.execute("COMMIT")
                 except Exception:

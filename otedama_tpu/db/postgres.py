@@ -28,7 +28,7 @@ import re
 import threading
 import time
 
-from otedama_tpu.db.database import MIGRATIONS, AuditMixin
+from otedama_tpu.db.database import MIGRATIONS, AuditMixin, split_statements
 
 log = logging.getLogger("otedama.db.postgres")
 
@@ -135,9 +135,9 @@ class PostgresDatabase(AuditMixin):
                     with self._cursor() as cur:
                         cur.execute("BEGIN")
                         try:
-                            for stmt in translate_ddl(sql).split(";"):
-                                if stmt.strip():
-                                    cur.execute(stmt)
+                            for stmt in split_statements(
+                                    translate_ddl(sql)):
+                                cur.execute(stmt)
                             cur.execute(
                                 "INSERT INTO schema_migrations "
                                 "VALUES (%s, %s)",

@@ -283,6 +283,30 @@ class PodSearch:
         per_chip = -(-per_chip // self.tile) * self.tile  # round up to tiles
         scanned = per_chip * self.n_chips                 # >= count (overscan)
 
+        if count < per_chip and count <= (self.tile << 2):
+            # the whole request fits inside one chip's batch (n_full == 0):
+            # the device step's chip-granular best mask would mask EVERY
+            # chip and telemetry would collapse to the sentinel (advisor
+            # r4). For these few-tile windows one host-path scan over
+            # exactly the requested lanes is authoritative — exact best
+            # AND exact winners — so skip the pod dispatch entirely
+            # rather than launching it and discarding its outputs
+            # (review r5). The condition depends only on host-identical
+            # values, so multi-controller processes stay in lockstep.
+            results = []
+            for jc in jcs:
+                res = self._rescan.search(jc, base, count)
+                results.append(SearchResult(res.winners, count,
+                                            res.best_hash_hi))
+            # same unit as the device path: flagged TILES, not winners
+            self.last_pod_flagged = sum(
+                len({((w.nonce_word - base) & 0xFFFFFFFF) // self.tile
+                     for w in r.winners})
+                for r in results
+            )
+            self.last_pod_best = min(r.best_hash_hi for r in results)
+            return results
+
         # numpy (uncommitted) inputs: in multi-controller mode every
         # process passes identical host values and jit shards them per the
         # shard_map specs — a committed single-device jnp array would be
@@ -304,6 +328,10 @@ class PodSearch:
         for r, jc in enumerate(jcs):
             winners: list[Winner] = []
             row_best = 0xFFFFFFFF
+            # NB n_full == 0 is still possible here (count < per_chip on
+            # a 1-chip mesh past the small-window bound above): best-hash
+            # telemetry keeps the conservative sentinel for that case —
+            # an unbounded host rescan would duplicate the device search
             for c in range(self.n_chips):
                 n_flagged = int(st[r, c, 0])
                 if c < n_full:

@@ -126,3 +126,59 @@ def test_certify_rejects_bad_vectors(cert_env, tmp_path, certify,
     assert not cert_env.exists()  # nothing certified
     report = json.loads(capsys.readouterr().out)
     assert report["x11_pass"] is False
+
+
+def test_sv2_certify_roundtrip(cert_env, tmp_path, certify, monkeypatch):
+    """A captured third-party frame that decodes + re-encodes byte-exact
+    certifies SV2 interop; the artifact fingerprint flips the module's
+    INTEROP_VERIFIED at (re)import; a drifted codec refuses. The 'capture'
+    here is self-generated — it proves the harness path, not interop."""
+    import importlib
+
+    from otedama_tpu.stratum import v2
+
+    frame = v2.pack_frame(v2.MSG_NEW_MINING_JOB, v2.NewMiningJob(
+        channel_id=9, job_id=1, future_job=False, version=0x20000000,
+        merkle_root=bytes(32)).encode())
+    vf = tmp_path / "vectors.json"
+    vf.write_text(json.dumps({"sv2_frame_vectors": [
+        {"name": "new_mining_job", "frame_hex": frame.hex()},
+    ]}))
+    monkeypatch.setattr(sys, "argv", ["certify.py", str(vf), "--apply"])
+    assert certify.main() == 0
+    data = json.loads(cert_env.read_text())
+    assert data["sv2"]["fingerprint"] == v2.interop_fingerprint()
+
+    try:
+        assert v2._interop_verified() is True
+        # client no longer refuses a third-party endpoint once verified
+        importlib.reload(v2)
+        assert v2.INTEROP_VERIFIED is True
+        v2.Sv2MiningClient("pool.example.com", 3336)
+        # fingerprint mismatch (drifted codec) un-verifies
+        data["sv2"]["fingerprint"] = "00" * 32
+        cert_env.write_text(json.dumps(data))
+        assert v2._interop_verified() is False
+    finally:
+        cert_env.unlink()
+        importlib.reload(v2)
+        assert v2.INTEROP_VERIFIED is False
+
+
+def test_certify_rejects_corrupt_sv2_frame(cert_env, tmp_path, certify,
+                                           monkeypatch, capsys):
+    from otedama_tpu.stratum import v2
+
+    frame = bytearray(v2.pack_frame(v2.MSG_SET_TARGET, v2.SetTarget(
+        channel_id=1, maximum_target=1 << 200).encode()))
+    frame[7] ^= 0xFF  # corrupt a payload byte -> re-encode can't match
+    frame += b"\x00"  # and break the length field
+    vf = tmp_path / "vectors.json"
+    vf.write_text(json.dumps({"sv2_frame_vectors": [
+        {"name": "bad", "frame_hex": bytes(frame).hex()},
+    ]}))
+    monkeypatch.setattr(sys, "argv", ["certify.py", str(vf), "--apply"])
+    assert certify.main() == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["sv2_pass"] is False
+    assert not cert_env.exists()

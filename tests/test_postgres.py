@@ -152,3 +152,47 @@ def test_postgres_migrations_and_repos():
         assert rows and rows[0]["action"] == "switch"
     finally:
         db.close()
+
+
+def test_split_statements_respects_literals():
+    # lives in db.database: ONE splitter for the shared MIGRATIONS list,
+    # used by both the sqlite and postgres migrate() paths
+    from otedama_tpu.db.database import split_statements
+
+    # plain multi-statement script
+    assert split_statements("CREATE TABLE a (x INT); CREATE INDEX i ON a(x);") == [
+        "CREATE TABLE a (x INT)", "CREATE INDEX i ON a(x)",
+    ]
+    # semicolon inside a single-quoted literal must not split
+    s = "INSERT INTO t VALUES ('a;b'); SELECT 1"
+    assert split_statements(s) == ["INSERT INTO t VALUES ('a;b')", "SELECT 1"]
+    # escaped quote ('') keeps the literal open
+    s = "INSERT INTO t VALUES ('it''s; fine'); SELECT 2"
+    assert split_statements(s) == [
+        "INSERT INTO t VALUES ('it''s; fine')", "SELECT 2",
+    ]
+    # dollar-quoted function body with semicolons stays one statement
+    fn = ("CREATE FUNCTION f() RETURNS int AS $body$ BEGIN RETURN 1; END; "
+          "$body$ LANGUAGE plpgsql")
+    assert split_statements(fn + "; SELECT 3") == [fn, "SELECT 3"]
+    # a $$ body whose content starts with '$' must not close on a window
+    # overlapping the opening tag (review r5)
+    assert split_statements("SELECT $$$ ; $$; SELECT 2") == [
+        "SELECT $$$ ; $$", "SELECT 2",
+    ]
+    # an apostrophe inside a -- comment must not flip quote state
+    # (MIGRATIONS carry -- comments today), ditto /* */ blocks
+    s = "CREATE TABLE t (\n  b INTEGER -- miner's atomic units\n); SELECT 4"
+    assert split_statements(s) == [
+        "CREATE TABLE t (\n  b INTEGER -- miner's atomic units\n)",
+        "SELECT 4",
+    ]
+    s = "SELECT /* don't; split */ 5; SELECT 6"
+    assert split_statements(s) == [
+        "SELECT /* don't; split */ 5", "SELECT 6",
+    ]
+    # postgres allows digits after the tag's first char: $v1$ is a tag
+    s = "CREATE FUNCTION g() AS $v1$ a; b $v1$ LANGUAGE sql; SELECT 7"
+    assert split_statements(s) == [
+        "CREATE FUNCTION g() AS $v1$ a; b $v1$ LANGUAGE sql", "SELECT 7",
+    ]

@@ -222,6 +222,28 @@ def test_pod_search_matches_single_device():
     assert res.best_hash_hi <= oracle_best
 
 
+def test_pod_search_small_window_keeps_best_telemetry():
+    """count < one chip batch masks EVERY chip at chip granularity; the
+    host-path recovery must still report the exact in-range best
+    (advisor r4: telemetry collapsed to the 0xFFFFFFFF sentinel)."""
+    import jax
+
+    from otedama_tpu.runtime.mesh import PodSearch, make_chip_mesh
+
+    mesh = make_chip_mesh(jax.devices())
+    pod = PodSearch(mesh, jnp_tile=256)
+    jc = JobConstants.from_header_prefix(HEADER, EASY_TARGET)
+    base, count = 77, 100  # << per_chip (256) -> n_full == 0
+    res = pod.search(jc, base, count)
+    oracle_best = min(
+        int.from_bytes(jc.digest_for((base + i) & 0xFFFFFFFF), "little")
+        >> 224
+        for i in range(count)
+    )
+    assert res.best_hash_hi == oracle_best != 0xFFFFFFFF
+    assert pod.last_pod_best == oracle_best
+
+
 def test_pod_search_2d_rows_are_distinct_jobs():
     """2D (host, chip) mesh: each row searches its own extranonce2 header
     (distinct midstates), winners recover per row, ICI telemetry aggregates."""

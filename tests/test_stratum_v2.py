@@ -23,7 +23,10 @@ def _roundtrip(msg):
     frame = v2.pack_frame(msg.MSG, msg.encode())
     ext, mtype = struct.unpack("<HB", frame[:3])
     length = int.from_bytes(frame[3:6], "little")
-    assert ext == 0 and mtype == msg.MSG and length == len(frame) - 6
+    # channel-scoped messages must carry the spec's channel_msg bit on
+    # the wire; everything else must leave extension_type clear
+    want_ext = v2.CHANNEL_MSG_BIT if msg.MSG in v2.CHANNEL_SCOPED else 0
+    assert ext == want_ext and mtype == msg.MSG and length == len(frame) - 6
     return v2.decode_message(mtype, frame[6:])
 
 
@@ -118,9 +121,7 @@ async def test_sv2_loopback_end_to_end():
     assert client.prevhash.nbits == job.nbits
 
     # the advertised merkle root must equal the channel-extranonce root
-    en2 = server._channel_extranonce2(
-        server._channels[client.channel.channel_id][0], job
-    )
+    en2 = server._channels[client.channel.channel_id][0].extranonce2
     want_root = jobmod.merkle_root(
         jobmod.build_coinbase(job, en2), job.merkle_branch
     )
@@ -213,7 +214,7 @@ async def test_sv2_rides_pool_mode():
         jid = max(client.jobs)
         job = app.server_v2._jobs[jid][0]
         chan = app.server_v2._channels[client.channel.channel_id][0]
-        en2 = app.server_v2._channel_extranonce2(chan, job)
+        en2 = chan.extranonce2
         nonce = _mine(job, en2, client.target, job.version)
         res = await client.submit(jid, nonce, job.ntime, job.version)
         assert isinstance(res, v2.SubmitSharesSuccess)
@@ -239,3 +240,28 @@ async def test_sv2_rejects_non_mining_protocol():
     assert msg.error_code == "unsupported-protocol"
     writer.close()
     await server.stop()
+
+
+def test_interop_gate_refuses_third_party_endpoints(monkeypatch):
+    # message ids are offline recall: refusing external endpoints must be
+    # enforced in code until a vector check flips INTEROP_VERIFIED.
+    # Pin the unverified state: on a machine where an operator has
+    # legitimately certified SV2 (certification.json present), the gate
+    # is open and this test would otherwise fail against real state
+    monkeypatch.setattr(v2, "INTEROP_VERIFIED", False)
+    with pytest.raises(ConnectionError, match="INTEROP_VERIFIED"):
+        v2.Sv2MiningClient("pool.example.com", 3336)
+    # loopback and the explicit override both construct fine
+    v2.Sv2MiningClient("127.0.0.1", 3336)
+    v2.Sv2MiningClient("pool.example.com", 3336, allow_uninterop=True)
+
+
+def test_set_job_rejects_divergent_extranonce_width():
+    import dataclasses
+
+    srv = v2.Sv2MiningServer()
+    job = _test_job(share_target=1 << 255)
+    wide = dataclasses.replace(job, extranonce2_size=8)
+    with pytest.raises(ValueError, match="extranonce2_size"):
+        srv.set_job(wide)
+    assert srv.set_job(job) == 1  # configured width still publishes

@@ -1,0 +1,132 @@
+"""Persistent TPU-capture loop for the measurement battery.
+
+Round 4 shipped four gather-war kernels and measured none of them: the
+tunnel was down for the whole round and the battery was attempted once.
+The r4 verdict's fix is cron-style persistence — "one good 2-hour window
+completes the whole battery".  This driver probes the device on a cycle,
+logs every attempt to ``BATTERY_PROBE_r05.jsonl`` (proof-of-attempt even
+if the tunnel never answers), and the moment a probe sees a real TPU it
+hands off to ``tools/tpu_battery.py``.
+
+Run: python tools/battery_loop.py [--interval 600] [--max-hours 11]
+Exits 0 after a completed battery, 1 if the window closes without one.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+PROBE_LOG = REPO / "BATTERY_PROBE_r05.jsonl"
+SUMMARY = REPO / "BATTERY_r05.json"
+
+sys.path.insert(0, str(REPO / "tools"))
+from tpu_battery import STEPS  # noqa: E402
+
+ALL_STEPS = [name for name, *_ in STEPS]
+
+# the probe runs in its own interpreter so a wedged tunnel kills the
+# child, never this loop
+PROBE_SRC = (
+    "import jax; d = jax.devices();"
+    "print(__import__('json').dumps("
+    "{'platform': d[0].platform, 'n': len(d)}))"
+)
+
+
+def probe(timeout: int) -> dict:
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", PROBE_SRC], cwd=REPO,
+            capture_output=True, text=True, timeout=timeout,
+        )
+        if proc.returncode == 0:
+            try:
+                # last line that parses: jax/plugin warnings may follow
+                # the JSON on stdout, and a hung-then-killed tunnel can
+                # leave stdout empty even at returncode 0
+                info = next(
+                    json.loads(ln)
+                    for ln in reversed(proc.stdout.strip().splitlines())
+                    if ln.lstrip().startswith("{")
+                )
+            except (StopIteration, json.JSONDecodeError):
+                return {"status": "error", "unparseable_stdout": True,
+                        "stdout_tail": proc.stdout.strip().splitlines()[-3:],
+                        "seconds": round(time.monotonic() - t0, 1)}
+            return {"status": "ok", **info,
+                    "seconds": round(time.monotonic() - t0, 1)}
+        return {"status": "error", "returncode": proc.returncode,
+                "stderr_tail": proc.stderr.strip().splitlines()[-3:],
+                "seconds": round(time.monotonic() - t0, 1)}
+    except subprocess.TimeoutExpired:
+        return {"status": "timeout",
+                "seconds": round(time.monotonic() - t0, 1)}
+
+
+def log(entry: dict) -> None:
+    entry["t"] = time.time()
+    with PROBE_LOG.open("a") as f:
+        f.write(json.dumps(entry) + "\n")
+    print(json.dumps(entry), flush=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=int, default=600,
+                    help="seconds between probe attempts")
+    ap.add_argument("--max-hours", type=float, default=11.0)
+    ap.add_argument("--probe-timeout", type=int, default=240)
+    args = ap.parse_args()
+
+    deadline = time.monotonic() + args.max_hours * 3600
+    attempt = 0
+    done_ok: set[str] = set()
+    while time.monotonic() < deadline:
+        attempt += 1
+        try:
+            res = probe(args.probe_timeout)
+        except Exception as e:  # the capture loop must survive anything
+            res = {"status": "error", "exception": repr(e)}
+        log({"attempt": attempt, "probe": res})
+        if res.get("status") == "ok" and res.get("platform") == "tpu":
+            remaining = [s for s in ALL_STEPS if s not in done_ok]
+            log({"attempt": attempt, "event": "tunnel up, battery start",
+                 "remaining": remaining})
+            argv = [sys.executable, "tools/tpu_battery.py"]
+            if done_ok:
+                # never redo a step that already produced its artifact —
+                # a partial window should finish the battery, not restart it
+                argv += ["--only", ",".join(remaining)]
+            bat = subprocess.run(argv, cwd=REPO)
+            # tpu_battery exits 0 if ANY step passed; completion is "every
+            # step has passed in SOME run this window", tracked here
+            try:
+                steps = json.loads(SUMMARY.read_text()).get("steps", {})
+            except (OSError, ValueError):
+                steps = {}
+            done_ok |= {n for n, s in steps.items()
+                        if s.get("status") == "ok"}
+            log({"attempt": attempt, "event": "battery done",
+                 "returncode": bat.returncode,
+                 "ok_so_far": sorted(done_ok)})
+            if all(s in done_ok for s in ALL_STEPS):
+                return 0
+            # steps remain (tunnel may have dropped mid-run): keep
+            # probing; the next good window runs only what's missing
+        time.sleep(max(0, min(args.interval,
+                              deadline - time.monotonic())))
+    log({"event": "window closed without a complete battery",
+         "attempts": attempt, "ok_steps": sorted(done_ok),
+         "missing": [s for s in ALL_STEPS if s not in done_ok]})
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

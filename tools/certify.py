@@ -21,8 +21,16 @@ section blocks that algorithm's certification):
   "x11_vectors":     [{"header_hex": ..., "hash_hex": ...}],
   "shavite512_vectors": [{"msg_hex": ..., "digest_hex": ...}],
   "ethash_vectors":  [{"block_number": N, "header_hash_hex": ...,
-                       "nonce": N-or-hex, "mix_hex": ..., "result_hex": ...}]
+                       "nonce": N-or-hex, "mix_hex": ..., "result_hex": ...}],
+  "sv2_frame_vectors": [{"name": ..., "frame_hex": ...}]
 }
+
+SV2 frame vectors are whole frames (6-byte header + payload) captured
+from a THIRD-PARTY Stratum V2 implementation (e.g. an SRI pool's
+NewMiningJob). Each must decode with this repo's codec and re-encode
+byte-exact; a full pass + --apply records stratum/v2.py's wire-behavior
+fingerprint, which flips ``v2.INTEROP_VERIFIED`` at next import (the
+client then stops refusing non-loopback endpoints).
 
 x11 certification requires the genesis check (and any extra vectors) to
 pass — the genesis chain exercises every stage including simd512 and
@@ -118,6 +126,44 @@ def check_ethash(vectors: dict, report: dict) -> bool:
     return ok
 
 
+def check_sv2(vectors: dict, report: dict) -> bool:
+    import struct
+
+    from otedama_tpu.stratum import v2
+
+    checks = []
+    for i, v in enumerate(vectors.get("sv2_frame_vectors", [])):
+        name = v.get("name", f"frame[{i}]") if isinstance(v, dict) else f"frame[{i}]"
+        try:
+            # a malformed vector entry (bad hex, missing key) must fail
+            # THIS check, not abort the whole report
+            frame = bytes.fromhex(v["frame_hex"])
+            ext, mtype = struct.unpack("<HB", frame[:3])
+            length = int.from_bytes(frame[3:6], "little")
+            if length != len(frame) - 6:
+                raise v2.Sv2DecodeError(
+                    f"length field {length} != payload {len(frame) - 6}")
+            msg = v2.decode_message(mtype, frame[6:])
+            # byte-exact re-encode: same ids, same channel bit, same
+            # field layout — anything short of identity is not interop
+            got = v2.pack_frame(mtype, msg.encode(),
+                                ext & ~v2.CHANNEL_MSG_BIT)
+            ok = got == frame
+            detail = {"got": got.hex(), "want": v["frame_hex"].lower()}
+        except (v2.Sv2DecodeError, struct.error, ValueError, KeyError,
+                TypeError) as e:
+            ok, detail = False, {"error": repr(e)}
+        checks.append({"check": f"sv2_{name}", "ok": ok, **detail})
+    report["sv2_checks"] = checks
+    ok = bool(checks) and all(c["ok"] for c in checks)
+    if ok:
+        report["sv2_certifiable"] = {
+            "fingerprint": v2.interop_fingerprint(),
+            "vectors_passed": len(checks),
+        }
+    return ok
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("vectors", help="JSON vector file (see module docstring)")
@@ -129,8 +175,10 @@ def main() -> int:
     report: dict = {"vectors_file": args.vectors}
     x11_ok = check_x11(vectors, report)
     eth_ok = check_ethash(vectors, report)
+    sv2_ok = check_sv2(vectors, report)
     report["x11_pass"] = x11_ok
     report["ethash_pass"] = eth_ok
+    report["sv2_pass"] = sv2_ok
 
     if args.apply:
         from otedama_tpu.utils import certification
@@ -142,13 +190,18 @@ def main() -> int:
         if eth_ok:
             certification.record("ethash", report["ethash_certifiable"])
             applied.append("ethash")
+        if sv2_ok:
+            certification.record("sv2", report["sv2_certifiable"])
+            applied.append("sv2")
         report["applied"] = applied
         report["artifact"] = str(certification.artifact_path())
 
     print(json.dumps(report, indent=2))
     # exit 0 iff every section PRESENT in the file passed
-    failed = (("dash_genesis_hash" in vectors or "x11_vectors" in vectors)
-              and not x11_ok) or ("ethash_vectors" in vectors and not eth_ok)
+    failed = ((("dash_genesis_hash" in vectors or "x11_vectors" in vectors)
+               and not x11_ok)
+              or ("ethash_vectors" in vectors and not eth_ok)
+              or ("sv2_frame_vectors" in vectors and not sv2_ok))
     return 1 if failed else 0
 
 

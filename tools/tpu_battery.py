@@ -1,21 +1,21 @@
-"""Round-4 TPU measurement battery.
+"""Round-5 TPU measurement battery.
 
 One command produces every artifact the round needs once the device is
 reachable, in priority order, each step isolated in its OWN subprocess
 (a wedged tunnel mid-battery must not take down the later steps — the
 r3 post-mortem) with a per-step timeout and the JSON line captured to a
-BENCH_*_r04.json artifact:
+BENCH_*_r05.json artifact:
 
-  1. sha256d headline (bench.py)                 -> BENCH_R04_sha256d.json
-  2. scrypt pallas tier (r3 baseline config)     -> BENCH_R04_scrypt_pallas.json
-  3. scrypt fused + fused-half (gather-free A/B) -> BENCH_R04_scrypt_fused*.json
-  4. x11 device chain, table vs compute S-box    -> BENCH_R04_x11_*.json
-  5. ethash light + full-DAG                     -> BENCH_R04_ethash.json
-  6. engine-path e2e                             -> BENCH_R04_engine.json
-  7. tuner finalist validation at 2^31           -> BENCH_R04_tune.json
+  1. sha256d headline (bench.py)                 -> BENCH_R05_sha256d.json
+  2. scrypt pallas tier (r3 baseline config)     -> BENCH_R05_scrypt_pallas.json
+  3. scrypt fused + fused-half (gather-free A/B) -> BENCH_R05_scrypt_fused*.json
+  4. x11 device chain, table vs compute S-box    -> BENCH_R05_x11_*.json
+  5. ethash light + full-DAG                     -> BENCH_R05_ethash.json
+  6. engine-path e2e                             -> BENCH_R05_engine.json
+  7. tuner finalist validation at 2^31           -> BENCH_R05_tune.json
 
 Run: python tools/tpu_battery.py [--only step,step] [--skip step,...]
-Steps run even if earlier ones fail; the summary JSON (BATTERY_r04.json)
+Steps run even if earlier ones fail; the summary JSON (BATTERY_r05.json)
 records per-step status/duration so a partial battery is still evidence.
 """
 
@@ -107,7 +107,7 @@ def run_step(name: str, argv: list[str], extra_env: dict,
                   "stdout_tail": _tail(e.stdout),
                   "stderr_tail": _tail(e.stderr)}
     if result.get("result"):
-        (REPO / f"BENCH_R04_{name}.json").write_text(
+        (REPO / f"BENCH_R05_{name}.json").write_text(
             json.dumps(result["result"]) + "\n"
         )
     print(f"=== {name}: {result['status']} "
@@ -138,7 +138,7 @@ def main() -> int:
             continue
         summary["steps"][name] = run_step(name, argv, extra_env, timeout)
         # keep the partial battery on disk after every step
-        (REPO / "BATTERY_r04.json").write_text(
+        (REPO / "BATTERY_r05.json").write_text(
             json.dumps(summary, indent=2) + "\n"
         )
     ok = sum(1 for s in summary["steps"].values() if s["status"] == "ok")
