@@ -184,12 +184,27 @@ class Application:
         if cfg.stratum.v2_enabled:
             from otedama_tpu.stratum.v2 import Sv2MiningServer, Sv2ServerConfig
 
+            noise_key = None
+            if cfg.stratum.v2_noise_key_file:
+                import pathlib as _pl
+
+                noise_key = bytes.fromhex(
+                    _pl.Path(cfg.stratum.v2_noise_key_file)
+                    .read_text().strip()
+                )
+                if len(noise_key) != 32:
+                    raise ValueError(
+                        f"{cfg.stratum.v2_noise_key_file}: X25519 static "
+                        f"key must be 32 bytes, got {len(noise_key)}"
+                    )
             self.server_v2 = Sv2MiningServer(
                 Sv2ServerConfig(
                     host=cfg.stratum.host,
                     port=cfg.stratum.v2_port,
                     initial_difficulty=cfg.stratum.initial_difficulty,
                     max_clients=cfg.stratum.max_clients,
+                    noise=cfg.stratum.v2_noise,
+                    noise_static_key=noise_key,
                 ),
                 on_share=self.pool.on_share,
                 on_block=self.pool.on_block,

