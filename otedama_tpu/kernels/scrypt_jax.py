@@ -250,3 +250,4 @@ from otedama_tpu.engine import algos as _algos  # noqa: E402
 
 _algos.mark_implemented("scrypt", "xla")
 _algos.mark_implemented("scrypt", "pod")
+_algos.mark_implemented("scrypt", "fused-pod")  # runtime.fused lockstep

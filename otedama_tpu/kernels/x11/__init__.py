@@ -183,6 +183,7 @@ if not missing_stages():
     _algos.mark_implemented("x11", "xla")
     _algos.mark_implemented("x11", "jax")
     _algos.mark_implemented("x11", "pod")  # runtime.mesh.X11PodBackend
+    _algos.mark_implemented("x11", "fused-pod")  # runtime.fused lockstep
 
 
 def _maybe_certify() -> bool:

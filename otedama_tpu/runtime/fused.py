@@ -31,8 +31,14 @@ them:
   every row's shares (results are replicated, so it has them all).
 
 Payload layout (fixed shape — broadcast_one_to_all requires it):
-``[stop u32 | generation u32 | base u32 | count u32]`` then per host row
-``header76 (76 bytes) + share target (32 bytes, big-endian)``.
+``[stop u32 | generation u32 | base u32 | count u32 | algo u32]`` then
+per host row ``header76 (76 bytes) + share target (32 bytes,
+big-endian)`` — the same row encoding for every algorithm, since
+sha256d, scrypt, and x11 pods all take ``JobConstants.from_header_prefix``
+jobs. The algo id in the header makes the WHOLE algo surface lockstep:
+the leader can switch the pod from sha256d to scrypt (profit switching
+at pod scale) and followers build the matching pod program on the same
+step, never searching a stale chain.
 """
 
 from __future__ import annotations
@@ -43,20 +49,32 @@ import threading
 
 import numpy as np
 
-from otedama_tpu.runtime.mesh import PodSearch, make_pod_mesh
+from otedama_tpu.runtime.mesh import (
+    PodSearch,
+    ScryptPodSearch,
+    X11PodSearch,
+    make_pod_mesh,
+)
 from otedama_tpu.runtime.search import JobConstants, SearchResult
 
 log = logging.getLogger("otedama.runtime.fused")
 
-_HDR = 16          # stop, generation, base, count (4 x u32, little-endian)
+# wire ids for the broadcast header's algo field — append-only, never
+# renumber (a mixed-version pod must agree on these)
+ALGO_IDS = {"sha256d": 0, "scrypt": 1, "x11": 2}
+_ALGO_BY_ID = {v: k for k, v in ALGO_IDS.items()}
+
+_HDR = 20          # stop, generation, base, count, algo (5 x u32, LE)
 _ROW = 76 + 32     # header76 + target
 
 
 def _encode(stop: int, generation: int, base: int, count: int,
-            jcs: list[JobConstants] | None, n_rows: int) -> np.ndarray:
+            jcs: list[JobConstants] | None, n_rows: int,
+            algo_id: int = 0) -> np.ndarray:
     buf = np.zeros(_HDR + n_rows * _ROW, dtype=np.uint8)
     buf[:_HDR] = np.frombuffer(
-        np.array([stop, generation, base, count], dtype="<u4").tobytes(),
+        np.array([stop, generation, base, count, algo_id],
+                 dtype="<u4").tobytes(),
         dtype=np.uint8,
     )
     if jcs is not None:
@@ -72,7 +90,7 @@ def _encode(stop: int, generation: int, base: int, count: int,
 
 
 def _decode(buf: np.ndarray, n_rows: int):
-    stop, generation, base, count = np.frombuffer(
+    stop, generation, base, count, algo_id = np.frombuffer(
         buf[:_HDR].tobytes(), dtype="<u4"
     )
     rows = []
@@ -82,7 +100,8 @@ def _decode(buf: np.ndarray, n_rows: int):
             buf[o:o + 76].tobytes(),
             int.from_bytes(buf[o + 76:o + _ROW].tobytes(), "big"),
         ))
-    return int(stop), int(generation), int(base), int(count), rows
+    return (int(stop), int(generation), int(base), int(count),
+            int(algo_id), rows)
 
 
 class FusedPodDriver:
@@ -95,9 +114,21 @@ class FusedPodDriver:
     (identical on every process), or None when the leader said stop.
     """
 
-    def __init__(self, mesh=None, **pod_kwargs):
+    _POD_CLASSES = {
+        "sha256d": PodSearch,
+        "scrypt": ScryptPodSearch,
+        "x11": X11PodSearch,
+    }
+
+    def __init__(self, mesh=None, algo: str = "sha256d",
+                 algo_kwargs: dict | None = None, **pod_kwargs):
         import jax
 
+        if algo not in ALGO_IDS:
+            raise ValueError(
+                f"unknown fused-pod algo {algo!r}; "
+                f"known: {sorted(ALGO_IDS)}"
+            )
         self.world = jax.process_count()
         self.rank = jax.process_index()
         if mesh is None:
@@ -107,12 +138,21 @@ class FusedPodDriver:
                 jax.devices(), key=lambda d: (d.process_index, d.id)
             )
             mesh = make_pod_mesh(devs, n_hosts=self.world)
-        self.pod = PodSearch(
-            mesh, multiprocess=self.world > 1, **pod_kwargs
-        )
+        self._mesh = mesh
+        # per-algo constructor kwargs; bare **pod_kwargs keep the
+        # historical call shape (they configure the DEFAULT algo's pod)
+        self._algo_kwargs: dict[str, dict] = {
+            k: dict(v) for k, v in (algo_kwargs or {}).items()
+        }
+        if pod_kwargs:
+            self._algo_kwargs.setdefault(algo, {}).update(pod_kwargs)
+        self.algo = algo
+        self._pods: dict[str, object] = {}
+        self.pod = self._pod_for(algo)
         self.n_rows = self.pod.n_hosts
         self.generation = 0       # last generation this process executed
         self._jcs: list[JobConstants] | None = None
+        self._jcs_algo: str | None = None
         self._pub_key = None      # leader: identity of last published jobs
         self._pub_gen = 0
         # one collective in flight per process, ever: a stop broadcast
@@ -120,6 +160,20 @@ class FusedPodDriver:
         # would give two concurrent collectives with undefined
         # cross-process ordering (deadlock class)
         self._step_lock = threading.Lock()
+
+    def _pod_for(self, algo: str):
+        """Get-or-build the pod program for ``algo`` on the shared mesh.
+        Lazy: a follower only compiles the chains the leader actually
+        dispatches (the x11 chain costs minutes of XLA compile)."""
+        pod = self._pods.get(algo)
+        if pod is None:
+            cls = self._POD_CLASSES[algo]
+            pod = cls(
+                self._mesh, multiprocess=self.world > 1,
+                **self._algo_kwargs.get(algo, {}),
+            )
+            self._pods[algo] = pod
+        return pod
 
     @property
     def is_leader(self) -> bool:
@@ -133,31 +187,40 @@ class FusedPodDriver:
         *,
         generation: int | None = None,
         stop: bool = False,
+        algo: str | None = None,
     ) -> list[SearchResult] | None:
         """One lockstep pod step. Leader passes the job set + window (and
-        bumps ``generation`` on clean jobs — or passes it explicitly);
-        followers pass nothing. Returns None when the pod is stopping."""
+        bumps ``generation`` on clean jobs — or passes it explicitly;
+        ``algo`` switches the whole pod's chain, defaulting to the
+        driver's construction algo); followers pass nothing. Returns
+        None when the pod is stopping."""
         from jax.experimental import multihost_utils as mu
 
         if self.is_leader:
             if not stop and jcs is None:
                 raise ValueError("leader must pass jcs (or stop=True)")
+            algo = algo or self.algo
+            if algo not in ALGO_IDS:
+                raise ValueError(f"unknown fused-pod algo {algo!r}")
             if generation is None:
                 if jcs is not None:
-                    # bump only on a CHANGED job set, so followers
-                    # rebuild midstates exactly when a clean job lands
-                    key = tuple((jc.header76, jc.target) for jc in jcs)
+                    # bump only on a CHANGED job set (the algo is part of
+                    # the identity: same header under a different chain
+                    # is a different job), so followers rebuild job state
+                    # exactly when a clean job lands
+                    key = (algo,
+                           tuple((jc.header76, jc.target) for jc in jcs))
                     if key != self._pub_key:
                         self._pub_key = key
                         self._pub_gen += 1
                 generation = self._pub_gen
             payload = _encode(
                 int(stop), generation, base & 0xFFFFFFFF, count,
-                jcs, self.n_rows,
+                jcs, self.n_rows, ALGO_IDS[algo],
             )
         else:
-            if jcs is not None or stop:
-                raise ValueError("only the leader publishes jobs/stop")
+            if jcs is not None or stop or algo is not None:
+                raise ValueError("only the leader publishes jobs/stop/algo")
             payload = _encode(0, 0, 0, 0, None, self.n_rows)
 
         # THE lockstep point: a collective barrier carrying the job state.
@@ -166,18 +229,29 @@ class FusedPodDriver:
         # another has already moved to the next one.
         with self._step_lock:
             payload = np.asarray(mu.broadcast_one_to_all(payload))
-            stop_f, gen, base, count, rows = _decode(payload, self.n_rows)
+            (stop_f, gen, base, count, algo_id,
+             rows) = _decode(payload, self.n_rows)
             if stop_f:
                 log.info("rank %d: stop received", self.rank)
                 return None
-            if self._jcs is None or gen != self.generation:
+            live_algo = _ALGO_BY_ID.get(algo_id)
+            if live_algo is None:
+                raise ValueError(
+                    f"rank {self.rank}: leader published unknown algo id "
+                    f"{algo_id} (version skew across the pod?)"
+                )
+            if (self._jcs is None or gen != self.generation
+                    or live_algo != self._jcs_algo):
                 self._jcs = [
                     JobConstants.from_header_prefix(h76, target)
                     for h76, target in rows
                 ]
+                self._jcs_algo = live_algo
                 self.generation = gen
-                log.info("rank %d: job generation %d", self.rank, gen)
-            return self.pod.search_jobs(self._jcs, base, count)
+                log.info("rank %d: job generation %d (%s)",
+                         self.rank, gen, live_algo)
+            return self._pod_for(live_algo).search_jobs(
+                self._jcs, base, count)
 
     def stop(self) -> None:
         """Leader: release every follower from its broadcast wait."""
@@ -211,13 +285,19 @@ class FusedPodBackend:
         if not self.driver.is_leader:
             raise ValueError("FusedPodBackend runs on the leader only; "
                              "followers run follower_loop()")
+        if self.algorithm not in ALGO_IDS:
+            raise ValueError(
+                f"fused pod cannot run {self.algorithm!r}; "
+                f"known: {sorted(ALGO_IDS)}"
+            )
         self.en2_fanout = self.driver.n_rows
         self.name = (
-            f"fused-pod{self.driver.n_rows}x{self.driver.pod.n_chips}"
+            f"fused-{self.algorithm}-pod"
+            f"{self.driver.n_rows}x{self.driver.pod.n_chips}"
         )
 
     def search_multi(self, jcs, base: int, count: int):
-        return self.driver.step(jcs, base, count)
+        return self.driver.step(jcs, base, count, algo=self.algorithm)
 
     def close(self, timeout: float = 30.0) -> None:
         """Engine teardown hook: release followers from their broadcast.
@@ -244,4 +324,5 @@ class FusedPodBackend:
                 f"{self.name} searches {self.en2_fanout} extranonce "
                 "spaces per call; use search_multi()"
             )
-        return self.driver.step([jc], base, count)[0]
+        return self.driver.step([jc], base, count,
+                                algo=self.algorithm)[0]

@@ -432,11 +432,17 @@ class ScryptPodSearch:
     mesh: Mesh
     blockmix: str | None = None  # None = "pallas" iff running on TPU
     rolled: bool | None = None
+    multiprocess: bool = False   # fused multi-controller mode: outputs
+    # are all-gathered on device so every process reads identical
+    # REPLICATED arrays (see PodSearch.multiprocess)
 
     def __post_init__(self):
         self._axes, self.n_hosts, self.n_chips = parse_mesh_axes(
             self.mesh, "ScryptPodSearch"
         )
+        if self.multiprocess and len(self._axes) != 2:
+            raise ValueError(
+                "multiprocess ScryptPodSearch needs a (host, chip) mesh")
         from otedama_tpu.utils.platform_probe import safe_default_backend
 
         on_tpu = safe_default_backend() == "tpu"  # hang-safe
@@ -453,12 +459,15 @@ class ScryptPodSearch:
         chip_axis = axes[-1]
         host_spec = P(axes[0]) if len(axes) == 2 else P()
         rolled, blockmix = self.rolled, self.blockmix
+        replicate_out = self.multiprocess
+        out_specs = ((P(), P()) if replicate_out
+                     else (P(*axes), P(*axes)))
 
         @functools.partial(
             shard_map,
             mesh=self.mesh,
             in_specs=(host_spec, P(), P()),
-            out_specs=(P(*axes), P(*axes)),
+            out_specs=out_specs,
             check_vma=False,
         )
         def _step(h19_rows, limbs8, base):
@@ -474,6 +483,15 @@ class ScryptPodSearch:
             hits = sj.le256(h, tuple(limbs8[i] for i in range(8)))
             # (no device-side pmin: host telemetry over requested lanes
             # only — overscan-safe and one less cross-pod collective)
+            if replicate_out:
+                # fused mode: gather over chip then host so every device
+                # — hence every PROCESS — reads the full (host, chip,
+                # per_chip) result (PodSearch's multi-controller rule)
+                return tuple(
+                    jax.lax.all_gather(jax.lax.all_gather(x, chip_axis),
+                                       axes[0])
+                    for x in (hits, h[0])
+                )
             shape = (1, 1, per_chip) if len(axes) == 2 else (1, per_chip)
             return hits.reshape(shape), h[0].reshape(shape)
 
@@ -514,12 +532,16 @@ class ScryptPodSearch:
                 per_chip = -(-per_chip // LANE_TILE) * LANE_TILE
         scanned = per_chip * self.n_chips
 
-        h19 = jnp.asarray(np.stack([
+        # numpy (uncommitted) inputs: multi-controller jit shards host
+        # values per the shard_map specs; a committed jnp array would be
+        # rejected there (same rule as PodSearch)
+        h19 = np.stack([
             np.array(sc.header_words19(jc.header76), dtype=np.uint32)
             for jc in jcs
-        ]))
+        ])
         out = self._step_for(per_chip)(
-            h19, jnp.asarray(limbs), jnp.uint32(base & 0xFFFFFFFF)
+            h19, np.asarray(limbs, dtype=np.uint32),
+            np.uint32(base & 0xFFFFFFFF)
         )
         hits, h0 = (np.asarray(o) for o in out)
         if hits.ndim == 2:  # 1D mesh: add the row axis
@@ -608,11 +630,16 @@ class X11PodSearch:
     mesh: Mesh
     chain_fn: callable = None  # tests inject a cheap stand-in
     chunk: int = 1 << 12       # per-chip lanes per step — ONE compiled shape
+    multiprocess: bool = False  # fused mode: replicated outputs (see
+    # ScryptPodSearch.multiprocess)
 
     def __post_init__(self):
         self._axes, self.n_hosts, self.n_chips = parse_mesh_axes(
             self.mesh, "X11PodSearch"
         )
+        if self.multiprocess and len(self._axes) != 2:
+            raise ValueError(
+                "multiprocess X11PodSearch needs a (host, chip) mesh")
         if self.chain_fn is None:
             from otedama_tpu.kernels.x11 import jnp_chain
 
@@ -629,12 +656,15 @@ class X11PodSearch:
         chip_axis = axes[-1]
         host_spec = P(axes[0]) if len(axes) == 2 else P()
         chain = self.chain_fn
+        replicate_out = self.multiprocess
+        out_specs = ((P(), P()) if replicate_out
+                     else (P(*axes), P(*axes)))
 
         @functools.partial(
             shard_map,
             mesh=self.mesh,
             in_specs=(host_spec, P(), P()),
-            out_specs=(P(*axes), P(*axes)),
+            out_specs=out_specs,
             check_vma=False,
         )
         def _step(h76_rows, t0_limb, base):
@@ -660,6 +690,12 @@ class X11PodSearch:
             # (no device-side pmin telemetry: best-hash stats come from
             # the host over requested lanes only, so overscan lanes can't
             # leak in and the chain avoids a dead cross-pod collective)
+            if replicate_out:
+                return tuple(
+                    jax.lax.all_gather(jax.lax.all_gather(x, chip_axis),
+                                       axes[0])
+                    for x in (hits, h0)
+                )
             shape = (1, 1, per_chip) if len(axes) == 2 else (1, per_chip)
             return hits.reshape(shape), h0.reshape(shape)
 
@@ -692,9 +728,10 @@ class X11PodSearch:
         per_chip = self.chunk
         window = per_chip * self.n_chips
 
-        h76 = jnp.asarray(np.stack([
+        # numpy (uncommitted) inputs — multi-controller rule, see above
+        h76 = np.stack([
             np.frombuffer(jc.header76, dtype=np.uint8) for jc in jcs
-        ]))
+        ])
         winners_per_row: list[list[Winner]] = [[] for _ in jcs]
         best_per_row = [0xFFFFFFFF] * len(jcs)
         done = 0
@@ -703,7 +740,7 @@ class X11PodSearch:
             valid = min(window, count - done)
             with jax.enable_x64():
                 out = self._step_for(per_chip)(
-                    h76, jnp.uint32(t0_limb), jnp.uint32(wbase)
+                    h76, np.uint32(t0_limb), np.uint32(wbase)
                 )
                 hits, h0 = (np.asarray(o) for o in out)
             if hits.ndim == 2:

@@ -658,6 +658,20 @@ class PythonBackend:
 
 
 def make_backend(kind: str, algorithm: str = "sha256d", **kwargs):
+    if kind == "fused-pod":
+        # LEADER of a multi-host fused pod (runtime.fused); followers run
+        # fused.follower_loop instead of an engine. One branch for every
+        # algorithm: the driver routes on its algo id (ALGO_IDS) and
+        # FusedPodBackend rejects algorithms the pod cannot run
+        from otedama_tpu.runtime.fused import (
+            FusedPodBackend,
+            FusedPodDriver,
+        )
+
+        algo = "sha256d" if algorithm in ("sha256d", "sha256") else algorithm
+        return FusedPodBackend(
+            FusedPodDriver(algo=algo, **kwargs), algorithm=algo
+        )
     if algorithm in ("sha256d", "sha256"):
         if kind == "pod":
             # every local chip behind one engine backend (runtime.mesh);
@@ -665,15 +679,6 @@ def make_backend(kind: str, algorithm: str = "sha256d", **kwargs):
             from otedama_tpu.runtime.mesh import PodBackend
 
             return PodBackend(**kwargs)
-        if kind == "fused-pod":
-            # LEADER of a multi-host fused pod (runtime.fused); followers
-            # run fused.follower_loop instead of an engine
-            from otedama_tpu.runtime.fused import (
-                FusedPodBackend,
-                FusedPodDriver,
-            )
-
-            return FusedPodBackend(FusedPodDriver(**kwargs))
         if kind == "pallas-tpu":
             return PallasBackend(**kwargs)
         if kind == "xla":

@@ -957,7 +957,8 @@ class Sv2MiningClient:
     (tests) and to act as the upstream leg of a future SV2 proxy."""
 
     def __init__(self, host: str, port: int, user: str = "worker",
-                 allow_uninterop: bool = False, noise: bool = False):
+                 allow_uninterop: bool = False, noise: bool = False,
+                 expected_server_key: bytes | None = None):
         if (not INTEROP_VERIFIED and not allow_uninterop
                 and host not in ("127.0.0.1", "::1", "localhost")):
             # enforced in code, not prose (verdict r4 weak #5): the
@@ -972,7 +973,13 @@ class Sv2MiningClient:
             )
         self.host, self.port, self.user = host, port, user
         self.noise = noise
-        self.noise_server_key: bytes | None = None  # pin this out-of-band
+        # pinned pool identity: with NX the server proves its static key
+        # during the handshake, but ANY server can complete a handshake
+        # with its own key — authentication requires comparing against a
+        # key obtained out-of-band, and it must happen INSIDE connect()
+        # before a single protocol byte (user identity!) is sent
+        self.expected_server_key = expected_server_key
+        self.noise_server_key: bytes | None = None
         self.reader: asyncio.StreamReader | None = None
         self.writer: asyncio.StreamWriter | None = None
         self._conn: FrameConn | None = None
@@ -991,15 +998,28 @@ class Sv2MiningClient:
         session = None
         if self.noise:
             # NX: the server transmits (and proves possession of) its
-            # static key during the handshake; the caller pins
-            # ``noise_server_key`` out-of-band — the SV2 certificate
+            # static key during the handshake — the SV2 certificate
             # authority layer is out of scope (module docstring). The
             # timeout covers a stalled server or a cleartext endpoint
-            # that will never answer a noise message
-            session = await asyncio.wait_for(
-                noise.client_handshake(self.reader, self.writer),
-                timeout=handshake_timeout,
-            )
+            # that will never answer a noise message; any failure closes
+            # the socket (a reconnect loop must not leak one FD per try)
+            try:
+                session = await asyncio.wait_for(
+                    noise.client_handshake(self.reader, self.writer),
+                    timeout=handshake_timeout,
+                )
+                if (self.expected_server_key is not None
+                        and session.rs != self.expected_server_key):
+                    # checked before ANY protocol byte flows: a MITM can
+                    # complete NX with its own key, so the pin is the
+                    # authentication step
+                    raise noise.HandshakeError(
+                        "server static key does not match the pinned "
+                        "expected_server_key (wrong pool or MITM)"
+                    )
+            except BaseException:
+                self.writer.close()
+                raise
             self.noise_server_key = session.rs
         self._conn = FrameConn(self.reader, self.writer, session)
         self._conn.send(MSG_SETUP_CONNECTION, SetupConnection().encode())

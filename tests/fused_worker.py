@@ -4,9 +4,13 @@ fused pod over a virtual CPU mesh (4 devices per process).
 Run: python fused_worker.py <rank> <port>
 
 Verifies, ON EVERY RANK, that the fused pod's replicated results match a
-host-side sha256d oracle for both extranonce rows, across a mid-run
-clean-job swap (the dcn.py deadlock case: the leader changes jobs while
-the follower is already blocked in its next step's broadcast).
+host-side oracle for both extranonce rows, across a mid-run clean-job
+swap (the dcn.py deadlock case: the leader changes jobs while the
+follower is already blocked in its next step's broadcast) AND across a
+mid-run ALGORITHM switch: the same lockstep broadcast carries sha256d,
+then scrypt (hashlib oracle), then x11 (injected cheap chain, so the
+structure — device header assembly, per-algo pod build on the follower,
+replicated hit masks — is proven without minutes of XLA compile).
 """
 
 import hashlib
@@ -18,16 +22,38 @@ def sha256d(b: bytes) -> bytes:
     return hashlib.sha256(hashlib.sha256(b).digest()).digest()
 
 
-def oracle(h76: bytes, base: int, count: int) -> dict[int, int]:
-    """nonce-word -> compare-order value of the digest's top limb."""
+def scrypt_host(b: bytes) -> bytes:
+    return hashlib.scrypt(b, salt=b, n=1024, r=1, p=1,
+                          maxmem=64 * 1024 * 1024, dklen=32)
+
+
+def fake_x11_digest_host(header80: bytes) -> bytes:
+    import numpy as np
+
+    h = np.frombuffer(header80, dtype=np.uint8).astype(np.uint32)
+    return bytes(((h[:32] * 3 + h[32:64] * 5 + h[48:80] * 7) & 0xFF)
+                 .astype(np.uint8))
+
+
+def fake_x11_chain(headers):
+    import jax.numpy as jnp
+
+    h = headers.astype(jnp.uint32)
+    folded = (h[:, :32] * 3 + h[:, 32:64] * 5 + h[:, 48:80] * 7)
+    return (folded & 0xFF).astype(jnp.uint8)
+
+
+def oracle(digest_fn, h76: bytes, base: int, count: int) -> dict[int, int]:
+    """nonce-word -> little-endian value of the digest."""
     out = {}
     for n in range(base, base + count):
-        d = sha256d(h76 + struct.pack(">I", n & 0xFFFFFFFF))
+        d = digest_fn(h76 + struct.pack(">I", n & 0xFFFFFFFF))
         out[n & 0xFFFFFFFF] = int.from_bytes(d, "little")
     return out
 
 
-def jobset(tag: int, target_quantile: float, base: int, count: int):
+def jobset(digest_fn, tag: int, target_quantile: float, base: int,
+           count: int):
     """Two extranonce-row headers + a target putting ~quantile of lanes
     under it, plus the expected winner sets."""
     from otedama_tpu.runtime.search import JobConstants
@@ -36,7 +62,7 @@ def jobset(tag: int, target_quantile: float, base: int, count: int):
         bytes([tag, r]) * 32 + struct.pack(">3I", 0x17034219, 0x6530D1B7, r)
         for r in range(2)
     ]
-    vals = [oracle(h, base, count) for h in rows]
+    vals = [oracle(digest_fn, h, base, count) for h in rows]
     allv = sorted(v for m in vals for v in m.values())
     target = allv[int(len(allv) * target_quantile)]
     jcs = [JobConstants.from_header_prefix(h, target) for h in rows]
@@ -56,16 +82,33 @@ def main() -> None:
     )
     assert jax.process_count() == 2 and len(jax.devices()) == 8
 
+    # the x11 pod exact-verifies flagged lanes through the kernels.x11
+    # numpy oracle; with the injected device chain the oracle must be the
+    # matching host stand-in — patched identically on BOTH ranks
+    from otedama_tpu.kernels import x11 as x11_mod
+
+    x11_mod.x11_digest = fake_x11_digest_host
+
     from otedama_tpu.runtime.fused import FusedPodDriver
 
-    driver = FusedPodDriver(use_pallas=False, rolled=True, jnp_tile=64)
+    driver = FusedPodDriver(
+        use_pallas=False, rolled=True, jnp_tile=64,
+        algo_kwargs={
+            "scrypt": {"blockmix": "xla", "rolled": True},
+            "x11": {"chain_fn": fake_x11_chain, "chunk": 16},
+        },
+    )
     assert driver.n_rows == 2 and driver.pod.n_chips == 4
 
     base, count = 0x0100, 512
-    jcs1, exp1 = jobset(0xA1, 0.05, base, count)
-    jcs2, exp2 = jobset(0xB7, 0.05, base, count)
+    jcs1, exp1 = jobset(sha256d, 0xA1, 0.05, base, count)
+    jcs2, exp2 = jobset(sha256d, 0xB7, 0.05, base, count)
+    sc_base, sc_count = 0x40, 96  # scrypt is ~ms/hash on the host oracle
+    jcs3, exp3 = jobset(scrypt_host, 0xC3, 0.10, sc_base, sc_count)
+    x_base, x_count = 0x10, 128
+    jcs4, exp4 = jobset(fake_x11_digest_host, 0xD9, 0.08, x_base, x_count)
 
-    def check(results, expected, label):
+    def check(results, expected, digest_fn, label):
         assert results is not None, f"{label}: unexpected stop"
         for r, res in enumerate(results):
             got = sorted(w.nonce_word for w in res.winners)
@@ -74,19 +117,28 @@ def main() -> None:
             )
             for w in res.winners:
                 jc = driver._jcs[r]
-                assert w.digest == sha256d(jc.header_for(w.nonce_word))
+                assert w.digest == digest_fn(jc.header_for(w.nonce_word))
 
     if rank == 0:
         # steps 1-3: generation 1 (step 2 walks a different window)
-        check(driver.step(jcs1, base, count), exp1, "gen1/s1")
+        check(driver.step(jcs1, base, count), exp1, sha256d, "gen1/s1")
         driver.step(jcs1, base + count, count)
-        check(driver.step(jcs1, base, count), exp1, "gen1/s3")
+        check(driver.step(jcs1, base, count), exp1, sha256d, "gen1/s3")
         assert driver.generation == 1
         # CLEAN JOB mid-run: the follower is already blocked in its next
         # broadcast with the old job — the swap must reach it atomically
-        check(driver.step(jcs2, base, count), exp2, "gen2/s1")
+        check(driver.step(jcs2, base, count), exp2, sha256d, "gen2/s1")
         assert driver.generation == 2
-        check(driver.step(jcs2, base, count), exp2, "gen2/s2")
+        check(driver.step(jcs2, base, count), exp2, sha256d, "gen2/s2")
+        # ALGO SWITCH mid-run: same lockstep broadcast, new chain — the
+        # follower builds its scrypt pod on this very step
+        check(driver.step(jcs3, sc_base, sc_count, algo="scrypt"),
+              exp3, scrypt_host, "gen3/scrypt")
+        assert driver.generation == 3
+        # and a second switch to the x11 pod (structural: injected chain)
+        check(driver.step(jcs4, x_base, x_count, algo="x11"),
+              exp4, fake_x11_digest_host, "gen4/x11")
+        assert driver.generation == 4
         driver.stop()
         print(f"OK rank=0 generation={driver.generation}", flush=True)
     else:
@@ -97,15 +149,19 @@ def main() -> None:
                 break
             steps += 1
             # the follower verifies against ITS OWN oracle for whichever
-            # generation the leader says is live — proving job state and
-            # results really did propagate in lockstep
-            expected = exp1 if driver.generation == 1 else exp2
-            # step 2's second window searched a different base; only
-            # windows at `base` are oracle-checked (count matches)
-            if results[0].hashes == count and steps != 2:
-                check(results, expected, f"follower/gen{driver.generation}")
-        assert steps == 5, steps
-        assert driver.generation == 2
+            # generation/algo the leader says is live — proving job AND
+            # chain state really did propagate in lockstep
+            gen = driver.generation
+            if gen == 3:
+                check(results, exp3, scrypt_host, "follower/scrypt")
+            elif gen == 4:
+                check(results, exp4, fake_x11_digest_host, "follower/x11")
+            elif results[0].hashes == count and steps != 2:
+                expected = exp1 if gen == 1 else exp2
+                check(results, expected, sha256d, f"follower/gen{gen}")
+        assert steps == 7, steps
+        assert driver.generation == 4
+        assert driver._jcs_algo == "x11"
         print(f"OK rank=1 steps={steps}", flush=True)
 
 
