@@ -73,7 +73,8 @@ def build_header_prefix(job: Job, extranonce2: bytes, ntime: int | None = None) 
 def job_constants(job: Job, extranonce2: bytes, ntime: int | None = None) -> JobConstants:
     """Device constants (midstate/tail/target limbs) for one search space."""
     return JobConstants.from_header_prefix(
-        build_header_prefix(job, extranonce2, ntime), job.share_target
+        build_header_prefix(job, extranonce2, ntime), job.share_target,
+        block_number=job.block_number,
     )
 
 

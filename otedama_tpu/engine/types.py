@@ -52,6 +52,10 @@ class Job:
     # pool-session context needed to build the coinbase
     extranonce1: bytes = b""
     extranonce2_size: int = 4
+    # chain height this job mines (templates carry it; stratum V1 does
+    # not, so pool-fed jobs may leave 0). DAG-class algorithms need it:
+    # ethash derives its epoch — cache and dataset — from the height
+    block_number: int = 0
     # share target for this job (pool difficulty), network target from nbits
     share_target: int = 0
     received_at: float = dataclasses.field(default_factory=time.time)

@@ -528,6 +528,7 @@ def hashimoto_full_device(
 
 from otedama_tpu.engine import algos as _algos  # noqa: E402
 
+_algos.mark_implemented("ethash", "managed")  # epoch-managed production tier
 _algos.mark_implemented("ethash", "xla")
 _algos.mark_implemented("ethash", "numpy")
 _algos.mark_implemented("ethash", "full")  # HBM-resident-DAG tier

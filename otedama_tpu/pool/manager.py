@@ -114,6 +114,7 @@ class PoolManager:
             ntime=t.ntime,
             clean=True,
             algorithm=algorithm,
+            block_number=t.height,
         )
 
     async def next_job(self) -> Job:

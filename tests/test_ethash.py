@@ -187,3 +187,160 @@ def test_full_backend_finds_same_winners_as_light():
         w.nonce_word for w in rf.winners
     ]
     assert rl.winners and rl.winners[0].digest == rf.winners[0].digest
+
+
+def _mini_sizing(epoch: int) -> dict:
+    """Miniature per-epoch sizing: distinct cache/dataset per epoch so a
+    cross-epoch digest can never accidentally validate."""
+    return {"cache_rows": TINY_ROWS + 8 * epoch,
+            "full_pages": 509 + 16 * epoch}
+
+
+def _mini_oracle(epoch: int, h76: bytes, nonces) -> dict[int, int]:
+    from otedama_tpu.kernels import ethash as eth
+
+    kw = _mini_sizing(epoch)
+    cache = eth.make_cache(kw["cache_rows"] * eth.HASH_BYTES,
+                           eth.seed_hash(0))
+    full_size = kw["full_pages"] * eth.MIX_BYTES
+    header_hash = eth.keccak256(h76)
+    out = {}
+    for n in nonces:
+        _, res = eth.hashimoto_light(full_size, cache, header_hash, n)
+        out[n] = int.from_bytes(res[::-1], "little")
+    return out
+
+
+def test_managed_backend_epoch_lifecycle():
+    """EthashManagedBackend follows job block_numbers across an epoch
+    boundary without dropping a search: light tier serves immediately,
+    the full DAG builds in the background and upgrades atomically, the
+    next epoch prefetches near the boundary — winners oracle-exact in
+    every phase (verdict r5 item 6)."""
+    import time as _time
+
+    from otedama_tpu.kernels import ethash as eth
+    from otedama_tpu.runtime.search import (
+        EthashManagedBackend,
+        JobConstants,
+    )
+
+    b = EthashManagedBackend(full_dataset=True, device=True, chunk=32,
+                             sizing=_mini_sizing, prefetch_blocks=16)
+    h76 = bytes(range(76))
+    base, span = 40, 32
+
+    # epoch 0: first search runs light (DAG still building)
+    vals0 = _mini_oracle(0, h76, range(base, base + span))
+    w0 = min(vals0, key=vals0.get)
+    jc0 = JobConstants.from_header_prefix(h76, vals0[w0], block_number=10)
+    res = b.search(jc0, base, span)
+    assert [w.nonce_word for w in res.winners] == [w0]
+    assert b.stats["light_chunks"] >= 1
+
+    # the background full build lands; the SAME job then runs full-tier
+    # with identical winners (light and full are byte-identical)
+    for _ in range(200):
+        if 0 in b.snapshot()["full_epochs"]:
+            break
+        _time.sleep(0.05)
+    assert 0 in b.snapshot()["full_epochs"], b.snapshot()
+    res = b.search(jc0, base, span)
+    assert [w.nonce_word for w in res.winners] == [w0]
+    assert b.stats["full_chunks"] >= 1
+
+    # epoch switch: a job in epoch 1 serves IMMEDIATELY (light) — the
+    # loop never drops — and its winners match the epoch-1 oracle
+    bn1 = eth.EPOCH_LENGTH + 5
+    vals1 = _mini_oracle(1, h76, range(base, base + span))
+    w1 = min(vals1, key=vals1.get)
+    assert vals1 != vals0  # distinct epoch params really change digests
+    jc1 = JobConstants.from_header_prefix(h76, vals1[w1], block_number=bn1)
+    res = b.search(jc1, base, span)
+    assert [w.nonce_word for w in res.winners] == [w1]
+    assert b.stats["epoch_switches"] >= 2
+
+    # prefetch: a job near the epoch-2 boundary starts epoch 2 building
+    near = 2 * eth.EPOCH_LENGTH - 4
+    jc_near = JobConstants.from_header_prefix(
+        h76, vals1[w1], block_number=near)
+    b.search(jc_near, base, span)
+    snap = b.snapshot()
+    assert 2 in snap["light_epochs"], snap
+    for _ in range(200):
+        snap = b.snapshot()
+        if 2 in snap["full_epochs"]:
+            break
+        _time.sleep(0.05)
+    assert 2 in snap["full_epochs"], snap
+
+
+@pytest.mark.asyncio
+async def test_engine_mines_ethash_across_epoch_boundary():
+    """Pool-template-shaped jobs (block_number carried from the template
+    height) drive the engine's managed ethash backend end-to-end across
+    an epoch boundary; shares keep flowing and every winner matches the
+    correct epoch's oracle."""
+    import asyncio
+
+    from otedama_tpu.engine.engine import EngineConfig, MiningEngine
+    from otedama_tpu.engine.types import Job
+    from otedama_tpu.kernels import ethash as eth
+    from otedama_tpu.runtime.search import EthashManagedBackend
+
+    backend = EthashManagedBackend(full_dataset=False, device=True,
+                                   chunk=64, sizing=_mini_sizing)
+    shares = []
+
+    async def on_share(share):
+        shares.append(share)
+
+    engine = MiningEngine(
+        {backend.name: backend},
+        on_share=on_share,
+        config=EngineConfig(algorithm="ethash", batch_size=128,
+                            extranonce2_size=4),
+    )
+
+    def mk_job(jid: str, bn: int, target: int) -> Job:
+        return Job(
+            job_id=jid, prev_hash=bytes(32), coinb1=b"\x01",
+            coinb2=b"\x02", merkle_branch=[], version=0x20000000,
+            nbits=0x207FFFFF, ntime=1700000000, clean=True,
+            algorithm="ethash", extranonce1=b"\x00\x01",
+            extranonce2_size=4, share_target=target, block_number=bn,
+        )
+
+    await engine.start()
+    try:
+        # epoch 0 job: permissive target so shares arrive fast
+        engine.set_job(mk_job("e0", 10, (1 << 255)))
+        # generous: the first chunk pays the XLA compile (~10 s CPU)
+        for _ in range(1800):
+            if shares:
+                break
+            await asyncio.sleep(0.05)
+        assert shares, "no epoch-0 shares"
+        n0 = len(shares)
+
+        # clean job across the boundary: the engine keeps mining
+        engine.set_job(mk_job("e1", eth.EPOCH_LENGTH + 3, (1 << 255)))
+        for _ in range(1800):
+            if any(s.job_id == "e1" for s in shares):
+                break
+            await asyncio.sleep(0.05)
+        assert any(s.job_id == "e1" for s in shares), "no epoch-1 shares"
+        assert n0 >= 1 and backend.stats["epoch_switches"] >= 2
+    finally:
+        await engine.stop()
+
+    # exact digest spot-check against the right epoch's oracle
+    from otedama_tpu.engine.jobs import build_header_prefix
+
+    for s in shares[:3] + [s for s in shares if s.job_id == "e1"][:3]:
+        epoch = 0 if s.job_id == "e0" else 1
+        job = mk_job(s.job_id, 10 if epoch == 0 else eth.EPOCH_LENGTH + 3,
+                     1 << 255)
+        h76 = build_header_prefix(job, s.extranonce2, s.ntime)
+        oracle = _mini_oracle(epoch, h76, [s.nonce_word])
+        assert int.from_bytes(s.digest, "little") == oracle[s.nonce_word]
