@@ -64,6 +64,11 @@ class AlgorithmManager:
                 order = ("pod", "pallas-tpu", "xla") if n_dev > 1 else ("pallas-tpu", "xla")
             else:
                 order = ("xla",)
+            if algorithm == "ethash":
+                # the epoch-managed tier IS the production path (it owns
+                # DAG lifecycle across epochs); the bare tiers below it
+                # are pinned to one construction-time epoch
+                order = ("managed",) + order
             for cand in order:
                 if cand in spec.backends:
                     kind = cand

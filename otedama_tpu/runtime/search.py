@@ -557,7 +557,8 @@ class EthashLightBackend:
     def __init__(self, cache_rows: int | None = None,
                  full_pages: int | None = None,
                  block_number: int | None = None, device: bool = True,
-                 chunk: int = 256, full_dataset: bool = False):
+                 chunk: int = 256, full_dataset: bool = False,
+                 cache: "np.ndarray | None" = None):
         from otedama_tpu.kernels import ethash as eth
 
         self._eth = eth
@@ -587,8 +588,19 @@ class EthashLightBackend:
             )
         # numpy stays the canonical copy (the host oracle mutates rows);
         # the device path gets an HBM-resident twin so per-chunk calls
-        # don't re-upload the epoch cache
-        self.cache = eth.make_cache(cache_bytes, seed)
+        # don't re-upload the epoch cache. A caller that already built
+        # this epoch's cache (EthashManagedBackend's light tier) passes
+        # it in — generating tens of MB of sequential keccak twice per
+        # epoch would be pure waste
+        if cache is not None:
+            if cache.shape[0] * eth.HASH_BYTES != cache_bytes:
+                raise ValueError(
+                    f"prebuilt cache has {cache.shape[0]} rows, epoch "
+                    f"sizing wants {cache_bytes // eth.HASH_BYTES}"
+                )
+            self.cache = cache
+        else:
+            self.cache = eth.make_cache(cache_bytes, seed)
         self._cache_dev = None
         self._dataset_dev = None
         if device:
@@ -608,6 +620,9 @@ class EthashLightBackend:
                 (-1, 32),
             )
             self._cache_dev = None
+            # full-mode search never touches the cache again; keeping
+            # the host copy would pin tens of MB per resident epoch
+            self.cache = None
             self.name = "ethash-full"
 
     def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
@@ -728,6 +743,7 @@ class EthashManagedBackend:
         self._building: set[int] = set()
         self._failed: dict[int, float] = {}  # epoch -> monotonic fail time
         self._live_epoch: int | None = None  # epoch searches are mining NOW
+        self._warned_no_height = False
         self._lock = threading.Lock()
         self._tier_build_lock = threading.Lock()
         self.name = "ethash-managed"
@@ -776,12 +792,17 @@ class EthashManagedBackend:
         """Background: light tier first (so a boundary crossing never
         stalls a search chunk), then the full DAG when enabled."""
         try:
-            self._light_tier(epoch)
+            light = self._light_tier(epoch)
             if not self.full_dataset:
+                with self._lock:
+                    self._building.discard(epoch)
                 return
+            # hand the light tier's epoch cache to the full build: the
+            # cache generation (native keccak over tens of MB) and its
+            # device upload must not run twice per epoch
             tier = EthashLightBackend(
                 device=True, chunk=self.chunk, full_dataset=True,
-                **self._sizing(epoch),
+                cache=light.cache, **self._sizing(epoch),
             )
         except Exception:
             # remember the failure: without backoff a persistent OOM
@@ -792,12 +813,14 @@ class EthashManagedBackend:
             with self._lock:
                 self.stats["build_failures"] += 1
                 self._failed[epoch] = time.monotonic()
-            return
-        finally:
-            with self._lock:
                 self._building.discard(epoch)
+            return
         with self._lock:
+            # registered in the SAME locked section that clears
+            # `building`: a gap between the two would let a concurrent
+            # search spawn a duplicate gigabyte DAG build
             self._full[epoch] = tier
+            self._building.discard(epoch)
             self._failed.pop(epoch, None)
             self._evict_locked(self._full, self.max_full_tiers,
                                "full DAG")
@@ -830,6 +853,21 @@ class EthashManagedBackend:
     # -- search --------------------------------------------------------------
 
     def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
+        if jc.block_number <= 0 and not self._warned_no_height:
+            # stratum-V1-fed jobs carry no height, so block_number stays
+            # 0 and this backend would mine the EPOCH-0 DAG against a
+            # chain that is hundreds of epochs along — every share
+            # invalid with nothing distinguishing it from healthy mining
+            # (EthashLightBackend refuses to guess sizing for the same
+            # reason). block 0 is only legitimately epoch 0 on a young
+            # chain, so warn loudly instead of refusing outright
+            self._warned_no_height = True
+            log.warning(
+                "ethash: job carries block_number<=0 — mining the "
+                "EPOCH-0 DAG. If this job came from a height-less feed "
+                "(stratum V1), every share will be invalid on a real "
+                "chain; wire the template height into Job.block_number."
+            )
         epoch = jc.block_number // self._eth.EPOCH_LENGTH
         with self._lock:
             self._live_epoch = epoch

@@ -1,0 +1,328 @@
+"""Pool soak under connection churn (verdict r5 item 7).
+
+The reference's connection-churn surface (internal/network/
+auto_reconnect.go; the 10k-connection target of its performance runs)
+had no repo analogue: this slow-tier test runs the REAL app in pool
+mode (V1 + V2 servers, sqlite file DB, mock chain template loop) under
+50+ flapping miners — connect/disconnect/reconnect cycles, abrupt
+resets mid-session, bad shares, duplicates, and a vardiff-spamming
+miner — then asserts the system came out clean:
+
+- no leaked asyncio tasks and no leaked file descriptors,
+- no lingering sessions/channels/conns on either server,
+- share accounting exactly consistent: every accept verdict a miner saw
+  is a row in the shares table, and server counters match,
+- vardiff actually retargeted the spammer upward.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import os
+import random
+import struct
+
+import pytest
+
+from otedama_tpu.engine import jobs as jobmod
+from otedama_tpu.kernels import target as tgt
+from otedama_tpu.stratum import protocol as sp
+from otedama_tpu.stratum import v2
+from otedama_tpu.utils.sha256_host import sha256d
+
+EASY = 1e-7  # ~2.3e-3 hit probability per hash: shares mine in ~430 tries
+
+
+def _fd_count() -> int:
+    return len(os.listdir("/proc/self/fd"))
+
+
+def _mine_v1(job, extranonce1: bytes, difficulty: float,
+             start: int = 0) -> tuple[bytes, int]:
+    target = tgt.difficulty_to_target(difficulty)
+    job = dataclasses.replace(job, extranonce1=extranonce1)
+    en2 = os.urandom(2) + b"\x00\x00"  # random space: duplicates unlikely
+    prefix = jobmod.build_header_prefix(job, en2)
+    for nonce in range(start, start + (1 << 22)):
+        if tgt.hash_meets_target(
+                sha256d(prefix + struct.pack(">I", nonce)), target):
+            return en2, nonce
+    raise AssertionError("no share found")
+
+
+class _V1Flapper:
+    """One miner's lifecycle: N connect/mine/disconnect cycles with a
+    mixed behavior profile (valid shares, garbage, duplicates, abrupt
+    resets)."""
+
+    def __init__(self, host: str, port: int, ident: int,
+                 rng: random.Random):
+        self.host, self.port, self.ident, self.rng = host, port, ident, rng
+        self.accepted = 0
+        self.rejected = 0
+
+    async def _call(self, reader, writer, msg_id, method, params):
+        writer.write(sp.encode_line(
+            sp.Message(id=msg_id, method=method, params=params)))
+        await writer.drain()
+        while True:
+            line = await asyncio.wait_for(reader.readline(), 20)
+            if not line:
+                raise ConnectionError("server closed")
+            m = sp.decode_line(line)
+            if m.is_response and m.id == msg_id:
+                return m
+            if m.method == "mining.notify":
+                self.job = sp.job_from_notify(m.params)
+            elif m.method == "mining.set_difficulty":
+                self.difficulty = float(m.params[0])
+
+    async def run_cycle(self) -> None:
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        try:
+            self.job = None
+            self.difficulty = EASY
+            sub = await self._call(reader, writer, 1, "mining.subscribe",
+                                   [f"soak-{self.ident}"])
+            extranonce1 = bytes.fromhex(sub.result[1])
+            await self._call(reader, writer, 2, "mining.authorize",
+                             [f"w.{self.ident}", "x"])
+            # the job arrives as a notification right after subscribe
+            for _ in range(200):
+                if self.job is not None:
+                    break
+                await asyncio.sleep(0.01)
+                # pump anything pending by issuing a cheap call
+                await self._call(reader, writer, 99, "mining.extranonce.subscribe", [])
+            assert self.job is not None, "no mining.notify"
+
+            for action in self.rng.choices(
+                    ("valid", "garbage", "dup"), weights=(6, 2, 1),
+                    k=self.rng.randint(1, 4)):
+                job = self.job  # latest (template loop may have moved)
+                if action == "garbage":
+                    bad = await self._call(
+                        reader, writer, 10, "mining.submit",
+                        [f"w.{self.ident}", job.job_id, "00000000",
+                         f"{job.ntime:08x}", "00000000"])
+                    if bad.result is True:  # EASY target: rare but legal
+                        self.accepted += 1
+                    else:
+                        self.rejected += 1
+                    continue
+                en2, nonce = _mine_v1(job, extranonce1, self.difficulty)
+                params = [f"w.{self.ident}", job.job_id, en2.hex(),
+                          f"{job.ntime:08x}", f"{nonce:08x}"]
+                ok = await self._call(reader, writer, 11, "mining.submit",
+                                      params)
+                if ok.result is True:
+                    self.accepted += 1
+                else:
+                    self.rejected += 1
+                if action == "dup":
+                    dup = await self._call(reader, writer, 12,
+                                           "mining.submit", params)
+                    assert dup.result is not True, "duplicate accepted"
+                    self.rejected += 1
+        finally:
+            if self.rng.random() < 0.3:
+                # abrupt reset: no goodbye, no drain — the server's read
+                # loop must reap the session anyway
+                writer.transport.abort()
+            else:
+                writer.close()
+
+
+class _V2Flapper:
+    def __init__(self, host: str, port: int, ident: int,
+                 rng: random.Random):
+        self.host, self.port, self.ident, self.rng = host, port, ident, rng
+        self.accepted = 0
+        self.rejected = 0
+
+    async def run_cycle(self, server) -> None:
+        client = v2.Sv2MiningClient(self.host, self.port,
+                                    user=f"w2.{self.ident}")
+        await client.connect()
+        try:
+            for _ in range(200):
+                if client.jobs and client.prevhash:
+                    break
+                await asyncio.wait_for(client.pump(), 20)
+            jid = max(client.jobs)
+            job = server._jobs[jid][0]
+            en2 = client.channel.extranonce_prefix
+            target = client.target
+            for action in self.rng.choices(("valid", "garbage"),
+                                           weights=(5, 2),
+                                           k=self.rng.randint(1, 3)):
+                if action == "garbage":
+                    res = await client.submit(jid, 0xDEAD0000, job.ntime,
+                                              job.version)
+                else:
+                    prefix = jobmod.header_from_share(job, en2, job.ntime, 0)[:76]
+                    nonce = None
+                    for n in range(1 << 22):
+                        d = sha256d(prefix + struct.pack(">I", n))
+                        if tgt.hash_meets_target(d, target):
+                            nonce = n
+                            break
+                    res = await client.submit(jid, nonce, job.ntime,
+                                              job.version)
+                if isinstance(res, v2.SubmitSharesSuccess):
+                    self.accepted += 1
+                else:
+                    self.rejected += 1
+        finally:
+            await client.close()
+
+
+async def _vardiff_spammer(host: str, port: int) -> float:
+    """Submit shares as fast as possible until the server retargets us
+    upward (mining.set_difficulty); return the final assigned
+    difficulty."""
+    f = _V1Flapper(host, port, 9999, random.Random(4242))
+    reader, writer = await asyncio.open_connection(host, port)
+    try:
+        f.job, f.difficulty = None, EASY
+        sub = await f._call(reader, writer, 1, "mining.subscribe", ["spam"])
+        extranonce1 = bytes.fromhex(sub.result[1])
+        await f._call(reader, writer, 2, "mining.authorize", ["w.spam", "x"])
+        for _ in range(200):
+            if f.job is not None:
+                break
+            await f._call(reader, writer, 99,
+                          "mining.extranonce.subscribe", [])
+            await asyncio.sleep(0.01)
+        for i in range(600):
+            if f.difficulty > EASY:
+                break  # upward retarget arrived — mining at the raised
+                # bar is the real miner's job, not this python loop's
+                # (an early DOWNWARD move can happen while the first
+                # window still contains connection setup time: keep
+                # spamming through it)
+            en2, nonce = _mine_v1(f.job, extranonce1, f.difficulty)
+            await f._call(reader, writer, 100 + i, "mining.submit",
+                          ["w.spam", f.job.job_id, en2.hex(),
+                           f"{f.job.ntime:08x}", f"{nonce:08x}"])
+        return f.difficulty
+    finally:
+        writer.close()
+
+
+@pytest.mark.slow
+@pytest.mark.asyncio
+async def test_pool_soak_under_churn(tmp_path):
+    from otedama_tpu.app import Application
+    from otedama_tpu.config.schema import AppConfig
+
+    rng = random.Random(1337)
+    cfg = AppConfig()
+    cfg.pool.enabled = True
+    cfg.pool.database = str(tmp_path / "soak.db")
+    cfg.stratum.enabled = True
+    cfg.stratum.host = "127.0.0.1"
+    cfg.stratum.port = 0
+    cfg.stratum.v2_enabled = True
+    cfg.stratum.v2_port = 0
+    cfg.stratum.initial_difficulty = EASY
+    # retarget aggressively so the spammer provokes a vardiff rise
+    cfg.stratum.vardiff_target_seconds = 0.05
+    cfg.mining.enabled = False
+    cfg.api.enabled = False
+    cfg.p2p.enabled = False
+
+    tasks_before = len(asyncio.all_tasks())
+    fds_before = _fd_count()
+
+    app = Application(cfg)
+    await app.start()
+    try:
+        # the whole swarm shares 127.0.0.1, so the per-IP DDoS guard sees
+        # 150+ connects from "one miner" and (correctly) bans it; keep the
+        # guard CODE in the path but lift the loopback thresholds
+        from otedama_tpu.security.ddos import DDoSConfig, DDoSProtection
+
+        app.server.ddos = DDoSProtection(DDoSConfig(
+            max_concurrent_per_ip=10000, connects_per_minute=1e9,
+            bytes_per_window=1 << 30,
+        ))
+        # retarget on a soak-friendly cadence (default reconsiders every
+        # 60 s; the spammer needs a verdict inside the soak window). The
+        # default min_difficulty clamp (0.001) sits 10,000x above EASY,
+        # so any upward retarget would jump straight to it and make
+        # shares unminable for a python loop — scale the floor down with
+        # the soak difficulty (max_step then bounds moves at 4x)
+        app.server.vardiff.config.retarget_seconds = 0.5
+        app.server.vardiff.config.min_difficulty = 1e-8
+        v1_port = app.server.port
+        v2_port = app.server_v2.port
+        # wait for the first template-loop job on both wires
+        for _ in range(200):
+            if app.server.current_job is not None and app.server_v2._jobs:
+                break
+            await asyncio.sleep(0.05)
+        assert app.server.current_job is not None
+
+        flappers = [_V1Flapper("127.0.0.1", v1_port, i, rng)
+                    for i in range(40)]
+        v2f = [_V2Flapper("127.0.0.1", v2_port, i, rng) for i in range(12)]
+
+        async def miner_life(m, cycles):
+            for _ in range(cycles):
+                try:
+                    if isinstance(m, _V1Flapper):
+                        await m.run_cycle()
+                    else:
+                        await m.run_cycle(app.server_v2)
+                except (ConnectionError, asyncio.IncompleteReadError,
+                        OSError):
+                    pass  # servers may legitimately drop an aborted peer
+                await asyncio.sleep(rng.random() * 0.2)
+
+        spam = asyncio.create_task(_vardiff_spammer("127.0.0.1", v1_port))
+        # timed churn: waves of full lifecycles for ~90 s, spanning many
+        # template-loop job refreshes
+        import time as _time
+
+        t0 = _time.monotonic()
+        waves = 0
+        while _time.monotonic() - t0 < 90:
+            await asyncio.gather(*[miner_life(m, 2) for m in flappers],
+                                 *[miner_life(m, 1) for m in v2f])
+            waves += 1
+        final_spam_diff = await asyncio.wait_for(spam, 120)
+
+        accepted = (sum(m.accepted for m in flappers)
+                    + sum(m.accepted for m in v2f))
+        rejected = (sum(m.rejected for m in flappers)
+                    + sum(m.rejected for m in v2f))
+        assert accepted >= 60, f"too few accepts ({accepted}) to mean much"
+        assert rejected >= 10, "the churn profile should produce rejects"
+
+        # vardiff really retargeted the spammer upward
+        assert final_spam_diff > EASY, final_spam_diff
+
+        # give the servers a beat to reap aborted peers
+        await asyncio.sleep(1.0)
+        assert len(app.server.sessions) <= 1, app.server.sessions  # spammer?
+        assert not app.server_v2._channels
+        assert not app.server_v2._conns
+
+        # share accounting: every accept a miner SAW is durably in the DB
+        # (the spammer's accepts land there too, so >=; and the server's
+        # own counters must cover the client-visible accepts)
+        rows = app.db.query("SELECT COUNT(*) AS c FROM shares")[0]["c"]
+        assert rows >= accepted, (rows, accepted)
+        total_server_accepts = (app.server.stats["shares_valid"]
+                                + app.server_v2.stats["shares_accepted"])
+        assert total_server_accepts == rows, (total_server_accepts, rows)
+    finally:
+        await app.stop()
+
+    # leak checks: tasks and fds return to baseline (small slack for
+    # asyncio internals / sqlite wal)
+    await asyncio.sleep(0.5)
+    assert len(asyncio.all_tasks()) <= tasks_before + 2
+    assert _fd_count() <= fds_before + 4, (fds_before, _fd_count())
