@@ -6,11 +6,13 @@ lib/pq; manager.go selects by driver name). Here ``connect_database``
 above the Database surface — the repositories in db/repos.py, the pool
 manager, the audit query route — is dialect-blind and runs unchanged.
 
-Driver-gated: needs ``psycopg`` (v3) or ``psycopg2``; neither is baked
-into this image, so the import is deferred and the error message says
-exactly what to install. The live integration test
-(tests/test_postgres.py) runs in CI against a postgres service container
-and is skipped locally without ``OTEDAMA_TEST_PG_DSN``.
+Drivers, in preference order: ``psycopg`` (v3), ``psycopg2``, then the
+VENDORED pure-python wire driver (db/pgwire.py) — so a postgres:// URL
+works with no installation at all (SCRAM-authenticated servers still
+need psycopg). The tier executes for real in tests against a loopback
+v3 wire-protocol emulator (tests/pg_emulator.py); set
+``OTEDAMA_TEST_PG_DSN`` to run the same tests against an actual
+PostgreSQL server (CI service container).
 
 Dialect mapping (one shared MIGRATIONS list, translated):
 - ``?`` placeholders        -> ``%s`` (DB-API paramstyle)
@@ -49,8 +51,10 @@ def translate_ddl(sql: str) -> str:
 
 
 def _load_driver():
-    """psycopg (v3) preferred, psycopg2 accepted; a clear install hint
-    otherwise — the app must fail loudly at startup, not mid-payout."""
+    """psycopg (v3) preferred, psycopg2 accepted, and the VENDORED pure-
+    python wire driver (db/pgwire.py) as the always-available fallback —
+    a postgres:// URL works out of the box (SCRAM-auth servers still
+    need psycopg; pgwire says so in its error)."""
     try:
         import psycopg
         import psycopg.rows  # noqa: F401 - explicit: dict_row is used
@@ -64,10 +68,15 @@ def _load_driver():
 
         return "psycopg2", psycopg2
     except ImportError:
-        raise ImportError(
-            "a postgres:// database is configured but no driver is "
-            "installed — pip install 'psycopg[binary]' (or psycopg2-binary)"
-        ) from None
+        pass
+    from otedama_tpu.db import pgwire
+
+    log.warning(
+        "psycopg not installed: using the vendored pure-python pgwire "
+        "driver (no TLS, no SCRAM; fine for trusted networks — install "
+        "psycopg for production deployments)"
+    )
+    return "pgwire", pgwire
 
 
 @dataclasses.dataclass
@@ -91,6 +100,7 @@ class PostgresDatabase(AuditMixin):
                 row_factory=self._driver.rows.dict_row,
             )
         else:
+            # psycopg2 and pgwire share the classic DB-API shape
             self._conn = self._driver.connect(dsn)
             self._conn.autocommit = True
         self.migrate()
@@ -98,6 +108,8 @@ class PostgresDatabase(AuditMixin):
     def _cursor(self):
         if self._kind == "psycopg3":
             return self._conn.cursor()
+        if self._kind == "pgwire":
+            return self._conn.cursor()  # dict rows natively
         return self._conn.cursor(
             cursor_factory=self._driver.extras.RealDictCursor
         )

@@ -88,7 +88,13 @@ class AlgorithmManager:
         self, algorithm: str, kind: str | None = None, budget_hashes: int | None = None
     ) -> BenchmarkResult:
         """Timed production-path search over a synthetic job."""
-        backend = self.backend_for(algorithm, kind)
+        extra = {}
+        if algorithm == "ethash" and kind != "full":
+            # a benchmark backend is discarded right after timing; the
+            # managed tier would otherwise kick off a background ~1 GiB
+            # epoch-0 full-DAG build that outlives it (review r5)
+            extra["full_dataset"] = False
+        backend = self.backend_for(algorithm, kind, **extra)
         header76 = bytes(range(64)) + struct.pack(
             ">3I", 0x17034219, 0x6530D1B7, 0x1D00FFFF
         )

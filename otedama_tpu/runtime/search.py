@@ -558,7 +558,7 @@ class EthashLightBackend:
                  full_pages: int | None = None,
                  block_number: int | None = None, device: bool = True,
                  chunk: int = 256, full_dataset: bool = False,
-                 cache: "np.ndarray | None" = None):
+                 cache: "np.ndarray | None" = None, cache_dev=None):
         from otedama_tpu.kernels import ethash as eth
 
         self._eth = eth
@@ -606,7 +606,10 @@ class EthashLightBackend:
         if device:
             import jax.numpy as jnp
 
-            self._cache_dev = jnp.asarray(self.cache)
+            # an already-uploaded device cache (the managed backend's
+            # light tier holds one) skips a second tens-of-MB HBM upload
+            self._cache_dev = (cache_dev if cache_dev is not None
+                               else jnp.asarray(self.cache))
         if self.full_dataset:
             # one-off per-epoch: the whole DAG generated on device and
             # kept HBM-resident; per-hash work then drops to one direct
@@ -797,12 +800,13 @@ class EthashManagedBackend:
                 with self._lock:
                     self._building.discard(epoch)
                 return
-            # hand the light tier's epoch cache to the full build: the
-            # cache generation (native keccak over tens of MB) and its
-            # device upload must not run twice per epoch
+            # hand the light tier's epoch cache (host AND device copy)
+            # to the full build: neither the cache generation (native
+            # keccak over tens of MB) nor its HBM upload may run twice
             tier = EthashLightBackend(
                 device=True, chunk=self.chunk, full_dataset=True,
-                cache=light.cache, **self._sizing(epoch),
+                cache=light.cache, cache_dev=light._cache_dev,
+                **self._sizing(epoch),
             )
         except Exception:
             # remember the failure: without backoff a persistent OOM
