@@ -89,7 +89,7 @@ class AlgorithmManager:
     ) -> BenchmarkResult:
         """Timed production-path search over a synthetic job."""
         extra = {}
-        if algorithm == "ethash" and kind != "full":
+        if algorithm == "ethash" and (kind or self.preferred_backend) != "full":
             # a benchmark backend is discarded right after timing; the
             # managed tier would otherwise kick off a background ~1 GiB
             # epoch-0 full-DAG build that outlives it (review r5)

@@ -200,11 +200,27 @@ def _maybe_certify() -> bool:
     cert = certification.get("x11")
     if not cert or missing_stages():
         return False
+    prev_variant = shavite.active_cnt_variant()
+    variant = cert.get("shavite_cnt_variant")
+    if variant:
+        # certification may have pinned a non-default counter order;
+        # the fingerprint below only matches with it applied
+        try:
+            shavite.set_cnt_variant(str(variant))
+        except ValueError:
+            logging.getLogger("otedama.kernels.x11").warning(
+                "x11 certification names unknown shavite counter "
+                "variant %r — keeping canonical=False", variant,
+            )
+            return False
     want = str(cert.get("genesis_hash", "")).lower()
     got = x11_digest(DASH_GENESIS_HEADER)[::-1].hex()
     if want and got == want:
         _algos.mark_canonical("x11")
         return True
+    # failed recheck: fall back to the default order — the process must
+    # not keep hashing under a variant that passed NO validation
+    shavite.set_cnt_variant(prev_variant)
     logging.getLogger("otedama.kernels.x11").warning(
         "x11 certification artifact present but the chain fingerprint "
         "no longer matches (%s != %s) — the kernel changed since "

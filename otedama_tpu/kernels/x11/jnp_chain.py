@@ -560,7 +560,8 @@ def _aes0_words_j(w4, sbox_mode: str | None = None):
     )
 
 
-def shavite512_64(data, sbox_mode: str | None = None):
+def shavite512_64(data, sbox_mode: str | None = None,
+                  cnt_variant: str | None = None):
     Bn = data.shape[0]
     tail = _const_rows(bytes(
         [0x80] + [0] * 45 + list((512).to_bytes(16, "little"))
@@ -583,7 +584,12 @@ def shavite512_64(data, sbox_mode: str | None = None):
                 x4 = _aes0_words_j(x4, sbox_mode)
                 for j in range(4):
                     rk.append(x4[:, j] ^ rk[u - 4 + j])
-                order = shavite._CNT_INJECT.get(u)
+                # counter-order variant (shavite.py switch): threaded
+                # as a STATIC jit argument like sbox_mode, so a
+                # certification-day flip is a different cache entry —
+                # never a stale compiled executable
+                order = shavite.CNT_VARIANTS[
+                    cnt_variant or shavite.active_cnt_variant()].get(u)
                 if order is not None:
                     for j in range(4):
                         wv = cnt[order[j]]
@@ -803,12 +809,15 @@ def echo512_64(data, sbox_mode: str | None = None):
 
 # -- the chain ----------------------------------------------------------------
 
-def x11_digest_chain(headers, sbox_mode: str | None = None):
+def x11_digest_chain(headers, sbox_mode: str | None = None,
+                     cnt_variant: str | None = None):
     """[B, 80] uint8 -> [B, 32] x11 digests (jit-friendly).
 
     ``sbox_mode``: "table" (byte-table gathers), "compute" (gather-free
     bitplane AES — the TPU form; kernels/x11/aes_bitslice), or None =
-    resolve by platform/env at trace time (see _default_sbox_mode)."""
+    resolve by platform/env at trace time (see _default_sbox_mode).
+    ``cnt_variant``: shavite counter-order (None = the active switch,
+    resolved at trace time; pass explicitly through a jit boundary)."""
     h = blake512_80(headers)
     h = bmw512_64(h)
     h = groestl512_64(h, sbox_mode)
@@ -817,7 +826,7 @@ def x11_digest_chain(headers, sbox_mode: str | None = None):
     h = keccak512_64(h)
     h = luffa512_64(h)
     h = cubehash512_64(h)
-    h = shavite512_64(h, sbox_mode)
+    h = shavite512_64(h, sbox_mode, cnt_variant)
     h = simd512_64(h)
     h = echo512_64(h, sbox_mode)
     return h[:, :32]
@@ -828,7 +837,8 @@ def x11_digest_chain(headers, sbox_mode: str | None = None):
 # evicts another's multi-minute XLA compile. sbox_mode is static: each
 # mode is a different program (and a different cache entry), so A/B
 # measurement never reuses a stale trace.
-_jitted_chain = jax.jit(x11_digest_chain, static_argnames=("sbox_mode",))
+_jitted_chain = jax.jit(x11_digest_chain,
+                        static_argnames=("sbox_mode", "cnt_variant"))
 
 
 def compiled_chain(batch: int = 0):
@@ -837,13 +847,16 @@ def compiled_chain(batch: int = 0):
 
 
 def x11_digest_device(headers_np: np.ndarray,
-                      sbox_mode: str | None = None) -> np.ndarray:
+                      sbox_mode: str | None = None,
+                      cnt_variant: str | None = None) -> np.ndarray:
     """Convenience host API: numpy [B, 80] -> numpy [B, 32]."""
     # resolve env/platform defaults HERE, outside jit, so the jit cache
     # key always carries the ACTUAL mode (an env flip between calls must
     # recompile, not hit the stale None-keyed trace)
     mode = sbox_mode or _default_sbox_mode()
+    cnt_variant = cnt_variant or shavite.active_cnt_variant()
     with jax.enable_x64():
         return np.asarray(_jitted_chain(
-            jnp.asarray(headers_np, dtype=U8), sbox_mode=mode
+            jnp.asarray(headers_np, dtype=U8), sbox_mode=mode,
+            cnt_variant=cnt_variant,
         ))

@@ -53,13 +53,71 @@ IV512 = (
     0xE275EADE, 0x502D9FCD, 0xB9357178, 0x022A4B9A,
 )
 
-# counter-injection points: subkey index -> word order (last complemented)
-_CNT_INJECT = {
-    32: (0, 1, 2, 3),
-    164: (3, 2, 1, 0),
-    316: (2, 3, 0, 1),
-    440: (1, 0, 3, 2),
+# Counter-injection word orders (verdict r5 item 8: a wrong recall must
+# cost a CONFIG FLIP, not a kernel rewrite). The Len=0 KAT pins the
+# injection OFFSETS (32/164/316/440) and the complement position (last
+# word of each group: with all counter words zero only ~c contributes),
+# but NOT the order of (c0..c3) within a group — so the order variants
+# live behind one switch and tools/certify.py auto-selects among them
+# the day a nonzero-counter vector exists (the artifact records the
+# winner; _maybe_certify applies it before the fingerprint recheck).
+# NB selectivity: for any message under 2^32 bits only counter word c0
+# is nonzero, so vectors can only pin WHERE c0 sits at each injection —
+# variants sharing that c0-position trajectory are indistinguishable by
+# any realistic vector (e.g. pure rotations share r3-recall's). The
+# registered set therefore keeps one representative per DISTINCT c0
+# trajectory (listed in the comments).
+CNT_VARIANTS: dict[str, dict[int, tuple[int, int, int, int]]] = {
+    # this author's recall of the reference; c0 at positions (0,3,2,1)
+    "r3-recall": {32: (0, 1, 2, 3), 164: (3, 2, 1, 0),
+                  316: (2, 3, 0, 1), 440: (1, 0, 3, 2)},
+    # same order everywhere; c0 at (0,0,0,0)
+    "identity": {32: (0, 1, 2, 3), 164: (0, 1, 2, 3),
+                 316: (0, 1, 2, 3), 440: (0, 1, 2, 3)},
+    # c0 walks forward; c0 at (1,2,3,0)
+    "c0-cycle": {32: (3, 0, 1, 2), 164: (1, 2, 0, 3),
+                 316: (1, 2, 3, 0), 440: (0, 3, 1, 2)},
+    # r3-recall with the last two injections swapped; c0 at (0,3,1,2)
+    "swap-mid": {32: (0, 1, 2, 3), 164: (3, 2, 1, 0),
+                 316: (1, 0, 3, 2), 440: (2, 3, 0, 1)},
+    # fully reversed everywhere; c0 at (3,3,3,3)
+    "reverse-all": {32: (3, 2, 1, 0), 164: (3, 2, 1, 0),
+                    316: (3, 2, 1, 0), 440: (3, 2, 1, 0)},
 }
+_ACTIVE_CNT_VARIANT = "r3-recall"
+
+
+def active_cnt_variant() -> str:
+    return _ACTIVE_CNT_VARIANT
+
+
+def set_cnt_variant(name: str) -> None:
+    """Switch the counter-injection word order (certification day)."""
+    global _ACTIVE_CNT_VARIANT
+    if name not in CNT_VARIANTS:
+        raise ValueError(
+            f"unknown shavite counter-order variant {name!r}; "
+            f"known: {sorted(CNT_VARIANTS)}"
+        )
+    _ACTIVE_CNT_VARIANT = name
+
+
+def select_cnt_variant(pairs: "list[tuple[bytes, bytes]]") -> str | None:
+    """Find the unique variant under which every (message, digest)
+    vector passes. Only nonzero-counter (non-empty) messages can
+    discriminate; returns None when none or several variants pass
+    (several = the vectors cannot pin the order yet)."""
+    global _ACTIVE_CNT_VARIANT
+    prev = _ACTIVE_CNT_VARIANT
+    passing = []
+    try:
+        for name in CNT_VARIANTS:
+            _ACTIVE_CNT_VARIANT = name
+            if all(shavite512_bytes(msg) == want for msg, want in pairs):
+                passing.append(name)
+    finally:
+        _ACTIVE_CNT_VARIANT = prev
+    return passing[0] if len(passing) == 1 else None
 
 
 def _words_to_aes_bytes(w: list[np.ndarray]) -> np.ndarray:
@@ -93,6 +151,7 @@ def _aes0_words(w: list[np.ndarray]) -> list[np.ndarray]:
 def expand_keys(m: list[np.ndarray], counter: int) -> list[np.ndarray]:
     """448 subkey words (lanes) from 32 message words + the bit counter."""
     cnt = [U32((counter >> (32 * i)) & 0xFFFFFFFF) for i in range(4)]
+    inject = CNT_VARIANTS[_ACTIVE_CNT_VARIANT]
     rk: list[np.ndarray] = list(m)
     u = 32
     nonlinear = True
@@ -103,7 +162,7 @@ def expand_keys(m: list[np.ndarray], counter: int) -> list[np.ndarray]:
                 x = _aes0_words(x)
                 for j in range(4):
                     rk.append(x[j] ^ rk[u - 4 + j])
-                order = _CNT_INJECT.get(u)
+                order = inject.get(u)
                 if order is not None:
                     for j in range(4):
                         w = cnt[order[j]]

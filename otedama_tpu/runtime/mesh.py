@@ -641,13 +641,15 @@ class X11PodSearch:
             raise ValueError(
                 "multiprocess X11PodSearch needs a (host, chip) mesh")
         if self.chain_fn is None:
-            from otedama_tpu.kernels.x11 import jnp_chain
+            from otedama_tpu.kernels.x11 import jnp_chain, shavite
 
-            # mode pinned at construction (outside any jit trace) so the
-            # pod's compiled-step cache always reflects the real mode
+            # mode AND shavite counter-order pinned at construction
+            # (outside any jit trace) so the pod's compiled-step cache
+            # always reflects the real configuration
             self.chain_fn = functools.partial(
                 jnp_chain.x11_digest_chain,
                 sbox_mode=jnp_chain._default_sbox_mode(),
+                cnt_variant=shavite.active_cnt_variant(),
             )
         self._steps: dict[int, callable] = {}
 

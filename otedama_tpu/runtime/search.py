@@ -454,12 +454,17 @@ class X11JaxBackend:
 
             from otedama_tpu.kernels.x11 import jnp_chain
 
+            from otedama_tpu.kernels.x11 import shavite
+
             with jax.enable_x64():
-                # resolve the sbox mode OUTSIDE jit so the compile cache
-                # is keyed on the actual mode (see x11_digest_device)
+                # resolve the sbox mode AND shavite counter-order OUTSIDE
+                # jit so the compile cache is keyed on the actual values
+                # (see x11_digest_device) — a certification-day variant
+                # flip is then a fresh cache entry, never a stale trace
                 self._fn = functools.partial(
                     jnp_chain.compiled_chain(self.chunk),
                     sbox_mode=jnp_chain._default_sbox_mode(),
+                    cnt_variant=shavite.active_cnt_variant(),
                 )
         return self._fn
 

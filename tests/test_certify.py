@@ -182,3 +182,55 @@ def test_certify_rejects_corrupt_sv2_frame(cert_env, tmp_path, certify,
     report = json.loads(capsys.readouterr().out)
     assert report["sv2_pass"] is False
     assert not cert_env.exists()
+
+
+def test_x11_certify_selects_shavite_cnt_variant(cert_env, tmp_path,
+                                                 certify, monkeypatch):
+    """Vectors generated under a non-default counter order: certify.py
+    must auto-select it, record it in the artifact, and the import-time
+    gate must re-apply it before the fingerprint recheck — a wrong
+    recall costs a config flip, not a kernel rewrite (r5 item 8)."""
+    from otedama_tpu.engine import algos
+    from otedama_tpu.kernels import x11 as x11_mod
+    from otedama_tpu.kernels.x11 import shavite
+
+    msg = bytes(range(200))
+    try:
+        shavite.set_cnt_variant("swap-mid")
+        sh_digest = shavite.shavite512_bytes(msg)
+        genesis = x11_mod.x11_digest(x11_mod.DASH_GENESIS_HEADER)[::-1].hex()
+    finally:
+        shavite.set_cnt_variant("r3-recall")
+
+    vf = tmp_path / "vectors.json"
+    vf.write_text(json.dumps({
+        "dash_genesis_hash": genesis,
+        "shavite512_vectors": [
+            {"msg_hex": msg.hex(), "digest_hex": sh_digest.hex()},
+        ],
+    }))
+    monkeypatch.setattr(sys, "argv", ["certify.py", str(vf), "--apply"])
+    try:
+        assert certify.main() == 0
+        data = json.loads(cert_env.read_text())
+        assert data["x11"]["shavite_cnt_variant"] == "swap-mid"
+        # certify.main left the selected variant active
+        assert shavite.active_cnt_variant() == "swap-mid"
+
+        # fresh import-gate pass: reset to the default recall, then let
+        # _maybe_certify re-apply the certified variant + flip the gate
+        shavite.set_cnt_variant("r3-recall")
+        algos.mark_uncanonical("x11")
+        assert x11_mod._maybe_certify() is True
+        assert shavite.active_cnt_variant() == "swap-mid"
+        assert algos.get("x11").canonical
+
+        # artifact naming an unknown variant refuses loudly
+        data["x11"]["shavite_cnt_variant"] = "bogus"
+        cert_env.write_text(json.dumps(data))
+        algos.mark_uncanonical("x11")
+        assert x11_mod._maybe_certify() is False
+        assert not algos.get("x11").canonical
+    finally:
+        shavite.set_cnt_variant("r3-recall")
+        algos.mark_uncanonical("x11")

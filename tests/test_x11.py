@@ -359,3 +359,44 @@ def test_x11_jax_backend_finds_planted_winner():
     res = X11JaxBackend(chunk=64).search(jc, base, span)
     assert [w.nonce_word for w in res.winners] == [winner]
     assert res.winners[0].digest == digests[winner]
+
+
+def test_shavite_cnt_variant_switch():
+    """The counter-order variants share the Len=0 KAT (zero counter
+    cannot discriminate orders) but diverge on ANY real input; the
+    switch + unique-selection helper make a wrong recall a config flip
+    (verdict r5 item 8)."""
+    from otedama_tpu.kernels.x11 import shavite
+
+    assert shavite.active_cnt_variant() == "r3-recall"
+    msg = bytes(range(96))  # multi-word, nonzero counter
+    digests = {}
+    try:
+        for name in shavite.CNT_VARIANTS:
+            shavite.set_cnt_variant(name)
+            digests[name] = shavite.shavite512_bytes(msg)
+        # all variants produce the SAME empty-message digest (KAT scope)
+        empties = set()
+        for name in shavite.CNT_VARIANTS:
+            shavite.set_cnt_variant(name)
+            empties.add(shavite.shavite512_bytes(b""))
+        assert len(empties) == 1
+    finally:
+        shavite.set_cnt_variant("r3-recall")
+    assert len(set(digests.values())) == len(digests), (
+        "variants must diverge on nonzero counters or they pin nothing"
+    )
+    # unique selection: a vector generated under any variant finds it
+    for planted in ("c0-cycle", "swap-mid"):
+        want = digests[planted]
+        assert shavite.select_cnt_variant([(msg, want)]) == planted
+    assert shavite.active_cnt_variant() == "r3-recall"  # restored
+    # an undiscriminating vector set (empty message) selects nothing
+    try:
+        shavite.set_cnt_variant("identity")
+        empty_digest = shavite.shavite512_bytes(b"")
+    finally:
+        shavite.set_cnt_variant("r3-recall")
+    assert shavite.select_cnt_variant([(b"", empty_digest)]) is None
+    with pytest.raises(ValueError, match="unknown"):
+        shavite.set_cnt_variant("nope")

@@ -108,7 +108,6 @@ def w_table(block128: bytes, final: bool, expansion: str) -> np.ndarray:
     s = (y * yoff) % P
     s = np.where(s > 128, s - P, s)
     m = mf if final else mn
-    W = np.zeros(256, dtype=np.int64)
     if pair == "k128":
         lo, hi = s, np.roll(s, -128)
     else:  # "2k"
