@@ -46,8 +46,17 @@ the configuration as a finalist requiring one out-of-band check of the
 true genesis hash.  tools/simd_search.py searches against both; round 3's
 mechanism-space sweep over the sph-style expansion variants (additive vs
 multiplicative yoff twist, 185/233 16-bit lift, four q->W pairing schemes,
-0x80 padding) found no match against either — the residual uncertainty is
-in the exact W-group table / FFT output ordering / IV.
+0x80 padding) found no match against either; round 4 exhausted the FFT
+output-ordering axis (SIMD_ENUM_r04.json, 384 combos); round 5 exhausted
+the STRUCTURED W-group axis (SIMD_ENUM_r05.json: per-round visit orders
+from affine/xor/bit-reversal families + the recalled rows over the
+contiguous-group-block constraint — 5.3M tables x 4 expansion variants,
+tools/simd_wsp_enum.py, all negative with zero IV-regeneration signal).
+The residual uncertainty is now outside every structured family swept:
+arbitrary per-round permutations (8!^4), a wrong IV recall, or an
+expansion mechanism none of the 4 swept variants captures.  The decisive
+unblock remains one copy of the SIMD submission or its KAT file
+(tools/certify.py applies it in minutes).
 """
 
 from __future__ import annotations

@@ -315,8 +315,9 @@ async def test_engine_mines_ethash_across_epoch_boundary():
     try:
         # epoch 0 job: permissive target so shares arrive fast
         engine.set_job(mk_job("e0", 10, (1 << 255)))
-        # generous: the first chunk pays the XLA compile (~10 s CPU)
-        for _ in range(1800):
+        # generous: the first chunk pays the XLA compile (~10 s on an
+        # idle CPU, minutes when the suite shares the box)
+        for _ in range(4800):
             if shares:
                 break
             await asyncio.sleep(0.05)
@@ -325,7 +326,8 @@ async def test_engine_mines_ethash_across_epoch_boundary():
 
         # clean job across the boundary: the engine keeps mining
         engine.set_job(mk_job("e1", eth.EPOCH_LENGTH + 3, (1 << 255)))
-        for _ in range(1800):
+        # epoch 1 is a fresh cache shape -> another full XLA compile
+        for _ in range(4800):
             if any(s.job_id == "e1" for s in shares):
                 break
             await asyncio.sleep(0.05)
