@@ -593,6 +593,13 @@ class Application:
             except Exception:
                 log.exception("stopping %s failed", type(component).__name__)
         self._started.clear()
+        for chain in (self.chain, getattr(self.pool, "chain", None)):
+            close = getattr(chain, "close", None)
+            if close is not None:
+                try:
+                    close()  # release pooled keep-alive RPC sockets
+                except Exception:
+                    log.exception("chain client close failed")
         if self.db is not None:
             self.db.close()
         log.info("application stopped")

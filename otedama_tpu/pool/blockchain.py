@@ -16,7 +16,6 @@ import json
 import logging
 import secrets
 import time
-import urllib.request
 from typing import Protocol
 
 from otedama_tpu.kernels import target as tgt
@@ -112,6 +111,8 @@ class BitcoinRPCClient:
     """
 
     def __init__(self, url: str, user: str = "", password: str = "", timeout: float = 10.0):
+        from otedama_tpu.utils.netpool import HttpConnectionPool
+
         self.url = url
         self.timeout = timeout
         self._auth = None
@@ -122,6 +123,24 @@ class BitcoinRPCClient:
                 f"{user}:{password}".encode()
             ).decode()
         self._id = 0
+        # keep-alive pool: template polls and block submits must not pay
+        # TCP connect + slow-start per call (utils/netpool — the
+        # reference's internal/network connection-pool analogue)
+        self._pool = HttpConnectionPool(url, timeout=timeout)
+        from urllib.parse import urlparse
+
+        u = urlparse(url)
+        # hosted RPC providers key auth on the query string — keep it
+        self._path = (u.path or "/") + (f"?{u.query}" if u.query else "")
+
+    # response-read replays are safe for reads/polls, NOT for submits
+    # (a replayed submitblock answers "duplicate", which would mis-report
+    # a succeeded block as rejected) — see netpool.request's policy
+    _IDEMPOTENT = frozenset({
+        "getblocktemplate", "getnetworkinfo", "getdifficulty",
+        "getblockheader", "getblockcount", "getblockchaininfo",
+        "getmininginfo", "getblock",
+    })
 
     async def _rpc(self, method: str, params: list | None = None):
         self._id += 1
@@ -130,18 +149,32 @@ class BitcoinRPCClient:
         ).encode()
 
         def do_request():
-            req = urllib.request.Request(
-                self.url, data=payload, headers={"Content-Type": "application/json"}
-            )
+            headers = {"Content-Type": "application/json"}
             if self._auth:
-                req.add_header("Authorization", self._auth)
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return json.loads(resp.read())
+                headers["Authorization"] = self._auth
+            resp = self._pool.request(
+                "POST", self._path, body=payload, headers=headers,
+                idempotent=method in self._IDEMPOTENT,
+            )
+            # bitcoind ships JSON-RPC errors WITH an HTTP error status —
+            # prefer the JSON error object; a proxy's HTML error page
+            # (502 from nginx etc.) must surface the STATUS, not a
+            # JSONDecodeError
+            try:
+                return json.loads(resp.body)
+            except ValueError:
+                raise RuntimeError(
+                    f"rpc http {resp.status}: non-JSON response"
+                ) from None
 
         obj = await asyncio.get_running_loop().run_in_executor(None, do_request)
         if obj.get("error"):
             raise RuntimeError(f"rpc {method}: {obj['error']}")
         return obj["result"]
+
+    def close(self) -> None:
+        """Release pooled keep-alive sockets (app teardown)."""
+        self._pool.close()
 
     async def get_block_template(self) -> BlockTemplate:
         t = await self._rpc("getblocktemplate", [{"rules": ["segwit"]}])

@@ -176,3 +176,135 @@ async def test_failover_scoring_and_selection():
     fm2 = FailoverManager([good, bad], FailoverStrategy.PERFORMANCE)
     if not bad.reachable:
         assert fm2.select() is good
+
+
+def test_http_connection_pool_reuses_and_retries():
+    """utils/netpool: keep-alive reuse (one TCP connection, many
+    requests), stale-keepalive replay, and latency telemetry — the
+    reference's internal/network connection-pool analogue, applied to
+    the JSON-RPC path."""
+    import http.server
+    import json as jsonmod
+    import threading
+
+    from otedama_tpu.utils.netpool import HttpConnectionPool
+
+    connections = []
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"  # keep-alive on
+
+        def do_POST(self):
+            n = int(self.headers["Content-Length"])
+            body = self.rfile.read(n)
+            payload = jsonmod.loads(body)
+            out = jsonmod.dumps({"id": payload["id"], "error": None,
+                                 "result": payload["method"]}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+        def setup(self):
+            super().setup()
+            connections.append(self.client_address)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{srv.server_port}/"
+        pool = HttpConnectionPool(url)
+        for i in range(8):
+            resp = pool.request(
+                "POST", "/", jsonmod.dumps(
+                    {"id": i, "method": f"m{i}"}).encode(),
+                {"Content-Type": "application/json"})
+            assert resp.status == 200
+            assert jsonmod.loads(resp.body)["result"] == f"m{i}"
+        snap = pool.snapshot()
+        # 8 requests over ONE tcp connection: 7 reuses, 1 open
+        assert len(connections) == 1, connections
+        assert snap["requests"] == 8 and snap["reused"] == 7
+        assert snap["opened"] == 1 and snap["errors"] == 0
+        assert snap["latency_ema_ms"] > 0
+
+        # dead keep-alive: the next request must transparently replay
+        # on a fresh connection
+        srv_sockets_before = len(connections)
+        # force the server side to drop: close our pooled socket's peer
+        # by restarting the listener's existing connections is awkward;
+        # emulate by closing OUR idle socket so the next write fails
+        with pool._lock:
+            for _, c in pool._idle:
+                c.sock.close()  # half-dead: write raises on use
+        resp = pool.request(
+            "POST", "/", jsonmod.dumps(
+                {"id": 99, "method": "after"}).encode(),
+            {"Content-Type": "application/json"})
+        assert resp.status == 200
+        assert pool.snapshot()["retries"] >= 1
+        assert len(connections) == srv_sockets_before + 1
+        pool.close()
+    finally:
+        srv.shutdown()
+
+
+@pytest.mark.asyncio
+async def test_bitcoin_rpc_client_rides_the_pool():
+    """BitcoinRPCClient template/submit calls reuse one keep-alive
+    connection instead of reconnecting per RPC."""
+    import http.server
+    import json as jsonmod
+    import threading
+
+    from otedama_tpu.pool.blockchain import BitcoinRPCClient
+
+    connections = []
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def do_POST(self):
+            n = int(self.headers["Content-Length"])
+            req = jsonmod.loads(self.rfile.read(n))
+            result = {
+                "getblocktemplate": {
+                    "version": 0x20000000, "height": 101,
+                    "previousblockhash": "00" * 32, "transactions": [],
+                    "coinbasevalue": 50_0000_0000, "bits": "1d00ffff",
+                    "curtime": 1700000000, "target": "00" * 32,
+                },
+                "getnetworkinfo": {"version": 250000},
+                "getdifficulty": 1.5,
+            }.get(req["method"], None)
+            out = jsonmod.dumps({"id": req["id"], "error": None,
+                                 "result": result}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+        def setup(self):
+            super().setup()
+            connections.append(1)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        client = BitcoinRPCClient(
+            f"http://127.0.0.1:{srv.server_port}/", user="u", password="p")
+        t = await client.get_block_template()
+        assert t.height == 101
+        d = await client.get_network_difficulty()
+        assert d == 1.5
+        await client.get_block_template()
+        assert len(connections) == 1  # every RPC shared one connection
+        assert client._pool.snapshot()["reused"] == 2
+    finally:
+        srv.shutdown()
