@@ -368,6 +368,33 @@ class ApiServer:
         reg.counter_set("otedama_blocks_found_total",
                         snapshot.get("blocks_found", 0), help_="Blocks found")
 
+    def sync_rpc_pool_metrics(self, chains: dict) -> None:
+        """Connection-pool telemetry for the blockchain RPC endpoints
+        (utils/netpool) — the reuse/latency counters are how the pool's
+        effect stays observable in production."""
+        for endpoint, chain in chains.items():
+            snapshot = getattr(chain, "pool_snapshot", None)
+            if snapshot is None:
+                continue  # e.g. MockChainClient: no network, no pool
+            snap = snapshot()
+            for key in ("requests", "reused", "opened", "retries",
+                        "errors"):
+                self.registry.counter_set(
+                    f"otedama_rpc_{key}_total", snap[key],
+                    {"endpoint": endpoint},
+                    help_="Blockchain RPC connection-pool counters",
+                )
+            self.registry.gauge_set(
+                "otedama_rpc_latency_ema_seconds",
+                snap["latency_ema_ms"] / 1e3, {"endpoint": endpoint},
+                help_="RPC request latency EMA",
+            )
+            self.registry.gauge_set(
+                "otedama_rpc_idle_connections", snap["idle"],
+                {"endpoint": endpoint},
+                help_="Pooled keep-alive connections currently idle",
+            )
+
     def sync_client_metrics(self, client) -> None:
         """Export the stratum client's measured share-accept latency
         distribution (BASELINE config 4; reference target <50 ms)."""

@@ -567,7 +567,20 @@ class Application:
     async def _metrics_loop(self) -> None:
         while True:
             await asyncio.sleep(5.0)
-            if self.api is not None and self.engine is not None:
+            if self.api is None:
+                continue
+            # chain-RPC pool telemetry is engine-independent: a
+            # pool-only node (mining disabled) still polls templates
+            # and submits blocks over the pooled RPC connections
+            chains = {
+                name: c for name, c in (
+                    ("solo", self.chain),
+                    ("pool", getattr(self.pool, "chain", None)),
+                ) if c is not None
+            }
+            if chains:
+                self.api.sync_rpc_pool_metrics(chains)
+            if self.engine is not None:
                 snap = self.engine.snapshot()
                 self.api.sync_engine_metrics(snap)
                 if self.client is not None:

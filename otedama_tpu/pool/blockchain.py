@@ -176,6 +176,10 @@ class BitcoinRPCClient:
         """Release pooled keep-alive sockets (app teardown)."""
         self._pool.close()
 
+    def pool_snapshot(self) -> dict:
+        """Connection-pool telemetry (exported at /metrics)."""
+        return self._pool.snapshot()
+
     async def get_block_template(self) -> BlockTemplate:
         t = await self._rpc("getblocktemplate", [{"rules": ["segwit"]}])
         # NOTE: coinbase construction from template transactions is chain-

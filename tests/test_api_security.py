@@ -535,3 +535,31 @@ def test_secret_store(tmp_path):
     assert again.get("wallet") == "xprv123"
     with pytest.raises(DecryptionError):
         SecretStore(p, "wrong")
+
+
+def test_rpc_pool_metrics_export():
+    """utils/netpool counters surface at /metrics with per-endpoint
+    labels (the connection pool must stay observable in production)."""
+    from otedama_tpu.api.server import ApiServer
+
+    class FakePool:
+        def snapshot(self):
+            return {"requests": 10, "reused": 8, "opened": 2,
+                    "retries": 1, "errors": 0, "idle": 2,
+                    "latency_ema_ms": 3.5}
+
+    class FakeChain:
+        def pool_snapshot(self):
+            return FakePool().snapshot()
+
+    api = ApiServer.__new__(ApiServer)
+    from otedama_tpu.api.metrics import MetricsRegistry
+
+    api.registry = MetricsRegistry()
+    api.sync_rpc_pool_metrics({"solo": FakeChain(), "noop": object()})
+    text = api.registry.render()
+    assert 'otedama_rpc_requests_total{endpoint="solo"} 10' in text
+    assert 'otedama_rpc_reused_total{endpoint="solo"} 8' in text
+    assert 'otedama_rpc_latency_ema_seconds{endpoint="solo"} 0.0035' in text
+    assert 'otedama_rpc_idle_connections{endpoint="solo"} 2' in text
+    assert 'endpoint="noop"' not in text  # chains without a pool skip

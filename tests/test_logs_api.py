@@ -163,3 +163,4 @@ def test_db_query_audit(tmp_path):
     assert db.query_audit(actor="admin", limit=1)[0]["action"] == "backup"
     assert db.query_audit(action="login")[0]["actor"] == "eve"
     db.close()
+
