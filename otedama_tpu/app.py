@@ -184,18 +184,38 @@ class Application:
         if cfg.stratum.v2_enabled:
             from otedama_tpu.stratum.v2 import Sv2MiningServer, Sv2ServerConfig
 
-            noise_key = None
-            if cfg.stratum.v2_noise_key_file:
+            def read_hex_file(path: str, want_len: int, what: str) -> bytes:
+                # a wrong file must kill STARTUP with the file named —
+                # served as-is it would only fail on the miners' side,
+                # where the pool operator cannot see it
                 import pathlib as _pl
 
-                noise_key = bytes.fromhex(
-                    _pl.Path(cfg.stratum.v2_noise_key_file)
-                    .read_text().strip()
-                )
-                if len(noise_key) != 32:
+                data = bytes.fromhex(_pl.Path(path).read_text().strip())
+                if len(data) != want_len:
                     raise ValueError(
-                        f"{cfg.stratum.v2_noise_key_file}: X25519 static "
-                        f"key must be 32 bytes, got {len(noise_key)}"
+                        f"{path}: {what} must be {want_len} bytes, "
+                        f"got {len(data)}"
+                    )
+                return data
+
+            noise_key = None
+            if cfg.stratum.v2_noise_key_file:
+                noise_key = read_hex_file(
+                    cfg.stratum.v2_noise_key_file, 32,
+                    "X25519 static key")
+            noise_cert = None
+            if cfg.stratum.v2_noise_cert_file:
+                from otedama_tpu.stratum.noise import NoiseCertificate
+
+                noise_cert = read_hex_file(
+                    cfg.stratum.v2_noise_cert_file,
+                    NoiseCertificate.WIRE_LEN, "noise certificate")
+                cert = NoiseCertificate.decode(noise_cert)
+                if not (cert.valid_from <= time.time()
+                        <= cert.not_valid_after):
+                    raise ValueError(
+                        f"{cfg.stratum.v2_noise_cert_file}: certificate "
+                        "validity window is not current"
                     )
             self.server_v2 = Sv2MiningServer(
                 Sv2ServerConfig(
@@ -205,6 +225,7 @@ class Application:
                     max_clients=cfg.stratum.max_clients,
                     noise=cfg.stratum.v2_noise,
                     noise_static_key=noise_key,
+                    noise_certificate=noise_cert,
                 ),
                 on_share=self.pool.on_share,
                 on_block=self.pool.on_block,

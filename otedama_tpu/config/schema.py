@@ -112,6 +112,9 @@ class StratumSettings:
     # identity survives restarts; empty path = fresh key each start
     v2_noise: bool = False
     v2_noise_key_file: str = ""
+    # hex-encoded NoiseCertificate (the authority's BIP340 endorsement
+    # of the static key); empty = no certificate in the handshake
+    v2_noise_cert_file: str = ""
 
 
 @dataclasses.dataclass
@@ -290,6 +293,7 @@ stratum:
   v2_port: 3336
   v2_noise: false     # Noise-NX encrypted transport for V2
   v2_noise_key_file: ""  # hex X25519 static key (empty = fresh each start)
+  v2_noise_cert_file: ""  # hex authority certificate (optional)
 
 pool:
   enabled: false

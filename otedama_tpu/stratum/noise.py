@@ -22,12 +22,14 @@ Scope notes (stated, not hidden — same discipline as stratum/v2.py):
 
 - Protocol name ``Noise_NX_25519_ChaChaPoly_SHA256`` and the SV2
   framing (u16-LE length-prefixed noise messages, 65535-byte cap) are
-  offline recall; the SV2 spec's *certificate* layer (the responder
-  signs its static key with an authority key — secp256k1 Schnorr) is
-  NOT implemented: the handshake payload is empty, so a client gets
-  confidentiality + integrity but must pin the server key out-of-band
-  for authentication. Interop with third-party endpoints stays behind
-  ``v2.INTEROP_VERIFIED``.
+  offline recall. The SV2 *certificate* layer IS implemented
+  (``NoiseCertificate`` + stratum/schnorr.py BIP340): the pool
+  authority signs (version, validity window, server static key) and
+  the certificate rides the handshake's message-2 payload — encrypted,
+  so only a peer that completed the key exchange sees it; a client
+  configured with the authority key verifies it before any protocol
+  byte. The exact SV2 certificate field order is recall — interop with
+  third-party endpoints stays behind ``v2.INTEROP_VERIFIED``.
 - Pure Python by design: handshakes are rare and mining frames are
   tiny (< 300 B at share rates of a few Hz), so primitive throughput
   is irrelevant here; nothing in the TPU compute path touches this.
@@ -35,10 +37,12 @@ Scope notes (stated, not hidden — same discipline as stratum/v2.py):
 
 from __future__ import annotations
 
+import dataclasses
 import hashlib
 import hmac
 import os
 import struct
+import time as _time
 
 # -- X25519 (RFC 7748) --------------------------------------------------------
 
@@ -352,6 +356,72 @@ class NXHandshake:
         return payload, c_i2r, c_r2i
 
 
+# -- SV2 certificate (SignatureNoiseMessage) ----------------------------------
+
+@dataclasses.dataclass
+class NoiseCertificate:
+    """The pool authority's endorsement of one server static key.
+
+    Wire shape (recalled from the SV2 spec's SignatureNoiseMessage):
+    ``version u16 | valid_from u32 | not_valid_after u32 |
+    signature (64B BIP340)`` — 74 bytes, little-endian ints, signed by
+    the AUTHORITY key over (version, window, server static pubkey). A
+    miner fleet pins ONE authority key instead of every server key.
+    """
+
+    version: int
+    valid_from: int
+    not_valid_after: int
+    signature: bytes
+
+    WIRE_LEN = 2 + 4 + 4 + 64
+
+    @staticmethod
+    def signed_payload(version: int, valid_from: int, not_valid_after: int,
+                       server_static_pub: bytes) -> bytes:
+        return struct.pack("<HII", version, valid_from,
+                           not_valid_after) + server_static_pub
+
+    @classmethod
+    def issue(cls, authority_seckey: bytes, server_static_pub: bytes,
+              valid_from: int | None = None,
+              not_valid_after: int | None = None,
+              version: int = 0) -> "NoiseCertificate":
+        from otedama_tpu.stratum import schnorr
+
+        now = int(_time.time())
+        valid_from = now - 600 if valid_from is None else valid_from
+        not_valid_after = (now + 365 * 86400 if not_valid_after is None
+                           else not_valid_after)
+        sig = schnorr.sign(authority_seckey, cls.signed_payload(
+            version, valid_from, not_valid_after, server_static_pub))
+        return cls(version, valid_from, not_valid_after, sig)
+
+    def encode(self) -> bytes:
+        return struct.pack("<HII", self.version, self.valid_from,
+                           self.not_valid_after) + self.signature
+
+    @classmethod
+    def decode(cls, data: bytes) -> "NoiseCertificate":
+        if len(data) != cls.WIRE_LEN:
+            raise HandshakeError(
+                f"certificate payload is {len(data)} bytes, "
+                f"want {cls.WIRE_LEN}")
+        v, vf, nva = struct.unpack("<HII", data[:10])
+        return cls(v, vf, nva, data[10:])
+
+    def verify(self, authority_pub: bytes, server_static_pub: bytes,
+               now: float | None = None) -> bool:
+        from otedama_tpu.stratum import schnorr
+
+        now = _time.time() if now is None else now
+        if not (self.valid_from <= now <= self.not_valid_after):
+            return False
+        return schnorr.verify(authority_pub, self.signed_payload(
+            self.version, self.valid_from, self.not_valid_after,
+            server_static_pub), self.signature)
+
+
 # -- SV2 noise framing over asyncio streams -----------------------------------
 
 MAX_NOISE_MSG = 65535  # u16 length prefix
@@ -375,10 +445,12 @@ class NoiseSession:
     are directional CipherStates from ``split()``."""
 
     def __init__(self, send_cipher: CipherState, recv_cipher: CipherState,
-                 rs: bytes | None = None):
+                 rs: bytes | None = None,
+                 certificate: "NoiseCertificate | None" = None):
         self.send_cipher = send_cipher
         self.recv_cipher = recv_cipher
         self.rs = rs  # remote static key (initiator side): pin it!
+        self.certificate = certificate  # verified authority endorsement
 
     def seal(self, frame: bytes) -> bytes:
         ct = self.send_cipher.encrypt(frame)
@@ -390,32 +462,52 @@ class NoiseSession:
         return self.recv_cipher.decrypt(await _read_lp(reader))
 
 
-async def client_handshake(reader, writer) -> NoiseSession:
+async def client_handshake(reader, writer,
+                           authority_key: bytes | None = None
+                           ) -> NoiseSession:
     """Initiator side: returns the transport session (``.rs`` carries
-    the server's static key for out-of-band pinning)."""
+    the server's static key for out-of-band pinning). With
+    ``authority_key`` (32-byte x-only BIP340 pubkey) the server MUST
+    present a valid certificate over its static key in the message-2
+    payload — fleet authentication without per-server pinning."""
     hs = NXHandshake(initiator=True)
     _write_lp(writer, hs.write_message_1())
     await writer.drain()
     msg2 = await _read_lp(reader)
     try:
-        _, c_i2r, c_r2i = hs.read_message_2(msg2)
+        payload, c_i2r, c_r2i = hs.read_message_2(msg2)
     except AuthError as e:
         raise HandshakeError(f"handshake message 2 failed auth: {e}") from e
-    return NoiseSession(c_i2r, c_r2i, rs=hs.rs)
+    cert = None
+    if authority_key is not None:
+        if not payload:
+            raise HandshakeError(
+                "authority verification required but the server sent no "
+                "certificate")
+        cert = NoiseCertificate.decode(payload)
+        if not cert.verify(authority_key, hs.rs):
+            raise HandshakeError(
+                "server certificate failed authority verification "
+                "(expired window or wrong/forged authority signature)")
+    return NoiseSession(c_i2r, c_r2i, rs=hs.rs, certificate=cert)
 
 
 async def server_handshake(reader, writer,
-                           s_priv: bytes | None = None) -> NoiseSession:
+                           s_priv: bytes | None = None,
+                           certificate: bytes | None = None
+                           ) -> NoiseSession:
     """Responder side. ``s_priv`` is the pool's long-lived static key
     (generated fresh when omitted — fine for tests, wrong for a real
-    pool, whose miners pin the static key)."""
+    pool, whose miners pin the static key or verify the authority
+    ``certificate`` — an encoded NoiseCertificate carried encrypted in
+    the message-2 payload)."""
     hs = NXHandshake(initiator=False, s_priv=s_priv)
     msg1 = await _read_lp(reader)
     try:
         hs.read_message_1(msg1)
     except AuthError as e:
         raise HandshakeError(f"handshake message 1 failed auth: {e}") from e
-    msg2, c_i2r, c_r2i = hs.write_message_2()
+    msg2, c_i2r, c_r2i = hs.write_message_2(certificate or b"")
     _write_lp(writer, msg2)
     await writer.drain()
     return NoiseSession(c_r2i, c_i2r)

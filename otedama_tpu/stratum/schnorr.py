@@ -1,0 +1,210 @@
+"""BIP340 Schnorr signatures over secp256k1 (pure Python).
+
+Completes the SV2 security story that stratum/noise.py scoped out: the
+spec's certificate layer has the pool AUTHORITY sign the server's
+static Noise key (SignatureNoiseMessage), so a miner can authenticate
+a pool fleet by pinning one authority key instead of every server key.
+The signature scheme is BIP340 Schnorr (x-only public keys, tagged
+hashes), implemented here from the BIP:
+
+- secp256k1 group ops in Jacobian coordinates (no timing hardening —
+  fine for VERIFY-mostly use; pools signing certificates do so
+  offline, and the handshake secrecy lives in the Noise layer);
+- tagged hashes ``SHA256(SHA256(tag)||SHA256(tag)||msg)``;
+- signing per BIP340's default (aux-rand nonce derivation), verify per
+  the BIP's algorithm including the even-Y rules.
+
+Validation status: the curve constants and pubkey(3)'s famous
+x-coordinate are checked at import (the point arithmetic must
+reproduce it); sign/verify roundtrips and malleation rejection are
+unit-tested. The official BIP340 CSV vectors could not be carried into
+this offline environment byte-for-byte — tools/certify.py-style
+external confirmation applies before trusting third-party certificate
+interop (the same discipline as the SV2 message-id table).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+# secp256k1
+P = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEFFFFFC2F
+N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+GX = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
+GY = 0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8
+
+_INF = None  # point at infinity
+
+
+def _jadd(a, b):
+    """Jacobian addition (a, b are (X, Y, Z) or None)."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    X1, Y1, Z1 = a
+    X2, Y2, Z2 = b
+    Z1Z1 = Z1 * Z1 % P
+    Z2Z2 = Z2 * Z2 % P
+    U1 = X1 * Z2Z2 % P
+    U2 = X2 * Z1Z1 % P
+    S1 = Y1 * Z2 * Z2Z2 % P
+    S2 = Y2 * Z1 * Z1Z1 % P
+    if U1 == U2:
+        if S1 != S2:
+            return _INF
+        return _jdbl(a)
+    H = (U2 - U1) % P
+    I = 4 * H * H % P
+    J = H * I % P
+    r = 2 * (S2 - S1) % P
+    V = U1 * I % P
+    X3 = (r * r - J - 2 * V) % P
+    Y3 = (r * (V - X3) - 2 * S1 * J) % P
+    Z3 = 2 * H * Z1 * Z2 % P
+    return (X3, Y3, Z3)
+
+
+def _jdbl(a):
+    if a is None:
+        return _INF
+    X1, Y1, Z1 = a
+    if Y1 == 0:
+        return _INF
+    A = X1 * X1 % P
+    B = Y1 * Y1 % P
+    C = B * B % P
+    D = 2 * ((X1 + B) * (X1 + B) - A - C) % P
+    E = 3 * A % P
+    F = E * E % P
+    X3 = (F - 2 * D) % P
+    Y3 = (E * (D - X3) - 8 * C) % P
+    Z3 = 2 * Y1 * Z1 % P
+    return (X3, Y3, Z3)
+
+
+def _jmul(point, k: int):
+    """Scalar multiply (double-and-add; see module docstring re timing)."""
+    result = _INF
+    addend = point
+    while k:
+        if k & 1:
+            result = _jadd(result, addend)
+        addend = _jdbl(addend)
+        k >>= 1
+    return result
+
+
+def _affine(a):
+    if a is None:
+        raise ValueError("point at infinity")
+    X, Y, Z = a
+    zinv = pow(Z, P - 2, P)
+    z2 = zinv * zinv % P
+    return (X * z2 % P, Y * z2 * zinv % P)
+
+
+_G = (GX, GY, 1)
+
+
+def _lift_x(x: int):
+    """BIP340 lift_x: the point with this x and EVEN y, or None."""
+    if x >= P:
+        return None
+    c = (pow(x, 3, P) + 7) % P
+    y = pow(c, (P + 1) // 4, P)
+    if y * y % P != c:
+        return None
+    if y & 1:
+        y = P - y
+    return (x, y)
+
+
+def tagged_hash(tag: str, msg: bytes) -> bytes:
+    th = hashlib.sha256(tag.encode()).digest()
+    return hashlib.sha256(th + th + msg).digest()
+
+
+def pubkey(seckey: bytes) -> bytes:
+    """32-byte x-only public key for a 32-byte secret."""
+    d = int.from_bytes(seckey, "big")
+    if not 1 <= d < N:
+        raise ValueError("secret key out of range")
+    x, _ = _affine(_jmul(_G, d))
+    return x.to_bytes(32, "big")
+
+
+def keypair(seckey: bytes | None = None) -> tuple[bytes, bytes]:
+    while True:
+        sk = seckey if seckey is not None else os.urandom(32)
+        d = int.from_bytes(sk, "big")
+        if 1 <= d < N:
+            return sk, pubkey(sk)
+        if seckey is not None:
+            raise ValueError("secret key out of range")
+
+
+def sign(seckey: bytes, msg: bytes, aux_rand: bytes | None = None) -> bytes:
+    """BIP340 sign (64 bytes). ``msg`` is arbitrary length (the BIP
+    allows it; SV2 signs a fixed struct digest anyway)."""
+    d0 = int.from_bytes(seckey, "big")
+    if not 1 <= d0 < N:
+        raise ValueError("secret key out of range")
+    px, py = _affine(_jmul(_G, d0))
+    d = d0 if py % 2 == 0 else N - d0
+    aux = aux_rand if aux_rand is not None else os.urandom(32)
+    t = (d ^ int.from_bytes(tagged_hash("BIP0340/aux", aux), "big"))
+    rand = tagged_hash(
+        "BIP0340/nonce",
+        t.to_bytes(32, "big") + px.to_bytes(32, "big") + msg,
+    )
+    k0 = int.from_bytes(rand, "big") % N
+    if k0 == 0:
+        raise ValueError("zero nonce (astronomically unlikely)")
+    rx, ry = _affine(_jmul(_G, k0))
+    k = k0 if ry % 2 == 0 else N - k0
+    e = int.from_bytes(tagged_hash(
+        "BIP0340/challenge",
+        rx.to_bytes(32, "big") + px.to_bytes(32, "big") + msg,
+    ), "big") % N
+    sig = rx.to_bytes(32, "big") + ((k + e * d) % N).to_bytes(32, "big")
+    if not verify(px.to_bytes(32, "big"), msg, sig):
+        raise RuntimeError("self-check failed: produced invalid signature")
+    return sig
+
+
+def verify(pubkey_x: bytes, msg: bytes, sig: bytes) -> bool:
+    """BIP340 verify: 32-byte x-only pubkey, 64-byte signature."""
+    if len(pubkey_x) != 32 or len(sig) != 64:
+        return False
+    pt = _lift_x(int.from_bytes(pubkey_x, "big"))
+    if pt is None:
+        return False
+    r = int.from_bytes(sig[:32], "big")
+    s = int.from_bytes(sig[32:], "big")
+    if r >= P or s >= N:
+        return False
+    e = int.from_bytes(tagged_hash(
+        "BIP0340/challenge", sig[:32] + pubkey_x + msg
+    ), "big") % N
+    # R = s*G - e*P
+    R = _jadd(_jmul(_G, s),
+              _jmul((pt[0], P - pt[1], 1), e))
+    if R is None:
+        return False
+    Rx, Ry, Rz = R
+    if Rz == 0:
+        return False
+    ax, ay = _affine(R)
+    return ay % 2 == 0 and ax == r
+
+
+# import-time self-check: the group law must reproduce the famous
+# pubkey(3) x-coordinate (3*G), or everything above is garbage
+_PK3 = "f9308a019258c31049344f85f89d5229b531c845836f99b08601f113bce036f9"
+if pubkey((3).to_bytes(32, "big")).hex() != _PK3:
+    # a plain raise, NOT assert: python -O strips asserts and this check
+    # is the module's whole claim to arithmetic correctness
+    raise RuntimeError("secp256k1 arithmetic failed its known-point "
+                       "self-check")

@@ -15,11 +15,11 @@ check at unified_stratum.go:888-913).
 Scope notes (stated, not hidden):
 
 - **Transport security**: the SV2 spec mounts this protocol behind a
-  Noise-NX encrypted transport. Curve25519/ChaCha20-Poly1305 primitives
-  are not available in this offline environment, so the transport here
-  is cleartext TCP; the framing/messages are transport-independent and
-  a noise wrapper slots between ``_read_frame``/``_send`` when the
-  primitives exist.
+  Noise-NX encrypted transport — implemented in stratum/noise.py
+  (X25519 + ChaCha20-Poly1305 from the RFCs, NX handshake, optional
+  authority certificates via stratum/schnorr.py BIP340) and enabled
+  with ``Sv2ServerConfig.noise`` / the client's ``noise=True``;
+  cleartext TCP remains the default for loopback/testing.
 - **Message-type ids** follow the public SV2 spec as recalled offline
   (SetupConnection 0x00/0x01/0x02, OpenStandardMiningChannel
   0x10/0x11/0x12, NewMiningJob 0x15 — the SRI const_sv2 value, with
@@ -588,9 +588,13 @@ class Sv2ServerConfig:
     # connection must complete the handshake before its first frame.
     # noise_static_key is the pool's long-lived X25519 private key
     # (generated fresh at start() when omitted — miners pin the public
-    # key, so a real deployment supplies a stable one)
+    # key, so a real deployment supplies a stable one).
+    # noise_certificate: encoded NoiseCertificate (the pool AUTHORITY's
+    # BIP340 endorsement of the static key) sent in the handshake so
+    # miners can pin one authority key for a whole fleet
     noise: bool = False
     noise_static_key: bytes | None = None
+    noise_certificate: bytes | None = None
     handshake_timeout: float = 10.0
 
 
@@ -743,7 +747,8 @@ class Sv2MiningServer:
                 # a peer that stalls the handshake is cut by timeout
                 conn.session = await asyncio.wait_for(
                     noise.server_handshake(
-                        reader, writer, self.config.noise_static_key),
+                        reader, writer, self.config.noise_static_key,
+                        certificate=self.config.noise_certificate),
                     timeout=self.config.handshake_timeout,
                 )
             except (noise.HandshakeError, noise.AuthError,
@@ -958,7 +963,8 @@ class Sv2MiningClient:
 
     def __init__(self, host: str, port: int, user: str = "worker",
                  allow_uninterop: bool = False, noise: bool = False,
-                 expected_server_key: bytes | None = None):
+                 expected_server_key: bytes | None = None,
+                 authority_key: bytes | None = None):
         if (not INTEROP_VERIFIED and not allow_uninterop
                 and host not in ("127.0.0.1", "::1", "localhost")):
             # enforced in code, not prose (verdict r4 weak #5): the
@@ -979,6 +985,11 @@ class Sv2MiningClient:
         # key obtained out-of-band, and it must happen INSIDE connect()
         # before a single protocol byte (user identity!) is sent
         self.expected_server_key = expected_server_key
+        # fleet authentication: a BIP340 authority pubkey makes the
+        # handshake demand a valid certificate over the server's static
+        # key (stratum/noise.NoiseCertificate) — one pinned key for many
+        # servers, instead of expected_server_key's exact-match pin
+        self.authority_key = authority_key
         self.noise_server_key: bytes | None = None
         self.reader: asyncio.StreamReader | None = None
         self.writer: asyncio.StreamWriter | None = None
@@ -998,14 +1009,17 @@ class Sv2MiningClient:
         session = None
         if self.noise:
             # NX: the server transmits (and proves possession of) its
-            # static key during the handshake — the SV2 certificate
-            # authority layer is out of scope (module docstring). The
+            # static key during the handshake; with ``authority_key``
+            # set, the handshake additionally demands a valid authority
+            # certificate over that key (noise.NoiseCertificate). The
             # timeout covers a stalled server or a cleartext endpoint
             # that will never answer a noise message; any failure closes
             # the socket (a reconnect loop must not leak one FD per try)
             try:
                 session = await asyncio.wait_for(
-                    noise.client_handshake(self.reader, self.writer),
+                    noise.client_handshake(
+                        self.reader, self.writer,
+                        authority_key=self.authority_key),
                     timeout=handshake_timeout,
                 )
                 if (self.expected_server_key is not None
