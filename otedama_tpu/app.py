@@ -184,19 +184,10 @@ class Application:
         if cfg.stratum.v2_enabled:
             from otedama_tpu.stratum.v2 import Sv2MiningServer, Sv2ServerConfig
 
-            def read_hex_file(path: str, want_len: int, what: str) -> bytes:
-                # a wrong file must kill STARTUP with the file named —
-                # served as-is it would only fail on the miners' side,
-                # where the pool operator cannot see it
-                import pathlib as _pl
-
-                data = bytes.fromhex(_pl.Path(path).read_text().strip())
-                if len(data) != want_len:
-                    raise ValueError(
-                        f"{path}: {what} must be {want_len} bytes, "
-                        f"got {len(data)}"
-                    )
-                return data
+            # a wrong file must kill STARTUP with the file named —
+            # served as-is it would only fail on the miners' side,
+            # where the pool operator cannot see it
+            from otedama_tpu.utils.keyfiles import read_hex_file
 
             noise_key = None
             if cfg.stratum.v2_noise_key_file:
@@ -205,6 +196,15 @@ class Application:
                     "X25519 static key")
             noise_cert = None
             if cfg.stratum.v2_noise_cert_file:
+                if noise_key is None:
+                    # a cert without a PERSISTED key would be served next
+                    # to a fresh random static key it can never endorse —
+                    # failing only on the miners' side
+                    raise ValueError(
+                        "stratum.v2_noise_cert_file is set but "
+                        "v2_noise_key_file is not: the certificate can "
+                        "only endorse a persisted static key"
+                    )
                 from otedama_tpu.stratum.noise import NoiseCertificate
 
                 noise_cert = read_hex_file(

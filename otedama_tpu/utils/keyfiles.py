@@ -1,0 +1,49 @@
+"""Hex-encoded key-material files: validated reads, safe writes.
+
+One implementation for every place that touches key/certificate files
+(app startup, tools/sv2_authority.py), so the validation discipline —
+exact length, the FILE named in the error, secrets never created
+world-readable, no silent clobbering — cannot drift between copies.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+
+
+def read_hex_file(path: str | os.PathLike, want_len: int,
+                  what: str) -> bytes:
+    """One line of hex -> bytes, length-checked with the file named in
+    the error (a wrong file must fail HERE, where the operator sees it,
+    not on the far side of a handshake)."""
+    data = bytes.fromhex(pathlib.Path(path).read_text().strip())
+    if len(data) != want_len:
+        raise ValueError(
+            f"{path}: {what} must be {want_len} bytes, got {len(data)}"
+        )
+    return data
+
+
+def write_hex_file(path: str | os.PathLike, data: bytes,
+                   secret: bool = False, force: bool = False) -> None:
+    """Write one line of hex. ``secret=True`` creates the file 0600
+    ATOMICALLY (O_EXCL + mode at open — never a world-readable window,
+    never a partial chmod after a crash). Existing files are refused
+    unless ``force`` (a rerun must not silently destroy the fleet
+    authority key every deployed miner pins)."""
+    flags = os.O_WRONLY | os.O_CREAT | (0 if force else os.O_EXCL)
+    if force:
+        flags |= os.O_TRUNC
+    mode = 0o600 if secret else 0o644
+    try:
+        fd = os.open(os.fspath(path), flags, mode)
+    except FileExistsError:
+        raise FileExistsError(
+            f"{path} already exists — refusing to overwrite key material "
+            "(pass force/--force to replace it)"
+        ) from None
+    with os.fdopen(fd, "w") as f:
+        f.write(data.hex() + "\n")
+    if force and secret:
+        os.chmod(path, 0o600)  # force-path may reuse an old file's mode

@@ -286,3 +286,45 @@ async def test_sv2_authority_certificate_end_to_end():
     with pytest.raises(noise.HandshakeError, match="authority"):
         await c3.connect()
     await srv3.stop()
+
+
+def test_sv2_authority_cli(tmp_path, monkeypatch):
+    """tools/sv2_authority.py: keygen -> server-key -> issue -> inspect,
+    and the minted materials drive a verified decode."""
+    import importlib.util
+    import pathlib as pl
+    import sys as _sys
+
+    spec = importlib.util.spec_from_file_location(
+        "sv2_authority",
+        pl.Path(__file__).parents[1] / "tools" / "sv2_authority.py")
+    cli = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cli)
+
+    monkeypatch.chdir(tmp_path)
+
+    def run(*argv):
+        monkeypatch.setattr(_sys, "argv", ["sv2_authority.py", *argv])
+        return cli.main()
+
+    assert run("keygen", "--out", "auth") == 0
+    assert run("server-key", "--out", "s1") == 0
+    assert run("issue", "--authority", "auth.sec", "--server-pub",
+               "s1.pub", "--days", "1", "--out", "s1.cert") == 0
+    assert run("inspect", "--cert", "s1.cert", "--authority-pub",
+               "auth.pub", "--server-pub", "s1.pub") == 0
+    # a certificate for a DIFFERENT server key inspects INVALID (rc 1)
+    assert run("server-key", "--out", "s2") == 0
+    assert run("inspect", "--cert", "s1.cert", "--authority-pub",
+               "auth.pub", "--server-pub", "s2.pub") == 1
+    # secrets written 0600
+    assert (tmp_path / "auth.sec").stat().st_mode & 0o777 == 0o600
+    # rerunning keygen must NOT clobber the live authority secret
+    before = (tmp_path / "auth.sec").read_text()
+    with pytest.raises(SystemExit, match="refusing to overwrite"):
+        run("keygen", "--out", "auth")
+    assert (tmp_path / "auth.sec").read_text() == before
+    assert run("keygen", "--out", "auth", "--force") == 0
+    # half the verification flags refuses instead of silently skipping
+    with pytest.raises(SystemExit, match="together"):
+        run("inspect", "--cert", "s1.cert", "--authority-pub", "auth.pub")

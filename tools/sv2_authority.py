@@ -1,0 +1,166 @@
+"""SV2 pool-authority CLI: mint keys, issue certificates, inspect.
+
+The Noise-NX transport authenticates a pool fleet through ONE authority
+key (stratum/noise.NoiseCertificate + stratum/schnorr.py BIP340): the
+authority signs each server's static X25519 key, miners pin only the
+authority pubkey. This tool is the operator workflow around that:
+
+    # one-time: mint the fleet authority (keep the .sec offline!)
+    python tools/sv2_authority.py keygen --out authority
+
+    # per server: mint its static key and certify it
+    python tools/sv2_authority.py server-key --out server1
+    python tools/sv2_authority.py issue --authority authority.sec \\
+        --server-pub server1.pub --days 90 --out server1.cert
+
+    # sanity / debugging
+    python tools/sv2_authority.py inspect --cert server1.cert \\
+        [--authority-pub authority.pub --server-pub server1.pub]
+
+Server config then points at the minted files:
+    stratum.v2_noise_key_file:  server1.sec
+    stratum.v2_noise_cert_file: server1.cert
+Miners connect with ``authority_key=bytes.fromhex(<authority.pub>)``.
+
+All files are one line of hex; secrets are written 0600.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+from otedama_tpu.stratum import noise, schnorr  # noqa: E402
+from otedama_tpu.utils.keyfiles import (  # noqa: E402
+    read_hex_file,
+    write_hex_file,
+)
+
+
+def _write(path: pathlib.Path, data: bytes, secret: bool,
+           force: bool) -> None:
+    # secrets are created 0600 atomically and never clobbered without
+    # --force: rerunning keygen must not destroy the fleet authority key
+    # every deployed miner pins
+    try:
+        write_hex_file(path, data, secret=secret, force=force)
+    except FileExistsError as e:
+        raise SystemExit(str(e)) from None
+    print(f"wrote {path}{' (0600)' if secret else ''}")
+
+
+def cmd_keygen(args) -> int:
+    sk, pk = schnorr.keypair()
+    _write(pathlib.Path(f"{args.out}.sec"), sk, True, args.force)
+    _write(pathlib.Path(f"{args.out}.pub"), pk, False, args.force)
+    print(f"authority pubkey (miners pin this): {pk.hex()}")
+    return 0
+
+
+def cmd_server_key(args) -> int:
+    sk, pk = noise.x25519_keypair()
+    _write(pathlib.Path(f"{args.out}.sec"), sk, True, args.force)
+    _write(pathlib.Path(f"{args.out}.pub"), pk, False, args.force)
+    return 0
+
+
+def _read_hex(path: str, want_len: int, what: str) -> bytes:
+    try:
+        return read_hex_file(path, want_len, what)
+    except ValueError as e:
+        raise SystemExit(str(e)) from None
+
+
+def cmd_issue(args) -> int:
+    auth_sk = _read_hex(args.authority, 32, "authority secret key")
+    server_pub = _read_hex(args.server_pub, 32, "server static pubkey")
+    now = int(time.time())
+    cert = noise.NoiseCertificate.issue(
+        auth_sk, server_pub,
+        valid_from=now - 600,  # clock-skew slack
+        not_valid_after=now + int(args.days * 86400),
+    )
+    # belt-and-braces: never emit a certificate that does not verify
+    # against the authority's own pubkey
+    auth_pk = schnorr.pubkey(auth_sk)
+    if not cert.verify(auth_pk, server_pub):
+        raise SystemExit("internal error: issued certificate fails "
+                         "self-verification")
+    _write(pathlib.Path(args.out), cert.encode(), False, args.force)
+    print(f"valid until {time.strftime('%Y-%m-%d %H:%M:%S UTC', time.gmtime(cert.not_valid_after))}")
+    return 0
+
+
+def cmd_inspect(args) -> int:
+    raw = _read_hex(args.cert, noise.NoiseCertificate.WIRE_LEN,
+                    "certificate")
+    cert = noise.NoiseCertificate.decode(raw)
+    now = time.time()
+    print(f"version:          {cert.version}")
+    print(f"valid_from:       {cert.valid_from} "
+          f"({time.strftime('%Y-%m-%d %H:%M:%S', time.gmtime(cert.valid_from))} UTC)")
+    print(f"not_valid_after:  {cert.not_valid_after} "
+          f"({time.strftime('%Y-%m-%d %H:%M:%S', time.gmtime(cert.not_valid_after))} UTC)")
+    state = ("current" if cert.valid_from <= now <= cert.not_valid_after
+             else "OUT OF VALIDITY WINDOW")
+    print(f"window:           {state}")
+    print(f"signature:        {cert.signature.hex()}")
+    if bool(args.authority_pub) != bool(args.server_pub):
+        # half the verification inputs reads as "verified" to a script
+        # gating on the exit code — refuse instead of silently skipping
+        raise SystemExit(
+            "--authority-pub and --server-pub must be given together "
+            "(verification needs both)")
+    if args.authority_pub:
+        auth_pk = _read_hex(args.authority_pub, 32, "authority pubkey")
+        server_pub = _read_hex(args.server_pub, 32, "server pubkey")
+        ok = cert.verify(auth_pk, server_pub)
+        print(f"verification:     {'VALID' if ok else 'INVALID'}")
+        return 0 if ok else 1
+    print("verification:     skipped (no --authority-pub/--server-pub)")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    k = sub.add_parser("keygen", help="mint a fleet authority keypair")
+    k.add_argument("--out", required=True, help="file stem (.sec/.pub)")
+    k.add_argument("--force", action="store_true",
+                   help="overwrite existing key files")
+    k.set_defaults(fn=cmd_keygen)
+
+    s = sub.add_parser("server-key", help="mint a server static X25519 key")
+    s.add_argument("--out", required=True, help="file stem (.sec/.pub)")
+    s.add_argument("--force", action="store_true",
+                   help="overwrite existing key files")
+    s.set_defaults(fn=cmd_server_key)
+
+    i = sub.add_parser("issue", help="certify a server key")
+    i.add_argument("--authority", required=True, help="authority .sec file")
+    i.add_argument("--server-pub", required=True, help="server .pub file")
+    i.add_argument("--days", type=float, default=90.0,
+                   help="validity in days (default 90)")
+    i.add_argument("--out", required=True, help="certificate output file")
+    i.add_argument("--force", action="store_true",
+                   help="overwrite an existing certificate file")
+    i.set_defaults(fn=cmd_issue)
+
+    n = sub.add_parser("inspect", help="decode (and optionally verify)")
+    n.add_argument("--cert", required=True)
+    n.add_argument("--authority-pub", default="")
+    n.add_argument("--server-pub", default="")
+    n.set_defaults(fn=cmd_inspect)
+
+    args = ap.parse_args()
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
