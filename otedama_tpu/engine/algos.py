@@ -88,7 +88,10 @@ def register(spec: AlgorithmSpec) -> AlgorithmSpec:
 # through a non-certified chain would hand the caller an algorithm that
 # produces invalid work on the real network, so the alias refuses until
 # the spec is marked canonical (mark_canonical after KAT parity).
-_CANONICAL_ALIASES = {"dash": "x11"}
+# coin aliases that name LIVE networks: they refuse to resolve while the
+# underlying chain is uncertified (request the algorithm name itself for
+# framework-internal use)
+_CANONICAL_ALIASES = {"dash": "x11", "etchash": "ethash"}
 
 
 def get(name: str) -> AlgorithmSpec:
@@ -172,7 +175,8 @@ register(AlgorithmSpec(
 ))
 register(AlgorithmSpec(
     name="ethash",
-    aliases=("etchash",),
+    # NB: the "etchash" coin alias lives in _CANONICAL_ALIASES (like
+    # "dash") — it only resolves once ethash is certified canonical
     memory_hard=True,   # DAG-class: benchmark budgets must treat it like scrypt
     backends=(),        # filled in by kernels.ethash import-time registration
     canonical=False,    # no offline vector — kernels.ethash re-asserts this

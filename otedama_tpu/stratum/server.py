@@ -409,7 +409,8 @@ class StratumServer:
             )
         except ValueError:
             return ShareOutcome.REJECTED_INVALID, None
-        digest = pow_digest(header, job.algorithm)
+        digest = pow_digest(header, job.algorithm,
+                            block_number=job.block_number)
         # credit at the difficulty the session was mining at; allow the
         # previous difficulty during a retarget window
         credit_diff = session.difficulty

@@ -903,7 +903,8 @@ class Sv2MiningServer:
         en2 = chan.extranonce2
         header = jobmod.header_from_share(job, en2, msg.ntime, msg.nonce)
         header = struct.pack("<I", msg.version) + header[4:]
-        digest = pow_digest(header, job.algorithm)
+        digest = pow_digest(header, job.algorithm,
+                            block_number=job.block_number)
         if not tgt.hash_meets_target(digest, chan.target):
             # NOT remembered: garbage submissions must cost the submitter
             # a recompute, not this process unbounded dedup memory

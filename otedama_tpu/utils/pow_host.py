@@ -23,8 +23,40 @@ def scrypt_1024_1_1(data: bytes) -> bytes:
     )
 
 
-def pow_digest(header: bytes, algorithm: str = "sha256d") -> bytes:
-    """The 32-byte PoW digest a miner's share claims for this header."""
+# epoch -> (full_size, cache): ethash share validation needs the job
+# epoch's cache; two resident epochs cover a boundary transition (each
+# real-chain cache is tens of MB, so the LRU stays small on purpose)
+_ETHASH_CACHES: "dict[int, tuple[int, object]]" = {}
+
+
+def _ethash_digest(header80: bytes, block_number: int) -> bytes:
+    from otedama_tpu.kernels import ethash as eth
+
+    epoch = block_number // eth.EPOCH_LENGTH
+    ent = _ETHASH_CACHES.get(epoch)
+    if ent is None:
+        bn = epoch * eth.EPOCH_LENGTH
+        cache = eth.make_cache(eth.cache_size(bn), eth.seed_hash(bn))
+        ent = (eth.dataset_size(bn), cache)
+        _ETHASH_CACHES[epoch] = ent
+        while len(_ETHASH_CACHES) > 2:
+            del _ETHASH_CACHES[min(_ETHASH_CACHES)]
+    full_size, cache = ent
+    # framework conventions (EthashLightBackend): the ethash header hash
+    # is keccak256 of the 76-byte prefix, the nonce is the big-endian
+    # word at bytes 76:80, and the BE result byte-reverses once so
+    # digests compare as LE integers like every other algorithm
+    header_hash = eth.keccak256(header80[:76])
+    nonce = int.from_bytes(header80[76:80], "big")
+    _, res = eth.hashimoto_light(full_size, cache, header_hash, nonce)
+    return res[::-1]
+
+
+def pow_digest(header: bytes, algorithm: str = "sha256d",
+               block_number: int = 0) -> bytes:
+    """The 32-byte PoW digest a miner's share claims for this header.
+    ``block_number`` matters only for DAG-class algorithms (ethash picks
+    its epoch from it; height-less callers get epoch 0)."""
     algorithm = (algorithm or "sha256d").lower()
     if algorithm in ("sha256d", "sha256double", "bitcoin"):
         return sha256d(header)
@@ -44,4 +76,12 @@ def pow_digest(header: bytes, algorithm: str = "sha256d") -> bytes:
         from otedama_tpu.kernels.x11 import x11_digest
 
         return x11_digest(header)
+    if algorithm in ("ethash", "etchash"):
+        if algorithm == "etchash":
+            # live-network alias: refuses while ethash is uncertified
+            # (same discipline as the dash alias above)
+            from otedama_tpu.engine import algos
+
+            algos.get("etchash")
+        return _ethash_digest(header, block_number)
     raise ValueError(f"no host PoW digest for algorithm {algorithm!r}")

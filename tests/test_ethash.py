@@ -346,3 +346,103 @@ async def test_engine_mines_ethash_across_epoch_boundary():
         h76 = build_header_prefix(job, s.extranonce2, s.ntime)
         oracle = _mini_oracle(epoch, h76, [s.nonce_word])
         assert int.from_bytes(s.digest, "little") == oracle[s.nonce_word]
+
+
+@pytest.mark.asyncio
+async def test_v1_server_validates_ethash_shares():
+    """Pool-side ethash: the stratum V1 server validates ethash shares
+    through the host hashimoto path (pow_digest grew an ethash branch —
+    previously it raised, so ethash pools could mine but never ACCEPT).
+    Uses the real epoch-0 cache (native generator) and the job's
+    block_number to pick the epoch."""
+    import asyncio
+
+    from otedama_tpu.engine import jobs as jobmod
+    from otedama_tpu.engine.types import Job
+    from otedama_tpu.kernels import ethash as eth
+    from otedama_tpu.kernels import target as tgt
+    from otedama_tpu.stratum import protocol as sp
+    from otedama_tpu.stratum.server import ServerConfig, StratumServer
+    from otedama_tpu.utils import pow_host
+
+    accepted = []
+
+    async def on_share(s):
+        accepted.append(s)
+
+    target = 1 << 255  # ~50% of hashes pass: a couple of host hashimotos
+    server = StratumServer(
+        ServerConfig(port=0,
+                     initial_difficulty=tgt.target_to_difficulty(target)),
+        on_share=on_share,
+    )
+    await server.start()
+    try:
+        job = Job(
+            job_id="eth1", prev_hash=bytes(32), coinb1=b"\x01",
+            coinb2=b"\x02", merkle_branch=[], version=0x20000000,
+            nbits=0x207FFFFF, ntime=1_700_000_000, clean=True,
+            algorithm="ethash", extranonce1=b"", extranonce2_size=4,
+            share_target=target, block_number=10,
+        )
+        server.set_job(job)
+
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", server.port)
+
+        async def call(msg_id, method, params):
+            writer.write(sp.encode_line(
+                sp.Message(id=msg_id, method=method, params=params)))
+            await writer.drain()
+            while True:
+                m = sp.decode_line(await reader.readline())
+                if m.is_response and m.id == msg_id:
+                    return m
+
+        sub = await call(1, "mining.subscribe", ["eth-test"])
+        extranonce1 = bytes.fromhex(sub.result[1])
+        await call(2, "mining.authorize", ["w.e", "x"])
+
+        # mine against the SAME host path the server validates with
+        import dataclasses
+
+        job_mine = dataclasses.replace(job, extranonce1=extranonce1)
+        en2 = b"\x00\x00\x00\x07"
+        prefix = jobmod.build_header_prefix(job_mine, en2)
+        found = None
+        for nonce in range(64):
+            h = prefix + nonce.to_bytes(4, "big")
+            d = pow_host.pow_digest(h, "ethash", block_number=10)
+            if tgt.hash_meets_target(d, target):
+                found = nonce
+                break
+        assert found is not None, "no ethash share in 64 tries at p=0.5?!"
+
+        ok = await call(3, "mining.submit",
+                        ["w.e", job.job_id, en2.hex(),
+                         f"{job.ntime:08x}", f"{found:08x}"])
+        assert ok.result is True, ok.error
+        assert len(accepted) == 1
+        # the accepted digest is the hashimoto result in LE convention
+        assert accepted[0].digest == pow_host.pow_digest(
+            prefix + found.to_bytes(4, "big"), "ethash", block_number=10)
+
+        # a garbage nonce fails validation (not an exception — pow_digest
+        # must COMPUTE for ethash now, and the target check rejects)
+        for bad_nonce in range(64, 128):
+            h = prefix + bad_nonce.to_bytes(4, "big")
+            if not tgt.hash_meets_target(
+                    pow_host.pow_digest(h, "ethash", block_number=10),
+                    target):
+                break
+        low = await call(4, "mining.submit",
+                         ["w.e", job.job_id, en2.hex(),
+                          f"{job.ntime:08x}", f"{bad_nonce:08x}"])
+        assert low.result is not True
+        writer.close()
+    finally:
+        await server.stop()
+
+    # the etchash ALIAS still refuses while ethash is uncertified
+    with pytest.raises(ValueError, match="not certified"):
+        pow_host.pow_digest(bytes(80), "etchash", block_number=10)
