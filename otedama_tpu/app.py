@@ -417,6 +417,11 @@ class Application:
         if self.p2p is not None:
             self.api.add_provider("p2p", self.p2p.snapshot)
         self.api.add_provider("benchmarks", self.algo_manager.snapshot)
+        # chaos observability: per-point hit/fault counters of the active
+        # fault injector ({"active": False} outside chaos runs)
+        from otedama_tpu.utils import faults as _faults
+
+        self.api.add_provider("fault_injection", _faults.snapshot_active)
         if self.db is not None:
             # /api/v1/logs/audit reads the pool db's audit trail
             self.api.audit_source = self.db.query_audit

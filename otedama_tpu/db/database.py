@@ -18,6 +18,8 @@ import sqlite3
 import threading
 import time
 
+from otedama_tpu.utils import faults
+
 log = logging.getLogger("otedama.db")
 
 def split_statements(script: str) -> list[str]:
@@ -235,10 +237,20 @@ class Database(AuditMixin):
     # -- access -------------------------------------------------------------
 
     def execute(self, sql: str, params: tuple = ()) -> sqlite3.Cursor:
+        # fault point db.execute: injected errors/delays hit application
+        # statements only — migration DDL and transaction control (BEGIN/
+        # COMMIT/ROLLBACK in migrate()/_Transaction) bypass this method,
+        # so an injected write failure always leaves a rollbackable txn
+        d = faults.hit("db.execute", supports=faults.POINT)
+        if d is not None:
+            d.sleep_sync()
         with self._lock:
             return self._conn.execute(sql, params)
 
     def executemany(self, sql: str, rows: list[tuple]) -> sqlite3.Cursor:
+        d = faults.hit("db.execute", supports=faults.POINT)
+        if d is not None:
+            d.sleep_sync()
         with self._lock:
             return self._conn.executemany(sql, rows)
 

@@ -195,6 +195,14 @@ def parse_error_fields(payload: bytes) -> dict:
     return fields
 
 
+def parse_parameter_status(payload: bytes) -> tuple[str, str]:
+    """ParameterStatus ('S'): name\\0value\\0."""
+    parts = payload.split(b"\x00")
+    if len(parts) < 2:
+        raise OperationalError(f"malformed ParameterStatus {payload!r}")
+    return parts[0].decode("utf-8", "replace"), parts[1].decode("utf-8", "replace")
+
+
 # -- DB-API surface -----------------------------------------------------------
 
 class Cursor:
@@ -255,7 +263,30 @@ class Connection:
                                               timeout=connect_timeout)
         self._sock.settimeout(None)
         self.autocommit = True  # simple-protocol reality; attr for parity
-        self._startup(user, password, dbname)
+        # server-reported run-time parameters (ParameterStatus messages)
+        self.parameters: dict[str, str] = {}
+        try:
+            self._startup(user, password, dbname)
+            self._check_scs()
+        except BaseException:
+            self._sock.close()
+            raise
+
+    def _check_scs(self) -> None:
+        """escape_literal's quote-doubling is only a COMPLETE escape
+        under standard_conforming_strings=on (the server default since
+        9.1). Off, a backslash in a '' literal is an escape character and
+        the interpolation becomes an injection hole — refuse to operate
+        rather than ship exploitable queries. Absent means an old/quiet
+        server that defaults on."""
+        scs = self.parameters.get("standard_conforming_strings", "on")
+        if scs.lower() != "on":
+            raise OperationalError(
+                "server reports standard_conforming_strings="
+                f"{scs!r}: the vendored pgwire driver's literal escaping "
+                "is unsafe in that mode — set it to 'on' (the server "
+                "default since PostgreSQL 9.1) or install psycopg"
+            )
 
     # -- protocol ------------------------------------------------------------
 
@@ -286,8 +317,11 @@ class Connection:
                 raise OperationalError(
                     f"unsupported authentication method {code} (SCRAM "
                     "needs a real driver — install psycopg for it)")
-            elif mtype in (b"S", b"K", b"N"):
-                continue  # ParameterStatus / BackendKeyData / Notice
+            elif mtype == b"S":
+                name, value = parse_parameter_status(payload)
+                self.parameters[name] = value
+            elif mtype in (b"K", b"N"):
+                continue  # BackendKeyData / Notice
             elif mtype == b"Z":
                 return  # ReadyForQuery
             elif mtype == b"E":
@@ -298,6 +332,11 @@ class Connection:
 
     def _query(self, sql: str):
         with self._lock:
+            # sticky pre-send refusal: once the server has ever reported
+            # standard_conforming_strings=off, no further query may ship
+            # (a caller catching the post-cycle error and retrying must
+            # not get one more unsafely-escaped statement executed)
+            self._check_scs()
             self._sock.sendall(_msg(b"Q", sql.encode() + b"\x00"))
             rows: list[dict] = []
             desc = None
@@ -345,9 +384,15 @@ class Connection:
                 elif mtype == b"Z":  # ReadyForQuery — end of cycle
                     if error is not None:
                         raise DatabaseError(error)
+                    # a SET could have flipped escaping semantics
+                    # mid-session; the refusal must track it live
+                    self._check_scs()
                     return rows, rowcount, desc
-                elif mtype in (b"N", b"S", b"I"):
-                    continue  # Notice / ParameterStatus / EmptyQuery
+                elif mtype == b"S":
+                    name, value = parse_parameter_status(payload)
+                    self.parameters[name] = value
+                elif mtype in (b"N", b"I"):
+                    continue  # Notice / EmptyQuery
                 else:
                     raise OperationalError(
                         f"unexpected message {mtype!r} mid-query")

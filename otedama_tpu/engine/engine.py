@@ -35,6 +35,7 @@ from otedama_tpu.engine.types import (
 from otedama_tpu.kernels import target as tgt
 from otedama_tpu.runtime.partition import ExtranonceCounter, NonceRange
 from otedama_tpu.runtime.search import JobConstants, SearchResult
+from otedama_tpu.utils import faults
 
 log = logging.getLogger("otedama.engine")
 
@@ -231,6 +232,16 @@ class MiningEngine:
                 for unit in _units():
                     if self._stop.is_set() or serial != self._job_serial:
                         break
+                    # fault point engine.batch: delay stalls batch
+                    # completion (FailureDetector must notice and
+                    # recover), error kills this searcher like a backend
+                    # crash would, drop skips the unit's dispatch
+                    fd = faults.hit("engine.batch", name, faults.STEP)
+                    if fd is not None:
+                        if fd.delay:
+                            await asyncio.sleep(fd.delay)
+                        if fd.drop:
+                            continue
                     if grouped:
                         fut = loop.run_in_executor(
                             None, backend.search_group, jcs[0], unit
@@ -325,4 +336,9 @@ class MiningEngine:
     def snapshot(self) -> dict:
         snap = self.stats.snapshot()
         snap["state"] = self.state.value
+        inj = faults.get()
+        if inj is not None:
+            # chaos runs are observable where operators already look:
+            # per-point hit/fault counters ride the engine snapshot
+            snap["fault_injection"] = inj.snapshot()
         return snap

@@ -23,6 +23,7 @@ from __future__ import annotations
 import asyncio
 
 from otedama_tpu.p2p.node import P2PNode, Peer
+from otedama_tpu.utils import faults
 
 
 class MemoryWriter:
@@ -34,8 +35,19 @@ class MemoryWriter:
         self._closed = False
 
     def write(self, data: bytes) -> None:
-        if not self._closed:
-            self._remote.feed_data(data)
+        if self._closed:
+            return
+        d = faults.hit("p2p.mem.send", self._label, faults.SEND_SYNC)
+        if d is not None:
+            if d.drop:
+                return
+            if d.truncate >= 0:
+                # partial frame + EOF: the remote peer loop must treat it
+                # as a dead link (IncompleteReadError), same as real TCP
+                self._remote.feed_data(data[:d.truncate])
+                self.close()
+                return
+        self._remote.feed_data(data)
 
     async def drain(self) -> None:
         # yield so fed readers get scheduled — keeps one chatty node from

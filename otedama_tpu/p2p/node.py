@@ -23,6 +23,7 @@ from collections import OrderedDict
 from typing import Awaitable, Callable
 
 from otedama_tpu.p2p.messages import MessageType, P2PMessage, read_frame
+from otedama_tpu.utils import faults
 
 log = logging.getLogger("otedama.p2p")
 
@@ -58,6 +59,17 @@ class Peer:
     messages_out: int = 0
 
     def send(self, msg: P2PMessage) -> None:
+        d = faults.hit("p2p.peer.send", self.node_id[:12],
+                       faults.SEND_SYNC)
+        if d is not None:
+            if d.drop:
+                return  # lossy link: gossip must still converge via others
+            if d.truncate >= 0:
+                # corrupt the stream mid-frame: the remote read loop sees
+                # a bad magic / short read and must drop the peer cleanly
+                self.writer.write(msg.encode()[:d.truncate])
+                self.writer.close()
+                raise ConnectionError("injected short write")
         self.writer.write(msg.encode())
         self.messages_out += 1
 
@@ -236,6 +248,10 @@ class P2PNode:
     async def _peer_loop(self, peer: Peer) -> None:
         try:
             while True:
+                d = faults.hit("p2p.peer.recv", peer.node_id[:12],
+                               faults.POINT)
+                if d is not None and d.delay:
+                    await asyncio.sleep(d.delay)
                 frame = await read_frame(peer.reader)
                 peer.last_seen = time.time()
                 peer.messages_in += 1

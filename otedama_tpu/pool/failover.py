@@ -16,6 +16,8 @@ import enum
 import logging
 import time
 
+from otedama_tpu.utils import faults
+
 log = logging.getLogger("otedama.pool.failover")
 
 
@@ -110,6 +112,13 @@ class FailoverManager:
     async def check_pool(self, pool: UpstreamPool) -> bool:
         t0 = time.monotonic()
         try:
+            # fault point inside the timed+caught section so injected
+            # unreachability (error) takes the real failure path and
+            # injected latency (delay) lands in the measured EMA —
+            # exactly how strategy selection sees a degraded upstream
+            d = faults.hit("pool.failover.check", pool.name, faults.POINT)
+            if d is not None and d.delay:
+                await asyncio.sleep(d.delay)
             _, writer = await asyncio.wait_for(
                 asyncio.open_connection(pool.host, pool.port), timeout=5.0
             )
@@ -118,7 +127,7 @@ class FailoverManager:
             pool.latency = dt if pool.latency == 0 else 0.3 * dt + 0.7 * pool.latency
             pool.reachable = True
             pool.consecutive_failures = 0
-        except (OSError, asyncio.TimeoutError):
+        except (OSError, asyncio.TimeoutError, faults.FaultInjectedError):
             self.record_connection_failure(pool)
         pool.last_check = time.time()
         return pool.reachable

@@ -124,19 +124,24 @@ class PoolManager:
 
     async def on_share(self, share: AcceptedShare) -> None:
         worker = share.worker_user
-        self.workers.upsert(worker)
-        self.workers.record_share(worker, True)
-        self.shares.create(
-            worker,
-            share.job_id,
-            share.difficulty,
-            share.actual_difficulty,
-            share.is_block,
-            share.submitted_at,
-        )
-        credit = self.calculator.pps_credit(share.difficulty)
-        if credit:
-            self.workers.credit(worker, credit)
+        # one transaction: a write failing mid-sequence (chaos: injected
+        # db faults) must roll back the worker counters WITH the missing
+        # share row — the servers turn the raised error into a reject, so
+        # "every accept the miner saw is in the books exactly once" holds
+        with self.db.transaction():
+            self.workers.upsert(worker)
+            self.workers.record_share(worker, True)
+            self.shares.create(
+                worker,
+                share.job_id,
+                share.difficulty,
+                share.actual_difficulty,
+                share.is_block,
+                share.submitted_at,
+            )
+            credit = self.calculator.pps_credit(share.difficulty)
+            if credit:
+                self.workers.credit(worker, credit)
 
     async def on_block(self, header: bytes, job: Job, share: AcceptedShare) -> None:
         reward = self._job_rewards.get(job.job_id, self._current_reward)

@@ -12,6 +12,7 @@ import logging
 
 from otedama_tpu.db.repos import BlockRepository
 from otedama_tpu.pool.blockchain import BlockchainClient, SubmitOutcome
+from otedama_tpu.utils import faults
 
 log = logging.getLogger("otedama.pool.submitter")
 
@@ -40,6 +41,15 @@ class BlockSubmitter:
         last = SubmitOutcome(False, reason="not attempted")
         for attempt in range(self.config.max_retries):
             try:
+                # fault point inside the try: an injected RPC failure
+                # takes the same retry path a real chain outage does
+                d = faults.hit("pool.submitter.submit",
+                               supports=faults.STEP)
+                if d is not None:
+                    if d.delay:
+                        await asyncio.sleep(d.delay)
+                    if d.drop:
+                        raise ConnectionError("injected submit drop")
                 last = await self.chain.submit_block(header)
             except Exception as e:
                 last = SubmitOutcome(False, reason=str(e))

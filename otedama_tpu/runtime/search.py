@@ -792,6 +792,19 @@ class EthashManagedBackend:
                 self.stats["epoch_switches"] += 1
                 self._evict_locked(self._light, self.max_light_tiers,
                                    "light cache")
+            # donate the freshly built cache to host-side share
+            # validation (utils/pow_host): the stratum servers then never
+            # regenerate tens of MB of keccak for an epoch the engine
+            # already paid for (refused automatically for miniature test
+            # sizings, which don't match real chain rules)
+            try:
+                from otedama_tpu.utils import pow_host
+
+                pow_host.register_epoch_cache(
+                    epoch, tier.full_size, tier.cache
+                )
+            except Exception:  # donation is an optimization, never fatal
+                log.debug("epoch cache donation failed", exc_info=True)
             log.info("ethash: epoch %d cache ready (light tier live)",
                      epoch)
         return tier

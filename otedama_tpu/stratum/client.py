@@ -20,6 +20,7 @@ from typing import Awaitable, Callable
 
 from otedama_tpu.engine.types import Job, Share
 from otedama_tpu.stratum import protocol as sp
+from otedama_tpu.utils import faults
 
 log = logging.getLogger("otedama.stratum.client")
 
@@ -93,6 +94,8 @@ class StratumClient:
         self._tasks: list[asyncio.Task] = []
         self._stop = False
         self._reconnect_requested = False
+        # chaos runs target one upstream among several by this tag
+        self._fault_tag = f"{config.host}:{config.port}"
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -177,7 +180,21 @@ class StratumClient:
     async def _send(self, msg: sp.Message) -> None:
         if self._writer is None:
             raise ConnectionError("not connected")
-        self._writer.write(sp.encode_line(msg))
+        line = sp.encode_line(msg)
+        d = faults.hit("stratum.client.send", self._fault_tag,
+                       faults.SEND_ASYNC)
+        if d is not None:
+            if d.delay:
+                await asyncio.sleep(d.delay)
+            if d.drop:
+                return  # the request vanishes; the caller's timeout decides
+            if d.truncate >= 0:
+                # partial write then a dead socket: the mid-submit drop
+                # scenario — the session loop must reconnect cleanly
+                self._writer.write(line[:d.truncate])
+                self._writer.close()
+                raise ConnectionError("injected short write")
+        self._writer.write(line)
         await self._writer.drain()
 
     async def _call(self, method: str, params: list, msg_id: int | None = None):
@@ -207,6 +224,10 @@ class StratumClient:
     async def _read_loop(self) -> None:
         assert self._reader is not None
         while True:
+            d = faults.hit("stratum.client.read", self._fault_tag,
+                           faults.POINT)
+            if d is not None and d.delay:
+                await asyncio.sleep(d.delay)
             line = await self._reader.readline()
             if not line:
                 raise ConnectionError("connection closed by pool")

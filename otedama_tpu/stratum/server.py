@@ -29,7 +29,12 @@ from otedama_tpu.engine.types import Job, ShareOutcome
 from otedama_tpu.engine.vardiff import VardiffConfig, VardiffManager
 from otedama_tpu.kernels import target as tgt
 from otedama_tpu.stratum import protocol as sp
-from otedama_tpu.utils.pow_host import pow_digest
+from otedama_tpu.utils import faults
+from otedama_tpu.utils.pow_host import (
+    SLOW_HOST_ALGOS,
+    pow_digest,
+    validation_executor,
+)
 
 log = logging.getLogger("otedama.stratum.server")
 
@@ -123,6 +128,7 @@ class StratumServer:
             "shares_valid": 0,
             "shares_invalid": 0,
             "blocks_found": 0,
+            "share_hook_failures": 0,
         }
         self._server: asyncio.AbstractServer | None = None
         self._next_session = 1
@@ -169,7 +175,7 @@ class StratumServer:
         line = sp.encode_line(notify)
         for s in self.sessions.values():
             if s.subscribed:
-                s.writer.write(line)
+                self._write_line(s, line)
         log.info("job %s broadcast to %d sessions", job.job_id, len(self.sessions))
 
     def _expire_jobs(self) -> None:
@@ -222,6 +228,10 @@ class StratumServer:
         log.info("client %d connected from %s", session.id, session.peer)
         try:
             while True:
+                d = faults.hit("stratum.server.read", str(session.id),
+                                faults.POINT)
+                if d is not None and d.delay:
+                    await asyncio.sleep(d.delay)
                 try:
                     line = await reader.readuntil(b"\n")
                 except asyncio.LimitOverrunError:
@@ -292,18 +302,35 @@ class StratumServer:
         except sp.StratumError as e:
             await self._reply_error(session, msg.id, e)
 
+    def _write_line(self, session: Session, line: bytes) -> None:
+        """Every byte to a miner passes one seam (fault point
+        stratum.server.write): drop swallows the line, truncate writes a
+        partial line and cuts the socket — the miner-side read loop must
+        survive both."""
+        d = faults.hit("stratum.server.write", str(session.id),
+                       faults.SEND_SYNC)
+        if d is not None:
+            if d.drop:
+                return
+            if d.truncate >= 0:
+                session.writer.write(line[:d.truncate])
+                session.writer.close()
+                return
+        session.writer.write(line)
+
     async def _reply(self, session: Session, msg_id, result) -> None:
-        session.writer.write(sp.encode_line(sp.Message(id=msg_id, result=result)))
+        self._write_line(session, sp.encode_line(sp.Message(id=msg_id, result=result)))
         await session.writer.drain()
 
     async def _reply_error(self, session: Session, msg_id, err: sp.StratumError) -> None:
-        session.writer.write(
-            sp.encode_line(sp.Message(id=msg_id, result=None, error=err.as_triple()))
+        self._write_line(
+            session,
+            sp.encode_line(sp.Message(id=msg_id, result=None, error=err.as_triple())),
         )
         await session.writer.drain()
 
     def _send_notification(self, session: Session, method: str, params: list) -> None:
-        session.writer.write(sp.encode_line(sp.Message(method=method, params=params)))
+        self._write_line(session, sp.encode_line(sp.Message(method=method, params=params)))
 
     def _send_difficulty(self, session: Session, difficulty: float) -> None:
         session.prev_difficulty = session.difficulty
@@ -350,20 +377,63 @@ class StratumServer:
             raise sp.StratumError(sp.ERR_UNAUTHORIZED, "not authorized")
         sub = sp.ShareSubmission.from_params(msg.params or [])
         self.stats["shares_total"] += 1
-        outcome, accepted = self._validate(session, sub)
+        job = self.jobs.get(sub.job_id)
+        if job is not None and job.algorithm in SLOW_HOST_ALGOS:
+            # scrypt/x11/ethash host validation is real CPU work (the
+            # first ethash share of an epoch builds a whole cache): off
+            # the event loop, or one share stalls every connected miner.
+            # On a DEDICATED pool — the default executor carries engine
+            # backend dispatches, and blocked validations there would
+            # starve mining. Safe because each session's messages are
+            # handled serially.
+            outcome, accepted = await asyncio.get_running_loop().run_in_executor(
+                validation_executor(), self._validate, session, sub
+            )
+        else:
+            outcome, accepted = self._validate(session, sub)
         if outcome in (ShareOutcome.ACCEPTED, ShareOutcome.BLOCK_FOUND):
+            # persist BEFORE the accept verdict: every accept a miner ever
+            # sees must be durable exactly once, so a failing share hook
+            # (db fault) turns into a reject the miner can see — never an
+            # accepted share the books don't have (tests/test_chaos.py)
+            if accepted is not None and self.on_share is not None:
+                try:
+                    await self.on_share(accepted)
+                except Exception:
+                    log.exception("share hook failed; rejecting share")
+                    # un-remember the share: it was never credited, so a
+                    # resubmit after accounting recovers must be able to
+                    # land, not die as a phantom duplicate (fields from
+                    # the SAME AcceptedShare _validate keyed on, so the
+                    # two sites cannot drift apart)
+                    session.seen.discard(
+                        (accepted.job_id, accepted.extranonce2,
+                         accepted.ntime, accepted.nonce_word))
+                    session.shares_invalid += 1
+                    self.stats["shares_invalid"] += 1
+                    self.stats["share_hook_failures"] += 1
+                    await self._reply_error(session, msg.id, sp.StratumError(
+                        sp.ERR_OTHER, "share accounting unavailable"))
+                    # a block candidate is still real: chain submission is
+                    # independent of share accounting (own retry loop) and
+                    # a db hiccup must never cost the block reward
+                    if accepted.is_block:
+                        self.stats["blocks_found"] += 1
+                        if self.on_block is not None and job is not None:
+                            try:
+                                await self.on_block(
+                                    accepted.header, job, accepted)
+                            except Exception:
+                                log.exception("block hook failed")
+                    return
             session.shares_valid += 1
             self.stats["shares_valid"] += 1
             self.vardiff.record_share(session.vardiff_key)
             await self._reply(session, msg.id, True)
-            if accepted is not None:
-                if accepted.is_block:
-                    self.stats["blocks_found"] += 1
-                    job = self.jobs.get(sub.job_id)
-                    if self.on_block is not None and job is not None:
-                        await self.on_block(accepted.header, job, accepted)
-                if self.on_share is not None:
-                    await self.on_share(accepted)
+            if accepted is not None and accepted.is_block:
+                self.stats["blocks_found"] += 1
+                if self.on_block is not None and job is not None:
+                    await self.on_block(accepted.header, job, accepted)
         else:
             session.shares_invalid += 1
             self.stats["shares_invalid"] += 1

@@ -50,7 +50,12 @@ from otedama_tpu.engine import jobs as jobmod
 from otedama_tpu.stratum import noise
 from otedama_tpu.engine.types import Job
 from otedama_tpu.kernels import target as tgt
-from otedama_tpu.utils.pow_host import pow_digest
+from otedama_tpu.utils import faults
+from otedama_tpu.utils.pow_host import (
+    SLOW_HOST_ALGOS,
+    pow_digest,
+    validation_executor,
+)
 
 log = logging.getLogger("otedama.stratum.v2")
 
@@ -216,6 +221,9 @@ class FrameConn:
         self.session = session
 
     async def recv(self) -> tuple[int, int, bytes]:
+        d = faults.hit("sv2.conn.recv", supports=faults.POINT)
+        if d is not None and d.delay:
+            await asyncio.sleep(d.delay)
         if self.session is None:
             return await read_frame(self.reader)
         return parse_frame(await self.session.recv_frame_bytes(self.reader))
@@ -227,8 +235,19 @@ class FrameConn:
                 and transport.get_write_buffer_size() > max_backlog):
             raise ConnectionError("write backlog over cap (stalled peer)")
         frame = pack_frame(msg_type, payload)
-        self.writer.write(frame if self.session is None
-                          else self.session.seal(frame))
+        wire = frame if self.session is None else self.session.seal(frame)
+        d = faults.hit("sv2.conn.send", supports=faults.SEND_SYNC)
+        if d is not None:
+            if d.drop:
+                return
+            if d.truncate >= 0:
+                # a partial binary frame desyncs the peer's length-
+                # delimited reader mid-header/payload: the read side must
+                # treat it as a dead connection, not a parse crash
+                self.writer.write(wire[:d.truncate])
+                self.writer.close()
+                raise ConnectionError("injected short write")
+        self.writer.write(wire)
 
     async def drain(self) -> None:
         await self.writer.drain()
@@ -631,7 +650,13 @@ class Sv2MiningServer:
         self._job_seq = 0
         self._chan_seq = 0
         self.stats = {"connections": 0, "shares_accepted": 0,
-                      "shares_rejected": 0, "blocks": 0}
+                      "shares_rejected": 0, "blocks": 0,
+                      "handshake_failures": 0, "share_hook_failures": 0}
+        # rate-limited handshake-failure warnings: a port scan must not
+        # flood the log, but a fleet of miners failing auth (wrong pinned
+        # key after a rotation) must be VISIBLE, not buried at debug
+        self._hs_warn_at = 0.0
+        self._hs_suppressed = 0
 
     async def start(self) -> None:
         if self.config.noise:
@@ -732,6 +757,21 @@ class Sv2MiningServer:
 
     # -- connection handling -------------------------------------------------
 
+    def _note_handshake_failure(self, exc: BaseException) -> None:
+        """Count every noise handshake failure; warn at most once per
+        10 s with the number suppressed since the last warning."""
+        self.stats["handshake_failures"] += 1
+        now = time.monotonic()
+        if now - self._hs_warn_at >= 10.0:
+            suffix = (f" ({self._hs_suppressed} more suppressed)"
+                      if self._hs_suppressed else "")
+            log.warning("sv2 noise handshake failed: %r%s", exc, suffix)
+            self._hs_warn_at = now
+            self._hs_suppressed = 0
+        else:
+            self._hs_suppressed += 1
+            log.debug("sv2 noise handshake failed: %r", exc)
+
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
         if len(self._conns) >= self.config.max_clients:
@@ -754,7 +794,7 @@ class Sv2MiningServer:
             except (noise.HandshakeError, noise.AuthError,
                     asyncio.IncompleteReadError, ConnectionError,
                     asyncio.TimeoutError, ValueError) as e:
-                log.debug("sv2 noise handshake failed: %r", e)
+                self._note_handshake_failure(e)
                 self._conns.discard(conn)
                 writer.close()
                 return
@@ -903,17 +943,24 @@ class Sv2MiningServer:
         en2 = chan.extranonce2
         header = jobmod.header_from_share(job, en2, msg.ntime, msg.nonce)
         header = struct.pack("<I", msg.version) + header[4:]
-        digest = pow_digest(header, job.algorithm,
-                            block_number=job.block_number)
+        if job.algorithm in SLOW_HOST_ALGOS:
+            # same discipline as the V1 server: heavyweight host digests
+            # (ethash cache builds!) run off the event loop, on the
+            # dedicated validation pool so they can't starve the engine's
+            # default-executor dispatches
+            digest = await asyncio.get_running_loop().run_in_executor(
+                validation_executor(), pow_digest, header, job.algorithm,
+                job.block_number
+            )
+        else:
+            digest = pow_digest(header, job.algorithm,
+                                block_number=job.block_number)
         if not tgt.hash_meets_target(digest, chan.target):
             # NOT remembered: garbage submissions must cost the submitter
             # a recompute, not this process unbounded dedup memory
             await reject("difficulty-too-low")
             return
         chan.seen_shares.add(key)
-        chan.accepted += 1
-        chan.shares_sum += 1
-        self.stats["shares_accepted"] += 1
         is_block = tgt.hash_meets_target(digest, tgt.bits_to_target(job.nbits))
         # SAME accounting surface as the V1 server: the pool manager
         # credits shares and submits blocks identically for both wires
@@ -931,13 +978,37 @@ class Sv2MiningServer:
             is_block=is_block,
             submitted_at=time.time(),
         )
+        # persist BEFORE the success frame (V1 server parity): an accept
+        # the miner saw must be in the books exactly once, so a failing
+        # share hook becomes a visible reject, never a phantom accept
+        if self.on_share is not None:
+            try:
+                await self.on_share(accepted)
+            except Exception:
+                log.exception("sv2 share hook failed; rejecting share")
+                # un-remember: the uncredited share must be resubmittable
+                # once accounting recovers (V1 server parity)
+                chan.seen_shares.discard(key)
+                self.stats["share_hook_failures"] += 1
+                await reject("share-accounting-unavailable")
+                # V1 parity: the block candidate still goes to the chain
+                # — submission is independent of share accounting
+                if is_block:
+                    self.stats["blocks"] += 1
+                    if self.on_block is not None:
+                        try:
+                            await self.on_block(header, job, accepted)
+                        except Exception:
+                            log.exception("sv2 block hook failed")
+                return
+        chan.accepted += 1
+        chan.shares_sum += 1
+        self.stats["shares_accepted"] += 1
         if is_block:
             self.stats["blocks"] += 1
             log.info("sv2: BLOCK candidate on channel %d", chan.channel_id)
             if self.on_block is not None:
                 await self.on_block(header, job, accepted)
-        if self.on_share is not None:
-            await self.on_share(accepted)
         self._write(conn, MSG_SUBMIT_SHARES_SUCCESS,
                     SubmitSharesSuccess(
                         channel_id=chan.channel_id,

@@ -1,0 +1,362 @@
+"""Deterministic fault injection: named fault points + seeded fault plans.
+
+The robustness surfaces reproduced from the reference (runtime/failure.py
+detection+recovery, stratum client reconnect, pool/failover.py strategy
+selection) only prove themselves when the failures actually happen. This
+module makes them happen ON DEMAND and REPRODUCIBLY: a process-global
+``FaultInjector`` holds composable rules that fire at named fault points
+threaded through the hot seams (stratum read/write, SV2 framing, P2P
+send/recv, DB writes, block submission, engine batch completion), and the
+whole schedule derives from one seed so a failing chaos run replays
+exactly (tests/test_chaos.py).
+
+Design constraints, in order:
+
+1. **No-op when off.** The default path is one module-global load and a
+   ``None`` check (``hit()`` returns immediately); no rule matching, no
+   string formatting, no allocation. Production code pays nothing.
+2. **Deterministic per point.** Each (rule, point) pair owns a dedicated
+   ``random.Random`` seeded from (injector seed, rule index, point key),
+   and every-Nth / one-shot schedules count per-point hits — so the fault
+   pattern at a point depends only on the seed and that point's own hit
+   sequence, never on cross-point async interleaving. Same seed, same
+   schedule (asserted in tests). Time-window rules are the one exception:
+   they gate on wall time since ``activate()`` and are meant for scenario
+   shaping, not bit-exact replay.
+3. **Call sites stay honest.** The injector never mutates state behind a
+   caller's back: it raises injected errors directly, but drop / truncate
+   / delay come back as a ``Directive`` the call site applies — a dropped
+   send is swallowed by the code that owns the writer, a short write is
+   written short by the code that knows the framing. That keeps every
+   fault representable as something the real world can do to that seam.
+
+Fault point registry (grep for ``faults.hit`` to verify):
+
+    stratum.client.read / stratum.client.send   (stratum/client.py; tag host:port)
+    stratum.server.read / stratum.server.write  (stratum/server.py; tag session id)
+    sv2.conn.send / sv2.conn.recv               (stratum/v2.py FrameConn)
+    p2p.peer.send / p2p.peer.recv               (p2p/node.py; tag peer id prefix)
+    p2p.mem.send                                (p2p/memnet.py MemoryWriter)
+    db.execute                                  (db/database.py writes)
+    pool.submitter.submit                       (pool/submitter.py retry loop)
+    pool.failover.check                         (pool/failover.py; tag pool name)
+    engine.batch                                (engine/engine.py; tag backend)
+
+Usage (tests / chaos drivers):
+
+    inj = (FaultInjector(seed=1337)
+           .error("stratum.client.read:*:3333", once=True)
+           .drop("p2p.peer.send", probability=0.3)
+           .delay("engine.batch", seconds=2.0, window=(1.0, 3.0)))
+    with active(inj):
+        ... run the scenario ...
+    print(inj.snapshot())   # per-point hit/fault counters
+
+Adding a fault point to a new module: docs/FAULT_INJECTION.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import hashlib
+import random
+import threading
+import time
+from contextlib import contextmanager
+from typing import Callable
+
+__all__ = [
+    "Directive",
+    "FaultInjectedError",
+    "FaultInjector",
+    "FaultRule",
+    "POINT",
+    "SEND_ASYNC",
+    "SEND_SYNC",
+    "STEP",
+    "activate",
+    "active",
+    "deactivate",
+    "get",
+    "hit",
+    "snapshot_active",
+]
+
+
+class FaultInjectedError(Exception):
+    """Default exception raised by ``error`` rules."""
+
+
+# What a call site can actually apply. A rule whose action a point does
+# not support is SKIPPED (not counted as fired): a chaos run must never
+# report a fault as injected when the seam silently ignored it.
+POINT = frozenset({"error", "crash", "delay"})        # reads/checks/execs
+STEP = frozenset({"error", "crash", "delay", "drop"})  # skippable steps
+SEND_ASYNC = frozenset({"error", "crash", "delay", "drop", "truncate"})
+SEND_SYNC = frozenset({"error", "crash", "drop", "truncate"})
+
+
+@dataclasses.dataclass
+class Directive:
+    """What a fault point must do, decided by the injector, applied by
+    the call site (which owns the writer/loop the fault acts on)."""
+
+    drop: bool = False        # swallow the send entirely
+    truncate: int = -1        # >= 0: write only this many bytes, then fail
+    delay: float = 0.0        # stall this long before proceeding
+    crash: str | None = None  # component name whose crash handler fired
+
+    def sleep_sync(self) -> None:
+        """Apply the delay on a synchronous (non-event-loop) path."""
+        if self.delay > 0:
+            time.sleep(self.delay)
+
+
+@dataclasses.dataclass
+class FaultRule:
+    """One composable fault: WHERE (point glob), WHAT (action), WHEN
+    (schedule). All schedule gates must pass for the rule to fire."""
+
+    point: str                       # exact key or fnmatch glob
+    action: str                      # error | delay | drop | truncate | crash
+    # action parameters
+    exc: Callable[[], BaseException] | type | None = None
+    seconds: float = 0.0             # delay duration
+    keep_bytes: int = 0              # truncate: bytes allowed through
+    component: str = ""              # crash target
+    # schedule
+    # schedule gates are PER MATCHED POINT (per tagged key), like the
+    # RNGs and hit counts: a glob rule with once/max_fires fires that
+    # budget at EVERY point it matches, so the schedule at one point
+    # never depends on which other point's task got scheduled first
+    probability: float = 1.0         # per-eligible-hit firing chance
+    every_nth: int = 0               # fire on hits N, 2N, 3N, ... (0 = off)
+    once: bool = False               # first eligible hit per point only
+    window: tuple[float, float] | None = None  # (start, end) s since activate
+    max_fires: int = 0               # per-point fire cap (0 = no cap)
+    # live state: total fires across all matched points (observability)
+    fires: int = 0
+
+    def make_exc(self) -> BaseException:
+        if self.exc is None:
+            return FaultInjectedError(f"injected fault at {self.point}")
+        if isinstance(self.exc, type):
+            return self.exc(f"injected fault at {self.point}")
+        return self.exc()
+
+
+@dataclasses.dataclass
+class _PointStats:
+    hits: int = 0
+    faults: int = 0
+
+
+class FaultInjector:
+    """Seeded registry of fault rules with per-point accounting.
+
+    Thread-safe: fault points fire from the event loop AND from executor
+    threads (db writes, engine backends), so every mutation sits under
+    one lock. The lock is only ever taken while an injector is active —
+    the disabled path never reaches it.
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+        self.rules: list[FaultRule] = []
+        self.points: dict[str, _PointStats] = {}
+        self.armed_at = 0.0      # set by activate()
+        self._lock = threading.RLock()
+        self._rngs: dict[tuple[int, str], random.Random] = {}
+        self._rule_hits: dict[tuple[int, str], int] = {}
+        self._rule_fires: dict[tuple[int, str], int] = {}
+        self._match_cache: dict[tuple[int, str], bool] = {}
+        self._crash_handlers: dict[str, Callable[[], None]] = {}
+
+    # -- plan construction (chainable) --------------------------------------
+
+    def add(self, rule: FaultRule) -> "FaultInjector":
+        self.rules.append(rule)
+        return self
+
+    def error(self, point: str, exc=None, **sched) -> "FaultInjector":
+        return self.add(FaultRule(point, "error", exc=exc, **sched))
+
+    def delay(self, point: str, seconds: float, **sched) -> "FaultInjector":
+        return self.add(FaultRule(point, "delay", seconds=seconds, **sched))
+
+    def drop(self, point: str, **sched) -> "FaultInjector":
+        return self.add(FaultRule(point, "drop", **sched))
+
+    def truncate(self, point: str, keep_bytes: int = 0, **sched) -> "FaultInjector":
+        """a.k.a. short_write: let ``keep_bytes`` through, then fail."""
+        return self.add(FaultRule(point, "truncate", keep_bytes=keep_bytes, **sched))
+
+    short_write = truncate
+
+    def crash(self, point: str, component: str, **sched) -> "FaultInjector":
+        return self.add(FaultRule(point, "crash", component=component, **sched))
+
+    def register_crash_handler(self, component: str,
+                               fn: Callable[[], None]) -> None:
+        """Register what "crash <component>" means (cancel its tasks,
+        abort its transport, ...). Handlers must be synchronous; a crash
+        rule firing with no handler raises FaultInjectedError instead."""
+        self._crash_handlers[component] = fn
+
+    # -- the fault point ----------------------------------------------------
+
+    def _rng_for(self, idx: int, key: str) -> random.Random:
+        rng = self._rngs.get((idx, key))
+        if rng is None:
+            digest = hashlib.sha256(
+                f"{self.seed}|{idx}|{key}".encode()
+            ).digest()
+            rng = random.Random(int.from_bytes(digest[:8], "big"))
+            self._rngs[(idx, key)] = rng
+        return rng
+
+    def _matches(self, idx: int, rule: FaultRule, point: str, key: str) -> bool:
+        cached = self._match_cache.get((idx, key))
+        if cached is None:
+            cached = (
+                rule.point == key
+                or rule.point == point
+                or fnmatch.fnmatchcase(key, rule.point)
+            )
+            self._match_cache[(idx, key)] = cached
+        return cached
+
+    def hit(self, point: str, tag: str | None = None,
+            supports: frozenset | None = None) -> Directive | None:
+        """Evaluate one fault-point hit. Raises for ``error``/handlerless
+        ``crash`` rules; returns a Directive for drop/truncate/delay; None
+        when nothing fires. First matching rule that fires wins.
+        ``supports`` names the actions this seam can apply — rules with
+        any other action are skipped WITHOUT counting as fired."""
+        key = point if tag is None else f"{point}:{tag}"
+        with self._lock:
+            stats = self.points.get(key)
+            if stats is None:
+                stats = self.points[key] = _PointStats()
+            stats.hits += 1
+            now = time.monotonic() - self.armed_at
+            for idx, rule in enumerate(self.rules):
+                if supports is not None and rule.action not in supports:
+                    continue
+                if not self._matches(idx, rule, point, key):
+                    continue
+                if rule.window is not None and not (
+                        rule.window[0] <= now < rule.window[1]):
+                    continue
+                fired = self._rule_fires.get((idx, key), 0)
+                if rule.max_fires and fired >= rule.max_fires:
+                    continue
+                if rule.once and fired:
+                    continue
+                n = self._rule_hits.get((idx, key), 0) + 1
+                self._rule_hits[(idx, key)] = n
+                if rule.every_nth and n % rule.every_nth:
+                    continue
+                if rule.probability < 1.0 and (
+                        self._rng_for(idx, key).random() >= rule.probability):
+                    continue
+                self._rule_fires[(idx, key)] = fired + 1
+                rule.fires += 1
+                stats.faults += 1
+                return self._apply(rule, key)
+        return None
+
+    def _apply(self, rule: FaultRule, key: str) -> Directive | None:
+        # called under the lock; only crash handlers run user code here,
+        # and they are required to be quick + sync (abort/cancel calls)
+        if rule.action == "error":
+            raise rule.make_exc()
+        if rule.action == "delay":
+            return Directive(delay=rule.seconds)
+        if rule.action == "drop":
+            return Directive(drop=True)
+        if rule.action == "truncate":
+            return Directive(truncate=rule.keep_bytes)
+        if rule.action == "crash":
+            handler = self._crash_handlers.get(rule.component)
+            if handler is None:
+                raise FaultInjectedError(
+                    f"injected crash of {rule.component!r} at {key} "
+                    "(no crash handler registered)"
+                )
+            handler()
+            return Directive(crash=rule.component)
+        raise ValueError(f"unknown fault action {rule.action!r}")
+
+    # -- observability -------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Injector state for the API/engine snapshot: chaos runs are
+        only trustworthy when you can SEE which seams actually fired."""
+        with self._lock:
+            return {
+                "active": self is _active,
+                "seed": self.seed,
+                "points": {
+                    key: {"hits": s.hits, "faults": s.faults}
+                    for key, s in sorted(self.points.items())
+                },
+                "rules": [
+                    {
+                        "point": r.point,
+                        "action": r.action,
+                        "fires": r.fires,
+                    }
+                    for r in self.rules
+                ],
+            }
+
+
+# -- process-global activation ------------------------------------------------
+
+_active: FaultInjector | None = None
+
+
+def activate(injector: FaultInjector) -> FaultInjector:
+    """Install the process-global injector (chaos runs only)."""
+    global _active
+    injector.armed_at = time.monotonic()
+    _active = injector
+    return injector
+
+
+def deactivate() -> None:
+    global _active
+    _active = None
+
+
+def get() -> FaultInjector | None:
+    return _active
+
+
+@contextmanager
+def active(injector: FaultInjector):
+    """``with faults.active(inj): ...`` — deterministic scope for tests."""
+    activate(injector)
+    try:
+        yield injector
+    finally:
+        deactivate()
+
+
+def hit(point: str, tag: str | None = None,
+        supports: frozenset | None = None) -> Directive | None:
+    """THE fault point. Disabled cost: one global load + None check."""
+    inj = _active
+    if inj is None:
+        return None
+    return inj.hit(point, tag, supports)
+
+
+def snapshot_active() -> dict:
+    """Snapshot provider shape for the API server (always callable)."""
+    inj = _active
+    if inj is None:
+        return {"active": False}
+    return inj.snapshot()

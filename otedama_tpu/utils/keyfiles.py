@@ -31,13 +31,39 @@ def write_hex_file(path: str | os.PathLike, data: bytes,
     ATOMICALLY (O_EXCL + mode at open — never a world-readable window,
     never a partial chmod after a crash). Existing files are refused
     unless ``force`` (a rerun must not silently destroy the fleet
-    authority key every deployed miner pins)."""
-    flags = os.O_WRONLY | os.O_CREAT | (0 if force else os.O_EXCL)
-    if force:
-        flags |= os.O_TRUNC
+    authority key every deployed miner pins). The force path writes a
+    0600 O_EXCL temp file in the same directory and ``os.replace()``s it
+    over the target, so replacing a key is atomic too: no window where
+    the file is world-readable, truncated, or half-written."""
+    path = os.fspath(path)
     mode = 0o600 if secret else 0o644
+    if force:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            # a rotation killed mid-write can leave this exact name (pid
+            # recycling): it is OURS by construction, clear it — O_EXCL
+            # below still refuses any race on the fresh create
+            os.unlink(tmp)
+        except FileNotFoundError:
+            pass
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_EXCL, mode)
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(data.hex() + "\n")
+                f.flush()
+                # the atomicity claim covers power loss: the content must
+                # be durable BEFORE the rename makes it the live key
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return
     try:
-        fd = os.open(os.fspath(path), flags, mode)
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, mode)
     except FileExistsError:
         raise FileExistsError(
             f"{path} already exists — refusing to overwrite key material "
@@ -45,5 +71,3 @@ def write_hex_file(path: str | os.PathLike, data: bytes,
         ) from None
     with os.fdopen(fd, "w") as f:
         f.write(data.hex() + "\n")
-    if force and secret:
-        os.chmod(path, 0o600)  # force-path may reuse an old file's mode

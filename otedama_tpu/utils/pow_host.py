@@ -10,7 +10,45 @@ enforce it. Reference parity: internal/mining/multi_algorithm.go:93-140
 
 from __future__ import annotations
 
+import concurrent.futures
 import hashlib
+import threading
+
+# algorithms whose host validation is real CPU work (milliseconds to
+# seconds per share — ethash's first share of an epoch builds a whole
+# cache): the stratum servers route their validation through an executor
+# thread for these instead of blocking the event loop
+SLOW_HOST_ALGOS = frozenset(
+    {"scrypt", "litecoin", "x11", "dash", "ethash", "etchash"}
+)
+
+# dedicated pool for share validation: the event loop's DEFAULT executor
+# also carries every engine backend.search dispatch, so N miners blocked
+# on an epoch cache build there would starve mining itself — validation
+# gets its own small pool instead
+_VALIDATION_POOL: concurrent.futures.ThreadPoolExecutor | None = None
+_VALIDATION_POOL_LOCK = threading.Lock()
+
+
+def validation_executor() -> concurrent.futures.ThreadPoolExecutor:
+    global _VALIDATION_POOL
+    if _VALIDATION_POOL is None:
+        with _VALIDATION_POOL_LOCK:
+            if _VALIDATION_POOL is None:
+                _VALIDATION_POOL = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=4, thread_name_prefix="share-validate"
+                )
+                # registered AFTER concurrent.futures' own exit handler,
+                # so this runs FIRST (atexit is LIFO): cancel queued
+                # validations so interpreter exit waits for at most the
+                # one in-flight digest (bounded seconds, not a queue)
+                import atexit
+
+                atexit.register(
+                    _VALIDATION_POOL.shutdown, wait=False,
+                    cancel_futures=True,
+                )
+    return _VALIDATION_POOL
 
 
 def sha256d(data: bytes) -> bytes:
@@ -25,23 +63,80 @@ def scrypt_1024_1_1(data: bytes) -> bytes:
 
 # epoch -> (full_size, cache): ethash share validation needs the job
 # epoch's cache; two resident epochs cover a boundary transition (each
-# real-chain cache is tens of MB, so the LRU stays small on purpose)
+# real-chain cache is tens of MB, so the LRU stays small on purpose).
+# Validation runs on executor threads, so the dict is lock-guarded — but
+# the LOCK is never held across a cache build: the first thread of an
+# epoch builds outside the lock behind a per-epoch event, so shares for
+# an already-resident epoch never wait on a boundary build.
 _ETHASH_CACHES: "dict[int, tuple[int, object]]" = {}
+_ETHASH_LOCK = threading.Lock()
+_ETHASH_BUILDING: "dict[int, threading.Event]" = {}
+
+
+def register_epoch_cache(epoch: int, full_size: int, cache) -> bool:
+    """Donate a prebuilt REAL-CHAIN epoch cache (EthashManagedBackend
+    builds one per followed epoch) so share validation never regenerates
+    it. Donations with non-canonical sizing (miniature test epochs) are
+    refused — this registry is keyed by epoch under real chain rules.
+    Returns True when the cache was adopted."""
+    from otedama_tpu.kernels import ethash as eth
+
+    bn = epoch * eth.EPOCH_LENGTH
+    if full_size != eth.dataset_size(bn):
+        return False
+    rows = getattr(cache, "shape", (0,))[0]
+    if rows * eth.HASH_BYTES != eth.cache_size(bn):
+        return False
+    with _ETHASH_LOCK:
+        if epoch not in _ETHASH_CACHES:
+            _ETHASH_CACHES[epoch] = (full_size, cache)
+            _prune_caches_locked()
+    return True
+
+
+def _prune_caches_locked() -> None:
+    while len(_ETHASH_CACHES) > 2:
+        del _ETHASH_CACHES[min(_ETHASH_CACHES)]
+
+
+def _epoch_cache(epoch: int) -> tuple[int, object]:
+    from otedama_tpu.kernels import ethash as eth
+
+    while True:
+        with _ETHASH_LOCK:
+            ent = _ETHASH_CACHES.get(epoch)
+            if ent is not None:
+                return ent
+            event = _ETHASH_BUILDING.get(epoch)
+            if event is None:
+                event = _ETHASH_BUILDING[epoch] = threading.Event()
+                building = True
+            else:
+                building = False
+        if not building:
+            # another thread is building this epoch: wait, then re-check
+            # (on builder failure the entry is absent and we take over)
+            event.wait()
+            continue
+        try:
+            bn = epoch * eth.EPOCH_LENGTH
+            cache = eth.make_cache(eth.cache_size(bn), eth.seed_hash(bn))
+            ent = (eth.dataset_size(bn), cache)
+            with _ETHASH_LOCK:
+                _ETHASH_CACHES[epoch] = ent
+                _prune_caches_locked()
+            return ent
+        finally:
+            with _ETHASH_LOCK:
+                _ETHASH_BUILDING.pop(epoch, None)
+            event.set()
 
 
 def _ethash_digest(header80: bytes, block_number: int) -> bytes:
     from otedama_tpu.kernels import ethash as eth
 
     epoch = block_number // eth.EPOCH_LENGTH
-    ent = _ETHASH_CACHES.get(epoch)
-    if ent is None:
-        bn = epoch * eth.EPOCH_LENGTH
-        cache = eth.make_cache(eth.cache_size(bn), eth.seed_hash(bn))
-        ent = (eth.dataset_size(bn), cache)
-        _ETHASH_CACHES[epoch] = ent
-        while len(_ETHASH_CACHES) > 2:
-            del _ETHASH_CACHES[min(_ETHASH_CACHES)]
-    full_size, cache = ent
+    full_size, cache = _epoch_cache(epoch)
     # framework conventions (EthashLightBackend): the ethash header hash
     # is keccak256 of the 76-byte prefix, the nonce is the big-endian
     # word at bytes 76:80, and the BE result byte-reverses once so

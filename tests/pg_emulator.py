@@ -50,8 +50,12 @@ class PgEmulator:
     lock (advisory-lock calls are acknowledged, the global lock is the
     actual serialization)."""
 
-    def __init__(self, password: str = "soak"):
+    def __init__(self, password: str = "soak",
+                 parameters: dict | None = None):
         self.password = password
+        # extra ParameterStatus pairs announced at startup (e.g.
+        # standard_conforming_strings=off to prove the driver refuses)
+        self.parameters = dict(parameters or {})
         self._db = sqlite3.connect(":memory:", check_same_thread=False)
         self._db.row_factory = sqlite3.Row
         self._db.isolation_level = None  # raw: BEGIN/COMMIT pass through
@@ -135,6 +139,9 @@ class PgEmulator:
             sock.sendall(_msg(b"R", struct.pack("!I", 0)))
             sock.sendall(_msg(
                 b"S", b"server_version\x0015.0 (otedama-emulator)\x00"))
+            for name, value in self.parameters.items():
+                sock.sendall(_msg(
+                    b"S", name.encode() + b"\x00" + value.encode() + b"\x00"))
             sock.sendall(_msg(b"Z", b"I"))
             while True:
                 head = self._recv_exact(sock, 5)

@@ -1,0 +1,1005 @@
+"""Seeded chaos scenarios against the REAL components (ISSUE 1 tentpole).
+
+tests/test_soak.py proved connection churn end-to-end; this module
+generalizes that into deterministic fault-injection runs via
+otedama_tpu/utils/faults.py. Every scenario arms a seeded FaultInjector,
+drives real servers/clients/managers over loopback or memnet, and then
+asserts the invariants that actually matter:
+
+- the fault SCHEDULE is reproducible from the seed (and fault points are
+  provably no-op when the injector is off),
+- no lost or double-counted accepted shares under reply drops and DB
+  write faults (every accept a miner saw is durable exactly once),
+- reconnect / failover convergence within bounded time under upstream
+  flaps,
+- engine batch stalls are detected and recovered (FailureDetector
+  restart, counters incremented),
+- no leaked sessions/conns/channels/tasks after the chaos window.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import os
+import sqlite3
+import stat
+import struct
+import time
+
+import pytest
+
+from otedama_tpu.engine import jobs as jobmod
+from otedama_tpu.engine.types import Job, Share
+from otedama_tpu.kernels import target as tgt
+from otedama_tpu.stratum import protocol as sp
+from otedama_tpu.utils import faults
+from otedama_tpu.utils.sha256_host import sha256d
+
+EASY = 1e-7  # ~2.3e-3 hit probability per hash: shares mine in ~430 tries
+
+
+def make_job(job_id: str = "c1", nbits: int = 0x1D00FFFF) -> Job:
+    return Job(
+        job_id=job_id,
+        prev_hash=bytes(range(32)),
+        coinb1=bytes.fromhex("01000000010000000000000000"),
+        coinb2=bytes.fromhex("ffffffff0100f2052a01000000"),
+        merkle_branch=[bytes([i] * 32) for i in (7, 9)],
+        version=0x20000000,
+        nbits=nbits,
+        ntime=int(time.time()),
+        clean=True,
+    )
+
+
+def mine_share(job: Job, extranonce1: bytes, difficulty: float,
+               en2: bytes) -> int:
+    """Brute-force a nonce meeting ``difficulty`` for this en2 space."""
+    target = tgt.difficulty_to_target(difficulty)
+    job = dataclasses.replace(job, extranonce1=extranonce1)
+    prefix = jobmod.build_header_prefix(job, en2)
+    for nonce in range(1 << 22):
+        if tgt.hash_meets_target(sha256d(prefix + struct.pack(">I", nonce)),
+                                 target):
+            return nonce
+    raise AssertionError("no share found")
+
+
+# -- determinism + disabled-path contract ------------------------------------
+
+def _drive_schedule(seed: int, order: list[str]) -> dict[str, str]:
+    """Hit points in ``order`` under a fixed plan; return one outcome
+    character per hit, grouped per point."""
+    inj = (faults.FaultInjector(seed)
+           .drop("a.*", probability=0.4)
+           .error("b", every_nth=3, exc=RuntimeError)
+           .delay("c", seconds=0.25, probability=0.5))
+    out: dict[str, list[str]] = {}
+    with faults.active(inj):
+        for point in order:
+            try:
+                d = faults.hit(point)
+            except RuntimeError:
+                out.setdefault(point, []).append("E")
+                continue
+            if d is None:
+                out.setdefault(point, []).append("-")
+            elif d.drop:
+                out.setdefault(point, []).append("D")
+            elif d.delay:
+                out.setdefault(point, []).append("S")
+    return {k: "".join(v) for k, v in out.items()}
+
+
+def test_fault_schedule_is_seed_deterministic():
+    order = (["a.x", "a.y", "b", "c"] * 30)
+    first = _drive_schedule(1337, order)
+    replay = _drive_schedule(1337, order)
+    assert first == replay, "same seed must replay the same schedule"
+    other = _drive_schedule(31337, order)
+    assert first != other, "a different seed must move the schedule"
+    # the schedule really exercised every action
+    assert "D" in first["a.x"] and "-" in first["a.x"]
+    assert first["b"].count("E") == 10  # every 3rd of 30 hits
+    assert "S" in first["c"]
+
+    # per-point independence: interleaving OTHER points must not perturb
+    # a point's own schedule (async ordering varies between runs)
+    seq = _drive_schedule(7, ["a.x"] * 40)
+    mixed = _drive_schedule(7, ["a.x", "b", "c", "a.y"] * 40)
+    assert mixed["a.x"][:40] == seq["a.x"]
+
+
+def test_fault_rule_gates_window_once_max_fires_crash():
+    inj = (faults.FaultInjector(5)
+           .error("w", window=(10.0, 20.0))      # the future: never fires
+           .drop("o", once=True)
+           .drop("m", max_fires=2)
+           .crash("k", component="widget"))
+    crashed = []
+    inj.register_crash_handler("widget", lambda: crashed.append(1))
+    with faults.active(inj):
+        assert all(faults.hit("w") is None for _ in range(5))
+        assert faults.hit("o").drop and faults.hit("o") is None
+        fires = [faults.hit("m") is not None for _ in range(5)]
+        assert sum(fires) == 2 and fires[:2] == [True, True]
+        d = faults.hit("k")
+        assert d.crash == "widget" and crashed == [1]
+        # a crash rule without a handler raises instead of passing silently
+        inj.rules[-1].component = "ghost"
+        with pytest.raises(faults.FaultInjectedError, match="ghost"):
+            faults.hit("k")
+    snap = inj.snapshot()
+    assert snap["points"]["m"] == {"hits": 5, "faults": 2}
+    assert snap["seed"] == 5
+
+    # fire budgets are PER MATCHED POINT: a glob once-rule fires once at
+    # EACH point, so async interleaving across points can never move the
+    # budget between them (the replay guarantee)
+    inj2 = faults.FaultInjector(9).drop("g.*", once=True)
+    with faults.active(inj2):
+        assert faults.hit("g.a").drop and faults.hit("g.a") is None
+        assert faults.hit("g.b").drop and faults.hit("g.b") is None
+    assert inj2.rules[0].fires == 2  # total across points, for telemetry
+
+    # a rule whose action a seam cannot apply is SKIPPED, not counted as
+    # fired: a chaos run must never report faults that never happened
+    inj3 = (faults.FaultInjector(3)
+            .truncate("r", keep_bytes=2)     # read seams can't truncate
+            .error("r", exc=KeyError))
+    with faults.active(inj3):
+        with pytest.raises(KeyError):        # falls through to the next rule
+            faults.hit("r", supports=faults.POINT)
+    snap3 = inj3.snapshot()
+    assert snap3["rules"][0]["fires"] == 0   # truncate never "fired"
+    assert snap3["rules"][1]["fires"] == 1
+    assert snap3["points"]["r"] == {"hits": 1, "faults": 1}
+
+
+@pytest.mark.asyncio
+async def test_fault_points_noop_when_disabled():
+    """With no active injector the fault points must change NOTHING:
+    the default path is a None check, and a real share round-trip
+    behaves exactly as before the layer existed."""
+    from otedama_tpu.stratum.server import ServerConfig, StratumServer
+
+    assert faults.get() is None
+    assert faults.hit("stratum.client.send") is None
+    assert faults.snapshot_active() == {"active": False}
+
+    accepted = []
+
+    async def on_share(s):
+        accepted.append(s)
+
+    server = StratumServer(ServerConfig(port=0, initial_difficulty=EASY),
+                           on_share=on_share)
+    await server.start()
+    try:
+        job = make_job()
+        server.set_job(job)
+        reader, writer = await asyncio.open_connection("127.0.0.1", server.port)
+
+        async def call(mid, method, params):
+            writer.write(sp.encode_line(
+                sp.Message(id=mid, method=method, params=params)))
+            await writer.drain()
+            while True:
+                m = sp.decode_line(await asyncio.wait_for(reader.readline(), 5))
+                if m.is_response and m.id == mid:
+                    return m
+
+        sub = await call(1, "mining.subscribe", ["chaos"])
+        en1 = bytes.fromhex(sub.result[1])
+        assert (await call(2, "mining.authorize", ["w.n", "x"])).result is True
+        en2 = b"\x00\x00\x00\x07"
+        nonce = mine_share(job, en1, EASY, en2)
+        ok = await call(3, "mining.submit",
+                        ["w.n", job.job_id, en2.hex(), f"{job.ntime:08x}",
+                         f"{nonce:08x}"])
+        assert ok.result is True
+        assert len(accepted) == 1
+        writer.close()
+    finally:
+        await server.stop()
+
+
+# -- scenario 1: upstream pool flap -> failover switchover --------------------
+
+@pytest.mark.asyncio
+async def test_chaos_failover_under_injected_unreachability_and_latency():
+    """FailoverManager strategy selection under injected upstream faults
+    (satellite: its previously untested adversarial surface). Injected
+    unreachability takes the real connection-failure path; injected
+    latency lands in the measured EMA the PERFORMANCE strategy scores."""
+    from otedama_tpu.pool.failover import (
+        FailoverManager,
+        FailoverStrategy,
+        UpstreamPool,
+    )
+
+    async def _noop(reader, writer):
+        pass
+
+    srv_a = await asyncio.start_server(_noop, "127.0.0.1", 0)
+    srv_b = await asyncio.start_server(_noop, "127.0.0.1", 0)
+    port_a = srv_a.sockets[0].getsockname()[1]
+    port_b = srv_b.sockets[0].getsockname()[1]
+    try:
+        def pools():
+            return [
+                UpstreamPool("primary", "127.0.0.1", port_a, priority=0),
+                UpstreamPool("backup", "127.0.0.1", port_b, priority=1),
+            ]
+
+        # PRIORITY: primary flaps -> converges to backup within the
+        # failure threshold, then back to primary once it heals
+        fm = FailoverManager(pools(), FailoverStrategy.PRIORITY,
+                             failure_threshold=2)
+        inj = faults.FaultInjector(2024).error(
+            "pool.failover.check:primary", exc=OSError, max_fires=2)
+        with faults.active(inj):
+            assert fm.select().name == "primary"
+            checks_to_converge = 0
+            while fm.select().name != "backup":
+                await fm.check_all()
+                checks_to_converge += 1
+                assert checks_to_converge <= 2, "no bounded convergence"
+            # faults exhausted (max_fires): the next probe heals primary
+            await fm.check_all()
+            assert fm.select().name == "primary"
+        assert inj.snapshot()["points"][
+            "pool.failover.check:primary"]["faults"] == 2
+
+        # PERFORMANCE: injected latency on primary degrades its score
+        fm2 = FailoverManager(pools(), FailoverStrategy.PERFORMANCE)
+        inj2 = faults.FaultInjector(99).delay(
+            "pool.failover.check:primary", seconds=0.15)
+        with faults.active(inj2):
+            await fm2.check_all()
+            await fm2.check_all()
+        a, b = fm2.pools
+        assert a.latency > b.latency
+        assert fm2.select().name == "backup"
+        snap = fm2.snapshot()
+        assert {p["name"] for p in snap} == {"primary", "backup"}
+        assert next(p for p in snap if p["name"] == "primary")["score"] < \
+            next(p for p in snap if p["name"] == "backup")["score"]
+
+        # ROUND_ROBIN and LOAD_BALANCED both route around an injected
+        # outage instead of handing shares to a dead upstream
+        for strategy in (FailoverStrategy.ROUND_ROBIN,
+                         FailoverStrategy.LOAD_BALANCED):
+            fm3 = FailoverManager(pools(), strategy, failure_threshold=1)
+            inj3 = faults.FaultInjector(7).error(
+                "pool.failover.check:primary", exc=OSError)
+            with faults.active(inj3):
+                await fm3.check_all()
+                assert all(fm3.select().name == "backup" for _ in range(4))
+    finally:
+        srv_a.close()
+        srv_b.close()
+        await srv_a.wait_closed()
+        await srv_b.wait_closed()
+
+
+@pytest.mark.asyncio
+async def test_chaos_upstream_flap_client_reconnects_and_failover_converges():
+    """A flapping upstream: the REAL StratumClient rides through a
+    window of injected read faults (reconnect loop), while the failover
+    manager (probing the same upstream under the same fault window)
+    switches selection to the backup and back after the flap ends.
+    Shares accepted before and after the flap are each counted exactly
+    once on the server."""
+    from otedama_tpu.pool.failover import (
+        FailoverManager,
+        FailoverStrategy,
+        UpstreamPool,
+    )
+    from otedama_tpu.stratum.client import ClientConfig, StratumClient
+    from otedama_tpu.stratum.server import ServerConfig, StratumServer
+
+    accepted_srv = []
+
+    async def on_share(s):
+        accepted_srv.append(s)
+
+    server = StratumServer(ServerConfig(port=0, initial_difficulty=EASY),
+                           on_share=on_share)
+    await server.start()
+    backup_srv = await asyncio.start_server(
+        lambda r, w: None, "127.0.0.1", 0)
+    backup_port = backup_srv.sockets[0].getsockname()[1]
+    job = make_job("flap1")
+    server.set_job(job)
+
+    jobs_seen: list[Job] = []
+    client = StratumClient(
+        ClientConfig(host="127.0.0.1", port=server.port, username="w.flap",
+                     response_timeout=2.0, reconnect_initial=0.05,
+                     reconnect_max=0.1),
+        on_job=jobs_seen.append,
+    )
+    fm = FailoverManager(
+        [UpstreamPool("primary", "127.0.0.1", server.port, priority=0),
+         UpstreamPool("backup", "127.0.0.1", backup_port, priority=1)],
+        FailoverStrategy.PRIORITY, failure_threshold=2,
+    )
+    try:
+        await asyncio.wait_for(client.start(), 5)
+        for _ in range(100):
+            if jobs_seen:
+                break
+            await asyncio.sleep(0.02)
+        assert jobs_seen, "no job before the flap"
+
+        async def submit_one(tag: bytes) -> bool:
+            j = dataclasses.replace(client.current_job or jobs_seen[-1])
+            nonce = mine_share(j, client.extranonce1, EASY, tag)
+            res = await client.submit(Share(
+                job_id=j.job_id, worker="w.flap", extranonce2=tag,
+                ntime=j.ntime, nonce_word=nonce, digest=b"\x00" * 32,
+                difficulty=EASY))
+            return res.accepted
+
+        assert await submit_one(b"\x00\x00\x00\x01")
+
+        # the flap: every upstream read fails for ~0.6 s (both the
+        # client's session and the failover probe see the same outage)
+        flap = (faults.FaultInjector(4242)
+                .error(f"stratum.client.read:127.0.0.1:{server.port}",
+                       exc=ConnectionError, window=(0.0, 0.6))
+                .error("pool.failover.check:primary", exc=OSError,
+                       window=(0.0, 0.6)))
+        with faults.active(flap):
+            t0 = time.monotonic()
+            while fm.select().name != "backup":
+                await fm.check_all()
+                assert time.monotonic() - t0 < 3.0, \
+                    "failover did not converge during the flap"
+            # ride out the window; the pool keeps pushing jobs (that is
+            # what wakes the client's read loop into the injected fault)
+            # and the client keeps reconnect-looping
+            wave = 0
+            while time.monotonic() - flap.armed_at < 0.8:
+                wave += 1
+                server.set_job(make_job(f"flapwave{wave}"))
+                await asyncio.sleep(0.05)
+        assert client.stats["reconnects"] >= 1, \
+            "injected read faults never tripped the reconnect loop"
+
+        # after the flap: probes heal the primary, selection returns
+        await fm.check_all()
+        assert fm.select().name == "primary"
+        # and the SAME client session mines again without intervention
+        await asyncio.wait_for(client.connected.wait(), 5)
+        t0 = time.monotonic()
+        while True:
+            if await submit_one(os.urandom(2) + b"\x00\x07"):
+                break
+            assert time.monotonic() - t0 < 5.0, "no accept after recovery"
+        assert client.stats["shares_accepted"] >= 2
+        # exactly-once accounting across the flap: every accept verdict
+        # the client saw is one AcceptedShare on the server
+        assert len(accepted_srv) == client.stats["shares_accepted"]
+    finally:
+        await client.stop()
+        await server.stop()
+        backup_srv.close()
+        await backup_srv.wait_closed()
+
+
+# -- scenario 2: mid-submit connection drops ----------------------------------
+
+@pytest.mark.asyncio
+async def test_chaos_mid_submit_drops_never_lose_or_double_count():
+    """Dropped/truncated writes around mining.submit: some verdicts
+    never reach the miner, some submits never reach the server. The
+    invariant that must hold through all of it: the server's accepted
+    counter equals the durable rows, and every accept the MINER saw is
+    among them (client accepts <= rows; nothing double-counted)."""
+    from otedama_tpu.db.database import Database
+    from otedama_tpu.pool.manager import PoolManager
+    from otedama_tpu.pool.blockchain import MockChainClient
+    from otedama_tpu.stratum.server import ServerConfig, StratumServer
+
+    db = Database(":memory:")
+    pool = PoolManager(db, MockChainClient())
+    server = StratumServer(ServerConfig(port=0, initial_difficulty=EASY),
+                           on_share=pool.on_share)
+    await server.start()
+    try:
+        job = make_job("drop1")
+        server.set_job(job)
+        reader, writer = await asyncio.open_connection("127.0.0.1", server.port)
+
+        async def call(mid, method, params, timeout=0.4):
+            writer.write(sp.encode_line(
+                sp.Message(id=mid, method=method, params=params)))
+            await writer.drain()
+            while True:
+                m = sp.decode_line(
+                    await asyncio.wait_for(reader.readline(), timeout))
+                if m.is_response and m.id == mid:
+                    return m
+
+        sub = await call(1, "mining.subscribe", ["chaos-drop"], timeout=5)
+        en1 = bytes.fromhex(sub.result[1])
+        await call(2, "mining.authorize", ["w.drop", "x"], timeout=5)
+
+        # every 3rd server->miner write vanishes (the accept verdict is
+        # lost in flight, NOT the share)
+        inj = faults.FaultInjector(777).drop("stratum.server.write",
+                                             every_nth=3)
+        seen_accepts = 0
+        lost_verdicts = 0
+        submitted = []
+        with faults.active(inj):
+            for i in range(9):
+                en2 = struct.pack(">HH", 0xD0, i)
+                nonce = mine_share(job, en1, EASY, en2)
+                params = ["w.drop", job.job_id, en2.hex(),
+                          f"{job.ntime:08x}", f"{nonce:08x}"]
+                try:
+                    m = await call(100 + i, "mining.submit", params)
+                except asyncio.TimeoutError:
+                    lost_verdicts += 1
+                    submitted.append(params)
+                    continue
+                assert m.result is True, m.error
+                seen_accepts += 1
+        assert lost_verdicts >= 2, "the drop schedule never fired"
+
+        # the real-miner follow-up: resubmitting a share whose verdict
+        # was lost must NOT double-count (duplicate window holds)
+        dup = await call(500, "mining.submit", submitted[0], timeout=5)
+        assert dup.result is not True
+        assert dup.error[0] == sp.ERR_DUPLICATE
+
+        rows = db.query("SELECT COUNT(*) AS c FROM shares")[0]["c"]
+        assert rows == server.stats["shares_valid"] == 9
+        assert seen_accepts <= rows  # every seen accept is durable
+        assert server.stats["shares_invalid"] == 1  # just the duplicate
+        writer.close()
+    finally:
+        await server.stop()
+        db.close()
+
+
+# -- scenario 3: DB write faults during share accounting ----------------------
+
+@pytest.mark.asyncio
+async def test_chaos_db_write_faults_keep_share_accounting_exact():
+    """Injected sqlite errors inside share accounting: the server must
+    turn the failed persist into a REJECT the miner sees (never a
+    phantom accept), the pool transaction must roll back whole (no
+    partial worker counters), and accounting must recover as soon as
+    the fault schedule ends."""
+    from otedama_tpu.db.database import Database
+    from otedama_tpu.pool.manager import PoolManager
+    from otedama_tpu.pool.blockchain import MockChainClient
+    from otedama_tpu.stratum.server import ServerConfig, StratumServer
+
+    db = Database(":memory:")
+    pool = PoolManager(db, MockChainClient())
+    server = StratumServer(ServerConfig(port=0, initial_difficulty=EASY),
+                           on_share=pool.on_share)
+    await server.start()
+    try:
+        job = make_job("dbf1")
+        server.set_job(job)
+        reader, writer = await asyncio.open_connection("127.0.0.1", server.port)
+
+        async def call(mid, method, params):
+            writer.write(sp.encode_line(
+                sp.Message(id=mid, method=method, params=params)))
+            await writer.drain()
+            while True:
+                m = sp.decode_line(await asyncio.wait_for(reader.readline(), 5))
+                if m.is_response and m.id == mid:
+                    return m
+
+        sub = await call(1, "mining.subscribe", ["chaos-db"])
+        en1 = bytes.fromhex(sub.result[1])
+        await call(2, "mining.authorize", ["w.db", "x"])
+
+        inj = faults.FaultInjector(606).error(
+            "db.execute", exc=sqlite3.OperationalError,
+            every_nth=5, max_fires=3)
+        accepts = 0
+        accounting_rejects = 0
+        rejected_params: list[list] = []
+        with faults.active(inj):
+            for i in range(10):
+                en2 = struct.pack(">HH", 0xDB, i)
+                nonce = mine_share(job, en1, EASY, en2)
+                params = ["w.db", job.job_id, en2.hex(),
+                          f"{job.ntime:08x}", f"{nonce:08x}"]
+                m = await call(200 + i, "mining.submit", params)
+                if m.result is True:
+                    accepts += 1
+                else:
+                    assert "accounting" in m.error[1]
+                    accounting_rejects += 1
+                    rejected_params.append(params)
+        assert accounting_rejects >= 1, "db fault schedule never fired"
+        assert server.stats["share_hook_failures"] == accounting_rejects
+
+        # exactly-once: accepted verdicts == durable rows; the rolled-
+        # back transactions left no partial worker state behind
+        rows = db.query("SELECT COUNT(*) AS c FROM shares")[0]["c"]
+        assert rows == accepts == server.stats["shares_valid"]
+        w = db.query_one(
+            "SELECT shares_valid FROM workers WHERE name = ?", ("w.db",))
+        assert w is not None and w["shares_valid"] == rows
+
+        # schedule exhausted (max_fires): accounting is healthy again
+        en2 = b"\xAA\x00\x00\x01"
+        nonce = mine_share(job, en1, EASY, en2)
+        m = await call(900, "mining.submit",
+                       ["w.db", job.job_id, en2.hex(),
+                        f"{job.ntime:08x}", f"{nonce:08x}"])
+        assert m.result is True
+        assert db.query("SELECT COUNT(*) AS c FROM shares")[0]["c"] == rows + 1
+
+        # the real-miner retry: a share rejected ONLY because accounting
+        # was down must be resubmittable now — not a phantom duplicate
+        # (it was never credited, so accepting it is exactly-once)
+        retry = await call(901, "mining.submit", rejected_params[0])
+        assert retry.result is True, retry.error
+        assert db.query("SELECT COUNT(*) AS c FROM shares")[0]["c"] == rows + 2
+        writer.close()
+    finally:
+        await server.stop()
+        db.close()
+
+
+@pytest.mark.asyncio
+async def test_chaos_block_candidate_survives_accounting_outage():
+    """A share that solves a BLOCK while share accounting is down: the
+    miner sees a reject (the share was not credited), but the block
+    still goes to the chain — submission is independent of accounting
+    and a db hiccup must never cost the reward."""
+    from otedama_tpu.stratum.server import ServerConfig, StratumServer
+
+    blocks = []
+
+    async def failing_share_hook(s):
+        raise sqlite3.OperationalError("accounting down")
+
+    async def on_block(header, job, share):
+        blocks.append(header)
+
+    server = StratumServer(
+        ServerConfig(port=0, initial_difficulty=EASY),
+        on_share=failing_share_hook, on_block=on_block,
+    )
+    await server.start()
+    try:
+        # regtest-easy nbits: any EASY share also meets the network target
+        job = make_job("blkout", nbits=0x207FFFFF)
+        server.set_job(job)
+        reader, writer = await asyncio.open_connection("127.0.0.1", server.port)
+
+        async def call(mid, method, params):
+            writer.write(sp.encode_line(
+                sp.Message(id=mid, method=method, params=params)))
+            await writer.drain()
+            while True:
+                m = sp.decode_line(await asyncio.wait_for(reader.readline(), 5))
+                if m.is_response and m.id == mid:
+                    return m
+
+        sub = await call(1, "mining.subscribe", ["chaos-blk"])
+        en1 = bytes.fromhex(sub.result[1])
+        await call(2, "mining.authorize", ["w.blk", "x"])
+        en2 = b"\x00\x00\x00\x2A"
+        nonce = mine_share(job, en1, EASY, en2)
+        m = await call(3, "mining.submit",
+                       ["w.blk", job.job_id, en2.hex(),
+                        f"{job.ntime:08x}", f"{nonce:08x}"])
+        assert m.result is not True and "accounting" in m.error[1]
+        assert blocks, "block candidate lost to the accounting outage"
+        assert server.stats["blocks_found"] == 1
+        assert server.stats["share_hook_failures"] == 1
+        writer.close()
+    finally:
+        await server.stop()
+
+
+# -- scenario 4: engine batch stall -> detector recovery ----------------------
+
+@pytest.mark.asyncio
+async def test_chaos_engine_stall_detected_and_recovered():
+    """A 60 s injected stall at the batch seam: the FailureDetector must
+    classify it (BATCH_STALL), the recovery strategy must restart the
+    engine, the recovery counter must increment, and hashing must resume
+    — all within seconds, with no orphaned search task left behind."""
+    from otedama_tpu.engine.engine import EngineConfig, MiningEngine
+    from otedama_tpu.runtime.failure import (
+        CallbackStrategy,
+        DetectorConfig,
+        FailureDetector,
+        FailureType,
+    )
+    from otedama_tpu.runtime.search import PythonBackend
+
+    engine = MiningEngine(
+        backends={"py0": PythonBackend()},
+        config=EngineConfig(batch_size=2048, worker_name="w",
+                            auto_batch=False, pipeline_depth=1),
+    )
+    detector = FailureDetector(engine, DetectorConfig(
+        check_interval=0.1, stall_seconds=0.5, recovery_cooldown=5.0,
+        max_recovery_attempts=1,
+    ))
+    restart_lock = asyncio.Lock()
+
+    async def restart(failure) -> bool:
+        async with restart_lock:
+            await engine.stop()
+            await engine.start()
+        return True
+
+    detector.add_strategy(CallbackStrategy(
+        "engine-restart", (FailureType.BATCH_STALL,), restart))
+
+    tasks_before = len(asyncio.all_tasks())
+    await engine.start()
+    engine.set_job(make_job("stall1"))
+    try:
+        t0 = time.monotonic()
+        while engine.stats.hashes == 0:
+            await asyncio.sleep(0.02)
+            assert time.monotonic() - t0 < 10.0, "engine never hashed"
+
+        inj = faults.FaultInjector(11).delay("engine.batch", seconds=60.0,
+                                             once=True)
+        with faults.active(inj):
+            await detector.start()
+            try:
+                # wait until the one-shot stall actually bit
+                t0 = time.monotonic()
+                while inj.rules[0].fires == 0:
+                    await asyncio.sleep(0.02)
+                    assert time.monotonic() - t0 < 5.0
+                stalled_at = engine.stats.hashes
+                # bounded-time recovery: detector sees the stall and the
+                # strategy restarts the engine
+                t0 = time.monotonic()
+                while detector.recoveries == 0:
+                    await asyncio.sleep(0.05)
+                    assert time.monotonic() - t0 < 8.0, \
+                        "stall never detected/recovered"
+                assert any(f.type == FailureType.BATCH_STALL
+                           for f in detector.failures)
+                # hashing resumes after the restart
+                t0 = time.monotonic()
+                while engine.stats.hashes <= stalled_at:
+                    await asyncio.sleep(0.05)
+                    assert time.monotonic() - t0 < 8.0, \
+                        "no progress after recovery"
+            finally:
+                await detector.stop()
+            # chaos observability: the injector state rides the snapshot
+            snap = engine.snapshot()
+            assert snap["fault_injection"]["seed"] == 11
+            assert snap["fault_injection"]["points"][
+                "engine.batch:py0"]["faults"] == 1
+            assert detector.snapshot()["recoveries"] == 1
+    finally:
+        await engine.stop()
+    assert "fault_injection" not in engine.snapshot()  # injector gone
+    await asyncio.sleep(0.1)
+    assert len(asyncio.all_tasks()) <= tasks_before, "leaked engine task"
+
+
+# -- gossip over lossy links --------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_chaos_p2p_gossip_survives_lossy_links():
+    """35% of in-memory link writes vanish (seeded): flood gossip over a
+    4-node full mesh must still converge for most messages (redundant
+    paths + dedup), nodes must stay connected, and a fault-free round
+    afterwards must deliver 100% — proving the overlay recovered."""
+    from otedama_tpu.p2p.memnet import MemoryNetwork
+    from otedama_tpu.p2p.messages import MessageType, P2PMessage
+    from otedama_tpu.p2p.node import NodeConfig, P2PNode
+
+    nodes = [P2PNode(NodeConfig(max_peers=8)) for _ in range(4)]
+    received: dict[int, set[str]] = {i: set() for i in range(4)}
+
+    def make_handler(i):
+        async def handler(node, peer, msg):
+            received[i].add(msg.payload["n"])
+            await node.propagate(peer, msg)
+        return handler
+
+    for i, n in enumerate(nodes):
+        n.on(MessageType.SHARE, make_handler(i))
+
+    net = MemoryNetwork()
+    for a in range(4):
+        for b in range(a + 1, 4):
+            net.link(nodes[a], nodes[b])
+
+    try:
+        inj = faults.FaultInjector(555).drop("p2p.mem.send",
+                                             probability=0.35)
+        sent = 24
+        with faults.active(inj):
+            for k in range(sent):
+                await nodes[0].broadcast(P2PMessage(
+                    MessageType.SHARE, {"n": f"m{k}"}))
+                await asyncio.sleep(0)
+            await asyncio.sleep(0.3)
+        assert inj.snapshot()["points"]  # drops really happened
+        dropped = sum(s["faults"] for s in inj.snapshot()["points"].values())
+        assert dropped > 0
+        for i in (1, 2, 3):
+            got = len(received[i])
+            assert got >= sent * 0.5, \
+                f"node {i} got {got}/{sent} despite redundant paths"
+        assert all(len(n.peers) == 3 for n in nodes), "peers were dropped"
+
+        # recovery round: with faults off, one more flood reaches everyone
+        await nodes[0].broadcast(P2PMessage(MessageType.SHARE,
+                                            {"n": "final"}))
+        t0 = time.monotonic()
+        while not all("final" in received[i] for i in (1, 2, 3)):
+            await asyncio.sleep(0.02)
+            assert time.monotonic() - t0 < 5.0, "post-chaos flood lost"
+        assert sum(n.stats["messages_deduped"] for n in nodes) > 0
+    finally:
+        await net.close()
+    assert all(not n.peers and not n._peer_tasks for n in nodes)
+
+
+# -- SV2 framing faults -------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_chaos_sv2_short_write_clean_teardown_and_recovery():
+    """A truncated SV2 frame desyncs the binary transport: the server
+    must reap the connection AND its channels (no leak), and a fresh
+    client must then connect and get a share accepted — with accounting
+    still exact."""
+    from otedama_tpu.stratum import v2
+
+    accepted = []
+
+    async def on_share(s):
+        accepted.append(s)
+
+    server = v2.Sv2MiningServer(v2.Sv2ServerConfig(port=0,
+                                                   initial_difficulty=EASY),
+                                on_share=on_share)
+    await server.start()
+    job = make_job("sv2c1")
+    server.set_job(job)
+
+    async def open_client():
+        client = v2.Sv2MiningClient("127.0.0.1", server.port, user="w2.c")
+        await client.connect()
+        for _ in range(200):
+            if client.jobs and client.prevhash:
+                break
+            await asyncio.wait_for(client.pump(), 5)
+        return client
+
+    def mine_v2(client, jid):
+        j = server._jobs[jid][0]
+        prefix = jobmod.header_from_share(
+            j, client.channel.extranonce_prefix, j.ntime, 0)[:76]
+        for n in range(1 << 22):
+            if tgt.hash_meets_target(
+                    sha256d(prefix + struct.pack(">I", n)), client.target):
+                return n, j
+        raise AssertionError("no sv2 share found")
+
+    try:
+        client = await open_client()
+        jid = max(client.jobs)
+        nonce, j = mine_v2(client, jid)
+
+        inj = faults.FaultInjector(303).truncate("sv2.conn.send",
+                                                 keep_bytes=3, once=True)
+        with faults.active(inj):
+            with pytest.raises(ConnectionError):
+                await client.submit(jid, nonce, j.ntime, j.version)
+        await client.close()
+        # the server reaps the desynced connection and its channel
+        t0 = time.monotonic()
+        while server._conns or server._channels:
+            await asyncio.sleep(0.02)
+            assert time.monotonic() - t0 < 5.0, "sv2 conn/channel leaked"
+        assert server.stats["shares_accepted"] == 0
+
+        # recovery: a fresh client mines and is accounted exactly once
+        client2 = await open_client()
+        jid2 = max(client2.jobs)
+        nonce2, j2 = mine_v2(client2, jid2)
+        res = await asyncio.wait_for(
+            client2.submit(jid2, nonce2, j2.ntime, j2.version), 5)
+        assert isinstance(res, v2.SubmitSharesSuccess)
+        assert server.stats["shares_accepted"] == len(accepted) == 1
+        await client2.close()
+    finally:
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_sv2_handshake_failures_counted_and_rate_limited():
+    """Noise-enabled server: junk bytes on the wire fail the handshake;
+    the failure lands in the stats snapshot (satellite: previously an
+    invisible debug log) and warnings are rate-limited, not per-probe."""
+    import logging
+
+    from otedama_tpu.stratum import v2
+
+    server = v2.Sv2MiningServer(v2.Sv2ServerConfig(
+        port=0, noise=True, handshake_timeout=0.5))
+    await server.start()
+    try:
+        records: list[logging.LogRecord] = []
+
+        class Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record)
+
+        cap = Capture()
+        logging.getLogger("otedama.stratum.v2").addHandler(cap)
+        try:
+            for _ in range(3):
+                _, w = await asyncio.open_connection("127.0.0.1", server.port)
+                w.write(b"\x00" * 8)  # nothing like a noise act-one
+                await w.drain()
+                w.close()
+            t0 = time.monotonic()
+            while server.stats["handshake_failures"] < 3:
+                await asyncio.sleep(0.05)
+                assert time.monotonic() - t0 < 5.0, server.stats
+        finally:
+            logging.getLogger("otedama.stratum.v2").removeHandler(cap)
+        warnings = [r for r in records if r.levelno == logging.WARNING
+                    and "handshake" in r.getMessage()]
+        assert 1 <= len(warnings) < 3, "warnings must be rate-limited"
+        assert "handshake_failures" in server.snapshot()
+    finally:
+        await server.stop()
+
+
+# -- block submitter faults ---------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_chaos_block_submitter_retries_through_faults():
+    """Injected RPC failures take the submitter's real retry path: the
+    block lands on the chain on the attempt after the faults exhaust,
+    and is recorded exactly once."""
+    from otedama_tpu.db.database import Database
+    from otedama_tpu.db.repos import BlockRepository
+    from otedama_tpu.pool.blockchain import MockChainClient
+    from otedama_tpu.pool.submitter import BlockSubmitter, SubmitterConfig
+
+    chain = MockChainClient(nbits=0x207FFFFF)
+    db = Database(":memory:")
+    submitter = BlockSubmitter(chain, BlockRepository(db),
+                               SubmitterConfig(max_retries=3,
+                                               retry_delay=0.01))
+    # mine an easy regtest block header
+    header = None
+    base = make_job("blk")
+    prefix = jobmod.build_header_prefix(
+        dataclasses.replace(base, extranonce1=b"\x00" * 4), b"\x00" * 4)
+    net_target = tgt.bits_to_target(chain.nbits)
+    for nonce in range(1 << 20):
+        h = prefix + struct.pack(">I", nonce)
+        if tgt.hash_meets_target(sha256d(h), net_target):
+            header = h
+            break
+    assert header is not None
+
+    inj = faults.FaultInjector(21).error("pool.submitter.submit",
+                                         exc=ConnectionError, max_fires=2)
+    with faults.active(inj):
+        outcome = await submitter.submit(header, "w.blk", reward=50)
+    assert outcome.accepted, outcome.reason
+    assert len(chain.submitted) == 1
+    assert inj.rules[0].fires == 2
+    rows = db.query("SELECT COUNT(*) AS c FROM blocks")[0]["c"]
+    assert rows == 1
+    db.close()
+
+
+# -- satellite hardening ------------------------------------------------------
+
+def test_keyfiles_force_path_is_atomic_and_0600(tmp_path):
+    """write_hex_file(force=True, secret=True) must never expose a
+    world-readable or half-written window: temp file is 0600+O_EXCL,
+    os.replace swaps it in, and no temp residue survives."""
+    from otedama_tpu.utils.keyfiles import read_hex_file, write_hex_file
+
+    path = tmp_path / "authority.key"
+    write_hex_file(path, b"\x01" * 32, secret=True)
+    os.chmod(path, 0o644)  # sabotage: an old world-readable key file
+    write_hex_file(path, b"\x02" * 32, secret=True, force=True)
+    assert read_hex_file(path, 32, "key") == b"\x02" * 32
+    assert stat.S_IMODE(os.stat(path).st_mode) == 0o600, \
+        "force path must not inherit the old file's mode"
+    assert not [p for p in os.listdir(tmp_path) if ".tmp." in p], \
+        "temp residue left behind"
+    # non-secret force keeps 0644 semantics and replaces content
+    pub = tmp_path / "authority.pub"
+    write_hex_file(pub, b"\x03" * 32)
+    write_hex_file(pub, b"\x04" * 32, force=True)
+    assert read_hex_file(pub, 32, "pub") == b"\x04" * 32
+    # refusal without force still holds
+    with pytest.raises(FileExistsError):
+        write_hex_file(path, b"\x05" * 32, secret=True)
+
+
+def test_pow_host_epoch_cache_locked_and_donated():
+    """_ETHASH_CACHES is lock-guarded and accepts donated real-chain
+    caches (EthashManagedBackend hands over the epoch cache it already
+    built) while refusing miniature test sizings."""
+    import numpy as np
+
+    from otedama_tpu.kernels import ethash as eth
+    from otedama_tpu.utils import pow_host
+
+    # a miniature sizing must be refused (wrong for the real epoch)
+    tiny = np.zeros((3, eth.HASH_BYTES // 4), dtype=np.uint32)
+    assert pow_host.register_epoch_cache(0, 12345, tiny) is False
+
+    # cache builds are single-flight and OUTSIDE the lock: concurrent
+    # validators of one epoch trigger exactly one build, and none of
+    # them holds the registry lock while it runs
+    import threading
+
+    builds: list[int] = []
+
+    real_make_cache = eth.make_cache
+
+    def fake_make_cache(size, seed):
+        builds.append(size)
+        time.sleep(0.05)
+        return "CACHE"
+
+    eth.make_cache = fake_make_cache
+    epoch = 7
+    results: list = []
+    try:
+        threads = [threading.Thread(
+            target=lambda: results.append(pow_host._epoch_cache(epoch)))
+            for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(builds) == 1, "duplicate concurrent epoch build"
+        assert all(r == results[0] for r in results) and len(results) == 4
+        assert results[0][1] == "CACHE"
+        assert not pow_host._ETHASH_BUILDING
+    finally:
+        eth.make_cache = real_make_cache
+        with pow_host._ETHASH_LOCK:
+            pow_host._ETHASH_CACHES.pop(epoch, None)
+
+    # a correctly-sized donation is adopted and then reused as-is (the
+    # registry checks sizing only, so a zeros stand-in keeps this cheap)
+    bn = 0
+    rows = eth.cache_size(bn) // eth.HASH_BYTES
+    cache = np.zeros((rows, eth.HASH_BYTES // 4), dtype=np.uint32)
+    try:
+        assert pow_host.register_epoch_cache(
+            0, eth.dataset_size(bn), cache) is True
+        with pow_host._ETHASH_LOCK:
+            assert pow_host._ETHASH_CACHES[0][1] is cache
+        # a second donation for the same epoch does not clobber the first
+        other = np.zeros_like(cache)
+        pow_host.register_epoch_cache(0, eth.dataset_size(bn), other)
+        with pow_host._ETHASH_LOCK:
+            assert pow_host._ETHASH_CACHES[0][1] is cache
+    finally:
+        with pow_host._ETHASH_LOCK:
+            pow_host._ETHASH_CACHES.pop(0, None)

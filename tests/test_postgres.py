@@ -260,6 +260,47 @@ def test_pgwire_against_wire_emulator():
         assert emu.queries >= 5  # the wire really carried the SQL
 
 
+def test_pgwire_parses_parameter_status_and_refuses_scs_off():
+    """The driver's literal escaping is only complete under
+    standard_conforming_strings=on: the startup must PARSE
+    ParameterStatus and refuse to operate when a server reports it off
+    (the injection hole the quote-doubling escape would otherwise open).
+    Servers that report it on (or not at all — pre-9.1 silence) work."""
+    from otedama_tpu.db import pgwire
+    from tests.pg_emulator import PgEmulator
+
+    with PgEmulator(parameters={
+            "standard_conforming_strings": "on", "TimeZone": "UTC"}) as emu:
+        conn = pgwire.connect(emu.dsn)
+        try:
+            # reported parameters are retained, not skipped
+            assert conn.parameters["standard_conforming_strings"] == "on"
+            assert conn.parameters["TimeZone"] == "UTC"
+            assert "server_version" in conn.parameters
+        finally:
+            conn.close()
+
+    with PgEmulator(parameters={
+            "standard_conforming_strings": "off"}) as emu:
+        with pytest.raises(pgwire.OperationalError,
+                           match="standard_conforming_strings"):
+            pgwire.connect(emu.dsn)
+
+    # the refusal is sticky and PRE-SEND: a mid-session flip to off (a
+    # SET reported via ParameterStatus) must stop the NEXT query before
+    # a single unsafely-escaped byte ships to the server
+    with PgEmulator() as emu:
+        conn = pgwire.connect(emu.dsn)
+        try:
+            conn.parameters["standard_conforming_strings"] = "off"
+            with pytest.raises(pgwire.OperationalError,
+                               match="standard_conforming_strings"):
+                conn.cursor().execute("SELECT 1")
+            assert emu.queries == 0, "refused query still hit the wire"
+        finally:
+            conn.close()
+
+
 def test_postgres_tier_live_on_emulator(monkeypatch):
     """The FULL Postgres tier — migrations under the advisory lock,
     RETURNING-id plumbing, paramstyle interpolation, repositories,
