@@ -407,3 +407,22 @@ class ApiServer:
             client.latency_count,
             help_="Share submit->verdict latency",
         )
+
+    def sync_pool_server_metrics(self, server=None, server_v2=None) -> None:
+        """Export the POOL-side share-accept latency SLO histograms
+        (submit-received -> verdict-written, per protocol). The client
+        histogram above measures the wire-inclusive half from a miner's
+        seat; these measure what the servers themselves owe the <50 ms
+        target at four-digit connection counts."""
+        for protocol, srv in (("v1", server), ("v2", server_v2)):
+            hist = getattr(srv, "latency", None)
+            if hist is None or hist.count <= 0:
+                continue
+            self.registry.histogram_set(
+                "otedama_pool_share_latency_seconds",
+                hist.cumulative(),
+                hist.sum,
+                hist.count,
+                labels={"protocol": protocol},
+                help_="Pool share submit-received->verdict-written latency",
+            )

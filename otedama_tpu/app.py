@@ -606,6 +606,8 @@ class Application:
             }
             if chains:
                 self.api.sync_rpc_pool_metrics(chains)
+            if self.server is not None or self.server_v2 is not None:
+                self.api.sync_pool_server_metrics(self.server, self.server_v2)
             if self.engine is not None:
                 snap = self.engine.snapshot()
                 self.api.sync_engine_metrics(snap)

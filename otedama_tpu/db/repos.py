@@ -51,6 +51,24 @@ class WorkerRepository:
             "UPDATE workers SET balance = balance + ? WHERE name=?", (amount, name)
         )
 
+    def upsert_many(self, names: list[str]) -> None:
+        """Batch upsert (block distribution touches every worker in the
+        payout window: one executemany, not N round-trips)."""
+        now = time.time()
+        self.db.executemany(
+            """INSERT INTO workers (name, wallet, created_at, last_seen, metadata)
+               VALUES (?,?,?,?,?)
+               ON CONFLICT(name) DO UPDATE SET last_seen = excluded.last_seen""",
+            [(name, "", now, now, "{}") for name in names],
+        )
+
+    def credit_many(self, pairs: list[tuple[str, int]]) -> None:
+        """Batch credit: (name, amount) rows in one statement."""
+        self.db.executemany(
+            "UPDATE workers SET balance = balance + ? WHERE name=?",
+            [(amount, name) for name, amount in pairs],
+        )
+
     def debit_for_payout(self, name: str, amount: int) -> None:
         self.db.execute(
             "UPDATE workers SET balance = balance - ?, paid_total = paid_total + ? WHERE name=?",

@@ -22,7 +22,7 @@ import struct
 
 from otedama_tpu.engine.types import Job
 from otedama_tpu.runtime.search import JobConstants
-from otedama_tpu.utils.sha256_host import sha256d
+from otedama_tpu.utils.sha256_host import Sha256Midstate, sha256d
 
 
 def decode_prevhash(hex_str: str) -> bytes:
@@ -80,6 +80,69 @@ def job_constants(job: Job, extranonce2: bytes, ntime: int | None = None) -> Job
 
 def header_from_share(job: Job, extranonce2: bytes, ntime: int, nonce_word: int) -> bytes:
     """Reconstruct the full 80-byte header a share claims to have hashed —
-    the validation path (pool side) re-derives everything from job data."""
+    the validation path (pool side) re-derives everything from job data.
+
+    One-shot form; the stratum servers' per-submit hot path goes through
+    ``ShareAssembler`` instead (same bytes, amortized precompute)."""
     prefix = build_header_prefix(job, extranonce2, ntime)
     return prefix + struct.pack(">I", nonce_word)
+
+
+class ShareAssembler:
+    """Per-(job, extranonce1) precompute for the share-validation hot path.
+
+    ``header_from_share`` rebuilds everything per submit: concatenate the
+    coinbase, hash all of it, fold the branch, re-pack four constant
+    header fields. At four-digit connection counts that work is pure
+    waste — per (job, session) only extranonce2/ntime/nonce vary. This
+    assembler freezes the rest once:
+
+    - the sha256 midstate over ``coinb1 || extranonce1``
+      (``utils.sha256_host.Sha256Midstate``) so each share's coinbase
+      txid costs one resumed hash of ``extranonce2 || coinb2``;
+    - the packed ``version || prev_hash`` head and ``nbits`` tail bytes.
+
+    ``header()`` is bit-identical to ``header_from_share`` on a job
+    carrying the same extranonce fields — tests pin the equivalence for
+    every registered algorithm (a cached path that drifts from the
+    validator would corrupt share accounting silently).
+    """
+
+    __slots__ = ("extranonce2_size", "algorithm", "block_number",
+                 "_cb_mid", "_coinb2", "_branch", "_head", "_nbits")
+
+    def __init__(self, job: Job, extranonce1: bytes | None = None,
+                 extranonce2_size: int | None = None):
+        en1 = job.extranonce1 if extranonce1 is None else extranonce1
+        self.extranonce2_size = (
+            job.extranonce2_size if extranonce2_size is None
+            else extranonce2_size
+        )
+        self.algorithm = job.algorithm
+        self.block_number = job.block_number
+        self._cb_mid = Sha256Midstate(job.coinb1 + en1)
+        self._coinb2 = job.coinb2
+        self._branch = list(job.merkle_branch)
+        self._head = struct.pack("<I", job.version) + job.prev_hash
+        self._nbits = struct.pack("<I", job.nbits)
+
+    def merkle_root(self, extranonce2: bytes) -> bytes:
+        if len(extranonce2) != self.extranonce2_size:
+            raise ValueError(
+                f"extranonce2 must be {self.extranonce2_size} bytes, "
+                f"got {len(extranonce2)}"
+            )
+        acc = self._cb_mid.sha256d_suffix(extranonce2 + self._coinb2)
+        for node in self._branch:
+            acc = sha256d(acc + node)
+        return acc
+
+    def header(self, extranonce2: bytes, ntime: int, nonce_word: int) -> bytes:
+        """The same 80 bytes ``header_from_share`` would build."""
+        return (
+            self._head
+            + self.merkle_root(extranonce2)
+            + struct.pack("<I", ntime)
+            + self._nbits
+            + struct.pack(">I", nonce_word)
+        )

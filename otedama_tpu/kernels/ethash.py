@@ -31,6 +31,8 @@ offline ethash test vector is available — the algorithm registers
 
 from __future__ import annotations
 
+from otedama_tpu.utils import jaxcompat
+
 import functools
 
 import numpy as np
@@ -429,7 +431,7 @@ def hashimoto_light_device(
     import jax
     import jax.numpy as jnp
 
-    with jax.enable_x64():
+    with jaxcompat.enable_x64():
         rows = cache.shape[0]
         # jnp.asarray is a no-op when the caller already holds a device
         # array (EthashLightBackend keeps the epoch cache HBM-resident);
@@ -482,7 +484,7 @@ def build_dataset_device(
     n_chunks = -(-n_items // item_chunk)
     cache_d = jnp.asarray(cache)
 
-    with jax.enable_x64():
+    with jaxcompat.enable_x64():
         @jax.jit
         def build():
             def step(_, c):
@@ -514,7 +516,7 @@ def hashimoto_full_device(
     import jax
     import jax.numpy as jnp
 
-    with jax.enable_x64():
+    with jaxcompat.enable_x64():
         pages_d = (dataset_d if dataset_d.shape[-1] == 32
                    else jnp.reshape(dataset_d, (-1, 32)))
         return _hashimoto_device(

@@ -26,6 +26,8 @@ the host ``x11.x11_digest`` oracle (tests/test_x11.py).
 
 from __future__ import annotations
 
+from otedama_tpu.utils import jaxcompat
+
 import functools
 
 import jax
@@ -855,7 +857,7 @@ def x11_digest_device(headers_np: np.ndarray,
     # recompile, not hit the stale None-keyed trace)
     mode = sbox_mode or _default_sbox_mode()
     cnt_variant = cnt_variant or shavite.active_cnt_variant()
-    with jax.enable_x64():
+    with jaxcompat.enable_x64():
         return np.asarray(_jitted_chain(
             jnp.asarray(headers_np, dtype=U8), sbox_mode=mode,
             cnt_variant=cnt_variant,

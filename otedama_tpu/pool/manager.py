@@ -160,9 +160,14 @@ class PoolManager:
             window = self.shares.last_n(self.config.payout.pplns_window)
         result = self.calculator.calculate_block(reward, window, finder=finder)
         with self.db.transaction():
-            for p in result.payouts:
-                self.workers.upsert(p.worker)
-                self.workers.credit(p.worker, p.amount)
+            # batched: a block touches every worker in the payout window,
+            # and this runs on the submit path when a share solves a
+            # block — per-worker statement round-trips here were the
+            # dominant cost of a block under four-digit connection counts
+            self.workers.upsert_many([p.worker for p in result.payouts])
+            self.workers.credit_many(
+                [(p.worker, p.amount) for p in result.payouts]
+            )
         self.db.audit(
             "pool", "distribute_block",
             f"reward={reward} fee={result.pool_fee} workers={len(result.payouts)}",

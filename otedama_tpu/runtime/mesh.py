@@ -30,6 +30,8 @@ per search call and gets one ``SearchResult`` per space back.
 
 from __future__ import annotations
 
+from otedama_tpu.utils import jaxcompat
+
 import dataclasses
 import functools
 
@@ -37,11 +39,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-try:
-    from jax import shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from otedama_tpu.utils.jaxcompat import shard_map
 
 from otedama_tpu.kernels import sha256_jax as sj
 from otedama_tpu.kernels import sha256_pallas as sp
@@ -740,7 +740,7 @@ class X11PodSearch:
         while done < count:
             wbase = (base + done) & 0xFFFFFFFF
             valid = min(window, count - done)
-            with jax.enable_x64():
+            with jaxcompat.enable_x64():
                 out = self._step_for(per_chip)(
                     h76, np.uint32(t0_limb), np.uint32(wbase)
                 )

@@ -20,6 +20,8 @@ big-endian read of header bytes 76:80 (wire bytes = pack(">I", nonce_word)).
 
 from __future__ import annotations
 
+from otedama_tpu.utils import jaxcompat
+
 import dataclasses
 import functools
 import logging
@@ -456,7 +458,7 @@ class X11JaxBackend:
 
             from otedama_tpu.kernels.x11 import shavite
 
-            with jax.enable_x64():
+            with jaxcompat.enable_x64():
                 # resolve the sbox mode AND shavite counter-order OUTSIDE
                 # jit so the compile cache is keyed on the actual values
                 # (see x11_digest_device) — a certification-day variant
@@ -475,7 +477,7 @@ class X11JaxBackend:
         fn = self._compiled()
 
         def digest_batch(headers: np.ndarray) -> np.ndarray:
-            with jax.enable_x64():
+            with jaxcompat.enable_x64():
                 return np.asarray(fn(jnp.asarray(headers)))
 
         return _x11_chunk_search(

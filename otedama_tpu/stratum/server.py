@@ -30,6 +30,7 @@ from otedama_tpu.engine.vardiff import VardiffConfig, VardiffManager
 from otedama_tpu.kernels import target as tgt
 from otedama_tpu.stratum import protocol as sp
 from otedama_tpu.utils import faults
+from otedama_tpu.utils.histogram import LatencyHistogram
 from otedama_tpu.utils.pow_host import (
     SLOW_HOST_ALGOS,
     pow_digest,
@@ -58,6 +59,15 @@ class ServerConfig:
     ddos_enabled: bool = True
     ddos: "DDoSConfig | None" = None     # None = DDoSConfig() defaults
     max_line_bytes: int = 16 * 1024      # one JSON-RPC line cap
+    # write-path backpressure: replies are written without awaiting the
+    # transport per message; a drain is awaited only once the session's
+    # write buffer passes ``drain_high_water`` (coalescing flushes so a
+    # slow reader costs ITS handler a wait, not a syscall-per-reply
+    # everywhere), and a session whose buffer exceeds
+    # ``max_write_backlog`` is cut outright — a stalled miner must not
+    # grow process memory with queued notifies
+    drain_high_water: int = 64 * 1024
+    max_write_backlog: int = 1 << 20
 
 
 @dataclasses.dataclass
@@ -82,6 +92,34 @@ ShareHook = Callable[[AcceptedShare], Awaitable[None]]
 BlockHook = Callable[[bytes, Job, AcceptedShare], Awaitable[None]]
 
 
+async def drain_if_backed_up(writer: asyncio.StreamWriter,
+                             high_water: int) -> None:
+    """Coalesced drain: await the transport only past the high-water
+    mark, so a per-reply drain (a scheduling point per message, and a
+    stall whenever one peer's TCP window closes) becomes a rare flush
+    on the connections that actually back up. Shared by the V1 and V2
+    servers — ONE statement of the write-backpressure policy."""
+    if writer.is_closing():
+        return
+    transport = writer.transport
+    if (transport is not None
+            and transport.get_write_buffer_size() > high_water):
+        await writer.drain()
+
+
+@dataclasses.dataclass
+class _JobCache:
+    """Per-job constants the submit/broadcast hot paths would otherwise
+    re-derive per share / per session: the decoded network target and
+    the encoded ``mining.notify`` line (the broadcast fans the SAME
+    bytes to every session; per-session JSON encoding at four-digit
+    connection counts was measurable serialization on the event loop)."""
+
+    network_target: int
+    notify_line: bytes        # as broadcast by set_job (its clean flag)
+    notify_clean_line: bytes  # clean=True variant for fresh subscribers
+
+
 @dataclasses.dataclass
 class Session:
     id: int
@@ -94,10 +132,28 @@ class Session:
     worker_user: str = ""
     difficulty: float = 1.0
     prev_difficulty: float | None = None
+    # share targets derived from the difficulties above, cached so the
+    # submit path never recomputes ``difficulty_to_target`` per share;
+    # ``_send_difficulty`` is the single invalidation point
+    target: int = dataclasses.field(
+        default_factory=lambda: tgt.difficulty_to_target(1.0)
+    )
+    prev_target: int | None = None
     connected_at: float = dataclasses.field(default_factory=time.time)
     shares_valid: int = 0
     shares_invalid: int = 0
     seen: set[tuple[str, bytes, int, int]] = dataclasses.field(default_factory=set)
+    # job_id -> ShareAssembler: per-(job, extranonce1) header precompute
+    # (midstate over the coinbase prefix); pruned with the job set
+    assemblers: dict[str, jobmod.ShareAssembler] = dataclasses.field(
+        default_factory=dict
+    )
+    # precomputed faults.hit tag: the disabled-path contract is one load
+    # plus a None check, not a str() per read/write (client parity)
+    fault_tag: str = ""
+
+    def __post_init__(self):
+        self.fault_tag = str(self.id)
 
     @property
     def vardiff_key(self) -> str:
@@ -121,7 +177,12 @@ class StratumServer:
         )
         self.sessions: dict[int, Session] = {}
         self.jobs: dict[str, Job] = {}
+        self.job_cache: dict[str, _JobCache] = {}
         self.current_job: Job | None = None
+        # share-accept latency: submit-received -> verdict-written (the
+        # pool-side half of the reference's <50 ms target; the client
+        # exports the wire-inclusive half)
+        self.latency = LatencyHistogram()
         self.stats = {
             "connections_total": 0,
             "shares_total": 0,
@@ -129,6 +190,7 @@ class StratumServer:
             "shares_invalid": 0,
             "blocks_found": 0,
             "share_hook_failures": 0,
+            "backlog_disconnects": 0,
         }
         self._server: asyncio.AbstractServer | None = None
         self._next_session = 1
@@ -165,14 +227,26 @@ class StratumServer:
     # -- jobs ---------------------------------------------------------------
 
     def set_job(self, job: Job, clean: bool = True) -> None:
-        """Register a job and broadcast it to all subscribed sessions."""
+        """Register a job and broadcast it to all subscribed sessions.
+
+        The notify line is encoded ONCE and the same bytes fan out to
+        every session (per-session ``sp.encode_line`` of an identical
+        payload was pure event-loop serialization at scale); the decoded
+        network target is cached alongside for the submit path."""
         self.jobs[job.job_id] = job
+        line = sp.encode_line(sp.Message(
+            method="mining.notify", params=sp.notify_params(job, clean)
+        ))
+        clean_line = line if clean else sp.encode_line(sp.Message(
+            method="mining.notify", params=sp.notify_params(job, True)
+        ))
+        self.job_cache[job.job_id] = _JobCache(
+            network_target=tgt.bits_to_target(job.nbits),
+            notify_line=line,
+            notify_clean_line=clean_line,
+        )
         self.current_job = job
         self._expire_jobs()
-        notify = sp.Message(
-            method="mining.notify", params=sp.notify_params(job, clean)
-        )
-        line = sp.encode_line(notify)
         for s in self.sessions.values():
             if s.subscribed:
                 self._write_line(s, line)
@@ -180,8 +254,28 @@ class StratumServer:
 
     def _expire_jobs(self) -> None:
         cutoff = time.time() - 2 * self.config.job_max_age
-        for jid in [j for j, job in self.jobs.items() if job.received_at < cutoff]:
+        evicted = [
+            j for j, job in self.jobs.items() if job.received_at < cutoff
+        ]
+        for jid in evicted:
             del self.jobs[jid]
+            self.job_cache.pop(jid, None)
+        if evicted:
+            # per-session state keyed by job id follows the job set out:
+            # ``seen`` (duplicate window) previously grew without bound
+            # over a long-lived session, and the assembler cache would
+            # pin dead jobs' midstates
+            # safe to iterate: per-session caches are mutated on the
+            # event loop only (_prepare/_judge) — the slow-algo executor
+            # computes pure digests and never touches session state
+            live = self.jobs
+            for s in self.sessions.values():
+                if s.seen:
+                    s.seen.difference_update(
+                        [k for k in s.seen if k[0] not in live]
+                    )
+                for jid in [j for j in s.assemblers if j not in live]:
+                    del s.assemblers[jid]
 
     # -- connection handling ------------------------------------------------
 
@@ -228,7 +322,7 @@ class StratumServer:
         log.info("client %d connected from %s", session.id, session.peer)
         try:
             while True:
-                d = faults.hit("stratum.server.read", str(session.id),
+                d = faults.hit("stratum.server.read", session.fault_tag,
                                 faults.POINT)
                 if d is not None and d.delay:
                     await asyncio.sleep(d.delay)
@@ -282,13 +376,24 @@ class StratumServer:
 
     async def _handle_message(self, session: Session, msg: sp.Message) -> None:
         method = msg.method or ""
+        if method == "mining.submit":
+            # share-accept latency SLO: submit-received -> verdict-written.
+            # _on_submit observes t0 at each verdict-write site, so block
+            # hooks / vardiff traffic AFTER the verdict stay out of the
+            # distribution (they delay the NEXT share, which the next
+            # measurement then shows)
+            t0 = time.monotonic()
+            try:
+                await self._on_submit(session, msg, t0)
+            except sp.StratumError as e:
+                await self._reply_error(session, msg.id, e)
+                self.latency.observe(time.monotonic() - t0)
+            return
         try:
             if method == "mining.subscribe":
                 await self._on_subscribe(session, msg)
             elif method == "mining.authorize":
                 await self._on_authorize(session, msg)
-            elif method == "mining.submit":
-                await self._on_submit(session, msg)
             elif method == "mining.get_transactions":
                 await self._reply(session, msg.id, [])
             elif method == "mining.extranonce.subscribe":
@@ -307,7 +412,7 @@ class StratumServer:
         stratum.server.write): drop swallows the line, truncate writes a
         partial line and cuts the socket — the miner-side read loop must
         survive both."""
-        d = faults.hit("stratum.server.write", str(session.id),
+        d = faults.hit("stratum.server.write", session.fault_tag,
                        faults.SEND_SYNC)
         if d is not None:
             if d.drop:
@@ -317,24 +422,42 @@ class StratumServer:
                 session.writer.close()
                 return
         session.writer.write(line)
+        transport = session.writer.transport
+        if (transport is not None
+                and transport.get_write_buffer_size()
+                > self.config.max_write_backlog):
+            # a peer that stopped reading must not buffer unbounded job
+            # broadcasts in process memory: abort (close would keep the
+            # backlog resident until "sent"), read loop reaps the session
+            self.stats["backlog_disconnects"] += 1
+            log.warning(
+                "client %d cut: write backlog %d over cap",
+                session.id, transport.get_write_buffer_size(),
+            )
+            transport.abort()
+
+    async def _maybe_drain(self, session: Session) -> None:
+        await drain_if_backed_up(session.writer, self.config.drain_high_water)
 
     async def _reply(self, session: Session, msg_id, result) -> None:
         self._write_line(session, sp.encode_line(sp.Message(id=msg_id, result=result)))
-        await session.writer.drain()
+        await self._maybe_drain(session)
 
     async def _reply_error(self, session: Session, msg_id, err: sp.StratumError) -> None:
         self._write_line(
             session,
             sp.encode_line(sp.Message(id=msg_id, result=None, error=err.as_triple())),
         )
-        await session.writer.drain()
+        await self._maybe_drain(session)
 
     def _send_notification(self, session: Session, method: str, params: list) -> None:
         self._write_line(session, sp.encode_line(sp.Message(method=method, params=params)))
 
     def _send_difficulty(self, session: Session, difficulty: float) -> None:
         session.prev_difficulty = session.difficulty
+        session.prev_target = session.target
         session.difficulty = difficulty
+        session.target = tgt.difficulty_to_target(difficulty)
         self._send_notification(session, "mining.set_difficulty", [difficulty])
 
     async def _on_subscribe(self, session: Session, msg: sp.Message) -> None:
@@ -350,11 +473,20 @@ class StratumServer:
         await self._reply(session, msg.id, result)
         self._send_difficulty(session, self.config.initial_difficulty)
         session.prev_difficulty = None
+        session.prev_target = None
         if self.current_job is not None:
-            self._send_notification(
-                session, "mining.notify", sp.notify_params(self.current_job, True)
-            )
-        await session.writer.drain()
+            # the cached clean=True notify bytes — same line every fresh
+            # subscriber gets (job_cache is written by set_job, so a
+            # current_job always has an entry)
+            cache = self.job_cache.get(self.current_job.job_id)
+            if cache is not None:
+                self._write_line(session, cache.notify_clean_line)
+            else:
+                self._send_notification(
+                    session, "mining.notify",
+                    sp.notify_params(self.current_job, True),
+                )
+        await self._maybe_drain(session)
 
     async def _on_authorize(self, session: Session, msg: sp.Message) -> None:
         from otedama_tpu.security import validation as val
@@ -372,25 +504,36 @@ class StratumServer:
 
     # -- share validation (the real thing) ----------------------------------
 
-    async def _on_submit(self, session: Session, msg: sp.Message) -> None:
+    async def _on_submit(self, session: Session, msg: sp.Message,
+                         t0: float | None = None) -> None:
+        if t0 is None:
+            t0 = time.monotonic()
         if not session.authorized:
             raise sp.StratumError(sp.ERR_UNAUTHORIZED, "not authorized")
         sub = sp.ShareSubmission.from_params(msg.params or [])
         self.stats["shares_total"] += 1
-        job = self.jobs.get(sub.job_id)
-        if job is not None and job.algorithm in SLOW_HOST_ALGOS:
-            # scrypt/x11/ethash host validation is real CPU work (the
-            # first ethash share of an epoch builds a whole cache): off
-            # the event loop, or one share stalls every connected miner.
-            # On a DEDICATED pool — the default executor carries engine
-            # backend dispatches, and blocked validations there would
-            # starve mining. Safe because each session's messages are
-            # handled serially.
-            outcome, accepted = await asyncio.get_running_loop().run_in_executor(
-                validation_executor(), self._validate, session, sub
-            )
+        reject, job, header = self._prepare(session, sub)
+        if reject is not None:
+            outcome, accepted = reject, None
         else:
-            outcome, accepted = self._validate(session, sub)
+            if job.algorithm in SLOW_HOST_ALGOS:
+                # scrypt/x11/ethash host digests are real CPU work (the
+                # first ethash share of an epoch builds a whole cache):
+                # off the event loop, or one share stalls every connected
+                # miner. Only the PURE digest goes to the thread — all
+                # session-state mutation stays on the loop, so the
+                # executor never races set_job's cache pruning. On a
+                # DEDICATED pool: the default executor carries engine
+                # backend dispatches, and blocked validations there
+                # would starve mining.
+                digest = await asyncio.get_running_loop().run_in_executor(
+                    validation_executor(), pow_digest, header,
+                    job.algorithm, job.block_number,
+                )
+            else:
+                digest = pow_digest(header, job.algorithm,
+                                    block_number=job.block_number)
+            outcome, accepted = self._judge(session, sub, job, header, digest)
         if outcome in (ShareOutcome.ACCEPTED, ShareOutcome.BLOCK_FOUND):
             # persist BEFORE the accept verdict: every accept a miner ever
             # sees must be durable exactly once, so a failing share hook
@@ -404,7 +547,7 @@ class StratumServer:
                     # un-remember the share: it was never credited, so a
                     # resubmit after accounting recovers must be able to
                     # land, not die as a phantom duplicate (fields from
-                    # the SAME AcceptedShare _validate keyed on, so the
+                    # the SAME AcceptedShare _judge keyed on, so the
                     # two sites cannot drift apart)
                     session.seen.discard(
                         (accepted.job_id, accepted.extranonce2,
@@ -414,6 +557,7 @@ class StratumServer:
                     self.stats["share_hook_failures"] += 1
                     await self._reply_error(session, msg.id, sp.StratumError(
                         sp.ERR_OTHER, "share accounting unavailable"))
+                    self.latency.observe(time.monotonic() - t0)
                     # a block candidate is still real: chain submission is
                     # independent of share accounting (own retry loop) and
                     # a db hiccup must never cost the block reward
@@ -430,6 +574,7 @@ class StratumServer:
             self.stats["shares_valid"] += 1
             self.vardiff.record_share(session.vardiff_key)
             await self._reply(session, msg.id, True)
+            self.latency.observe(time.monotonic() - t0)
             if accepted is not None and accepted.is_block:
                 self.stats["blocks_found"] += 1
                 if self.on_block is not None and job is not None:
@@ -446,54 +591,74 @@ class StratumServer:
             await self._reply_error(
                 session, msg.id, sp.StratumError(code, outcome.value)
             )
+            self.latency.observe(time.monotonic() - t0)
         new_diff = self.vardiff.maybe_retarget(session.vardiff_key)
         if new_diff is not None and new_diff != session.difficulty:
             self._send_difficulty(session, new_diff)
-            await session.writer.drain()
+            await self._maybe_drain(session)
 
-    def _validate(
+    def _prepare(
         self, session: Session, sub: sp.ShareSubmission
-    ) -> tuple[ShareOutcome, AcceptedShare | None]:
+    ) -> tuple[ShareOutcome | None, Job | None, bytes | None]:
+        """Structural checks + header assembly (EVENT LOOP ONLY — this
+        and _judge are the sole mutators of per-session caches, so the
+        slow-algo executor never touches shared state). Returns
+        (reject_outcome, None, None) or (None, job, header)."""
         job = self.jobs.get(sub.job_id)
         if job is None:
-            return ShareOutcome.REJECTED_BAD_JOB, None
+            return ShareOutcome.REJECTED_BAD_JOB, None, None
         if job.is_expired(self.config.job_max_age):
-            return ShareOutcome.REJECTED_STALE, None
+            return ShareOutcome.REJECTED_STALE, None, None
         if len(sub.extranonce2) != session.extranonce2_size:
-            return ShareOutcome.REJECTED_INVALID, None
+            return ShareOutcome.REJECTED_INVALID, None, None
         if abs(sub.ntime - job.ntime) > self.config.ntime_slack:
-            return ShareOutcome.REJECTED_INVALID, None
+            return ShareOutcome.REJECTED_INVALID, None, None
         key = (sub.job_id, sub.extranonce2, sub.ntime, sub.nonce_word)
         if key in session.seen:
-            return ShareOutcome.REJECTED_DUPLICATE, None
-        session.seen.add(key)
+            return ShareOutcome.REJECTED_DUPLICATE, None, None
 
-        try:
-            header = jobmod.header_from_share(
-                dataclasses.replace(
-                    job,
-                    extranonce1=session.extranonce1,
-                    extranonce2_size=session.extranonce2_size,
-                ),
-                sub.extranonce2, sub.ntime, sub.nonce_word,
+        # per-(job, extranonce1) assembler: coinbase-prefix midstate +
+        # frozen header fields instead of dataclasses.replace + a full
+        # rebuild per submit (bit-identical — tests pin it)
+        asm = session.assemblers.get(sub.job_id)
+        if asm is None:
+            asm = session.assemblers[sub.job_id] = jobmod.ShareAssembler(
+                job, session.extranonce1, session.extranonce2_size
             )
+        try:
+            header = asm.header(sub.extranonce2, sub.ntime, sub.nonce_word)
         except ValueError:
-            return ShareOutcome.REJECTED_INVALID, None
-        digest = pow_digest(header, job.algorithm,
-                            block_number=job.block_number)
-        # credit at the difficulty the session was mining at; allow the
-        # previous difficulty during a retarget window
+            return ShareOutcome.REJECTED_INVALID, None, None
+        return None, job, header
+
+    def _judge(
+        self, session: Session, sub: sp.ShareSubmission, job: Job,
+        header: bytes, digest: bytes
+    ) -> tuple[ShareOutcome, AcceptedShare | None]:
+        """Target comparison + share record (event loop only)."""
+        # credit at the difficulty the session was mining at (cached
+        # target, invalidated by _send_difficulty); allow the previous
+        # difficulty during a retarget window
         credit_diff = session.difficulty
-        share_target = tgt.difficulty_to_target(credit_diff)
-        if not tgt.hash_meets_target(digest, share_target):
-            if session.prev_difficulty is not None and tgt.hash_meets_target(
-                digest, tgt.difficulty_to_target(session.prev_difficulty)
+        if not tgt.hash_meets_target(digest, session.target):
+            if session.prev_target is not None and tgt.hash_meets_target(
+                digest, session.prev_target
             ):
                 credit_diff = session.prev_difficulty
             else:
                 return ShareOutcome.REJECTED_LOW_DIFF, None
+        # remembered only once it VALIDATES (V2 server parity): garbage
+        # submissions must cost the submitter a recompute, not this
+        # process unbounded dedup memory — and a rejected share must
+        # reject the same way twice, not mutate into a "duplicate"
+        session.seen.add(
+            (sub.job_id, sub.extranonce2, sub.ntime, sub.nonce_word)
+        )
 
-        is_block = tgt.hash_meets_target(digest, tgt.bits_to_target(job.nbits))
+        cache = self.job_cache.get(sub.job_id)
+        net_target = (cache.network_target if cache is not None
+                      else tgt.bits_to_target(job.nbits))
+        is_block = tgt.hash_meets_target(digest, net_target)
         accepted = AcceptedShare(
             session_id=session.id,
             worker_user=session.worker_user,
@@ -519,4 +684,5 @@ class StratumServer:
             "sessions": len(self.sessions),
             "jobs_cached": len(self.jobs),
             "current_job": self.current_job.job_id if self.current_job else None,
+            "accept_latency": self.latency.snapshot(),
         }

@@ -51,6 +51,7 @@ from otedama_tpu.stratum import noise
 from otedama_tpu.engine.types import Job
 from otedama_tpu.kernels import target as tgt
 from otedama_tpu.utils import faults
+from otedama_tpu.utils.histogram import LatencyHistogram
 from otedama_tpu.utils.pow_host import (
     SLOW_HOST_ALGOS,
     pow_digest,
@@ -603,6 +604,9 @@ class Sv2ServerConfig:
     # memory: past this transport backlog the channel stops receiving
     # (and a dead TCP peer gets reaped by its read loop)
     max_write_backlog: int = 1 << 20
+    # coalesced drains (V1 server parity): reply frames await the
+    # transport only once the write buffer passes this mark
+    drain_high_water: int = 64 * 1024
     # Noise-NX encrypted transport (stratum/noise.py): when on, every
     # connection must complete the handshake before its first frame.
     # noise_static_key is the pool's long-lived X25519 private key
@@ -626,6 +630,11 @@ class Sv2Channel:
     seen_shares: set = dataclasses.field(default_factory=set)
     accepted: int = 0
     shares_sum: int = 0
+    # sv2 job id -> merkle root for THIS channel's fixed extranonce —
+    # computed once at job delivery (_send_job already derives it for
+    # the NewMiningJob frame); the submit path then assembles headers
+    # with zero hashing. Pruned with the job window in set_job.
+    roots: dict[int, bytes] = dataclasses.field(default_factory=dict)
 
 
 class Sv2MiningServer:
@@ -646,9 +655,14 @@ class Sv2MiningServer:
         self._server: asyncio.AbstractServer | None = None
         self._channels: dict[int, tuple[Sv2Channel, FrameConn]] = {}
         self._conns: set[FrameConn] = set()
-        self._jobs: dict[int, tuple[Job, float]] = {}
+        # sv2 job id -> (job, born, network_target): the decoded nbits
+        # target rides the entry so the submit path never re-derives it
+        self._jobs: dict[int, tuple[Job, float, int]] = {}
         self._job_seq = 0
         self._chan_seq = 0
+        # share-accept latency, submit-received -> verdict-written
+        # (same histogram shape as the V1 server / stratum client)
+        self.latency = LatencyHistogram()
         self.stats = {"connections": 0, "shares_accepted": 0,
                       "shares_rejected": 0, "blocks": 0,
                       "handshake_failures": 0, "share_hook_failures": 0}
@@ -712,14 +726,17 @@ class Sv2MiningServer:
             )
         self._job_seq += 1
         jid = self._job_seq
-        self._jobs[jid] = (job, time.time())
+        self._jobs[jid] = (job, time.time(), tgt.bits_to_target(job.nbits))
         cutoff = time.time() - self.config.job_max_age
         self._jobs = {k: v for k, v in self._jobs.items() if v[1] >= cutoff}
         for chan, conn in list(self._channels.values()):
-            # duplicate window stays bounded: drop keys of pruned jobs
+            # duplicate window and root cache stay bounded: drop keys of
+            # pruned jobs
             chan.seen_shares = {
                 k for k in chan.seen_shares if k[0] in self._jobs
             }
+            for stale in [j for j in chan.roots if j not in self._jobs]:
+                del chan.roots[stale]
             try:
                 self._send_job(chan, conn, jid, job)
             except (ConnectionError, RuntimeError):
@@ -746,6 +763,9 @@ class Sv2MiningServer:
         root = jobmod.merkle_root(
             jobmod.build_coinbase(job, en2), job.merkle_branch
         )
+        # the submit path reuses this root: per (channel, job) the whole
+        # coinbase/merkle derivation happens exactly once — here
+        chan.roots[jid] = root
         self._write(conn, MSG_NEW_MINING_JOB, NewMiningJob(
             channel_id=chan.channel_id, job_id=jid, future_job=False,
             version=job.version, merkle_root=root,
@@ -900,10 +920,19 @@ class Sv2MiningServer:
             self._send_job(chan, conn, max(self._jobs), latest)
         await conn.drain()
 
+    async def _maybe_drain(self, conn: FrameConn) -> None:
+        from otedama_tpu.stratum.server import drain_if_backed_up
+
+        await drain_if_backed_up(conn.writer, self.config.drain_high_water)
+
     async def _on_submit(self, msg: SubmitSharesStandard,
                          conn: FrameConn) -> None:
         from otedama_tpu.stratum.server import AcceptedShare
 
+        # share-accept latency SLO: submit-received -> verdict-written
+        # (observed at each result-frame write, so post-verdict block
+        # hooks stay out of the distribution — V1 server parity)
+        t0 = time.monotonic()
         entry = self._channels.get(msg.channel_id)
 
         async def reject(code: str) -> None:
@@ -912,7 +941,8 @@ class Sv2MiningServer:
                         SubmitSharesError(msg.channel_id,
                                           msg.sequence_number,
                                           code).encode())
-            await conn.drain()
+            await self._maybe_drain(conn)
+            self.latency.observe(time.monotonic() - t0)
 
         if entry is None:
             await reject("invalid-channel-id")
@@ -922,7 +952,7 @@ class Sv2MiningServer:
         if jobent is None:
             await reject("stale-job")
             return
-        job, born = jobent
+        job, born, net_target = jobent
         if time.time() - born > self.config.job_max_age:
             await reject("stale-job")
             return
@@ -939,10 +969,26 @@ class Sv2MiningServer:
             await reject("duplicate-share")
             return
         # exact reconstruction: channel-fixed extranonce2, share-rolled
-        # version word (SV2 version-rolling is first-class)
+        # version word (SV2 version-rolling is first-class). The merkle
+        # root for (channel, job) was computed once at job delivery
+        # (chan.roots); assembly here is pure byte concatenation — the
+        # fallback covers a submit against a job this channel was never
+        # sent (possible only for ids predating the channel)
         en2 = chan.extranonce2
-        header = jobmod.header_from_share(job, en2, msg.ntime, msg.nonce)
-        header = struct.pack("<I", msg.version) + header[4:]
+        root = chan.roots.get(msg.job_id)
+        if root is None:
+            root = jobmod.merkle_root(
+                jobmod.build_coinbase(job, en2), job.merkle_branch
+            )
+            chan.roots[msg.job_id] = root
+        header = (
+            struct.pack("<I", msg.version)
+            + job.prev_hash
+            + root
+            + struct.pack("<I", msg.ntime)
+            + struct.pack("<I", job.nbits)
+            + struct.pack(">I", msg.nonce)
+        )
         if job.algorithm in SLOW_HOST_ALGOS:
             # same discipline as the V1 server: heavyweight host digests
             # (ethash cache builds!) run off the event loop, on the
@@ -961,7 +1007,7 @@ class Sv2MiningServer:
             await reject("difficulty-too-low")
             return
         chan.seen_shares.add(key)
-        is_block = tgt.hash_meets_target(digest, tgt.bits_to_target(job.nbits))
+        is_block = tgt.hash_meets_target(digest, net_target)
         # SAME accounting surface as the V1 server: the pool manager
         # credits shares and submits blocks identically for both wires
         accepted = AcceptedShare(
@@ -1004,11 +1050,9 @@ class Sv2MiningServer:
         chan.accepted += 1
         chan.shares_sum += 1
         self.stats["shares_accepted"] += 1
-        if is_block:
-            self.stats["blocks"] += 1
-            log.info("sv2: BLOCK candidate on channel %d", chan.channel_id)
-            if self.on_block is not None:
-                await self.on_block(header, job, accepted)
+        # verdict first, block hook after (V1 server order): chain
+        # submission has its own retry loop and must not delay the
+        # miner's accept — durability was already settled by on_share
         self._write(conn, MSG_SUBMIT_SHARES_SUCCESS,
                     SubmitSharesSuccess(
                         channel_id=chan.channel_id,
@@ -1016,13 +1060,20 @@ class Sv2MiningServer:
                         new_submits_accepted_count=1,
                         new_shares_sum=chan.shares_sum,
                     ).encode())
-        await conn.drain()
+        await self._maybe_drain(conn)
+        self.latency.observe(time.monotonic() - t0)
+        if is_block:
+            self.stats["blocks"] += 1
+            log.info("sv2: BLOCK candidate on channel %d", chan.channel_id)
+            if self.on_block is not None:
+                await self.on_block(header, job, accepted)
 
     def snapshot(self) -> dict:
         return {
             **self.stats,
             "channels": len(self._channels),
             "jobs": len(self._jobs),
+            "accept_latency": self.latency.snapshot(),
         }
 
 

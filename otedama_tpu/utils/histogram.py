@@ -1,0 +1,79 @@
+"""Cumulative-bucket latency histogram for hot paths.
+
+One shape shared by everything that measures a latency distribution:
+fixed upper bounds, CUMULATIVE per-bucket counts (Prometheus ``le``
+semantics — `api/metrics.py` ``histogram_set`` consumes the dict
+as-is), a running sum/count, and bucket-resolution quantiles. The
+stratum client grew this ad hoc (`stratum/client.py latency_buckets`);
+the pool servers' share-accept SLO histogram uses this class so both
+sides of the wire export the same family shape.
+
+``observe`` is a few adds under a lock — cheap enough for per-share
+use on the event loop. The lock matters because readers (metrics loop,
+bench tools) run on other threads.
+"""
+
+from __future__ import annotations
+
+import threading
+
+# upper bounds (seconds) bracketing the reference's 50 ms share-accept
+# target (README.md:104) — same ladder the stratum client exports
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0
+)
+
+
+class LatencyHistogram:
+    """Thread-safe fixed-bucket histogram with cumulative counts."""
+
+    __slots__ = ("bounds", "_counts", "sum", "count", "_lock")
+
+    def __init__(self, bounds: tuple[float, ...] = DEFAULT_BUCKETS):
+        self.bounds = tuple(sorted(float(b) for b in bounds))
+        self._counts = [0] * len(self.bounds)
+        self.sum = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            self.sum += seconds
+            self.count += 1
+            # cumulative: every bucket whose bound >= value ticks
+            for i in range(len(self.bounds) - 1, -1, -1):
+                if seconds <= self.bounds[i]:
+                    self._counts[i] += 1
+                else:
+                    break
+
+    def cumulative(self) -> dict[float, int]:
+        """bound -> cumulative count (``le`` semantics); +Inf is implied
+        by ``count`` (histogram_set adds it)."""
+        with self._lock:
+            return dict(zip(self.bounds, self._counts))
+
+    def quantile(self, q: float) -> float:
+        """Upper bound of the bucket holding the q-quantile (a
+        conservative estimate: the true quantile is <= the returned
+        bound). +Inf overflow returns float('inf'); empty returns 0."""
+        with self._lock:
+            if self.count == 0:
+                return 0.0
+            rank = q * self.count
+            for bound, cum in zip(self.bounds, self._counts):
+                if cum >= rank:
+                    return bound
+            return float("inf")
+
+    def snapshot(self) -> dict:
+        """Compact form for server ``snapshot()`` surfaces."""
+        with self._lock:
+            count, total = self.count, self.sum
+        return {
+            "count": count,
+            "sum_seconds": round(total, 6),
+            "avg_ms": round(1e3 * total / count, 3) if count else 0.0,
+            "p50_ms": 1e3 * self.quantile(0.5),
+            "p99_ms": 1e3 * self.quantile(0.99),
+        }

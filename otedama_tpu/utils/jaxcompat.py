@@ -1,0 +1,53 @@
+"""Version-bridging shims for the jax API surface.
+
+The codebase targets the modern spelling ``jax.enable_x64()`` (a scoped
+context manager); older trees (e.g. 0.4.x, where the image's jax lives)
+ship it as ``jax.experimental.enable_x64``. One import point here keeps
+every kernel/runtime call site on a single name instead of sprinkling
+getattr probes through the hot modules.
+"""
+
+from __future__ import annotations
+
+
+def enable_x64():
+    """Scoped-x64 context manager under whichever name this jax has."""
+    import jax
+
+    fn = getattr(jax, "enable_x64", None)
+    if fn is not None:
+        return fn()
+    from jax.experimental import enable_x64 as _experimental_enable_x64
+
+    return _experimental_enable_x64()
+
+
+def _resolve_shard_map():
+    import inspect
+
+    try:
+        from jax import shard_map as sm
+    except ImportError:  # older jax keeps it in experimental
+        from jax.experimental.shard_map import shard_map as sm
+    if "check_vma" in inspect.signature(sm).parameters:
+        return sm
+
+    def adapter(f, **kwargs):
+        # the replication check was renamed check_rep -> check_vma; the
+        # codebase writes the modern name, older jax gets it translated
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return sm(f, **kwargs)
+
+    return adapter
+
+
+_shard_map = None  # lazy: this module must stay importable without jax
+
+
+def shard_map(f, **kwargs):
+    """``jax.shard_map`` with the modern keyword surface on any jax."""
+    global _shard_map
+    if _shard_map is None:
+        _shard_map = _resolve_shard_map()
+    return _shard_map(f, **kwargs)

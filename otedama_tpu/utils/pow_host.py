@@ -152,6 +152,10 @@ def pow_digest(header: bytes, algorithm: str = "sha256d",
     """The 32-byte PoW digest a miner's share claims for this header.
     ``block_number`` matters only for DAG-class algorithms (ethash picks
     its epoch from it; height-less callers get epoch 0)."""
+    if algorithm == "sha256d":
+        # the flagship hot path: skip the normalization chain (share
+        # validation calls this once per submit)
+        return sha256d(header)
     algorithm = (algorithm or "sha256d").lower()
     if algorithm in ("sha256d", "sha256double", "bitcoin"):
         return sha256d(header)

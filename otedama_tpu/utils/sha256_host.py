@@ -128,6 +128,42 @@ def sha256d(data: bytes) -> bytes:
     return hashlib.sha256(hashlib.sha256(data).digest()).digest()
 
 
+class Sha256Midstate:
+    """Resumable SHA-256 over a fixed prefix — the VALIDATION-side
+    midstate trick.
+
+    The device midstate above ships an 8-word compression state because
+    kernels need raw words; the pool-side share validator just needs
+    "hash prefix once, finish with a different suffix per share", and
+    OpenSSL already maintains exactly that state (including the
+    partial-block buffer, so the prefix length need not be 64-aligned).
+    ``hashlib``'s C ``copy()`` clones it in a memcpy — bit-identical to
+    ``sha256(prefix + suffix)`` by construction, at ~one compression of
+    cost per share instead of re-hashing the whole coinbase.
+
+    Used per (job, extranonce1) by ``engine.jobs.ShareAssembler``: the
+    coinbase prefix ``coinb1 || extranonce1`` is fixed for a session's
+    whole job lifetime while extranonce2 varies per share.
+    """
+
+    __slots__ = ("_h",)
+
+    def __init__(self, prefix: bytes):
+        self._h = hashlib.sha256(prefix)
+
+    def digest_suffix(self, suffix: bytes) -> bytes:
+        """sha256(prefix || suffix)."""
+        h = self._h.copy()
+        h.update(suffix)
+        return h.digest()
+
+    def sha256d_suffix(self, suffix: bytes) -> bytes:
+        """sha256d(prefix || suffix)."""
+        h = self._h.copy()
+        h.update(suffix)
+        return hashlib.sha256(h.digest()).digest()
+
+
 def sha256d_header(header80: bytes) -> bytes:
     assert len(header80) == 80
     return sha256d(header80)

@@ -6,6 +6,11 @@
 #                   x11 pod; expect many minutes of XLA compile)
 #   all             both
 #   audit           static security self-audit only
+#   stratum-bench   opt-in pool-latency bench: drives the real stratum
+#                   server with STRATUM_BENCH_CONNS (default 1000)
+#                   loopback miners and writes a BENCH_STRATUM json
+#                   artifact. FAILS LOUDLY (exit 2) if the fd limit
+#                   cannot fit the soak — never silently under-tests.
 # Extra args pass through to pytest (e.g. ./run_tests.sh fast -k scrypt).
 set -euo pipefail
 cd "$(dirname "$0")"
@@ -16,5 +21,9 @@ case "$tier" in
   slow)  exec python -m pytest tests/ -q -m slow "$@" ;;
   all)   exec python -m pytest tests/ -q -m '' "$@" ;;
   audit) exec python tools/security_audit.py ;;
-  *) echo "usage: $0 [fast|slow|all|audit] [pytest args...]" >&2; exit 2 ;;
+  stratum-bench)
+    exec env JAX_PLATFORMS=cpu python tools/bench_stratum.py \
+      --connections "${STRATUM_BENCH_CONNS:-1000}" \
+      --out "${STRATUM_BENCH_OUT:-BENCH_STRATUM_manual.json}" "$@" ;;
+  *) echo "usage: $0 [fast|slow|all|audit|stratum-bench] [pytest args...]" >&2; exit 2 ;;
 esac

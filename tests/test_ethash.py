@@ -94,6 +94,7 @@ def test_ethash_registered_but_gated():
     assert not algos.switchable("ethash")
 
 
+@pytest.mark.slow  # minutes of XLA compile on a CPU mesh (jax 0.4.x)
 def test_ethash_backend_finds_planted_winner(tiny_cache):
     """Engine-protocol backend: winners agree with the host oracle and
     carry framework-convention (LE) digests."""
@@ -211,6 +212,7 @@ def _mini_oracle(epoch: int, h76: bytes, nonces) -> dict[int, int]:
     return out
 
 
+@pytest.mark.slow  # minutes of XLA compile on a CPU mesh (jax 0.4.x)
 def test_managed_backend_epoch_lifecycle():
     """EthashManagedBackend follows job block_numbers across an epoch
     boundary without dropping a search: light tier serves immediately,
@@ -276,6 +278,7 @@ def test_managed_backend_epoch_lifecycle():
 
 
 @pytest.mark.asyncio
+@pytest.mark.slow  # minutes of XLA compile on a CPU mesh (jax 0.4.x)
 async def test_engine_mines_ethash_across_epoch_boundary():
     """Pool-template-shaped jobs (block_number carried from the template
     height) drive the engine's managed ethash backend end-to-end across

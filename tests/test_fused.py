@@ -78,6 +78,7 @@ def test_cli_fused_pod_routing(monkeypatch):
     assert cfg3.mining.backend != "fused-pod"
 
 
+@pytest.mark.slow  # minutes of XLA compile on a CPU mesh (jax 0.4.x)
 def test_fused_pod_two_processes():
     port = _free_port()
     env = dict(os.environ)

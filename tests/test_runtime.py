@@ -199,6 +199,7 @@ def test_pallas_interpret_tiny():
     )
 
 
+@pytest.mark.slow  # minutes of XLA compile on a CPU mesh (jax 0.4.x)
 def test_pod_search_matches_single_device():
     import jax
 
@@ -244,6 +245,7 @@ def test_pod_search_small_window_keeps_best_telemetry():
     assert pod.last_pod_best == oracle_best
 
 
+@pytest.mark.slow  # minutes of XLA compile on a CPU mesh (jax 0.4.x)
 def test_pod_search_2d_rows_are_distinct_jobs():
     """2D (host, chip) mesh: each row searches its own extranonce2 header
     (distinct midstates), winners recover per row, ICI telemetry aggregates."""
@@ -286,6 +288,7 @@ def test_pod_search_2d_rows_are_distinct_jobs():
 
 
 @pytest.mark.asyncio
+@pytest.mark.slow  # minutes of XLA compile on a CPU mesh (jax 0.4.x)
 async def test_engine_mines_on_pod_backend():
     """End-to-end: MiningEngine drives the pod backend (2x4 CPU mesh), rolls
     real extranonce2 spaces per host row, and emits exactly the oracle's
@@ -351,6 +354,7 @@ async def test_engine_mines_on_pod_backend():
 
 
 @pytest.mark.asyncio
+@pytest.mark.slow  # minutes of XLA compile on a CPU mesh (jax 0.4.x)
 async def test_engine_pipelines_and_adopts_preferred_batch():
     """VERDICT r2 weak #2: the engine must (a) adopt a backend's
     preferred_batch under auto_batch and (b) keep a second launch in
@@ -455,6 +459,7 @@ async def test_engine_clamps_batch_for_slow_backends():
     assert backend.batches and backend.batches[0] == 512
 
 
+@pytest.mark.slow  # minutes of XLA compile on a CPU mesh (jax 0.4.x)
 def test_scrypt_pod_search_rows_and_winners():
     """Scrypt through the SPMD pod path on the virtual 2x4 mesh: per-row
     extranonce headers, chip-strided nonce ranges, planted winner recovered
@@ -544,6 +549,7 @@ def test_dcn_config_from_env():
             DcnConfig.from_env(bad)
 
 
+@pytest.mark.slow  # minutes of XLA compile on a CPU mesh (jax 0.4.x)
 def test_x11_pod_plumbing_with_injected_chain():
     """X11 pod mechanics (device header assembly, chip striding, top-limb
     prefilter, host oracle verification) with a cheap injected chain —

@@ -195,17 +195,41 @@ async def _vardiff_spammer(host: str, port: int) -> float:
             await f._call(reader, writer, 99,
                           "mining.extranonce.subscribe", [])
             await asyncio.sleep(0.01)
-        for i in range(600):
+        for i in range(120):
             if f.difficulty > EASY:
                 break  # upward retarget arrived — mining at the raised
                 # bar is the real miner's job, not this python loop's
                 # (an early DOWNWARD move can happen while the first
                 # window still contains connection setup time: keep
                 # spamming through it)
-            en2, nonce = _mine_v1(f.job, extranonce1, f.difficulty)
-            await f._call(reader, writer, 100 + i, "mining.submit",
-                          ["w.spam", f.job.job_id, en2.hex(),
-                           f"{f.job.ntime:08x}", f"{nonce:08x}"])
+            # PIPELINED batch: one submit per round-trip caps the
+            # measured rate at 1/RTT, which under churn load on a small
+            # CPU sits below the aggressive vardiff target and retargets
+            # the spammer DOWN instead of up (flaked on exactly that).
+            # A real spamming ASIC has many shares in flight — batch 8
+            # submits, then collect the verdicts.
+            batch = []
+            for k in range(8):
+                en2, nonce = _mine_v1(f.job, extranonce1, f.difficulty)
+                batch.append((100 + 8 * i + k, en2, nonce))
+            for msg_id, en2, nonce in batch:
+                writer.write(sp.encode_line(sp.Message(
+                    id=msg_id, method="mining.submit",
+                    params=["w.spam", f.job.job_id, en2.hex(),
+                            f"{f.job.ntime:08x}", f"{nonce:08x}"])))
+            await writer.drain()
+            for msg_id, _en2, _nonce in batch:
+                while True:
+                    line = await asyncio.wait_for(reader.readline(), 20)
+                    if not line:
+                        raise ConnectionError("server closed")
+                    m = sp.decode_line(line)
+                    if m.method == "mining.set_difficulty":
+                        f.difficulty = float(m.params[0])
+                    elif m.method == "mining.notify":
+                        f.job = sp.job_from_notify(m.params)
+                    if m.is_response and m.id == msg_id:
+                        break
         return f.difficulty
     finally:
         writer.close()
@@ -326,3 +350,203 @@ async def test_pool_soak_under_churn(tmp_path):
     await asyncio.sleep(0.5)
     assert len(asyncio.all_tasks()) <= tasks_before + 2
     assert _fd_count() <= fds_before + 4, (fds_before, _fd_count())
+
+
+# -- four-digit connection latency SLO (ISSUE 2 tentpole) --------------------
+
+SOAK_CONNECTIONS = 1200
+SOAK_SHARES_PER_CONN = 2
+
+
+def _require_fd_budget(connections: int) -> None:
+    """Raise RLIMIT_NOFILE for the soak; FAIL (never skip) when the
+    budget can't fit — a silently skipped scale test is how the 10k/<50ms
+    claim rotted in the reference."""
+    import resource
+
+    need = 2 * connections + 256
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft < need:
+        try:
+            resource.setrlimit(
+                resource.RLIMIT_NOFILE, (min(need, hard), hard))
+        except (ValueError, OSError):
+            pass
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft < need:
+        pytest.fail(
+            f"fd limit too low for the {connections}-connection soak: "
+            f"need {need}, soft={soft} hard={hard}. Raise ulimit -n; "
+            "this tier fails loudly instead of silently under-testing."
+        )
+
+
+class _SloMiner:
+    """Steady-state miner for the latency soak: subscribe once, submit
+    pre-mined valid shares with jittered pacing, account every verdict."""
+
+    def __init__(self, ident: int, port: int):
+        self.ident = ident
+        self.port = port
+        self.reader = None
+        self.writer = None
+        self.extranonce1 = b""
+        self.job = None
+        self.accepted = 0
+        self.rejected = 0
+
+    async def _call(self, msg_id, method, params):
+        self.writer.write(sp.encode_line(
+            sp.Message(id=msg_id, method=method, params=params)))
+        await self.writer.drain()
+        while True:
+            line = await asyncio.wait_for(self.reader.readline(), 30)
+            if not line:
+                raise ConnectionError("server closed")
+            m = sp.decode_line(line)
+            if m.is_response and m.id == msg_id:
+                return m
+            if m.method == "mining.notify" and self.job is None:
+                self.job = sp.job_from_notify(m.params)
+
+    async def connect(self) -> None:
+        self.reader, self.writer = await asyncio.open_connection(
+            "127.0.0.1", self.port)
+        sub = await self._call(1, "mining.subscribe", [f"slo-{self.ident}"])
+        self.extranonce1 = bytes.fromhex(sub.result[1])
+        await self._call(2, "mining.authorize", [f"w.{self.ident}", "x"])
+        for _ in range(200):
+            if self.job is not None:
+                return
+            await self._call(99, "mining.extranonce.subscribe", [])
+            await asyncio.sleep(0.01)
+        raise AssertionError("no mining.notify")
+
+    def premine(self, difficulty: float) -> list[tuple[bytes, int]]:
+        target = tgt.difficulty_to_target(difficulty)
+        job = dataclasses.replace(self.job, extranonce1=self.extranonce1)
+        out = []
+        for i in range(SOAK_SHARES_PER_CONN):
+            en2 = struct.pack(">I", (self.ident << 8) | i)
+            prefix = jobmod.build_header_prefix(job, en2)
+            for nonce in range(1 << 20):
+                if tgt.hash_meets_target(
+                        sha256d(prefix + struct.pack(">I", nonce)), target):
+                    out.append((en2, nonce))
+                    break
+        return out
+
+    async def submit_all(self, shares, window: float,
+                         rng: random.Random) -> None:
+        for i, (en2, nonce) in enumerate(shares):
+            await asyncio.sleep(rng.random() * window / len(shares))
+            m = await self._call(
+                10 + i, "mining.submit",
+                [f"w.{self.ident}", self.job.job_id, en2.hex(),
+                 f"{self.job.ntime:08x}", f"{nonce:08x}"])
+            if m.result is True:
+                self.accepted += 1
+            else:
+                self.rejected += 1
+
+
+@pytest.mark.slow
+@pytest.mark.asyncio
+async def test_pool_soak_four_digit_latency_slo(tmp_path):
+    """ISSUE 2 acceptance: >= 1,000 loopback connections against the
+    REAL app (V1 server + sqlite-backed pool accounting), exact share
+    accounting, and the server's own share-accept histogram holding
+    p99 < 50 ms — the pool-side half of the reference's 10k/<50ms
+    operational headline, measured instead of claimed."""
+    from otedama_tpu.app import Application
+    from otedama_tpu.config.schema import AppConfig
+
+    _require_fd_budget(SOAK_CONNECTIONS)
+
+    cfg = AppConfig()
+    cfg.pool.enabled = True
+    cfg.pool.database = str(tmp_path / "slo.db")
+    cfg.stratum.enabled = True
+    cfg.stratum.host = "127.0.0.1"
+    cfg.stratum.port = 0
+    cfg.stratum.initial_difficulty = EASY
+    cfg.mining.enabled = False
+    cfg.api.enabled = False
+    cfg.p2p.enabled = False
+
+    fds_before = _fd_count()
+    app = Application(cfg)
+    await app.start()
+    try:
+        from otedama_tpu.security.ddos import DDoSConfig, DDoSProtection
+
+        app.server.ddos = DDoSProtection(DDoSConfig(
+            max_concurrent_per_ip=1 << 20, connects_per_minute=1e12,
+            bytes_per_window=1 << 40,
+        ))
+        # realistic network difficulty: the mock chain's regtest nbits
+        # (0x207FFFFF) makes EVERY easy share a block candidate, turning
+        # the soak into a block-distribution storm instead of a share
+        # latency measurement. Mainnet-shaped nbits keeps is_block rare
+        # (the block path has its own soak in the churn test above).
+        app.pool.chain.nbits = 0x1D00FFFF
+        app.pool.chain.height += 1  # force a fresh template broadcast
+        for _ in range(400):
+            j = app.server.current_job
+            if j is not None and j.nbits == 0x1D00FFFF:
+                break
+            await asyncio.sleep(0.05)
+        assert app.server.current_job is not None
+        assert app.server.current_job.nbits == 0x1D00FFFF
+
+        miners = [_SloMiner(i, app.server.port)
+                  for i in range(SOAK_CONNECTIONS)]
+        # staggered connect: batches, so the soak measures steady-state
+        # serving, not one accept storm
+        for i in range(0, SOAK_CONNECTIONS, 100):
+            await asyncio.gather(*[m.connect() for m in miners[i:i + 100]])
+        assert len(app.server.sessions) == SOAK_CONNECTIONS
+
+        # pre-mine OFF the measured window (miner-side CPU is not the
+        # system under test); unique (ident, i) extranonce2 per share ->
+        # exact accounting with zero expected rejects
+        mined = [m.premine(EASY) for m in miners]
+        assert all(len(s) == SOAK_SHARES_PER_CONN for s in mined)
+
+        lat_count_before = app.server.latency.count
+        rng = random.Random(20260803)
+        await asyncio.gather(*[
+            m.submit_all(s, 15.0, random.Random(rng.random()))
+            for m, s in zip(miners, mined)
+        ])
+
+        accepted = sum(m.accepted for m in miners)
+        rejected = sum(m.rejected for m in miners)
+        total = SOAK_CONNECTIONS * SOAK_SHARES_PER_CONN
+        # exact accounting: every submit was a unique valid share; every
+        # accept a miner SAW is durably a row; counters agree everywhere
+        assert rejected == 0, f"{rejected} rejects in a clean soak"
+        assert accepted == total
+        assert app.server.stats["shares_valid"] == total
+        rows = app.db.query("SELECT COUNT(*) AS c FROM shares")[0]["c"]
+        assert rows == total, (rows, total)
+
+        # the SLO itself, from the server's own histogram (the metric
+        # /metrics exports as otedama_pool_share_latency_seconds)
+        hist = app.server.latency
+        assert hist.count - lat_count_before == total
+        p99 = hist.quantile(0.99)
+        assert p99 <= 0.05, (
+            f"share-accept p99 {1e3 * p99:.1f} ms breaches the 50 ms SLO "
+            f"(snapshot: {hist.snapshot()})"
+        )
+
+        for m in miners:
+            m.writer.close()
+        await asyncio.sleep(1.0)
+        assert not app.server.sessions
+    finally:
+        await app.stop()
+
+    await asyncio.sleep(0.5)
+    assert _fd_count() <= fds_before + 8, (fds_before, _fd_count())

@@ -1,0 +1,407 @@
+"""Hot-path cache correctness for the stratum servers (ISSUE 2).
+
+The submit/broadcast hot paths now run on precomputed state: per-job
+notify bytes + network target, per-session share-target caches, and
+per-(job, extranonce1) ShareAssembler midstates. Every cache is only
+safe if its invalidation is exact — these tests pin:
+
+- cached-path headers/digests bit-identical to the uncached validator
+  for EVERY registered algorithm with a host digest;
+- job-switch invalidation (stale notify bytes are never sent);
+- difficulty-retarget target-cache invalidation;
+- ``session.seen`` / assembler / v2 root caches pruned with the job
+  window (the unbounded-growth satellite);
+- write-backlog disconnects and the share-accept latency histogram
+  (snapshot + /metrics export shape).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import os
+import random
+import struct
+import time
+
+import pytest
+
+from otedama_tpu.engine import jobs as jobmod
+from otedama_tpu.engine.types import Job
+from otedama_tpu.kernels import target as tgt
+from otedama_tpu.stratum import protocol as sp
+from otedama_tpu.stratum import v2
+from otedama_tpu.stratum.server import ServerConfig, StratumServer
+from otedama_tpu.utils.pow_host import pow_digest
+from otedama_tpu.utils.sha256_host import Sha256Midstate, sha256d
+
+EASY = 1e-7
+
+
+# -- bit-identity of the cached assembly path --------------------------------
+
+def _random_job(rng: random.Random, algorithm: str) -> Job:
+    return Job(
+        job_id=f"r{rng.randrange(1 << 30):x}",
+        prev_hash=rng.randbytes(32),
+        coinb1=rng.randbytes(rng.randrange(0, 150)),
+        coinb2=rng.randbytes(rng.randrange(0, 150)),
+        merkle_branch=[rng.randbytes(32) for _ in range(rng.randrange(0, 6))],
+        version=rng.getrandbits(32),
+        nbits=rng.getrandbits(32),
+        ntime=rng.getrandbits(32),
+        algorithm=algorithm,
+        extranonce1=rng.randbytes(rng.randrange(0, 9)),
+        extranonce2_size=rng.choice([2, 4, 8]),
+        block_number=10,
+    )
+
+
+def test_sha256_midstate_matches_one_shot():
+    rng = random.Random(11)
+    for _ in range(50):
+        prefix = rng.randbytes(rng.randrange(0, 200))
+        suffix = rng.randbytes(rng.randrange(0, 200))
+        mid = Sha256Midstate(prefix)
+        import hashlib
+
+        assert mid.digest_suffix(suffix) == hashlib.sha256(
+            prefix + suffix).digest()
+        assert mid.sha256d_suffix(suffix) == sha256d(prefix + suffix)
+
+
+def test_share_assembler_bit_identical_all_host_algorithms():
+    """The cached per-(job, extranonce1) path must produce the SAME 80
+    header bytes as the one-shot rebuild for every algorithm the host
+    validator knows — and therefore the same pow digest (ethash is
+    covered by header identity + the digest spot-check below: the
+    digest function input is the header, nothing else)."""
+    rng = random.Random(1202)
+    for algorithm in ("sha256d", "sha256", "scrypt", "x11", "ethash"):
+        for _ in range(8):
+            job = _random_job(rng, algorithm)
+            asm = jobmod.ShareAssembler(job)
+            for _ in range(4):
+                en2 = rng.randbytes(job.extranonce2_size)
+                ntime = rng.getrandbits(32)
+                nonce = rng.getrandbits(32)
+                want = jobmod.header_from_share(job, en2, ntime, nonce)
+                got = asm.header(en2, ntime, nonce)
+                assert got == want, (algorithm, job.job_id)
+    # digest equality end-to-end on the fast host digests (identical
+    # headers make this a tautology — asserting it anyway pins that the
+    # server feeds pow_digest the cached header unchanged)
+    for algorithm in ("sha256d", "sha256", "scrypt", "x11"):
+        job = _random_job(rng, algorithm)
+        asm = jobmod.ShareAssembler(job)
+        en2 = rng.randbytes(job.extranonce2_size)
+        h1 = jobmod.header_from_share(job, en2, job.ntime, 7)
+        h2 = asm.header(en2, job.ntime, 7)
+        assert pow_digest(h1, algorithm) == pow_digest(h2, algorithm)
+
+
+def test_share_assembler_session_overrides():
+    """The server builds assemblers with the SESSION's extranonce fields
+    (the job template carries none) — both spellings must agree."""
+    rng = random.Random(3)
+    job = _random_job(rng, "sha256d")
+    en1 = b"\x00\x00\x00\x2a"
+    asm = jobmod.ShareAssembler(job, en1, 4)
+    jobx = dataclasses.replace(job, extranonce1=en1, extranonce2_size=4)
+    en2 = b"\x01\x02\x03\x04"
+    assert asm.header(en2, job.ntime, 99) == jobmod.header_from_share(
+        jobx, en2, job.ntime, 99)
+    with pytest.raises(ValueError):
+        asm.header(b"\x01", job.ntime, 99)  # wrong en2 width still loud
+
+
+# -- server-level cache behavior ---------------------------------------------
+
+def _job(job_id: str, ntime: int | None = None) -> Job:
+    return Job(
+        job_id=job_id, prev_hash=bytes(32),
+        coinb1=bytes.fromhex("01000000010000000000000000"),
+        coinb2=bytes.fromhex("ffffffff0100f2052a01000000"),
+        merkle_branch=[bytes(range(32))],
+        version=0x20000000, nbits=0x1D00FFFF,
+        ntime=int(time.time()) if ntime is None else ntime,
+        clean=True, algorithm="sha256d",
+    )
+
+
+async def _connect(port: int):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+
+    notifies = []
+
+    async def call(msg_id, method, params):
+        writer.write(sp.encode_line(
+            sp.Message(id=msg_id, method=method, params=params)))
+        await writer.drain()
+        while True:
+            m = sp.decode_line(await asyncio.wait_for(reader.readline(), 10))
+            if m.method == "mining.notify":
+                notifies.append(m.params)
+            if m.is_response and m.id == msg_id:
+                return m
+
+    return reader, writer, call, notifies
+
+
+def _mine(job: Job, en1: bytes, difficulty: float,
+          en2: bytes | None = None) -> tuple[bytes, int]:
+    target = tgt.difficulty_to_target(difficulty)
+    j = dataclasses.replace(job, extranonce1=en1)
+    en2 = en2 if en2 is not None else os.urandom(2) + b"\x00\x00"
+    prefix = jobmod.build_header_prefix(j, en2)
+    for nonce in range(1 << 22):
+        if tgt.hash_meets_target(
+                sha256d(prefix + struct.pack(">I", nonce)), target):
+            return en2, nonce
+    raise AssertionError("no share found")
+
+
+@pytest.mark.asyncio
+async def test_notify_bytes_cache_invalidated_on_job_switch():
+    """After set_job(job2), every byte any session receives (broadcast
+    AND fresh-subscriber replay) must describe job2 — a stale cached
+    notify line would strand miners on dead work."""
+    server = StratumServer(ServerConfig(port=0, initial_difficulty=EASY))
+    await server.start()
+    try:
+        server.set_job(_job("jobA"))
+        r1, w1, call1, notifies1 = await _connect(server.port)
+        await call1(1, "mining.subscribe", ["a"])
+        await call1(99, "mining.ping", [])  # pump
+        assert notifies1 and notifies1[-1][0] == "jobA"
+
+        server.set_job(_job("jobB"))
+        await call1(100, "mining.ping", [])
+        assert notifies1[-1][0] == "jobB", notifies1
+
+        # a FRESH subscriber must get jobB's bytes (the clean variant),
+        # never jobA's stale line
+        r2, w2, call2, notifies2 = await _connect(server.port)
+        await call2(1, "mining.subscribe", ["b"])
+        await call2(99, "mining.ping", [])
+        assert [p[0] for p in notifies2] == ["jobB"]
+        assert notifies2[-1][8] is True  # clean flag on the replay line
+
+        # the cache itself matches a from-scratch encode of the job
+        cache = server.job_cache["jobB"]
+        fresh = sp.encode_line(sp.Message(
+            method="mining.notify",
+            params=sp.notify_params(server.jobs["jobB"], True)))
+        assert cache.notify_clean_line == fresh
+        assert cache.network_target == tgt.bits_to_target(0x1D00FFFF)
+        w1.close()
+        w2.close()
+    finally:
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_difficulty_retarget_invalidates_target_cache():
+    server = StratumServer(ServerConfig(port=0, initial_difficulty=EASY))
+    accepted = []
+
+    async def on_share(s):
+        accepted.append(s)
+
+    server.on_share = on_share
+    await server.start()
+    try:
+        job = _job("jobT")
+        server.set_job(job)
+        r, w, call, _n = await _connect(server.port)
+        sub = await call(1, "mining.subscribe", ["t"])
+        en1 = bytes.fromhex(sub.result[1])
+        await call(2, "mining.authorize", ["w.t", "x"])
+
+        session = next(iter(server.sessions.values()))
+        assert session.target == tgt.difficulty_to_target(EASY)
+        assert session.prev_target is None
+
+        en2, nonce = _mine(job, en1, EASY, en2=b"\x00\x00\x00\x01")
+        ok = await call(3, "mining.submit",
+                        ["w.t", "jobT", en2.hex(), f"{job.ntime:08x}",
+                         f"{nonce:08x}"])
+        assert ok.result is True
+
+        # retarget 10000x harder: the session's cached target must move
+        # with the difficulty in the same invalidation point
+        hard = EASY * 10000
+        server._send_difficulty(session, hard)
+        assert session.difficulty == hard
+        assert session.target == tgt.difficulty_to_target(hard)
+        assert session.prev_target == tgt.difficulty_to_target(EASY)
+
+        # a share meeting only the OLD target is credited at the old
+        # difficulty (retarget window), proving the new cached target is
+        # what the validator now compares against
+        for attempt in range(2, 64):
+            en2b, nonceb = _mine(job, en1, EASY,
+                                 en2=struct.pack(">I", attempt))
+            h = jobmod.header_from_share(
+                dataclasses.replace(job, extranonce1=en1), en2b, job.ntime,
+                nonceb)
+            if not tgt.hash_meets_target(sha256d(h), session.target):
+                break  # meets old, not new — the case we want
+        else:
+            pytest.skip("every easy share met the hard target (p~1e-256)")
+        ok2 = await call(4, "mining.submit",
+                         ["w.t", "jobT", en2b.hex(), f"{job.ntime:08x}",
+                          f"{nonceb:08x}"])
+        assert ok2.result is True
+        assert accepted[-1].difficulty == EASY  # credited at prev diff
+        w.close()
+    finally:
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_seen_and_assembler_pruned_with_expired_jobs():
+    """The duplicate window and assembler cache previously grew without
+    bound over a long-lived session; both must follow evicted jobs out."""
+    server = StratumServer(
+        ServerConfig(port=0, initial_difficulty=EASY, job_max_age=5.0))
+    await server.start()
+    try:
+        jobA = _job("oldjob")
+        server.set_job(jobA)
+        r, w, call, _n = await _connect(server.port)
+        sub = await call(1, "mining.subscribe", ["p"])
+        en1 = bytes.fromhex(sub.result[1])
+        await call(2, "mining.authorize", ["w.p", "x"])
+        en2, nonce = _mine(jobA, en1, EASY)
+        ok = await call(3, "mining.submit",
+                        ["w.p", "oldjob", en2.hex(), f"{jobA.ntime:08x}",
+                         f"{nonce:08x}"])
+        assert ok.result is True
+        session = next(iter(server.sessions.values()))
+        assert any(k[0] == "oldjob" for k in session.seen)
+        assert "oldjob" in session.assemblers
+
+        # age the job past the 2x eviction horizon, then publish a new
+        # one: eviction must sweep the per-session state too
+        server.jobs["oldjob"] = dataclasses.replace(
+            jobA, received_at=time.time() - 11.0)
+        server.set_job(_job("newjob"))
+        assert "oldjob" not in server.jobs
+        assert "oldjob" not in server.job_cache
+        assert not any(k[0] == "oldjob" for k in session.seen)
+        assert "oldjob" not in session.assemblers
+        w.close()
+    finally:
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_backlog_disconnect_and_latency_histogram():
+    """A session that stops reading is cut once its write buffer passes
+    the configured bound; accepted submits land in the share-accept
+    histogram surfaced by snapshot() and exported at /metrics."""
+    server = StratumServer(ServerConfig(
+        port=0, initial_difficulty=EASY, max_write_backlog=8 * 1024))
+    await server.start()
+    try:
+        job = _job("jobL")
+        server.set_job(job)
+        r, w, call, _n = await _connect(server.port)
+        sub = await call(1, "mining.subscribe", ["l"])
+        en1 = bytes.fromhex(sub.result[1])
+        await call(2, "mining.authorize", ["w.l", "x"])
+        en2, nonce = _mine(job, en1, EASY)
+        ok = await call(3, "mining.submit",
+                        ["w.l", "jobL", en2.hex(), f"{job.ntime:08x}",
+                         f"{nonce:08x}"])
+        assert ok.result is True
+
+        # histogram observed the submit, and snapshot surfaces it
+        assert server.latency.count == 1
+        snap = server.snapshot()
+        assert snap["accept_latency"]["count"] == 1
+        assert snap["accept_latency"]["p99_ms"] > 0
+
+        # /metrics export shape (the api server mirrors the histogram)
+        from otedama_tpu.api.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.histogram_set(
+            "otedama_pool_share_latency_seconds",
+            server.latency.cumulative(), server.latency.sum,
+            server.latency.count, labels={"protocol": "v1"},
+        )
+        text = reg.render()
+        assert ('otedama_pool_share_latency_seconds_bucket'
+                '{le="0.05",protocol="v1"}') in text
+        assert 'otedama_pool_share_latency_seconds_count{protocol="v1"} 1' in text
+
+        # now stop reading and flood broadcasts: the server must cut the
+        # session at the backlog bound instead of buffering forever
+        for i in range(20000):
+            server.set_job(_job(f"flood{i}"))
+            if server.stats["backlog_disconnects"]:
+                break
+        assert server.stats["backlog_disconnects"] >= 1
+        await asyncio.sleep(0.2)  # read loop reaps the aborted session
+        assert not server.sessions
+    finally:
+        await server.stop()
+
+
+# -- V2 parity ---------------------------------------------------------------
+
+def _v2_job(job_id: str) -> Job:
+    return Job(
+        job_id=job_id, prev_hash=bytes(32), coinb1=b"\x01\x02",
+        coinb2=b"\x03\x04", merkle_branch=[b"\x05" * 32],
+        version=0x20000000, nbits=0x1D00FFFF, ntime=int(time.time()),
+        extranonce1=b"", extranonce2_size=4,
+    )
+
+
+@pytest.mark.asyncio
+async def test_v2_root_cache_latency_and_prune():
+    """V2: the per-(channel, job) merkle root computed at job delivery
+    is what the submit path validates with (bit-identical accept), the
+    latency histogram fills, and root/dup windows prune with the job
+    window."""
+    target = tgt.difficulty_to_target(EASY)
+    server = v2.Sv2MiningServer(v2.Sv2ServerConfig(
+        port=0, initial_difficulty=EASY, job_max_age=3600.0))
+    await server.start()
+    try:
+        client = v2.Sv2MiningClient("127.0.0.1", server.port, user="w.v2")
+        await client.connect()
+        jid = server.set_job(_v2_job("v2a"))
+        while jid not in client.jobs or client.prevhash is None:
+            await client.pump()
+        chan, _conn = server._channels[client.channel.channel_id]
+        assert jid in chan.roots  # root cached at delivery
+
+        # mine against the server's own math and submit
+        job = server._jobs[jid][0]
+        en2 = client.channel.extranonce_prefix
+        ntime = job.ntime
+        nonce = None
+        for n in range(1 << 22):
+            h = jobmod.header_from_share(job, en2, ntime, n)
+            if tgt.hash_meets_target(sha256d(h), target):
+                nonce = n
+                break
+        res = await client.submit(jid, nonce, ntime, job.version)
+        assert isinstance(res, v2.SubmitSharesSuccess)
+        assert server.latency.count == 1
+        assert server.snapshot()["accept_latency"]["count"] == 1
+
+        # shrink the job window: the old job's root + dup keys must go
+        server.config.job_max_age = 0.0
+        server._jobs[jid] = (job, time.time() - 1.0, server._jobs[jid][2])
+        jid2 = server.set_job(_v2_job("v2b"))
+        assert jid not in server._jobs
+        assert jid not in chan.roots and jid2 in chan.roots
+        assert not any(k[0] == jid for k in chan.seen_shares)
+        await client.close()
+    finally:
+        await server.stop()

@@ -16,6 +16,7 @@ import numpy as np
 import pytest
 
 from otedama_tpu.kernels import x11
+from otedama_tpu.utils import jaxcompat
 from otedama_tpu.kernels.x11 import (
     blake,
     bmw,
@@ -301,7 +302,7 @@ def test_jnp_chain_matches_numpy_oracle():
         np.frombuffer(x11.x11_digest(row.tobytes()), dtype=np.uint8)
         for row in hdr
     ])
-    with jax.enable_x64():
+    with jaxcompat.enable_x64():
         got = np.asarray(jc.x11_digest_chain(jnp.asarray(hdr)))
     assert np.array_equal(got, want)
 
@@ -333,7 +334,7 @@ def test_jnp_chain_compute_sbox_matches_numpy_oracle():
         np.frombuffer(x11.x11_digest(row.tobytes()), dtype=np.uint8)
         for row in hdr
     ])
-    with jax.enable_x64():
+    with jaxcompat.enable_x64():
         got = np.asarray(
             jc.x11_digest_chain(jnp.asarray(hdr), sbox_mode="compute")
         )

@@ -1,0 +1,277 @@
+"""Stratum V1 pool-server latency/throughput bench (four-digit SLO).
+
+Drives the REAL asyncio ``StratumServer`` (loopback TCP, full JSON-RPC
+wire, full share validation — the exact submit hot path production
+runs) with N concurrent miner connections submitting pre-mined valid
+shares, and emits a ``BENCH_STRATUM_*.json`` artifact so the pool
+latency trajectory is tracked like the kernel benches:
+
+    {"connections": N, "shares": M, "shares_per_sec": ...,
+     "server_p50_ms": ..., "server_p99_ms": ...,
+     "client_p50_ms": ..., "client_p99_ms": ...}
+
+Server percentiles come from the server's own share-accept histogram
+(submit-received -> verdict-written — the SLO the reference's 10k/<50ms
+claim is about); client percentiles additionally include wire +
+event-loop scheduling from a miner's seat.
+
+FD-limit aware and LOUD about it: the bench needs ~2 fds per connection
+(both socket ends live in this process). It tries to raise RLIMIT_NOFILE
+to the hard limit and **exits 2 with a clear message** if the budget
+still doesn't fit — a silently skipped soak is how scale claims rot.
+
+Usage:
+    python tools/bench_stratum.py --connections 1000 --shares 3 \
+        --out BENCH_STRATUM_r06.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import random
+import resource
+import struct
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from otedama_tpu.engine import jobs as jobmod          # noqa: E402
+from otedama_tpu.engine.types import Job               # noqa: E402
+from otedama_tpu.kernels import target as tgt          # noqa: E402
+from otedama_tpu.stratum import protocol as sp         # noqa: E402
+from otedama_tpu.stratum.server import (               # noqa: E402
+    ServerConfig, StratumServer,
+)
+from otedama_tpu.utils.sha256_host import sha256d      # noqa: E402
+
+EASY = 1e-7  # ~2.3e-3 hit probability per hash: shares mine in ~430 tries
+
+
+def ensure_fd_budget(connections: int) -> None:
+    """Raise RLIMIT_NOFILE if needed; exit 2 loudly if it can't fit."""
+    need = 2 * connections + 128  # both socket ends + process baseline
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft < need:
+        try:
+            resource.setrlimit(
+                resource.RLIMIT_NOFILE, (min(need, hard), hard)
+            )
+        except (ValueError, OSError):
+            pass
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft < need:
+        print(
+            f"FATAL: fd limit too low for the soak: need {need} "
+            f"(2 x {connections} connections + slack), have soft={soft} "
+            f"hard={hard}. Raise it (ulimit -n {need}) or lower "
+            f"--connections. Refusing to silently under-test.",
+            file=sys.stderr,
+        )
+        sys.exit(2)
+
+
+def make_job(job_id: str = "bench1") -> Job:
+    return Job(
+        job_id=job_id,
+        prev_hash=bytes(32),
+        coinb1=bytes.fromhex("01000000010000000000000000"),
+        coinb2=bytes.fromhex("ffffffff0100f2052a01000000"),
+        merkle_branch=[bytes(range(32))],
+        version=0x20000000,
+        nbits=0x1D00FFFF,
+        ntime=1_700_000_000,
+        clean=True,
+        algorithm="sha256d",
+    )
+
+
+def mine_share(job: Job, extranonce1: bytes, en2: bytes,
+               target: int) -> int | None:
+    """Find a nonce for (job, en1, en2) meeting target; None if unlucky."""
+    import dataclasses
+
+    j = dataclasses.replace(job, extranonce1=extranonce1)
+    prefix = jobmod.build_header_prefix(j, en2)
+    for nonce in range(1 << 20):
+        if tgt.hash_meets_target(
+                sha256d(prefix + struct.pack(">I", nonce)), target):
+            return nonce
+    return None
+
+
+class Miner:
+    """One raw-wire loopback miner: subscribe, authorize, submit."""
+
+    def __init__(self, ident: int, port: int):
+        self.ident = ident
+        self.port = port
+        self.reader: asyncio.StreamReader | None = None
+        self.writer: asyncio.StreamWriter | None = None
+        self.extranonce1 = b""
+        self.latencies: list[float] = []
+        self.accepted = 0
+        self.rejected = 0
+
+    async def connect(self) -> None:
+        self.reader, self.writer = await asyncio.open_connection(
+            "127.0.0.1", self.port
+        )
+        sub = await self._call(1, "mining.subscribe", [f"bench-{self.ident}"])
+        self.extranonce1 = bytes.fromhex(sub.result[1])
+        await self._call(2, "mining.authorize", [f"w.{self.ident}", "x"])
+
+    async def _call(self, msg_id, method, params) -> sp.Message:
+        self.writer.write(sp.encode_line(
+            sp.Message(id=msg_id, method=method, params=params)))
+        await self.writer.drain()
+        while True:
+            line = await asyncio.wait_for(self.reader.readline(), 30)
+            if not line:
+                raise ConnectionError("server closed")
+            m = sp.decode_line(line)
+            if m.is_response and m.id == msg_id:
+                return m
+
+    async def submit_all(self, job: Job,
+                         shares: list[tuple[bytes, int]],
+                         window: float) -> None:
+        rng = random.Random(self.ident)
+        for i, (en2, nonce) in enumerate(shares):
+            # jittered pacing spreads the fleet's submits over `window`
+            await asyncio.sleep(rng.random() * window / len(shares))
+            t0 = time.monotonic()
+            m = await self._call(10 + i, "mining.submit",
+                                 [f"w.{self.ident}", job.job_id, en2.hex(),
+                                  f"{job.ntime:08x}", f"{nonce:08x}"])
+            self.latencies.append(time.monotonic() - t0)
+            if m.result is True:
+                self.accepted += 1
+            else:
+                self.rejected += 1
+
+    def close(self) -> None:
+        if self.writer is not None:
+            self.writer.close()
+
+
+def percentile(values: list[float], q: float) -> float:
+    if not values:
+        return 0.0
+    s = sorted(values)
+    return s[min(len(s) - 1, int(q * len(s)))]
+
+
+async def run_bench(connections: int, shares_per_conn: int,
+                    window: float) -> dict:
+    hook_count = 0
+
+    async def on_share(_s):
+        nonlocal hook_count
+        hook_count += 1
+
+    server = StratumServer(
+        ServerConfig(port=0, initial_difficulty=EASY, max_clients=65536),
+        on_share=on_share,
+    )
+    # loopback fleet: the whole swarm shares one IP — lift per-IP caps,
+    # keep the guard code in the path (same approach as tests/test_soak)
+    from otedama_tpu.security.ddos import DDoSConfig, DDoSProtection
+
+    server.ddos = DDoSProtection(DDoSConfig(
+        max_concurrent_per_ip=1 << 20, connects_per_minute=1e12,
+        bytes_per_window=1 << 40,
+    ))
+    await server.start()
+    job = make_job()
+    server.set_job(job)
+    target = tgt.difficulty_to_target(EASY)
+
+    miners = [Miner(i, server.port) for i in range(connections)]
+    t_conn0 = time.monotonic()
+    # staggered connect (batches): a 1000-way simultaneous connect storm
+    # measures the kernel's accept queue, not the server
+    for i in range(0, connections, 100):
+        await asyncio.gather(*[m.connect() for m in miners[i:i + 100]])
+    connect_seconds = time.monotonic() - t_conn0
+
+    # pre-mine every share OFF the measured window (pure hashlib; the
+    # miners' cost is not the system under test)
+    mined: list[list[tuple[bytes, int]]] = []
+    t_mine0 = time.monotonic()
+    for m in miners:
+        lst = []
+        for i in range(shares_per_conn):
+            en2 = struct.pack(">I", (m.ident << 8) | i)
+            nonce = mine_share(job, m.extranonce1, en2, target)
+            if nonce is not None:
+                lst.append((en2, nonce))
+        mined.append(lst)
+    mine_seconds = time.monotonic() - t_mine0
+
+    t0 = time.monotonic()
+    await asyncio.gather(*[
+        m.submit_all(job, lst, window) for m, lst in zip(miners, mined)
+    ])
+    elapsed = time.monotonic() - t0
+
+    accepted = sum(m.accepted for m in miners)
+    rejected = sum(m.rejected for m in miners)
+    client_lat = [lat for m in miners for lat in m.latencies]
+    snap = server.latency.snapshot()
+    result = {
+        "connections": connections,
+        "shares_submitted": accepted + rejected,
+        "shares_accepted": accepted,
+        "shares_rejected": rejected,
+        "hook_deliveries": hook_count,
+        "server_sessions_peak": connections,
+        "connect_seconds": round(connect_seconds, 3),
+        "premine_seconds": round(mine_seconds, 3),
+        "submit_window_seconds": round(elapsed, 3),
+        "shares_per_sec": round((accepted + rejected) / elapsed, 1),
+        "server_p50_ms": snap["p50_ms"],
+        "server_p99_ms": snap["p99_ms"],
+        "server_avg_ms": snap["avg_ms"],
+        "client_p50_ms": round(1e3 * percentile(client_lat, 0.50), 3),
+        "client_p99_ms": round(1e3 * percentile(client_lat, 0.99), 3),
+        "exact_accounting": (
+            accepted == hook_count == server.stats["shares_valid"]
+        ),
+    }
+    for m in miners:
+        m.close()
+    await server.stop()
+    return result
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--connections", type=int, default=1000)
+    ap.add_argument("--shares", type=int, default=3,
+                    help="shares submitted per connection")
+    ap.add_argument("--window", type=float, default=10.0,
+                    help="seconds the submit load is spread over")
+    ap.add_argument("--out", default="BENCH_STRATUM_manual.json")
+    args = ap.parse_args()
+
+    ensure_fd_budget(args.connections)
+    result = asyncio.run(
+        run_bench(args.connections, args.shares, args.window)
+    )
+    result["bench"] = "stratum_v1_share_accept"
+    result["timestamp"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(result, indent=2, sort_keys=True))
+    if not result["exact_accounting"]:
+        print("FATAL: share accounting mismatch", file=sys.stderr)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
