@@ -408,6 +408,36 @@ class ApiServer:
             help_="Share submit->verdict latency",
         )
 
+    def sync_compile_metrics(self, counters: dict, histograms: dict) -> None:
+        """Compilation-lifecycle telemetry (utils/compile_cache): cache
+        hit/miss counters plus per-(algorithm, backend) compile-duration
+        histograms. The compile counter is the recompile guard's metric —
+        steady-state mining must not move it between scrapes."""
+        reg = self.registry
+        reg.counter_set(
+            "otedama_compile_cache_hits_total", counters["cache_hits"],
+            help_="Persistent XLA compile-cache hits",
+        )
+        reg.counter_set(
+            "otedama_compile_cache_misses_total", counters["cache_misses"],
+            help_="Persistent XLA compile-cache misses",
+        )
+        reg.counter_set(
+            "otedama_compile_total", counters["compiles"],
+            help_="XLA backend-compile requests (steady state adds zero)",
+        )
+        for (algorithm, backend), hist in histograms.items():
+            if hist.count <= 0:
+                continue
+            reg.histogram_set(
+                "otedama_compile_seconds",
+                hist.cumulative(),
+                hist.sum,
+                hist.count,
+                labels={"algorithm": algorithm, "backend": backend},
+                help_="XLA compile durations per (algorithm, backend)",
+            )
+
     def sync_pool_server_metrics(self, server=None, server_v2=None) -> None:
         """Export the POOL-side share-accept latency SLO histograms
         (submit-received -> verdict-written, per protocol). The client

@@ -28,6 +28,7 @@ from otedama_tpu.engine.engine import EngineConfig, MiningEngine
 from otedama_tpu.engine.types import Job, Share
 from otedama_tpu.engine.vardiff import VardiffConfig
 from otedama_tpu.kernels import target as tgt
+from otedama_tpu.utils import compile_cache
 
 log = logging.getLogger("otedama.app")
 
@@ -66,6 +67,7 @@ class Application:
         self.profit_analyzer = None
         self.profit_switcher = None
         self._solo_jobs: dict[str, Job] = {}
+        self._solo_last_height = -1  # solo template gate (see _solo_job_loop)
         # engine restarts are requested by two supervisors (failure detector
         # and recovery manager); serialize them or interleaved stop/start
         # orphans search tasks
@@ -76,12 +78,20 @@ class Application:
 
     # -- construction ---------------------------------------------------------
 
-    def _build_engine(self) -> MiningEngine:
+    def _backend_kwargs(self) -> dict:
+        """Construction kwargs EVERY backend build shares — startup,
+        profit switch, and warm set alike, or a switch would silently
+        change the configured mesh shape."""
         cfg = self.config.mining
         kwargs = {}
         if cfg.backend == "pod" and cfg.pod_hosts:
             kwargs["n_hosts"] = cfg.pod_hosts
-        backend = self.algo_manager.backend_for(cfg.algorithm, **kwargs)
+        return kwargs
+
+    def _build_engine(self) -> MiningEngine:
+        cfg = self.config.mining
+        backend = self.algo_manager.backend_for(
+            cfg.algorithm, **self._backend_kwargs())
         engine = MiningEngine(
             backends={getattr(backend, "name", "device0"): backend},
             on_share=self._on_share,
@@ -126,6 +136,14 @@ class Application:
         self.started_at = time.time()
         cfg = self.config
 
+        # compilation lifecycle first: every backend built below should
+        # hit the persistent cache (restart = deserialize, not recompile),
+        # and the compile counters must see the startup compiles
+        if cfg.mining.compile_cache_dir:
+            compile_cache.enable(cfg.mining.compile_cache_dir)
+        else:
+            compile_cache.install()  # observability even without the cache
+
         if cfg.pool.enabled:
             await self._start_pool_side()
         if cfg.mining.enabled:
@@ -150,6 +168,11 @@ class Application:
         from otedama_tpu.stratum.server import ServerConfig, StratumServer
 
         cfg = self.config
+        # the POOL serves one chain whose algorithm never changes at
+        # runtime — snapshot it so a miner-side profit switch (which
+        # mutates the live mining config) can never re-label the pool's
+        # jobs out from under its external miners
+        self._pool_algorithm = cfg.mining.algorithm
         self.db = connect_database(cfg.pool.database)
         chain = (
             BitcoinRPCClient(cfg.pool.chain_rpc_url, cfg.pool.chain_rpc_user,
@@ -244,7 +267,7 @@ class Application:
                 t = await chain.get_block_template()
                 if t.height != last_height and self.pool is not None:
                     job = self.pool.job_from_template(
-                        t, algorithm=self.config.mining.algorithm
+                        t, algorithm=self._pool_algorithm
                     )
                     last_height = t.height
                     if self.server is not None:
@@ -315,8 +338,78 @@ class Application:
                 else MockChainClient()
             )
             self._tasks.append(asyncio.create_task(self._solo_job_loop()))
-        await self.engine.start()
+        if cfg.mining.precompile and any(
+            getattr(b, "precompile", None) is not None
+            for b in self.engine.backends.values()
+        ):
+            # precompile-then-start runs as a BACKGROUND task: a cold
+            # compile is minutes for the unrolled paths, and the API /
+            # supervision / job feeds must come up meanwhile (early jobs
+            # just buffer in set_job). The engine itself starts only when
+            # warm, so its first dispatched batch mines instead of
+            # compiling.
+            self._tasks.append(
+                asyncio.create_task(self._precompile_then_start_engine())
+            )
+        else:
+            await self.engine.start()
         self._started.append(self.engine)
+        warm = [a.strip() for a in cfg.mining.warm_algorithms.split(",")
+                if a.strip()]
+        if warm:
+            self._tasks.append(
+                asyncio.create_task(self._warm_algorithm_set(warm))
+            )
+
+    async def _precompile_then_start_engine(self) -> None:
+        """Startup warm path: AOT-compile the active algorithm's programs
+        in an executor, then start the engine (see _start_miner_side)."""
+        loop = asyncio.get_running_loop()
+        engine = self.engine
+        for backend in engine.backends.values():
+            fn = getattr(backend, "precompile", None)
+            if fn is None:
+                continue
+            count = engine.planned_batch(backend)
+            try:
+                await loop.run_in_executor(
+                    None, lambda f=fn, c=count: f(count=c)
+                )
+            except Exception:
+                log.exception(
+                    "startup precompile of %s failed (first batch will "
+                    "compile instead)", getattr(backend, "name", "?"))
+        await engine.start()
+
+    async def _warm_algorithm_set(self, names: list[str]) -> None:
+        """Startup warmup of the configured algorithm SET: build +
+        precompile each likely switch target in the background (engine
+        already mining), so their programs land in the persistent cache
+        and the first profit switch to any of them is compile-free. The
+        built backends are discarded — the swap path builds fresh ones,
+        which then deserialize from the cache."""
+        loop = asyncio.get_running_loop()
+        for name in names:
+            if name == self.config.mining.algorithm:
+                continue  # the active algorithm precompiled at startup
+            try:
+                # planned_batch as the warm count: the cached program
+                # must be the SHAPE a later switch dispatches, or the
+                # batch-shape-keyed backends (pallas/pods) miss anyway
+                backend = await self.algo_manager.prepare_backend_async(
+                    name, warm_count=self.engine.planned_batch,
+                    **self._backend_kwargs(),
+                )
+            except Exception:
+                log.exception("startup warmup of %r failed", name)
+                continue
+            close = getattr(backend, "close", None)
+            if close is not None:
+                try:
+                    await loop.run_in_executor(None, close)
+                except Exception:
+                    log.exception("warmup backend %r close failed", name)
+            log.info("algorithm %s warmed into the compile cache", name)
 
     async def _failover_loop(self) -> None:
         """Re-point the stratum client when a better upstream wins the
@@ -350,13 +443,17 @@ class Application:
 
     async def _solo_job_loop(self) -> None:
         counter = 0
-        last_height = -1
+        # instance attr, not a local: an algorithm switch resets it to
+        # force an immediate re-issue of the current template under the
+        # new algorithm label (otherwise the engine idles until the next
+        # block arrives)
+        self._solo_last_height = -1
         while True:
             try:
                 t = await self.chain.get_block_template()
-                if t.height != last_height:
+                if t.height != self._solo_last_height:
                     counter += 1
-                    last_height = t.height
+                    self._solo_last_height = t.height
                     job = Job(
                         job_id=f"solo-{counter:x}",
                         prev_hash=t.prev_hash,
@@ -417,6 +514,9 @@ class Application:
         if self.p2p is not None:
             self.api.add_provider("p2p", self.p2p.snapshot)
         self.api.add_provider("benchmarks", self.algo_manager.snapshot)
+        # compilation lifecycle: cache hit/miss + per-(algorithm, backend)
+        # compile-time telemetry (utils/compile_cache)
+        self.api.add_provider("compile", compile_cache.snapshot)
         # chaos observability: per-point hit/fault counters of the active
         # fault injector ({"active": False} outside chaos runs)
         from otedama_tpu.utils import faults as _faults
@@ -444,13 +544,42 @@ class Application:
         async def on_switch(algorithm, est):
             if self.engine is None:
                 return
-            backend = self.algo_manager.backend_for(algorithm)
+            if self.server is not None and not self.config.upstreams:
+                # pool mode with loopback mining: the engine mines THIS
+                # pool's own chain, whose algorithm is fixed — a switch
+                # could only produce work the pool rejects
+                raise ValueError(
+                    "refusing algorithm switch: the engine mines this "
+                    f"pool's own {self._pool_algorithm} chain via the "
+                    "loopback client"
+                )
+            # double-buffered switch: build + precompile the new
+            # algorithm's backend in an executor while the engine keeps
+            # mining the old one; planned_batch as the warm count means
+            # batch-shape-keyed programs (pallas/pods) compile the exact
+            # shape the hot loop will dispatch
+            engine = self.engine
+            backend = await self.algo_manager.prepare_backend_async(
+                algorithm, warm_count=engine.planned_batch,
+                **self._backend_kwargs(),
+            )
             async with self._restart_lock:
-                await self.engine.stop()
-                self.engine.backends = {getattr(backend, "name", "device0"): backend}
-                self.engine.config.algorithm = algorithm
-                self.engine.stats.algorithm = algorithm
-                await self.engine.start()
+                await engine.switch_algorithm(
+                    algorithm,
+                    {getattr(backend, "name", "device0"): backend},
+                )
+            # every job source must follow the switch, or the engine
+            # idles on (or worse, mines) stale-algorithm jobs forever:
+            # - live config: solo template loop + failover reconnects
+            # - the connected stratum client labels each notify with ITS
+            #   config's algorithm, snapshotted at construction
+            # - solo mode re-issues the current template immediately (the
+            #   height-change gate would otherwise idle the engine until
+            #   the next block)
+            self.config.mining.algorithm = algorithm
+            if self.client is not None:
+                self.client.config.algorithm = algorithm
+            self._solo_last_height = -1
             log.info("algorithm switched to %s", algorithm)
 
         self.profit_switcher = ProfitSwitcher(
@@ -527,8 +656,12 @@ class Application:
 
             async def engine_probe() -> bool:
                 # transitional states (starting/stopping) are another
-                # supervisor's restart in flight, not ill health
-                return engine.state.value in ("running", "starting", "stopping")
+                # supervisor's restart in flight, not ill health; idle
+                # means the startup precompile task has not started the
+                # engine yet — recovery "restarting" it would start it
+                # COLD and defeat the warm startup
+                return engine.state.value in (
+                    "idle", "running", "starting", "stopping")
 
             async def engine_restart() -> None:
                 async with lock:
@@ -608,6 +741,9 @@ class Application:
                 self.api.sync_rpc_pool_metrics(chains)
             if self.server is not None or self.server_v2 is not None:
                 self.api.sync_pool_server_metrics(self.server, self.server_v2)
+            self.api.sync_compile_metrics(
+                compile_cache.counters(), compile_cache.histograms()
+            )
             if self.engine is not None:
                 snap = self.engine.snapshot()
                 self.api.sync_engine_metrics(snap)

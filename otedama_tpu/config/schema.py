@@ -92,6 +92,18 @@ class MiningConfig:
     # pod backend: extranonce2 rows of the (host, chip) mesh; 0 = pick
     # automatically (2 rows when the device count is even, else 1)
     pod_hosts: int = 0
+    # persistent XLA compilation cache directory (utils/compile_cache):
+    # restarts and algorithm switches deserialize their compiled programs
+    # from disk instead of recompiling. "" disables. Env override:
+    # OTEDAMA_MINING_COMPILE_CACHE_DIR (jax's JAX_COMPILATION_CACHE_DIR
+    # also works, upstream of this knob).
+    compile_cache_dir: str = ""
+    # AOT-compile the active algorithm's search programs at startup (off
+    # the event loop) so the first job mines instead of compiling
+    precompile: bool = True
+    # comma list of algorithms warmed into the compile cache in the
+    # background after startup — likely profit-switch targets; "" = none
+    warm_algorithms: str = ""
 
 
 @dataclasses.dataclass
@@ -259,6 +271,13 @@ def validate_config(cfg: AppConfig) -> list[str]:
         algos.get(cfg.mining.algorithm)
     except KeyError:
         errors.append(f"unknown algorithm {cfg.mining.algorithm!r}")
+    for name in (a.strip() for a in cfg.mining.warm_algorithms.split(",")):
+        if not name:
+            continue
+        try:
+            algos.get(name)
+        except KeyError:
+            errors.append(f"unknown warm algorithm {name!r}")
     if cfg.mining.batch_size <= 0 or cfg.mining.batch_size > (1 << 32):
         errors.append("mining.batch_size out of range")
     for name in ("stratum", "p2p", "api"):
@@ -283,6 +302,9 @@ mining:
   backend: auto
   batch_size: 16777216
   worker_name: tpu-pod
+  compile_cache_dir: ""  # persistent XLA compile cache (empty = off)
+  precompile: true       # AOT-compile the active algorithm at startup
+  warm_algorithms: ""    # e.g. "scrypt,ethash": pre-cache switch targets
 
 stratum:
   enabled: false

@@ -12,12 +12,15 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import functools
 import logging
-import struct
 import time
 
 from otedama_tpu.engine import algos
-from otedama_tpu.runtime.search import JobConstants, make_backend
+from otedama_tpu.runtime.search import (
+    make_backend,
+    synthetic_job_constants,
+)
 
 log = logging.getLogger("otedama.engine.algos")
 
@@ -82,12 +85,78 @@ class AlgorithmManager:
             )
         return make_backend(kind, algorithm=algorithm, **kwargs)
 
+    # -- building + precompiling (the warm-swap path) ------------------------
+
+    def prepare_backend(self, algorithm: str, kind: str | None = None,
+                        warm_count=None, **kwargs):
+        """Build AND precompile a backend: after this returns, its search
+        programs are compiled (and persisted when the compile cache is
+        enabled), so handing it to ``MiningEngine.switch_algorithm`` costs
+        one batch boundary, not an XLA compile.
+
+        Blocking (a compile can take minutes) — async code uses
+        ``prepare_backend_async``. ``warm_count`` forces the warmup batch
+        size for batch-shape-keyed programs (pallas/pods): an int, or a
+        callable(backend) -> int — pass the engine's ``planned_batch``
+        bound method for an exact-shape warm.
+        """
+        backend = self.backend_for(algorithm, kind, **kwargs)
+        precompile = getattr(backend, "precompile", None)
+        if precompile is not None:
+            try:
+                count = (warm_count(backend) if callable(warm_count)
+                         else warm_count)
+                seconds = precompile(count=count)
+            except Exception:
+                # a built backend can own real resources (pod follower
+                # processes, HBM-resident caches) — release them instead
+                # of leaking on a failed compile
+                close = getattr(backend, "close", None)
+                if close is not None:
+                    try:
+                        close()
+                    except Exception:
+                        log.exception(
+                            "closing %s after failed precompile also "
+                            "failed", getattr(backend, "name", "?"))
+                raise
+            log.info("prepared %s/%s in %.2fs", algorithm,
+                     getattr(backend, "name", "?"), seconds)
+        return backend
+
+    async def prepare_backend_async(self, algorithm: str,
+                                    kind: str | None = None,
+                                    warm_count=None, **kwargs):
+        """Double-buffered switching: build + precompile OFF the event
+        loop while the engine keeps mining the current algorithm."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None,
+            functools.partial(self.prepare_backend, algorithm, kind,
+                              warm_count=warm_count, **kwargs),
+        )
+
     # -- benchmarking --------------------------------------------------------
 
     def benchmark(
         self, algorithm: str, kind: str | None = None, budget_hashes: int | None = None
     ) -> BenchmarkResult:
-        """Timed production-path search over a synthetic job."""
+        """Timed production-path search over a synthetic job.
+
+        Blocking by design (it times a device search); event-loop code
+        must use ``benchmark_async`` — calling this on a running loop's
+        thread would stall every coroutine for the whole budget, so it
+        refuses loudly instead.
+        """
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            pass
+        else:
+            raise RuntimeError(
+                "benchmark() blocks on device searches; call "
+                "benchmark_async() from event-loop code"
+            )
         extra = {}
         if algorithm == "ethash" and (kind or self.preferred_backend) != "full":
             # a benchmark backend is discarded right after timing; the
@@ -95,13 +164,16 @@ class AlgorithmManager:
             # epoch-0 full-DAG build that outlives it (review r5)
             extra["full_dataset"] = False
         backend = self.backend_for(algorithm, kind, **extra)
-        header76 = bytes(range(64)) + struct.pack(
-            ">3I", 0x17034219, 0x6530D1B7, 0x1D00FFFF
-        )
-        jc = JobConstants.from_header_prefix(header76, target=0)  # no winners
+        jc = synthetic_job_constants()  # target=0: no winners
         if budget_hashes is None:
             budget_hashes = 1 << 12 if algos.get(algorithm).memory_hard else 1 << 18
-        backend.search(jc, 0, min(budget_hashes, 1 << 10))  # warmup/compile
+        # warmup/compile outside the timed region, attributed in the
+        # compile telemetry (utils.compile_cache)
+        precompile = getattr(backend, "precompile", None)
+        if precompile is not None:
+            precompile(jc)
+        else:
+            backend.search(jc, 0, min(budget_hashes, 1 << 10))
         t0 = time.monotonic()
         backend.search(jc, 1 << 20, budget_hashes)
         dt = time.monotonic() - t0

@@ -42,6 +42,24 @@ log = logging.getLogger("otedama.engine")
 ShareCallback = Callable[[Share], Awaitable[None]]
 
 
+def _job_constants_batch(job: Job, en2s: list[bytes]) -> list[JobConstants]:
+    """All of one dispatch unit's midstates in a single executor call."""
+    return [job_constants(job, en2) for en2 in en2s]
+
+
+def _canon_algo(name: str) -> str:
+    """Canonical algorithm identity for job/engine compatibility checks:
+    registered aliases resolve through the algos registry; the sha256
+    family collapses to one name (sha256 jobs are valid work for a
+    sha256d engine and vice versa — ``make_backend`` routes them to the
+    same kernels)."""
+    try:
+        name = algos.get(name).name
+    except Exception:
+        pass  # unknown names compare as themselves (and mismatch loudly)
+    return "sha256d" if name == "sha256" else name
+
+
 class SearchBackendProtocol(Protocol):
     name: str
 
@@ -93,12 +111,28 @@ class MiningEngine:
         self._tasks: list[asyncio.Task] = []
         self._stop = asyncio.Event()
         self._seen_shares: set[tuple[str, bytes, int, int]] = set()
+        # in-flight device calls (executor futures): cancelling a searcher
+        # task does NOT stop its worker thread, so teardown paths must
+        # wait these out before closing the backends under them
+        self._inflight: set[asyncio.Future] = set()
+        self._switches = 0
+        self._last_switch_downtime = 0.0
 
     # -- job intake ---------------------------------------------------------
 
     def set_job(self, job: Job) -> None:
         """Replace the current job. Clean jobs invalidate in-flight work
         (the searcher rechecks the serial between batches)."""
+        if _canon_algo(job.algorithm) != _canon_algo(self.config.algorithm):
+            # mining a mislabeled job would produce work every upstream
+            # validator rejects, indistinguishable from healthy hashing —
+            # refuse loudly; the feed must follow the engine's algorithm
+            # (app.on_switch re-points every job source on a switch)
+            log.warning(
+                "ignoring job %s: feed labels it %r but engine runs %r",
+                job.job_id, job.algorithm, self.config.algorithm,
+            )
+            return
         self._job = job
         self._job_serial += 1
         self.stats.current_job_id = job.job_id
@@ -113,6 +147,11 @@ class MiningEngine:
             return
         self.state = EngineState.STARTING
         self._stop.clear()
+        self._spawn_searchers()
+        self.state = EngineState.RUNNING
+        log.info("engine started with backends: %s", list(self.backends))
+
+    def _spawn_searchers(self) -> None:
         loop = asyncio.get_running_loop()
         # extranonce2 block layout across heterogeneous backends: device i
         # owns [sum(fanouts[:i]), ...+fanout_i) and strides by the total, so
@@ -127,23 +166,36 @@ class MiningEngine:
                 )
             )
             offset += fanouts[i]
-        self.state = EngineState.RUNNING
-        log.info("engine started with backends: %s", list(self.backends))
 
-    async def stop(self) -> None:
-        self.state = EngineState.STOPPING
-        self._stop.set()
-        self._job_event.set()
+    async def _cancel_searchers(self) -> None:
         for t in self._tasks:
             t.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)
         self._tasks.clear()
+
+    def _run_device(self, loop, fn, *args) -> asyncio.Future:
+        """Dispatch one device call to the executor, tracked in
+        ``_inflight`` so teardown can wait out the worker thread."""
+        fut = loop.run_in_executor(None, fn, *args)
+        self._inflight.add(fut)
+        fut.add_done_callback(self._inflight.discard)
+        return fut
+
+    async def _drain_inflight(self, futures) -> None:
+        """Wait out still-running device calls (results discarded):
+        closing a backend under a live ``search`` thread would be a
+        use-after-close on the device."""
+        pending = [f for f in futures if not f.done()]
+        if pending:
+            await asyncio.gather(*pending, return_exceptions=True)
+
+    async def _close_backends(self, backends: dict) -> None:
         # backends with teardown needs (fused-pod: release the follower
         # processes blocked in their lockstep broadcast). Off the loop
         # thread: a close may block on cross-host coordination (bounded
         # internally), and the event loop must keep serving meanwhile.
         loop = asyncio.get_running_loop()
-        for backend in self.backends.values():
+        for backend in backends.values():
             close = getattr(backend, "close", None)
             if close is not None:
                 try:
@@ -151,8 +203,87 @@ class MiningEngine:
                 except Exception:
                     log.exception("backend %s close failed",
                                   getattr(backend, "name", "?"))
+
+    async def stop(self) -> None:
+        self.state = EngineState.STOPPING
+        self._stop.set()
+        self._job_event.set()
+        await self._cancel_searchers()
+        await self._drain_inflight(list(self._inflight))
+        await self._close_backends(self.backends)
         self.state = EngineState.STOPPED
         log.info("engine stopped")
+
+    # -- warm algorithm switching -------------------------------------------
+
+    def planned_batch(self, backend) -> int:
+        """The batch size the hot loop will dispatch to ``backend`` —
+        exposed so warm-swap precompiles can compile the EXACT production
+        shape (batch-shape-keyed programs: pallas, pods)."""
+        batch_size = self.config.batch_size
+        if self.config.auto_batch:
+            batch_size = max(batch_size, getattr(backend, "preferred_batch", 0))
+        max_batch = getattr(backend, "max_batch", None)
+        if max_batch:
+            batch_size = min(batch_size, max_batch)
+        return batch_size
+
+    async def switch_algorithm(
+        self, algorithm: str, backends: dict[str, SearchBackendProtocol]
+    ) -> float:
+        """Atomic warm swap of the backend set (double-buffered switch).
+
+        Callers build AND precompile ``backends`` first, off the event
+        loop, while the current algorithm keeps mining
+        (``AlgorithmManager.prepare_backend_async``) — so the only
+        downtime this method pays is searcher teardown/spawn: one batch
+        boundary, never an XLA compile. Returns the measured downtime in
+        seconds (old searchers cancelled -> new searchers spawned).
+        """
+        if not backends:
+            raise ValueError("need at least one search backend")
+        algos.get(algorithm)  # unknown algorithm fails before teardown
+        was_running = self.state == EngineState.RUNNING
+        old_backends = self.backends
+        t0 = time.monotonic()
+        if was_running:
+            await self._cancel_searchers()
+        # snapshot BEFORE spawning: only the old backends' device calls
+        # must finish before those backends close; the new searchers can
+        # dispatch meanwhile (the device serializes the overlap)
+        old_inflight = [f for f in self._inflight if not f.done()]
+        self.backends = backends
+        self.config.algorithm = algorithm
+        self.stats.algorithm = algorithm
+        # drop departed devices: a stale EMA entry would keep inflating
+        # the summed engine hashrate forever
+        self.stats.devices = {
+            name: self.stats.devices.get(name, DeviceStats())
+            for name in backends
+        }
+        job = self._job
+        if job is not None and _canon_algo(job.algorithm) != _canon_algo(algorithm):
+            # the old algorithm's job is meaningless to the new backends;
+            # searchers idle on the job event until the new feed delivers
+            self._job = None
+            self._job_serial += 1
+            self._job_event.set()
+        if was_running:
+            self._spawn_searchers()
+        downtime = time.monotonic() - t0
+        self._switches += 1
+        self._last_switch_downtime = downtime
+        log.info(
+            "engine switched to %s in %.3fs (backends: %s)",
+            algorithm, downtime, list(backends),
+        )
+        # old backends close AFTER the new searchers are live — teardown
+        # (possibly cross-host) is not part of the downtime window — and
+        # only once their last in-flight device call has drained
+        if old_backends is not backends:
+            await self._drain_inflight(old_inflight)
+            await self._close_backends(old_backends)
+        return downtime
 
     # -- the hot host loop --------------------------------------------------
 
@@ -176,19 +307,11 @@ class MiningEngine:
             # pod's host rows — runtime.mesh.PodBackend.en2_fanout); devices
             # own disjoint blocks laid out by the engine at start()
             fanout = getattr(backend, "en2_fanout", 1)
-            batch_size = self.config.batch_size
-            if self.config.auto_batch:
-                batch_size = max(
-                    batch_size, getattr(backend, "preferred_batch", 0)
-                )
-            # slow-algorithm backends (scrypt/x11/ethash — kH/s, not GH/s)
-            # cap their batch so one search call stays seconds long: a
-            # clean-job invalidation mid-call must not strand minutes of
-            # stale work. A backend-advertised hard cap, independent of
-            # auto_batch tuning.
-            max_batch = getattr(backend, "max_batch", None)
-            if max_batch:
-                batch_size = min(batch_size, max_batch)
+            # batch sizing: auto_batch adoption + the slow-algorithm cap
+            # (scrypt/x11/ethash — kH/s, not GH/s — cap their batch so one
+            # search call stays seconds long: a clean-job invalidation
+            # mid-call must not strand minutes of stale work)
+            batch_size = self.planned_batch(backend)
             depth = max(1, self.config.pipeline_depth)
             extranonce = ExtranonceCounter(size=job.extranonce2_size or self.config.extranonce2_size)
             extranonce.value = en2_offset
@@ -210,10 +333,12 @@ class MiningEngine:
                 en2s = [extranonce.current()]
                 for _ in range(fanout - 1):
                     en2s.append(extranonce.roll())
-                jcs = [
-                    await loop.run_in_executor(None, job_constants, job, en2)
-                    for en2 in en2s
-                ]
+                # ONE executor round-trip for the whole fanout: a pod's
+                # n_hosts midstates cost one thread handoff, not n_hosts
+                # sequential loop->thread->loop bounces
+                jcs = await loop.run_in_executor(
+                    None, _job_constants_batch, job, en2s
+                )
                 space = NonceRange(0, 1 << 32)
                 t_last = time.monotonic()
                 # lazy batching: at clamped (slow-algorithm) batch sizes the
@@ -243,18 +368,18 @@ class MiningEngine:
                         if fd.drop:
                             continue
                     if grouped:
-                        fut = loop.run_in_executor(
-                            None, backend.search_group, jcs[0], unit
+                        fut = self._run_device(
+                            loop, backend.search_group, jcs[0], unit
                         )
                     elif fanout > 1:
                         base, count = unit[0]
-                        fut = loop.run_in_executor(
-                            None, backend.search_multi, jcs, base, count
+                        fut = self._run_device(
+                            loop, backend.search_multi, jcs, base, count
                         )
                     else:
                         base, count = unit[0]
-                        fut = loop.run_in_executor(
-                            None, backend.search, jcs[0], base, count
+                        fut = self._run_device(
+                            loop, backend.search, jcs[0], base, count
                         )
                     pending.append((en2s, fut))
                     # grouped backends already overlap inside one call, so
@@ -336,6 +461,10 @@ class MiningEngine:
     def snapshot(self) -> dict:
         snap = self.stats.snapshot()
         snap["state"] = self.state.value
+        snap["switches"] = self._switches
+        snap["last_switch_downtime_seconds"] = round(
+            self._last_switch_downtime, 6
+        )
         inj = faults.get()
         if inj is not None:
             # chaos runs are observable where operators already look:

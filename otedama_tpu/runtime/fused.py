@@ -296,6 +296,19 @@ class FusedPodBackend:
             f"{self.driver.n_rows}x{self.driver.pod.n_chips}"
         )
 
+    def precompile(self, jc=None, count: int | None = None) -> float:
+        """Warm-swap for the whole fused pod: one lockstep warmup step
+        compiles the algorithm's SPMD program on the leader AND every
+        follower (they mirror the step in ``follower_loop``), so an
+        algorithm switch on a fused pod is also compile-free."""
+        from otedama_tpu.runtime.search import warmup_backend
+
+        return warmup_backend(
+            self, jc,
+            count if count else self.driver.pod.n_chips * getattr(
+                self.driver.pod, "tile", 1),
+        )
+
     def search_multi(self, jcs, base: int, count: int):
         return self.driver.step(jcs, base, count, algo=self.algorithm)
 

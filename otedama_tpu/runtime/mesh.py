@@ -395,6 +395,16 @@ class PodBackend:
         self.en2_fanout = self.pod.n_hosts
         self.name = f"pod{self.pod.n_hosts}x{self.pod.n_chips}"
 
+    def precompile(self, jc=None, count: int | None = None) -> float:
+        """Warm-swap support: the SPMD program is per-chip-shape-keyed
+        (count / n_chips rounded to tiles), so swap callers pass the
+        engine's planned batch; the default warms one tile per chip."""
+        from otedama_tpu.runtime.search import warmup_backend
+
+        return warmup_backend(
+            self, jc, count if count else self.pod.n_chips * self.pod.tile
+        )
+
     def search_multi(
         self, jcs: list[JobConstants], base: int, count: int
     ) -> list[SearchResult]:
@@ -591,6 +601,14 @@ class ScryptPodBackend:
         # slow-algorithm cap (see engine._search_loop): ~1-2 s of scrypt
         # per chip per call at the measured per-chip rate
         self.max_batch = (1 << 15) * self.pod.n_chips
+
+    def precompile(self, jc=None, count: int | None = None) -> float:
+        """Per-chip shape follows count/n_chips: the production batch is
+        the clamped ``max_batch``, so warming it IS one production batch
+        (seconds of device time — the price of a compile-free swap)."""
+        from otedama_tpu.runtime.search import warmup_backend
+
+        return warmup_backend(self, jc, count if count else self.max_batch)
 
     def search_multi(
         self, jcs: list[JobConstants], base: int, count: int
@@ -792,6 +810,14 @@ class X11PodBackend:
         self.name = f"x11-pod{self.pod.n_hosts}x{self.pod.n_chips}"
         # slow-algorithm cap (see engine._search_loop)
         self.max_batch = (1 << 12) * self.pod.n_chips
+
+    def precompile(self, jc=None, count: int | None = None) -> float:
+        """The x11 pod's per-chip window is FIXED at ``pod.chunk`` (the
+        chain is minutes-per-shape to compile), so any warm count covers
+        every later call — one chip-row window is enough."""
+        from otedama_tpu.runtime.search import warmup_backend
+
+        return warmup_backend(self, jc, count if count else self.pod.n_chips)
 
     def search_multi(
         self, jcs: list[JobConstants], base: int, count: int

@@ -85,6 +85,77 @@ class Winner:
         return struct.pack(">I", self.nonce_word).hex()
 
 
+def synthetic_job_constants(block_number: int = 0) -> JobConstants:
+    """Fixed synthetic job for warmup/benchmark paths: target=0 means no
+    winner ever fires, so a warmup batch costs device time only (no host
+    digest work). The bytes are arbitrary but STABLE — the compiled
+    programs are shape-keyed, not value-keyed, so any job works, and a
+    stable one keeps benchmark runs comparable."""
+    header76 = bytes(range(64)) + struct.pack(
+        ">3I", 0x17034219, 0x6530D1B7, 0x1D00FFFF
+    )
+    return JobConstants.from_header_prefix(
+        header76, target=0, block_number=block_number
+    )
+
+
+def _precompile_aot_step(backend, algorithm: str, jc: JobConstants,
+                         jit_fn, args: tuple, static: dict) -> float:
+    """Shared precompile policy for backends whose step is a module-level
+    jit: AOT-lower + compile (``jaxcompat.aot_compile``), validate the
+    executable with a live call before trusting it on the hot path, fall
+    back to a one-chunk warmup batch where AOT is unavailable or rejects.
+    Sets ``backend._aot`` on success; records + returns wall seconds."""
+    from otedama_tpu.utils import compile_cache
+
+    t0 = time.monotonic()
+    with compile_cache.attribution(algorithm, backend.name):
+        aot = jaxcompat.aot_compile(jit_fn, *args, static=static)
+        if aot is not None:
+            try:
+                hits, h0 = aot(*args)
+                np.asarray(hits), np.asarray(h0)
+                backend._aot = aot
+            except Exception:
+                log.warning(
+                    "AOT-compiled %s step rejected a live call — "
+                    "falling back to jit dispatch", algorithm,
+                    exc_info=True)
+                aot = None
+        if aot is None:
+            backend.search(jc, 0, 1)  # warmup: one chunk-shaped step
+    seconds = time.monotonic() - t0
+    compile_cache.record_precompile(algorithm, backend.name, seconds)
+    return seconds
+
+
+def warmup_backend(backend, jc: JobConstants | None = None,
+                   count: int | None = None) -> float:
+    """Generic ``precompile`` fallback: run one minimal-count search over
+    the backend's PRODUCTION call path so every program the hot loop will
+    dispatch is compiled (and, with the persistent cache enabled, written
+    to disk) before the engine depends on it. Compile events fired during
+    the warmup are attributed to (algorithm, backend) in
+    ``utils.compile_cache``. Returns wall seconds."""
+    from otedama_tpu.utils import compile_cache
+
+    jc = synthetic_job_constants() if jc is None else jc
+    algorithm = getattr(backend, "algorithm", "sha256d")
+    name = getattr(backend, "name", type(backend).__name__)
+    count = 1 if count is None else max(1, int(count))
+    t0 = time.monotonic()
+    with compile_cache.attribution(algorithm, name):
+        fanout = getattr(backend, "en2_fanout", 1)
+        if fanout > 1:
+            backend.search_multi([jc] * fanout, 0, count)
+        else:
+            backend.search(jc, 0, count)
+    seconds = time.monotonic() - t0
+    compile_cache.record_precompile(algorithm, name, seconds)
+    log.info("warmed %s/%s in %.2fs", algorithm, name, seconds)
+    return seconds
+
+
 @dataclasses.dataclass
 class SearchResult:
     winners: list[Winner]
@@ -181,6 +252,24 @@ class XlaBackend:
     def __init__(self, chunk: int = 1 << 16, rolled: bool | None = None):
         self.chunk = chunk
         self.rolled = _default_rolled() if rolled is None else rolled
+        # AOT-compiled step (precompile): same program, dispatched without
+        # the jit tracing/cache machinery
+        self._aot = None
+
+    def precompile(self, jc: JobConstants | None = None,
+                   count: int | None = None) -> float:
+        """AOT-lower the chunk-shaped step where this jax supports it;
+        warmup-batch fallback (``_precompile_aot_step``). After this,
+        ``search`` never compiles again for this chunk shape."""
+        jc = synthetic_job_constants() if jc is None else jc
+        ms = jnp.asarray(np.array(jc.midstate, dtype=np.uint32))
+        tl = jnp.asarray(np.array(jc.tail, dtype=np.uint32))
+        lb = jnp.asarray(jc.limbs)
+        return _precompile_aot_step(
+            self, "sha256d", jc, _xla_search_step,
+            (ms, tl, jnp.uint32(0), lb),
+            {"n": self.chunk, "rolled": self.rolled},
+        )
 
     def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
         ms = jnp.asarray(np.array(jc.midstate, dtype=np.uint32))
@@ -188,6 +277,8 @@ class XlaBackend:
         lb = jnp.asarray(jc.limbs)
 
         def step(b):
+            if self._aot is not None:
+                return self._aot(ms, tl, jnp.uint32(b), lb)
             return _xla_search_step(
                 ms, tl, jnp.uint32(b), lb, n=self.chunk, rolled=self.rolled
             )
@@ -247,6 +338,20 @@ class PallasBackend:
     @property
     def tile(self) -> int:
         return self.sub * 128
+
+    def precompile(self, jc: JobConstants | None = None,
+                   count: int | None = None) -> float:
+        """The Pallas program is batch-shape-keyed, so warm the shape the
+        engine will actually dispatch: callers on the swap path pass the
+        engine's planned batch. The warmup's target=0 job never flags a
+        tile, so the winner-rescan XLA programs are precompiled
+        explicitly — the first REAL share must not pay a jit compile
+        mid-hot-path."""
+        jc = synthetic_job_constants() if jc is None else jc
+        seconds = self._rescan.precompile(jc)
+        seconds += self._rescan_full.precompile(jc)
+        return seconds + warmup_backend(
+            self, jc, count if count else self.tile)
 
     def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
         return self.search_group(jc, [(base, count)])[0]
@@ -328,6 +433,26 @@ class ScryptXlaBackend:
         self.max_batch = 4 * chunk
         self.rolled = _default_rolled() if rolled is None else rolled
         self.blockmix = blockmix
+        self._aot = None
+
+    def precompile(self, jc: JobConstants | None = None,
+                   count: int | None = None) -> float:
+        """AOT-lower the chunk-shaped scrypt step; warmup-batch fallback
+        (``_precompile_aot_step``). One chunk of lanes is the whole
+        program — count is shape-irrelevant here."""
+        from otedama_tpu.kernels import scrypt_jax as sc
+
+        jc = synthetic_job_constants() if jc is None else jc
+        h19 = jnp.asarray(
+            np.array(sc.header_words19(jc.header76), dtype=np.uint32)
+        )
+        lb = jnp.asarray(jc.limbs)
+        return _precompile_aot_step(
+            self, self.algorithm, jc, sc.scrypt_search_step,
+            (h19, jnp.uint32(0), lb),
+            {"n": self.chunk, "rolled": self.rolled,
+             "blockmix": self.blockmix},
+        )
 
     def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
         from otedama_tpu.kernels import scrypt_jax as sc
@@ -338,6 +463,8 @@ class ScryptXlaBackend:
         lb = jnp.asarray(jc.limbs)
 
         def step(b):
+            if self._aot is not None:
+                return self._aot(h19, jnp.uint32(b), lb)
             return sc.scrypt_search_step(
                 h19, jnp.uint32(b), lb, n=self.chunk, rolled=self.rolled,
                 blockmix=self.blockmix,
@@ -390,6 +517,10 @@ class ScryptPythonBackend:
     name = "scrypt-python"
     algorithm = "scrypt"
 
+    def precompile(self, jc: JobConstants | None = None,
+                   count: int | None = None) -> float:
+        return warmup_backend(self, jc, 1)  # no jit: trivially warm
+
     def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
         from otedama_tpu.kernels import scrypt_jax as sc
 
@@ -413,6 +544,10 @@ class X11NumpyBackend:
     def __init__(self, chunk: int = 1 << 10):
         self.chunk = chunk
         self.max_batch = 4 * chunk  # see ScryptXlaBackend.max_batch
+
+    def precompile(self, jc: JobConstants | None = None,
+                   count: int | None = None) -> float:
+        return warmup_backend(self, jc, 1)  # numpy pipeline: no jit
 
     def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
         from otedama_tpu.kernels import x11
@@ -469,6 +604,14 @@ class X11JaxBackend:
                     cnt_variant=shavite.active_cnt_variant(),
                 )
         return self._fn
+
+    def precompile(self, jc: JobConstants | None = None,
+                   count: int | None = None) -> float:
+        """x11-jax pays the LARGEST compile of any backend (~4 min on
+        CPU) — exactly the stall the warm-swap path exists to hide. The
+        fixed_shape contract means one warmup chunk covers every later
+        call."""
+        return warmup_backend(self, jc, 1)
 
     def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
         import jax
@@ -634,6 +777,13 @@ class EthashLightBackend:
             # the host copy would pin tens of MB per resident epoch
             self.cache = None
             self.name = "ethash-full"
+
+    def precompile(self, jc: JobConstants | None = None,
+                   count: int | None = None) -> float:
+        """One full production-shaped chunk: the hashimoto programs are
+        keyed on the nonce-batch shape, and a 1-nonce warmup would compile
+        a shape the hot loop never dispatches."""
+        return warmup_backend(self, jc, self.chunk)
 
     def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
         eth = self._eth
@@ -876,6 +1026,13 @@ class EthashManagedBackend:
 
     # -- search --------------------------------------------------------------
 
+    def precompile(self, jc: JobConstants | None = None,
+                   count: int | None = None) -> float:
+        """Build the job's epoch light tier AND warm one production-shaped
+        chunk through it (the full-DAG upgrade stays a background build,
+        as in steady state)."""
+        return warmup_backend(self, jc, count if count else self.chunk)
+
     def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
         if jc.block_number <= 0 and not self._warned_no_height:
             # stratum-V1-fed jobs carry no height, so block_number stays
@@ -934,6 +1091,10 @@ class PythonBackend:
     the reference's stdlib-crypto CPU path, internal/mining/workers.go:330)."""
 
     name = "python"
+
+    def precompile(self, jc: JobConstants | None = None,
+                   count: int | None = None) -> float:
+        return warmup_backend(self, jc, 1)  # no jit: trivially warm
 
     def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
         return _scalar_search(jc, base, count, jc.digest_for)

@@ -425,6 +425,11 @@ class NoiseCertificate:
 # -- SV2 noise framing over asyncio streams -----------------------------------
 
 MAX_NOISE_MSG = 65535  # u16 length prefix
+AEAD_TAG_LEN = 16
+# largest plaintext chunk one noise message carries (the AEAD tag rides
+# inside the u16 envelope); SV2 frames carry a u24 payload length, so a
+# frame can be ~256x this — seal() fragments, recv reassembles
+MAX_NOISE_PLAINTEXT = MAX_NOISE_MSG - AEAD_TAG_LEN  # 65519
 
 
 async def _read_lp(reader) -> bytes:
@@ -453,13 +458,37 @@ class NoiseSession:
         self.certificate = certificate  # verified authority endorsement
 
     def seal(self, frame: bytes) -> bytes:
-        ct = self.send_cipher.encrypt(frame)
-        if len(ct) > MAX_NOISE_MSG:
-            raise ValueError("frame too large for one noise message")
-        return struct.pack("<H", len(ct)) + ct
+        """Encrypt one whole SV2 frame as ONE OR MORE noise messages.
+
+        SV2 frames carry a u24 payload length but a noise message tops out
+        at u16, so oversized frames fragment into sequential
+        ``MAX_NOISE_PLAINTEXT``-byte chunks (each with its own AEAD tag and
+        nonce — the cipher counter orders them; a reordered or dropped
+        fragment fails decryption). The receiver reassembles by the frame
+        header's length field (``recv_frame_bytes``).
+        """
+        parts = []
+        for off in range(0, max(len(frame), 1), MAX_NOISE_PLAINTEXT):
+            ct = self.send_cipher.encrypt(frame[off:off + MAX_NOISE_PLAINTEXT])
+            parts.append(struct.pack("<H", len(ct)) + ct)
+        return b"".join(parts)
 
     async def recv_frame_bytes(self, reader) -> bytes:
-        return self.recv_cipher.decrypt(await _read_lp(reader))
+        """Read + decrypt one whole SV2 frame, reassembling fragments.
+
+        The first fragment always covers the 6-byte frame header (chunks
+        are 65519 bytes), whose u24 length field says how much is still in
+        flight. A peer that overshoots the declared length desyncs the
+        stream; the overlong buffer is returned as-is so the frame parser
+        rejects it loudly (``v2.parse_frame`` length check) instead of
+        this layer silently resynchronizing."""
+        buf = self.recv_cipher.decrypt(await _read_lp(reader))
+        if len(buf) < 6:
+            return buf  # short/garbage frame: the parser's problem
+        need = 6 + int.from_bytes(buf[3:6], "little")
+        while len(buf) < need:
+            buf += self.recv_cipher.decrypt(await _read_lp(reader))
+        return buf
 
 
 async def client_handshake(reader, writer,

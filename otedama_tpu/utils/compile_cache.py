@@ -1,0 +1,264 @@
+"""Compilation-lifecycle subsystem: persistent XLA cache + observability.
+
+Every algorithm compiles its own XLA program, so before this module a
+profit-switch or a process restart paid a full JIT compile (minutes for
+the unrolled paths — ``runtime/search._default_rolled``) with mining
+stalled for the duration. This module removes or amortizes that cost:
+
+- ``enable(cache_dir)`` points jax's persistent compilation cache at a
+  directory (version-guarded like ``utils/jaxcompat``): a restart or a
+  re-built backend deserializes its XLA binary from disk instead of
+  recompiling. Configured via ``mining.compile_cache_dir`` (env:
+  ``OTEDAMA_MINING_COMPILE_CACHE_DIR``); jax's own
+  ``JAX_COMPILATION_CACHE_DIR`` works too, upstream of this module.
+- ``install()`` registers ``jax.monitoring`` listeners that count cache
+  hits/misses and time every backend-compile request, attributed to the
+  (algorithm, backend) whose ``precompile()``/search triggered it (the
+  ``attribution`` context below). Steady-state mining MUST add zero
+  compile events — that is the shape-discipline audit tests pin.
+- snapshots feed ``/api/v1/stats`` (``compile`` provider) and
+  ``/metrics`` (``otedama_compile_seconds``,
+  ``otedama_compile_cache_hits_total`` — ``ApiServer.sync_compile_metrics``).
+
+The module never imports jax at import time and degrades to no-ops on a
+jax without the monitoring/cache surface: observability is off, mining
+is unaffected.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import threading
+
+from otedama_tpu.utils.histogram import LatencyHistogram
+
+log = logging.getLogger("otedama.compile_cache")
+
+# compile durations span cache-hit deserializes (~ms) to unrolled
+# XLA-CPU sha256d compiles (minutes) — a much wider ladder than the
+# share-latency default
+COMPILE_BUCKETS = (
+    0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0, 300.0, 1200.0
+)
+
+# jax.monitoring event names (stable across 0.4.x; unknown names are
+# simply never delivered, so a rename degrades to zero counters, not
+# a crash)
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_UNATTRIBUTED = ("unattributed", "unattributed")
+
+
+class _State:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.install_attempted = False
+        self.installed = False
+        self.cache_dir: str | None = None
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.compiles = 0
+        self.compile_seconds = 0.0
+        # (algorithm, backend) -> compile-duration histogram
+        self.histograms: dict[tuple[str, str], LatencyHistogram] = {}
+        # (algorithm, backend) -> last precompile() wall seconds
+        self.precompiles: dict[tuple[str, str], float] = {}
+        self.ctx = threading.local()  # per-thread attribution key
+
+
+_state = _State()
+
+
+def _on_event(event: str, **kwargs) -> None:
+    if event == _HIT_EVENT:
+        with _state.lock:
+            _state.cache_hits += 1
+    elif event == _MISS_EVENT:
+        with _state.lock:
+            _state.cache_misses += 1
+
+
+def _on_duration(event: str, duration: float, **kwargs) -> None:
+    if event != _COMPILE_EVENT:
+        return
+    key = getattr(_state.ctx, "key", None) or _UNATTRIBUTED
+    with _state.lock:
+        _state.compiles += 1
+        _state.compile_seconds += duration
+        hist = _state.histograms.get(key)
+        if hist is None:
+            hist = _state.histograms[key] = LatencyHistogram(COMPILE_BUCKETS)
+    hist.observe(duration)  # histogram carries its own lock
+
+
+def install() -> bool:
+    """Register the jax.monitoring listeners (idempotent, one attempt).
+
+    There is no unregister API, so registration is process-lifetime —
+    exactly the scope of the counters.
+    """
+    with _state.lock:
+        if _state.install_attempted:
+            return _state.installed
+        _state.install_attempted = True
+    try:
+        from jax import monitoring
+
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        with _state.lock:
+            _state.installed = True
+        return True
+    except Exception:
+        log.warning(
+            "jax.monitoring unavailable — compile observability disabled",
+            exc_info=True,
+        )
+        return False
+
+
+def enable(cache_dir: str, min_compile_seconds: float = 0.0) -> bool:
+    """Point jax's persistent compilation cache at ``cache_dir``.
+
+    ``min_compile_seconds=0`` persists even tiny programs — an algorithm
+    set is a handful of programs, and the whole point is that the SECOND
+    process (or the rebuilt backend after a switch cycle) compiles
+    nothing. Returns True when the running jax honors the cache.
+    """
+    install()
+    cache_dir = os.path.abspath(os.path.expanduser(cache_dir))
+    os.makedirs(cache_dir, exist_ok=True)
+    import jax
+
+    enabled = False
+    try:  # modern spelling: a config knob
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        enabled = True
+    except Exception:
+        try:  # older trees: the experimental module API
+            from jax.experimental.compilation_cache import (
+                compilation_cache as cc,
+            )
+
+            if hasattr(cc, "set_cache_dir"):
+                cc.set_cache_dir(cache_dir)
+            else:
+                cc.initialize_cache(cache_dir)
+            enabled = True
+        except Exception:
+            log.warning(
+                "this jax exposes no compilation-cache API — persistent "
+                "cache disabled", exc_info=True,
+            )
+    # best-effort companion knobs (absent names are fine)
+    for knob, value in (
+        ("jax_enable_compilation_cache", True),
+        ("jax_persistent_cache_min_compile_time_secs", min_compile_seconds),
+        ("jax_persistent_cache_min_entry_size_bytes", 0),
+    ):
+        try:
+            jax.config.update(knob, value)
+        except Exception:
+            pass
+    if enabled:
+        _reset_jax_cache_gate()
+        with _state.lock:
+            _state.cache_dir = cache_dir
+        log.info("persistent XLA compile cache at %s", cache_dir)
+    return enabled
+
+
+def _reset_jax_cache_gate() -> None:
+    """jax decides ONCE per process whether the persistent cache is in
+    use (``_cache_checked``); enabling/moving the cache after any compile
+    has happened needs that verdict re-evaluated or every later compile
+    silently bypasses the cache."""
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:
+        log.debug("jax compilation-cache reset unavailable", exc_info=True)
+
+
+def disable() -> None:
+    """Detach the persistent cache (tests restore global state with this)."""
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", None)
+    except Exception:
+        pass
+    _reset_jax_cache_gate()
+    with _state.lock:
+        _state.cache_dir = None
+
+
+@contextlib.contextmanager
+def attribution(algorithm: str, backend: str):
+    """Attribute compile events fired on THIS thread to (algorithm,
+    backend) — precompile/warmup paths wrap their device calls in this so
+    the histograms say which program cost what."""
+    prev = getattr(_state.ctx, "key", None)
+    _state.ctx.key = (str(algorithm), str(backend))
+    try:
+        yield
+    finally:
+        _state.ctx.key = prev
+
+
+def record_precompile(algorithm: str, backend: str, seconds: float) -> None:
+    with _state.lock:
+        _state.precompiles[(str(algorithm), str(backend))] = float(seconds)
+
+
+def compiles_total() -> int:
+    """Backend-compile requests so far — the recompile-guard counter.
+
+    Steady-state mining (fixed shapes, warmed backends) must not move
+    this; tests assert exactly that.
+    """
+    with _state.lock:
+        return _state.compiles
+
+
+def counters() -> dict:
+    with _state.lock:
+        return {
+            "cache_hits": _state.cache_hits,
+            "cache_misses": _state.cache_misses,
+            "compiles": _state.compiles,
+            "compile_seconds": round(_state.compile_seconds, 3),
+        }
+
+
+def histograms() -> dict[tuple[str, str], LatencyHistogram]:
+    """Live per-(algorithm, backend) compile histograms (shared objects —
+    readers use their thread-safe accessors)."""
+    with _state.lock:
+        return dict(_state.histograms)
+
+
+def snapshot() -> dict:
+    """API provider: the `compile` section of /api/v1/stats."""
+    with _state.lock:
+        programs = {
+            f"{a}/{b}": h.snapshot() for (a, b), h in _state.histograms.items()
+        }
+        precompiles = {
+            f"{a}/{b}": round(s, 3) for (a, b), s in _state.precompiles.items()
+        }
+        return {
+            "cache_dir": _state.cache_dir,
+            "observability": _state.installed,
+            "cache_hits": _state.cache_hits,
+            "cache_misses": _state.cache_misses,
+            "compiles": _state.compiles,
+            "compile_seconds": round(_state.compile_seconds, 3),
+            "precompile_seconds": precompiles,
+            "programs": programs,
+        }

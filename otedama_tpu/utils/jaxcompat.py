@@ -51,3 +51,25 @@ def shard_map(f, **kwargs):
     if _shard_map is None:
         _shard_map = _resolve_shard_map()
     return _shard_map(f, **kwargs)
+
+
+def aot_compile(jit_fn, *args, static: dict | None = None):
+    """Ahead-of-time ``jit_fn.lower(*args, **static).compile()``.
+
+    Returns the Compiled executable (callable with positional arrays of
+    the lowered shapes/dtypes; the statics are baked in), or None where
+    this jax has no AOT surface or the lowering fails — callers fall back
+    to a warmup batch (``runtime.search.warmup_backend``).
+    """
+    lower = getattr(jit_fn, "lower", None)
+    if lower is None:
+        return None
+    try:
+        return lower(*args, **(static or {})).compile()
+    except Exception:
+        import logging
+
+        logging.getLogger("otedama.jaxcompat").debug(
+            "AOT lower/compile unavailable for %r", jit_fn, exc_info=True
+        )
+        return None

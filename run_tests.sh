@@ -11,6 +11,11 @@
 #                   loopback miners and writes a BENCH_STRATUM json
 #                   artifact. FAILS LOUDLY (exit 2) if the fd limit
 #                   cannot fit the soak — never silently under-tests.
+#   switch-bench    opt-in compilation-lifecycle bench: cold-start with
+#                   cold vs warm persistent XLA cache + mid-run
+#                   sha256d->scrypt warm switch; writes a BENCH_SWITCH
+#                   json artifact and fails if the warm cache is not
+#                   faster or switch downtime exceeds a batch boundary.
 # Extra args pass through to pytest (e.g. ./run_tests.sh fast -k scrypt).
 set -euo pipefail
 cd "$(dirname "$0")"
@@ -25,5 +30,8 @@ case "$tier" in
     exec env JAX_PLATFORMS=cpu python tools/bench_stratum.py \
       --connections "${STRATUM_BENCH_CONNS:-1000}" \
       --out "${STRATUM_BENCH_OUT:-BENCH_STRATUM_manual.json}" "$@" ;;
-  *) echo "usage: $0 [fast|slow|all|audit|stratum-bench] [pytest args...]" >&2; exit 2 ;;
+  switch-bench)
+    exec env JAX_PLATFORMS=cpu python tools/bench_switch.py \
+      --out "${SWITCH_BENCH_OUT:-BENCH_SWITCH_manual.json}" "$@" ;;
+  *) echo "usage: $0 [fast|slow|all|audit|stratum-bench|switch-bench] [pytest args...]" >&2; exit 2 ;;
 esac

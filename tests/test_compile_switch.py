@@ -1,0 +1,273 @@
+"""Compilation lifecycle: persistent cache, AOT precompile, warm switching.
+
+Pins the three guarantees of the zero-stall switching subsystem:
+
+- shape discipline: a precompiled backend driven by the engine for many
+  batches triggers ZERO new XLA compiles (the recompile guard);
+- the persistent compile cache turns a second process's cold start into
+  cache hits (and an in-process rebuild after ``jax.clear_caches`` too);
+- a mid-run algorithm switch keeps shares flowing from the old backend
+  until the new one reports warm, then swaps in bounded time.
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import pytest
+
+from otedama_tpu.engine.algo_manager import AlgorithmManager
+from otedama_tpu.engine.engine import EngineConfig, MiningEngine
+from otedama_tpu.engine.types import Job
+from otedama_tpu.runtime.search import (
+    SearchResult,
+    Winner,
+    XlaBackend,
+    synthetic_job_constants,
+)
+from otedama_tpu.utils import compile_cache
+
+compile_cache.install()
+
+
+def make_job(job_id: str = "j1", algorithm: str = "sha256d") -> Job:
+    return Job(
+        job_id=job_id,
+        prev_hash=bytes(range(32)),
+        coinb1=bytes.fromhex("01000000010000000000000000"),
+        coinb2=bytes.fromhex("ffffffff0100f2052a01000000"),
+        merkle_branch=[bytes([i] * 32) for i in (7, 9)],
+        version=0x20000000,
+        nbits=0x1D00FFFF,
+        ntime=int(time.time()),
+        clean=True,
+        algorithm=algorithm,
+    )
+
+
+# -- recompile guard ----------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_engine_steady_state_adds_zero_compiles():
+    """N engine batches after precompile() must not add a single XLA
+    compile request — steady-state mining is compile-free by contract."""
+    backend = XlaBackend(chunk=1 << 10, rolled=True)
+    loop = asyncio.get_running_loop()
+    await loop.run_in_executor(None, backend.precompile)
+    engine = MiningEngine(
+        backends={"xla": backend},
+        config=EngineConfig(batch_size=1 << 12, auto_batch=False,
+                            pipeline_depth=2),
+    )
+    baseline = compile_cache.compiles_total()
+    await engine.start()
+    engine.set_job(make_job())
+    deadline = time.monotonic() + 20.0
+    while engine.stats.hashes < 5 * (1 << 12):  # ≥5 engine batches
+        assert time.monotonic() < deadline, "engine made no progress"
+        await asyncio.sleep(0.02)
+    await engine.stop()
+    assert compile_cache.compiles_total() == baseline, (
+        "steady-state mining recompiled — shape discipline broken"
+    )
+
+
+def test_precompile_makes_search_compile_free():
+    backend = XlaBackend(chunk=1 << 9, rolled=True)
+    jc = synthetic_job_constants()
+    backend.precompile(jc)
+    baseline = compile_cache.compiles_total()
+    result = backend.search(jc, 0, 3 * (1 << 9))
+    assert result.hashes == 3 * (1 << 9)
+    assert compile_cache.compiles_total() == baseline
+    # precompile telemetry landed under the right key
+    snap = compile_cache.snapshot()
+    assert "sha256d/xla" in snap["precompile_seconds"]
+
+
+# -- persistent cache ---------------------------------------------------------
+
+def test_compile_cache_hits_after_cache_clear(tmp_path):
+    """Enable the persistent cache, compile, drop the in-memory caches
+    (what a fresh process starts with), recompile: the second compile must
+    be served from disk (cache_hits advances)."""
+    assert compile_cache.enable(str(tmp_path / "xla-cache"))
+    try:
+        import jax.numpy as jnp
+
+        fn = jax.jit(lambda x: (x * 5 + 3) ^ (x >> 7))
+        arg = jnp.arange(1013, dtype=jnp.uint32)
+        before = compile_cache.counters()
+        fn(arg).block_until_ready()
+        mid = compile_cache.counters()
+        assert mid["cache_misses"] > before["cache_misses"]
+        jax.clear_caches()
+        fn(arg).block_until_ready()
+        after = compile_cache.counters()
+        assert after["cache_hits"] > mid["cache_hits"]
+    finally:
+        compile_cache.disable()
+
+
+def test_compile_cache_hit_on_second_process(tmp_path):
+    """The real restart story: two processes, one cache dir — the second
+    compiles nothing it can deserialize."""
+    script = tmp_path / "compile_once.py"
+    script.write_text(
+        "import os, sys, json\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "from otedama_tpu.utils import compile_cache\n"
+        "compile_cache.install()\n"
+        "assert compile_cache.enable(sys.argv[1])\n"
+        "import jax, jax.numpy as jnp\n"
+        "fn = jax.jit(lambda x: (x * 7 + 11) ^ (x >> 3))\n"
+        "fn(jnp.arange(997, dtype=jnp.uint32)).block_until_ready()\n"
+        "print(json.dumps(compile_cache.counters()))\n"
+    )
+    cache_dir = str(tmp_path / "xla-cache2")
+    env = dict(os.environ, PYTHONPATH=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+    def run_once() -> dict:
+        out = subprocess.run(
+            [sys.executable, str(script), cache_dir],
+            capture_output=True, text=True, timeout=300, env=env,
+        )
+        assert out.returncode == 0, out.stderr
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    first = run_once()
+    assert first["cache_misses"] >= 1
+    assert first["cache_hits"] == 0
+    second = run_once()
+    assert second["cache_hits"] >= 1, (
+        f"second process recompiled: {second}"
+    )
+
+
+# -- warm algorithm switching -------------------------------------------------
+
+class StubBackend:
+    """Minimal engine backend: fabricated winner per call, slow warmup."""
+
+    def __init__(self, name: str, algorithm: str, warm_seconds: float = 0.0):
+        self.name = name
+        self.algorithm = algorithm
+        self.warm_seconds = warm_seconds
+        self.warmed = False
+        self.calls = 0
+        self.closed = False
+        self.max_batch = 256
+
+    def precompile(self, jc=None, count=None) -> float:
+        time.sleep(self.warm_seconds)
+        self.warmed = True
+        return self.warm_seconds
+
+    def search(self, jc, base, count) -> SearchResult:
+        if not self.warmed and self.warm_seconds:
+            raise AssertionError("searched before warm — swap was not warm")
+        self.calls += 1
+        time.sleep(0.002)
+        return SearchResult(
+            [Winner(base & 0xFFFFFFFF, b"\xff" * 32)], count, 0xFFFFFFFF
+        )
+
+    def close(self) -> None:
+        self.closed = True
+
+
+@pytest.mark.asyncio
+async def test_switch_keeps_shares_flowing_until_warm():
+    shares = []
+
+    async def on_share(share):
+        shares.append(share)
+
+    old = StubBackend("stub-old", "sha256d")
+    old.warmed = True
+    engine = MiningEngine(
+        backends={old.name: old},
+        on_share=on_share,
+        config=EngineConfig(batch_size=256, auto_batch=False,
+                            pipeline_depth=1),
+    )
+    await engine.start()
+    engine.set_job(make_job("sha-job", "sha256d"))
+
+    async def wait_for(cond, timeout=10.0):
+        deadline = time.monotonic() + timeout
+        while not cond():
+            assert time.monotonic() < deadline, "timed out"
+            await asyncio.sleep(0.01)
+
+    await wait_for(lambda: len(shares) >= 3)
+
+    # double-buffered prepare: the new backend warms in an executor while
+    # the old algorithm keeps mining
+    new = StubBackend("stub-new", "scrypt", warm_seconds=0.4)
+    loop = asyncio.get_running_loop()
+    prepare = loop.run_in_executor(None, new.precompile)
+    n_before = len(shares)
+    await asyncio.sleep(0.2)  # mid-warmup
+    assert not prepare.done() or new.warmed
+    assert len(shares) > n_before, "old algorithm stalled during warmup"
+    await prepare
+    assert new.warmed
+
+    downtime = await engine.switch_algorithm("scrypt", {new.name: new})
+    assert downtime < 5.0
+    assert engine.config.algorithm == "scrypt"
+    assert old.closed, "old backend was not released"
+    # the old algorithm's job must not survive the swap
+    assert engine._job is None
+    calls_after_swap = old.calls
+    await asyncio.sleep(0.05)
+    assert old.calls == calls_after_swap, "old backend searched after swap"
+
+    engine.set_job(make_job("scrypt-job", "scrypt"))
+    await wait_for(lambda: new.calls >= 2)
+    await wait_for(lambda: any(s.algorithm == "scrypt" for s in shares))
+
+    snap = engine.snapshot()
+    assert snap["switches"] == 1
+    assert snap["last_switch_downtime_seconds"] == pytest.approx(
+        downtime, abs=1e-3)
+    assert set(snap["devices"]) == {new.name}
+    await engine.stop()
+
+
+@pytest.mark.asyncio
+async def test_prepare_backend_async_returns_warm_backend():
+    mgr = AlgorithmManager(preferred_backend="xla")
+    backend = await mgr.prepare_backend_async(
+        "sha256d", kind="xla", chunk=1 << 9, rolled=True
+    )
+    baseline = compile_cache.compiles_total()
+    backend.search(synthetic_job_constants(), 0, 1 << 9)
+    assert compile_cache.compiles_total() == baseline
+
+
+@pytest.mark.asyncio
+async def test_benchmark_refuses_event_loop_thread():
+    mgr = AlgorithmManager(preferred_backend="xla")
+    with pytest.raises(RuntimeError, match="benchmark_async"):
+        mgr.benchmark("sha256d", kind="xla", budget_hashes=64)
+    # the executor path stays open
+    result = await mgr.benchmark_async("sha256d", kind="xla",
+                                       budget_hashes=64)
+    assert result.hashes == 64
+
+
+def test_warm_algorithms_config_validation():
+    from otedama_tpu.config.schema import AppConfig, validate_config
+
+    cfg = AppConfig()
+    cfg.mining.warm_algorithms = "scrypt, sha256d"
+    assert validate_config(cfg) == []
+    cfg.mining.warm_algorithms = "scrypt,notanalgo"
+    assert any("notanalgo" in e for e in validate_config(cfg))

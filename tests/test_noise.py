@@ -328,3 +328,76 @@ def test_sv2_authority_cli(tmp_path, monkeypatch):
     # half the verification flags refuses instead of silently skipping
     with pytest.raises(SystemExit, match="together"):
         run("inspect", "--cert", "s1.cert", "--authority-pub", "auth.pub")
+
+
+# -- oversized-frame fragmentation (u24 SV2 frames over u16 noise msgs) -------
+
+def _paired_sessions() -> tuple[noise.NoiseSession, noise.NoiseSession]:
+    """Two transport sessions sharing directional keys (what split()
+    hands each side after a handshake)."""
+    k_ab, k_ba = b"\x11" * 32, b"\x22" * 32
+    a = noise.NoiseSession(noise.CipherState(k_ab), noise.CipherState(k_ba))
+    b = noise.NoiseSession(noise.CipherState(k_ba), noise.CipherState(k_ab))
+    return a, b
+
+
+def _feed(wire: bytes) -> asyncio.StreamReader:
+    reader = asyncio.StreamReader()
+    reader.feed_data(wire)
+    reader.feed_eof()
+    return reader
+
+
+@pytest.mark.asyncio
+async def test_noise_seal_small_frame_is_one_message():
+    from otedama_tpu.stratum.v2 import pack_frame, parse_frame
+
+    a, b = _paired_sessions()
+    frame = pack_frame(0x1E, b"payload")
+    wire = a.seal(frame)
+    # exactly one u16-length-prefixed message: len prefix + ct + tag
+    assert len(wire) == 2 + len(frame) + noise.AEAD_TAG_LEN
+    got = await b.recv_frame_bytes(_feed(wire))
+    assert got == frame
+    assert parse_frame(got) == (0, 0x1E, b"payload")
+
+
+@pytest.mark.asyncio
+async def test_noise_seal_fragments_oversized_frame():
+    from otedama_tpu.stratum.v2 import pack_frame, parse_frame
+
+    a, b = _paired_sessions()
+    payload = bytes(range(256)) * 1000  # 256_000 bytes > 3 * 65519
+    frame = pack_frame(0x1E, payload)
+    wire = a.seal(frame)
+    n_msgs = -(-len(frame) // noise.MAX_NOISE_PLAINTEXT)
+    assert n_msgs == 4
+    assert len(wire) == len(frame) + n_msgs * (2 + noise.AEAD_TAG_LEN)
+    # the stream stays aligned: a second frame follows the big one (the
+    # whole stream is sealed before ANY decryption — cipher counters
+    # advance once per fragment on each side)
+    wire2 = a.seal(pack_frame(0x1F, b"after"))
+    reader = _feed(wire + wire2)
+    got = await b.recv_frame_bytes(reader)
+    assert got == frame
+    ext, mtype, body = parse_frame(got)
+    assert (mtype, body) == (0x1E, payload)
+    assert parse_frame(await b.recv_frame_bytes(reader)) == (0, 0x1F, b"after")
+
+
+@pytest.mark.asyncio
+async def test_noise_fragment_reorder_fails_auth():
+    """Fragment order is enforced by the cipher's nonce counter: swapping
+    two fragments must fail AEAD authentication, never yield bytes."""
+    a, b = _paired_sessions()
+    from otedama_tpu.stratum.v2 import pack_frame
+
+    frame = pack_frame(0x1E, bytes(70_000))
+    wire = a.seal(frame)
+    # split the wire back into its two length-prefixed messages and swap
+    import struct as _struct
+
+    (l1,) = _struct.unpack("<H", wire[:2])
+    m1, m2 = wire[: 2 + l1], wire[2 + l1:]
+    with pytest.raises(noise.AuthError):
+        await b.recv_frame_bytes(_feed(m2 + m1))

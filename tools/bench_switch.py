@@ -1,0 +1,239 @@
+"""Algorithm-switch + cold-start bench (compilation lifecycle).
+
+Measures the two costs the compile-cache/warm-swap subsystem exists to
+kill, and emits a ``BENCH_SWITCH_*.json`` artifact:
+
+1. **Cold start, cold vs warm persistent cache** — three subprocesses
+   each time ``XlaBackend.precompile()`` from a fresh interpreter:
+   no cache, cold cache dir (miss + write), then the same dir again
+   (hit + deserialize). The warm run must beat the cold runs.
+
+2. **Mid-run algorithm switch downtime** — a real ``MiningEngine`` mines
+   sha256d on the XLA backend while the scrypt backend builds AND
+   precompiles in an executor (the double-buffered switch path the app
+   uses); the engine then warm-swaps. Reported downtime is the true
+   mining idle window: last old-algorithm batch completion -> first
+   new-algorithm batch start, which must stay bounded by one batch
+   boundary (it contains no compile).
+
+Usage:
+    python tools/bench_switch.py --out BENCH_SWITCH_r07.json [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import platform
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from otedama_tpu.engine.algo_manager import AlgorithmManager   # noqa: E402
+from otedama_tpu.engine.engine import EngineConfig, MiningEngine  # noqa: E402
+from otedama_tpu.engine.types import Job                       # noqa: E402
+from otedama_tpu.utils import compile_cache                    # noqa: E402
+
+_CHILD = """\
+import json, os, sys, time
+from otedama_tpu.utils import compile_cache
+compile_cache.install()
+cache_dir = sys.argv[1]
+if cache_dir != "-":
+    assert compile_cache.enable(cache_dir)
+from otedama_tpu.runtime.search import XlaBackend
+t0 = time.monotonic()
+backend = XlaBackend(chunk=int(sys.argv[2]), rolled=True)
+seconds = backend.precompile()
+print(json.dumps({
+    "precompile_seconds": seconds,
+    "wall_seconds": time.monotonic() - t0,
+    **compile_cache.counters(),
+}))
+"""
+
+
+def _child_run(cache_dir: str, chunk: int) -> dict:
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    out = subprocess.run(
+        [sys.executable, "-c", _CHILD, cache_dir, str(chunk)],
+        capture_output=True, text=True, timeout=1800, env=env,
+    )
+    if out.returncode != 0:
+        raise RuntimeError(f"cold-start child failed:\n{out.stderr}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def bench_cold_start(chunk: int) -> dict:
+    with tempfile.TemporaryDirectory(prefix="otedama-xla-cache-") as d:
+        no_cache = _child_run("-", chunk)
+        cold = _child_run(d, chunk)
+        warm = _child_run(d, chunk)
+    return {
+        "chunk": chunk,
+        "no_cache_seconds": round(no_cache["precompile_seconds"], 3),
+        "cold_cache_seconds": round(cold["precompile_seconds"], 3),
+        "warm_cache_seconds": round(warm["precompile_seconds"], 3),
+        "cold_cache_misses": cold["cache_misses"],
+        "warm_cache_hits": warm["cache_hits"],
+        "warm_faster_than_cold": (
+            warm["precompile_seconds"] < cold["precompile_seconds"]
+        ),
+        "speedup_vs_cold": round(
+            cold["precompile_seconds"]
+            / max(warm["precompile_seconds"], 1e-9), 2),
+    }
+
+
+class TimedBackend:
+    """Pass-through backend recording per-search (start, end) stamps."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.name = getattr(inner, "name", "timed")
+        self.algorithm = getattr(inner, "algorithm", "sha256d")
+        for attr in ("max_batch", "preferred_batch", "en2_fanout"):
+            if hasattr(inner, attr):
+                setattr(self, attr, getattr(inner, attr))
+        self.events: list[tuple[float, float]] = []
+
+    def precompile(self, jc=None, count=None) -> float:
+        return self._inner.precompile(jc, count=count)
+
+    def search(self, jc, base, count):
+        t0 = time.monotonic()
+        result = self._inner.search(jc, base, count)
+        self.events.append((t0, time.monotonic()))
+        return result
+
+
+def _job(algorithm: str) -> Job:
+    return Job(
+        job_id=f"bench-{algorithm}",
+        prev_hash=bytes(range(32)),
+        coinb1=bytes.fromhex("01000000010000000000000000"),
+        coinb2=bytes.fromhex("ffffffff0100f2052a01000000"),
+        merkle_branch=[bytes([i] * 32) for i in (7, 9)],
+        version=0x20000000,
+        nbits=0x1D00FFFF,
+        ntime=int(time.time()),
+        clean=True,
+        algorithm=algorithm,
+    )
+
+
+async def bench_switch(sha_chunk: int, scrypt_chunk: int,
+                       mine_seconds: float) -> dict:
+    mgr = AlgorithmManager(preferred_backend="xla")
+    old = TimedBackend(await mgr.prepare_backend_async(
+        "sha256d", kind="xla", chunk=sha_chunk, rolled=True))
+    engine = MiningEngine(
+        backends={old.name: old},
+        config=EngineConfig(batch_size=4 * sha_chunk, auto_batch=False,
+                            pipeline_depth=2),
+    )
+    await engine.start()
+    engine.set_job(_job("sha256d"))
+    await asyncio.sleep(mine_seconds)  # steady-state baseline
+
+    # double-buffered prepare: scrypt builds + compiles OFF the loop
+    # while sha256d keeps mining (this is the multi-second compile the
+    # old stop->build->start path ate as downtime)
+    request_at = time.monotonic()
+    new_inner = await mgr.prepare_backend_async(
+        "scrypt", kind="xla", warm_count=engine.planned_batch,
+        chunk=scrypt_chunk, rolled=True)
+    prepare_seconds = time.monotonic() - request_at
+    old_events_during_prepare = [
+        (s, e) for s, e in old.events if s >= request_at]
+
+    new = TimedBackend(new_inner)
+    swap_at = time.monotonic()
+    swap_seconds = await engine.switch_algorithm("scrypt", {new.name: new})
+    engine.set_job(_job("scrypt"))
+    deadline = time.monotonic() + 600
+    while not new.events:
+        if time.monotonic() > deadline:
+            raise RuntimeError("new algorithm produced no batch in 600s")
+        await asyncio.sleep(0.005)
+    first_new_start, first_new_end = new.events[0]
+    await engine.stop()
+
+    old_durations = [e - s for s, e in old.events]
+    last_old_end = max(e for _, e in old.events)
+    # the true mining idle window around the swap: no device search in
+    # flight between the last old batch ending and the first new one
+    # starting (both algorithms' batches themselves are useful work)
+    idle = max(0.0, first_new_start - max(last_old_end, swap_at))
+    max_batch = max(old_durations + [first_new_end - first_new_start])
+    gaps = [
+        b[0] - a[1] for a, b in zip(old_events_during_prepare,
+                                    old_events_during_prepare[1:])
+    ]
+    return {
+        "sha_chunk": sha_chunk,
+        "scrypt_chunk": scrypt_chunk,
+        "old_batches": len(old.events),
+        "old_batch_seconds_max": round(max(old_durations), 4),
+        "prepare_seconds": round(prepare_seconds, 3),
+        "old_batches_during_prepare": len(old_events_during_prepare),
+        "max_mining_gap_during_prepare_seconds": round(
+            max(gaps), 4) if gaps else 0.0,
+        "swap_seconds": round(swap_seconds, 4),
+        "mining_idle_seconds": round(idle, 4),
+        "request_to_first_new_batch_seconds": round(
+            first_new_end - request_at, 3),
+        "swap_to_first_new_batch_seconds": round(
+            first_new_end - swap_at, 4),
+        "max_single_batch_seconds": round(max_batch, 4),
+        "downtime_bounded_by_one_batch": idle <= max_batch + 0.25,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--out", default="BENCH_SWITCH_manual.json")
+    ap.add_argument("--quick", action="store_true",
+                    help="smaller shapes (CI smoke, not a real measurement)")
+    args = ap.parse_args()
+
+    sha_chunk = 1 << 10 if args.quick else 1 << 12
+    scrypt_chunk = 64 if args.quick else 256
+    compile_cache.install()
+
+    print("== cold start: cold vs warm persistent cache ==", flush=True)
+    cold_start = bench_cold_start(sha_chunk)
+    print(json.dumps(cold_start, indent=2), flush=True)
+
+    print("== mid-run sha256d -> scrypt warm switch ==", flush=True)
+    switch = asyncio.run(bench_switch(
+        sha_chunk, scrypt_chunk, mine_seconds=1.0 if args.quick else 2.0))
+    print(json.dumps(switch, indent=2), flush=True)
+
+    result = {
+        "bench": "algorithm_switch",
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "platform": platform.platform(),
+        "jax_platform": os.environ.get("JAX_PLATFORMS", "default"),
+        "cold_start": cold_start,
+        "switch": switch,
+        "compile_telemetry": compile_cache.snapshot(),
+    }
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {args.out}")
+    if not cold_start["warm_faster_than_cold"]:
+        sys.exit("FAIL: warm-cache cold start was not faster than cold")
+    if not switch["downtime_bounded_by_one_batch"]:
+        sys.exit("FAIL: switch downtime exceeded one batch boundary")
+
+
+if __name__ == "__main__":
+    main()
