@@ -76,6 +76,7 @@ class Response:
         reason = {200: "OK", 400: "Bad Request", 401: "Unauthorized",
                   403: "Forbidden", 404: "Not Found", 405: "Method Not Allowed",
                   429: "Too Many Requests", 500: "Internal Server Error",
+                  503: "Service Unavailable",
                   101: "Switching Protocols"}.get(self.status, "Status")
         head = [f"HTTP/1.1 {self.status} {reason}"]
         hdrs = {

@@ -8,6 +8,7 @@ against this implementation unchanged.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 
@@ -26,14 +27,37 @@ class MetricsRegistry:
     """Thread-safe gauge/counter registry rendering Prometheus text format."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # reentrant: ``atomic()`` holds it across a batch of per-op
+        # calls (which each take it again) so a concurrent render never
+        # observes a half-rebuilt family
+        self._lock = threading.RLock()
         # name -> (help, type, {labelstr: value})
         self._metrics: dict[str, tuple[str, str, dict[str, float]]] = {}
+
+    @contextlib.contextmanager
+    def atomic(self):
+        """Hold the registry lock across several mutations: a family
+        rebuilt via clear_family + re-set must flip in one step with
+        respect to a concurrent /metrics render, or scrape timing makes
+        gauges vanish and counters appear to reset."""
+        with self._lock:
+            yield self
 
     def _slot(self, name: str, help_: str, type_: str) -> dict[str, float]:
         if name not in self._metrics:
             self._metrics[name] = (help_, type_, {})
         return self._metrics[name][2]
+
+    def clear_family(self, name: str) -> None:
+        """Drop every label set of a family (help/type kept). For
+        families mirrored per-entity from an authoritative snapshot
+        (e.g. per-device supervision series): an entity that left the
+        snapshot — a pod replaced by its degraded rebuild — must not
+        keep exporting its last value forever."""
+        with self._lock:
+            entry = self._metrics.get(name)
+            if entry is not None:
+                entry[2].clear()
 
     def gauge_set(self, name: str, value: float, labels: dict | None = None,
                   help_: str = "") -> None:

@@ -53,6 +53,13 @@ class ApiServer:
         # signature-compatible if a file-based trail is ever configured);
         # unwired -> /api/v1/logs/audit answers 404
         self.audit_source: Callable | None = None
+        # readiness source for /health: a callable returning at least
+        # {"status": "ok" | "degraded" | "unready"} (the app wires the
+        # engine's device_health). ok/degraded answer 200 — degraded
+        # means serving at reduced capacity, still serving — while
+        # unready (no device able to mine) answers 503 so orchestrators
+        # rotate traffic away. Unwired -> the legacy always-ok health.
+        self.health_source: Callable[[], dict] | None = None
         self.auth: AuthManager | None = (
             AuthManager(self.config.auth_secret) if self.config.auth_secret else None
         )
@@ -135,10 +142,20 @@ class ApiServer:
         return None
 
     async def _health(self, request: Request) -> Response:
-        return Response.json({
+        body = {
             "status": "ok",
             "uptime_seconds": round(time.time() - self.started_at, 1),
-        })
+        }
+        if self.health_source is not None:
+            try:
+                body.update(self.health_source())
+            except Exception as e:  # a broken source is NOT healthy
+                log.exception("health source failed")
+                return Response.json(
+                    {"status": "error", "error": str(e)}, 500
+                )
+        status = 200 if body.get("status") in ("ok", "degraded") else 503
+        return Response.json(body, status)
 
     def _snapshot(self) -> dict:
         out = {}
@@ -353,20 +370,86 @@ class ApiServer:
 
     # -- metric sync ----------------------------------------------------------
 
+    # mirrors runtime.supervision.DeviceState VALUES as literals: the
+    # API layer renders snapshot providers without importing subsystem
+    # modules (decoupling rule at the top of this file); a test pins the
+    # two in sync (test_device_supervision.test_device_state_names_in_sync)
+    _DEVICE_STATES = ("healthy", "suspect", "quarantined", "probing", "dead")
+
+    _DEVICE_FAMILIES = (
+        "otedama_device_hashrate",
+        "otedama_device_state",
+        "otedama_device_quarantines_total",
+        "otedama_device_searcher_restarts_total",
+        "otedama_device_abandoned_calls_total",
+        "otedama_device_call_seconds",
+    )
+
     def sync_engine_metrics(self, snapshot: dict) -> None:
         """Map an engine snapshot onto the reference's metric names."""
         reg = self.registry
         reg.gauge_set("otedama_hashrate", snapshot.get("hashrate", 0.0),
                       help_="Total hashrate in H/s")
-        for device, d in snapshot.get("devices", {}).items():
-            reg.gauge_set("otedama_device_hashrate", d.get("hashrate", 0.0),
-                          {"device": device}, help_="Per-device hashrate")
+        # per-device families mirror the snapshot exactly: a device that
+        # left it (degraded-mesh replacement/removal) must not keep a
+        # latched quarantined=1 series paging forever. Atomic so a
+        # concurrent scrape never sees the cleared-but-unrebuilt gap
+        with reg.atomic():
+            for family in self._DEVICE_FAMILIES:
+                reg.clear_family(family)
+            self._set_device_metrics(snapshot)
         shares = snapshot.get("shares", {})
         for status in ("found", "accepted", "rejected", "stale"):
             reg.counter_set("otedama_shares_total", shares.get(status, 0),
                             {"status": status}, help_="Share counters")
         reg.counter_set("otedama_blocks_found_total",
                         snapshot.get("blocks_found", 0), help_="Blocks found")
+        reg.counter_set(
+            "otedama_device_relayouts_total", snapshot.get("relayouts", 0),
+            help_="Searcher-layout rebuilds (extranonce2 re-shards)",
+        )
+
+    def _set_device_metrics(self, snapshot: dict) -> None:
+        reg = self.registry
+        for device, d in snapshot.get("devices", {}).items():
+            reg.gauge_set("otedama_device_hashrate", d.get("hashrate", 0.0),
+                          {"device": device}, help_="Per-device hashrate")
+            state = d.get("state")
+            if state is None:
+                continue  # unsupervised engine snapshot (older shape)
+            # one-hot state family: the standard Prometheus enum shape,
+            # alertable as otedama_device_state{state="quarantined"} == 1
+            for s in self._DEVICE_STATES:
+                reg.gauge_set(
+                    "otedama_device_state", 1.0 if s == state else 0.0,
+                    {"device": device, "state": s},
+                    help_="Device supervision state (one-hot per state)",
+                )
+            reg.counter_set(
+                "otedama_device_quarantines_total",
+                d.get("quarantines", 0), {"device": device},
+                help_="Watchdog quarantines per device",
+            )
+            reg.counter_set(
+                "otedama_device_searcher_restarts_total",
+                d.get("searcher_restarts", 0), {"device": device},
+                help_="Searcher restarts after backend exceptions",
+            )
+            reg.counter_set(
+                "otedama_device_abandoned_calls_total",
+                d.get("abandoned_calls", 0), {"device": device},
+                help_="Device calls abandoned past a watchdog/drain deadline",
+            )
+            hist = d.get("call_seconds") or {}
+            if hist.get("count"):
+                reg.histogram_set(
+                    "otedama_device_call_seconds",
+                    hist["buckets"],
+                    hist["sum"],
+                    hist["count"],
+                    labels={"device": device},
+                    help_="Device call durations (the watchdog's model input)",
+                )
 
     def sync_rpc_pool_metrics(self, chains: dict) -> None:
         """Connection-pool telemetry for the blockchain RPC endpoints

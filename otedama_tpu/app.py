@@ -99,6 +99,11 @@ class Application:
                 worker_name=cfg.worker_name,
                 algorithm=cfg.algorithm,
                 batch_size=cfg.batch_size,
+                drain_timeout=cfg.drain_timeout,
+                watchdog_multiplier=cfg.watchdog_multiplier,
+                watchdog_floor=cfg.watchdog_floor,
+                watchdog_first_deadline=cfg.watchdog_first_deadline,
+                max_probes=cfg.max_probes,
             ),
         )
         return engine
@@ -503,6 +508,9 @@ class Application:
         ))
         if self.engine is not None:
             self.api.add_provider("engine", self.engine.snapshot)
+            # /health readiness follows device supervision: 200 while
+            # serving (even degraded), 503 once no device can mine
+            self.api.health_source = self.engine.device_health
         if self.client is not None:
             self.api.add_provider("upstream", lambda: dict(self.client.stats))
         if self.server is not None:
@@ -673,6 +681,20 @@ class Application:
             self.recovery.register("engine", engine_probe, engine_restart)
 
             async def restart_engine_on_failure(failure) -> bool:
+                # a hashrate drop / batch stall caused by capacity in
+                # QUARANTINE belongs to the supervision layer (verified
+                # probes, degraded rebuild): a blind restart would
+                # reset the wedged device straight to HEALTHY and
+                # bypass oracle-verified reintegration, looping
+                # hang -> restart -> hang every recovery cooldown.
+                # DEAD is terminal (no reintegration in flight), so a
+                # dead tombstone must NOT stand this strategy down
+                # forever — an operator-sanctioned restart is exactly
+                # the fresh chance a dead device gets.
+                states = engine.device_health()["device_states"]
+                if any(s in ("quarantined", "probing")
+                       for s in states.values()):
+                    return False
                 async with lock:
                     await engine.stop()
                     await engine.start()
@@ -683,6 +705,85 @@ class Application:
                 "engine-restart",
                 (FailureType.BATCH_STALL, FailureType.HASHRATE_DROP),
                 restart_engine_on_failure,
+            ))
+
+            async def rebuild_degraded_mesh(failure) -> bool:
+                """DEVICE_HUNG/DEVICE_LOST on a pod backend: census the
+                pod's JAX devices individually, rebuild the pod over the
+                survivors off the event loop (precompiled — the warm-swap
+                rule), and swap it in while other searchers keep mining.
+                The wedged chip stays out until an operator/full restart
+                brings it back."""
+                from otedama_tpu.runtime.mesh import degraded_pod_backend
+                from otedama_tpu.runtime.supervision import probe_jax_devices
+
+                backend = engine.backends.get(failure.component)
+                if backend is None or getattr(backend, "pod", None) is None:
+                    return False
+                pod = backend.pod
+
+                def _build():
+                    survivors = probe_jax_devices(
+                        list(pod.mesh.devices.flat)
+                    )
+                    return degraded_pod_backend(
+                        backend, survivors, warm_count=engine.planned_batch
+                    )
+
+                loop = asyncio.get_running_loop()
+                try:
+                    rebuilt = await loop.run_in_executor(None, _build)
+                except Exception:
+                    log.exception(
+                        "degraded-mesh rebuild of %s failed",
+                        failure.component)
+                    return False
+                if rebuilt is None:
+                    # every device answered its probe (transient hang) or
+                    # none did: leave it to quarantine/probe reintegration
+                    return False
+                async with lock:
+                    await engine.replace_backend(failure.component, rebuilt)
+                log.warning(
+                    "pod %s rebuilt over surviving devices as %s",
+                    failure.component, getattr(rebuilt, "name", "?"))
+                return True
+
+            async def acknowledge_quarantine(failure) -> bool:
+                """DEVICE_HUNG on a single-device backend: the engine
+                already quarantined it, reassigned its extranonce2 block
+                to the survivors, and is probing for reintegration —
+                report the failure handled so it counts as a recovery."""
+                sup = engine.supervisors.get(failure.component)
+                return sup is not None and not sup.can_mine
+
+            async def drop_dead_device(failure) -> bool:
+                """DEVICE_LOST with no degraded rebuild possible: drop
+                the backend (close it under its tombstoned supervisor)
+                as long as at least one other device keeps mining."""
+                sup = engine.supervisors.get(failure.component)
+                if (sup is None or sup.state.value != "dead"
+                        or failure.component not in engine.backends
+                        or len(engine.backends) <= 1):
+                    return False
+                async with lock:
+                    await engine.remove_backend(failure.component)
+                return True
+
+            self.failure_detector.add_strategy(CallbackStrategy(
+                "degraded-mesh-rebuild",
+                (FailureType.DEVICE_HUNG, FailureType.DEVICE_LOST),
+                rebuild_degraded_mesh,
+            ))
+            self.failure_detector.add_strategy(CallbackStrategy(
+                "device-quarantine",
+                (FailureType.DEVICE_HUNG,),
+                acknowledge_quarantine,
+            ))
+            self.failure_detector.add_strategy(CallbackStrategy(
+                "drop-dead-device",
+                (FailureType.DEVICE_LOST,),
+                drop_dead_device,
             ))
             await self.failure_detector.start()
             self._started.append(self.failure_detector)

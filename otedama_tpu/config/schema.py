@@ -104,6 +104,23 @@ class MiningConfig:
     # comma list of algorithms warmed into the compile cache in the
     # background after startup — likely profit-switch targets; "" = none
     warm_algorithms: str = ""
+    # -- device supervision (engine watchdog / quarantine / probes) ----------
+    # bound on stop()/switch drains of in-flight device calls: calls
+    # still running past it are abandoned so a wedged device can never
+    # hang process exit or an algorithm switch
+    drain_timeout: float = 30.0
+    # watchdog deadline = per-(backend, batch-shape) call-duration EWMA
+    # x this multiplier (floored by watchdog_floor); <= 0 disables the
+    # watchdog. A blown deadline quarantines the device; survivors
+    # re-shard its extranonce2 block and keep mining
+    watchdog_multiplier: float = 8.0
+    watchdog_floor: float = 5.0
+    # deadline for calls whose shape has no EWMA yet (a first call can
+    # be a cold XLA compile — minutes, not milliseconds)
+    watchdog_first_deadline: float = 1800.0
+    # consecutive failed reintegration probes before a quarantined
+    # device is marked DEAD (0 = probe forever)
+    max_probes: int = 8
 
 
 @dataclasses.dataclass
@@ -280,6 +297,14 @@ def validate_config(cfg: AppConfig) -> list[str]:
             errors.append(f"unknown warm algorithm {name!r}")
     if cfg.mining.batch_size <= 0 or cfg.mining.batch_size > (1 << 32):
         errors.append("mining.batch_size out of range")
+    if cfg.mining.drain_timeout <= 0:
+        errors.append("mining.drain_timeout must be positive")
+    if cfg.mining.watchdog_floor <= 0:
+        errors.append("mining.watchdog_floor must be positive")
+    if cfg.mining.watchdog_first_deadline <= 0:
+        errors.append("mining.watchdog_first_deadline must be positive")
+    if cfg.mining.max_probes < 0:
+        errors.append("mining.max_probes must be >= 0")
     for name in ("stratum", "p2p", "api"):
         port = getattr(cfg, name).port
         if not (0 <= port <= 65535):
@@ -305,6 +330,11 @@ mining:
   compile_cache_dir: ""  # persistent XLA compile cache (empty = off)
   precompile: true       # AOT-compile the active algorithm at startup
   warm_algorithms: ""    # e.g. "scrypt,ethash": pre-cache switch targets
+  drain_timeout: 30.0    # abandon in-flight device calls past this on stop/switch
+  watchdog_multiplier: 8.0   # deadline = call-duration EWMA x this (<=0 = off)
+  watchdog_floor: 5.0        # minimum watchdog deadline, seconds
+  watchdog_first_deadline: 1800.0  # deadline while a shape has no EWMA (compiles)
+  max_probes: 8          # failed reintegration probes before DEAD (0 = forever)
 
 stratum:
   enabled: false

@@ -27,6 +27,11 @@ class FailureType(enum.Enum):
     BACKEND_ERROR = "backend-error"
     SHARE_STARVATION = "share-starvation"
     COMPONENT_DEAD = "component-dead"
+    # device supervision (runtime/supervision.py via the engine): a
+    # device whose call blew its watchdog deadline and entered
+    # quarantine, and a device whose reintegration probe budget ran out
+    DEVICE_HUNG = "device-hung"
+    DEVICE_LOST = "device-lost"
 
 
 @dataclasses.dataclass
@@ -86,6 +91,9 @@ class FailureDetector:
         self._last_hashes = 0
         self._last_progress = time.time()
         self._last_recovery: dict[str, float] = {}
+        # device-state edge detection: DEVICE_HUNG/DEVICE_LOST fire on
+        # TRANSITIONS, not on every pass over a still-quarantined device
+        self._device_states: dict[str, str | None] = {}
         self._task: asyncio.Task | None = None
 
     def add_strategy(self, strategy: RecoveryStrategy) -> None:
@@ -127,6 +135,26 @@ class FailureDetector:
                 FailureType.BATCH_STALL, "engine",
                 f"no hashes for {now - self._last_progress:.0f}s",
             ))
+
+        # device supervision states (engine snapshot devices carry the
+        # per-device state machine): emit on entry into quarantine/death
+        for name, d in snap.get("devices", {}).items():
+            state = d.get("state") if isinstance(d, dict) else None
+            prev = self._device_states.get(name)
+            if state == prev:
+                continue
+            self._device_states[name] = state
+            if (state in ("quarantined", "probing")
+                    and prev not in ("quarantined", "probing", "dead")):
+                found.append(Failure(
+                    FailureType.DEVICE_HUNG, name,
+                    d.get("last_error") or f"device {state}",
+                ))
+            elif state == "dead" and prev != "dead":
+                found.append(Failure(
+                    FailureType.DEVICE_LOST, name,
+                    d.get("last_error") or "probe budget exhausted",
+                ))
         self.failures.extend(found)
         del self.failures[:-256]
         return found

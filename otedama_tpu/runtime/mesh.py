@@ -392,6 +392,9 @@ class PodBackend:
                 n_hosts = 2 if len(devices) % 2 == 0 and len(devices) > 1 else 1
             mesh = make_pod_mesh(devices, n_hosts)
         self.pod = PodSearch(mesh, **pod_kwargs)
+        # remembered so a degraded-mesh rebuild (degraded_pod_backend)
+        # reconstructs the same configuration over the surviving devices
+        self._pod_kwargs = dict(pod_kwargs)
         self.en2_fanout = self.pod.n_hosts
         self.name = f"pod{self.pod.n_hosts}x{self.pod.n_chips}"
 
@@ -596,6 +599,7 @@ class ScryptPodBackend:
                 n_hosts = 2 if len(devices) % 2 == 0 and len(devices) > 1 else 1
             mesh = make_pod_mesh(devices, n_hosts)
         self.pod = ScryptPodSearch(mesh, **pod_kwargs)
+        self._pod_kwargs = dict(pod_kwargs)
         self.en2_fanout = self.pod.n_hosts
         self.name = f"scrypt-pod{self.pod.n_hosts}x{self.pod.n_chips}"
         # slow-algorithm cap (see engine._search_loop): ~1-2 s of scrypt
@@ -806,6 +810,7 @@ class X11PodBackend:
                 n_hosts = 2 if len(devices) % 2 == 0 and len(devices) > 1 else 1
             mesh = make_pod_mesh(devices, n_hosts)
         self.pod = X11PodSearch(mesh, **pod_kwargs)
+        self._pod_kwargs = dict(pod_kwargs)
         self.en2_fanout = self.pod.n_hosts
         self.name = f"x11-pod{self.pod.n_hosts}x{self.pod.n_chips}"
         # slow-algorithm cap (see engine._search_loop)
@@ -831,3 +836,44 @@ class X11PodBackend:
                 "per call; use search_multi()"
             )
         return self.pod.search_jobs([jc], base, count)[0]
+
+
+# -- degraded-mesh rebuild -----------------------------------------------------
+
+def degraded_pod_backend(backend, survivors, n_hosts: int | None = None,
+                         warm_count=None):
+    """Rebuild a pod-class backend over the surviving device subset.
+
+    The device-loss story for pods: the engine sees ONE backend for the
+    whole mesh, so a single wedged chip quarantines the entire pod. This
+    helper builds a replacement of the same class over ``survivors``
+    (typically from ``runtime.supervision.probe_jax_devices``) so the
+    engine can warm-swap it in (``MiningEngine.replace_backend``) and keep
+    mining at degraded capacity while the wedged chip stays out.
+
+    Returns ``None`` when there is nothing to degrade to: ``backend`` is
+    not a pod, no device was actually lost, or no device survived. The
+    host-row count shrinks to the largest value <= the old ``n_hosts``
+    that divides the survivor count (extranonce2 fanout follows it).
+    ``warm_count`` (int or callable(backend) -> int, e.g. the engine's
+    ``planned_batch``) precompiles the rebuilt pod before it is returned
+    — the warm-swap rule: the swap must never pay an XLA compile.
+    """
+    pod = getattr(backend, "pod", None)
+    if pod is None:
+        return None  # single-device backend: it just drops out
+    current = list(pod.mesh.devices.flat)
+    alive = set(survivors)
+    surv = [d for d in current if d in alive]
+    if not surv or len(surv) == len(current):
+        return None
+    if n_hosts is None:
+        n_hosts = pod.n_hosts
+        while n_hosts > 1 and len(surv) % n_hosts:
+            n_hosts -= 1
+    mesh = make_pod_mesh(surv, n_hosts)
+    rebuilt = type(backend)(mesh, **getattr(backend, "_pod_kwargs", {}))
+    if warm_count is not None:
+        count = warm_count(rebuilt) if callable(warm_count) else warm_count
+        rebuilt.precompile(count=count)
+    return rebuilt

@@ -1,0 +1,395 @@
+"""Per-device supervision: watchdog deadlines, quarantine, probes.
+
+The reference's failure layer (internal/hardware/failure_detector.go:
+typed failures + pluggable recovery) assumes the recovery knob is
+"reset the GPU / restart the worker". The TPU redesign has no such
+knob: a wedged chip call simply never returns, and the only honest
+recovery levers are (a) stop waiting, (b) stop dispatching to the
+device, (c) periodically re-prove the device end to end before letting
+it mine again. This module holds the per-device half of that design —
+the engine (`engine/engine.py`) owns dispatch and the async lifecycle:
+
+- ``DeviceSupervisor``: one per engine backend. Tracks an EWMA of call
+  durations per (backend, batch-shape) key and derives the watchdog
+  deadline the engine arms on every dispatch (EWMA x configurable
+  multiplier with a floor; a large first-call deadline covers
+  compile-length cold calls). Owns the HEALTHY -> SUSPECT ->
+  QUARANTINED -> PROBING -> (HEALTHY | DEAD) state machine and the
+  counters the snapshot/metrics surfaces export. The quarantine is the
+  device's circuit breaker: open while QUARANTINED, half-open during a
+  probe, closed again on reintegration.
+- probe helpers: a fixed easy-target probe job plus an exact host
+  oracle (`utils.pow_host.pow_digest`) that a reintegration probe's
+  device results must match bit-for-bit before the device rejoins the
+  mesh — a device that answers quickly but WRONGLY (the ``corrupt``
+  fault mode, or real silent data corruption) must stay quarantined.
+- ``corrupt_result``: the wrong-result arm of the ``device.call``
+  fault point (utils/faults): winner digests are inverted past the
+  device filter, exactly what a flipped-bit HBM lane would produce.
+- ``probe_jax_devices``: per-JAX-device liveness probe on daemon
+  threads (a wedged device's probe must not block process exit) — the
+  degraded-mesh rebuild uses it to find the surviving device set.
+"""
+
+from __future__ import annotations
+
+import enum
+import struct
+import threading
+import time
+
+from otedama_tpu.kernels import target as tgt
+from otedama_tpu.runtime.search import JobConstants, SearchResult, Winner
+from otedama_tpu.utils.histogram import LatencyHistogram
+
+__all__ = [
+    "DeviceHungError",
+    "DeviceState",
+    "DeviceSupervisor",
+    "PROBE_BASE",
+    "corrupt_result",
+    "probe_job_constants",
+    "probe_jax_devices",
+    "verify_probe_results",
+]
+
+
+class DeviceState(enum.Enum):
+    HEALTHY = "healthy"          # mining; watchdog armed per dispatch
+    SUSPECT = "suspect"          # deadline blown; detaching the searcher
+    QUARANTINED = "quarantined"  # circuit open: no work dispatched
+    PROBING = "probing"          # half-open: one verified probe in flight
+    DEAD = "dead"                # probe budget exhausted; needs operator
+
+
+class DeviceHungError(Exception):
+    """A device call blew its watchdog deadline (the searcher detaches;
+    the call itself keeps running on its executor thread and its late
+    result is discarded)."""
+
+
+# device calls run from milliseconds (sha256d batch) to minutes (cold
+# compile) — a wider ladder than the share-latency default
+_CALL_BUCKETS = (
+    0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+    120.0, 300.0,
+)
+
+_EWMA_ALPHA = 0.3
+
+
+class DeviceSupervisor:
+    """State machine + call-duration model for ONE engine backend.
+
+    ``observe_call`` runs on executor threads (under ``_lock``); every
+    state transition happens on the event loop, so transitions need no
+    lock of their own. ``cfg`` is the engine's live EngineConfig —
+    shared by reference so runtime knob changes apply immediately.
+    """
+
+    def __init__(self, name: str, cfg):
+        self.name = name
+        self.cfg = cfg
+        self.state = DeviceState.HEALTHY
+        # counters (cumulative; exported via snapshot/metrics)
+        self.quarantines = 0
+        self.watchdog_timeouts = 0
+        self.abandoned_calls = 0
+        self.searcher_restarts = 0
+        self.probes = 0                 # probe attempts, cumulative
+        self.probes_failed = 0          # CONSECUTIVE failures this incident
+        self.reintegrations = 0
+        self.last_error: str | None = None
+        self.quarantined_at = 0.0
+        self.call_hist = LatencyHistogram(_CALL_BUCKETS)
+        self.transitions: list[dict] = []
+        self._ewma: dict[object, tuple[float, int]] = {}
+        self._lock = threading.Lock()
+
+    # -- call-duration model -------------------------------------------------
+
+    def observe_call(self, key, seconds: float) -> None:
+        """Feed one completed call (executor thread). MINING samples
+        observed while the device is not mining-healthy are kept out of
+        the EWMA: a wedged call that finally lands minutes later must
+        not loosen the deadline the device will face after
+        reintegration. Probe-shaped samples always record — a completed
+        probe is by definition a valid duration for its own key, and
+        ``has_samples`` on it is what retires the first-probe
+        compile-length deadline allowance."""
+        self.call_hist.observe(seconds)
+        is_probe = isinstance(key, tuple) and key and key[0] == "probe"
+        if not is_probe and self.state not in (
+                DeviceState.HEALTHY, DeviceState.SUSPECT):
+            return
+        with self._lock:
+            value, n = self._ewma.get(key, (0.0, 0))
+            value = seconds if n == 0 else (
+                _EWMA_ALPHA * seconds + (1 - _EWMA_ALPHA) * value
+            )
+            self._ewma[key] = (value, n + 1)
+
+    def has_samples(self, key) -> bool:
+        """Whether any call of this shape has completed (the probe path
+        uses it: a first probe may pay a cold-compile cost and gets the
+        compile-length deadline allowance)."""
+        with self._lock:
+            return key in self._ewma
+
+    def deadline(self, key) -> float:
+        """Watchdog deadline for the next call of this shape: EWMA x
+        multiplier, floored; until the EWMA has enough samples the
+        first-call deadline applies (first calls can be compiles).
+        multiplier <= 0 disables the watchdog entirely."""
+        cfg = self.cfg
+        if cfg.watchdog_multiplier <= 0:
+            return float("inf")
+        with self._lock:
+            entry = self._ewma.get(key)
+        if entry is None or entry[1] < cfg.watchdog_min_samples:
+            return max(cfg.watchdog_first_deadline, cfg.watchdog_floor)
+        return max(cfg.watchdog_floor, entry[0] * cfg.watchdog_multiplier)
+
+    # -- state machine -------------------------------------------------------
+
+    def _transition(self, state: DeviceState, reason: str) -> None:
+        self.state = state
+        self.transitions.append({
+            "at": round(time.time(), 3),
+            "state": state.value,
+            "reason": reason,
+        })
+        del self.transitions[:-8]
+
+    @property
+    def can_mine(self) -> bool:
+        return self.state in (DeviceState.HEALTHY, DeviceState.SUSPECT)
+
+    def on_hung(self, reason: str) -> None:
+        """Blown watchdog deadline: SUSPECT for the record, then the
+        circuit opens (QUARANTINED) — the threshold is one blown
+        deadline because the deadline already embeds the multiplier's
+        slack over the measured call-duration model."""
+        self.last_error = reason
+        self._transition(DeviceState.SUSPECT, reason)
+        self._transition(DeviceState.QUARANTINED, "circuit opened")
+        self.quarantines += 1
+        self.probes_failed = 0
+        self.quarantined_at = time.time()
+
+    def next_probe_delay(self) -> float:
+        """Exponential backoff between reintegration probes."""
+        return min(
+            self.cfg.probe_backoff * (2 ** self.probes_failed),
+            self.cfg.probe_backoff_max,
+        )
+
+    def begin_probe(self) -> None:
+        self.probes += 1
+        self._transition(DeviceState.PROBING, f"probe #{self.probes}")
+
+    def probe_failed(self, reason: str) -> None:
+        self.last_error = reason
+        self.probes_failed += 1
+        self._transition(
+            DeviceState.QUARANTINED, f"probe failed: {reason}"
+        )
+
+    def probe_interrupted(self) -> None:
+        """A relayout cancelled the in-flight probe (not a verdict on
+        the device): back to QUARANTINED, recorded in the audit trail,
+        without consuming probe budget."""
+        if self.state is DeviceState.PROBING:
+            self._transition(
+                DeviceState.QUARANTINED, "probe cancelled by relayout"
+            )
+
+    def reintegrate(self) -> None:
+        self.probes_failed = 0
+        self.reintegrations += 1
+        self._transition(DeviceState.HEALTHY, "probe verified; reintegrated")
+
+    def mark_dead(self) -> None:
+        self._transition(
+            DeviceState.DEAD,
+            f"probe budget exhausted ({self.probes_failed} consecutive)",
+        )
+
+    def reset_state(self) -> None:
+        """Engine (re)start: a full restart is itself a recovery action,
+        so every device gets a fresh chance; cumulative counters stay."""
+        self.probes_failed = 0
+        if self.state is not DeviceState.HEALTHY:
+            self._transition(DeviceState.HEALTHY, "engine restart")
+
+    # -- observability -------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        return {
+            "state": self.state.value,
+            "quarantines": self.quarantines,
+            "watchdog_timeouts": self.watchdog_timeouts,
+            "abandoned_calls": self.abandoned_calls,
+            "searcher_restarts": self.searcher_restarts,
+            "probes": self.probes,
+            "consecutive_probe_failures": self.probes_failed,
+            "reintegrations": self.reintegrations,
+            "last_error": self.last_error,
+            "call_seconds": {
+                "buckets": self.call_hist.cumulative(),
+                "sum": self.call_hist.sum,
+                "count": self.call_hist.count,
+            },
+            "transitions": list(self.transitions),
+        }
+
+
+# -- probe construction + verification ----------------------------------------
+
+# nonce base for probe batches: arbitrary but fixed, away from 0 so a
+# backend that ignores `base` cannot pass by accident
+PROBE_BASE = 0x00400000
+
+# ~1 winner per 16 nonces: a probe batch is guaranteed winners to verify,
+# and a corrupt/fabricating device is guaranteed a mismatch
+_PROBE_TARGET = (1 << 252) - 1
+
+
+def probe_job_constants(algorithm: str = "sha256d") -> JobConstants:
+    """Fixed synthetic probe job with an easy target. STABLE bytes per
+    algorithm (the name is folded into the header, so probe jobs are
+    distinguishable across algorithms): the probe exercises compiled
+    programs shape-keyed like production, and a stable job keeps probe
+    timings comparable across incidents."""
+    tag = f"otedama-tpu/probe/{algorithm}".encode()[:64]
+    header76 = tag + bytes(range(64 - len(tag))) + struct.pack(
+        ">3I", 0x20000000, 0x6530D1B7, 0x1D00FFFF
+    )
+    return JobConstants.from_header_prefix(header76, target=_PROBE_TARGET)
+
+
+# probe jobs are deliberately stable per algorithm, so the oracle winner
+# set for a (job, range) never changes — cache it: probe RETRIES fire as
+# often as probe_backoff, and the slow-algorithm host digests (scrypt,
+# x11) are orders of magnitude pricier than sha256d
+_EXPECTED_CACHE: dict[tuple, dict[int, bytes]] = {}
+
+
+def expected_probe_winners(
+    algorithm: str, jc: JobConstants, base: int, count: int
+) -> dict[int, bytes]:
+    """The exact host-oracle winner set for a probe range: nonce_word ->
+    digest, computed independently of any device path."""
+    from otedama_tpu.utils.pow_host import pow_digest
+
+    key = (algorithm, jc.header76, jc.target, jc.block_number, base, count)
+    cached = _EXPECTED_CACHE.get(key)
+    if cached is not None:
+        return cached
+    out: dict[int, bytes] = {}
+    for i in range(count):
+        w = (base + i) & 0xFFFFFFFF
+        digest = pow_digest(jc.header_for(w), algorithm, jc.block_number)
+        if tgt.hash_meets_target(digest, jc.target):
+            out[w] = digest
+    if len(_EXPECTED_CACHE) >= 32:  # bound: one entry per (algo, shape);
+        # evict ONE entry, not the whole cache — a mixed-algorithm
+        # deployment must not thrash its expensive slow-algo entries
+        _EXPECTED_CACHE.pop(next(iter(_EXPECTED_CACHE)))
+    _EXPECTED_CACHE[key] = out
+    return out
+
+
+# algorithms whose host oracle (pow_digest) is valid for ANY backend
+# configuration. Ethash-class backends pin an epoch context (possibly a
+# miniature test epoch) at construction that the height-0 oracle cannot
+# reproduce, and live-network aliases sit behind certification gates —
+# verifying those against the oracle would fail a perfectly healthy
+# device into DEAD.
+_ORACLE_ALGORITHMS = frozenset({"sha256d", "sha256", "scrypt", "x11"})
+
+
+def verify_probe_results(
+    algorithm: str, jc: JobConstants, results, base: int, count: int
+) -> bool:
+    """True iff EVERY returned row matches the host oracle exactly —
+    same winner set, same digests. Exactness (not subset) is the point:
+    a device that silently drops winners is as broken as one that
+    fabricates them. Algorithms outside the oracle set fall back to
+    structural verification: well-formed rows whose winners sit inside
+    the probed range and whose digests meet the probe target (enough to
+    catch hangs, crashes, and digest corruption; not wrong-but-plausible
+    winners)."""
+    rows = results if isinstance(results, list) else [results]
+    if not rows:
+        return False
+    if algorithm not in _ORACLE_ALGORITHMS:
+        for res in rows:
+            if not isinstance(res, SearchResult):
+                return False
+            for w in res.winners:
+                if not (base <= w.nonce_word < base + count):
+                    return False
+                if len(w.digest) != 32:
+                    return False
+                if not tgt.hash_meets_target(w.digest, jc.target):
+                    return False
+        return True
+    expected = expected_probe_winners(algorithm, jc, base, count)
+    for res in rows:
+        got = {w.nonce_word: w.digest for w in res.winners}
+        if set(got) != set(expected):
+            return False
+        if any(expected[n] != d for n, d in got.items()):
+            return False
+    return True
+
+
+def corrupt_result(obj):
+    """Wrong-result fault mode (``device.call`` corrupt action): invert
+    every winner digest; a winnerless result grows one fabricated
+    worst-difficulty winner so the corruption is observable either way.
+    Recurses through the tuple/list shapes device calls return."""
+    if isinstance(obj, tuple):
+        return tuple(corrupt_result(x) for x in obj)
+    if isinstance(obj, list):
+        return [corrupt_result(x) for x in obj]
+    if isinstance(obj, SearchResult):
+        winners = [
+            Winner(w.nonce_word, bytes(b ^ 0xFF for b in w.digest))
+            for w in obj.winners
+        ]
+        if not winners:
+            winners = [Winner(0xDEADBEEF, b"\xff" * 32)]
+        return SearchResult(winners, obj.hashes, obj.best_hash_hi)
+    return obj
+
+
+def probe_jax_devices(devices, timeout: float = 10.0) -> list:
+    """Survivor census over individual JAX devices: round-trip one value
+    through each device. All probes launch CONCURRENTLY and join against
+    one shared deadline, so a pod of N wedged chips costs ~timeout, not
+    N x timeout. Daemon threads — a wedged device's probe thread must
+    never block interpreter exit."""
+    import numpy as np
+
+    results: dict[int, list] = {}
+    threads: list[threading.Thread] = []
+    for i, device in enumerate(devices):
+        done = results[i] = []
+
+        def _touch(d=device, out=done):
+            import jax
+
+            x = jax.device_put(np.uint32(1), d)
+            out.append(int(np.asarray(x)))
+
+        t = threading.Thread(
+            target=_touch, daemon=True, name=f"probe-{device}"
+        )
+        t.start()
+        threads.append(t)
+    deadline = time.monotonic() + timeout
+    for t in threads:
+        t.join(max(deadline - time.monotonic(), 0.0))
+    return [d for i, d in enumerate(devices) if results[i]]

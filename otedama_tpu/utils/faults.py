@@ -41,6 +41,7 @@ Fault point registry (grep for ``faults.hit`` to verify):
     pool.submitter.submit                       (pool/submitter.py retry loop)
     pool.failover.check                         (pool/failover.py; tag pool name)
     engine.batch                                (engine/engine.py; tag backend)
+    device.call                                 (engine/engine.py executor wrapper; tag backend)
 
 Usage (tests / chaos drivers):
 
@@ -67,6 +68,7 @@ from contextlib import contextmanager
 from typing import Callable
 
 __all__ = [
+    "DEVICE",
     "Directive",
     "FaultInjectedError",
     "FaultInjector",
@@ -95,6 +97,10 @@ POINT = frozenset({"error", "crash", "delay"})        # reads/checks/execs
 STEP = frozenset({"error", "crash", "delay", "drop"})  # skippable steps
 SEND_ASYNC = frozenset({"error", "crash", "delay", "drop", "truncate"})
 SEND_SYNC = frozenset({"error", "crash", "drop", "truncate"})
+# device calls on executor threads: delay = hang (sleeps the worker
+# thread, the watchdog's target failure), error = backend crash,
+# corrupt = wrong results past the device filter (silent data error)
+DEVICE = frozenset({"error", "crash", "delay", "corrupt"})
 
 
 @dataclasses.dataclass
@@ -106,6 +112,7 @@ class Directive:
     truncate: int = -1        # >= 0: write only this many bytes, then fail
     delay: float = 0.0        # stall this long before proceeding
     crash: str | None = None  # component name whose crash handler fired
+    corrupt: bool = False     # mangle the call's result (wrong-result mode)
 
     def sleep_sync(self) -> None:
         """Apply the delay on a synchronous (non-event-loop) path."""
@@ -194,6 +201,14 @@ class FaultInjector:
 
     short_write = truncate
 
+    def corrupt(self, point: str, **sched) -> "FaultInjector":
+        """Wrong-result mode: the call completes on time but the call
+        site mangles its payload (device.call: winner digests inverted)
+        — models silent data corruption the deadline cannot see."""
+        return self.add(FaultRule(point, "corrupt", **sched))
+
+    wrong_result = corrupt
+
     def crash(self, point: str, component: str, **sched) -> "FaultInjector":
         return self.add(FaultRule(point, "crash", component=component, **sched))
 
@@ -278,6 +293,8 @@ class FaultInjector:
             return Directive(drop=True)
         if rule.action == "truncate":
             return Directive(truncate=rule.keep_bytes)
+        if rule.action == "corrupt":
+            return Directive(corrupt=True)
         if rule.action == "crash":
             handler = self._crash_handlers.get(rule.component)
             if handler is None:

@@ -16,6 +16,13 @@
 #                   sha256d->scrypt warm switch; writes a BENCH_SWITCH
 #                   json artifact and fails if the warm cache is not
 #                   faster or switch downtime exceeds a batch boundary.
+#   degrade-bench   opt-in device-loss resilience bench: hangs one of
+#                   three devices via the device.call fault point and
+#                   measures time-to-quarantine, shares lost during the
+#                   window vs a fault-free control run, reintegration
+#                   time, and drain-bounded stop(); writes a
+#                   BENCH_DEGRADE json artifact and fails if quarantine
+#                   or reintegration never happened or stop() hung.
 # Extra args pass through to pytest (e.g. ./run_tests.sh fast -k scrypt).
 set -euo pipefail
 cd "$(dirname "$0")"
@@ -33,5 +40,8 @@ case "$tier" in
   switch-bench)
     exec env JAX_PLATFORMS=cpu python tools/bench_switch.py \
       --out "${SWITCH_BENCH_OUT:-BENCH_SWITCH_manual.json}" "$@" ;;
-  *) echo "usage: $0 [fast|slow|all|audit|stratum-bench|switch-bench] [pytest args...]" >&2; exit 2 ;;
+  degrade-bench)
+    exec env JAX_PLATFORMS=cpu python tools/bench_degrade.py \
+      --out "${DEGRADE_BENCH_OUT:-BENCH_DEGRADE_manual.json}" "$@" ;;
+  *) echo "usage: $0 [fast|slow|all|audit|stratum-bench|switch-bench|degrade-bench] [pytest args...]" >&2; exit 2 ;;
 esac

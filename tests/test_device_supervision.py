@@ -1,0 +1,656 @@
+"""Device-loss resilience: watchdog deadlines, quarantine, degraded mesh.
+
+Seeded chaos over the ``device.call`` fault point (utils/faults): a hung
+device must be quarantined within its watchdog deadline while the
+surviving devices keep mining with its extranonce2 block re-sharded over
+them, reintegrate through host-oracle-verified probes once the fault
+window closes, and a permanently wedged call must never hang ``stop()``
+past ``drain_timeout``. Pod re-shards must stay share-exact against the
+host oracle on the surviving device set.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from contextlib import asynccontextmanager
+
+import pytest
+
+from otedama_tpu.engine.engine import EngineConfig, MiningEngine
+from otedama_tpu.engine.jobs import job_constants
+from otedama_tpu.engine.types import Job
+from otedama_tpu.kernels import target as tgt
+from otedama_tpu.runtime import supervision
+from otedama_tpu.runtime.search import (
+    PythonBackend,
+    SearchResult,
+    Winner,
+    _scalar_search,
+)
+from otedama_tpu.utils import faults
+
+# easy target: ~1 winner per 4096 nonces — shares flow fast on the
+# pure-python backends without swamping the submit path
+EASY_TARGET = (1 << 256) - 1 >> 12
+
+
+def make_job(jid: str, **kw) -> Job:
+    defaults = dict(
+        job_id=jid,
+        prev_hash=bytes(32),
+        coinb1=b"\x01" * 8,
+        coinb2=b"\x02" * 8,
+        merkle_branch=[],
+        version=0x20000000,
+        nbits=0x1D00FFFF,
+        ntime=1700000000,
+        extranonce1=b"\xaa\xbb",
+        extranonce2_size=4,
+        share_target=EASY_TARGET,
+        algorithm="sha256d",
+    )
+    defaults.update(kw)
+    return Job(**defaults)
+
+
+def fast_config(**kw) -> EngineConfig:
+    """Test-speed supervision knobs: sub-second deadlines, fast probes.
+    The floor sits well above scheduler-jitter scale so a healthy
+    device's call can never falsely blow its deadline on a loaded CI
+    box, while every injected hang (>= 1 s) still overshoots it."""
+    defaults = dict(
+        batch_size=512,
+        auto_batch=False,
+        pipeline_depth=1,
+        watchdog_multiplier=3.0,
+        watchdog_floor=0.3,
+        watchdog_first_deadline=0.4,
+        watchdog_min_samples=1,
+        probe_timeout=0.5,
+        probe_backoff=0.05,
+        probe_backoff_max=0.2,
+        max_probes=50,
+        probe_count=64,
+        drain_timeout=2.0,
+        searcher_restart_backoff=0.02,
+        searcher_restart_backoff_max=0.1,
+    )
+    defaults.update(kw)
+    return EngineConfig(**defaults)
+
+
+def py_backends(n: int) -> dict:
+    out = {}
+    for i in range(n):
+        b = PythonBackend()
+        b.name = f"py{i}"
+        out[f"py{i}"] = b
+    return out
+
+
+async def wait_until(cond, timeout: float, what: str) -> None:
+    t0 = time.monotonic()
+    while not cond():
+        await asyncio.sleep(0.02)
+        assert time.monotonic() - t0 < timeout, f"timed out waiting: {what}"
+
+
+@asynccontextmanager
+async def running(engine):
+    """Start the engine; ALWAYS stop it — a failed assertion must not
+    leave a mining engine running under the rest of the pytest session."""
+    await engine.start()
+    try:
+        yield engine
+    finally:
+        if engine.state.value != "stopped":
+            await engine.stop()
+
+
+@asynccontextmanager
+async def faults_active(inj):
+    """faults.active as an async context manager, composable with
+    ``running`` in one ``async with`` line."""
+    with faults.active(inj):
+        yield inj
+
+
+# -- the acceptance scenario ---------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_hang_quarantine_probe_reintegrate_lifecycle():
+    """One of three devices hangs (seeded window fault): quarantined
+    within the watchdog deadline, survivors keep mining with exact share
+    accounting, the device reintegrates via a verified probe once the
+    fault window closes, and stop() stays bounded."""
+    shares = []
+
+    async def on_share(s):
+        shares.append(s)
+
+    engine = MiningEngine(py_backends(3), on_share=on_share,
+                          config=fast_config())
+    inj = faults.FaultInjector(1337).delay(
+        "device.call:py1", seconds=1.5, window=(0.0, 1.0)
+    )
+    job = make_job("life-1")
+    async with running(engine), faults_active(inj):
+        engine.set_job(job)
+        sup = engine.supervisors["py1"]
+
+        await wait_until(lambda: not sup.can_mine, 3.0, "quarantine")
+        quarantined_at = time.monotonic()
+        snap = engine.snapshot()
+        assert snap["devices"]["py1"]["state"] in ("quarantined", "probing")
+        assert snap["devices"]["py1"]["quarantines"] == 1
+        assert snap["devices"]["py1"]["watchdog_timeouts"] >= 1
+        assert snap["abandoned_calls"] >= 1
+        assert snap["supervision"]["status"] == "degraded"
+        assert snap["supervision"]["active_devices"] == 2
+
+        # survivors keep mining while py1 is out
+        h0 = (snap["devices"]["py0"]["hashes"]
+              + snap["devices"]["py2"]["hashes"])
+        await asyncio.sleep(0.3)
+        snap2 = engine.snapshot()
+        assert (snap2["devices"]["py0"]["hashes"]
+                + snap2["devices"]["py2"]["hashes"]) > h0
+
+        # reintegration after the fault window closes: probe verified
+        # against the host oracle, device back to mining
+        await wait_until(
+            lambda: sup.state.value == "healthy", 6.0, "reintegration"
+        )
+        assert time.monotonic() - quarantined_at < 6.0
+        assert sup.reintegrations == 1
+        py1_hashes = engine.snapshot()["devices"]["py1"]["hashes"]
+        await wait_until(
+            lambda: engine.snapshot()["devices"]["py1"]["hashes"] > py1_hashes,
+            3.0, "py1 mining after reintegration",
+        )
+        snap3 = engine.snapshot()
+        assert snap3["supervision"]["status"] == "ok"
+        assert snap3["relayouts"] >= 2  # quarantine exit + rejoin
+        await engine.stop()
+
+    # exact accounting: every share is oracle-valid for its extranonce
+    # space and no (en2, nonce) pair was double-counted
+    assert shares, "survivors produced no shares"
+    seen = set()
+    for s in shares:
+        key = (s.job_id, s.extranonce2, s.nonce_word)
+        assert key not in seen, "duplicate share emitted"
+        seen.add(key)
+        jc = job_constants(job, s.extranonce2)
+        assert s.digest == jc.digest_for(s.nonce_word)
+        assert tgt.hash_meets_target(s.digest, jc.target)
+    assert engine.stats.shares_found == len(shares)
+
+
+@pytest.mark.asyncio
+async def test_stop_bounded_with_permanently_hung_call():
+    """stop() must complete within mining.drain_timeout even with a
+    device call still hung in flight, counting it abandoned."""
+    engine = MiningEngine(
+        py_backends(1),
+        config=fast_config(drain_timeout=0.3, watchdog_first_deadline=10.0,
+                           watchdog_multiplier=50.0, watchdog_floor=10.0),
+    )
+    # every py0 call wedges for 2.5 s — longer than every bound in play
+    inj = faults.FaultInjector(5).delay("device.call:py0", seconds=2.5)
+    async with running(engine), faults_active(inj):
+        engine.set_job(make_job("hang-stop"))
+        await wait_until(lambda: inj.rules[0].fires >= 1, 3.0, "fault armed")
+        t0 = time.monotonic()
+        await engine.stop()
+        elapsed = time.monotonic() - t0
+    assert elapsed < 1.5, f"stop() took {elapsed:.2f}s with a hung call"
+    snap = engine.snapshot()
+    assert snap["abandoned_calls"] >= 1
+    assert engine.state.value == "stopped"
+
+
+@pytest.mark.asyncio
+async def test_searcher_restarts_on_backend_error():
+    """A backend exception escaping the search loop must restart the
+    searcher under capped backoff (not silently kill the device) and be
+    visible as searcher_restarts in the snapshot."""
+    engine = MiningEngine(py_backends(1), config=fast_config())
+    inj = faults.FaultInjector(23).error(
+        "device.call:py0", window=(0.0, 0.3)
+    )
+    async with running(engine), faults_active(inj):
+        engine.set_job(make_job("err-restart"))
+        sup = engine.supervisors["py0"]
+        await wait_until(lambda: sup.searcher_restarts >= 2, 3.0,
+                         "searcher restarts")
+        # after the error window the restarted searcher mines again
+        await wait_until(
+            lambda: engine.snapshot()["devices"]["py0"]["hashes"] > 0,
+            4.0, "mining resumed",
+        )
+        snap = engine.snapshot()
+        assert snap["devices"]["py0"]["searcher_restarts"] >= 2
+        assert snap["devices"]["py0"]["state"] == "healthy"
+
+
+@pytest.mark.asyncio
+async def test_probe_rejects_wrong_results_until_window_closes():
+    """The corrupt (wrong-result) fault mode: probes that return mangled
+    winners must FAIL oracle verification and keep the device
+    quarantined; reintegration happens only once results verify again."""
+    engine = MiningEngine(py_backends(1), config=fast_config())
+    inj = (
+        faults.FaultInjector(77)
+        .delay("device.call:py0", seconds=1.0, once=True)   # trigger
+        .corrupt("device.call:py0", window=(0.0, 1.0))      # poison probes
+    )
+    async with running(engine), faults_active(inj):
+        engine.set_job(make_job("probe-corrupt"))
+        sup = engine.supervisors["py0"]
+        await wait_until(lambda: not sup.can_mine, 3.0, "quarantine")
+        await wait_until(lambda: sup.probes_failed >= 1, 3.0,
+                         "corrupted probe rejected")
+        assert "oracle" in (sup.last_error or "")
+        assert sup.state.value in ("quarantined", "probing")
+        await wait_until(lambda: sup.state.value == "healthy", 6.0,
+                         "reintegration after corruption window")
+        assert sup.reintegrations == 1
+
+
+@pytest.mark.asyncio
+async def test_dead_after_probe_budget_and_detector_failures():
+    """A permanently hung device exhausts max_probes -> DEAD; the
+    FailureDetector emits DEVICE_HUNG on quarantine entry and DEVICE_LOST
+    on death (once each), and /health readiness reports degraded while a
+    survivor keeps mining."""
+    from otedama_tpu.runtime.failure import FailureDetector, FailureType
+
+    engine = MiningEngine(
+        py_backends(2),
+        config=fast_config(max_probes=2, probe_timeout=0.2,
+                           probe_backoff=0.03, probe_backoff_max=0.05),
+    )
+    detector = FailureDetector(engine)
+    inj = faults.FaultInjector(9).delay("device.call:py1", seconds=3.0)
+    async with running(engine), faults_active(inj):
+        engine.set_job(make_job("dead-dev"))
+        sup = engine.supervisors["py1"]
+        found = []
+        await wait_until(
+            lambda: (found.extend(detector.check()) or
+                     sup.state.value == "dead"),
+            8.0, "device death",
+        )
+        found.extend(detector.check())
+        # only the DEVICE_* edge events are under test here: the
+        # detector may legitimately also emit engine-level failures
+        # (e.g. a hashrate drop caused by the outage itself)
+        device_failures = [
+            f for f in found
+            if f.type in (FailureType.DEVICE_HUNG, FailureType.DEVICE_LOST)
+        ]
+        types = [f.type for f in device_failures]
+        assert types.count(FailureType.DEVICE_HUNG) == 1
+        assert types.count(FailureType.DEVICE_LOST) == 1
+        assert [f.component for f in device_failures] == ["py1", "py1"]
+
+        health = engine.device_health()
+        assert health["status"] == "degraded"
+        assert health["active_devices"] == 1
+        assert health["device_states"]["py1"] == "dead"
+        # the survivor still mines
+        h0 = engine.snapshot()["devices"]["py0"]["hashes"]
+        await wait_until(
+            lambda: engine.snapshot()["devices"]["py0"]["hashes"] > h0,
+            3.0, "survivor mining",
+        )
+        t0 = time.monotonic()
+        await engine.stop()
+        assert time.monotonic() - t0 < 2 * engine.config.drain_timeout + 1.0
+
+
+# -- extranonce2 reassignment --------------------------------------------------
+
+class FullSpaceBackend:
+    """Fake device: one call covers the whole 2^32 nonce space, so the
+    engine rolls to the device's next extranonce2 block every call. The
+    single winner encodes the device index in its nonce so shares can be
+    attributed to the device that mined them."""
+
+    preferred_batch = 1 << 32
+
+    def __init__(self, name: str, index: int):
+        self.name = name
+        self.index = index
+        self.calls = 0
+
+    def search(self, jc, base, count):
+        self.calls += 1
+        time.sleep(0.004)  # keep the en2 roll rate bounded
+        return SearchResult(
+            [Winner(self.index, jc.digest_for(self.index))], count,
+            0xFFFFFFFF,
+        )
+
+
+@pytest.mark.asyncio
+async def test_en2_blocks_disjoint_and_reassigned_after_quarantine():
+    """Devices own disjoint extranonce2 blocks (stride layout); when a
+    device is quarantined the surviving layout covers the whole en2 space
+    again — the lost device's block is NOT orphaned."""
+    backends = {
+        f"r{i}": FullSpaceBackend(f"r{i}", i) for i in range(3)
+    }
+    shares = []
+
+    async def on_share(s):
+        shares.append(s)
+
+    engine = MiningEngine(
+        backends, on_share=on_share,
+        config=EngineConfig(
+            batch_size=1 << 32, auto_batch=True, pipeline_depth=1,
+            watchdog_multiplier=3.0, watchdog_floor=0.3,
+            watchdog_first_deadline=0.4, watchdog_min_samples=1,
+            probe_timeout=0.3, probe_backoff=1.0, probe_backoff_max=1.0,
+            max_probes=1, probe_count=16, drain_timeout=1.0,
+        ),
+    )
+    job1 = make_job("layout-1")
+    inj = faults.FaultInjector(3).delay("device.call:r2", seconds=1.5)
+    async with running(engine):
+        engine.set_job(job1)
+        # phase 1: all three devices mine disjoint residue classes mod 3
+        await wait_until(lambda: len(shares) >= 9, 5.0, "phase-1 shares")
+        phase1 = [s for s in shares if s.job_id == "layout-1"]
+        for s in phase1:
+            en2 = int.from_bytes(s.extranonce2, "big")
+            assert en2 % 3 == s.nonce_word, (
+                f"device {s.nonce_word} mined en2 {en2} outside its block"
+            )
+
+        # phase 2: r2 hangs -> quarantined; surviving layout strides by 2
+        with faults.active(inj):
+            sup = engine.supervisors["r2"]
+            await wait_until(lambda: not sup.can_mine, 4.0, "r2 quarantine")
+            await wait_until(lambda: engine._relayouts >= 1, 2.0, "relayout")
+            shares.clear()
+            job2 = make_job("layout-2")
+            engine.set_job(job2)
+            await wait_until(
+                lambda: len(
+                    [s for s in shares if s.job_id == "layout-2"]
+                ) >= 8,
+                5.0, "phase-2 shares",
+            )
+            phase2 = [s for s in shares if s.job_id == "layout-2"]
+            en2_by_dev: dict[int, set] = {}
+            for s in phase2:
+                en2 = int.from_bytes(s.extranonce2, "big")
+                en2_by_dev.setdefault(s.nonce_word, set()).add(en2)
+            assert set(en2_by_dev) == {0, 1}, "quarantined r2 kept mining"
+            # disjoint blocks with stride 2 over the survivors...
+            for dev, en2s in en2_by_dev.items():
+                residues = {e % 2 for e in en2s}
+                assert len(residues) == 1
+            assert (en2_by_dev[0] | en2_by_dev[1]) >= {0, 1, 2, 3}, (
+                "old r2 block (en2=2 under the 3-way layout) was orphaned"
+            )
+
+
+# -- pod re-shard --------------------------------------------------------------
+
+class FakePodBackend:
+    """Pod-shaped fake: en2_fanout host rows, each row's search computed
+    by the exact host oracle (hashlib), so emitted shares can be checked
+    bit-for-bit. Stands in for a PodBackend whose SPMD compile is
+    minutes-slow on the CPU mesh (the real pod path is covered by the
+    slow tier's test_engine_mines_on_pod_backend)."""
+
+    max_batch = 2048
+
+    def __init__(self, name: str, n_hosts: int):
+        self.name = name
+        self.en2_fanout = n_hosts
+
+    def search_multi(self, jcs, base, count):
+        return [
+            _scalar_search(jc, base, count, jc.digest_for) for jc in jcs
+        ]
+
+    def search(self, jc, base, count):
+        if self.en2_fanout != 1:
+            raise ValueError("use search_multi")
+        return _scalar_search(jc, base, count, jc.digest_for)
+
+
+@pytest.mark.asyncio
+async def test_pod_reshard_share_correctness_vs_host_oracle():
+    """replace_backend swaps a 3-row pod for a 2-row survivor pod while
+    the engine runs; shares before AND after the re-shard are exactly the
+    host oracle's winners for their extranonce spaces, with no
+    duplicates across the membership change."""
+    shares = []
+
+    async def on_share(s):
+        shares.append(s)
+
+    pod3 = FakePodBackend("fakepod3", 3)
+    engine = MiningEngine(
+        {pod3.name: pod3}, on_share=on_share,
+        config=fast_config(batch_size=2048, auto_batch=False),
+    )
+    job = make_job("reshard-1")
+    async with running(engine):
+        engine.set_job(job)
+        await wait_until(lambda: len(shares) >= 3, 5.0, "pod3 shares")
+
+        pod2 = FakePodBackend("fakepod2", 2)
+        await engine.replace_backend(pod3.name, pod2)
+        assert ("fakepod2" in engine.backends
+                and "fakepod3" not in engine.backends)
+        n_before = len(shares)
+        await wait_until(lambda: len(shares) >= n_before + 3, 5.0,
+                         "pod2 shares after re-shard")
+
+    seen = set()
+    fanouts_seen = set()
+    for s in shares:
+        key = (s.extranonce2, s.nonce_word)
+        assert key not in seen, "duplicate share across the re-shard"
+        seen.add(key)
+        jc = job_constants(job, s.extranonce2)
+        assert s.digest == jc.digest_for(s.nonce_word)
+        assert tgt.hash_meets_target(s.digest, jc.target)
+        fanouts_seen.add(int.from_bytes(s.extranonce2, "big"))
+    # both layouts actually produced work (first call rows 0..2, then 0..1)
+    assert fanouts_seen >= {0, 1, 2}
+    snap = engine.snapshot()
+    assert snap["devices"]["fakepod2"]["state"] == "healthy"
+
+
+def test_degraded_pod_backend_rebuilds_over_survivors():
+    """degraded_pod_backend rebuilds the same pod class over the
+    surviving JAX devices with the host-row count (and so en2_fanout)
+    shrunk to divide them; construction is compile-free."""
+    import jax
+
+    from otedama_tpu.runtime.mesh import (
+        PodBackend,
+        degraded_pod_backend,
+        make_pod_mesh,
+    )
+
+    devices = jax.devices()
+    assert len(devices) == 8
+    backend = PodBackend(make_pod_mesh(devices, n_hosts=2), jnp_tile=256)
+    assert (backend.pod.n_hosts, backend.pod.n_chips) == (2, 4)
+
+    rebuilt = degraded_pod_backend(backend, survivors=devices[:6])
+    assert rebuilt is not None
+    assert (rebuilt.pod.n_hosts, rebuilt.pod.n_chips) == (2, 3)
+    assert rebuilt.en2_fanout == 2
+    assert rebuilt.pod.jnp_tile == 256  # construction kwargs preserved
+
+    # nothing lost -> nothing to rebuild; nothing survived -> None too
+    assert degraded_pod_backend(backend, survivors=devices) is None
+    assert degraded_pod_backend(backend, survivors=[]) is None
+    # non-pod backends are not rebuildable (they just drop out)
+    assert degraded_pod_backend(PythonBackend(), survivors=devices) is None
+
+
+# -- fault plumbing + observability --------------------------------------------
+
+def test_device_call_corrupt_action_and_supports_gate():
+    """The corrupt action mangles winners deterministically; actions a
+    seam does not support are skipped WITHOUT counting as fired."""
+    jc = supervision.probe_job_constants()
+    res = _scalar_search(jc, supervision.PROBE_BASE, 64, jc.digest_for)
+    assert res.winners, "probe target must guarantee winners"
+    mangled = supervision.corrupt_result(res)
+    assert [w.nonce_word for w in mangled.winners] == \
+        [w.nonce_word for w in res.winners]
+    assert all(
+        m.digest != w.digest
+        for m, w in zip(mangled.winners, res.winners)
+    )
+    assert not supervision.verify_probe_results(
+        "sha256d", jc, mangled, supervision.PROBE_BASE, 64
+    )
+    assert supervision.verify_probe_results(
+        "sha256d", jc, res, supervision.PROBE_BASE, 64
+    )
+    # a winnerless result grows a fabricated (wrong) winner
+    empty = SearchResult([], 16, 0xFFFFFFFF)
+    assert supervision.corrupt_result(empty).winners
+
+    # supports gate: drop is not applicable to device.call
+    inj = faults.FaultInjector(1).drop("device.call")
+    assert inj.hit("device.call", "py0", faults.DEVICE) is None
+    assert inj.rules[0].fires == 0
+    # corrupt IS applicable, and only where declared
+    inj2 = faults.FaultInjector(1).corrupt("device.call")
+    d = inj2.hit("device.call", "py0", faults.DEVICE)
+    assert d is not None and d.corrupt
+    assert inj2.hit("stratum.client.read", "x", faults.POINT) is None
+
+
+@pytest.mark.asyncio
+async def test_health_endpoint_reflects_degraded_capacity():
+    """/health: 200 ok -> 200 degraded (serving at reduced capacity) ->
+    503 unready (no device able to mine); a broken source is a 500."""
+    import json
+
+    from otedama_tpu.api.server import ApiServer
+
+    api = ApiServer()
+    resp = await api._health(None)
+    assert resp.status == 200
+
+    state = {"status": "degraded", "active_devices": 1, "total_devices": 2}
+    api.health_source = lambda: state
+    resp = await api._health(None)
+    assert resp.status == 200
+    assert json.loads(resp.body)["status"] == "degraded"
+    assert json.loads(resp.body)["active_devices"] == 1
+
+    state["status"] = "unready"
+    resp = await api._health(None)
+    assert resp.status == 503
+
+    def boom():
+        raise RuntimeError("snapshot exploded")
+
+    api.health_source = boom
+    resp = await api._health(None)
+    assert resp.status == 500
+
+
+def test_device_state_names_in_sync():
+    """The API layer restates DeviceState values as literals (it must
+    not import subsystem modules); this pins the two in sync so a new
+    or renamed state cannot silently vanish from the one-hot family."""
+    from otedama_tpu.api.server import ApiServer
+
+    assert set(ApiServer._DEVICE_STATES) == {
+        s.value for s in supervision.DeviceState
+    }
+    assert len(ApiServer._DEVICE_STATES) == len(supervision.DeviceState)
+
+
+def test_probe_verification_structural_for_non_oracle_algorithms():
+    """Ethash-class backends pin an epoch context the height-0 host
+    oracle cannot reproduce: their probes verify structurally (range,
+    digest shape, target) instead of failing a healthy device DEAD —
+    and corruption (inverted digests) still fails the target check."""
+    jc = supervision.probe_job_constants("ethash")
+    good = SearchResult(
+        [Winner(supervision.PROBE_BASE + 1, b"\x01" + b"\x00" * 31)],
+        64, 0xFFFFFFFF,
+    )
+    assert supervision.verify_probe_results(
+        "ethash", jc, good, supervision.PROBE_BASE, 64
+    )
+    # corrupt digests no longer meet the easy probe target
+    assert not supervision.verify_probe_results(
+        "ethash", jc, supervision.corrupt_result(good),
+        supervision.PROBE_BASE, 64,
+    )
+    # out-of-range winners are rejected
+    bad = SearchResult(
+        [Winner(supervision.PROBE_BASE + 4096, b"\x01" + b"\x00" * 31)],
+        64, 0xFFFFFFFF,
+    )
+    assert not supervision.verify_probe_results(
+        "ethash", jc, bad, supervision.PROBE_BASE, 64
+    )
+
+
+def test_metrics_export_device_supervision_families():
+    """sync_engine_metrics renders the new supervision families."""
+    from otedama_tpu.api.server import ApiServer
+
+    api = ApiServer()
+    api.sync_engine_metrics({
+        "hashrate": 1.0,
+        "shares": {},
+        "relayouts": 3,
+        "devices": {
+            "pod2x4": {
+                "hashrate": 1.0,
+                "state": "quarantined",
+                "quarantines": 2,
+                "searcher_restarts": 1,
+                "abandoned_calls": 4,
+                "call_seconds": {
+                    "buckets": {0.1: 5, 1.0: 9},
+                    "sum": 3.5,
+                    "count": 9,
+                },
+            },
+        },
+    })
+    text = api.registry.render()
+    assert ('otedama_device_state{device="pod2x4",state="quarantined"} 1'
+            in text)
+    assert ('otedama_device_state{device="pod2x4",state="healthy"} 0'
+            in text)
+    assert ('otedama_device_quarantines_total{device="pod2x4"} 2'
+            in text)
+    assert 'otedama_device_searcher_restarts_total{device="pod2x4"} 1' in text
+    assert 'otedama_device_abandoned_calls_total{device="pod2x4"} 4' in text
+    assert 'otedama_device_call_seconds_bucket' in text
+    assert 'otedama_device_relayouts_total 3' in text
+
+    # per-device series mirror the snapshot: a device replaced by its
+    # degraded rebuild must not keep a latched quarantined=1 series
+    api.sync_engine_metrics({
+        "hashrate": 1.0,
+        "shares": {},
+        "devices": {"pod1x3": {"hashrate": 1.0, "state": "healthy"}},
+    })
+    text = api.registry.render()
+    assert 'device="pod2x4"' not in text
+    assert 'otedama_device_state{device="pod1x3",state="healthy"} 1' in text

@@ -1,0 +1,234 @@
+"""Degraded-mesh resilience bench (device supervision lifecycle).
+
+Measures the three numbers the watchdog/quarantine/reintegration layer
+exists to bound, and emits a ``BENCH_DEGRADE_*.json`` artifact:
+
+1. **time_to_quarantine_seconds** — injected hang (``device.call`` fault
+   point) -> the device leaves the mining set. Must be on the order of
+   the armed watchdog deadline, never the hang duration.
+2. **hashrate_recovery** — survivor throughput during the outage vs the
+   pre-fault baseline, plus time from fault-window close to the device's
+   verified reintegration.
+3. **shares_lost** — shares found during the chaos run vs a fault-free
+   control run of identical duration/seed (the survivors' re-sharded
+   extranonce2 layout should keep most of the flow alive).
+
+Also times a bounded ``stop()`` with a call still hung in flight — the
+drain-timeout guarantee, measured rather than asserted.
+
+Usage:
+    python tools/bench_degrade.py --out BENCH_DEGRADE_r08.json [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import platform
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from otedama_tpu.engine.engine import EngineConfig, MiningEngine   # noqa: E402
+from otedama_tpu.engine.types import Job                           # noqa: E402
+from otedama_tpu.runtime.search import PythonBackend               # noqa: E402
+from otedama_tpu.utils import faults                               # noqa: E402
+
+EASY_TARGET = (1 << 256) - 1 >> 12
+N_DEVICES = 3
+HUNG = "py1"
+
+
+def make_job(jid: str) -> Job:
+    return Job(
+        job_id=jid, prev_hash=bytes(32), coinb1=b"\x01" * 8,
+        coinb2=b"\x02" * 8, merkle_branch=[], version=0x20000000,
+        nbits=0x1D00FFFF, ntime=1700000000, extranonce1=b"\xaa\xbb",
+        extranonce2_size=4, share_target=EASY_TARGET, algorithm="sha256d",
+    )
+
+
+def build_engine(shares: list, *, drain_timeout: float = 1.0) -> MiningEngine:
+    backends = {}
+    for i in range(N_DEVICES):
+        b = PythonBackend()
+        b.name = f"py{i}"
+        backends[b.name] = b
+
+    async def on_share(s):
+        shares.append((time.monotonic(), s))
+
+    return MiningEngine(
+        backends, on_share=on_share,
+        config=EngineConfig(
+            batch_size=1024, auto_batch=False, pipeline_depth=1,
+            watchdog_multiplier=4.0, watchdog_floor=0.1,
+            watchdog_first_deadline=0.5, watchdog_min_samples=1,
+            probe_timeout=0.8, probe_backoff=0.1, probe_backoff_max=0.4,
+            max_probes=50, probe_count=128, drain_timeout=drain_timeout,
+        ),
+    )
+
+
+async def run_once(duration: float, fault_window: tuple | None,
+                   hang_seconds: float) -> dict:
+    """One mining run; with a fault window, HUNG wedges for its length."""
+    shares: list = []
+    engine = build_engine(shares)
+    inj = None
+    if fault_window is not None:
+        inj = faults.FaultInjector(1337).delay(
+            f"device.call:{HUNG}", seconds=hang_seconds, window=fault_window
+        )
+        faults.activate(inj)
+    out: dict = {}
+    try:
+        await engine.start()
+        engine.set_job(make_job("bench"))
+        t0 = time.monotonic()
+        sup = engine.supervisors[HUNG]
+        quarantined_at = reintegrated_at = None
+        while time.monotonic() - t0 < duration:
+            await asyncio.sleep(0.02)
+            if quarantined_at is None and not sup.can_mine:
+                quarantined_at = time.monotonic() - t0
+            if (quarantined_at is not None and reintegrated_at is None
+                    and sup.state.value == "healthy"):
+                reintegrated_at = time.monotonic() - t0
+        snap = engine.snapshot()
+        out = {
+            "shares": len(shares),
+            "hashes": snap["hashes"],
+            "quarantined_at": quarantined_at,
+            "reintegrated_at": reintegrated_at,
+            "relayouts": snap["relayouts"],
+            "abandoned_calls": snap["abandoned_calls"],
+            "quarantines": snap["devices"][HUNG]["quarantines"],
+        }
+        # survivor throughput while HUNG is out (fault runs only): the
+        # window is defined on the injector's clock (seconds since
+        # activate()), so filter share timestamps against armed_at, not
+        # against the post-start t0
+        if inj is not None and quarantined_at is not None:
+            w0, w1 = fault_window
+            in_window = [
+                s for t, s in shares if w0 <= t - inj.armed_at < w1
+            ]
+            out["shares_during_window"] = len(in_window)
+        await engine.stop()
+    finally:
+        if inj is not None:
+            faults.deactivate()
+    return out
+
+
+async def bounded_stop_seconds(hang_seconds: float,
+                               drain_timeout: float) -> dict:
+    """stop() wall time with a call permanently hung in flight."""
+    shares: list = []
+    engine = build_engine(shares, drain_timeout=drain_timeout)
+    inj = faults.FaultInjector(7).delay(
+        f"device.call:{HUNG}", seconds=hang_seconds
+    )
+    faults.activate(inj)
+    try:
+        await engine.start()
+        engine.set_job(make_job("stop-bench"))
+        t0 = time.monotonic()
+        while inj.rules[0].fires < 1 and time.monotonic() - t0 < 5.0:
+            await asyncio.sleep(0.02)
+        t1 = time.monotonic()
+        await engine.stop()
+        stop_seconds = time.monotonic() - t1
+    finally:
+        faults.deactivate()
+    return {
+        "drain_timeout": drain_timeout,
+        "stop_seconds": stop_seconds,
+        "abandoned_calls": engine.snapshot()["abandoned_calls"],
+    }
+
+
+async def main(out_path: str, quick: bool) -> int:
+    duration = 4.0 if quick else 8.0
+    fault_start, fault_end = (1.0, 2.5) if quick else (2.0, 5.0)
+    hang = 10.0  # longer than the window: every in-window call wedges
+
+    control = await run_once(duration, None, hang)
+    chaos = await run_once(duration, (fault_start, fault_end), hang)
+    stop = await bounded_stop_seconds(hang_seconds=4.0, drain_timeout=0.5)
+
+    failures = []
+    if chaos["quarantined_at"] is None:
+        failures.append("hung device was never quarantined")
+    else:
+        tq = chaos["quarantined_at"] - fault_start
+        if tq > 2.0:
+            failures.append(f"time-to-quarantine {tq:.2f}s exceeds 2 s")
+    if chaos["reintegrated_at"] is None:
+        failures.append("device never reintegrated after the fault window")
+    if stop["stop_seconds"] > 2 * stop["drain_timeout"] + 0.5:
+        failures.append(
+            f"stop() took {stop['stop_seconds']:.2f}s with a hung call"
+        )
+
+    shares_lost = max(control["shares"] - chaos["shares"], 0)
+    result = {
+        "bench": "degrade",
+        "created": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "platform": {
+            "python": platform.python_version(),
+            "machine": platform.machine(),
+            "jax_platforms": os.environ.get("JAX_PLATFORMS", ""),
+        },
+        "config": {
+            "devices": N_DEVICES,
+            "hung_device": HUNG,
+            "duration_seconds": duration,
+            "fault_window_seconds": [fault_start, fault_end],
+            "watchdog_floor": 0.1,
+            "watchdog_multiplier": 4.0,
+        },
+        "time_to_quarantine_seconds": (
+            None if chaos["quarantined_at"] is None
+            else round(chaos["quarantined_at"] - fault_start, 3)
+        ),
+        "reintegration_seconds_after_window": (
+            None if chaos["reintegrated_at"] is None
+            else round(chaos["reintegrated_at"] - fault_end, 3)
+        ),
+        "shares_control": control["shares"],
+        "shares_chaos": chaos["shares"],
+        "shares_during_fault_window": chaos.get("shares_during_window"),
+        "shares_lost": shares_lost,
+        "share_retention": (
+            round(chaos["shares"] / control["shares"], 3)
+            if control["shares"] else None
+        ),
+        "hashes_control": control["hashes"],
+        "hashes_chaos": chaos["hashes"],
+        "relayouts": chaos["relayouts"],
+        "abandoned_calls": chaos["abandoned_calls"],
+        "bounded_stop": stop,
+        "failures": failures,
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result, indent=2))
+    if failures:
+        print(f"DEGRADE BENCH FAILED: {failures}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="BENCH_DEGRADE_manual.json")
+    ap.add_argument("--quick", action="store_true",
+                    help="short windows (CI smoke)")
+    args = ap.parse_args()
+    sys.exit(asyncio.run(main(args.out, args.quick)))
