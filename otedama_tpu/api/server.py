@@ -521,6 +521,48 @@ class ApiServer:
                 help_="XLA compile durations per (algorithm, backend)",
             )
 
+    def sync_p2p_metrics(self, snapshot: dict) -> None:
+        """Share-chain + overlay health from a P2PPool snapshot: chain
+        height/tip work (is this node converged?), reorg and orphan
+        pressure (is the overlay partitioning?), and verification rejects
+        (is a peer feeding us garbage?)."""
+        reg = self.registry
+        chain = snapshot.get("chain", {})
+        reg.gauge_set("otedama_p2p_peers", snapshot.get("peers", 0),
+                      help_="Connected overlay peers")
+        reg.gauge_set("otedama_p2p_chain_height", chain.get("height", 0),
+                      help_="Best share-chain height")
+        # tip work is an exact 256-bit int; the float cast is lossy but
+        # monotone, which is all a convergence gauge needs
+        reg.gauge_set("otedama_p2p_tip_work", float(chain.get("tip_work", 0)),
+                      help_="Cumulative work of the best share-chain tip")
+        reg.gauge_set("otedama_p2p_orphans", chain.get("orphans", 0),
+                      help_="Shares held waiting for their parent")
+        reg.gauge_set("otedama_p2p_reorg_depth_max",
+                      chain.get("deepest_reorg", 0),
+                      help_="Deepest reorg performed since start")
+        reg.counter_set("otedama_p2p_reorgs_total", chain.get("reorgs", 0),
+                        help_="Best-tip reorgs performed")
+        reg.counter_set("otedama_p2p_reorgs_refused_total",
+                        chain.get("reorgs_refused", 0),
+                        help_="Forks refused for exceeding max reorg depth")
+        reg.counter_set("otedama_p2p_shares_connected_total",
+                        chain.get("shares_connected", 0),
+                        help_="PoW-verified shares linked into the chain")
+        reg.counter_set("otedama_p2p_shares_rejected_total",
+                        snapshot.get("shares_rejected", 0),
+                        help_="Gossiped shares failing verification")
+        reg.counter_set("otedama_p2p_share_verify_failures_total",
+                        snapshot.get("verify_failures", 0),
+                        help_="Share verifications lost to internal/injected errors")
+        with reg.atomic():
+            reg.clear_family("otedama_p2p_share_rejects")
+            for reason, count in snapshot.get("rejects", {}).items():
+                reg.counter_set(
+                    "otedama_p2p_share_rejects", count, {"reason": reason},
+                    help_="Share rejections by verification failure reason",
+                )
+
     def sync_pool_server_metrics(self, server=None, server_v2=None) -> None:
         """Export the POOL-side share-accept latency SLO histograms
         (submit-received -> verdict-written, per protocol). The client

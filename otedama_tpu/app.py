@@ -485,6 +485,7 @@ class Application:
     async def _start_p2p(self) -> None:
         from otedama_tpu.p2p.node import NodeConfig
         from otedama_tpu.p2p.pool import P2PPool
+        from otedama_tpu.p2p.sharechain import ChainParams
 
         cfg = self.config.p2p
         bootstrap = []
@@ -492,10 +493,24 @@ class Application:
             host, _, port = str(entry).rpartition(":")
             if host:
                 bootstrap.append((host, int(port)))
-        self.p2p = P2PPool(NodeConfig(
-            host=cfg.host, port=cfg.port, max_peers=cfg.max_peers,
-            bootstrap=bootstrap,
-        ))
+        self.p2p = P2PPool(
+            NodeConfig(
+                host=cfg.host, port=cfg.port, max_peers=cfg.max_peers,
+                bootstrap=bootstrap,
+            ),
+            # the share chain mines/verifies the pool's own algorithm;
+            # the consensus knobs come straight from config so every
+            # node of one deployment agrees on them
+            ChainParams(
+                algorithm=self.config.mining.algorithm,
+                min_difficulty=cfg.share_difficulty,
+                window=cfg.pplns_window,
+                max_reorg_depth=cfg.max_reorg_depth,
+                max_time_skew=cfg.max_time_skew,
+                share_interval=cfg.share_interval,
+                sync_page=cfg.sync_page,
+            ),
+        )
         await self.p2p.start()
         self._started.append(self.p2p)
 
@@ -842,6 +857,8 @@ class Application:
                 self.api.sync_rpc_pool_metrics(chains)
             if self.server is not None or self.server_v2 is not None:
                 self.api.sync_pool_server_metrics(self.server, self.server_v2)
+            if self.p2p is not None:
+                self.api.sync_p2p_metrics(self.p2p.snapshot())
             self.api.sync_compile_metrics(
                 compile_cache.counters(), compile_cache.histograms()
             )

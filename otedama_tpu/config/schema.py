@@ -175,6 +175,26 @@ class P2PConfig:
     port: int = 4333
     max_peers: int = 32
     bootstrap: list = dataclasses.field(default_factory=list)  # ["host:port"]
+    # -- share chain consensus parameters (p2p/sharechain.py) ----------------
+    # every node of one chain must agree on these, like a chain's genesis
+    # rules: a share's claimed difficulty must be >= share_difficulty and
+    # is verified against its PoW, never trusted
+    share_difficulty: float = 1.0
+    # PPLNS window in SHARES of the best chain (the pool.pplns_window knob
+    # counts stratum submits; this one counts chain shares)
+    pplns_window: int = 8192
+    # deepest rewind a node will perform when a heavier fork appears;
+    # deeper forks are refused and counted (payout-horizon protection)
+    max_reorg_depth: int = 96
+    # shares dated further than this into the future are rejected (one
+    # clock-skewed peer must not pre-date work into everyone's window)
+    max_time_skew: float = 300.0
+    # intended share production cadence, seconds (capacity planning /
+    # future retarget rule; not yet consensus-critical)
+    share_interval: float = 10.0
+    # shares per locator-sync response page (bounded catch-up after
+    # partitions; clamped to the wire MAX_SYNC_PAGE)
+    sync_page: int = 200
 
 
 @dataclasses.dataclass
@@ -315,6 +335,18 @@ def validate_config(cfg: AppConfig) -> list[str]:
         errors.append("pool.fee_percent out of range")
     if cfg.pool.pplns_window <= 0:
         errors.append("pool.pplns_window must be positive")
+    if cfg.p2p.share_difficulty <= 0:
+        errors.append("p2p.share_difficulty must be positive")
+    if cfg.p2p.pplns_window <= 0:
+        errors.append("p2p.pplns_window must be positive")
+    if cfg.p2p.max_reorg_depth < 1:
+        errors.append("p2p.max_reorg_depth must be >= 1")
+    if cfg.p2p.max_time_skew <= 0:
+        errors.append("p2p.max_time_skew must be positive")
+    if cfg.p2p.share_interval <= 0:
+        errors.append("p2p.share_interval must be positive")
+    if cfg.p2p.sync_page < 1:
+        errors.append("p2p.sync_page must be >= 1")
     return errors
 
 
@@ -359,6 +391,12 @@ p2p:
   port: 4333
   max_peers: 32
   bootstrap: []
+  share_difficulty: 1.0   # chain share difficulty floor (PoW-verified)
+  pplns_window: 8192      # PPLNS window in chain shares
+  max_reorg_depth: 96     # deepest fork rewind a node will perform
+  max_time_skew: 300.0    # reject shares dated further into the future
+  share_interval: 10.0    # intended share cadence, seconds
+  sync_page: 200          # shares per locator-sync page
 
 api:
   enabled: true

@@ -26,6 +26,46 @@ import time
 MAGIC = 0x4F54504F  # "OTPO"
 MAX_FRAME = 4 << 20  # 4 MiB
 
+# -- share-chain schemas ------------------------------------------------------
+#
+# SHARE payload (p2p/sharechain.py Share.to_payload):
+#     {"header": <160 hex chars>, "worker": str, "job_id": str,
+#      "ts_ms": int, "algorithm": str, "block_number": int}
+# The 80-byte header IS the proof: prev-share hash at bytes 4:36, claim
+# commitment at 36:68, claimed target as compact nbits at 72:76. Receivers
+# verify the PoW before linking or re-flooding.
+#
+# SYNC_REQUEST payload (locator-based catch-up, replaces the timestamp dump):
+#     {"locator": [<64 hex chars>, ...], "page": int}
+# Locator hashes run newest -> oldest, exponentially spaced (bitcoin block
+# locator); at most MAX_LOCATOR entries are honored.
+#
+# SYNC_RESPONSE payload:
+#     {"shares": [<SHARE payload>, ...], "more": bool}
+# Shares are the best-chain suffix after the highest recognized locator
+# hash, oldest first, at most MAX_SYNC_PAGE per page; "more" drives the
+# requester's next page.
+
+MAX_SYNC_PAGE = 500
+MAX_LOCATOR = 64
+
+
+def parse_locator(raw) -> list[str]:
+    """Validate a wire locator: a bounded list of 32-byte hex hashes.
+    Malformed entries are dropped (a partial locator still syncs — the
+    receiver just starts from an earlier fork point or genesis)."""
+    if not isinstance(raw, list):
+        return []
+    out: list[str] = []
+    for entry in raw[:MAX_LOCATOR]:
+        if isinstance(entry, str) and len(entry) == 64:
+            try:
+                bytes.fromhex(entry)
+            except ValueError:
+                continue
+            out.append(entry)
+    return out
+
 
 class MessageType(enum.IntEnum):
     HANDSHAKE = 1

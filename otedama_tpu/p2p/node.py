@@ -29,7 +29,9 @@ log = logging.getLogger("otedama.p2p")
 
 Handler = Callable[["P2PNode", "Peer", P2PMessage], Awaitable[None]]
 
-PROTOCOL_VERSION = 1
+# v2: share gossip carries PoW'd headers and sync is locator-based
+# (p2p/sharechain.py); the old claimed-difficulty ledger schema is gone
+PROTOCOL_VERSION = 2
 
 
 @dataclasses.dataclass
@@ -42,6 +44,9 @@ class NodeConfig:
     peer_timeout: float = 90.0
     dedup_window: int = 4096
     bootstrap: list[tuple[str, int]] = dataclasses.field(default_factory=list)
+    # pinned node id (64 hex chars) — deterministic overlays for seeded
+    # chaos tests (fault points tag by id prefix); "" = random
+    node_id: str = ""
 
 
 @dataclasses.dataclass
@@ -77,7 +82,7 @@ class Peer:
 class P2PNode:
     def __init__(self, config: NodeConfig | None = None):
         self.config = config or NodeConfig()
-        self.node_id = secrets.token_hex(32)
+        self.node_id = self.config.node_id or secrets.token_hex(32)
         self.peers: dict[str, Peer] = {}
         self.handlers: dict[MessageType, Handler] = {}
         self.stats = {
@@ -112,6 +117,13 @@ class P2PNode:
                 log.warning("bootstrap %s:%d failed: %s", host, port, e)
 
     async def stop(self) -> None:
+        # snapshot writers FIRST: awaiting the cancelled peer tasks runs
+        # their finally-block _drop_peer, which empties self.peers — a
+        # later snapshot would await nothing and leak the transports
+        writers = [p.writer for p in self.peers.values()]
+        # cancel the keepalive loop AND in-flight _connect_quietly dials
+        # (discovery appends them to _tasks): a dial completing after stop
+        # would register a peer loop nobody will ever reap
         for t in self._tasks + list(self._peer_tasks.values()):
             t.cancel()
         await asyncio.gather(
@@ -119,13 +131,30 @@ class P2PNode:
         )
         self._tasks.clear()
         self._peer_tasks.clear()
-        for p in list(self.peers.values()):
-            p.writer.close()
+        self._dialing.clear()
+        for w in writers:
+            w.close()
         self.peers.clear()
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
             self._server = None
+        # close() only schedules the transport teardown; without awaiting
+        # wait_closed() repeated start/stop cycles leak live transports
+        await asyncio.gather(
+            *(self._await_writer_closed(w) for w in writers),
+            return_exceptions=True,
+        )
+
+    @staticmethod
+    async def _await_writer_closed(writer) -> None:
+        wait = getattr(writer, "wait_closed", None)
+        if wait is None:
+            return  # non-transport writer (in-memory test links)
+        try:
+            await asyncio.wait_for(wait(), 5.0)
+        except (asyncio.TimeoutError, ConnectionError, OSError):
+            pass  # a wedged transport must not hang shutdown
 
     @property
     def port(self) -> int:
@@ -325,22 +354,26 @@ class P2PNode:
         Marks the id as seen so our own flood doesn't bounce back in."""
         msg.sender = msg.sender or self.node_id
         self._dedup(msg.message_id)  # pre-mark
-        n = 0
+        sent: list[Peer] = []
         for peer in list(self.peers.values()):
             if peer.node_id == exclude:
                 continue
             try:
                 peer.send(msg)
-                n += 1
+                sent.append(peer)
             except (ConnectionError, RuntimeError):
                 self._drop_peer(peer)
-        self.stats["messages_sent"] += n
-        # writer.drain on each would serialize; flush opportunistically
+        self.stats["messages_sent"] += len(sent)
+        # writer.drain on each would serialize; flush opportunistically —
+        # but ONLY the peers this call actually wrote to: re-iterating
+        # self.peers here would touch writers of peers registered since
+        # (never written, pointless) and of peers dropped mid-broadcast
+        # (drain on a closed transport raises into the gather)
         await asyncio.gather(
-            *(p.writer.drain() for p in self.peers.values() if p.node_id != exclude),
+            *(p.writer.drain() for p in sent if not p.writer.is_closing()),
             return_exceptions=True,
         )
-        return n
+        return len(sent)
 
     async def propagate(self, peer: Peer, msg: P2PMessage) -> int:
         """Re-flood a received message to everyone but its origin."""

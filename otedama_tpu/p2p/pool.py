@@ -1,47 +1,74 @@
-"""Decentralized (P2P) pool mode: share gossip + distributed share ledger.
+"""Decentralized (P2P) pool mode: a verified share chain over flood gossip.
 
 Reference parity: internal/mining/p2p_engine.go:14-110 (engine + network
-composition), internal/p2p/handlers.go:70-447 (share/job/block handlers with
-re-propagation). Each node validates gossiped shares against the advertised
-job target and accumulates a worker->difficulty ledger; when any node finds
-a block, every node can compute the same PPLNS split from its ledger —
-the share-chain idea the reference sketches with its "ledger" message type.
+composition), internal/p2p/handlers.go:70-447 (share/job/block handlers
+with re-propagation). The reference's "ledger" message type sketched a
+share chain but trusted claimed difficulties; this node runs the real
+construction (p2p/sharechain.py): every gossiped share carries its 80-byte
+PoW'd header, receivers verify the proof-of-work OFF the event loop (the
+validation executor, like slow-algo stratum share checks) before linking,
+tips are chosen by cumulative work, reorgs rewind/replay the PPLNS window,
+and partition catch-up is locator-based paged sync. Invalid shares are
+never linked AND never re-propagated — an honest overlay quarantines a
+Byzantine peer's output at the first hop.
 """
 
 from __future__ import annotations
 
-import dataclasses
+import asyncio
 import logging
 import time
-from collections import OrderedDict
 
-from otedama_tpu.p2p.messages import MessageType, P2PMessage
+from otedama_tpu.p2p import sharechain
+from otedama_tpu.p2p.messages import (
+    MAX_SYNC_PAGE,
+    MessageType,
+    P2PMessage,
+    parse_locator,
+)
 from otedama_tpu.p2p.node import NodeConfig, P2PNode, Peer
+from otedama_tpu.p2p.sharechain import (
+    ChainParams,
+    Share,
+    ShareChain,
+    ShareFormatError,
+    ShareInvalid,
+)
+from otedama_tpu.utils import faults, pow_host
 
 log = logging.getLogger("otedama.p2p.pool")
 
+# fault-point support sets: share verification / sync steps are skippable
+# (drop = the verdict or page is lost; delay = a slow verifier/link)
+_VERIFY_FAULTS = faults.STEP
+_SYNC_FAULTS = faults.STEP
 
-@dataclasses.dataclass
-class LedgerEntry:
-    worker: str
-    difficulty: float
-    job_id: str
-    timestamp: float
-    origin: str  # node id that first saw the share
+# floor between orphan-triggered locator syncs to one peer: a burst of
+# out-of-order arrivals must not turn into a sync-request storm
+_ORPHAN_SYNC_INTERVAL = 2.0
 
 
 class P2PPool:
-    """A pool node in the gossip overlay."""
+    """A pool node in the gossip overlay, accounting on the share chain."""
 
-    def __init__(self, config: NodeConfig | None = None, window: int = 10000):
+    def __init__(self, config: NodeConfig | None = None,
+                 params: ChainParams | None = None):
         self.node = P2PNode(config)
-        self.window = window
-        self.ledger: list[LedgerEntry] = []
-        # dedup keys outlive the ledger window (bounded LRU) so late syncs
-        # can't re-append shares that were already counted and then trimmed
-        self._ledger_keys: "OrderedDict[tuple, None]" = OrderedDict()
+        self.chain = ShareChain(params)
         self.blocks_seen: list[dict] = []
         self.jobs_seen: dict[str, dict] = {}
+        self.stats = {
+            "shares_accepted": 0,      # verified + linked (or orphaned)
+            "shares_rejected": 0,      # failed verification (any reason)
+            "verify_failures": 0,      # injected/internal verifier errors
+            "sync_requests": 0,
+            "sync_pages_sent": 0,
+            "sync_pages_received": 0,
+        }
+        self.rejects: dict[str, int] = {}   # ShareInvalid.reason -> count
+        self._verifying: set[bytes] = set()  # share ids in-flight on executor
+        self._last_orphan_sync: dict[str, float] = {}
+        self._last_prune = 0                 # shares_connected at last prune
         self.node.on(MessageType.SHARE, self._on_share)
         self.node.on(MessageType.BLOCK, self._on_block)
         self.node.on(MessageType.JOB, self._on_job)
@@ -56,20 +83,43 @@ class P2PPool:
 
     # -- local events -> gossip ---------------------------------------------
 
-    async def announce_share(
-        self, worker: str, difficulty: float, job_id: str
-    ) -> None:
-        entry = LedgerEntry(worker, difficulty, job_id, time.time(), self.node.node_id)
-        self._append(entry)
-        await self.node.broadcast(P2PMessage(
-            MessageType.SHARE,
-            {
-                "worker": worker,
-                "difficulty": difficulty,
-                "job_id": job_id,
-                "ts": entry.timestamp,
-            },
-        ))
+    async def announce_share(self, worker: str, difficulty: float,
+                             job_id: str) -> Share:
+        """Mine a share extending the local tip and flood it.
+
+        Host-grinds the PoW on the default executor — the bootstrap/test
+        path. Production nodes feed device-found headers through
+        ``submit_share`` instead; either way the gossiped bytes carry a
+        real proof, because receivers verify, not trust.
+        """
+        if difficulty < self.chain.params.min_difficulty:
+            raise ValueError(
+                f"difficulty {difficulty} below chain minimum "
+                f"{self.chain.params.min_difficulty}"
+            )
+        prev = self.chain.tip if self.chain.tip is not None else sharechain.GENESIS
+        loop = asyncio.get_running_loop()
+        share = await loop.run_in_executor(
+            None, lambda: sharechain.mine_share(
+                prev, worker, job_id, difficulty,
+                algorithm=self.chain.params.algorithm,
+            ),
+        )
+        await self.submit_share(share)
+        return share
+
+    async def submit_share(self, share: Share) -> str:
+        """Verify + link a locally-produced share, then flood it. The local
+        node runs the same verification as receivers: a miner-side bug must
+        not poison our own chain (or waste a broadcast)."""
+        await self._verify_off_loop(share)
+        status = self.chain.connect(share)
+        if status != "duplicate":
+            self.stats["shares_accepted"] += 1
+            await self.node.broadcast(
+                P2PMessage(MessageType.SHARE, share.to_payload())
+            )
+        return status
 
     async def announce_block(self, block_hash: str, worker: str, height: int) -> None:
         block = {"hash": block_hash, "worker": worker, "height": height}
@@ -81,24 +131,67 @@ class P2PPool:
         self.jobs_seen[str(job_params[0])] = {"params": job_params, "ts": time.time()}
         await self.node.broadcast(P2PMessage(MessageType.JOB, {"params": job_params}))
 
-    # -- gossip handlers (validate, record, re-flood) ------------------------
+    # -- verification plumbing ----------------------------------------------
+
+    async def _verify_off_loop(self, share: Share) -> None:
+        """Run full PoW verification on the validation executor (the same
+        pool slow-algo stratum checks use) — scrypt/ethash share hashes
+        take milliseconds to seconds and must not stall the gossip pump."""
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(
+            pow_host.validation_executor(),
+            sharechain.verify_share, share, self.chain.params,
+        )
 
     async def _on_share(self, node: P2PNode, peer: Peer, msg: P2PMessage) -> None:
-        p = msg.payload
         try:
-            entry = LedgerEntry(
-                worker=str(p["worker"]),
-                difficulty=float(p["difficulty"]),
-                job_id=str(p["job_id"]),
-                timestamp=float(p.get("ts", time.time())),
-                origin=msg.sender,
-            )
-        except (KeyError, ValueError, TypeError):
-            log.warning("malformed share gossip from %s", peer.node_id[:12])
+            share = Share.from_payload(msg.payload)
+        except ShareFormatError as e:
+            self.stats["shares_rejected"] += 1
+            self.rejects["format"] = self.rejects.get("format", 0) + 1
+            log.warning("malformed share gossip from %s: %s",
+                        peer.node_id[:12], e)
             return
-        if entry.difficulty <= 0:
+        sid = share.share_id
+        if sid in self.chain or sid in self._verifying:
+            return  # already linked/held/in-flight: nothing to redo
+        try:
+            d = faults.hit("p2p.share.verify", sid.hex()[:12], _VERIFY_FAULTS)
+        except faults.FaultInjectedError:
+            self.stats["verify_failures"] += 1
             return
-        self._append(entry)
+        if d is not None:
+            if d.drop:
+                self.stats["verify_failures"] += 1
+                return
+            if d.delay:
+                await asyncio.sleep(d.delay)
+        self._verifying.add(sid)
+        try:
+            await self._verify_off_loop(share)
+        except ShareInvalid as e:
+            self.stats["shares_rejected"] += 1
+            self.rejects[e.reason] = self.rejects.get(e.reason, 0) + 1
+            log.warning("rejected share %s from %s (%s)",
+                        sid.hex()[:12], peer.node_id[:12], e)
+            return  # invalid: never linked, never re-propagated
+        except Exception:
+            self.stats["verify_failures"] += 1
+            log.exception("share verification failed internally")
+            return
+        finally:
+            self._verifying.discard(sid)
+        status = self.chain.connect(share)
+        if status == "duplicate":
+            return
+        self.stats["shares_accepted"] += 1
+        self._maybe_prune()
+        if status == "orphan":
+            # out-of-order arrival: ask the sender for our missing suffix
+            # (rate-limited per peer so a burst is one request)
+            self._request_sync_from(peer)
+        # verified shares re-flood — orphans too: a peer further along may
+        # hold the lineage we lack
         await node.propagate(peer, msg)
 
     async def _on_block(self, node: P2PNode, peer: Peer, msg: P2PMessage) -> None:
@@ -111,57 +204,145 @@ class P2PPool:
             self.jobs_seen[str(params[0])] = {"params": params, "ts": time.time()}
             await node.propagate(peer, msg)
 
-    async def _on_sync_request(self, node: P2PNode, peer: Peer, msg: P2PMessage) -> None:
-        since = float(msg.payload.get("since", 0.0))
-        entries = [
-            dataclasses.asdict(e) for e in self.ledger if e.timestamp >= since
-        ][-2000:]
+    # -- locator sync --------------------------------------------------------
+
+    def _sync_fault(self, peer: Peer) -> bool:
+        """Shared p2p.sync fault point. True = this sync step is lost."""
+        try:
+            d = faults.hit("p2p.sync", peer.node_id[:12], _SYNC_FAULTS)
+        except faults.FaultInjectedError:
+            return True
+        if d is not None and d.drop:
+            return True
+        return False
+
+    def _request_sync_from(self, peer: Peer, *, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force:
+            last = self._last_orphan_sync.get(peer.node_id, 0.0)
+            if now - last < _ORPHAN_SYNC_INTERVAL:
+                return
+        self._last_orphan_sync[peer.node_id] = now
+        # bounded: long-lived public nodes see endless peer churn, and a
+        # rate-limit stamp must not outlive its peer by much
+        while len(self._last_orphan_sync) > 1024:
+            del self._last_orphan_sync[next(iter(self._last_orphan_sync))]
+        if self._sync_fault(peer):
+            return
+        try:
+            peer.send(P2PMessage(
+                MessageType.SYNC_REQUEST,
+                {"locator": self.chain.locator(),
+                 "page": self.chain.params.sync_page},
+                sender=self.node.node_id,
+            ))
+        except (ConnectionError, RuntimeError):
+            pass
+
+    async def request_sync(self) -> None:
+        """Ask every peer for our missing best-chain suffix (partition
+        heal, cold start). Paged: each response triggers the next request
+        while the peer reports more."""
+        for peer in list(self.node.peers.values()):
+            self._request_sync_from(peer, force=True)
+
+    async def _on_sync_request(self, node: P2PNode, peer: Peer,
+                               msg: P2PMessage) -> None:
+        if self._sync_fault(peer):
+            return
+        self.stats["sync_requests"] += 1
+        locator = parse_locator(msg.payload.get("locator", []))
+        try:
+            page = int(msg.payload.get("page", self.chain.params.sync_page))
+        except (TypeError, ValueError):
+            page = self.chain.params.sync_page
+        page = max(1, min(page, MAX_SYNC_PAGE))
+        shares, more = self.chain.shares_after(locator, page)
+        self.stats["sync_pages_sent"] += 1
         peer.send(P2PMessage(
-            MessageType.SYNC_RESPONSE, {"entries": entries}, sender=node.node_id
+            MessageType.SYNC_RESPONSE,
+            {
+                "shares": [s.to_payload() for s in shares],
+                "more": bool(more),
+            },
+            sender=node.node_id,
         ))
 
-    async def _on_sync_response(self, node: P2PNode, peer: Peer, msg: P2PMessage) -> None:
-        for obj in msg.payload.get("entries", []):
-            try:
-                self._append(LedgerEntry(**obj))
-            except TypeError:
-                continue
-
-    async def request_sync(self, since: float = 0.0) -> None:
-        for peer in list(self.node.peers.values()):
-            peer.send(P2PMessage(
-                MessageType.SYNC_REQUEST, {"since": since}, sender=self.node.node_id
-            ))
-
-    # -- ledger -------------------------------------------------------------
-
-    def _append(self, entry: LedgerEntry) -> None:
-        # dedup by identity, not message_id: overlapping SYNC_RESPONSEs from
-        # several peers carry the same entries under fresh message ids, and
-        # double-counting would skew every node's PPLNS split
-        key = (entry.origin, entry.worker, entry.job_id, entry.timestamp,
-               entry.difficulty)
-        if key in self._ledger_keys:
+    async def _on_sync_response(self, node: P2PNode, peer: Peer,
+                                msg: P2PMessage) -> None:
+        if self._sync_fault(peer):
             return
-        self._ledger_keys[key] = None
-        while len(self._ledger_keys) > 8 * self.window:
-            self._ledger_keys.popitem(last=False)
-        self.ledger.append(entry)
-        if len(self.ledger) > 2 * self.window:
-            del self.ledger[: -self.window]
+        entries = msg.payload.get("shares", [])
+        if not isinstance(entries, list):
+            return
+        self.stats["sync_pages_received"] += 1
+        # parse + dedup on the loop, verify the page CONCURRENTLY on the
+        # validation executor (slow-algo chains hash for ms-to-s per
+        # share; one-at-a-time would idle the pool's other threads), then
+        # connect in page order so lineage links without orphan churn
+        fresh: list[Share] = []
+        for obj in entries[:MAX_SYNC_PAGE]:
+            try:
+                share = Share.from_payload(obj)
+            except ShareFormatError:
+                self.stats["shares_rejected"] += 1
+                self.rejects["format"] = self.rejects.get("format", 0) + 1
+                continue
+            if share.share_id not in self.chain:
+                fresh.append(share)
+        verdicts = await asyncio.gather(
+            *(self._verify_off_loop(s) for s in fresh),
+            return_exceptions=True,
+        )
+        progressed = 0
+        for share, verdict in zip(fresh, verdicts):
+            if isinstance(verdict, ShareInvalid):
+                self.stats["shares_rejected"] += 1
+                self.rejects[verdict.reason] = (
+                    self.rejects.get(verdict.reason, 0) + 1)
+                continue
+            if isinstance(verdict, BaseException):
+                self.stats["verify_failures"] += 1
+                continue
+            if self.chain.connect(share) != "duplicate":
+                self.stats["shares_accepted"] += 1
+                progressed += 1
+        if progressed:
+            self._maybe_prune()
+        if msg.payload.get("more") and progressed:
+            # the pages arrive oldest-first, so our locator has advanced:
+            # pull the next page until the peer runs dry. The progress
+            # gate matters: a Byzantine {"shares": [], "more": true}
+            # (or a page of junk) must not drive an unbounded
+            # request/response ping-pong — with no progress we simply
+            # stop, and the next orphan/manual sync retries elsewhere
+            self._request_sync_from(peer, force=True)
+
+    def _maybe_prune(self) -> None:
+        """Periodic housekeeping on the connect path: side branches past
+        the reorg horizon can never be adopted again and are dropped.
+        (Best-chain records are retained to serve locator sync from
+        genesis; a checkpoint scheme bounding those is future work.)
+        Delta-gated, not modulo: orphan adoption and sync pages link
+        several shares per call and would step over exact multiples."""
+        if self.chain.shares_connected - self._last_prune >= 256:
+            self._last_prune = self.chain.shares_connected
+            self.chain.prune_side_branches()
+
+    # -- reporting ------------------------------------------------------------
 
     def weights(self) -> dict[str, float]:
-        """PPLNS weights over the last-N ledger window — every node computes
-        the same split from the same gossip."""
-        out: dict[str, float] = {}
-        for e in self.ledger[-self.window:]:
-            out[e.worker] = out.get(e.worker, 0.0) + e.difficulty
-        return out
+        """PPLNS weights over the best chain's window — identical on every
+        converged node, by construction (fork choice is deterministic and
+        the window is walked in chain order)."""
+        return self.chain.weights()
 
     def snapshot(self) -> dict:
         return {
             **self.node.snapshot(),
-            "ledger_entries": len(self.ledger),
+            **self.stats,
+            "chain": self.chain.snapshot(),
+            "rejects": dict(self.rejects),
             "blocks_seen": len(self.blocks_seen),
             "jobs_seen": len(self.jobs_seen),
         }

@@ -1,0 +1,483 @@
+"""P2Pool-style share chain: PoW-checked shares, heaviest-work fork choice.
+
+Reference direction: the Go reference sketches a decentralized pool with a
+"ledger" message type (internal/mining/p2p_engine.go, internal/p2p/
+handlers.go) but trusts every peer's claimed difficulty. P2Pool solved this
+in 2011 — shares form their own hash-linked chain at reduced difficulty, so
+a share's weight is *proved* by its own PoW, two honest nodes converge on
+one heaviest chain, and the PPLNS split is a pure function of that chain.
+This module is that construction, asyncio/host-side:
+
+- **Share format.** Each share is a real 80-byte header. The header's
+  prev-hash field (bytes 4:36) is the parent SHARE's id — the hash link is
+  inside the PoW'd bytes, not metadata. The merkle-root field (bytes
+  36:68) is a commitment hash binding the claim metadata (worker, job id,
+  timestamp, algorithm, block number), so a relay cannot re-assign a
+  share to another worker without redoing its PoW. The nbits field
+  encodes the share's claimed target: inflating the claimed difficulty
+  changes the header, which changes the digest, which fails the PoW
+  check. The share's id is ``sha256d(header)`` (bitcoin block-id rule,
+  independent of the PoW algorithm).
+
+- **Verification.** ``verify_share`` is a pure CPU function (commitment
+  recompute + one ``pow_host.pow_digest`` call) safe to run on the
+  validation executor, off the event loop — slow-algorithm chains (scrypt,
+  ethash) hash for milliseconds to seconds per share.
+
+- **Fork choice.** Cumulative work (exact integers, bitcoin chainwork
+  formula) from genesis; ties break toward the lexicographically smaller
+  share id, so converged record sets imply identical tips on every node
+  — no coordination message exists or is needed.
+
+- **Reorg-safe PPLNS.** The best chain is kept as an explicit id list;
+  adopting a heavier tip rewinds to the fork point and replays, bounded
+  by ``max_reorg_depth`` (a deeper fork is refused and counted — a pool
+  must not let one burst rewrite splits beyond its payout horizon).
+  ``weights()`` walks the window of that list in chain order, so every
+  converged node computes a bit-identical split, by construction.
+
+- **Sync.** Block-locator catch-up (exponentially spaced ids from the
+  tip): a peer answers with the suffix after the highest common share, in
+  bounded pages — replacing the old unordered timestamp dump.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+import time
+
+from otedama_tpu.kernels.target import (
+    bits_to_target,
+    difficulty_to_target,
+    target_to_bits,
+    target_to_difficulty,
+)
+from otedama_tpu.utils import pow_host
+
+GENESIS = b"\x00" * 32
+HEADER_VERSION = 0x20000000
+COMMIT_TAG = b"otedama-sharechain-v1"
+MAX_WORKER_LEN = 128
+MAX_JOB_ID_LEN = 64
+MAX_LOCATOR_LEN = 64
+
+
+class ShareFormatError(ValueError):
+    """Payload does not parse as a share (wire-shape problem)."""
+
+
+class ShareInvalid(ValueError):
+    """A parsed share that fails verification. ``reason`` is a stable
+    counter key: commitment | difficulty | pow | time-future | algorithm."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(f"{reason}: {detail}" if detail else reason)
+        self.reason = reason
+
+
+@dataclasses.dataclass(frozen=True)
+class ChainParams:
+    """Consensus parameters every node of one chain must agree on."""
+
+    algorithm: str = "sha256d"
+    min_difficulty: float = 1.0     # floor on a share's claimed difficulty
+    window: int = 8192              # PPLNS window, in shares
+    max_reorg_depth: int = 96       # deepest rewind a node will perform
+    max_time_skew: float = 300.0    # future-dated shares beyond this: reject
+    max_orphans: int = 512          # out-of-order holding pen bound
+    sync_page: int = 200            # shares per locator-sync response page
+    # intended share production cadence, seconds. Not consensus-critical
+    # yet (difficulty is fixed, not retargeted); benches and capacity
+    # planning read it, and a future retarget rule will gate on it.
+    share_interval: float = 10.0
+
+    def max_target(self) -> int:
+        """Largest (easiest) share target this chain accepts."""
+        return difficulty_to_target(self.min_difficulty)
+
+
+def commitment(worker: str, job_id: str, ts_ms: int, algorithm: str,
+               block_number: int) -> bytes:
+    """The 32-byte claim commitment carried in the header's merkle field."""
+    return pow_host.sha256d(
+        COMMIT_TAG + b"\0" + worker.encode() + b"\0" + job_id.encode()
+        + b"\0" + struct.pack("<Q", ts_ms) + b"\0" + algorithm.encode()
+        + b"\0" + struct.pack("<q", block_number)
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class Share:
+    """One verified-or-verifiable share chain entry."""
+
+    header: bytes            # the 80 PoW'd bytes
+    worker: str
+    job_id: str
+    ts_ms: int               # claim timestamp, milliseconds (committed)
+    algorithm: str = "sha256d"
+    block_number: int = 0    # DAG-class algorithms pick their epoch from it
+
+    # -- derived views -------------------------------------------------------
+
+    @property
+    def share_id(self) -> bytes:
+        return pow_host.sha256d(self.header)
+
+    @property
+    def prev_hash(self) -> bytes:
+        return self.header[4:36]
+
+    @property
+    def nbits(self) -> int:
+        return struct.unpack("<I", self.header[72:76])[0]
+
+    @property
+    def target(self) -> int:
+        return bits_to_target(self.nbits)
+
+    @property
+    def difficulty(self) -> float:
+        return target_to_difficulty(self.target)
+
+    @property
+    def work(self) -> int:
+        """Exact expected-hashes work unit (bitcoin chainwork formula)."""
+        return (1 << 256) // (self.target + 1)
+
+    # -- wire ----------------------------------------------------------------
+
+    def to_payload(self) -> dict:
+        return {
+            "header": self.header.hex(),
+            "worker": self.worker,
+            "job_id": self.job_id,
+            "ts_ms": self.ts_ms,
+            "algorithm": self.algorithm,
+            "block_number": self.block_number,
+        }
+
+    @classmethod
+    def from_payload(cls, payload: dict) -> "Share":
+        if not isinstance(payload, dict):
+            raise ShareFormatError("share payload must be an object")
+        try:
+            header = bytes.fromhex(str(payload["header"]))
+            worker = str(payload["worker"])
+            job_id = str(payload["job_id"])
+            ts_ms = int(payload["ts_ms"])
+            algorithm = str(payload.get("algorithm", "sha256d"))
+            block_number = int(payload.get("block_number", 0))
+        except (KeyError, ValueError, TypeError) as e:
+            raise ShareFormatError(f"malformed share payload: {e}") from e
+        if len(header) != 80:
+            raise ShareFormatError(f"header must be 80 bytes, got {len(header)}")
+        if not worker or len(worker) > MAX_WORKER_LEN:
+            raise ShareFormatError("worker name empty or too long")
+        if len(job_id) > MAX_JOB_ID_LEN:
+            raise ShareFormatError("job id too long")
+        # bounds keep commitment()'s struct packing total: an absurd
+        # value must be a clean wire reject (counted per-reason), not a
+        # struct.error miscounted as an internal verifier failure
+        if not (0 <= ts_ms < 1 << 62) or not (0 <= block_number < 1 << 31):
+            raise ShareFormatError("timestamp or block number out of range")
+        return cls(header, worker, job_id, ts_ms, algorithm, block_number)
+
+
+def effective_difficulty(difficulty: float) -> float:
+    """The difficulty a share mined at ``difficulty`` actually carries after
+    the lossy compact-nbits round trip (what ``weights()`` will credit)."""
+    return target_to_difficulty(
+        bits_to_target(target_to_bits(difficulty_to_target(difficulty)))
+    )
+
+
+def verify_share(share: Share, params: ChainParams,
+                 now: float | None = None) -> None:
+    """Full share verification — pure CPU, executor-safe. Raises
+    ``ShareInvalid`` with a stable ``reason`` on any failure.
+
+    Timestamp policy (clock-skew clamp): a share dated more than
+    ``max_time_skew`` into the future is REJECTED — no honest clock can be
+    there, and accepting it would let one skewed peer pre-date work.
+    Past-dated shares are accepted however old: chain linkage orders the
+    PPLNS window structurally, so an old timestamp carries no ordering
+    power (sync after a partition legitimately delivers old shares); local
+    consumers reading timestamps must clamp into ``[0, now + skew]``.
+    """
+    if share.algorithm != params.algorithm:
+        raise ShareInvalid(
+            "algorithm",
+            f"chain runs {params.algorithm!r}, share claims {share.algorithm!r}",
+        )
+    if share.header[36:68] != commitment(
+        share.worker, share.job_id, share.ts_ms, share.algorithm,
+        share.block_number,
+    ):
+        raise ShareInvalid("commitment", "header does not commit to claim")
+    target = share.target
+    if target <= 0 or target > params.max_target():
+        raise ShareInvalid(
+            "difficulty",
+            f"target easier than chain minimum {params.min_difficulty}",
+        )
+    now = time.time() if now is None else now
+    if share.ts_ms / 1000.0 > now + params.max_time_skew:
+        raise ShareInvalid("time-future", "share dated beyond allowed skew")
+    digest = pow_host.pow_digest(
+        share.header, share.algorithm, block_number=share.block_number
+    )
+    if int.from_bytes(digest, "little") > target:
+        raise ShareInvalid("pow", "digest does not meet claimed target")
+
+
+def clamp_timestamp(ts_ms: int, now: float, skew: float) -> float:
+    """Normalize a share timestamp for LOCAL, non-consensus use (stats,
+    rate estimates): clamped into ``[0, now + skew]`` so one skewed peer
+    cannot distort local telemetry. Consensus never reads timestamps."""
+    return min(max(ts_ms / 1000.0, 0.0), now + skew)
+
+
+def mine_share(prev_hash: bytes, worker: str, job_id: str,
+               difficulty: float, algorithm: str = "sha256d",
+               block_number: int = 0, ts_ms: int | None = None,
+               max_tries: int = 1 << 28) -> Share:
+    """Grind a valid share extending ``prev_hash`` on the host.
+
+    Test/bootstrap path: production deployments derive share headers from
+    device-found candidates. The claimed target is the compact-rounded
+    ``difficulty`` (so the mined share's credited weight is
+    ``effective_difficulty(difficulty)``).
+    """
+    if len(prev_hash) != 32:
+        raise ValueError("prev_hash must be 32 bytes")
+    ts_ms = int(time.time() * 1000) if ts_ms is None else int(ts_ms)
+    nbits = target_to_bits(difficulty_to_target(difficulty))
+    target = bits_to_target(nbits)
+    commit = commitment(worker, job_id, ts_ms, algorithm, block_number)
+    ntime = max(0, ts_ms // 1000)
+    prefix = (
+        struct.pack("<I", HEADER_VERSION) + prev_hash + commit
+        + struct.pack("<I", ntime & 0xFFFFFFFF) + struct.pack("<I", nbits)
+    )
+    for nonce in range(max_tries):
+        header = prefix + struct.pack(">I", nonce)
+        digest = pow_host.pow_digest(header, algorithm,
+                                     block_number=block_number)
+        if int.from_bytes(digest, "little") <= target:
+            return Share(header, worker, job_id, ts_ms, algorithm,
+                         block_number)
+    raise RuntimeError(
+        f"no share found in {max_tries} tries at difficulty {difficulty}"
+    )
+
+
+# -- the chain ----------------------------------------------------------------
+
+@dataclasses.dataclass
+class _Rec:
+    share: Share
+    height: int
+    cumwork: int
+
+
+class ShareChain:
+    """The verified share DAG + its heaviest-chain view.
+
+    Single-threaded by design: verification (the expensive part) runs on
+    executor threads, but ``connect``/fork choice/window maintenance run
+    on the event loop only — linking is dict work, and serializing it
+    makes the reorg bookkeeping trivially race-free.
+    """
+
+    def __init__(self, params: ChainParams | None = None):
+        self.params = params or ChainParams()
+        self.records: dict[bytes, _Rec] = {}
+        self.orphans: dict[bytes, Share] = {}          # id -> share (FIFO)
+        self._orphans_by_prev: dict[bytes, set[bytes]] = {}
+        self.tip: bytes | None = None
+        self._chain: list[bytes] = []                  # best chain, by height
+        self._pos: dict[bytes, int] = {}               # id -> height on best
+        # stats
+        self.shares_connected = 0
+        self.orphans_adopted = 0
+        self.orphans_evicted = 0
+        self.reorgs = 0
+        self.deepest_reorg = 0
+        self.reorgs_refused = 0
+
+    # -- views ---------------------------------------------------------------
+
+    @property
+    def height(self) -> int:
+        """Number of shares on the best chain."""
+        return len(self._chain)
+
+    @property
+    def tip_work(self) -> int:
+        return self.records[self.tip].cumwork if self.tip is not None else 0
+
+    def __contains__(self, share_id: bytes) -> bool:
+        return share_id in self.records or share_id in self.orphans
+
+    def weights(self) -> dict[str, float]:
+        """PPLNS weights over the window of the best chain, walked in
+        chain order — identical on every converged node by construction."""
+        out: dict[str, float] = {}
+        for sid in self._chain[-self.params.window:]:
+            share = self.records[sid].share
+            out[share.worker] = out.get(share.worker, 0.0) + share.difficulty
+        return out
+
+    # -- linking -------------------------------------------------------------
+
+    def connect(self, share: Share) -> str:
+        """Link one VERIFIED share. Returns ``accepted`` (linked, possibly
+        adopting queued orphans), ``orphan`` (parent unknown — held), or
+        ``duplicate``. Never verifies: callers run ``verify_share`` first,
+        off the loop."""
+        sid = share.share_id
+        if sid in self.records or sid in self.orphans:
+            return "duplicate"
+        prev = share.prev_hash
+        if prev != GENESIS and prev not in self.records:
+            while len(self.orphans) >= self.params.max_orphans:
+                old_id, old = next(iter(self.orphans.items()))
+                del self.orphans[old_id]
+                waiting = self._orphans_by_prev.get(old.prev_hash)
+                if waiting is not None:
+                    waiting.discard(old_id)
+                    if not waiting:
+                        del self._orphans_by_prev[old.prev_hash]
+                self.orphans_evicted += 1
+            self.orphans[sid] = share
+            self._orphans_by_prev.setdefault(prev, set()).add(sid)
+            return "orphan"
+        self._link(share)
+        # adopt orphans that were waiting on this lineage, oldest first
+        queue = [sid]
+        while queue:
+            parent = queue.pop(0)
+            for oid in sorted(self._orphans_by_prev.pop(parent, ())):
+                orphan = self.orphans.pop(oid, None)
+                if orphan is not None:
+                    self._link(orphan)
+                    self.orphans_adopted += 1
+                    queue.append(oid)
+        return "accepted"
+
+    def _link(self, share: Share) -> None:
+        prev = share.prev_hash
+        parent = self.records.get(prev)
+        height = 0 if parent is None else parent.height + 1
+        cumwork = (0 if parent is None else parent.cumwork) + share.work
+        sid = share.share_id
+        self.records[sid] = _Rec(share, height, cumwork)
+        self.shares_connected += 1
+        self._maybe_adopt(sid)
+
+    def _maybe_adopt(self, sid: bytes) -> None:
+        """Fork choice: heaviest cumulative work; ties break to the
+        smaller id so every converged node picks the same tip."""
+        rec = self.records[sid]
+        if self.tip is not None:
+            cur = self.records[self.tip]
+            if (rec.cumwork, self.tip) <= (cur.cumwork, sid):
+                # strictly-more work wins; equal work wins only on a
+                # smaller id (note the swapped ids in the comparison)
+                return
+        # walk the candidate's lineage back to the best chain (fork point)
+        path: list[bytes] = []
+        h = sid
+        while h != GENESIS and h not in self._pos:
+            r = self.records.get(h)
+            if r is None:
+                return  # lineage pruned from under us: cannot adopt
+            path.append(h)
+            h = r.share.prev_hash
+        fork_height = -1 if h == GENESIS else self._pos[h]
+        depth = len(self._chain) - (fork_height + 1)
+        if self.tip is not None and depth > self.params.max_reorg_depth:
+            self.reorgs_refused += 1
+            return
+        if depth > 0 and self.tip is not None:
+            self.reorgs += 1
+            self.deepest_reorg = max(self.deepest_reorg, depth)
+        for old in self._chain[fork_height + 1:]:
+            del self._pos[old]
+        del self._chain[fork_height + 1:]
+        for h in reversed(path):
+            self._pos[h] = len(self._chain)
+            self._chain.append(h)
+        self.tip = sid
+
+    # -- locator sync --------------------------------------------------------
+
+    def locator(self) -> list[str]:
+        """Block-locator hashes: dense near the tip, exponentially sparse
+        toward genesis, genesis-most element always included."""
+        out: list[str] = []
+        step, h = 1, len(self._chain) - 1
+        while h >= 0:
+            out.append(self._chain[h].hex())
+            if len(out) >= 10:
+                step *= 2
+            h -= step
+        if self._chain:
+            first = self._chain[0].hex()
+            if out[-1] != first:
+                out.append(first)
+        return out
+
+    def shares_after(self, locator_hex: list[str],
+                     limit: int | None = None) -> tuple[list[Share], bool]:
+        """The suffix of the best chain after the highest locator hash we
+        recognize (or from genesis when none match), oldest first, at most
+        ``limit`` shares. Returns ``(shares, more)``."""
+        limit = self.params.sync_page if limit is None else max(1, int(limit))
+        start = 0
+        for hh in locator_hex[:MAX_LOCATOR_LEN]:
+            try:
+                pos = self._pos.get(bytes.fromhex(str(hh)))
+            except ValueError:
+                continue
+            if pos is not None:
+                start = pos + 1
+                break
+        page = [self.records[sid].share for sid in self._chain[start:start + limit]]
+        return page, start + limit < len(self._chain)
+
+    # -- housekeeping --------------------------------------------------------
+
+    def prune_side_branches(self) -> int:
+        """Drop records that can never matter again: off the best chain
+        AND deeper below the tip than any permitted reorg. Best-chain
+        records are kept (they serve locator sync from genesis)."""
+        if self.tip is None:
+            return 0
+        horizon = len(self._chain) - 1 - self.params.max_reorg_depth
+        doomed = [
+            sid for sid, rec in self.records.items()
+            if sid not in self._pos and rec.height < horizon
+        ]
+        for sid in doomed:
+            del self.records[sid]
+        return len(doomed)
+
+    def snapshot(self) -> dict:
+        return {
+            "height": self.height,
+            "tip": self.tip.hex() if self.tip is not None else "",
+            "tip_work": self.tip_work,
+            "records": len(self.records),
+            "orphans": len(self.orphans),
+            "orphans_adopted": self.orphans_adopted,
+            "orphans_evicted": self.orphans_evicted,
+            "shares_connected": self.shares_connected,
+            "reorgs": self.reorgs,
+            "deepest_reorg": self.deepest_reorg,
+            "reorgs_refused": self.reorgs_refused,
+            "window": self.params.window,
+            "min_difficulty": self.params.min_difficulty,
+            "algorithm": self.params.algorithm,
+        }

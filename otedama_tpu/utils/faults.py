@@ -37,6 +37,8 @@ Fault point registry (grep for ``faults.hit`` to verify):
     sv2.conn.send / sv2.conn.recv               (stratum/v2.py FrameConn)
     p2p.peer.send / p2p.peer.recv               (p2p/node.py; tag peer id prefix)
     p2p.mem.send                                (p2p/memnet.py MemoryWriter)
+    p2p.share.verify                            (p2p/pool.py; tag share id prefix)
+    p2p.sync                                    (p2p/pool.py; tag peer id prefix)
     db.execute                                  (db/database.py writes)
     pool.submitter.submit                       (pool/submitter.py retry loop)
     pool.failover.check                         (pool/failover.py; tag pool name)

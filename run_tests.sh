@@ -16,6 +16,12 @@
 #                   sha256d->scrypt warm switch; writes a BENCH_SWITCH
 #                   json artifact and fails if the warm cache is not
 #                   faster or switch downtime exceeds a batch boundary.
+#   sharechain-bench opt-in P2P share-chain bench: share verification
+#                   throughput, N-node partition-heal convergence time
+#                   over the in-memory transport, and deepest
+#                   rewind-and-replay reorg; writes a BENCH_SHARECHAIN
+#                   json artifact and fails if convergence or the reorg
+#                   never happened.
 #   degrade-bench   opt-in device-loss resilience bench: hangs one of
 #                   three devices via the device.call fault point and
 #                   measures time-to-quarantine, shares lost during the
@@ -43,5 +49,8 @@ case "$tier" in
   degrade-bench)
     exec env JAX_PLATFORMS=cpu python tools/bench_degrade.py \
       --out "${DEGRADE_BENCH_OUT:-BENCH_DEGRADE_manual.json}" "$@" ;;
-  *) echo "usage: $0 [fast|slow|all|audit|stratum-bench|switch-bench|degrade-bench] [pytest args...]" >&2; exit 2 ;;
+  sharechain-bench)
+    exec env JAX_PLATFORMS=cpu python tools/bench_sharechain.py \
+      --out "${SHARECHAIN_BENCH_OUT:-BENCH_SHARECHAIN_manual.json}" "$@" ;;
+  *) echo "usage: $0 [fast|slow|all|audit|stratum-bench|switch-bench|degrade-bench|sharechain-bench] [pytest args...]" >&2; exit 2 ;;
 esac

@@ -1,0 +1,615 @@
+"""Verified P2P share chain: PoW checks, fork choice, reorg-safe PPLNS.
+
+The trust-model tests the old ledger could not have: a share's weight is
+proved by its own PoW (inflated claims and re-assigned workers are
+rejected), converged nodes agree on one heaviest chain and a bit-identical
+PPLNS split, and partitions heal through locator-based sync — including
+reorgs deeper than one share. Chaos is seeded (`utils.faults`) on the
+`p2p.peer.send`, `p2p.share.verify` and `p2p.sync` points.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import struct
+import time
+
+import pytest
+
+from otedama_tpu.kernels.target import target_to_bits
+from otedama_tpu.p2p import sharechain as sc
+from otedama_tpu.p2p.memnet import MemoryNetwork
+from otedama_tpu.p2p.messages import MessageType, P2PMessage, parse_locator
+from otedama_tpu.p2p.node import NodeConfig
+from otedama_tpu.p2p.pool import P2PPool
+from otedama_tpu.p2p.sharechain import ChainParams, Share, ShareChain
+from otedama_tpu.utils import faults, pow_host
+
+# easy enough that host-grinding a share is a few milliseconds, hard
+# enough that the PoW check is real (digest must actually meet target)
+TEST_D = 1e-6
+D_EFF = sc.effective_difficulty(TEST_D)
+
+
+def params(**kw) -> ChainParams:
+    base = dict(min_difficulty=TEST_D, window=64, max_reorg_depth=8,
+                max_orphans=32, sync_page=3)
+    base.update(kw)
+    return ChainParams(**base)
+
+
+def mine_chain(n: int, worker: str = "w", prev: bytes = sc.GENESIS,
+               difficulty: float = TEST_D) -> list[Share]:
+    out = []
+    for i in range(n):
+        s = sc.mine_share(prev, worker, f"job{i}", difficulty)
+        out.append(s)
+        prev = s.share_id
+    return out
+
+
+# -- verification -------------------------------------------------------------
+
+def test_mine_verify_roundtrip_and_payload():
+    s = sc.mine_share(sc.GENESIS, "alice", "j1", TEST_D)
+    sc.verify_share(s, params())
+    assert s.prev_hash == sc.GENESIS
+    assert s.difficulty == pytest.approx(TEST_D, rel=1e-3)
+    back = Share.from_payload(json.loads(json.dumps(s.to_payload())))
+    assert back.share_id == s.share_id
+    sc.verify_share(back, params())
+
+
+def test_reassigned_worker_fails_commitment():
+    """A relay cannot re-credit a share to another worker: the claim is
+    committed inside the PoW'd header."""
+    s = sc.mine_share(sc.GENESIS, "alice", "j1", TEST_D)
+    stolen = Share(s.header, "mallory", s.job_id, s.ts_ms)
+    with pytest.raises(sc.ShareInvalid) as e:
+        sc.verify_share(stolen, params())
+    assert e.value.reason == "commitment"
+
+
+def test_inflated_difficulty_claim_fails_pow():
+    """Claiming more difficulty than the digest earned = rewriting nbits =
+    a header whose digest no longer meets its own claimed target."""
+    s = sc.mine_share(sc.GENESIS, "alice", "j1", TEST_D)
+    digest = pow_host.pow_digest(s.header)
+    # claim a target 512x harder than what this digest actually meets
+    inflated_bits = target_to_bits(int.from_bytes(digest, "little") >> 9)
+    hdr = bytearray(s.header)
+    hdr[72:76] = struct.pack("<I", inflated_bits)
+    inflated = Share(bytes(hdr), s.worker, s.job_id, s.ts_ms)
+    with pytest.raises(sc.ShareInvalid) as e:
+        sc.verify_share(inflated, params())
+    assert e.value.reason == "pow"
+
+
+def test_below_minimum_difficulty_rejected():
+    p = params(min_difficulty=TEST_D)
+    easy = sc.mine_share(sc.GENESIS, "alice", "j1", TEST_D / 64)
+    with pytest.raises(sc.ShareInvalid) as e:
+        sc.verify_share(easy, p)
+    assert e.value.reason == "difficulty"
+
+
+def test_wrong_algorithm_rejected():
+    s = sc.mine_share(sc.GENESIS, "alice", "j1", TEST_D)
+    with pytest.raises(sc.ShareInvalid) as e:
+        sc.verify_share(s, params(algorithm="scrypt"))
+    assert e.value.reason == "algorithm"
+
+
+def test_timestamp_skew_future_rejected_past_normalized():
+    p = params(max_time_skew=300.0)
+    now = time.time()
+    future = sc.mine_share(sc.GENESIS, "a", "j", TEST_D,
+                           ts_ms=int((now + 3600) * 1000))
+    with pytest.raises(sc.ShareInvalid) as e:
+        sc.verify_share(future, p, now=now)
+    assert e.value.reason == "time-future"
+    # far-past shares verify (sync legitimately delivers old history) —
+    # they carry no ordering power, and local stats clamp the timestamp
+    old = sc.mine_share(sc.GENESIS, "a", "j", TEST_D, ts_ms=1000)
+    sc.verify_share(old, p, now=now)
+    assert sc.clamp_timestamp(old.ts_ms, now, 300.0) == pytest.approx(1.0)
+    assert sc.clamp_timestamp(int((now + 9e6) * 1000), now, 300.0) == (
+        pytest.approx(now + 300.0))
+
+
+def test_malformed_payloads_raise_format_error():
+    for bad in (
+        "not a dict",
+        {},
+        {"header": "zz", "worker": "w", "job_id": "j", "ts_ms": 0},
+        {"header": "ab" * 79, "worker": "w", "job_id": "j", "ts_ms": 0},
+        {"header": "ab" * 80, "worker": "", "job_id": "j", "ts_ms": 0},
+        {"header": "ab" * 80, "worker": "w", "job_id": "j", "ts_ms": -5},
+        {"header": "ab" * 80, "worker": "w", "job_id": "j", "ts_ms": 1 << 64},
+        {"header": "ab" * 80, "worker": "w", "job_id": "j", "ts_ms": 0,
+         "block_number": 1 << 40},
+        {"header": "ab" * 80, "worker": "w" * 200, "job_id": "j", "ts_ms": 0},
+    ):
+        with pytest.raises(sc.ShareFormatError):
+            Share.from_payload(bad)
+
+
+# -- chain linking / fork choice ---------------------------------------------
+
+def test_orphans_link_when_parent_arrives():
+    chain = ShareChain(params())
+    a, b, c = mine_chain(3)
+    assert chain.connect(c) == "orphan"
+    assert chain.connect(b) == "orphan"
+    assert chain.height == 0
+    assert chain.connect(a) == "accepted"   # adopts b then c recursively
+    assert chain.height == 3
+    assert chain.tip == c.share_id
+    assert chain.orphans_adopted == 2 and not chain.orphans
+
+
+def test_orphan_pool_bounded():
+    chain = ShareChain(params(max_orphans=4))
+    # 6 parentless shares: pool holds the newest 4, evicts the oldest 2
+    for i in range(6):
+        s = sc.mine_share(b"\x11" * 32, "w", f"j{i}", TEST_D)
+        assert chain.connect(s) == "orphan"
+    assert len(chain.orphans) == 4
+    assert chain.orphans_evicted == 2
+
+
+def test_fork_choice_heaviest_work_and_deterministic_tie():
+    chain = ShareChain(params())
+    main = mine_chain(3, "main")
+    for s in main:
+        chain.connect(s)
+    # lighter fork does not displace the tip
+    side = mine_chain(2, "side")
+    for s in side:
+        chain.connect(s)
+    assert chain.tip == main[-1].share_id
+    # equal-work tie: tip goes to the smaller share id on EVERY node
+    tie = sc.mine_share(main[1].share_id, "tie", "jt", TEST_D)
+    chain.connect(tie)
+    expect = min(tie.share_id, main[-1].share_id)
+    assert chain.tip == expect
+    other = ShareChain(params())
+    for s in main + side + [tie]:
+        other.connect(s)
+    assert other.tip == chain.tip
+    assert json.dumps(other.weights(), sort_keys=True) == (
+        json.dumps(chain.weights(), sort_keys=True))
+
+
+def test_reorg_rewinds_and_replays_window():
+    chain = ShareChain(params())
+    base = mine_chain(2, "base")
+    for s in base:
+        chain.connect(s)
+    a_side = mine_chain(2, "a", prev=base[-1].share_id)
+    for s in a_side:
+        chain.connect(s)
+    assert chain.tip == a_side[-1].share_id
+    w_before = chain.weights()
+    assert w_before["a"] == pytest.approx(2 * D_EFF)
+    # heavier fork from the same base: depth-2 reorg (deeper than one share)
+    b_side = mine_chain(3, "b", prev=base[-1].share_id)
+    for s in b_side:
+        chain.connect(s)
+    assert chain.tip == b_side[-1].share_id
+    assert chain.reorgs == 1 and chain.deepest_reorg == 2
+    w = chain.weights()
+    assert "a" not in w          # rewound out of the window entirely
+    assert w["b"] == pytest.approx(3 * D_EFF)
+    assert w["base"] == pytest.approx(2 * D_EFF)
+
+
+def test_reorg_deeper_than_limit_refused():
+    chain = ShareChain(params(max_reorg_depth=2))
+    main = mine_chain(4, "main")
+    for s in main:
+        chain.connect(s)
+    heavy = mine_chain(6, "heavy")   # would rewind depth 4 > 2
+    for s in heavy:
+        chain.connect(s)
+    assert chain.tip == main[-1].share_id
+    assert chain.reorgs_refused >= 1 and chain.reorgs == 0
+
+
+def test_pplns_window_bounds_weights():
+    chain = ShareChain(params(window=3))
+    shares = mine_chain(5, "w")
+    for s in shares:
+        chain.connect(s)
+    w = chain.weights()
+    assert w["w"] == pytest.approx(3 * D_EFF)   # only the window counts
+
+
+def test_prune_side_branches_keeps_best_chain():
+    chain = ShareChain(params(max_reorg_depth=2))
+    main = mine_chain(8, "main")
+    for s in main:
+        chain.connect(s)
+    side = mine_chain(1, "side")          # height 0, far below horizon
+    chain.connect(side[0])
+    assert chain.prune_side_branches() == 1
+    assert side[0].share_id not in chain.records
+    assert chain.height == 8 and all(
+        s.share_id in chain.records for s in main)
+
+
+# -- locator sync -------------------------------------------------------------
+
+def test_locator_shape_and_paged_sync():
+    src = ShareChain(params(sync_page=4))
+    shares = mine_chain(23, "w")
+    for s in shares:
+        src.connect(s)
+    loc = src.locator()
+    assert loc[0] == src.tip.hex()
+    assert loc[-1] == shares[0].share_id.hex()   # genesis-most always there
+    assert len(loc) < 23                          # exponentially sparse
+    assert parse_locator(loc) == loc
+
+    dst = ShareChain(params(sync_page=4))
+    pages = 0
+    while True:
+        page, more = src.shares_after(dst.locator())
+        assert len(page) <= 4
+        for s in page:
+            dst.connect(s)
+        pages += 1
+        if not more:
+            break
+    assert dst.tip == src.tip and dst.height == 23
+    assert pages >= 6
+    assert json.dumps(dst.weights(), sort_keys=True) == (
+        json.dumps(src.weights(), sort_keys=True))
+
+
+def test_sync_from_diverged_fork_finds_common_ancestor():
+    src = ShareChain(params())
+    base = mine_chain(3, "base")
+    for s in base:
+        src.connect(s)
+    dst = ShareChain(params())
+    for s in base:
+        dst.connect(s)
+    for s in mine_chain(4, "src", prev=base[-1].share_id):
+        src.connect(s)
+    for s in mine_chain(2, "dst", prev=base[-1].share_id):
+        dst.connect(s)
+    page, more = src.shares_after(dst.locator(), 100)
+    # src serves exactly its suffix after the common base, not the world
+    assert len(page) == 4 and not more
+    assert page[0].prev_hash == base[-1].share_id
+    for s in page:
+        dst.connect(s)
+    assert dst.tip == src.tip
+    assert dst.deepest_reorg == 2
+
+
+# -- multi-node scenarios -----------------------------------------------------
+
+async def _wait_for(cond, timeout=20.0, kick=None):
+    """Poll until cond(); optionally fire ``kick`` (e.g. request_sync
+    retries) every ~0.5 s so seeded message loss can never wedge the wait."""
+    deadline = time.monotonic() + timeout
+    i = 0
+    while not cond():
+        if time.monotonic() > deadline:
+            raise AssertionError("condition not met before timeout")
+        if kick is not None and i % 25 == 24:
+            await kick()
+        i += 1
+        await asyncio.sleep(0.02)
+
+
+def _pin(i: int) -> NodeConfig:
+    return NodeConfig(node_id=f"{i + 0xA0:02x}" * 32)
+
+
+@pytest.mark.asyncio
+async def test_share_verify_fault_drop_recovers_via_orphan_sync():
+    """A dropped verification loses a share on one node; the NEXT share
+    arrives as an orphan and triggers locator sync, which restores the
+    missing parent — seeded on the new p2p.share.verify point."""
+    p = params()
+    a, b = P2PPool(_pin(0), p), P2PPool(_pin(1), p)
+    net = MemoryNetwork()
+    net.link(a.node, b.node)
+    # NOTE: schedule gates are per tagged point key, and this point tags
+    # by share id — an untagged once-rule drops every share's FIRST
+    # verification. Exactly right here: gossip verification is lossy for
+    # the whole faulted window, and recovery must come from the sync path
+    inj = faults.FaultInjector(seed=901).drop("p2p.share.verify", once=True)
+    try:
+        with faults.active(inj):
+            await a.announce_share("alice", TEST_D, "j0")
+            await _wait_for(lambda: b.stats["verify_failures"] == 1)
+            assert b.chain.height == 0
+        # faults off: the next share verifies, lands as an ORPHAN (its
+        # parent was dropped), and orphan-triggered locator sync restores
+        # the missing lineage
+        await a.announce_share("alice", TEST_D, "j1")
+        await _wait_for(lambda: b.chain.height == 2)
+        assert b.chain.orphans_adopted >= 1
+        assert json.dumps(a.weights(), sort_keys=True) == (
+            json.dumps(b.weights(), sort_keys=True))
+        assert inj.snapshot()["rules"][0]["fires"] == 1
+    finally:
+        await net.close()
+
+
+@pytest.mark.asyncio
+async def test_truncated_send_kills_link_sync_heals():
+    """A truncated frame (p2p.peer.send) kills the link mid-gossip; after
+    re-linking, locator sync restores convergence."""
+    p = params()
+    a, b = P2PPool(_pin(2), p), P2PPool(_pin(3), p)
+    net = MemoryNetwork()
+    net.link(a.node, b.node)
+    # first frame from a to b is cut short: b's reader sees a dead link
+    inj = faults.FaultInjector(seed=902).truncate(
+        f"p2p.peer.send:{b.node.node_id[:12]}", keep_bytes=5, once=True)
+    try:
+        with faults.active(inj):
+            await a.announce_share("alice", TEST_D, "j0")
+        await _wait_for(lambda: not a.node.peers and not b.node.peers)
+        assert b.chain.height == 0
+        net.link(a.node, b.node)    # "reconnect"
+        await b.request_sync()
+        await _wait_for(lambda: b.chain.height == 1,
+                        kick=lambda: b.request_sync())
+        assert b.chain.tip == a.chain.tip
+    finally:
+        await net.close()
+
+
+@pytest.mark.asyncio
+async def test_4node_byzantine_partition_heal_converges_identically():
+    """The acceptance scenario, seeded end to end:
+
+    (a) a share with a bad commitment AND a share claiming inflated
+        difficulty are rejected by every honest receiver and never enter
+        (or leave) any honest node's chain — even across a partition heal;
+    (b) after a partition with divergent mining on both sides, all four
+        nodes converge on the heaviest chain — a reorg 2 deep on the
+        losing side — and report byte-identical PPLNS weights().
+
+    Chaos: seeded drops on p2p.peer.send during mining (gossip loss is
+    healed by orphan-triggered sync) and on p2p.sync during the heal
+    (sync requests retry until convergence).
+    """
+    p = params(max_reorg_depth=8)
+    pools = [P2PPool(_pin(i), p) for i in range(4)]
+    net = MemoryNetwork()
+    links: dict[tuple[int, int], tuple] = {}
+    for i in range(4):
+        for j in range(i + 1, 4):
+            links[(i, j)] = net.link(pools[i].node, pools[j].node)
+
+    async def kick_all():
+        for pool in pools:
+            await pool.request_sync()
+
+    def heights(group):
+        return [pools[i].chain.height for i in group]
+
+    inj = (
+        faults.FaultInjector(seed=4242)
+        .drop("p2p.peer.send", probability=0.10)
+        .drop("p2p.sync", probability=0.25)
+    )
+    try:
+        with faults.active(inj):
+            # -- phase A: connected mesh, honest mining + Byzantine noise --
+            await pools[0].announce_share("alice", 2 * TEST_D, "jA")
+            await _wait_for(lambda: min(heights(range(4))) == 1, kick=kick_all)
+            await pools[1].announce_share("bob", 3 * TEST_D, "jB")
+            await _wait_for(lambda: min(heights(range(4))) == 2, kick=kick_all)
+
+            # Byzantine payload 1: PoW'd header, claim re-assigned to a
+            # different worker — commitment mismatch everywhere
+            tip = pools[3].chain.tip
+            honest = sc.mine_share(tip, "evil", "jE", TEST_D)
+            stolen = Share(honest.header, "mallory", honest.job_id,
+                           honest.ts_ms)
+            await pools[3].node.broadcast(
+                P2PMessage(MessageType.SHARE, stolen.to_payload()))
+            await _wait_for(lambda: all(
+                pool.rejects.get("commitment", 0) >= 1
+                for pool in pools[:3]), kick=kick_all)
+            assert all(stolen.share_id not in pool.chain for pool in pools)
+            assert all(pool.chain.height == 2 for pool in pools)
+
+            # -- phase B: partition {0,1} | {2,3}, divergent mining --------
+            for (i, j), (pa, pb) in links.items():
+                if (i < 2) != (j < 2):
+                    pa.writer.close()
+                    pb.writer.close()
+            await _wait_for(lambda: all(
+                len(pool.node.peers) == 1 for pool in pools))
+
+            fork_tip = pools[0].chain.tip
+            for k in range(2):      # side A mines 2
+                await pools[0].announce_share("a-side", TEST_D, f"ja{k}")
+                await _wait_for(
+                    lambda k=k: min(heights((0, 1))) == 3 + k,
+                    kick=lambda: pools[1].request_sync())
+            for k in range(4):      # side B mines 4: strictly heavier
+                await pools[2].announce_share("b-side", TEST_D, f"jb{k}")
+                await _wait_for(
+                    lambda k=k: min(heights((2, 3))) == 3 + k,
+                    kick=lambda: pools[3].request_sync())
+            assert pools[0].chain.tip != pools[2].chain.tip
+            assert pools[0].chain.records[pools[0].chain.tip].cumwork < (
+                pools[2].chain.records[pools[2].chain.tip].cumwork)
+
+            # Byzantine payload 2, inside the partition: inflated
+            # difficulty claim broadcast to side B only — node 2 must
+            # reject it, and it must never cross the heal
+            base = sc.mine_share(pools[3].chain.tip, "evil", "jI", TEST_D)
+            digest = pow_host.pow_digest(base.header)
+            hdr = bytearray(base.header)
+            hdr[72:76] = struct.pack("<I", target_to_bits(
+                int.from_bytes(digest, "little") >> 9))
+            inflated = Share(bytes(hdr), base.worker, base.job_id,
+                             base.ts_ms)
+            await pools[3].node.broadcast(
+                P2PMessage(MessageType.SHARE, inflated.to_payload()))
+            await _wait_for(lambda: pools[2].rejects.get("pow", 0) >= 1)
+
+            # -- phase C: heal, locator sync, convergence ------------------
+            for i in range(2):
+                for j in range(2, 4):
+                    net.link(pools[i].node, pools[j].node)
+            await _wait_for(lambda: all(
+                len(pool.node.peers) == 3 for pool in pools))
+            await kick_all()
+            await _wait_for(
+                lambda: len({pool.chain.tip for pool in pools}) == 1,
+                timeout=30.0, kick=kick_all)
+
+            # (b) heaviest chain won; the losing side rewound 2 shares
+            assert pools[0].chain.tip == pools[2].chain.tip
+            # 2 phase-A shares + side B's 4 = the winning chain
+            assert all(pool.chain.height == 6 for pool in pools)
+            for i in (0, 1):
+                assert pools[i].chain.reorgs >= 1
+                assert pools[i].chain.deepest_reorg == 2
+            # byte-identical PPLNS split on every node
+            splits = {json.dumps(pool.weights(), sort_keys=True)
+                      for pool in pools}
+            assert len(splits) == 1
+            w = pools[0].weights()
+            assert w["b-side"] == pytest.approx(4 * D_EFF)
+            assert "a-side" not in w or w["a-side"] == 0.0  # rewound out
+            assert w["alice"] == pytest.approx(
+                sc.effective_difficulty(2 * TEST_D))
+            assert w["bob"] == pytest.approx(
+                sc.effective_difficulty(3 * TEST_D))
+
+            # (a) neither Byzantine share exists anywhere, and the
+            # inflated share never crossed the heal: side A nodes never
+            # even saw it (no "pow" rejects there — it was dropped at
+            # node 2/3, not re-propagated)
+            for pool in pools:
+                assert stolen.share_id not in pool.chain
+                assert inflated.share_id not in pool.chain
+            for i in (0, 1):
+                assert pools[i].rejects.get("pow", 0) == 0
+
+        # the seeded chaos actually happened
+        snap = inj.snapshot()
+        fired = {r["point"]: r["fires"] for r in snap["rules"]}
+        assert fired["p2p.peer.send"] > 0
+        assert fired["p2p.sync"] > 0
+    finally:
+        await net.close()
+
+
+@pytest.mark.asyncio
+async def test_byzantine_empty_more_page_does_not_loop():
+    """A peer answering {"shares": [], "more": true} forever must not
+    drive an unbounded sync ping-pong: with no page progress the
+    requester stops (later orphan/manual syncs retry independently)."""
+    p = params()
+    a, b = P2PPool(_pin(12), p), P2PPool(_pin(13), p)
+    net = MemoryNetwork()
+    peer_at_a, peer_at_b = net.link(a.node, b.node)
+    try:
+        # b speaks raw wire: an empty page claiming more, twice
+        for _ in range(2):
+            peer_at_b.send(P2PMessage(
+                MessageType.SYNC_RESPONSE,
+                {"shares": [], "more": True}, sender=b.node.node_id))
+        await asyncio.sleep(0.3)
+        assert a.stats["sync_pages_received"] == 2
+        # a never took the bait: no follow-up page requests reached b
+        assert b.stats["sync_requests"] == 0
+    finally:
+        await net.close()
+
+
+@pytest.mark.asyncio
+async def test_local_announce_enforces_min_difficulty():
+    pool = P2PPool(_pin(9), params())
+    with pytest.raises(ValueError):
+        await pool.announce_share("w", TEST_D / 10, "j")
+
+
+@pytest.mark.asyncio
+async def test_app_p2p_mode_runs_the_chain():
+    """p2p.enabled wires the share chain from config (consensus params
+    included), exposes it as an API provider, and two app nodes converge
+    over real sockets via the bootstrap path."""
+    from otedama_tpu.app import Application
+    from otedama_tpu.config.schema import AppConfig
+
+    def make_cfg():
+        cfg = AppConfig()
+        cfg.mining.enabled = False
+        cfg.api.enabled = False
+        cfg.p2p.enabled = True
+        cfg.p2p.host = "127.0.0.1"
+        cfg.p2p.port = 0
+        cfg.p2p.share_difficulty = TEST_D
+        cfg.p2p.pplns_window = 32
+        cfg.p2p.max_reorg_depth = 4
+        return cfg
+
+    app_a = Application(make_cfg())
+    await app_a.start()
+    try:
+        chain = app_a.p2p.chain
+        assert chain.params.min_difficulty == TEST_D
+        assert chain.params.window == 32
+        assert chain.params.max_reorg_depth == 4
+        assert chain.params.algorithm == "sha256d"
+
+        cfg_b = make_cfg()
+        cfg_b.p2p.bootstrap = [f"127.0.0.1:{app_a.p2p.node.port}"]
+        app_b = Application(cfg_b)
+        await app_b.start()
+        try:
+            await _wait_for(lambda: len(app_a.p2p.node.peers) == 1)
+            await app_a.p2p.announce_share("w", TEST_D, "j0")
+            await _wait_for(lambda: app_b.p2p.chain.height == 1)
+            assert app_b.p2p.chain.tip == app_a.p2p.chain.tip
+            snap = app_a.snapshot()
+            assert snap["p2p"]["chain"]["height"] == 1
+        finally:
+            await app_b.stop()
+    finally:
+        await app_a.stop()
+
+
+@pytest.mark.asyncio
+async def test_snapshot_and_metrics_export():
+    from otedama_tpu.api.server import ApiServer
+
+    p = params()
+    a, b = P2PPool(_pin(10), p), P2PPool(_pin(11), p)
+    net = MemoryNetwork()
+    net.link(a.node, b.node)
+    try:
+        await a.announce_share("alice", TEST_D, "j0")
+        await _wait_for(lambda: b.chain.height == 1)
+        snap = a.snapshot()
+        assert snap["chain"]["height"] == 1
+        assert snap["chain"]["tip"] == a.chain.tip.hex()
+        assert snap["chain"]["tip_work"] > 0
+        assert snap["shares_accepted"] == 1
+        api = ApiServer.__new__(ApiServer)   # registry-only use
+        from otedama_tpu.api.metrics import MetricsRegistry
+
+        api.registry = MetricsRegistry()
+        api.sync_p2p_metrics(snap)
+        text = api.registry.render()
+        assert "otedama_p2p_chain_height 1" in text
+        assert "otedama_p2p_shares_connected_total 1" in text
+        assert "otedama_p2p_tip_work" in text
+    finally:
+        await net.close()

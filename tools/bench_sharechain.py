@@ -1,0 +1,222 @@
+"""Share-chain bench: verify throughput, partition-heal convergence, reorgs.
+
+Measures the three numbers the verified P2P share chain is accountable
+for, and emits a ``BENCH_SHARECHAIN_*.json`` artifact:
+
+1. **verify_per_sec** — full share verifications (commitment recompute +
+   host PoW digest + target compare) per second, single-threaded. This
+   bounds how fast one node can ingest gossip/sync backlog; the pool runs
+   it on the validation executor, so N threads scale it.
+2. **convergence_seconds** — N nodes over the in-memory transport
+   (p2p/memnet.py), partitioned into halves that mine divergently, then
+   healed: time from re-link + sync kick to every node reporting the same
+   tip AND byte-identical PPLNS ``weights()``.
+3. **reorg_depth_handled / reorg_seconds** — deepest rewind-and-replay a
+   single chain performs when a heavier fork lands, and how long the
+   adoption (including window replay) takes.
+
+Fails loudly (exit 2) if convergence or the reorg never happens — a bench
+that silently measures a broken chain would report garbage as progress.
+
+Usage:
+    python tools/bench_sharechain.py --out BENCH_SHARECHAIN_r09.json [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import platform
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from otedama_tpu.p2p import sharechain as sc                       # noqa: E402
+from otedama_tpu.p2p.memnet import MemoryNetwork                   # noqa: E402
+from otedama_tpu.p2p.node import NodeConfig                        # noqa: E402
+from otedama_tpu.p2p.pool import P2PPool                           # noqa: E402
+from otedama_tpu.p2p.sharechain import ChainParams, ShareChain     # noqa: E402
+
+# a few thousand hashes per share: mining the fixtures stays fast while
+# every verification still does a real PoW comparison
+BENCH_D = 1e-6
+
+
+def mine_chain(n, worker="w", prev=sc.GENESIS):
+    out = []
+    for i in range(n):
+        s = sc.mine_share(prev, worker, f"j{i}", BENCH_D)
+        out.append(s)
+        prev = s.share_id
+    return out
+
+
+def bench_verify(n_shares: int, passes: int) -> dict:
+    params = ChainParams(min_difficulty=BENCH_D, window=n_shares)
+    shares = mine_chain(n_shares)
+    t0 = time.perf_counter()
+    done = 0
+    for _ in range(passes):
+        for s in shares:
+            sc.verify_share(s, params)
+            done += 1
+    dt = time.perf_counter() - t0
+    return {
+        "verify_shares": n_shares,
+        "verify_passes": passes,
+        "verify_seconds": round(dt, 4),
+        "verify_per_sec": round(done / dt, 1),
+    }
+
+
+def bench_reorg(depth: int) -> dict:
+    params = ChainParams(min_difficulty=BENCH_D, window=4 * depth,
+                         max_reorg_depth=2 * depth)
+    chain = ShareChain(params)
+    base = mine_chain(4, "base")
+    for s in base:
+        chain.connect(s)
+    main = mine_chain(depth, "main", prev=base[-1].share_id)
+    for s in main:
+        chain.connect(s)
+    heavy = mine_chain(depth + 1, "heavy", prev=base[-1].share_id)
+    for s in heavy[:-1]:
+        chain.connect(s)           # linking the side branch: no adoption yet
+    t0 = time.perf_counter()
+    chain.connect(heavy[-1])       # the tipping share triggers the reorg
+    dt = time.perf_counter() - t0
+    ok = chain.tip == heavy[-1].share_id and chain.deepest_reorg == depth
+    return {
+        "reorg_depth_attempted": depth,
+        "reorg_depth_handled": chain.deepest_reorg if ok else 0,
+        "reorg_seconds": round(dt, 6),
+        "reorg_ok": ok,
+    }
+
+
+async def bench_convergence(n_nodes: int, shares_a: int, shares_b: int) -> dict:
+    params = ChainParams(min_difficulty=BENCH_D, window=256,
+                         max_reorg_depth=64, sync_page=50)
+    pools = [P2PPool(NodeConfig(node_id=f"{i + 1:02x}" * 32), params)
+             for i in range(n_nodes)]
+    half = n_nodes // 2
+    net = MemoryNetwork()
+    cross = []
+    # full mesh within halves, one-to-one bridges across
+    for i in range(n_nodes):
+        for j in range(i + 1, n_nodes):
+            link = net.link(pools[i].node, pools[j].node)
+            if (i < half) != (j < half):
+                cross.append(link)
+
+    async def settle(group, height, timeout=60.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(p.chain.height >= height for p in group):
+                return
+            for p in group:
+                await p.request_sync()
+            await asyncio.sleep(0.05)
+        raise RuntimeError(f"group never reached height {height}")
+
+    try:
+        # common prefix while connected
+        await pools[0].announce_share("common", BENCH_D, "c0")
+        await settle(pools, 1)
+        # partition: kill the bridges
+        for pa, pb in cross:
+            pa.writer.close()
+            pb.writer.close()
+        await asyncio.sleep(0.1)
+        for k in range(shares_a):
+            await pools[0].announce_share("side-a", BENCH_D, f"a{k}")
+        await settle(pools[:half], 1 + shares_a)
+        for k in range(shares_b):
+            await pools[half].announce_share("side-b", BENCH_D, f"b{k}")
+        await settle(pools[half:], 1 + shares_b)
+
+        # heal + measure convergence (tips AND identical weights)
+        t0 = time.perf_counter()
+        for i in range(half):
+            for j in range(half, n_nodes):
+                net.link(pools[i].node, pools[j].node)
+        deadline = time.monotonic() + 120.0
+        while True:
+            for p in pools:
+                await p.request_sync()
+            await asyncio.sleep(0.05)
+            tips = {p.chain.tip for p in pools}
+            if len(tips) == 1:
+                splits = {json.dumps(p.weights(), sort_keys=True)
+                          for p in pools}
+                if len(splits) == 1:
+                    break
+            if time.monotonic() > deadline:
+                raise RuntimeError("overlay never converged after heal")
+        dt = time.perf_counter() - t0
+        loser_reorgs = max(p.chain.deepest_reorg for p in pools)
+        return {
+            "nodes": n_nodes,
+            "partition_shares": [shares_a, shares_b],
+            "convergence_seconds": round(dt, 3),
+            "heal_reorg_depth": loser_reorgs,
+            "final_height": pools[0].chain.height,
+            "shares_rejected_total": sum(
+                p.stats["shares_rejected"] for p in pools),
+        }
+    finally:
+        await net.close()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="BENCH_SHARECHAIN_manual.json")
+    ap.add_argument("--nodes", type=int, default=16)
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+
+    failures: list[str] = []
+    n_shares, passes, depth = (32, 2, 8) if args.quick else (64, 5, 48)
+    shares_a, shares_b = (2, 4) if args.quick else (6, 10)
+    nodes = max(4, args.nodes if not args.quick else 8)
+
+    verify = bench_verify(n_shares, passes)
+    reorg = bench_reorg(depth)
+    if not reorg["reorg_ok"]:
+        failures.append(f"reorg of depth {depth} was not performed")
+    conv = asyncio.run(bench_convergence(nodes, shares_a, shares_b))
+    if conv["heal_reorg_depth"] < min(shares_a, shares_b):
+        failures.append("heal did not exercise a multi-share reorg")
+
+    out = {
+        "bench": "sharechain",
+        "created": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "platform": {
+            "python": platform.python_version(),
+            "machine": platform.machine(),
+        },
+        "config": {
+            "share_difficulty": BENCH_D,
+            "nodes": nodes,
+            "reorg_depth": depth,
+        },
+        **verify,
+        **reorg,
+        **conv,
+        "failures": failures,
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print(json.dumps(out, indent=2))
+    if failures:
+        print("BENCH FAILED:", "; ".join(failures), file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
