@@ -53,6 +53,12 @@ class ApiServer:
         # signature-compatible if a file-based trail is ever configured);
         # unwired -> /api/v1/logs/audit answers 404
         self.audit_source: Callable | None = None
+        # settlement operator surface (the app wires the settlement
+        # engine): fn() -> list of {worker, balance, paid_total}, and
+        # fn(limit) -> {pending, recent} payout intents. Unwired ->
+        # /api/v1/balances and /api/v1/payouts answer 404.
+        self.balances_source: Callable[[], list] | None = None
+        self.payouts_source: Callable[[int], dict] | None = None
         # readiness source for /health: a callable returning at least
         # {"status": "ok" | "degraded" | "unready"} (the app wires the
         # engine's device_health). ok/degraded answer 200 — degraded
@@ -99,6 +105,10 @@ class ApiServer:
         h.route("GET", "/api/v1/stats/{name}", self._stats_one)
         h.route("GET", "/api/v1/algorithms", self._algorithms)
         h.route("GET", "/api/v1/controls", self._list_controls)
+        # settlement operator surface (reference parity: the payout
+        # routes of internal/api/server.go)
+        h.route("GET", "/api/v1/balances", self._balances)
+        h.route("GET", "/api/v1/payouts", self._payouts)
         # log query surface (reference parity: internal/api/log_routes.go
         # over internal/logging/analyzer.go)
         h.route("GET", "/api/v1/logs", self._logs)
@@ -176,6 +186,37 @@ class ApiServer:
         if fn is None:
             return Response.error(404, f"no stats provider {name!r}")
         return Response.json(fn())
+
+    async def _balances(self, request: Request) -> Response:
+        """Carried worker balances + lifetime paid totals (?worker=
+        filters to one) — the settlement engine's balance table."""
+        if self.balances_source is None:
+            return Response.error(404, "no settlement engine wired")
+        try:
+            balances = self.balances_source()
+        except Exception as e:
+            log.exception("balances source failed")
+            return Response.error(500, f"balances source failed: {e}")
+        worker = request.query.get("worker")
+        if worker:
+            balances = [b for b in balances if b.get("worker") == worker]
+        return Response.json({"count": len(balances), "balances": balances})
+
+    async def _payouts(self, request: Request) -> Response:
+        """Pending payout intents + recent outcomes (?limit=) from the
+        idempotency-keyed ledger."""
+        if self.payouts_source is None:
+            return Response.error(404, "no settlement engine wired")
+        try:
+            limit = min(max(int(request.query.get("limit", "100")), 1), 1000)
+        except ValueError:
+            return Response.error(400, "limit must be an integer")
+        try:
+            out = self.payouts_source(limit)
+        except Exception as e:
+            log.exception("payouts source failed")
+            return Response.error(500, f"payouts source failed: {e}")
+        return Response.json(out)
 
     async def _algorithms(self, request: Request) -> Response:
         from otedama_tpu.engine import algos
@@ -562,6 +603,58 @@ class ApiServer:
                     "otedama_p2p_share_rejects", count, {"reason": reason},
                     help_="Share rejections by verification failure reason",
                 )
+
+    def sync_settlement_metrics(self, snapshot: dict) -> None:
+        """Settlement/payout pipeline health from a SettlementEngine
+        snapshot: ledger progress (settled count, cursor vs horizon),
+        money movement (credited/sent amounts), and the exactly-once
+        alarms (failures, lost verdicts healed, wallet dedup hits)."""
+        reg = self.registry
+        reg.counter_set("otedama_settlement_settled_total",
+                        snapshot.get("settlements_settled", 0),
+                        help_="Settlements driven to the settled state")
+        reg.counter_set("otedama_settlement_failures_total",
+                        snapshot.get("settle_failures", 0),
+                        help_="Settlement ticks aborted mid-pipeline (replayed)")
+        reg.counter_set("otedama_settlement_resumed_total",
+                        snapshot.get("resumes", 0),
+                        help_="Half-applied settlements replayed after restart")
+        reg.counter_set("otedama_settlement_credited_amount_total",
+                        snapshot.get("credited_amount", 0),
+                        help_="Atomic units credited to worker balances")
+        reg.counter_set("otedama_settlement_horizon_violations_total",
+                        snapshot.get("horizon_violations", 0),
+                        help_="Settlements refused: cursor not on the local chain")
+        reg.gauge_set("otedama_settlement_last_height",
+                      snapshot.get("last_tip_height", 0),
+                      help_="Chain position the ledger has consumed up to")
+        reg.gauge_set("otedama_settlement_unsettled_shares",
+                      snapshot.get("unsettled_shares", 0),
+                      help_="Immutable shares awaiting settlement")
+        totals = snapshot.get("payout_totals", {})
+        sent = totals.get("sent", {})
+        pending = totals.get("pending", {})
+        reg.counter_set("otedama_payout_sent_total",
+                        sent.get("count", 0),
+                        help_="Payout intents paid out (exactly once)")
+        reg.counter_set("otedama_payout_sent_amount_total",
+                        sent.get("amount", 0),
+                        help_="Atomic units paid out")
+        reg.counter_set("otedama_payout_failed_total",
+                        totals.get("failed", {}).get("count", 0),
+                        help_="Payout intents whose send failed (retried via balance)")
+        reg.gauge_set("otedama_payout_pending",
+                      pending.get("count", 0),
+                      help_="Payout intents awaiting submission")
+        reg.gauge_set("otedama_payout_pending_amount",
+                      pending.get("amount", 0),
+                      help_="Atomic units awaiting submission")
+        reg.counter_set("otedama_payout_verdicts_lost_total",
+                        snapshot.get("submit_verdicts_lost", 0),
+                        help_="Wallet sends whose verdict was lost pre-record")
+        reg.counter_set("otedama_payout_duplicates_avoided_total",
+                        snapshot.get("wallet_duplicates_avoided", 0),
+                        help_="Re-submitted batches deduplicated by idempotency key")
 
     def sync_pool_server_metrics(self, server=None, server_v2=None) -> None:
         """Export the POOL-side share-accept latency SLO histograms

@@ -60,6 +60,7 @@ class Application:
         self.pool = None            # pool manager
         self.db = None
         self.p2p = None
+        self.settlement = None      # crash-safe settlement engine
         self.api: ApiServer | None = None
         self.recovery = None
         self.failure_detector = None
@@ -155,6 +156,8 @@ class Application:
             await self._start_miner_side()
         if cfg.p2p.enabled:
             await self._start_p2p()
+        if cfg.settlement.enabled:
+            await self._start_settlement()
         if cfg.api.enabled:
             await self._start_api()
         await self._start_supervision()
@@ -185,15 +188,23 @@ class Application:
             if cfg.pool.chain_rpc_url
             else MockChainClient()
         )
-        self.pool = PoolManager(
-            self.db, chain,
-            config=PoolConfig(payout=PayoutConfig(
-                scheme=PayoutScheme(cfg.pool.payout_scheme.upper()),
-                pplns_window=cfg.pool.pplns_window,
-                pool_fee_percent=cfg.pool.fee_percent,
-                minimum_payout=cfg.pool.minimum_payout,
-            )),
-        )
+        pool_cfg = PoolConfig(payout=PayoutConfig(
+            scheme=PayoutScheme(cfg.pool.payout_scheme.upper()),
+            pplns_window=cfg.pool.pplns_window,
+            pool_fee_percent=cfg.pool.fee_percent,
+            minimum_payout=cfg.pool.minimum_payout,
+            payout_fee=cfg.pool.payout_fee,
+        ))
+        if cfg.settlement.enabled:
+            # the settlement engine owns the money path: disable the
+            # manager's interval payout loop AND its at-accept block
+            # distribution (two payers or two crediting paths over one
+            # balance table would double-spend/double-credit it — the
+            # engine credits each block from its db row after
+            # confirmation + reorg horizon)
+            pool_cfg.payout_interval = 0.0
+            pool_cfg.defer_block_distribution = True
+        self.pool = PoolManager(self.db, chain, config=pool_cfg)
         self.server = StratumServer(
             ServerConfig(
                 host=cfg.stratum.host,
@@ -514,6 +525,25 @@ class Application:
         await self.p2p.start()
         self._started.append(self.p2p)
 
+    async def _start_settlement(self) -> None:
+        """Crash-safe settlement engine: share-chain PPLNS weights ->
+        ledger -> balances -> exactly-once batched payouts. Config
+        validation guarantees pool (db + wallet) and p2p (chain) are up;
+        start() resumes any settlement a crash left mid-pipeline before
+        the first tick."""
+        from otedama_tpu.pool.settlement import SettlementConfig, SettlementEngine
+
+        cfg = self.config.settlement
+        self.settlement = SettlementEngine(
+            self.db, self.p2p.chain, self.pool.wallet,
+            payout=self.pool.config.payout,
+            config=SettlementConfig(
+                interval=cfg.interval, drain_timeout=cfg.drain_timeout,
+            ),
+        )
+        await self.settlement.start()
+        self._started.append(self.settlement)
+
     async def _start_api(self) -> None:
         cfg = self.config.api
         self.api = ApiServer(ApiServerConfig(
@@ -536,6 +566,29 @@ class Application:
             self.api.add_provider("pool", self.pool.snapshot)
         if self.p2p is not None:
             self.api.add_provider("p2p", self.p2p.snapshot)
+        if self.settlement is not None:
+            self.api.add_provider("settlement", self.settlement.snapshot)
+            # operator surface: carried balances + pending/recent payouts
+            self.api.balances_source = self.settlement.balances
+            self.api.payouts_source = self.settlement.pending_payouts
+
+            async def settle_now(params: dict) -> dict:
+                """Admin override: run one settlement tick immediately
+                (same serialized pipeline the interval loop drives)."""
+                return await self.settlement.settle_once()
+
+            async def abandon_payouts(params: dict) -> dict:
+                """Admin override for a DEFINITIVE wallet rejection:
+                mark a stuck settlement's pending intents failed (see
+                SettlementEngine.abandon_pending_payouts)."""
+                if "skey" not in params:
+                    raise ValueError("missing 'skey' parameter")
+                n = await self.settlement.abandon_pending_payouts(
+                    str(params["skey"]))
+                return {"abandoned": n}
+
+            self.api.add_control("settle_now", settle_now)
+            self.api.add_control("abandon_payouts", abandon_payouts)
         self.api.add_provider("benchmarks", self.algo_manager.snapshot)
         # compilation lifecycle: cache hit/miss + per-(algorithm, backend)
         # compile-time telemetry (utils/compile_cache)
@@ -859,6 +912,8 @@ class Application:
                 self.api.sync_pool_server_metrics(self.server, self.server_v2)
             if self.p2p is not None:
                 self.api.sync_p2p_metrics(self.p2p.snapshot())
+            if self.settlement is not None:
+                self.api.sync_settlement_metrics(self.settlement.snapshot())
             self.api.sync_compile_metrics(
                 compile_cache.counters(), compile_cache.histograms()
             )
@@ -909,4 +964,6 @@ class Application:
             out["pool"] = self.pool.snapshot()
         if self.p2p is not None:
             out["p2p"] = self.p2p.snapshot()
+        if self.settlement is not None:
+            out["settlement"] = self.settlement.snapshot()
         return out

@@ -161,11 +161,33 @@ class PoolSettings:
     pplns_window: int = 10000
     fee_percent: float = 1.0
     minimum_payout: int = 100_000
+    # per-payout network fee charged to the worker (atomic units); must
+    # stay below minimum_payout or nothing is ever payable
+    payout_fee: int = 1_000
     # SQLite path, or a postgres://user:pw@host/db DSN (db.postgres)
     database: str = "otedama.db"
     chain_rpc_url: str = ""
     chain_rpc_user: str = ""
     chain_rpc_password: str = ""
+
+
+@dataclasses.dataclass
+class SettlementSettings:
+    """Crash-safe settlement engine (pool/settlement.py): periodic
+    snapshots of the share chain's immutable prefix -> append-only
+    ledger -> worker balances -> idempotency-keyed batched payouts.
+    Requires pool mode (the database/wallet) AND p2p mode (the chain).
+    When enabled it OWNS payouts — the PoolManager's interval payout
+    loop is disabled so one balance table never has two payers."""
+
+    enabled: bool = False
+    # seconds between settlement ticks (each tick first replays anything
+    # a crash left mid-pipeline, then settles newly immutable shares)
+    interval: float = 60.0
+    # stop(): how long to let an in-flight settlement finish its current
+    # atomic transition before hard-cancelling (a hard cancel is safe —
+    # it is exactly the crash the ledger is built to replay)
+    drain_timeout: float = 10.0
 
 
 @dataclasses.dataclass
@@ -218,6 +240,8 @@ class AppConfig:
     mining: MiningConfig = dataclasses.field(default_factory=MiningConfig)
     stratum: StratumSettings = dataclasses.field(default_factory=StratumSettings)
     pool: PoolSettings = dataclasses.field(default_factory=PoolSettings)
+    settlement: SettlementSettings = dataclasses.field(
+        default_factory=SettlementSettings)
     p2p: P2PConfig = dataclasses.field(default_factory=P2PConfig)
     api: ApiConfig = dataclasses.field(default_factory=ApiConfig)
     logging: LoggingConfig = dataclasses.field(default_factory=LoggingConfig)
@@ -228,6 +252,7 @@ _SECTIONS = {
     "mining": MiningConfig,
     "stratum": StratumSettings,
     "pool": PoolSettings,
+    "settlement": SettlementSettings,
     "p2p": P2PConfig,
     "api": ApiConfig,
     "logging": LoggingConfig,
@@ -335,6 +360,22 @@ def validate_config(cfg: AppConfig) -> list[str]:
         errors.append("pool.fee_percent out of range")
     if cfg.pool.pplns_window <= 0:
         errors.append("pool.pplns_window must be positive")
+    if cfg.pool.payout_fee < 0:
+        errors.append("pool.payout_fee must be >= 0")
+    if cfg.pool.minimum_payout <= cfg.pool.payout_fee:
+        errors.append(
+            "pool.minimum_payout must exceed pool.payout_fee "
+            "(nothing would ever be payable)"
+        )
+    if cfg.settlement.enabled and not (cfg.pool.enabled and cfg.p2p.enabled):
+        errors.append(
+            "settlement.enabled requires pool.enabled (the ledger "
+            "database and wallet) and p2p.enabled (the share chain)"
+        )
+    if cfg.settlement.interval <= 0:
+        errors.append("settlement.interval must be positive")
+    if cfg.settlement.drain_timeout <= 0:
+        errors.append("settlement.drain_timeout must be positive")
     if cfg.p2p.share_difficulty <= 0:
         errors.append("p2p.share_difficulty must be positive")
     if cfg.p2p.pplns_window <= 0:
@@ -384,7 +425,14 @@ pool:
   payout_scheme: PPLNS
   pplns_window: 10000
   fee_percent: 1.0
+  minimum_payout: 100000  # atomic units; balances below it carry forward
+  payout_fee: 1000        # per-payout network fee charged to the worker
   database: otedama.db
+
+settlement:
+  enabled: false       # crash-safe exactly-once payouts (needs pool + p2p)
+  interval: 60.0       # seconds between settlement ticks
+  drain_timeout: 10.0  # stop(): bound on waiting out an in-flight tick
 
 p2p:
   enabled: false

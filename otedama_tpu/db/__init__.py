@@ -2,6 +2,8 @@ from otedama_tpu.db.database import Database, connect_database
 from otedama_tpu.db.repos import (
     BlockRepository,
     PayoutRepository,
+    PayoutTxRepository,
+    SettlementRepository,
     ShareRepository,
     WorkerRepository,
 )
@@ -13,4 +15,6 @@ __all__ = [
     "ShareRepository",
     "BlockRepository",
     "PayoutRepository",
+    "PayoutTxRepository",
+    "SettlementRepository",
 ]

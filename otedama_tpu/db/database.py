@@ -157,6 +157,52 @@ MIGRATIONS: list[tuple[int, str]] = [
         created_at REAL NOT NULL
     );
     """),
+    # settlement ledger (pool/settlement.py): append-only, idempotency-
+    # keyed. `skey` columns are deterministic ids derived from the share-
+    # chain snapshot tip (+ worker for payout_txs) so a replayed
+    # settlement writes the SAME rows it wrote before the crash — the
+    # UNIQUE constraints are the hard duplicate-payment backstop.
+    (3, """
+    ALTER TABLE blocks ADD COLUMN settled_skey TEXT NOT NULL DEFAULT '';
+    CREATE TABLE settlements (
+        id           INTEGER PRIMARY KEY AUTOINCREMENT,
+        skey         TEXT NOT NULL UNIQUE,   -- H(tag | snapshot tip id)
+        tip_hash     TEXT NOT NULL,          -- snapshot tip share id (hex)
+        tip_height   INTEGER NOT NULL,       -- chain position AFTER the tip
+        start_height INTEGER NOT NULL,       -- first chain position consumed
+        reward       INTEGER NOT NULL,
+        pool_fee     INTEGER NOT NULL,
+        state        TEXT NOT NULL DEFAULT 'calculated',
+                     -- calculated -> credited -> submitting -> settled
+        created_at   REAL NOT NULL,
+        settled_at   REAL
+    );
+    CREATE INDEX idx_settlements_state ON settlements(state);
+    CREATE TABLE settlement_credits (
+        settlement_skey TEXT NOT NULL,
+        worker          TEXT NOT NULL,
+        amount          INTEGER NOT NULL,    -- atomic units
+        share_value     REAL NOT NULL,
+        applied_at      REAL,
+        PRIMARY KEY (settlement_skey, worker)
+    );
+    CREATE TABLE payout_txs (
+        id              INTEGER PRIMARY KEY AUTOINCREMENT,
+        skey            TEXT NOT NULL UNIQUE, -- H(tag | tip id | worker)
+        settlement_skey TEXT NOT NULL,
+        worker          TEXT NOT NULL,
+        address         TEXT NOT NULL,
+        amount          INTEGER NOT NULL,     -- net of fee
+        fee             INTEGER NOT NULL,
+        status          TEXT NOT NULL DEFAULT 'pending', -- pending|sent|failed
+        tx_ref          TEXT NOT NULL DEFAULT '',
+        created_at      REAL NOT NULL,
+        sent_at         REAL
+    );
+    CREATE INDEX idx_payout_txs_settlement ON payout_txs(settlement_skey);
+    CREATE INDEX idx_payout_txs_worker ON payout_txs(worker);
+    CREATE INDEX idx_payout_txs_status ON payout_txs(status);
+    """),
 ]
 
 
@@ -199,12 +245,26 @@ class Database(AuditMixin):
     def __init__(self, path: str = ":memory:"):
         self.path = path
         self._lock = threading.RLock()
+        # write accounting: chaos runs and the settlement engine read
+        # these to prove failures were SEEN, not swallowed (injected
+        # db.execute faults count here alongside real sqlite errors)
+        self.writes = 0
+        self.write_failures = 0
         self._conn = sqlite3.connect(
             path, check_same_thread=False, isolation_level=None
         )
         self._conn.row_factory = sqlite3.Row
-        if path != ":memory:":
-            self._conn.execute("PRAGMA journal_mode=WAL")
+        self.journal_mode = str(
+            self._conn.execute("PRAGMA journal_mode=WAL").fetchone()[0]
+        ).lower()
+        if path != ":memory:" and self.journal_mode != "wal":
+            # the settlement ledger's crash-safety story assumes WAL
+            # (atomic multi-statement commits survive a mid-write kill);
+            # a filesystem that silently refused it must fail loudly
+            raise RuntimeError(
+                f"sqlite at {path!r} could not enter WAL journal mode "
+                f"(got {self.journal_mode!r}); the ledger requires it"
+            )
         self._conn.execute("PRAGMA foreign_keys=ON")
         self._conn.execute("PRAGMA synchronous=NORMAL")
         self.migrate()
@@ -241,18 +301,38 @@ class Database(AuditMixin):
         # statements only — migration DDL and transaction control (BEGIN/
         # COMMIT/ROLLBACK in migrate()/_Transaction) bypass this method,
         # so an injected write failure always leaves a rollbackable txn
-        d = faults.hit("db.execute", supports=faults.POINT)
+        try:
+            d = faults.hit("db.execute", supports=faults.POINT)
+        except Exception:
+            with self._lock:
+                self.write_failures += 1
+            raise
         if d is not None:
             d.sleep_sync()
         with self._lock:
-            return self._conn.execute(sql, params)
+            self.writes += 1
+            try:
+                return self._conn.execute(sql, params)
+            except Exception:
+                self.write_failures += 1
+                raise
 
     def executemany(self, sql: str, rows: list[tuple]) -> sqlite3.Cursor:
-        d = faults.hit("db.execute", supports=faults.POINT)
+        try:
+            d = faults.hit("db.execute", supports=faults.POINT)
+        except Exception:
+            with self._lock:
+                self.write_failures += 1
+            raise
         if d is not None:
             d.sleep_sync()
         with self._lock:
-            return self._conn.executemany(sql, rows)
+            self.writes += 1
+            try:
+                return self._conn.executemany(sql, rows)
+            except Exception:
+                self.write_failures += 1
+                raise
 
     def query(self, sql: str, params: tuple = ()) -> list[sqlite3.Row]:
         with self._lock:
@@ -265,6 +345,18 @@ class Database(AuditMixin):
     def transaction(self):
         return _Transaction(self)
 
+    def snapshot(self) -> dict:
+        """Write-path health for operator surfaces (settlement snapshot,
+        chaos runs): every executed statement and every failure, injected
+        or real, is visible here."""
+        with self._lock:
+            return {
+                "path": self.path,
+                "journal_mode": self.journal_mode,
+                "writes": self.writes,
+                "write_failures": self.write_failures,
+            }
+
     def close(self) -> None:
         with self._lock:
             self._conn.close()
@@ -276,7 +368,11 @@ class _Transaction:
 
     def __enter__(self):
         self.db._lock.acquire()
-        self.db._conn.execute("BEGIN")
+        # IMMEDIATE: take the write lock at BEGIN, not at first write —
+        # a ledger batch commit must never discover mid-transaction that
+        # another connection (backup tooling, operator sqlite3 shell)
+        # holds the file, because a late SQLITE_BUSY aborts the batch
+        self.db._conn.execute("BEGIN IMMEDIATE")
         return self.db
 
     def __exit__(self, exc_type, exc, tb):

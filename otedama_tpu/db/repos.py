@@ -162,6 +162,20 @@ class BlockRepository:
             "SELECT * FROM blocks ORDER BY id DESC LIMIT ?", (limit,)
         )]
 
+    def unsettled_confirmed(self) -> list[dict]:
+        """Confirmed block rewards not yet consumed by a settlement —
+        the settlement engine's reward source."""
+        return [dict(r) for r in self.db.query(
+            "SELECT * FROM blocks WHERE status='confirmed' "
+            "AND settled_skey='' ORDER BY id"
+        )]
+
+    def mark_settled(self, block_ids: list[int], skey: str) -> None:
+        self.db.executemany(
+            "UPDATE blocks SET settled_skey=? WHERE id=?",
+            [(skey, bid) for bid in block_ids],
+        )
+
 
 class PayoutRepository:
     def __init__(self, db: Database):
@@ -195,3 +209,173 @@ class PayoutRepository:
             "SELECT * FROM payouts WHERE worker=? ORDER BY id DESC LIMIT ?",
             (worker, limit),
         )]
+
+
+class SettlementRepository:
+    """The settlement half of the ledger (pool/settlement.py): one row
+    per snapshot, state-machine column, deterministic `skey` so a crashed
+    settlement is re-derived into the SAME row it left behind."""
+
+    def __init__(self, db: Database):
+        self.db = db
+
+    def create(self, skey: str, tip_hash: str, tip_height: int,
+               start_height: int, reward: int, pool_fee: int) -> None:
+        self.db.execute(
+            """INSERT INTO settlements (skey, tip_hash, tip_height,
+               start_height, reward, pool_fee, state, created_at)
+               VALUES (?,?,?,?,?,?,'calculated',?)""",
+            (skey, tip_hash, tip_height, start_height, reward, pool_fee,
+             time.time()),
+        )
+
+    def get(self, skey: str) -> dict | None:
+        row = self.db.query_one(
+            "SELECT * FROM settlements WHERE skey=?", (skey,)
+        )
+        return dict(row) if row else None
+
+    def set_state(self, skey: str, state: str, settled: bool = False) -> None:
+        if settled:
+            self.db.execute(
+                "UPDATE settlements SET state=?, settled_at=? WHERE skey=?",
+                (state, time.time(), skey),
+            )
+        else:
+            self.db.execute(
+                "UPDATE settlements SET state=? WHERE skey=?", (state, skey)
+            )
+
+    def unfinished(self) -> list[dict]:
+        """Settlements a crash left mid-pipeline, oldest first — the
+        restart replay set."""
+        return [dict(r) for r in self.db.query(
+            "SELECT * FROM settlements WHERE state != 'settled' ORDER BY id"
+        )]
+
+    def last_tip_height(self) -> int:
+        """The settlement cursor: first chain position NOT yet consumed.
+        Every settlement past 'calculated' is committed to its window, so
+        unfinished rows advance the cursor too (their replay completes
+        them; a new settlement must never overlap them)."""
+        row = self.db.query_one(
+            "SELECT MAX(tip_height) AS h FROM settlements"
+        )
+        return int(row["h"] or 0) if row else 0
+
+    def latest(self) -> dict | None:
+        row = self.db.query_one(
+            "SELECT * FROM settlements ORDER BY tip_height DESC LIMIT 1"
+        )
+        return dict(row) if row else None
+
+    def list(self, limit: int = 50) -> list[dict]:
+        return [dict(r) for r in self.db.query(
+            "SELECT * FROM settlements ORDER BY id DESC LIMIT ?", (limit,)
+        )]
+
+    def insert_credits(self, skey: str,
+                       rows: list[tuple[str, int, float]]) -> None:
+        """(worker, amount, share_value) rows for one settlement. The
+        composite PK makes a replayed insert a hard conflict instead of a
+        silent double-credit; DO NOTHING because a replay re-derives
+        byte-identical rows."""
+        self.db.executemany(
+            """INSERT INTO settlement_credits
+               (settlement_skey, worker, amount, share_value)
+               VALUES (?,?,?,?)
+               ON CONFLICT(settlement_skey, worker) DO NOTHING""",
+            [(skey, worker, amount, value) for worker, amount, value in rows],
+        )
+
+    def credits_for(self, skey: str) -> list[dict]:
+        return [dict(r) for r in self.db.query(
+            "SELECT * FROM settlement_credits WHERE settlement_skey=? "
+            "ORDER BY worker", (skey,)
+        )]
+
+    def mark_credits_applied(self, skey: str) -> None:
+        self.db.execute(
+            "UPDATE settlement_credits SET applied_at=? "
+            "WHERE settlement_skey=?", (time.time(), skey),
+        )
+
+    def counts(self) -> dict:
+        row = self.db.query_one(
+            "SELECT COUNT(*) AS total, "
+            "SUM(CASE WHEN state='settled' THEN 1 ELSE 0 END) AS settled "
+            "FROM settlements"
+        )
+        return {"total": int(row["total"] or 0),
+                "settled": int(row["settled"] or 0)}
+
+
+class PayoutTxRepository:
+    """Idempotency-keyed payout intents (the money-moving half of the
+    ledger). `skey` = H(tag | snapshot tip | worker) — a replayed submit
+    re-derives the same keys, so the UNIQUE constraint plus the wallet's
+    key dedup make the external send exactly-once."""
+
+    def __init__(self, db: Database):
+        self.db = db
+
+    def insert_many(self, rows: list[tuple]) -> None:
+        """(skey, settlement_skey, worker, address, amount, fee) rows."""
+        now = time.time()
+        self.db.executemany(
+            """INSERT INTO payout_txs
+               (skey, settlement_skey, worker, address, amount, fee,
+                status, created_at)
+               VALUES (?,?,?,?,?,?,'pending',?)
+               ON CONFLICT(skey) DO NOTHING""",
+            [(s, ss, w, a, amt, fee, now) for s, ss, w, a, amt, fee in rows],
+        )
+
+    def for_settlement(self, skey: str, status: str | None = None) -> list[dict]:
+        if status is None:
+            rows = self.db.query(
+                "SELECT * FROM payout_txs WHERE settlement_skey=? "
+                "ORDER BY worker", (skey,)
+            )
+        else:
+            rows = self.db.query(
+                "SELECT * FROM payout_txs WHERE settlement_skey=? "
+                "AND status=? ORDER BY worker", (skey, status),
+            )
+        return [dict(r) for r in rows]
+
+    def mark_sent_many(self, skeys: list[str], tx_ref: str) -> None:
+        now = time.time()
+        self.db.executemany(
+            "UPDATE payout_txs SET status='sent', tx_ref=?, sent_at=? "
+            "WHERE skey=?",
+            [(tx_ref, now, s) for s in skeys],
+        )
+
+    def mark_failed_many(self, skeys: list[str]) -> None:
+        self.db.executemany(
+            "UPDATE payout_txs SET status='failed' WHERE skey=?",
+            [(s,) for s in skeys],
+        )
+
+    def pending(self) -> list[dict]:
+        return [dict(r) for r in self.db.query(
+            "SELECT * FROM payout_txs WHERE status='pending' ORDER BY id"
+        )]
+
+    def recent(self, limit: int = 100) -> list[dict]:
+        return [dict(r) for r in self.db.query(
+            "SELECT * FROM payout_txs ORDER BY id DESC LIMIT ?", (limit,)
+        )]
+
+    def totals(self) -> dict:
+        """Sent/failed/pending counts and amounts — the metrics source."""
+        out = {}
+        for status in ("sent", "failed", "pending"):
+            row = self.db.query_one(
+                "SELECT COUNT(*) AS n, SUM(amount) AS amt "
+                "FROM payout_txs WHERE status=?", (status,),
+            )
+            out[status] = {"count": int(row["n"] or 0),
+                           "amount": int(row["amt"] or 0)}
+        return out

@@ -329,6 +329,32 @@ class ShareChain:
             out[share.worker] = out.get(share.worker, 0.0) + share.difficulty
         return out
 
+    # -- settlement horizon --------------------------------------------------
+
+    def settled_height(self) -> int:
+        """Length of the IMMUTABLE prefix of the best chain. Forks deeper
+        than ``max_reorg_depth`` are refused (``_maybe_adopt``), so a
+        position below this can never be rewound — the settlement engine
+        (pool/settlement.py) snapshots only below it, which is what makes
+        settled credit un-reorgable by construction."""
+        return max(0, len(self._chain) - self.params.max_reorg_depth)
+
+    def share_id_at(self, height: int) -> bytes:
+        """Best-chain share id at a 0-based chain position."""
+        return self._chain[height]
+
+    def chain_slice(self, start: int, end: int) -> list[Share]:
+        """Best-chain shares for positions ``[start, end)``, chain order.
+        Positions below ``settled_height()`` are stable; callers slicing
+        above it own the reorg risk."""
+        return [self.records[sid].share for sid in self._chain[start:end]]
+
+    def position_of(self, share_id: bytes) -> int | None:
+        """Best-chain position of a share id (None when off-chain) —
+        settlement uses it to assert its persisted cursor still lies on
+        THIS chain before consuming more of it."""
+        return self._pos.get(share_id)
+
     # -- linking -------------------------------------------------------------
 
     def connect(self, share: Share) -> str:

@@ -25,7 +25,12 @@ from otedama_tpu.db import (
 )
 from otedama_tpu.engine.types import Job
 from otedama_tpu.pool.blockchain import BlockchainClient, BlockTemplate
-from otedama_tpu.pool.payouts import PayoutCalculator, PayoutConfig, PayoutScheme
+from otedama_tpu.pool.payouts import (
+    PayoutCalculator,
+    PayoutConfig,
+    PayoutScheme,
+    stage_payable_workers,
+)
 from otedama_tpu.pool.submitter import BlockSubmitter, SubmitterConfig
 from otedama_tpu.stratum.server import AcceptedShare
 
@@ -33,9 +38,14 @@ log = logging.getLogger("otedama.pool.manager")
 
 
 class WalletInterface(Protocol):
-    """Reference parity: internal/pool/payout_processor.go:59-66."""
+    """Reference parity: internal/pool/payout_processor.go:59-66, plus an
+    idempotency ``key``: a re-submitted batch carrying a key the wallet
+    has already honoured must return the ORIGINAL tx id without moving
+    coins again (the settlement engine's exactly-once hinge — a crash
+    between send and record is indistinguishable from a lost verdict)."""
 
-    async def send_many(self, outputs: dict[str, int]) -> str: ...
+    async def send_many(self, outputs: dict[str, int],
+                        key: str | None = None) -> str: ...
     async def get_balance(self) -> int: ...
 
 
@@ -46,14 +56,25 @@ class MockWallet:
         self.balance = balance
         self.sent: list[dict[str, int]] = []
         self._tx = itertools.count(1)
+        self._by_key: dict[str, str] = {}
+        self.duplicates_avoided = 0
 
-    async def send_many(self, outputs: dict[str, int]) -> str:
+    async def send_many(self, outputs: dict[str, int],
+                        key: str | None = None) -> str:
+        if key is not None and key in self._by_key:
+            # idempotent re-submit: the batch already went out — answer
+            # with the original tx, move nothing
+            self.duplicates_avoided += 1
+            return self._by_key[key]
         total = sum(outputs.values())
         if total > self.balance:
             raise RuntimeError("insufficient funds")
         self.balance -= total
         self.sent.append(dict(outputs))
-        return f"mock-tx-{next(self._tx):08d}"
+        tx = f"mock-tx-{next(self._tx):08d}"
+        if key is not None:
+            self._by_key[key] = tx
+        return tx
 
     async def get_balance(self) -> int:
         return self.balance
@@ -65,6 +86,11 @@ class PoolConfig:
     payout_interval: float = 3600.0
     template_poll_seconds: float = 5.0
     share_retention_seconds: float = 7 * 86400.0
+    # True when the settlement engine (pool/settlement.py) owns reward
+    # distribution: on_block then only records the block and the engine
+    # credits it AFTER confirmation + reorg horizon — crediting here too
+    # would pay every block reward twice from the same balance table
+    defer_block_distribution: bool = False
 
 
 class PoolManager:
@@ -148,6 +174,11 @@ class PoolManager:
         outcome = await self.submitter.submit(header, share.worker_user, reward)
         if not outcome.accepted:
             return
+        if self.config.defer_block_distribution:
+            # the settlement engine credits this block from its db row
+            # once it confirms and the share-chain horizon passes it
+            log.info("block recorded; distribution deferred to settlement")
+            return
         self.distribute_block(reward, finder=share.worker_user)
 
     # -- reward distribution ------------------------------------------------
@@ -184,13 +215,11 @@ class PoolManager:
         cfg = self.config.payout
         outputs: dict[str, int] = {}
         entries: list[tuple[str, str, int, int]] = []  # worker,address,amount,payout_id
-        for w in self.workers.list():
-            payable = w["balance"] - cfg.payout_fee
-            if w["balance"] >= cfg.minimum_payout and payable > 0:
-                address = w["wallet"] or w["name"].split(".")[0]
-                pid = self.payout_repo.create(w["name"], address, payable)
-                entries.append((w["name"], address, payable, pid))
-                outputs[address] = outputs.get(address, 0) + payable
+        for name, address, payable in stage_payable_workers(
+                self.workers.list(), cfg):
+            pid = self.payout_repo.create(name, address, payable)
+            entries.append((name, address, payable, pid))
+            outputs[address] = outputs.get(address, 0) + payable
         if not outputs:
             return 0
         try:
@@ -224,6 +253,11 @@ class PoolManager:
         self._tasks.clear()
 
     async def _payout_loop(self) -> None:
+        if self.config.payout_interval <= 0:
+            # payouts are owned elsewhere (the crash-safe settlement
+            # engine, pool/settlement.py) — two payers over one balance
+            # table would double-spend it
+            return
         while True:
             await asyncio.sleep(self.config.payout_interval)
             await self.process_payouts()

@@ -72,7 +72,10 @@ def _split_proportional(
         out.append(WorkerPayout(worker, amt, weight, weight / total))
     if out:
         remainder = reward_after_fee - floor_sum
-        biggest = max(out, key=lambda p: p.share_value)
+        # remainder tie-break must be FULLY deterministic: settlement ids
+        # and replayed ledgers hash these amounts, so equal share_values
+        # break by worker name, never by list order
+        biggest = min(out, key=lambda p: (-p.share_value, p.worker))
         biggest.amount += remainder
     return out
 
@@ -146,6 +149,27 @@ class PayoutCalculator:
         )
         credit = share_difficulty * rate * (1.0 - cfg.pool_fee_percent / 100.0)
         return int(credit)
+
+
+def stage_payable_workers(
+    workers: list[dict], cfg: PayoutConfig
+) -> list[tuple[str, str, int]]:
+    """The one payout-eligibility rule, shared by every payer: workers
+    whose balance clears ``minimum_payout`` AND nets positive after the
+    per-payout fee become ``(worker, address, payable)`` rows; everyone
+    else carries forward. Address falls back to the stratum-convention
+    account half of ``account.rig``. Both the legacy interval loop
+    (PoolManager.process_payouts) and the settlement engine stage
+    through here — the settlement ledger hashes these amounts, so the
+    rule must never diverge between payers."""
+    out: list[tuple[str, str, int]] = []
+    for w in workers:
+        balance = int(w["balance"])
+        payable = balance - cfg.payout_fee
+        if balance >= cfg.minimum_payout and payable > 0:
+            address = w["wallet"] or w["name"].split(".")[0]
+            out.append((w["name"], address, payable))
+    return out
 
 
 @dataclasses.dataclass

@@ -1,0 +1,526 @@
+"""Crash-safe, exactly-once settlement: PPLNS weights -> settled balances.
+
+Reference parity: internal/pool/payout_calculator.go + fee_distributor.go
+feed a Postgres-backed payout processor; PAPER.md names payouts +
+persistence as a core layer the reproduction lacked. PR 5 made
+``ShareChain.weights()`` byte-identical on every converged node — this
+module turns those weights into money that survives a kill -9 at any
+instruction boundary, without ever paying a worker twice.
+
+Construction, in order of what it protects against:
+
+- **Reorg safety.** A settlement snapshots ONLY the immutable prefix of
+  the best share chain: positions below ``ShareChain.settled_height()``
+  (= height - ``max_reorg_depth``). The chain refuses deeper forks, so a
+  reorg can re-order the recent window but can never un-earn credit a
+  settlement already consumed — no clawback logic exists because no
+  clawback is possible.
+
+- **Determinism.** Every id in the ledger derives from chain content:
+  settlement id = H(tag | snapshot tip id), payout id = H(tag | snapshot
+  tip id | worker), and the split itself is ``PayoutCalculator`` over a
+  chain slice with a name-deterministic remainder tie-break. A replay
+  after a crash re-derives byte-identical rows; the UNIQUE constraints
+  in the schema turn any would-be duplicate into a hard conflict.
+
+- **Replayable pipeline.** One settlement advances through a state
+  machine persisted on its row, each transition committed ATOMICALLY
+  with its effects (sqlite WAL / postgres transactions):
+
+      calculated   settlement row + per-worker credit rows + reward
+                   blocks marked consumed               (one txn)
+      credited     worker balances += credits           (one txn)
+      submitting   payout intents (idempotency-keyed) for every balance
+                   >= minimum_payout                    (one txn)
+      settled      wallet batch sent -> intents marked sent + balances
+                   debited (one txn)
+
+  A send failure — injected, network, or "insufficient funds" — keeps
+  the intents PENDING and the settlement in 'submitting': after any
+  attempt the transfer may or may not have reached the wallet, so the
+  only safe move is to re-submit the SAME idempotency key until the
+  wallet answers (the key makes the retry free). Marking intents failed
+  is reserved for a definitive, operator-confirmed rejection
+  (``abandon_pending_payouts`` — balances were never debited, so the
+  workers simply retry via the next settlement under fresh keys). An
+  unreachable or underfunded wallet therefore wedges the pipeline
+  VISIBLY (``unfinished`` > 0, ``settle_failures`` climbing) instead of
+  silently stranding or double-moving coins.
+
+  On restart ``resume()`` re-reads the ledger: whatever state a crash
+  left, the remaining transitions run; completed ones are no-ops by
+  construction. The only non-transactional step — the external wallet
+  send — is bracketed by the intent rows and an idempotency key, so a
+  crash between send and record is healed by the wallet answering the
+  re-submitted key with the ORIGINAL tx.
+
+- **Carried balances.** Credits always land on worker balances; only
+  balances >= ``minimum_payout`` (and > ``payout_fee``) become intents.
+  Small earners accumulate across settlements and are paid once, later.
+
+Chaos surface: ``payout.settle`` (tagged by pipeline stage) and
+``payout.submit`` fault points; ``payout.submit`` ``drop`` models the
+nastiest failure — the wallet call SUCCEEDS but the verdict is lost
+before it is recorded (tests/test_settlement.py proves the replay does
+not double-pay).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import time
+
+from otedama_tpu.db.repos import (
+    BlockRepository,
+    PayoutTxRepository,
+    SettlementRepository,
+    WorkerRepository,
+)
+from otedama_tpu.pool.payouts import (
+    PayoutCalculator,
+    PayoutConfig,
+    stage_payable_workers,
+)
+from otedama_tpu.utils import faults, pow_host
+
+log = logging.getLogger("otedama.pool.settlement")
+
+SETTLE_TAG = b"otedama-settle-v1"
+PAYOUT_TAG = b"otedama-payout-v1"
+
+# pipeline stages are skippable steps under chaos (error/crash/delay);
+# the submit step additionally supports drop = "sent but verdict lost"
+_SETTLE_FAULTS = faults.POINT
+_SUBMIT_FAULTS = faults.STEP
+
+
+def settlement_key(tip_id: bytes) -> str:
+    """Deterministic settlement id from the snapshot tip share id."""
+    return pow_host.sha256d(SETTLE_TAG + b"\0" + tip_id).hex()
+
+
+def payout_key(tip_id: bytes, worker: str) -> str:
+    """Deterministic payout-intent id: snapshot tip + worker."""
+    return pow_host.sha256d(
+        PAYOUT_TAG + b"\0" + tip_id + b"\0" + worker.encode()
+    ).hex()
+
+
+class SettleInterrupted(RuntimeError):
+    """A settlement tick aborted mid-pipeline (injected or real); the
+    ledger holds the completed prefix and the next tick replays."""
+
+
+@dataclasses.dataclass
+class SettlementConfig:
+    interval: float = 60.0        # seconds between settlement ticks
+    drain_timeout: float = 10.0   # stop(): wait this long for an in-flight tick
+
+
+class SettlementEngine:
+    """Periodic settlements of the share chain's immutable prefix into
+    the append-only ledger, driving balances and batched payouts."""
+
+    def __init__(self, db, chain, wallet,
+                 payout: PayoutConfig | None = None,
+                 config: SettlementConfig | None = None):
+        self.db = db
+        self.chain = chain
+        self.wallet = wallet
+        self.config = config or SettlementConfig()
+        self.calculator = PayoutCalculator(payout)
+        self.workers = WorkerRepository(db)
+        self.blocks = BlockRepository(db)
+        self.settlements = SettlementRepository(db)
+        self.payout_txs = PayoutTxRepository(db)
+        self.stats = {
+            "settlements_started": 0,
+            "settlements_completed": 0,
+            "credited_amount": 0,
+            "payouts_sent": 0,
+            "payouts_sent_amount": 0,
+            "payouts_failed": 0,
+            "submit_retries": 0,
+            "settle_failures": 0,
+            "resumes": 0,
+            "submit_verdicts_lost": 0,
+            "horizon_violations": 0,
+        }
+        # one settlement pipeline at a time: ticks, manual settle_once()
+        # calls, and the startup resume all serialize here
+        self._gate = asyncio.Lock()
+        self._wake = asyncio.Event()
+        self._stopping = False
+        self._task: asyncio.Task | None = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self) -> None:
+        """Resume whatever a crash left mid-pipeline, then tick. A
+        resume that cannot complete (wallet still unreachable, injected
+        fault) must NOT abort node startup — the node boots with the
+        settlement wedged-but-visible (``unfinished`` > 0) and the loop
+        keeps retrying."""
+        try:
+            await self.resume()
+        except Exception as e:
+            self.stats["settle_failures"] += 1
+            log.warning("startup resume incomplete (loop will retry): %s", e)
+        self._stopping = False
+        self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        """Drain: let an in-flight settlement finish its current atomic
+        transition (bounded by ``drain_timeout``), then cancel. A hard
+        cancel is SAFE — it is exactly the crash the ledger replays —
+        but a clean drain avoids needless replay work on next start."""
+        self._stopping = True
+        self._wake.set()
+        if self._task is None:
+            return
+        try:
+            await asyncio.wait_for(self._task, self.config.drain_timeout)
+        except asyncio.TimeoutError:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+        except (asyncio.CancelledError, Exception):
+            pass
+        self._task = None
+
+    def kick(self) -> None:
+        """Request an immediate settlement tick (operator control)."""
+        self._wake.set()
+
+    async def _loop(self) -> None:
+        while not self._stopping:
+            try:
+                await asyncio.wait_for(
+                    self._wake.wait(), timeout=self.config.interval
+                )
+            except asyncio.TimeoutError:
+                pass
+            self._wake.clear()
+            if self._stopping:
+                return
+            try:
+                await self.settle_once()
+            except SettleInterrupted as e:
+                self.stats["settle_failures"] += 1
+                log.warning("settlement interrupted (will replay): %s", e)
+            except Exception:
+                self.stats["settle_failures"] += 1
+                log.exception("settlement tick failed (will replay)")
+
+    # -- the pipeline --------------------------------------------------------
+
+    async def resume(self) -> int:
+        """Replay every settlement a crash left mid-pipeline. Safe to
+        call any time; each completed transition is a no-op on replay."""
+        async with self._gate:
+            return await self._resume_locked()
+
+    async def _resume_locked(self) -> int:
+        n = 0
+        for row in self.settlements.unfinished():
+            log.info("resuming settlement %s from state %r",
+                     row["skey"][:16], row["state"])
+            self.stats["resumes"] += 1
+            await self._advance(row)
+            n += 1
+        return n
+
+    async def settle_once(self) -> dict:
+        """One settlement tick: finish unfinished work first, then (if
+        the horizon advanced AND matured rewards exist) run one new
+        settlement end to end. Returns a summary dict."""
+        async with self._gate:
+            out = {"resumed": 0, "settled": 0}
+            out["resumed"] = await self._resume_locked()
+
+            self._stage_point("snapshot")
+            horizon = self.chain.settled_height()
+            start = self.settlements.last_tip_height()
+            if horizon <= start:
+                return out
+            if not self._cursor_on_chain():
+                return out
+            blocks = self.blocks.unsettled_confirmed()
+            reward = sum(int(b["reward"]) for b in blocks)
+            if reward <= 0:
+                return out  # shares wait until a reward matures
+
+            # PPLNS: the window is the LAST `pplns_window` immutable
+            # shares ending at the horizon tip; older unconsumed shares
+            # expire unrewarded (standard last-N semantics) but the
+            # cursor still advances past them exactly once
+            window = self.calculator.config.pplns_window
+            window_start = max(start, horizon - window)
+            shares = self.chain.chain_slice(window_start, horizon)
+            tip_id = self.chain.share_id_at(horizon - 1)
+            row = self._begin(tip_id, horizon, start, shares, reward, blocks)
+            self.stats["settlements_started"] += 1
+            await self._advance(row)
+            out["settled"] = 1
+            return out
+
+    def _cursor_on_chain(self) -> bool:
+        """The persisted cursor must still lie on THIS chain at the
+        recorded position — a ledger re-attached to a different chain
+        (operator error, wiped node) must refuse loudly, not settle the
+        same shares twice or skip earned ones."""
+        prev = self.settlements.latest()
+        if prev is None:
+            return True
+        pos = self.chain.position_of(bytes.fromhex(prev["tip_hash"]))
+        if pos == prev["tip_height"] - 1:
+            return True
+        self.stats["horizon_violations"] += 1
+        log.error(
+            "settlement cursor %s@%d is not on the local chain "
+            "(position %s) — refusing to settle",
+            prev["tip_hash"][:16], prev["tip_height"], pos,
+        )
+        return False
+
+    def _stage_point(self, stage: str) -> None:
+        """payout.settle fault point, tagged by pipeline stage. Injected
+        errors abort the tick between atomic transitions — exactly a
+        crash at that boundary; the ledger replays."""
+        d = faults.hit("payout.settle", stage, supports=_SETTLE_FAULTS)
+        if d is not None:
+            d.sleep_sync()
+
+    def _begin(self, tip_id: bytes, horizon: int, start: int,
+               shares, reward: int, blocks: list[dict]) -> dict:
+        """Transition -> 'calculated': settlement row + credit rows +
+        reward blocks consumed, one transaction."""
+        self._stage_point("calculate")
+        skey = settlement_key(tip_id)
+        existing = self.settlements.get(skey)
+        if existing is not None:
+            return existing  # replay met its own earlier row
+        result = self.calculator.calculate_block(
+            reward,
+            [{"worker": s.worker, "difficulty": s.difficulty} for s in shares],
+        )
+        with self.db.transaction():
+            self.settlements.create(
+                skey, tip_id.hex(), horizon, start, reward, result.pool_fee
+            )
+            self.settlements.insert_credits(
+                skey,
+                [(p.worker, p.amount, p.share_value) for p in result.payouts],
+            )
+            if blocks:
+                self.blocks.mark_settled([b["id"] for b in blocks], skey)
+        log.info(
+            "settlement %s calculated: positions [%d, %d) reward=%d "
+            "fee=%d workers=%d", skey[:16], start, horizon, reward,
+            result.pool_fee, len(result.payouts),
+        )
+        return self.settlements.get(skey)
+
+    async def _advance(self, row: dict) -> None:
+        """Drive one settlement from its persisted state to 'settled'."""
+        skey = row["skey"]
+        state = row["state"]
+        if state == "calculated":
+            self._apply_credits(skey)
+            state = "credited"
+        if state == "credited":
+            self._stage_payouts(skey, row["tip_hash"])
+            state = "submitting"
+        if state == "submitting":
+            await self._submit(skey)
+
+    def _apply_credits(self, skey: str) -> None:
+        """Transition -> 'credited': balances += credits, one txn. The
+        state flip rides the same commit, so a crash either applied ALL
+        credits or NONE — replay cannot double-credit."""
+        self._stage_point("credit")
+        credits = self.settlements.credits_for(skey)
+        with self.db.transaction():
+            if credits:
+                self.workers.upsert_many([c["worker"] for c in credits])
+                self.workers.credit_many(
+                    [(c["worker"], int(c["amount"])) for c in credits]
+                )
+                self.settlements.mark_credits_applied(skey)
+            self.settlements.set_state(skey, "credited")
+        self.stats["credited_amount"] += sum(int(c["amount"]) for c in credits)
+
+    def _stage_payouts(self, skey: str, tip_hash: str) -> None:
+        """Transition -> 'submitting': write idempotency-keyed payout
+        intents for every worker whose carried balance clears the
+        minimum. Balances are NOT debited yet — the debit is atomically
+        tied to the recorded send, so an unsent intent costs nothing."""
+        self._stage_point("stage-payouts")
+        cfg = self.calculator.config
+        tip = bytes.fromhex(tip_hash)
+        rows = [
+            (payout_key(tip, name), skey, name, address, payable,
+             cfg.payout_fee)
+            for name, address, payable
+            in stage_payable_workers(self.workers.list(), cfg)
+        ]
+        with self.db.transaction():
+            if rows:
+                self.payout_txs.insert_many(rows)
+            self.settlements.set_state(skey, "submitting")
+
+    async def _submit(self, skey: str) -> None:
+        """Transition -> 'settled': the one external step. The wallet
+        call carries the settlement id as idempotency key, so a replay
+        after a lost verdict gets the ORIGINAL tx back instead of paying
+        twice; the recorded outcome (sent + debit, or failed) commits in
+        one transaction with the state flip."""
+        pending = self.payout_txs.for_settlement(skey, "pending")
+        if not pending:
+            with self.db.transaction():
+                self.settlements.set_state(skey, "settled", settled=True)
+            self.stats["settlements_completed"] += 1
+            return
+        outputs: dict[str, int] = {}
+        for p in pending:
+            outputs[p["address"]] = outputs.get(p["address"], 0) + int(p["amount"])
+        lost_verdict = False
+        try:
+            d = faults.hit("payout.submit", supports=_SUBMIT_FAULTS)
+        except faults.FaultInjectedError as e:
+            # injected wallet unreachability: intents stay pending, the
+            # next tick re-submits the same key (retry is free)
+            self.stats["submit_retries"] += 1
+            raise SettleInterrupted(
+                f"payout submit for {skey[:16]} failed (will retry): {e}"
+            ) from e
+        if d is not None:
+            if d.delay:
+                await asyncio.sleep(d.delay)
+            lost_verdict = d.drop
+        try:
+            tx_ref = await self.wallet.send_many(outputs, key=skey)
+        except Exception as e:
+            # after a send ATTEMPT the coins may or may not have moved
+            # (a timeout is indistinguishable from a success whose reply
+            # died) — the only safe move is to keep the intents pending
+            # and re-submit the SAME idempotency key later. Marking them
+            # failed here could strand coins that did move, or pay twice
+            # when a fresh-key retry follows a success we never saw.
+            self.stats["submit_retries"] += 1
+            log.warning("payout submit for %s failed (will retry): %s",
+                        skey[:16], e)
+            raise SettleInterrupted(
+                f"payout submit for {skey[:16]} failed (will retry): {e}"
+            ) from e
+        if lost_verdict:
+            # the coins MOVED but we "crash" before recording — the
+            # exactly-once acid test: replay must re-submit the same key
+            # and record the wallet's deduplicated answer
+            self.stats["submit_verdicts_lost"] += 1
+            raise SettleInterrupted(
+                f"payout.submit verdict lost after tx for {skey[:16]}"
+            )
+        with self.db.transaction():
+            self.payout_txs.mark_sent_many([p["skey"] for p in pending], tx_ref)
+            for p in pending:
+                self.workers.debit_for_payout(
+                    p["worker"], int(p["amount"]) + int(p["fee"])
+                )
+            self.settlements.set_state(skey, "settled", settled=True)
+        self.stats["settlements_completed"] += 1
+        self.stats["payouts_sent"] += len(pending)
+        self.stats["payouts_sent_amount"] += sum(int(p["amount"]) for p in pending)
+        log.info("settlement %s settled: %d payouts in tx %s",
+                 skey[:16], len(pending), tx_ref)
+
+    async def abandon_pending_payouts(self, skey: str) -> int:
+        """Operator override for a DEFINITIVE wallet rejection (the
+        operator has confirmed out-of-band that the idempotency key was
+        never honoured): mark the settlement's pending intents failed
+        and settle it. Balances were never debited, so the workers clear
+        the minimum again next settlement and retry under FRESH keys.
+
+        Serialized on the pipeline gate: abandoning while a tick's
+        ``_submit`` is awaiting the wallet for the SAME settlement would
+        settle it under the in-flight send's feet — if that send then
+        lands, the workers retry under fresh keys on top of moved coins
+        (a double payment). Behind the gate, any in-flight attempt has
+        fully recorded or fully failed before the state check runs."""
+        async with self._gate:
+            return self._abandon_locked(skey)
+
+    def _abandon_locked(self, skey: str) -> int:
+        pending = self.payout_txs.for_settlement(skey, "pending")
+        row = self.settlements.get(skey)
+        if row is None or row["state"] != "submitting":
+            raise ValueError(f"settlement {skey[:16]} is not submitting")
+        with self.db.transaction():
+            self.payout_txs.mark_failed_many([p["skey"] for p in pending])
+            self.settlements.set_state(skey, "settled", settled=True)
+        self.stats["settlements_completed"] += 1
+        self.stats["payouts_failed"] += len(pending)
+        log.warning("settlement %s abandoned %d pending payouts by "
+                    "operator override", skey[:16], len(pending))
+        return len(pending)
+
+    # -- operator surface ----------------------------------------------------
+
+    def balances(self) -> list[dict]:
+        """Carried balances + lifetime paid, per worker (the /api/v1/
+        balances source)."""
+        return [
+            {
+                "worker": w["name"],
+                "balance": int(w["balance"]),
+                "paid_total": int(w["paid_total"]),
+            }
+            for w in self.workers.list()
+        ]
+
+    def pending_payouts(self, limit: int = 100) -> dict:
+        """Intents awaiting submission + recent outcomes (the
+        /api/v1/payouts source)."""
+        return {
+            "pending": self.payout_txs.pending(),
+            "recent": self.payout_txs.recent(limit),
+        }
+
+    def snapshot(self) -> dict:
+        counts = self.settlements.counts()
+        totals = self.payout_txs.totals()
+        latest = self.settlements.latest()
+        out = {
+            "scheme": self.calculator.config.scheme.value,
+            "minimum_payout": self.calculator.config.minimum_payout,
+            "interval": self.config.interval,
+            "settlements": counts["total"],
+            "settlements_settled": counts["settled"],
+            "unfinished": counts["total"] - counts["settled"],
+            "last_tip_height": self.settlements.last_tip_height(),
+            "chain_height": self.chain.height,
+            "horizon": self.chain.settled_height(),
+            "payout_totals": totals,
+            **self.stats,
+        }
+        out["unsettled_shares"] = max(
+            0, out["horizon"] - out["last_tip_height"]
+        )
+        if latest is not None:
+            out["last_settlement"] = {
+                "skey": latest["skey"],
+                "state": latest["state"],
+                "reward": int(latest["reward"]),
+                "tip_height": int(latest["tip_height"]),
+            }
+        dup = getattr(self.wallet, "duplicates_avoided", None)
+        if dup is not None:
+            out["wallet_duplicates_avoided"] = dup
+        db_snap = getattr(self.db, "snapshot", None)
+        if db_snap is not None:
+            out["db"] = db_snap()
+        return out

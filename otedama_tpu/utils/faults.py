@@ -40,6 +40,8 @@ Fault point registry (grep for ``faults.hit`` to verify):
     p2p.share.verify                            (p2p/pool.py; tag share id prefix)
     p2p.sync                                    (p2p/pool.py; tag peer id prefix)
     db.execute                                  (db/database.py writes)
+    payout.settle                               (pool/settlement.py; tag pipeline stage)
+    payout.submit                               (pool/settlement.py wallet send)
     pool.submitter.submit                       (pool/submitter.py retry loop)
     pool.failover.check                         (pool/failover.py; tag pool name)
     engine.batch                                (engine/engine.py; tag backend)

@@ -22,6 +22,12 @@
 #                   rewind-and-replay reorg; writes a BENCH_SHARECHAIN
 #                   json artifact and fails if convergence or the reorg
 #                   never happened.
+#   payout-bench    opt-in settlement-pipeline bench: settlement
+#                   throughput over the sqlite ledger, crash-restart
+#                   recovery time at the lost-verdict boundary, and a
+#                   seeded chaos run audited for duplicate/lost payouts
+#                   (MUST be 0/0 — exit 2 otherwise); writes a
+#                   BENCH_PAYOUT json artifact.
 #   degrade-bench   opt-in device-loss resilience bench: hangs one of
 #                   three devices via the device.call fault point and
 #                   measures time-to-quarantine, shares lost during the
@@ -52,5 +58,8 @@ case "$tier" in
   sharechain-bench)
     exec env JAX_PLATFORMS=cpu python tools/bench_sharechain.py \
       --out "${SHARECHAIN_BENCH_OUT:-BENCH_SHARECHAIN_manual.json}" "$@" ;;
-  *) echo "usage: $0 [fast|slow|all|audit|stratum-bench|switch-bench|degrade-bench|sharechain-bench] [pytest args...]" >&2; exit 2 ;;
+  payout-bench)
+    exec env JAX_PLATFORMS=cpu python tools/bench_payout.py \
+      --out "${PAYOUT_BENCH_OUT:-BENCH_PAYOUT_manual.json}" "$@" ;;
+  *) echo "usage: $0 [fast|slow|all|audit|stratum-bench|switch-bench|degrade-bench|sharechain-bench|payout-bench] [pytest args...]" >&2; exit 2 ;;
 esac

@@ -1,0 +1,153 @@
+"""PayoutCalculator invariants, property-style across all five schemes.
+
+The settlement ledger hashes these amounts into idempotency-keyed rows,
+so two properties are load-bearing far beyond unit-test hygiene:
+
+- **exact sum**: every distributed block satisfies
+  ``sum(amounts) + pool_fee == reward`` to the atomic unit (integer
+  floor split + remainder assignment — the reference's big.Int math
+  leaks dust);
+- **full determinism**: the same weights produce byte-identical splits
+  regardless of share arrival order, including the remainder tie-break
+  (equal share_value breaks by worker name, pool/payouts.py).
+"""
+
+from __future__ import annotations
+
+import random
+
+from otedama_tpu.pool.payouts import (
+    FeeDistributor,
+    FeeSplit,
+    PayoutCalculator,
+    PayoutConfig,
+    PayoutScheme,
+    _split_proportional,
+)
+
+N_CASES = 60
+
+
+def _random_shares(rng: random.Random, n_workers: int, n_shares: int):
+    workers = [f"w{i:02d}.rig" for i in range(n_workers)]
+    return [
+        {"worker": rng.choice(workers),
+         "difficulty": rng.choice([0.5, 1.0, 2.0, 7.25, 64.0])}
+        for _ in range(n_shares)
+    ]
+
+
+def test_exact_sum_invariant_all_schemes_property():
+    """Seeded sweep: for every scheme and random (reward, fee, shares),
+    the distributed total plus the pool fee equals the reward exactly —
+    including pathological rewards (0, 1, primes) and fee percents."""
+    rng = random.Random(0xBEEF)
+    for case in range(N_CASES):
+        reward = rng.choice([0, 1, 17, 1_000, 999_983, 5_000_000_000])
+        fee_pct = rng.choice([0.0, 0.5, 1.0, 2.75, 49.9])
+        shares = _random_shares(rng, rng.randrange(1, 12),
+                                rng.randrange(1, 200))
+        finder = shares[0]["worker"]
+        for scheme in PayoutScheme:
+            calc = PayoutCalculator(PayoutConfig(
+                scheme=scheme, pool_fee_percent=fee_pct,
+                pplns_window=rng.randrange(1, 300),
+            ))
+            res = calc.calculate_block(reward, shares, finder=finder)
+            after_fee = reward - res.pool_fee
+            if scheme in (PayoutScheme.PPLNS, PayoutScheme.PROP):
+                assert res.distributed == after_fee, (case, scheme)
+                assert all(p.amount >= 0 for p in res.payouts)
+            elif scheme == PayoutScheme.SOLO:
+                assert res.distributed == after_fee
+                assert [p.worker for p in res.payouts] == [finder]
+            else:  # PPS / FPPS pay continuously, nothing at block time
+                assert res.distributed == 0
+
+
+def test_zero_weight_and_empty_window_edges():
+    for scheme in (PayoutScheme.PPLNS, PayoutScheme.PROP):
+        calc = PayoutCalculator(PayoutConfig(scheme=scheme))
+        assert calc.calculate_block(1_000_000, []).payouts == []
+        zero = [{"worker": "a", "difficulty": 0.0}]
+        assert calc.calculate_block(1_000_000, zero).payouts == []
+    # SOLO with no finder distributes nothing
+    calc = PayoutCalculator(PayoutConfig(scheme=PayoutScheme.SOLO))
+    assert calc.calculate_block(1_000_000, [], finder=None).payouts == []
+
+
+def test_single_worker_takes_everything_after_fee():
+    for scheme in (PayoutScheme.PPLNS, PayoutScheme.PROP):
+        calc = PayoutCalculator(PayoutConfig(
+            scheme=scheme, pool_fee_percent=1.0))
+        res = calc.calculate_block(
+            1_000_001, [{"worker": "solo.rig", "difficulty": 3.0}] * 7)
+        assert len(res.payouts) == 1
+        assert res.payouts[0].amount == 1_000_001 - res.pool_fee
+
+
+def test_split_is_independent_of_share_order():
+    """Permuting the share list never changes a worker's amount — the
+    weights aggregation and the remainder tie-break are both order-free
+    (settlement ids derive from these amounts on every node)."""
+    rng = random.Random(42)
+    for _ in range(N_CASES):
+        shares = _random_shares(rng, rng.randrange(2, 8),
+                                rng.randrange(5, 60))
+        calc = PayoutCalculator(PayoutConfig(
+            scheme=PayoutScheme.PROP, pool_fee_percent=1.0))
+        reward = rng.randrange(1, 10**9)
+        base = {p.worker: p.amount
+                for p in calc.calculate_block(reward, shares).payouts}
+        for _ in range(3):
+            rng.shuffle(shares)
+            again = {p.worker: p.amount
+                     for p in calc.calculate_block(reward, shares).payouts}
+            assert again == base
+
+
+def test_remainder_tie_break_is_by_worker_name():
+    """Equal weights leave the whole remainder decision to the
+    tie-break: it must land on the lexicographically SMALLEST worker
+    name, for any insertion order of the weights dict."""
+    for names in (["b", "a", "c"], ["c", "b", "a"], ["a", "c", "b"]):
+        weights = {n: 1.0 for n in names}
+        out = _split_proportional(100, weights)
+        amounts = {p.worker: p.amount for p in out}
+        assert amounts == {"a": 34, "b": 33, "c": 33}
+    # ties only among the LARGEST weights matter (101 leaves remainder 1)
+    out = _split_proportional(101, {"z": 2.0, "m": 2.0, "a": 1.0})
+    amounts = {p.worker: p.amount for p in out}
+    assert sum(amounts.values()) == 101
+    assert amounts["m"] == amounts["z"] + 1  # remainder went to 'm', not 'z'
+
+
+def test_pps_and_fpps_credit_rates():
+    cfg = PayoutConfig(scheme=PayoutScheme.PPS, pps_rate_per_diff1=100.0,
+                       pool_fee_percent=2.0)
+    calc = PayoutCalculator(cfg)
+    assert calc.pps_credit(10.0) == int(10.0 * 100.0 * 0.98)
+    fpps = PayoutCalculator(PayoutConfig(
+        scheme=PayoutScheme.FPPS, pps_rate_per_diff1=100.0,
+        pool_fee_percent=2.0))
+    assert fpps.pps_credit(10.0) == int(10.0 * 100.0 * 1.02 * 0.98)
+    # PPLNS never PPS-credits
+    assert PayoutCalculator(PayoutConfig()).pps_credit(10.0) == 0
+
+
+def test_fee_distributor_exact_sum_property():
+    rng = random.Random(7)
+    for _ in range(N_CASES):
+        n = rng.randrange(1, 6)
+        cuts = [rng.random() for _ in range(n)]
+        total = sum(cuts)
+        splits = [FeeSplit(f"op{i}", 100.0 * c / total)
+                  for i, c in enumerate(cuts)]
+        # normalize the last split so the configured percents sum to 100
+        splits[-1] = FeeSplit(
+            splits[-1].recipient,
+            100.0 - sum(s.percent for s in splits[:-1]),
+        )
+        fee = rng.randrange(0, 10**7)
+        out = FeeDistributor(splits).distribute(fee)
+        assert sum(out.values()) == fee
