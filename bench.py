@@ -104,13 +104,14 @@ def bench_sha256d() -> dict:
         def timed(batch: int, iters: int) -> float:
             t0 = time.monotonic()
             for i in range(iters):
-                np.asarray(launch(batch, i * batch).stats)  # forced sync
+                np.asarray(launch(batch, i * batch))  # forced sync: the
+                # output IS the 2K+3-word winner buffer
             return (time.monotonic() - t0) / iters
 
         log("bench: compiling pallas kernel ...")
         t0 = time.monotonic()
-        np.asarray(launch(1 << 28, 0).stats)
-        np.asarray(launch(1 << 31, 0).stats)
+        np.asarray(launch(1 << 28, 0))
+        np.asarray(launch(1 << 31, 0))
         log(f"bench: compile+warmup {time.monotonic() - t0:.1f}s")
 
         # marginal rate: batch-size differencing cancels fixed dispatch cost
@@ -124,7 +125,7 @@ def bench_sha256d() -> dict:
         t0 = time.monotonic()
         outs = [launch(batch, i * batch) for i in range(N)]
         for o in outs:
-            np.asarray(o.stats)
+            np.asarray(o)
         dt = time.monotonic() - t0
         rate = N * batch / dt
         name = f"pallas-tpu(sub={sub},unroll={unroll})"
@@ -303,22 +304,188 @@ def bench_ethash() -> dict:
     }
 
 
-def bench_engine_path(algo: str = "sha256d",
-                      scrypt_tier: str = "pallas") -> dict:
-    """Effective rate through the LIVE mining pipeline (engine loop +
-    pipelined dispatch + share path), not a bare kernel loop — the number
-    the verdict's weak #2 asked for. Uses the same backend auto-selection
-    as production; ``--algo scrypt`` measures the slow-algorithm path
-    (max_batch clamping + per-chunk dispatch) instead of sha256d."""
+def _measure_engine(backend, window: float,
+                    batch_size: int | None = None,
+                    pipeline_depth: int | None = None) -> tuple[int, float]:
+    """Hashes moved through the LIVE engine loop on ``backend`` over a
+    ``window``-second measured interval (warmup batch excluded).
+    ``batch_size`` overrides the engine default — the CPU fallback needs
+    sub-second batches so the window covers many completion cycles
+    instead of one burst. ``pipeline_depth`` overrides the engine's
+    in-flight launch count (the CPU pod run needs 1: see the --pod
+    branch)."""
     import asyncio
-
-    import jax
 
     from otedama_tpu.engine.engine import EngineConfig, MiningEngine
     from otedama_tpu.engine.types import Job
 
+    cfg_kw = dict(worker_name="bench")
+    if batch_size is not None:
+        cfg_kw.update(batch_size=batch_size, auto_batch=False)
+    if pipeline_depth is not None:
+        cfg_kw.update(pipeline_depth=pipeline_depth)
+    cfg = EngineConfig(**cfg_kw)
+
+    async def run() -> tuple[int, float]:
+        engine = MiningEngine(
+            backends={backend.name: backend},
+            config=cfg,
+        )
+        # impossible-target job: measures pure search throughput
+        job = Job(
+            job_id="bench", prev_hash=b"\x07" * 32, coinb1=b"\x01",
+            coinb2=b"\x02", merkle_branch=[], version=0x20000000,
+            nbits=0x03000001, ntime=int(time.time()), clean=True,
+            share_target=0,
+        )
+        engine.set_job(job)
+        await engine.start()
+        # warmup: first launch includes compile; don't count it
+        while engine.stats.hashes == 0:
+            await asyncio.sleep(0.25)
+        # anchor the clock at an OBSERVED completion and stop it at the
+        # last one: batch completions arrive in pipeline-depth bursts, so
+        # an unanchored fixed window measures burst quantization, not the
+        # steady-state rate (completions per anchor->last interval)
+        h0 = engine.stats.hashes
+        while engine.stats.hashes == h0:
+            await asyncio.sleep(0.02)
+        h0 = engine.stats.hashes
+        t0 = time.monotonic()
+        last_h, last_t = h0, t0
+        while time.monotonic() - t0 < window:
+            await asyncio.sleep(0.05)
+            h = engine.stats.hashes
+            if h != last_h:
+                last_h, last_t = h, time.monotonic()
+        hashes = last_h - h0
+        dt = last_t - t0
+        await engine.stop()
+        return hashes, dt or 1e-9
+
+    return asyncio.run(run())
+
+
+def _planned_batch(backend, batch_size: int | None) -> int:
+    """The batch the engine hot loop would dispatch — the ENGINE'S OWN
+    ``planned_batch`` run against a config shim, so the bench can never
+    silently measure a different shape than production dispatches."""
+    import types
+
+    from otedama_tpu.engine.engine import EngineConfig, MiningEngine
+
+    cfg = (EngineConfig(worker_name="bench") if batch_size is None
+           else EngineConfig(worker_name="bench", batch_size=batch_size,
+                             auto_batch=False))
+    shim = types.SimpleNamespace(config=cfg)
+    return MiningEngine.planned_batch(shim, backend)
+
+
+def _measure_kernel_e2e(backend, window: float,
+                        batch_size: int | None = None) -> tuple[int, float]:
+    """Raw pipelined backend rate at the engine's planned batch: the same
+    launches the engine issues (search_group when the backend has one, up
+    to ``EngineConfig.pipeline_depth`` groups in flight), minus the engine
+    itself — job bookkeeping, asyncio loop, share path. The acceptance
+    ratio is ``engine_rate / this``: with on-device winner selection the
+    engine's per-batch host work is one fixed-size buffer transfer, so
+    the two must be within noise of each other."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from otedama_tpu.engine.engine import EngineConfig, MiningEngine
+    from otedama_tpu.runtime.search import synthetic_job_constants
+
+    cfg = EngineConfig(worker_name="bench")
+    batch = _planned_batch(backend, batch_size)
+    jc = synthetic_job_constants()
+    grouped = hasattr(backend, "search_group")
+    depth = max(1, cfg.pipeline_depth)
+    # mirror the engine's in-flight policy exactly: grouped backends get
+    # `depth` launches per call with 2 groups in flight (engine pend_cap);
+    # plain backends get `depth` concurrent single-launch calls
+    group = depth if grouped else 1
+    workers = min(2, depth) if grouped else depth
+
+    def launch(i: int) -> int:
+        unit = [(((i * group + g) * batch) & 0xFFFFFFFF, batch)
+                for g in range(group)]
+        if grouped:
+            for _ in backend.search_group(jc, unit):
+                pass
+        else:
+            backend.search(jc, unit[0][0], batch)
+        return group * batch
+
+    launch(0)  # compile + warmup, uncounted
+    # same completion-anchored clock as _measure_engine: rate = results
+    # AFTER the first counted completion over the anchor->last interval
+    hashes = 0
+    t_start = time.monotonic()
+    t_anchor = dt = None
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        pending = [pool.submit(launch, i) for i in range(1, workers + 1)]
+        i = workers + 1
+        while time.monotonic() - t_start < window:
+            done = pending.pop(0).result()
+            now = time.monotonic()
+            if t_anchor is None:
+                t_anchor = now
+            else:
+                hashes += done
+                dt = now - t_anchor
+            pending.append(pool.submit(launch, i))
+            i += 1
+        for f in pending:
+            f.result()  # drain in-flight work, uncounted
+    if dt is None:  # window shorter than two completions
+        return hashes, 1e-9
+    return hashes, dt
+
+
+class _NullBackend:
+    """Instant backend: the engine loop's own per-batch cost, isolated.
+
+    ``search`` returns an empty result with zero device work, so driving
+    the LIVE engine on it measures exactly the host-side bookkeeping the
+    engine wraps around each device call (unit construction, executor
+    round-trip, watchdog, stats, winner processing of an empty buffer).
+    That overhead is the only thing separating the engine rate from the
+    raw kernel-e2e rate — and unlike a wall-clock A/B on a time-shared
+    host, it does not drift with machine load."""
+
+    name = "null"
+    algorithm = "sha256d"
+
+    def search(self, jc, base, count):
+        from otedama_tpu.runtime.search import SearchResult
+
+        return SearchResult([], count, 0xFFFFFFFF)
+
+
+def _measure_engine_overhead(batch: int) -> float:
+    """Seconds of pure engine-loop work per batch (device time = 0)."""
+    n, dt = _measure_engine(_NullBackend(), 3.0, batch_size=batch)
+    return dt / max(1.0, n / batch)
+
+
+def bench_engine_path(algo: str = "sha256d", scrypt_tier: str = "pallas",
+                      pod: bool = False) -> dict:
+    """Effective rate through the LIVE mining pipeline (engine loop +
+    pipelined dispatch + share path), not a bare kernel loop — the number
+    the verdict's weak #2 asked for. Uses the same backend auto-selection
+    as production; ``--algo scrypt`` measures the slow-algorithm path
+    (max_batch clamping + per-chunk dispatch) instead of sha256d.
+
+    ``pod=True`` additionally drives the engine on a pod backend spanning
+    EVERY visible device (the shard_map SPMD program) and reports per-chip
+    rate and mesh-scaling efficiency vs the single-device run — the
+    multi-chip numbers ROADMAP item 2 asks the engine bench to carry.
+    """
+    import jax
+
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
+    n_devices = len(jax.devices())
     if algo == "scrypt":
         backend = _scrypt_backend(on_tpu, scrypt_tier)
         window = 20.0 if on_tpu else 8.0
@@ -335,36 +502,43 @@ def bench_engine_path(algo: str = "sha256d",
         from otedama_tpu.runtime.search import XlaBackend
 
         backend = XlaBackend(chunk=1 << 16)
-        window = 6.0
+        window = 36.0  # this branch is the off-TPU fallback
+    # CPU fallback: sub-second batches so every measurement slice covers
+    # dozens of completion cycles (a 2^22 batch takes ~10s of CPU — a
+    # short window would time one completion burst, not the steady
+    # state); TPU keeps the production engine sizing (auto_batch ->
+    # preferred_batch)
+    bench_batch = None if on_tpu else 1 << 17
     log(f"bench: engine-path on platform={platform} backend={backend.name}")
 
-    async def run() -> tuple[int, float]:
-        engine = MiningEngine(
-            backends={backend.name: backend},
-            config=EngineConfig(worker_name="bench"),
-        )
-        # impossible-target job: measures pure search throughput
-        job = Job(
-            job_id="bench", prev_hash=b"\x07" * 32, coinb1=b"\x01",
-            coinb2=b"\x02", merkle_branch=[], version=0x20000000,
-            nbits=0x03000001, ntime=int(time.time()), clean=True,
-            share_target=0,
-        )
-        engine.set_job(job)
-        await engine.start()
-        # warmup: first launch includes compile; don't count it
-        while engine.stats.hashes == 0:
-            await asyncio.sleep(0.25)
-        h0 = engine.stats.hashes
-        t0 = time.monotonic()
-        await asyncio.sleep(window)
-        hashes = engine.stats.hashes - h0
-        dt = time.monotonic() - t0
-        await engine.stop()
-        return hashes, dt
-
-    hashes, dt = asyncio.run(run())
+    if algo == "sha256d":
+        # engine vs kernel-e2e, INTERLEAVED in adjacent slice pairs: the
+        # two rates are measured minutes apart otherwise, and host load
+        # drift (shared CPU, thermal throttle) then dominates the ratio —
+        # the one number this comparison exists for. The reported ratio
+        # is the MEDIAN of the per-pair ratios: drift mostly cancels
+        # inside one back-to-back pair, and the median rejects a pair
+        # that caught a load spike
+        rounds = 3 if on_tpu else 5
+        e_h = e_dt = k_h = k_dt = 0.0
+        ratios = []
+        for _ in range(rounds):
+            eh, ed = _measure_engine(backend, window / rounds,
+                                     batch_size=bench_batch)
+            e_h, e_dt = e_h + eh, e_dt + ed
+            kh, kd = _measure_kernel_e2e(backend, window / rounds,
+                                         batch_size=bench_batch)
+            k_h, k_dt = k_h + kh, k_dt + kd
+            if eh and kh:
+                ratios.append((eh / ed) / (kh / kd))
+        hashes, dt = e_h, e_dt
+        k_hashes, k_dt = k_h, k_dt
+    else:
+        hashes, dt = _measure_engine(backend, window, batch_size=bench_batch)
     if algo == "scrypt":
+        if pod:
+            log("bench: --pod is only wired for the sha256d engine path; "
+                "skipping the mesh-scaling run")
         khs = hashes / dt / 1e3
         log(f"bench: engine-path {hashes} hashes in {dt:.2f}s -> "
             f"{khs:.2f} kH/s")
@@ -377,12 +551,90 @@ def bench_engine_path(algo: str = "sha256d",
         }
     ghs = hashes / dt / 1e9
     log(f"bench: engine-path {hashes} hashes in {dt:.2f}s -> {ghs:.3f} GH/s")
-    return {
+    # raw kernel-e2e on the SAME backend and shapes, measured interleaved
+    # with the engine slices above: the engine must sit within noise of it
+    # now that its per-batch host work is one winner-buffer transfer
+    if not k_hashes or not ratios:
+        # a contended/slow host can complete fewer than 2 launches per
+        # slice, leaving the anchored clock with nothing to measure —
+        # fail with a diagnosis, not a ZeroDivisionError deep in a format
+        # string (the fix is a longer window or a smaller batch)
+        raise SystemExit(
+            "bench: kernel-e2e window saw < 2 launch completions per "
+            "slice — host too contended for this batch/window; rerun "
+            "with the machine idle"
+        )
+    kghs = k_hashes / k_dt / 1e9
+    ratios.sort()
+    pct = 100 * ratios[len(ratios) // 2]
+    log(f"bench: kernel-e2e {k_hashes} hashes in {k_dt:.2f}s -> "
+        f"{kghs:.3f} GH/s (engine at {pct:.1f}%, pair ratios "
+        f"{[round(100 * r, 1) for r in ratios]})")
+    # the load-drift-immune version of the same ratio: per-batch device
+    # time (from the kernel-e2e rate) vs the engine loop's own per-batch
+    # cost measured on an instant null backend. Structural because both
+    # terms are per-batch costs, not wall-clock windows — and conservative
+    # because with pipeline_depth > 1 the engine's host work actually
+    # OVERLAPS device compute instead of adding to it
+    batch_used = _planned_batch(backend, bench_batch)
+    overhead_s = _measure_engine_overhead(batch_used)
+    device_s = batch_used / (k_hashes / k_dt)
+    structural_pct = 100 * device_s / (device_s + overhead_s)
+    log(f"bench: engine loop overhead {1e3 * overhead_s:.2f} ms/batch vs "
+        f"{device_s:.2f} s/batch device time -> structural engine rate "
+        f"{structural_pct:.2f}% of kernel-e2e")
+    out = {
         "metric": "sha256d_engine_path_ghs",
         "value": round(ghs, 4),
         "unit": "GH/s",
         "vs_baseline": round(ghs / BASELINE_GHS, 4),
+        "kernel_e2e_ghs": round(kghs, 4),
+        "engine_vs_kernel_pct": round(pct, 1),
+        "engine_vs_kernel_pair_pcts": [round(100 * r, 1) for r in ratios],
+        "engine_overhead_ms_per_batch": round(1e3 * overhead_s, 3),
+        "device_s_per_batch": round(device_s, 4),
+        "structural_engine_vs_kernel_pct": round(structural_pct, 2),
+        "per_chip_ghs": round(ghs, 4),  # single-device run: 1 chip
+        "devices": 1,
     }
+
+    if pod and n_devices > 1:
+        # mesh scaling: the SAME engine loop on a pod backend spanning
+        # every device (one SPMD program, compact winner buffers
+        # all-reduced/gathered on the interconnect)
+        from otedama_tpu.runtime.mesh import PodBackend, make_pod_mesh
+
+        n_hosts = 2 if n_devices % 2 == 0 else 1
+        pod_backend = PodBackend(
+            make_pod_mesh(jax.devices(), n_hosts=n_hosts)
+        )
+        log(f"bench: engine-path pod run on {pod_backend.name} "
+            "(compiling the SPMD step) ...")
+        # CPU multi-device: concurrent dispatches of one collective
+        # program from several engine pipeline threads cross-wait at the
+        # all-reduce rendezvous (run N's rank-0 pairs with run N+1's
+        # rank-1) and deadlock — XLA:CPU has no per-device launch stream.
+        # Depth 1 serializes dispatch; real TPU streams keep the default.
+        p_hashes, p_dt = _measure_engine(
+            pod_backend, window, batch_size=bench_batch,
+            pipeline_depth=None if on_tpu else 1,
+        )
+        p_ghs = p_hashes / p_dt / 1e9
+        out["pod"] = {
+            "backend": pod_backend.name,
+            "devices": n_devices,
+            "ghs": round(p_ghs, 4),
+            "per_chip_ghs": round(p_ghs / n_devices, 4),
+            # ideal scaling = single-device rate x devices
+            "scaling_efficiency": round(p_ghs / (ghs * n_devices), 4),
+        }
+        log(f"bench: pod {p_hashes} hashes in {p_dt:.2f}s -> "
+            f"{p_ghs:.3f} GH/s ({out['pod']['scaling_efficiency']:.1%} "
+            "scaling)")
+    elif pod:
+        log("bench: --pod requested but only one device is visible; "
+            "skipping the mesh-scaling run")
+    return out
 
 
 _PROBE_STATE = pathlib.Path(__file__).resolve().parent / ".bench_probe_state.json"
@@ -509,10 +761,29 @@ def main() -> None:
     ap.add_argument("--scrypt-tier", default="pallas",
                     choices=("pallas", "fused", "fused-half"),
                     help="scrypt kernel tier (fused = VMEM-resident ROMix)")
+    ap.add_argument("--pod", action="store_true",
+                    help="with --engine-path: also run the engine on a pod "
+                         "backend over every visible device and report "
+                         "per-chip rate + mesh-scaling efficiency")
+    ap.add_argument("--host-devices", type=int, default=None,
+                    help="force N virtual host (CPU) devices so --pod can "
+                         "measure mesh scaling off-TPU (sets "
+                         "xla_force_host_platform_device_count; must run "
+                         "before jax initializes — i.e. only via this flag)")
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON result to this path "
+                         "(BENCH_ENGINE_*.json artifacts)")
     args = ap.parse_args()
+    if args.host_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={args.host_devices}"
+            ).strip()
     fell_back = _guard_platform()
     if args.engine_path:
-        out = bench_engine_path(args.algo, args.scrypt_tier)
+        out = bench_engine_path(args.algo, args.scrypt_tier, pod=args.pod)
     elif args.algo == "x11":
         out = bench_x11(args.x11_backend, args.x11_chunk)
     elif args.algo == "scrypt":
@@ -528,6 +799,11 @@ def main() -> None:
             "fallback so a number exists at all — previously recorded "
             "device rates live in the committed BENCH_*_r03.json artifacts"
         )
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+            f.write("\n")
+        log(f"bench: result written to {args.out}")
     print(json.dumps(out))
 
 

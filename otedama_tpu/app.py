@@ -87,7 +87,34 @@ class Application:
         kwargs = {}
         if cfg.backend == "pod" and cfg.pod_hosts:
             kwargs["n_hosts"] = cfg.pod_hosts
+        if cfg.winner_depth:
+            # on-device winner-buffer depth; make_backend drops it for
+            # backends without a winner table
+            kwargs["winner_depth"] = cfg.winner_depth
+        else:
+            # 0 = auto: adopt the persisted tuner record here, not in the
+            # backends — PallasBackend consults it itself but the pod
+            # backends take the dataclass default, so resolving the auto
+            # value once at the app layer keeps every kind honoring the
+            # same record
+            from otedama_tpu.tuner import load_tuned
+
+            depth = (load_tuned() or {}).get("winner_depth")
+            if depth:
+                kwargs["winner_depth"] = int(depth)
         return kwargs
+
+    def _pipeline_depth(self) -> int:
+        """Engine pipeline depth: explicit config wins, else the persisted
+        tuner record (the knobs were measured together), else the engine
+        default."""
+        if self.config.mining.pipeline_depth:
+            return self.config.mining.pipeline_depth
+        from otedama_tpu.tuner import load_tuned
+
+        tuned = load_tuned() or {}
+        depth = tuned.get("pipeline_depth")
+        return int(depth) if depth else EngineConfig.pipeline_depth
 
     def _build_engine(self) -> MiningEngine:
         cfg = self.config.mining
@@ -100,6 +127,7 @@ class Application:
                 worker_name=cfg.worker_name,
                 algorithm=cfg.algorithm,
                 batch_size=cfg.batch_size,
+                pipeline_depth=self._pipeline_depth(),
                 drain_timeout=cfg.drain_timeout,
                 watchdog_multiplier=cfg.watchdog_multiplier,
                 watchdog_floor=cfg.watchdog_floor,

@@ -104,6 +104,19 @@ class MiningConfig:
     # comma list of algorithms warmed into the compile cache in the
     # background after startup — likely profit-switch targets; "" = none
     warm_algorithms: str = ""
+    # device winner-table depth K: slots in the fixed on-device winner
+    # buffer each kernel launch compacts its exact winners into (> K
+    # winners in one launch falls back to an exact rescan — test-easy
+    # targets only). 0 = auto: the persisted tuner record
+    # (tuner.load_tuned), else the kernel default (16). Fused multi-host
+    # pods always run the kernel default: every process of the
+    # multi-controller program must compile the same buffer shape, and
+    # followers never see this config
+    winner_depth: int = 0
+    # in-flight device launches per backend (engine double-buffering:
+    # batch N+1 dispatches while batch N's winner buffer transfers).
+    # 0 = auto: the persisted tuner record, else the engine default (3)
+    pipeline_depth: int = 0
     # -- device supervision (engine watchdog / quarantine / probes) ----------
     # bound on stop()/switch drains of in-flight device calls: calls
     # still running past it are abandoned so a wedged device can never
@@ -342,6 +355,12 @@ def validate_config(cfg: AppConfig) -> list[str]:
             errors.append(f"unknown warm algorithm {name!r}")
     if cfg.mining.batch_size <= 0 or cfg.mining.batch_size > (1 << 32):
         errors.append("mining.batch_size out of range")
+    if not (0 <= cfg.mining.winner_depth <= 1024):
+        # the winner buffer lives in SMEM: thousands of slots would blow
+        # the scalar-memory budget long before they could ever fill
+        errors.append("mining.winner_depth out of range (0 = auto, 1..1024)")
+    if not (0 <= cfg.mining.pipeline_depth <= 64):
+        errors.append("mining.pipeline_depth out of range (0 = auto, 1..64)")
     if cfg.mining.drain_timeout <= 0:
         errors.append("mining.drain_timeout must be positive")
     if cfg.mining.watchdog_floor <= 0:
@@ -403,6 +422,8 @@ mining:
   compile_cache_dir: ""  # persistent XLA compile cache (empty = off)
   precompile: true       # AOT-compile the active algorithm at startup
   warm_algorithms: ""    # e.g. "scrypt,ethash": pre-cache switch targets
+  winner_depth: 0        # on-device winner-buffer slots K (0 = auto/tuned)
+  pipeline_depth: 0      # in-flight device launches per backend (0 = auto)
   drain_timeout: 30.0    # abandon in-flight device calls past this on stop/switch
   watchdog_multiplier: 8.0   # deadline = call-duration EWMA x this (<=0 = off)
   watchdog_floor: 5.0        # minimum watchdog deadline, seconds

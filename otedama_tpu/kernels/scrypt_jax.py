@@ -212,10 +212,12 @@ def scrypt_1024_1_1(header_words, nonces, *, rolled: bool = True,
 @functools.partial(jax.jit, static_argnames=("n", "rolled", "blockmix"))
 def scrypt_search_step(header19, base, limbs8, *, n: int, rolled: bool = True,
                        blockmix: str = "xla"):
-    """Jittable scrypt nonce-search step.
+    """Jittable scrypt nonce-search step (dense outputs).
 
     ``header19``: uint32[19] array; ``base``: uint32 scalar; ``limbs8``:
     uint32[8] target limbs most-significant-first. Returns ``(hits, h0)``.
+    The hot path uses ``scrypt_search_winners`` (O(k) transfer); this dense
+    variant remains the winner-table-overflow fallback and oracle.
     """
     nonces = base + jax.lax.iota(jnp.uint32, n)
     d = scrypt_1024_1_1(
@@ -225,6 +227,27 @@ def scrypt_search_step(header19, base, limbs8, *, n: int, rolled: bool = True,
     h = sj.digest_words_to_compare_order(d)
     hits = sj.le256(h, tuple(limbs8[i] for i in range(8)))
     return hits, h[0]
+
+
+@functools.partial(jax.jit, static_argnames=("n", "k", "rolled", "blockmix"))
+def scrypt_search_winners(header19, base, limbs8, last, *, n: int, k: int,
+                          rolled: bool = True, blockmix: str = "xla"):
+    """Scrypt search step with on-device winner compaction: the exact
+    256-bit compare and the range clamp (lane offsets > ``last`` are
+    overscan) happen on device, and the host reads ONE ``uint32[2k+3]``
+    winner buffer per chunk (``sha256_pallas.unpack_winner_buffer``) — the
+    scrypt twin of the fused sha256d kernel's output contract."""
+    nonces = base + jax.lax.iota(jnp.uint32, n)
+    d = scrypt_1024_1_1(
+        tuple(header19[i] for i in range(19)), nonces, rolled=rolled,
+        blockmix=blockmix,
+    )
+    h = sj.digest_words_to_compare_order(d)
+    offs = jax.lax.iota(jnp.uint32, n)
+    rng = offs <= last
+    hits = sj.le256(h, tuple(limbs8[i] for i in range(8))) & rng
+    h0m = jnp.where(rng, h[0], _U32(0xFFFFFFFF))
+    return sj.compact_winners(hits, h0m, nonces, k)
 
 
 def scrypt_digest_host(header80: bytes) -> bytes:

@@ -33,6 +33,14 @@ scoped VMEM at 52.65 MiB):
 Reference for the scrypt parameters: internal/mining/multi_algorithm.go:
 100-140 (N=1024, r=1, p=1). The Salsa20 double-round is imported from
 ``scrypt_jax`` — one definition, two execution tiers.
+
+Winner selection is NOT this module's job: whichever BlockMix tier is
+active, ``scrypt_jax.scrypt_search_winners`` wraps the pipeline with the
+exact on-device 256-bit compare, lane-granular range clamp, and compact
+K-slot winner-buffer output (``sha256_pallas.unpack_winner_buffer``
+layout) — the scrypt twin of the fused sha256d kernel's contract, fused
+into the same XLA program as the final PBKDF2 so no per-lane digest ever
+reaches the host.
 """
 
 from __future__ import annotations

@@ -203,6 +203,37 @@ def le256(h, t):
     return le
 
 
+def compact_winners(hits, h0_masked, nonces, k: int):
+    """Compact a dense hit mask into the fixed-size winner buffer the
+    Pallas kernel emits (``sha256_pallas.unpack_winner_buffer`` layout:
+    ``uint32[2k+3] = [win_nonce[k] | win_limb[k] | n, 0, min_h0]``).
+
+    The jnp twin of the in-kernel winner compaction, shared by the CPU-mesh
+    pod step and the scrypt winner step so every execution tier ships the
+    SAME O(k) buffer. ``hits`` must already be masked to the in-range
+    window; ``h0_masked`` is the top compare limb with out-of-range lanes
+    set to 0xFFFFFFFF (so the min is exact over the requested window). The
+    first k winners in nonce-position order fill the table; a true count
+    past k is the caller's overflow signal.
+    """
+    n = hits.size
+    idx = jnp.arange(n, dtype=jnp.uint32)
+    sel = jnp.where(hits, idx, _U32(0xFFFFFFFF))
+    if n < k:
+        sel = jnp.pad(sel, (0, k - n), constant_values=np.uint32(0xFFFFFFFF))
+    order = jnp.sort(sel)[:k]
+    take = jnp.clip(order, 0, n - 1).astype(jnp.int32)
+    win_nonce = jnp.where(order != _U32(0xFFFFFFFF), nonces[take], _U32(0))
+    win_limb = jnp.where(order != _U32(0xFFFFFFFF), h0_masked[take],
+                         _U32(0xFFFFFFFF))
+    stats = jnp.stack([
+        jnp.sum(hits.astype(jnp.uint32)),
+        _U32(0),
+        jnp.min(h0_masked),
+    ])
+    return jnp.concatenate([win_nonce, win_limb, stats])
+
+
 def sha256d_search(midstate, tail, nonces, target_limbs):
     """The jittable inner search step: hash a nonce block, flag winners.
 

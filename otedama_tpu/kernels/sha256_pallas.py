@@ -10,7 +10,7 @@ variant). TPU-first redesign rather than a translation:
   covers an arbitrarily large batch (up to the full 2^32 space) with O(1)
   output — the key to amortizing host→device dispatch overhead (~0.2 s on
   the tunneled platform) down to nothing;
-- CUDA's ``atomicAdd`` winner list becomes a K-slot SMEM hit-tile table plus
+- CUDA's ``atomicAdd`` winner list becomes a K-slot SMEM winner buffer plus
   running scalar stats, maintained across grid steps on the scalar core.
   The hot loop's only bookkeeping is one branch-free min-reduce per tile
   stored to SMEM (no VPU→scalar control dependency — hit checks run as a
@@ -25,11 +25,17 @@ variant). TPU-first redesign rather than a translation:
   only needs digest word 7 = IV[7] + e-produced-by-round-60, so rounds
   57-59 shed their a-chain and rounds 61-63 vanish entirely.
 
-The kernel's target check is a *filter* on the top compare limb
-(``H0 <= T0``): winners are candidates that the runtime re-validates exactly
-(jnp ``le256`` path / host python). This mirrors how real GPU miners check a
-hash prefix on-device and verify on host, and keeps the hot loop at 1 vector
-compare instead of a full 256-bit lexicographic chain.
+The winner decision is EXACT and fully on-device: the hot loop filters tiles
+on the top compare limb (``min H0 <= T0`` — no false negatives, since a
+lexicographic ``H <= T`` forces ``H0 <= T0``), and a flagged tile — rare
+enough at production difficulty to cost nothing — is escalated in-kernel to
+the full 256-bit lexicographic compare against all 8 target limbs, with the
+winning lanes compacted into a fixed K-slot ``(nonce_word, top-limb)`` table
+clamped to the requested in-range window. The host's per-launch work is ONE
+fixed-size SMEM buffer transfer (``2K+3`` words); it never re-hashes a tile
+and never trims overscan. More winners than K slots sets the count past K
+(the overflow signal) and callers fall back to an exact rescan — the only
+remaining host-side scan path, reachable only at test-easy targets.
 
 Off-TPU the kernel runs in Pallas interpret mode (slow — tests keep batches
 tiny); the jnp path in ``sha256_jax`` is the exactness oracle.
@@ -38,7 +44,6 @@ tiny); the jnp path in ``sha256_jax`` is the exactness oracle.
 from __future__ import annotations
 
 import functools
-import typing
 
 import jax
 import jax.numpy as jnp
@@ -52,26 +57,53 @@ _U32 = jnp.uint32
 NO_WINNER = np.uint32(0xFFFFFFFF)
 _M32 = 0xFFFFFFFF
 
-# job_words layout (uint32[20], SMEM scalar-prefetch):
+# job_words layout (uint32[22], SMEM scalar-prefetch):
 #   [0:8]  midstate of header[0:64]
 #   [8:11] header words 16..18 (merkle tail, ntime, nbits)
 #   [11]   nonce base for this launch
 #   [12:20] target limbs, most-significant-first (limb 0 is the filter limb)
-JOB_WORDS = 20
+#   [20]   last in-range launch offset (count-1): lanes past it are overscan
+#          and excluded from winners AND telemetry in-kernel
+#   [21]   empty flag: 1 = no lane of this launch is in range (pod chips
+#          wholly past the requested window; count-1 cannot encode "none")
+JOB_WORDS = 22
 
-# winner-table depth: per-launch candidate hits beyond this overflow into
-# `stats[0] > K_WINNERS`, which callers resolve with an exact rescan. At
-# production difficulty a 2^30 batch sees ~0-1 filter hits, so K=16 is deep.
+# default winner-table depth: per-launch exact winners beyond this overflow
+# into `n_winners > k`, which callers resolve with an exact rescan. At
+# production difficulty a 2^30 batch sees ~0-1 hits, so K=16 is deep.
+# Tunable per backend (PallasBackend winner_depth / mining.winner_depth).
 K_WINNERS = 16
 
 
-def pack_job_words(midstate, tail, nonce_base, target_limbs) -> np.ndarray:
+def pack_job_words(midstate, tail, nonce_base, target_limbs,
+                   count: int | None = None) -> np.ndarray:
+    """``count`` = in-range lanes of the launch (clamped in-kernel); None
+    means the whole launch is in range, 0 means none of it is."""
     out = np.zeros((JOB_WORDS,), dtype=np.uint32)
     out[0:8] = np.asarray(midstate, dtype=np.uint64).astype(np.uint32)
     out[8:11] = np.asarray(tail, dtype=np.uint64).astype(np.uint32)
     out[11] = np.uint32(nonce_base & _M32)
     out[12:20] = np.asarray(target_limbs, dtype=np.uint32)
+    if count is None:
+        out[20] = np.uint32(_M32)  # off <= 0xFFFFFFFF: everything in range
+    elif count <= 0:
+        out[21] = np.uint32(1)
+    else:
+        out[20] = np.uint32((count - 1) & _M32)
     return out
+
+
+def winner_buffer_words(k: int) -> int:
+    """One launch's output: k nonces, k top limbs, [n_winners, 0, min_h0]."""
+    return 2 * k + 3
+
+
+def unpack_winner_buffer(buf, k: int):
+    """Split one transferred winner buffer (numpy uint32[2k+3]) into
+    ``(win_nonce[k], win_limb[k], n_winners, min_hash_hi)``. ``n_winners``
+    past ``k`` means the table overflowed and the caller must rescan."""
+    buf = np.asarray(buf)
+    return buf[:k], buf[k:2 * k], int(buf[2 * k]), int(buf[2 * k + 2])
 
 
 # ---------------------------------------------------------------------------
@@ -228,6 +260,12 @@ def _umin_s(a, b):
     return jnp.where(fa < fb, a, b)
 
 
+def _flip(x):
+    """uint32 -> order-isomorphic int32 (unsigned compares lower as signed
+    ones after the sign-bit xor). Works on scalars and vectors alike."""
+    return (x ^ _U32(0x80000000)).astype(jnp.int32)
+
+
 def sha256d_word7(midstate, tail, nonces):
     """sha256d of an 80-byte header, returning only big-endian digest word 7
     (the word holding the most-significant bytes of the little-endian hash
@@ -239,60 +277,66 @@ def sha256d_word7(midstate, tail, nonces):
     return compress_pe(tuple(int(v) for v in SHA256_IV), w2, truncate_to_word7=True)
 
 
-class PallasSearchOut(typing.NamedTuple):
-    """One launch's result: a K-deep hit-tile table plus running stats.
-
-    The kernel flags *tiles* whose min hash passes the filter; the caller
-    re-scans each flagged tile exactly (a tile is only ``sub*128`` nonces).
-    ``stats = [n_hit_tiles, 0, min_hash_hi]``. If ``n_hit_tiles`` exceeds
-    ``K_WINNERS`` the table overflowed (astronomically unlikely at
-    production difficulty) and callers must rescan the whole batch.
-    """
-
-    win_tile: jax.Array   # uint32[K] tile index of each flagged tile
-    win_min: jax.Array    # uint32[K] that tile's min compare limb
-    stats: jax.Array      # uint32[3]
+def sha256d_words(midstate, tail, nonces):
+    """Full 8-word sha256d digest (big-endian words) through the same
+    partial evaluator — the escalation path of the exact in-kernel winner
+    decision (rare: only runs for tiles whose min top limb passes the
+    filter). Accepts python ints for host-level verification of the exact
+    trace the kernel runs."""
+    w1 = [tail[0], tail[1], tail[2], nonces,
+          0x80000000, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 640]
+    d = compress_pe(tuple(midstate), w1)
+    w2 = list(d) + [0x80000000, 0, 0, 0, 0, 0, 0, 256]
+    return compress_pe(tuple(int(v) for v in SHA256_IV), w2)
 
 
-def _search_kernel(job_ref, wt_ref, wm_ref, st_ref, mins_ref, *, sub: int,
-                   inner: int, unroll: int):
+def _search_kernel(job_ref, out_ref, mins_ref, *, sub: int, inner: int,
+                   unroll: int, k: int):
     tile = sub * 128
     step = pl.program_id(0)
 
     @pl.when(step == 0)
     def _init():
-        for i in range(K_WINNERS):
-            wt_ref[i] = _U32(0)
-            wm_ref[i] = _U32(NO_WINNER)
-        st_ref[0] = _U32(0)
-        st_ref[1] = _U32(0)
-        st_ref[2] = _U32(NO_WINNER)
+        for i in range(k):
+            out_ref[i] = _U32(0)
+            out_ref[k + i] = _U32(NO_WINNER)
+        out_ref[2 * k] = _U32(0)          # n_winners (exact, in-range)
+        out_ref[2 * k + 1] = _U32(0)      # reserved
+        out_ref[2 * k + 2] = _U32(NO_WINNER)  # min top limb, in-range lanes
 
     midstate = tuple(job_ref[i] for i in range(8))
     tail = (job_ref[8], job_ref[9], job_ref[10])
-    t0_limb = job_ref[12]
     nonce0 = job_ref[11]
+    t0_f = _flip(job_ref[12])
+    last_f = _flip(job_ref[20])    # last in-range launch offset
+    not_empty = job_ref[21] == _U32(0)
 
     lanes = (
         jax.lax.broadcasted_iota(_U32, (sub, 128), 0) * _U32(128)
         + jax.lax.broadcasted_iota(_U32, (sub, 128), 1)
     )
 
+    def in_range(tile_off):
+        """Per-lane range mask: launch offset <= last, unless empty."""
+        return (_flip(tile_off + lanes) <= last_f) & not_empty
+
     def one_tile(i):
         tile_idx = (step * inner + i).astype(_U32)
-        base = nonce0 + tile_idx * _U32(tile)
-        nonces = base + lanes
+        tile_off = tile_idx * _U32(tile)
+        nonces = nonce0 + tile_off + lanes
 
         d7 = sha256d_word7(midstate, tail, nonces)
         h0 = _bswap32(d7)
 
-        # the hot loop's ONLY bookkeeping: one min-reduce, stored to SMEM
-        # with no branch and no scalar-core control dependency — the VPU
-        # pipeline never stalls on hit checks. Hit detection and the winner
-        # table happen in a scalar-core scan over the stored mins at step
-        # end; flagged tiles are re-scanned exactly by the host (a tile is
-        # only `sub*128` hashes).
-        mins_ref[i] = _umin(h0)
+        # the hot loop's ONLY bookkeeping: one masked min-reduce, stored to
+        # SMEM with no branch and no scalar-core control dependency — the
+        # VPU pipeline never stalls on hit checks. Out-of-range (overscan)
+        # lanes are masked to the sentinel here, so tile flagging AND the
+        # min-hash telemetry are exact over the requested window. Hit
+        # detection and the winner table happen in a scalar-core scan over
+        # the stored mins at step end.
+        mins_ref[i] = _umin(jnp.where(in_range(tile_off), h0,
+                                      _U32(NO_WINNER)))
 
     def body(j, _):
         # `unroll` independent tiles per loop iteration: amortizes loop
@@ -303,54 +347,87 @@ def _search_kernel(job_ref, wt_ref, wm_ref, st_ref, mins_ref, *, sub: int,
 
     jax.lax.fori_loop(0, inner // unroll, body, 0)
 
+    tl_f = tuple(_flip(job_ref[12 + j]) for j in range(8))
+
     def scan(i, mh):
         tm = mins_ref[i]
         mh = _umin_s(mh, tm)
 
-        @pl.when(_umin_s(tm, t0_limb) == tm)  # tm <= t0 unsigned
-        def _record():
-            idx = st_ref[0]
-            slot = jnp.minimum(idx, _U32(K_WINNERS - 1)).astype(jnp.int32)
-            wt_ref[slot] = (step * inner + i).astype(_U32)
-            wm_ref[slot] = tm
-            st_ref[0] = idx + _U32(1)
+        @pl.when(_flip(tm) <= t0_f)  # tile min <= T0: candidate tile
+        def _escalate():
+            # exact 256-bit winner decision, fully on-device. A flagged
+            # tile is rare (production difficulty: ~0-1 per 2^30 batch),
+            # so re-hashing it with the untruncated tail and walking the
+            # full lexicographic limb chain costs nothing amortized —
+            # and the host never rescans anything.
+            tile_idx = (step * inner + i).astype(_U32)
+            tile_off = tile_idx * _U32(tile)
+            base = nonce0 + tile_off
+            nonces = base + lanes
+            d = sha256d_words(midstate, tail, nonces)
+            h_f = tuple(_flip(_bswap32(d[7 - j])) for j in range(8))
+            le = h_f[7] <= tl_f[7]
+            for j in range(6, -1, -1):
+                le = (h_f[j] < tl_f[j]) | ((h_f[j] == tl_f[j]) & le)
+            win = le & in_range(tile_off)
+
+            n_hit = jnp.sum(win.astype(jnp.int32)).astype(_U32)
+            idx0 = out_ref[2 * k]
+            out_ref[2 * k] = idx0 + n_hit  # true count: > k flags overflow
+
+            # compact the (typically single) winning lanes into the K-slot
+            # table: iterated masked min-reduce over the lane index map —
+            # deterministic nonce order, no scatter, no atomics
+            h0 = _bswap32(d[7])
+
+            def extract(s, cand):
+                m = _umin(cand)
+
+                @pl.when(m != _U32(NO_WINNER))
+                def _record():
+                    slot = jnp.minimum(
+                        idx0 + s.astype(_U32), _U32(k - 1)
+                    ).astype(jnp.int32)
+                    out_ref[slot] = base + m
+                    out_ref[k + slot] = _umin(
+                        jnp.where(lanes == m, h0, _U32(NO_WINNER))
+                    )
+
+                return jnp.where(cand == m, _U32(NO_WINNER), cand)
+
+            jax.lax.fori_loop(
+                0, k, extract, jnp.where(win, lanes, _U32(NO_WINNER))
+            )
 
         return mh
 
-    st_ref[2] = jax.lax.fori_loop(0, inner, scan, st_ref[2])
+    out_ref[2 * k + 2] = jax.lax.fori_loop(0, inner, scan,
+                                           out_ref[2 * k + 2])
 
 
 @functools.partial(
-    jax.jit, static_argnames=("num_tiles", "sub", "inner", "unroll",
+    jax.jit, static_argnames=("num_tiles", "sub", "inner", "unroll", "k",
                               "interpret")
 )
 def _search_call(job_words, *, num_tiles: int, sub: int, inner: int,
-                 unroll: int, interpret: bool):
+                 unroll: int, k: int, interpret: bool):
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(num_tiles // inner,),
         in_specs=[],
-        out_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-        ],
+        out_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)],
         scratch_shapes=[pltpu.SMEM((inner,), jnp.uint32)],
     )
     kernel = functools.partial(_search_kernel, sub=sub, inner=inner,
-                               unroll=unroll)
-    return PallasSearchOut(
-        *pl.pallas_call(
-            kernel,
-            grid_spec=grid_spec,
-            out_shape=[
-                jax.ShapeDtypeStruct((K_WINNERS,), jnp.uint32),
-                jax.ShapeDtypeStruct((K_WINNERS,), jnp.uint32),
-                jax.ShapeDtypeStruct((3,), jnp.uint32),
-            ],
-            interpret=interpret,
-        )(job_words)
-    )
+                               unroll=unroll, k=k)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((winner_buffer_words(k),), jnp.uint32),
+        ],
+        interpret=interpret,
+    )(job_words)[0]
 
 
 def _on_tpu() -> bool:
@@ -366,20 +443,27 @@ def sha256d_pallas_search(
     sub: int = 32,
     inner: int | None = None,
     unroll: int = 4,
+    k: int | None = None,
     interpret: bool | None = None,
-) -> PallasSearchOut:
+) -> jax.Array:
     """Search ``batch`` nonces starting at ``job_words[11]`` in ONE launch.
 
     ``batch`` must be a multiple of ``tile = sub*128``; tiles are walked by a
-    grid × in-kernel loop, carrying the winner table and stats in SMEM, so
+    grid × in-kernel loop, carrying the winner buffer and stats in SMEM, so
     output size is independent of ``batch`` — callers should use large
     batches (2^28..2^30) to amortize dispatch. ``inner`` tiles run per grid
     step (default: ~2^24 nonces per step); ``unroll`` independent tiles are
-    traced per loop iteration.
+    traced per loop iteration; ``k`` is the winner-table depth.
+
+    Returns the ``uint32[2k+3]`` winner buffer (``unpack_winner_buffer``):
+    exact in-range winners, their top limbs, the true winner count, and the
+    in-range min top limb — the launch's ONE host transfer.
     """
     tile = sub * 128
     if batch % tile:
         raise ValueError(f"batch {batch} not a multiple of tile {tile}")
+    if k is None:
+        k = K_WINNERS
     num_tiles = batch // tile
     if inner is None:
         inner = min(num_tiles, max(1, (1 << 24) // tile))
@@ -392,5 +476,5 @@ def sha256d_pallas_search(
     job_words = jnp.asarray(job_words, dtype=jnp.uint32)
     return _search_call(
         job_words, num_tiles=num_tiles, sub=sub, inner=inner, unroll=unroll,
-        interpret=interpret,
+        k=k, interpret=interpret,
     )

@@ -14,9 +14,10 @@ The three disciplines ``dcn.py`` names, and how this module implements
 them:
 
 - **multi-controller input discipline**: every process passes IDENTICAL
-  host (numpy) values into the jitted step; winner tables are
-  all-gathered ON DEVICE (``PodSearch(multiprocess=True)``) so outputs
-  come back fully replicated and every process's host-side winner
+  host (numpy) values into the jitted step; the compact K-slot winner
+  buffers (exact, range-clamped on device) are all-gathered ON DEVICE
+  (``PodSearch(multiprocess=True)``) so outputs come back fully
+  replicated and every process's O(K)-per-chip host-side winner
   extraction sees the same bytes;
 - **lockstep job dispatch**: every ``step()`` begins with
   ``broadcast_one_to_all`` of the leader's (generation, jobs, window)

@@ -18,10 +18,13 @@ design collapses both into one SPMD program over a 2D ``(host, chip)`` mesh:
   ``engine.jobs.job_constants``), stacked and sharded along ``host``;
 - per-chip telemetry reduces over **ICI** (``psum``/``pmin`` across both
   axes) inside the compiled step, so the pod reports one aggregate best
-  hash / flag count — the BASELINE north star of the pod surfacing as a
+  hash / winner count — the BASELINE north star of the pod surfacing as a
   single worker;
-- winner recovery mirrors the single-chip driver: the device flags *tiles*,
-  the host re-scans each flagged tile exactly against that row's job.
+- winner recovery mirrors the single-chip driver: every chip decides its
+  winners EXACTLY on device (full 256-bit compare, range clamp in-kernel)
+  and emits one compact K-slot winner buffer; the host (and in fused
+  multi-controller mode, EVERY host, via an on-device all-gather of the
+  tiny tables) does O(K) extraction — no rescans, no overscan trimming.
 
 ``PodBackend`` adapts this to the engine's backend protocol: it advertises
 ``en2_fanout = n_hosts`` so the engine rolls that many extranonce2 spaces
@@ -34,6 +37,7 @@ from otedama_tpu.utils import jaxcompat
 
 import dataclasses
 import functools
+import logging
 
 import jax
 import jax.numpy as jnp
@@ -53,9 +57,11 @@ from otedama_tpu.runtime.search import (
     XlaBackend,
 )
 
+log = logging.getLogger("otedama.runtime.mesh")
+
 NO_WINNER = np.uint32(0xFFFFFFFF)
 _SIGN = np.uint32(0x80000000)
-K = sp.K_WINNERS
+K = sp.K_WINNERS  # default winner-table depth (PodSearch.winner_depth)
 
 
 def _flip(x):
@@ -67,11 +73,65 @@ def _unflip(x):
     return x.astype(jnp.uint32) ^ jnp.uint32(_SIGN)
 
 
-def _local_tiles_jnp(midstate8, tail3, t0_limb, base, *, batch: int,
-                     tile: int, rolled: bool):
-    """Exact jnp search with the same flagged-tile contract as the Pallas
-    kernel: returns ``(win_tile[K], win_min[K], stats[3])`` where stats =
-    [n_flagged_tiles, 0, min_hash_hi]."""
+def _chip_windows(n_chips: int, per_chip: int, count: int):
+    """Per-chip in-range window: chip c owns launch offsets
+    [0, chip_count), chip_count = clamp(count - c*per_chip). The kernel
+    (and its jnp twin) applies the clamp LANE-granularly, so winners and
+    telemetry are exact over [base, base+count) with no host-side
+    trimming. Returns ``(lasts, empties)`` uint32 arrays (last in-range
+    offset per chip; 1 where no lane of the chip is in range)."""
+    lasts = np.zeros((n_chips,), dtype=np.uint32)
+    empties = np.zeros((n_chips,), dtype=np.uint32)
+    for c in range(n_chips):
+        chip_count = min(per_chip, count - c * per_chip)
+        if chip_count <= 0:
+            empties[c] = 1
+        else:
+            lasts[c] = chip_count - 1
+    return lasts, empties
+
+
+def _extract_row_winners(buf_row, k: int, base: int, per_chip: int,
+                         lasts, empties, target: int, digest_fn, rescan,
+                         what: str):
+    """One row's host-side winner extraction from per-chip compact winner
+    buffers — O(k) per chip, shared by the sha256d and scrypt pods so the
+    overflow and verification semantics cannot diverge. ``digest_fn``
+    materializes a winner's digest bytes; ``rescan(chip_base, count)`` is
+    the k-overflow fallback (> k exact winners on one chip — test-easy
+    targets only), scoped to THAT chip's in-range window so no other chip
+    pays anything. Returns ``(winners, row_best)``."""
+    winners: list[Winner] = []
+    row_best = 0xFFFFFFFF
+    for c in range(len(lasts)):
+        wn, _, n, min_hash = sp.unpack_winner_buffer(buf_row[c], k)
+        row_best = min(row_best, min_hash)
+        if empties[c]:
+            continue
+        if n > k:
+            chip_base = (base + c * per_chip) & 0xFFFFFFFF
+            winners.extend(rescan(chip_base, int(lasts[c]) + 1).winners)
+            continue
+        for s in range(n):
+            w = int(wn[s])
+            digest = digest_fn(w)
+            if not tgt.hash_meets_target(digest, target):
+                # the device decision is exact: a host-side miss means
+                # the DEVICE produced a wrong winner
+                log.error(
+                    "%s winner %#010x failed host verification (chip %d)"
+                    " — device result corrupt?", what, w, c,
+                )
+                continue
+            winners.append(Winner(w, digest))
+    return winners, row_best
+
+
+def _local_winners_jnp(midstate8, tail3, limbs8, base, last, empty, *,
+                       batch: int, k: int, rolled: bool):
+    """Exact jnp search with the same compact winner-buffer contract as the
+    Pallas kernel: one ``uint32[2k+3]`` buffer of in-range 256-bit-exact
+    winners (``sha256_pallas.unpack_winner_buffer`` layout)."""
     nonces = base + jax.lax.iota(jnp.uint32, batch)
     d = sj.sha256d_from_midstate(
         tuple(midstate8[i] for i in range(8)),
@@ -80,36 +140,27 @@ def _local_tiles_jnp(midstate8, tail3, t0_limb, base, *, batch: int,
         rolled=rolled,
     )
     h = sj.digest_words_to_compare_order(d)
-    mins = _flip(h[0]).reshape(batch // tile, tile).min(axis=1)
-    flags = mins <= _flip(t0_limb)
-    n = jnp.sum(flags.astype(jnp.uint32))
-    masked = jnp.where(flags, mins, jnp.int32(np.int32(0x7FFFFFFF)))
-    if masked.shape[0] < K:  # fewer tiles than table slots: pad
-        masked = jnp.pad(
-            masked, (0, K - masked.shape[0]),
-            constant_values=np.int32(0x7FFFFFFF),
-        )
-    order = jnp.argsort(masked)[:K]
-    return (
-        order.astype(jnp.uint32),
-        _unflip(masked[order]),
-        jnp.stack([n, jnp.uint32(0), _unflip(jnp.min(mins))]),
-    )
+    offs = jax.lax.iota(jnp.uint32, batch)
+    rng = (offs <= last) & (empty == jnp.uint32(0))
+    hits = sj.le256(h, tuple(limbs8[i] for i in range(8))) & rng
+    h0m = jnp.where(rng, h[0], jnp.uint32(NO_WINNER))
+    return sj.compact_winners(hits, h0m, nonces, k)
 
 
-def _local_tiles_pallas(midstate8, tail3, limbs8, base, *, batch: int,
-                        sub: int):
+def _local_winners_pallas(midstate8, tail3, limbs8, base, last, empty, *,
+                          batch: int, sub: int, k: int):
     """TPU per-chip local: the production Pallas kernel under shard_map."""
     job_words = jnp.concatenate([
         midstate8.astype(jnp.uint32),
         tail3.astype(jnp.uint32),
         base[None].astype(jnp.uint32),
         limbs8.astype(jnp.uint32),
+        last[None].astype(jnp.uint32),
+        empty[None].astype(jnp.uint32),
     ])
-    out = sp.sha256d_pallas_search(
-        job_words, batch=batch, sub=sub, interpret=False
+    return sp.sha256d_pallas_search(
+        job_words, batch=batch, sub=sub, k=k, interpret=False
     )
-    return out.win_tile, out.win_min, out.stats
 
 
 def make_pod_mesh(devices=None, n_hosts: int = 1) -> Mesh:
@@ -156,11 +207,12 @@ class PodSearch:
 
     mesh: Mesh
     sub: int = 32               # Pallas tile second-minor (TPU path)
-    jnp_tile: int = 1024        # flagged-tile granularity (CPU/jnp path)
+    jnp_tile: int = 1024        # per-chip batch rounding (CPU/jnp path)
     use_pallas: bool | None = None  # None = pallas iff running on TPU
     rolled: bool | None = None      # jnp path: rolled rounds off-TPU
+    winner_depth: int = K       # K-slot winner buffer per chip
     multiprocess: bool = False  # fused multi-controller mode (runtime.fused):
-    # winner tables are all-gathered on device so every process reads
+    # winner buffers are all-gathered on device so every process reads
     # identical REPLICATED outputs — multi-controller jax cannot np.asarray
     # a host-sharded output, and replicated results keep every process's
     # host-side winner extraction in lockstep
@@ -171,6 +223,9 @@ class PodSearch:
         )
         if self.multiprocess and len(self._axes) != 2:
             raise ValueError("multiprocess PodSearch needs a (host, chip) mesh")
+        if self.winner_depth < 1:
+            raise ValueError(
+                f"winner_depth must be >= 1, got {self.winner_depth}")
         if self.use_pallas is None or self.rolled is None:
             from otedama_tpu.utils.platform_probe import safe_default_backend
 
@@ -181,7 +236,13 @@ class PodSearch:
                 self.rolled = not on_tpu
         self.tile = self.sub * 128 if self.use_pallas else self.jnp_tile
         self._steps: dict[int, callable] = {}
-        self._rescan = XlaBackend(chunk=min(max(self.tile, 1 << 10), 1 << 14))
+        # tiny-window shortcut (count below one chip's tile): exact host
+        # oracle instead of an SPMD dispatch whose lanes would be mostly
+        # overscan — cold path, never the hot loop
+        self._host_exact = XlaBackend(
+            chunk=min(max(self.tile, 1 << 10), 1 << 14))
+        # k-overflow fallback (> winner_depth exact winners on one chip —
+        # test-easy targets only): exact rescan of that chip's range
         self._rescan_full = XlaBackend(chunk=1 << 18)
 
     # -- compiled step -------------------------------------------------------
@@ -190,21 +251,18 @@ class PodSearch:
         axes = self._axes
         chip_axis = axes[-1]
         host_spec = P(axes[0]) if len(axes) == 2 else P()
-        use_pallas, sub = self.use_pallas, self.sub
-        tile, rolled = self.tile, self.rolled
+        chip_spec = P(axes[-1])
+        use_pallas, sub, k = self.use_pallas, self.sub, self.winner_depth
+        rolled = self.rolled
         replicate_out = self.multiprocess
-
-        table_specs = (
-            (P(), P(), P()) if replicate_out
-            else (P(*axes), P(*axes), P(*axes))
-        )
+        buf_spec = P() if replicate_out else P(*axes)
 
         @functools.partial(
             shard_map,
             mesh=self.mesh,
-            in_specs=(host_spec, host_spec, P(), P(), P()),
+            in_specs=(host_spec, host_spec, P(), P(), chip_spec, chip_spec),
             out_specs=(
-                *table_specs,  # per-(row,chip) K-tables
+                buf_spec,      # per-(row,chip) winner buffers
                 P(), P(),      # pod-aggregated telemetry
             ),
             # vma-typing is off: pallas_call's out_shape structs carry no
@@ -212,50 +270,44 @@ class PodSearch:
             # chip-varying nonces inside the local search
             check_vma=False,
         )
-        def _step(midstates, tails, limbs8, base, n_full):
-            # midstates: (1, 8) local row slice; tails: (1, 3)
+        def _step(midstates, tails, limbs8, base, lasts, empties):
+            # midstates: (1, 8) local row slice; tails: (1, 3);
+            # lasts/empties: (1,) this chip's in-range window (the range
+            # clamp happens in-kernel, lane-granular — winners AND
+            # telemetry are exact over the requested window)
             ms = midstates[0]
             tl = tails[0]
             chip = jax.lax.axis_index(chip_axis).astype(jnp.uint32)
             my_base = base + chip * jnp.uint32(per_chip)
             if use_pallas:
-                wt, wm, st = _local_tiles_pallas(
-                    ms, tl, limbs8, my_base, batch=per_chip, sub=sub
+                buf = _local_winners_pallas(
+                    ms, tl, limbs8, my_base, lasts[0], empties[0],
+                    batch=per_chip, sub=sub, k=k,
                 )
             else:
-                wt, wm, st = _local_tiles_jnp(
-                    ms, tl, limbs8[0], my_base, batch=per_chip,
-                    tile=tile, rolled=rolled,
+                buf = _local_winners_jnp(
+                    ms, tl, limbs8, my_base, lasts[0], empties[0],
+                    batch=per_chip, k=k, rolled=rolled,
                 )
             # ICI reductions: the pod reports aggregate telemetry as ONE
             # worker (psum/pmin ride the interconnect, never the host).
-            # best-hash telemetry only counts chips FULLY inside the
-            # requested range (chip < n_full): a chip whose batch extends
-            # past count would leak out-of-range nonces into
-            # share-difficulty stats (chip granularity is conservative —
-            # the partial chip's in-range lanes are simply not reported)
-            pod_flagged = jax.lax.psum(st[0], axes)
-            best = jnp.where(
-                chip < n_full, _flip(st[2]), jnp.int32(np.int32(0x7FFFFFFF))
-            )
-            pod_best = _unflip(jax.lax.pmin(best, axes))
+            # The buffers are already lane-exact over the in-range window
+            # (empty chips report 0 winners and the min sentinel), so no
+            # chip-granular masking is needed.
+            pod_winners = jax.lax.psum(buf[2 * k], axes)
+            pod_best = _unflip(jax.lax.pmin(_flip(buf[2 * k + 2]), axes))
             if replicate_out:
-                # fused mode: gather the (tiny) K-tables across the pod so
-                # every device — hence every PROCESS — holds the full
-                # (n_hosts, n_chips, ...) result; the gathers ride
+                # fused mode: gather the (tiny) winner buffers across the
+                # pod so every device — hence every PROCESS — holds the
+                # full (n_hosts, n_chips, 2k+3) result; the gathers ride
                 # ICI/DCN and keep multi-controller host code in lockstep
-                wt, wm, st = (
-                    jax.lax.all_gather(jax.lax.all_gather(x, chip_axis),
-                                       axes[0])
-                    for x in (wt, wm, st)
+                buf = jax.lax.all_gather(
+                    jax.lax.all_gather(buf, chip_axis), axes[0]
                 )
-                return wt, wm, st, pod_flagged, pod_best
-            shape = (1, 1, K) if len(axes) == 2 else (1, K)
-            sshape = (1, 1, 3) if len(axes) == 2 else (1, 3)
-            return (
-                wt.reshape(shape), wm.reshape(shape), st.reshape(sshape),
-                pod_flagged, pod_best,
-            )
+                return buf, pod_winners, pod_best
+            shape = ((1, 1, buf.shape[0]) if len(axes) == 2
+                     else (1, buf.shape[0]))
+            return buf.reshape(shape), pod_winners, pod_best
 
         return jax.jit(_step)
 
@@ -281,31 +333,26 @@ class PodSearch:
         limbs = jcs[0].limbs
         per_chip = -(-count // self.n_chips)              # ceil
         per_chip = -(-per_chip // self.tile) * self.tile  # round up to tiles
-        scanned = per_chip * self.n_chips                 # >= count (overscan)
 
         if count < per_chip and count <= (self.tile << 2):
-            # the whole request fits inside one chip's batch (n_full == 0):
-            # the device step's chip-granular best mask would mask EVERY
-            # chip and telemetry would collapse to the sentinel (advisor
-            # r4). For these few-tile windows one host-path scan over
-            # exactly the requested lanes is authoritative — exact best
-            # AND exact winners — so skip the pod dispatch entirely
-            # rather than launching it and discarding its outputs
-            # (review r5). The condition depends only on host-identical
-            # values, so multi-controller processes stay in lockstep.
+            # the whole request fits inside one chip's batch: for these
+            # few-tile windows one host-path scan over exactly the
+            # requested lanes is cheaper than an SPMD dispatch whose lanes
+            # would be almost all overscan — so skip the pod dispatch
+            # entirely (review r5). The condition depends only on
+            # host-identical values, so multi-controller processes stay
+            # in lockstep.
             results = []
             for jc in jcs:
-                res = self._rescan.search(jc, base, count)
+                res = self._host_exact.search(jc, base, count)
                 results.append(SearchResult(res.winners, count,
                                             res.best_hash_hi))
-            # same unit as the device path: flagged TILES, not winners
-            self.last_pod_flagged = sum(
-                len({((w.nonce_word - base) & 0xFFFFFFFF) // self.tile
-                     for w in r.winners})
-                for r in results
-            )
+            # same unit as the device path: exact winners
+            self.last_pod_flagged = sum(len(r.winners) for r in results)
             self.last_pod_best = min(r.best_hash_hi for r in results)
             return results
+
+        lasts, empties = _chip_windows(self.n_chips, per_chip, count)
 
         # numpy (uncommitted) inputs: in multi-controller mode every
         # process passes identical host values and jit shards them per the
@@ -313,54 +360,26 @@ class PodSearch:
         # rejected there; single-controller behavior is unchanged
         ms = np.stack([np.array(jc.midstate, dtype=np.uint32) for jc in jcs])
         tl = np.stack([np.array(jc.tail, dtype=np.uint32) for jc in jcs])
-        n_full = count // per_chip  # chips fully inside the request
         out = self._step_for(per_chip)(
             ms, tl, np.asarray(limbs, dtype=np.uint32),
-            np.uint32(base & 0xFFFFFFFF), np.uint32(n_full),
+            np.uint32(base & 0xFFFFFFFF), lasts, empties,
         )
-        wt, wm, st, pod_flagged, pod_best = (np.asarray(o) for o in out)
-        if wt.ndim == 2:  # 1D mesh: add the row axis
-            wt, wm, st = wt[None], wm[None], st[None]
-        self.last_pod_flagged = int(pod_flagged)
+        buf, pod_winners, pod_best = (np.asarray(o) for o in out)
+        if buf.ndim == 2:  # 1D mesh: add the row axis
+            buf = buf[None]
+        self.last_pod_flagged = int(pod_winners)
         self.last_pod_best = int(pod_best)
 
+        k = self.winner_depth
         results: list[SearchResult] = []
         for r, jc in enumerate(jcs):
-            winners: list[Winner] = []
-            row_best = 0xFFFFFFFF
-            # NB n_full == 0 is still possible here (count < per_chip on
-            # a 1-chip mesh past the small-window bound above): best-hash
-            # telemetry keeps the conservative sentinel for that case —
-            # an unbounded host rescan would duplicate the device search
-            for c in range(self.n_chips):
-                n_flagged = int(st[r, c, 0])
-                if c < n_full:
-                    # same chip-granular mask as the device pmin: chips
-                    # extending past `count` must not leak out-of-range
-                    # nonces into best-share telemetry
-                    row_best = min(row_best, int(st[r, c, 2]))
-                chip_base = (base + c * per_chip) & 0xFFFFFFFF
-                if n_flagged > K:
-                    res = self._rescan_full.search(jc, chip_base, per_chip)
-                    winners.extend(res.winners)
-                    continue
-                for s in range(n_flagged):
-                    tile_base = (chip_base + int(wt[r, c, s]) * self.tile) & 0xFFFFFFFF
-                    res = self._rescan.search(jc, tile_base, self.tile)
-                    winners.extend(res.winners)
-            if scanned != count:
-                winners = [
-                    w for w in winners
-                    if ((w.nonce_word - base) & 0xFFFFFFFF) < count
-                ]
-            # dedupe (overscan rescans can overlap across chip boundaries)
-            seen: set[int] = set()
-            uniq = []
-            for w in winners:
-                if w.nonce_word not in seen:
-                    seen.add(w.nonce_word)
-                    uniq.append(w)
-            results.append(SearchResult(uniq, count, row_best))
+            winners, row_best = _extract_row_winners(
+                buf[r], k, base, per_chip, lasts, empties, jc.target,
+                jc.digest_for,
+                lambda b, c, jc=jc: self._rescan_full.search(jc, b, c),
+                f"pod row {r}",
+            )
+            results.append(SearchResult(winners, count, row_best))
         return results
 
     def search(self, jc: JobConstants, base: int, count: int | None = None) -> SearchResult:
@@ -432,9 +451,11 @@ class ScryptPodSearch:
     but the per-chip local is the full scrypt pipeline (PBKDF2 -> ROMix ->
     PBKDF2, kernels/scrypt_jax; the fused Pallas BlockMix on TPU). scrypt
     has no midstate trick, so rows ship 19 header words instead of
-    midstate+tail, and winner recovery pulls each chip's hit MASK (scrypt
-    counts are small — tens of kH per call — so a dense bool per lane is
-    cheap) with exact host-side digest verification per hit.
+    midstate+tail. Winner recovery matches the sha256d pod: every chip
+    decides winners EXACTLY on device (full 256-bit compare, lane-granular
+    range clamp) and emits the same compact ``uint32[2k+3]`` winner buffer
+    (``sha256_pallas.unpack_winner_buffer`` layout), so host extraction —
+    and the fused-mode all-gather — stays O(k) regardless of chip count.
 
     Reference parity: the extranonce partition of
     internal/stratum/unified_stratum.go:690-714 applied to the scrypt
@@ -445,6 +466,7 @@ class ScryptPodSearch:
     mesh: Mesh
     blockmix: str | None = None  # None = "pallas" iff running on TPU
     rolled: bool | None = None
+    winner_depth: int = K        # K-slot winner buffer per chip
     multiprocess: bool = False   # fused multi-controller mode: outputs
     # are all-gathered on device so every process reads identical
     # REPLICATED arrays (see PodSearch.multiprocess)
@@ -456,6 +478,9 @@ class ScryptPodSearch:
         if self.multiprocess and len(self._axes) != 2:
             raise ValueError(
                 "multiprocess ScryptPodSearch needs a (host, chip) mesh")
+        if self.winner_depth < 1:
+            raise ValueError(
+                f"winner_depth must be >= 1, got {self.winner_depth}")
         from otedama_tpu.utils.platform_probe import safe_default_backend
 
         on_tpu = safe_default_backend() == "tpu"  # hang-safe
@@ -464,6 +489,7 @@ class ScryptPodSearch:
         if self.rolled is None:
             self.rolled = not on_tpu
         self._steps: dict[int, callable] = {}
+        self._rescan_full = None  # built on first k-overflow (rare)
 
     def _build_step(self, per_chip: int):
         from otedama_tpu.kernels import scrypt_jax as sc
@@ -471,19 +497,20 @@ class ScryptPodSearch:
         axes = self._axes
         chip_axis = axes[-1]
         host_spec = P(axes[0]) if len(axes) == 2 else P()
+        chip_spec = P(axes[-1])
         rolled, blockmix = self.rolled, self.blockmix
+        k = self.winner_depth
         replicate_out = self.multiprocess
-        out_specs = ((P(), P()) if replicate_out
-                     else (P(*axes), P(*axes)))
+        buf_spec = P() if replicate_out else P(*axes)
 
         @functools.partial(
             shard_map,
             mesh=self.mesh,
-            in_specs=(host_spec, P(), P()),
-            out_specs=out_specs,
+            in_specs=(host_spec, P(), P(), chip_spec, chip_spec),
+            out_specs=(buf_spec, P(), P()),
             check_vma=False,
         )
-        def _step(h19_rows, limbs8, base):
+        def _step(h19_rows, limbs8, base, lasts, empties):
             hw = h19_rows[0]  # this row's 19 header words
             chip = jax.lax.axis_index(chip_axis).astype(jnp.uint32)
             my_base = base + chip * jnp.uint32(per_chip)
@@ -493,20 +520,28 @@ class ScryptPodSearch:
                 rolled=rolled, blockmix=blockmix,
             )
             h = sj.digest_words_to_compare_order(d)
-            hits = sj.le256(h, tuple(limbs8[i] for i in range(8)))
-            # (no device-side pmin: host telemetry over requested lanes
-            # only — overscan-safe and one less cross-pod collective)
+            # lane-granular range clamp: winners AND telemetry exact over
+            # the requested window, overscan lanes never surface
+            offs = jax.lax.iota(jnp.uint32, per_chip)
+            rng = (offs <= lasts[0]) & (empties[0] == jnp.uint32(0))
+            hits = sj.le256(h, tuple(limbs8[i] for i in range(8))) & rng
+            h0m = jnp.where(rng, h[0], jnp.uint32(NO_WINNER))
+            buf = sj.compact_winners(hits, h0m, nonces, k)
+            # ICI reductions: the pod reports aggregate telemetry as one
+            # worker (see PodSearch._step)
+            pod_winners = jax.lax.psum(buf[2 * k], axes)
+            pod_best = _unflip(jax.lax.pmin(_flip(buf[2 * k + 2]), axes))
             if replicate_out:
                 # fused mode: gather over chip then host so every device
                 # — hence every PROCESS — reads the full (host, chip,
-                # per_chip) result (PodSearch's multi-controller rule)
-                return tuple(
-                    jax.lax.all_gather(jax.lax.all_gather(x, chip_axis),
-                                       axes[0])
-                    for x in (hits, h[0])
+                # 2k+3) result (PodSearch's multi-controller rule)
+                buf = jax.lax.all_gather(
+                    jax.lax.all_gather(buf, chip_axis), axes[0]
                 )
-            shape = (1, 1, per_chip) if len(axes) == 2 else (1, per_chip)
-            return hits.reshape(shape), h[0].reshape(shape)
+                return buf, pod_winners, pod_best
+            shape = ((1, 1, buf.shape[0]) if len(axes) == 2
+                     else (1, buf.shape[0]))
+            return buf.reshape(shape), pod_winners, pod_best
 
         return jax.jit(_step)
 
@@ -515,6 +550,20 @@ class ScryptPodSearch:
         if step is None:
             step = self._steps[per_chip] = self._build_step(per_chip)
         return step
+
+    def _overflow_rescan(self, jc: JobConstants, base: int,
+                         count: int) -> SearchResult:
+        """k-overflow fallback (> winner_depth exact winners on one chip —
+        test-easy targets only): exact rescan of that chip's in-range
+        window through the single-device scrypt driver."""
+        if self._rescan_full is None:
+            from otedama_tpu.runtime.search import ScryptXlaBackend
+
+            self._rescan_full = ScryptXlaBackend(
+                chunk=1 << 10, rolled=self.rolled,
+                blockmix=self.blockmix,
+            )
+        return self._rescan_full.search(jc, base, count)
 
     def search_jobs(
         self, jcs: list[JobConstants], base: int, count: int
@@ -525,7 +574,7 @@ class ScryptPodSearch:
             raise ValueError(
                 f"need {self.n_hosts} jobs (one per host row), got {len(jcs)}"
             )
-        # the device hit mask is computed against ONE target for the whole
+        # the device winner decision runs against ONE target for the whole
         # pod (same job difficulty across extranonce rows); a silently
         # different per-row target would drop that row's winners
         if any(jc.target != jcs[0].target for jc in jcs):
@@ -537,13 +586,14 @@ class ScryptPodSearch:
         per_chip = max(-(-count // self.n_chips), 1)
         if self.blockmix == "pallas":
             # scrypt_pallas._tile accepts any B <= LANE_TILE, else only
-            # multiples of it — round up (overscan lanes are filtered on
-            # extraction, same as PodSearch's tile rounding)
+            # multiples of it — round up (overscan lanes are clamped
+            # in-device, same as PodSearch's tile rounding)
             from otedama_tpu.kernels.scrypt_pallas import LANE_TILE
 
             if per_chip > LANE_TILE and per_chip % LANE_TILE:
                 per_chip = -(-per_chip // LANE_TILE) * LANE_TILE
-        scanned = per_chip * self.n_chips
+
+        lasts, empties = _chip_windows(self.n_chips, per_chip, count)
 
         # numpy (uncommitted) inputs: multi-controller jit shards host
         # values per the shard_map specs; a committed jnp array would be
@@ -554,29 +604,26 @@ class ScryptPodSearch:
         ])
         out = self._step_for(per_chip)(
             h19, np.asarray(limbs, dtype=np.uint32),
-            np.uint32(base & 0xFFFFFFFF)
+            np.uint32(base & 0xFFFFFFFF), lasts, empties,
         )
-        hits, h0 = (np.asarray(o) for o in out)
-        if hits.ndim == 2:  # 1D mesh: add the row axis
-            hits, h0 = hits[None], h0[None]
+        buf, pod_winners, pod_best = (np.asarray(o) for o in out)
+        if buf.ndim == 2:  # 1D mesh: add the row axis
+            buf = buf[None]
+        # same telemetry surface as PodSearch: the psum'd pod winner count
+        # is already paid for on the interconnect — store it
+        self.last_pod_flagged = int(pod_winners)
+        self.last_pod_best = int(pod_best)
 
+        k = self.winner_depth
         results: list[SearchResult] = []
         for r, jc in enumerate(jcs):
-            winners: list[Winner] = []
-            row = hits[r].reshape(-1)  # chip-major concatenation
-            # best-hash telemetry over REQUESTED lanes only: overscan
-            # lanes hash nonces outside [base, base+count) and must not
-            # leak into share-difficulty stats (advisor r3)
-            row_best = int(h0[r].reshape(-1)[:count].min())
-            for idx in np.nonzero(row)[0].tolist():
-                nonce = (base + idx) & 0xFFFFFFFF
-                if scanned != count and idx >= count:
-                    continue  # overscan lane beyond the requested range
-                digest = sc.scrypt_digest_host(jc.header_for(nonce))
-                if tgt.hash_meets_target(digest, jc.target):
-                    winners.append(Winner(nonce, digest))
+            winners, row_best = _extract_row_winners(
+                buf[r], k, base, per_chip, lasts, empties, jc.target,
+                lambda w, jc=jc: sc.scrypt_digest_host(jc.header_for(w)),
+                lambda b, c, jc=jc: self._overflow_rescan(jc, b, c),
+                f"scrypt pod row {r}",
+            )
             results.append(SearchResult(winners, count, row_best))
-        self.last_pod_best = min(r.best_hash_hi for r in results)
         return results
 
     def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:

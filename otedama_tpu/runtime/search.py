@@ -7,10 +7,12 @@ The TPU replacement for the reference's per-worker hot loop
 constants once (midstate, tail words, target limbs) and the device consumes
 the nonce space in large strides:
 
-- ``PallasBackend`` — the TPU hot path (``kernels.sha256_pallas``): device
-  returns per-tile candidate winners under a top-limb filter; the host
-  validates candidates exactly against the 256-bit target (hashlib) and
-  rescans a tile with the XLA path when several candidates landed in it.
+- ``PallasBackend`` — the TPU hot path (``kernels.sha256_pallas``): the
+  kernel decides winners EXACTLY on device (full 256-bit lexicographic
+  compare, range-clamped in-kernel) and returns one fixed-size compact
+  winner buffer per launch; the host's per-batch work is that single
+  transfer plus a sha256d per (rare) winner to materialize the share's
+  digest bytes. No tile rescans, no overscan trimming.
 - ``XlaBackend`` — pure-jnp exact search; correctness oracle, CPU/GPU
   fallback, and the path used inside the multi-chip CPU-mesh tests.
 
@@ -113,8 +115,7 @@ def _precompile_aot_step(backend, algorithm: str, jc: JobConstants,
         aot = jaxcompat.aot_compile(jit_fn, *args, static=static)
         if aot is not None:
             try:
-                hits, h0 = aot(*args)
-                np.asarray(hits), np.asarray(h0)
+                jax.tree_util.tree_map(np.asarray, aot(*args))
                 backend._aot = aot
             except Exception:
                 log.warning(
@@ -289,11 +290,13 @@ class XlaBackend:
 
 
 class PallasBackend:
-    """TPU hot path: Pallas kernel + host-side exact validation.
+    """TPU hot path: fused Pallas search with on-device winner selection.
 
     One device launch covers the whole requested range (the kernel walks
-    tiles with an in-kernel loop and returns a K-deep winner table), so the
-    engine can use 2^28..2^30 batches without per-chunk dispatch overhead.
+    tiles with an in-kernel loop, decides winners with an exact in-kernel
+    256-bit compare, and clamps to the requested window), so the engine can
+    use 2^28..2^30 batches without per-chunk dispatch overhead — and the
+    host's per-batch work is a single fixed-size winner-buffer transfer.
     """
 
     name = "pallas-tpu"
@@ -309,13 +312,18 @@ class PallasBackend:
     preferred_batch = 1 << 31
 
     def __init__(self, sub: int | None = None, unroll: int | None = None,
-                 inner: int | None = None, interpret: bool | None = None):
+                 inner: int | None = None, interpret: bool | None = None,
+                 winner_depth: int | None = None):
         # With no explicit knobs, adopt the persisted tuner winner as a
         # COMPLETE record (tuner.py tune_kernel) — the knobs were measured
         # jointly, so mixing one explicit override with tuned values for
         # the rest would run a configuration nobody measured. Any explicit
         # knob therefore switches the remaining ones to the static
         # defaults (the measured r2 config), not the tuned record.
+        # winner_depth (mining.winner_depth) is orthogonal — it sizes the
+        # SMEM table, not the compute shape — so an explicit value simply
+        # overrides whatever the record says.
+        explicit_depth = winner_depth
         if sub is None and unroll is None and inner is None:
             from otedama_tpu.tuner import load_tuned
 
@@ -323,16 +331,22 @@ class PallasBackend:
             sub = tuned.get("sub", 32)
             unroll = tuned.get("unroll", 4)
             inner = tuned.get("inner")
+            winner_depth = tuned.get("winner_depth", sp.K_WINNERS)
         else:
             sub = 32 if sub is None else sub
             unroll = 4 if unroll is None else unroll
+        if explicit_depth is not None:
+            winner_depth = explicit_depth
         self.sub = sub
         self.unroll = unroll
         self.inner = inner
         self.interpret = interpret
-        self._rescan = XlaBackend(chunk=min(sub * 128, 1 << 14))
-        # overflow fallback covers the WHOLE batch: use big chunks so a
-        # 2^28-count rescan is hundreds of dispatches, not tens of thousands
+        self.k = int(winner_depth or sp.K_WINNERS)
+        if self.k < 1:
+            raise ValueError(f"winner_depth must be >= 1, got {self.k}")
+        # overflow fallback (> k exact winners in one launch — reachable
+        # only at test-easy targets) covers the WHOLE batch: big chunks so
+        # a 2^28-count rescan is hundreds of dispatches, not thousands
         self._rescan_full = XlaBackend(chunk=1 << 18)
 
     @property
@@ -343,13 +357,10 @@ class PallasBackend:
                    count: int | None = None) -> float:
         """The Pallas program is batch-shape-keyed, so warm the shape the
         engine will actually dispatch: callers on the swap path pass the
-        engine's planned batch. The warmup's target=0 job never flags a
-        tile, so the winner-rescan XLA programs are precompiled
-        explicitly — the first REAL share must not pay a jit compile
-        mid-hot-path."""
+        engine's planned batch. The k-overflow rescan program is warmed
+        too — a table overflow must not pay a jit compile mid-hot-path."""
         jc = synthetic_job_constants() if jc is None else jc
-        seconds = self._rescan.precompile(jc)
-        seconds += self._rescan_full.precompile(jc)
+        seconds = self._rescan_full.precompile(jc)
         return seconds + warmup_backend(
             self, jc, count if count else self.tile)
 
@@ -363,17 +374,23 @@ class PallasBackend:
         sync. On the tunneled platform a blocking transfer starves the next
         dispatch (thread-level pipelining cannot hide it), so grouping is
         what keeps the chip busy: per-group overhead is one sync instead of
-        one per launch. The engine feeds whole groups via one executor call.
+        one per launch; while launch N's winner buffer transfers, launches
+        N+1.. are still computing. The engine feeds whole groups via one
+        executor call and keeps a second group in flight behind this one.
         """
         outs = []
         for base, count in batches:
             tile = self.tile
             batch = (count + tile - 1) // tile * tile  # overscan to tiles
-            jw = sp.pack_job_words(jc.midstate, jc.tail, base, jc.limbs)
+            # the kernel clamps winners AND telemetry to [base, base+count)
+            # itself — overscan lanes past a mid-tile batch end never
+            # surface, so there is nothing for the host to trim
+            jw = sp.pack_job_words(jc.midstate, jc.tail, base, jc.limbs,
+                                   count=count)
             outs.append(
                 sp.sha256d_pallas_search(
                     jw, batch=batch, sub=self.sub, unroll=self.unroll,
-                    inner=self.inner, interpret=self.interpret,
+                    inner=self.inner, k=self.k, interpret=self.interpret,
                 )
             )
         return [
@@ -382,33 +399,27 @@ class PallasBackend:
         ]
 
     def _collect(self, jc: JobConstants, base: int, count: int, out) -> SearchResult:
-        tile = self.tile
-        batch = (count + tile - 1) // tile * tile
-        # one host transfer on the common path: the tunneled platform pays
-        # a full RTT per fetch, so win_tile is only pulled when a tile
-        # actually hit (at production difficulty most launches have none)
-        st = np.asarray(out.stats)
-        n_hit_tiles, min_hash = int(st[0]), int(st[2])
-        wt = np.asarray(out.win_tile) if n_hit_tiles > 0 else None
-
-        winners: list[Winner] = []
-        if n_hit_tiles > sp.K_WINNERS:
-            # hit-tile table overflowed (only plausible at test-easy
+        # the launch's ONE host transfer: the fixed 2k+3-word winner buffer
+        wn, _, n, min_hash = sp.unpack_winner_buffer(np.asarray(out), self.k)
+        if n > self.k:
+            # winner table overflowed (only plausible at test-easy
             # targets): fall back to an exact scan of the whole range
             return self._rescan_full.search(jc, base, count)
-        for i in range(n_hit_tiles):
-            # the kernel flags tiles; winners come from an exact rescan of
-            # each flagged tile (sub*128 nonces — cheap on the XLA path)
-            tile_base = (base + int(wt[i]) * tile) & 0xFFFFFFFF
-            res = self._rescan.search(jc, tile_base, tile)
-            winners.extend(res.winners)
-        # drop overscan winners beyond the requested range
-        if batch != count:
-            winners = [
-                w
-                for w in winners
-                if ((w.nonce_word - base) & 0xFFFFFFFF) < count
-            ]
+        winners: list[Winner] = []
+        for i in range(n):
+            w = int(wn[i])
+            digest = jc.digest_for(w)
+            if not tgt.hash_meets_target(digest, jc.target):
+                # the kernel's decision is exact, so a host-side miss means
+                # the DEVICE produced a wrong winner — corruption, not an
+                # expected filter false-positive. Surface it loudly.
+                log.error(
+                    "pallas winner %#010x failed host verification "
+                    "(digest=%s target=%#x) — device result corrupt?",
+                    w, digest.hex(), jc.target,
+                )
+                continue
+            winners.append(Winner(w, digest))
         return SearchResult(winners, count, min_hash)
 
 
@@ -426,20 +437,23 @@ class ScryptXlaBackend:
     algorithm = "scrypt"
 
     def __init__(self, chunk: int = 1 << 12, rolled: bool | None = None,
-                 blockmix: str = "xla"):
+                 blockmix: str = "xla", winner_depth: int | None = None):
         self.chunk = chunk
         # engine batch cap: at tens of kH/s one search call must stay
         # seconds-long so clean-job invalidation doesn't strand stale work
         self.max_batch = 4 * chunk
         self.rolled = _default_rolled() if rolled is None else rolled
         self.blockmix = blockmix
+        self.k = int(winner_depth or sp.K_WINNERS)
+        if self.k < 1:
+            raise ValueError(f"winner_depth must be >= 1, got {self.k}")
         self._aot = None
 
     def precompile(self, jc: JobConstants | None = None,
                    count: int | None = None) -> float:
-        """AOT-lower the chunk-shaped scrypt step; warmup-batch fallback
-        (``_precompile_aot_step``). One chunk of lanes is the whole
-        program — count is shape-irrelevant here."""
+        """AOT-lower the chunk-shaped scrypt winner step; warmup-batch
+        fallback (``_precompile_aot_step``). One chunk of lanes is the
+        whole program — count is shape-irrelevant here."""
         from otedama_tpu.kernels import scrypt_jax as sc
 
         jc = synthetic_job_constants() if jc is None else jc
@@ -448,9 +462,9 @@ class ScryptXlaBackend:
         )
         lb = jnp.asarray(jc.limbs)
         return _precompile_aot_step(
-            self, self.algorithm, jc, sc.scrypt_search_step,
-            (h19, jnp.uint32(0), lb),
-            {"n": self.chunk, "rolled": self.rolled,
+            self, self.algorithm, jc, sc.scrypt_search_winners,
+            (h19, jnp.uint32(0), lb, jnp.uint32(self.chunk - 1)),
+            {"n": self.chunk, "k": self.k, "rolled": self.rolled,
              "blockmix": self.blockmix},
         )
 
@@ -461,20 +475,52 @@ class ScryptXlaBackend:
             np.array(sc.header_words19(jc.header76), dtype=np.uint32)
         )
         lb = jnp.asarray(jc.limbs)
+        k = self.k
 
-        def step(b):
-            if self._aot is not None:
-                return self._aot(h19, jnp.uint32(b), lb)
-            return sc.scrypt_search_step(
-                h19, jnp.uint32(b), lb, n=self.chunk, rolled=self.rolled,
+        def step(b, valid):
+            if self._aot is not None:  # `last` is a runtime arg: AOT covers
+                return self._aot(h19, jnp.uint32(b), lb,  # tails too
+                                 jnp.uint32(valid - 1))
+            return sc.scrypt_search_winners(
+                h19, jnp.uint32(b), lb, jnp.uint32(valid - 1),
+                n=self.chunk, k=k, rolled=self.rolled,
                 blockmix=self.blockmix,
             )
 
-        return _chunked_search(
-            jc, base, count, self.chunk, step,
-            lambda w: sc.scrypt_digest_host(jc.header_for(w)),
-            verify=True,
-        )
+        winners: list[Winner] = []
+        best = 0xFFFFFFFF
+        done = 0
+        while done < count:
+            b = (base + done) & 0xFFFFFFFF
+            valid = min(self.chunk, count - done)
+            # the device compare is exact AND range-clamped: the host's
+            # per-chunk work is one fixed-size winner-buffer transfer
+            wn, _, n, min_hash = sp.unpack_winner_buffer(
+                np.asarray(step(b, valid)), k
+            )
+            best = min(best, min_hash)
+            if n > k:
+                # winner table overflowed (test-easy targets): dense
+                # fallback over this chunk via the old-style step
+                hits, _ = sc.scrypt_search_step(
+                    h19, jnp.uint32(b), lb, n=self.chunk,
+                    rolled=self.rolled, blockmix=self.blockmix,
+                )
+                idxs = np.nonzero(np.asarray(hits)[:valid])[0].tolist()
+                nonce_words = [(b + i) & 0xFFFFFFFF for i in idxs]
+            else:
+                nonce_words = [int(w) for w in wn[:n]]
+            for w in nonce_words:
+                digest = sc.scrypt_digest_host(jc.header_for(w))
+                if tgt.hash_meets_target(digest, jc.target):
+                    winners.append(Winner(w, digest))
+                else:
+                    log.error(
+                        "scrypt winner %#010x failed host verification — "
+                        "device result corrupt?", w,
+                    )
+            done += valid
+        return SearchResult(winners, count, best)
 
 
 class ScryptPallasBackend(ScryptXlaBackend):
@@ -490,7 +536,7 @@ class ScryptPallasBackend(ScryptXlaBackend):
     # at chunk=2^15, the gather-bound sweet spot; V = chunk * 128 KiB HBM) —
     # the engine's no-kwargs auto construction must run what was measured
     def __init__(self, chunk: int = 1 << 15, rolled: bool | None = None,
-                 tier: str = "pallas"):
+                 tier: str = "pallas", winner_depth: int | None = None):
         """``tier``: "pallas" (fused BlockMix, HBM V + XLA gather) or
         "fused"/"fused-half" (whole ROMix in-kernel, V in VMEM — the
         gather-free experiment; kernels/scrypt_pallas.romix_fused_pallas)."""
@@ -506,7 +552,8 @@ class ScryptPallasBackend(ScryptXlaBackend):
                 )
         else:
             raise ValueError(f"unknown scrypt pallas tier {tier!r}")
-        super().__init__(chunk=chunk, rolled=rolled, blockmix=tier)
+        super().__init__(chunk=chunk, rolled=rolled, blockmix=tier,
+                         winner_depth=winner_depth)
         if tier != "pallas":
             self.name = f"scrypt-{tier}"
 
@@ -1100,7 +1147,26 @@ class PythonBackend:
         return _scalar_search(jc, base, count, jc.digest_for)
 
 
+# (kind, algorithm-family) pairs whose backends take the winner-table depth
+# knob; every other build silently drops it so one shared kwargs dict
+# (app._backend_kwargs) can describe heterogeneous backend sets.
+# fused-pod is deliberately ABSENT: the knob only reaches the leader
+# (followers run cli's bare `follower_loop(FusedPodDriver())`), and a
+# leader-only K compiles a different all-gather shape than the followers'
+# — multi-controller lockstep requires every process to run the same
+# program, so fused pods always use the static kernel default.
+_WINNER_DEPTH_KINDS = {
+    ("pallas-tpu", "sha256d"), ("pod", "sha256d"),
+    ("pallas-tpu", "scrypt"), ("xla", "scrypt"), ("pod", "scrypt"),
+}
+
+
 def make_backend(kind: str, algorithm: str = "sha256d", **kwargs):
+    algo_family = "sha256d" if algorithm in ("sha256d", "sha256") else algorithm
+    if ("winner_depth" in kwargs
+            and (kind, algo_family) not in _WINNER_DEPTH_KINDS):
+        kwargs = dict(kwargs)
+        kwargs.pop("winner_depth")
     if kind == "fused-pod":
         # LEADER of a multi-host fused pod (runtime.fused); followers run
         # fused.follower_loop instead of an engine. One branch for every

@@ -107,17 +107,23 @@ class GeneticTuner:
 #   inner  - tiles per grid step (None = the kernel's own default)
 #   batch  - nonces per launch (production batch comes from the engine's
 #            grouped dispatch; the tuner validates the winner at it)
+#   winner_depth   - K slots of the on-device winner buffer (sizes the
+#            SMEM table and the per-launch host transfer, 2K+3 words)
+#   pipeline_depth - in-flight launches the engine keeps per backend
+#            (engine double-buffering; consumed by app._pipeline_depth)
 #
 # Each DISTINCT (sub, unroll, inner) compiles its own kernel (~10-20 s on
 # the tunneled platform), so the search is a focused grid, not a GA — the
 # GA above remains for cheap host-side knob spaces where evaluations are
-# free. Results persist to TUNED_PATH; PallasBackend and bench.py load it.
+# free. Results persist to TUNED_PATH; PallasBackend, the engine, and
+# bench.py load it.
 
 TUNED_PATH = "tuned_sha256d.json"
 
 
 def measure_config(sub: int, unroll: int, inner: int | None,
-                   batch: int = 1 << 28, repeats: int = 3) -> float:
+                   batch: int = 1 << 28, repeats: int = 3,
+                   winner_depth: int | None = None) -> float:
     """Forced-sync pipelined rate (GH/s) of one kernel config."""
     import struct
     import time
@@ -136,14 +142,14 @@ def measure_config(sub: int, unroll: int, inner: int | None,
     def launch():
         return sp.sha256d_pallas_search(
             jw, batch=batch, sub=sub, unroll=unroll, inner=inner,
-            interpret=False,
+            k=winner_depth, interpret=False,
         )
 
-    np.asarray(launch().stats)  # compile + warmup
+    np.asarray(launch())  # compile + warmup (output IS the winner buffer)
     t0 = time.monotonic()
     outs = [launch() for _ in range(repeats)]
     for o in outs:
-        np.asarray(o.stats)  # forced host transfer = honest sync
+        np.asarray(o)  # forced host transfer = honest sync
     dt = time.monotonic() - t0
     return repeats * batch / dt / 1e9
 
@@ -154,6 +160,8 @@ def tune_kernel(
     inners=(None,),
     batch: int = 1 << 28,
     validate_batch: int = 1 << 31,
+    winner_depth: int | None = None,
+    pipeline_depth: int | None = None,
     out_path: str | None = TUNED_PATH,
     log=print,
 ) -> dict:
@@ -165,6 +173,12 @@ def tune_kernel(
     launches (engine grouped dispatch) — and the final winner is picked by
     the validated rate. A config that wins a short run by amortizing
     dispatch differently must not get persisted on that alone.
+
+    ``winner_depth``/``pipeline_depth`` ride the record verbatim (both are
+    orthogonal to the compute shape: the former sizes the SMEM winner
+    table, the latter the engine's in-flight launch count) so the whole
+    measured configuration is adopted together by PallasBackend and the
+    engine.
     """
     import itertools
     import json
@@ -172,7 +186,8 @@ def tune_kernel(
     results = []
     for sub, unroll, inner in itertools.product(subs, unrolls, inners):
         try:
-            ghs = measure_config(sub, unroll, inner, batch=batch)
+            ghs = measure_config(sub, unroll, inner, batch=batch,
+                                 winner_depth=winner_depth)
         except Exception as e:  # a config may exceed VMEM etc. — skip it
             log(f"tune: sub={sub} unroll={unroll} inner={inner} FAILED: {e}")
             continue
@@ -194,6 +209,7 @@ def tune_kernel(
             vghs = measure_config(
                 r["sub"], r["unroll"], r["inner"],
                 batch=validate_batch, repeats=2,
+                winner_depth=winner_depth,
             )
         except Exception as e:
             log(f"tune: validate sub={r['sub']} unroll={r['unroll']} FAILED: {e}")
@@ -217,6 +233,10 @@ def tune_kernel(
         "validate_batch": validate_batch,
         "all": results,
     }
+    if winner_depth is not None:
+        record["winner_depth"] = winner_depth
+    if pipeline_depth is not None:
+        record["pipeline_depth"] = pipeline_depth
     if out_path:
         with open(out_path, "w") as f:
             json.dump(record, f, indent=1)
@@ -258,9 +278,16 @@ def main() -> None:  # pragma: no cover - device entry point
 
     ap = argparse.ArgumentParser(description="tune the sha256d Pallas kernel")
     ap.add_argument("--batch", type=int, default=1 << 28)
+    ap.add_argument("--winner-depth", type=int, default=None,
+                    help="on-device winner-buffer slots K baked into the "
+                         "record (mining.winner_depth)")
+    ap.add_argument("--pipeline-depth", type=int, default=None,
+                    help="engine in-flight launch depth baked into the "
+                         "record (mining.pipeline_depth)")
     ap.add_argument("--out", default=TUNED_PATH)
     args = ap.parse_args()
-    rec = tune_kernel(batch=args.batch, out_path=args.out)
+    rec = tune_kernel(batch=args.batch, winner_depth=args.winner_depth,
+                      pipeline_depth=args.pipeline_depth, out_path=args.out)
     import json
 
     print(json.dumps(rec))  # one JSON line: harvested by tools/tpu_battery

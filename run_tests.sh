@@ -35,6 +35,14 @@
 #                   time, and drain-bounded stop(); writes a
 #                   BENCH_DEGRADE json artifact and fails if quarantine
 #                   or reintegration never happened or stop() hung.
+#   engine-bench    opt-in live-engine throughput bench: drives the real
+#                   mining engine loop (pipelined dispatch, on-device
+#                   winner selection, share path) on the production
+#                   backend, plus a pod-mesh run over every visible
+#                   device for per-chip rate and scaling efficiency;
+#                   writes a BENCH_ENGINE json artifact. Runs on the
+#                   live device when one answers (bench.py's probe
+#                   guard); ENGINE_BENCH_ARGS passes extra bench flags.
 # Extra args pass through to pytest (e.g. ./run_tests.sh fast -k scrypt).
 set -euo pipefail
 cd "$(dirname "$0")"
@@ -55,11 +63,18 @@ case "$tier" in
   degrade-bench)
     exec env JAX_PLATFORMS=cpu python tools/bench_degrade.py \
       --out "${DEGRADE_BENCH_OUT:-BENCH_DEGRADE_manual.json}" "$@" ;;
+  engine-bench)
+    # no cpu pin: this bench wants the real device (bench.py degrades to
+    # cpu itself when the tunnel is wedged, so it never hangs).
+    # ENGINE_BENCH_ARGS is word-split on purpose (extra bench flags).
+    exec python bench.py --engine-path --pod \
+      --out "${ENGINE_BENCH_OUT:-BENCH_ENGINE_manual.json}" \
+      ${ENGINE_BENCH_ARGS:-} "$@" ;;
   sharechain-bench)
     exec env JAX_PLATFORMS=cpu python tools/bench_sharechain.py \
       --out "${SHARECHAIN_BENCH_OUT:-BENCH_SHARECHAIN_manual.json}" "$@" ;;
   payout-bench)
     exec env JAX_PLATFORMS=cpu python tools/bench_payout.py \
       --out "${PAYOUT_BENCH_OUT:-BENCH_PAYOUT_manual.json}" "$@" ;;
-  *) echo "usage: $0 [fast|slow|all|audit|stratum-bench|switch-bench|degrade-bench|sharechain-bench|payout-bench] [pytest args...]" >&2; exit 2 ;;
+  *) echo "usage: $0 [fast|slow|all|audit|stratum-bench|switch-bench|degrade-bench|engine-bench|sharechain-bench|payout-bench] [pytest args...]" >&2; exit 2 ;;
 esac

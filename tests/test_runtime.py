@@ -101,13 +101,14 @@ def test_kernel_math_host_eval_vs_hashlib():
 
 
 def test_pallas_backend_host_logic(monkeypatch):
-    """PallasBackend's host-side paths, with the device launch stubbed.
+    """PallasBackend's host-side paths, with the device launch stubbed by
+    an oracle that honors the kernel's exact output contract (the compact
+    ``uint32[2k+3]`` winner buffer, range clamp included).
 
-    Covers: flagged-tile exact rescan, table-overflow full-range fallback,
-    and overscan winner filtering — none of which need a TPU.
+    Covers: O(K) winner extraction from the buffer (no tile rescans), the
+    in-kernel range clamp reaching the host as already-trimmed winners,
+    and the K-overflow full-range fallback — none of which need a TPU.
     """
-    import jax.numpy as jnp
-
     from otedama_tpu.kernels import sha256_pallas as sp
     from otedama_tpu.runtime import search as rs
 
@@ -115,39 +116,53 @@ def test_pallas_backend_host_logic(monkeypatch):
     backend = rs.PallasBackend(sub=8)
     tile = backend.tile  # 1024
 
-    # oracle winners for tiles 0 and 3 of range [0, 4*tile)
     all_winners = _oracle_winners(jc, 0, 4 * tile)
-    hit_tiles = sorted({w // tile for w in all_winners})
-    assert hit_tiles, "easy target must produce winners in 4 tiles"
+    assert all_winners, "easy target must produce winners in 4 tiles"
+
+    calls = []
 
     def fake_search(job_words, *, batch, sub, inner=None, unroll=4,
-                    interpret=None):
-        pad = sp.K_WINNERS - len(hit_tiles)
-        return sp.PallasSearchOut(
-            win_tile=jnp.asarray(hit_tiles + [0] * pad, dtype=jnp.uint32),
-            win_min=jnp.zeros((sp.K_WINNERS,), dtype=jnp.uint32),
-            stats=jnp.asarray([len(hit_tiles), 0, 123], dtype=jnp.uint32),
-        )
+                    k=sp.K_WINNERS, interpret=None):
+        # behave exactly like the kernel: exact winners over the in-range
+        # window [0, job_words[20]] (the clamp the device applies), first
+        # k in the table, TRUE count in slot 2k
+        jw = np.asarray(job_words)
+        calls.append(int(jw[20]))
+        base = int(jw[11])
+        in_range = [] if jw[21] else [
+            w for w in _oracle_winners(jc, base, batch)
+            if ((w - base) & 0xFFFFFFFF) <= int(jw[20])
+        ]
+        buf = np.zeros((sp.winner_buffer_words(k),), dtype=np.uint32)
+        buf[:min(len(in_range), k)] = in_range[:k]
+        buf[2 * k] = len(in_range)
+        buf[2 * k + 2] = 123
+        return buf
 
     monkeypatch.setattr(sp, "sha256d_pallas_search", fake_search)
     res = backend.search(jc, 0, 4 * tile)
     assert sorted(w.nonce_word for w in res.winners) == all_winners
     assert res.best_hash_hi == 123
+    for w in res.winners:  # digests rebuilt on the host are exact
+        assert w.digest == jc.digest_for(w.nonce_word)
 
-    # overscan: request a non-tile-multiple count; winners past it drop
-    res2 = backend.search(jc, 0, 4 * tile - 7)
-    expect2 = [w for w in all_winners if w < 4 * tile - 7]
-    assert sorted(w.nonce_word for w in res2.winners) == expect2
+    # a batch ending MID-TILE: the kernel receives the in-range window
+    # (count-1) and the already-clamped buffer yields no out-of-range
+    # nonce — there is no host-side trim left to save us
+    count2 = 4 * tile - 7
+    res2 = backend.search(jc, 0, count2)
+    assert calls[-1] == count2 - 1  # the clamp was passed to the device
+    assert all(w.nonce_word < count2 for w in res2.winners)
+    assert sorted(w.nonce_word for w in res2.winners) == [
+        w for w in all_winners if w < count2
+    ]
 
-    # overflow: stats[0] > K_WINNERS routes to the full-range fallback
-    def overflow_search(job_words, **kw):
-        return sp.PallasSearchOut(
-            win_tile=jnp.zeros((sp.K_WINNERS,), dtype=jnp.uint32),
-            win_min=jnp.zeros((sp.K_WINNERS,), dtype=jnp.uint32),
-            stats=jnp.asarray(
-                [sp.K_WINNERS + 5, 0, 0xFFFFFFFF], dtype=jnp.uint32
-            ),
-        )
+    # overflow: n_winners > k routes to the exact full-range fallback
+    def overflow_search(job_words, *, k=sp.K_WINNERS, **kw):
+        buf = np.zeros((sp.winner_buffer_words(k),), dtype=np.uint32)
+        buf[2 * k] = k + 5
+        buf[2 * k + 2] = 0xFFFFFFFF
+        return buf
 
     monkeypatch.setattr(sp, "sha256d_pallas_search", overflow_search)
     res3 = backend.search(jc, 0, 2 * tile)

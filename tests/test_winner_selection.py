@@ -1,0 +1,372 @@
+"""On-device winner selection (ISSUE 7): exact 256-bit compare, compact
+K-slot winner buffers, in-device range clamping.
+
+Fast tier pits the jnp twin of the kernel's winner compaction
+(``sha256_jax.compact_winners`` / ``mesh._local_winners_jnp``) and the
+kernel's own partial-evaluated escalation math (``sha256_pallas
+.sha256d_words`` on python ints — the EXACT trace the kernel runs)
+against the host oracle at adversarial targets: hash == target,
+target ± 1, winner in the last in-range lane, K-overflow. The slow tier
+runs the REAL Pallas kernel in interpret mode under ``JAX_PLATFORMS=cpu``
+on the same boundaries.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from otedama_tpu.kernels import sha256_jax as sj
+from otedama_tpu.kernels import sha256_pallas as sp
+from otedama_tpu.kernels import target as tgt
+from otedama_tpu.runtime.search import JobConstants
+
+HEADER = bytes(bytearray(b"\x05" * 76))
+
+
+def _oracle(jc, base, count):
+    out = []
+    for off in range(count):
+        w = (base + off) & 0xFFFFFFFF
+        if tgt.hash_meets_target(jc.digest_for(w), jc.target):
+            out.append(w)
+    return out
+
+
+def _values(jc, base, count):
+    return {
+        (base + off) & 0xFFFFFFFF: int.from_bytes(
+            jc.digest_for((base + off) & 0xFFFFFFFF), "little"
+        )
+        for off in range(count)
+    }
+
+
+# -- the shared winner-buffer contract ----------------------------------------
+
+
+def test_winner_buffer_roundtrip_and_job_word_encoding():
+    k = 5
+    buf = np.zeros((sp.winner_buffer_words(k),), dtype=np.uint32)
+    buf[:3] = [11, 22, 33]
+    buf[k:k + 3] = [1, 2, 3]
+    buf[2 * k] = 3
+    buf[2 * k + 2] = 0xABCD
+    wn, wl, n, best = sp.unpack_winner_buffer(buf, k)
+    assert list(wn[:n]) == [11, 22, 33]
+    assert list(wl[:n]) == [1, 2, 3]
+    assert (n, best) == (3, 0xABCD)
+
+    jc = JobConstants.from_header_prefix(HEADER, 1)
+    # count=None: whole launch in range; count=0: nothing is; count=n:
+    # last in-range offset is n-1
+    jw = sp.pack_job_words(jc.midstate, jc.tail, 7, jc.limbs)
+    assert (int(jw[20]), int(jw[21])) == (0xFFFFFFFF, 0)
+    jw = sp.pack_job_words(jc.midstate, jc.tail, 7, jc.limbs, count=0)
+    assert int(jw[21]) == 1
+    jw = sp.pack_job_words(jc.midstate, jc.tail, 7, jc.limbs, count=1000)
+    assert (int(jw[20]), int(jw[21])) == (999, 0)
+
+
+def test_compact_winners_order_count_and_overflow():
+    import jax.numpy as jnp
+
+    n, k = 1024, 4
+    nonces = jnp.arange(100, 100 + n, dtype=jnp.uint32)
+    h0 = jnp.full((n,), 0xFFFFFFFF, dtype=jnp.uint32)
+
+    def buf_for(hit_offs):
+        hits = np.zeros((n,), dtype=bool)
+        hits[hit_offs] = True
+        h0m = np.asarray(h0).copy()
+        for i, off in enumerate(hit_offs):
+            h0m[off] = 10 + i
+        return np.asarray(sj.compact_winners(
+            jnp.asarray(hits), jnp.asarray(h0m), nonces, k
+        ))
+
+    # 3 hits, k=4: table filled in nonce-position order, true count, min
+    wn, wl, cnt, best = sp.unpack_winner_buffer(buf_for([5, 9, 700]), k)
+    assert list(wn[:cnt]) == [105, 109, 800]
+    assert list(wl[:cnt]) == [10, 11, 12]
+    assert cnt == 3 and best == 10
+    assert wn[3] == 0 and wl[3] == 0xFFFFFFFF  # empty slots
+
+    # 6 hits, k=4: the TRUE count (the overflow signal) with the first k
+    # winners still in the table
+    wn, _, cnt, _ = sp.unpack_winner_buffer(
+        buf_for([1, 2, 3, 4, 5, 6]), k
+    )
+    assert cnt == 6
+    assert list(wn) == [101, 102, 103, 104]
+
+
+# -- the kernel's escalation math, partially evaluated on host ints ----------
+
+
+def test_kernel_escalation_trace_matches_hashlib():
+    """``sha256d_words`` on python ints IS the dataflow the escalation
+    path traces to the VPU (same partial evaluator, same expressions) —
+    checking the full 8-word digest against hashlib verifies the exact
+    compare's inputs without a device."""
+    jc = JobConstants.from_header_prefix(HEADER, 1)
+    ms = tuple(int(x) for x in jc.midstate)
+    tail = tuple(int(t) for t in jc.tail)
+    for nonce in (0, 1, 0x7FFFFFFF, 0xDEADBEEF, 0xFFFFFFFF):
+        d = sp.sha256d_words(ms, tail, nonce)
+        assert tuple(d) == struct.unpack(">8I", jc.digest_for(nonce)), (
+            hex(nonce)
+        )
+
+
+def test_kernel_lexicographic_chain_boundary_targets():
+    """The in-kernel limb-chain decision (le built least-significant-limb
+    first, exactly as ``_search_kernel`` codes it) evaluated on host ints
+    at hash == target and target ± 1 — the off-by-one class an exact
+    on-device compare must not have."""
+    jc = JobConstants.from_header_prefix(HEADER, 1)
+    ms = tuple(int(x) for x in jc.midstate)
+    tail = tuple(int(t) for t in jc.tail)
+
+    def bswap(x):
+        return int.from_bytes(int(x).to_bytes(4, "big"), "little")
+
+    def kernel_decides(nonce, target):
+        d = sp.sha256d_words(ms, tail, nonce)
+        h = [bswap(d[7 - j]) for j in range(8)]  # compare order, ms-first
+        tl = [int(v) for v in tgt.target_to_limbs(target)]
+        le = h[7] <= tl[7]
+        for j in range(6, -1, -1):
+            le = (h[j] < tl[j]) or ((h[j] == tl[j]) and le)
+        return le
+
+    for nonce in (3, 0xBEEF, 0xFFFFFFF0):
+        value = int.from_bytes(jc.digest_for(nonce), "little")
+        assert kernel_decides(nonce, value)          # hash == target: hit
+        assert not kernel_decides(nonce, value - 1)  # one below: miss
+        assert kernel_decides(nonce, value + 1)      # one above: hit
+        # oracle agreement at all three boundaries
+        for t in (value - 1, value, value + 1):
+            assert kernel_decides(nonce, t) == tgt.hash_meets_target(
+                jc.digest_for(nonce), t
+            )
+
+
+# -- the jnp twin: same output contract as the kernel, fast on CPU -----------
+
+
+def _twin_search(jc, base, batch, last, empty, k=8):
+    import jax.numpy as jnp
+
+    from otedama_tpu.runtime.mesh import _local_winners_jnp
+
+    buf = _local_winners_jnp(
+        jnp.asarray(np.array(jc.midstate, dtype=np.uint32)),
+        jnp.asarray(np.array(jc.tail, dtype=np.uint32)),
+        jnp.asarray(jc.limbs),
+        jnp.uint32(base),
+        jnp.uint32(last),
+        jnp.uint32(empty),
+        batch=batch,
+        k=k,
+        rolled=True,
+    )
+    return sp.unpack_winner_buffer(np.asarray(buf), k)
+
+
+def test_twin_exact_compare_at_boundary_targets():
+    """hash == target is a winner, target - 1 is not, byte-exact vs the
+    host oracle — through the jnp twin that shares the kernel's buffer
+    contract (the pod CPU path ships exactly this)."""
+    base, batch = 4000, 256
+    probe = JobConstants.from_header_prefix(HEADER, 1)
+    vals = _values(probe, base, batch)
+    w_star = min(vals, key=vals.get)
+
+    jc_eq = JobConstants.from_header_prefix(HEADER, vals[w_star])
+    wn, _, n, best = _twin_search(jc_eq, base, batch, batch - 1, 0)
+    assert n == 1 and int(wn[0]) == w_star
+    assert best == vals[w_star] >> 224
+
+    jc_below = JobConstants.from_header_prefix(HEADER, vals[w_star] - 1)
+    _, _, n, _ = _twin_search(jc_below, base, batch, batch - 1, 0)
+    assert n == 0
+
+    jc_above = JobConstants.from_header_prefix(HEADER, vals[w_star] + 1)
+    wn, _, n, _ = _twin_search(jc_above, base, batch, batch - 1, 0)
+    assert n == 1 and int(wn[0]) == w_star
+
+
+def test_twin_range_clamp_winner_in_last_lane():
+    """The in-device range clamp at lane granularity: a window ending ON
+    a winner's lane includes it, one lane earlier excludes it — no
+    out-of-range nonce can ever surface (the host trim is gone)."""
+    base, batch = 0, 256
+    probe = JobConstants.from_header_prefix(HEADER, 1)
+    vals = _values(probe, base, batch)
+    w_star = min(vals, key=vals.get)
+    off = (w_star - base) & 0xFFFFFFFF
+    jc = JobConstants.from_header_prefix(HEADER, vals[w_star])
+
+    wn, _, n, best = _twin_search(jc, base, batch, off, 0)
+    assert n == 1 and int(wn[0]) == w_star  # last in-range lane wins
+    assert best == vals[w_star] >> 224
+
+    if off > 0:
+        _, _, n, best2 = _twin_search(jc, base, batch, off - 1, 0)
+        assert n == 0  # one lane shorter: the winner is overscan now
+        # telemetry is clamped too: the excluded lane's hash (the global
+        # min) must not leak into best-share stats
+        assert best2 >= min(
+            v >> 224 for w, v in vals.items() if (w - base) < off
+        )
+
+    # empty window: nothing in range, sentinel telemetry
+    _, _, n, best3 = _twin_search(jc, base, batch, 0, 1)
+    assert n == 0 and best3 == 0xFFFFFFFF
+
+
+def test_twin_k_overflow_true_count():
+    """> K winners in one window: the true count comes back (the overflow
+    signal callers resolve with an exact rescan) and the table holds the
+    first K in nonce order."""
+    base, batch, k = 0, 256, 4
+    probe = JobConstants.from_header_prefix(HEADER, 1)
+    vals = _values(probe, base, batch)
+    # target at the 8th-smallest value: exactly 8 winners > k=4
+    target = sorted(vals.values())[7]
+    jc = JobConstants.from_header_prefix(HEADER, target)
+    expect = sorted(w for w, v in vals.items() if v <= target)
+    assert len(expect) == 8
+
+    wn, _, n, _ = _twin_search(jc, base, batch, batch - 1, 0, k=k)
+    assert n == 8
+    assert [int(w) for w in wn] == expect[:k]
+
+
+# -- single-device backends end to end ----------------------------------------
+
+
+def test_scrypt_winner_step_clamp_and_overflow():
+    """ScryptXlaBackend now ships the same O(k) winner-buffer contract:
+    a mid-chunk count yields no out-of-range nonce, and > k winners in a
+    chunk fall back to the exact dense path."""
+    from otedama_tpu.kernels import scrypt_jax as sc
+    from otedama_tpu.runtime.search import ScryptXlaBackend
+
+    base, count = 9, 23
+    vals = {
+        n: int.from_bytes(
+            sc.scrypt_digest_host(HEADER + struct.pack(">I", n)), "little"
+        )
+        for n in range(base, base + count + 8)
+    }
+    # target = 3rd-smallest in-range value: 3 winners, some nonces past
+    # count would also pass — the device clamp must keep them out
+    in_range = {n: v for n, v in vals.items() if n < base + count}
+    target = sorted(in_range.values())[2]
+    jc = JobConstants.from_header_prefix(HEADER, target)
+    backend = ScryptXlaBackend(chunk=32, winner_depth=8)
+    res = backend.search(jc, base, count)
+    expect = sorted(n for n, v in in_range.items() if v <= target)
+    assert sorted(w.nonce_word for w in res.winners) == expect
+    assert all(base <= w.nonce_word < base + count for w in res.winners)
+    assert res.best_hash_hi == min(v >> 224 for v in in_range.values())
+
+    # k-overflow: winner_depth=2 with 3+ winners routes through the dense
+    # fallback and still returns the exact oracle set
+    tiny = ScryptXlaBackend(chunk=32, winner_depth=2)
+    res2 = tiny.search(jc, base, count)
+    assert sorted(w.nonce_word for w in res2.winners) == expect
+
+
+def test_winner_depth_validation_and_kwarg_routing():
+    from otedama_tpu.runtime.search import (
+        PallasBackend,
+        ScryptXlaBackend,
+        make_backend,
+    )
+
+    with pytest.raises(ValueError):
+        PallasBackend(sub=8, winner_depth=-1)
+    with pytest.raises(ValueError):
+        ScryptXlaBackend(winner_depth=-1)
+    # 0 = auto (the mining.winner_depth sentinel): kernel default adopted
+    assert PallasBackend(sub=8, winner_depth=0).k == sp.K_WINNERS
+    assert PallasBackend(sub=8, winner_depth=7).k == 7
+    # a shared kwargs dict must not break backends without a winner table
+    b = make_backend("python", "sha256d", winner_depth=9)
+    assert not hasattr(b, "k")
+    assert make_backend("xla", "scrypt", winner_depth=9).k == 9
+
+
+def test_mining_config_knob_validation():
+    from otedama_tpu.config.schema import AppConfig, validate_config
+
+    cfg = AppConfig()
+    cfg.mining.winner_depth = 4096
+    assert any("winner_depth" in e for e in validate_config(cfg))
+    cfg.mining.winner_depth = 16
+    cfg.mining.pipeline_depth = 100
+    assert any("pipeline_depth" in e for e in validate_config(cfg))
+    cfg.mining.pipeline_depth = 4
+    assert not [e for e in validate_config(cfg)
+                if "winner_depth" in e or "pipeline_depth" in e]
+
+
+# -- the REAL Pallas kernel, interpret mode (slow tier) -----------------------
+
+
+@pytest.mark.slow
+def test_pallas_interpret_boundary_targets():
+    """The real kernel in interpret mode at the adversarial boundaries:
+    hash == target (byte-exact winner), target - 1 (miss), winner in the
+    LAST in-range lane of the LAST tile, and a mid-tile count yielding no
+    out-of-range nonce. One 128-lane tile keeps interpret-mode runtime
+    bounded."""
+    from otedama_tpu.runtime.search import PallasBackend
+
+    probe = JobConstants.from_header_prefix(HEADER, 1)
+    tile = 128  # sub=1
+    vals = _values(probe, 0, tile)
+    w_star = min(vals, key=vals.get)
+
+    jc_eq = JobConstants.from_header_prefix(HEADER, vals[w_star])
+    backend = PallasBackend(sub=1, interpret=True)
+    res = backend.search(jc_eq, 0, tile)
+    assert [w.nonce_word for w in res.winners] == [w_star]
+    assert res.winners[0].digest == jc_eq.digest_for(w_star)
+    assert res.best_hash_hi == vals[w_star] >> 224
+
+    res = backend.search(
+        JobConstants.from_header_prefix(HEADER, vals[w_star] - 1), 0, tile
+    )
+    assert res.winners == []
+
+    # count ending exactly ON the winner lane includes it; one short
+    # excludes it (the clamp is in-kernel — nothing on the host trims)
+    res = backend.search(jc_eq, 0, w_star + 1)
+    assert [w.nonce_word for w in res.winners] == [w_star]
+    if w_star > 0:
+        res = backend.search(jc_eq, 0, w_star)
+        assert res.winners == []
+        assert all(w.nonce_word < w_star for w in res.winners)
+
+
+@pytest.mark.slow
+def test_pallas_interpret_k_overflow():
+    """> K winners in one interpret-mode launch: the kernel reports the
+    true count past K and the backend's exact rescan recovers the full
+    oracle set."""
+    from otedama_tpu.runtime.search import PallasBackend
+
+    probe = JobConstants.from_header_prefix(HEADER, 1)
+    tile = 128
+    vals = _values(probe, 0, tile)
+    target = sorted(vals.values())[5]  # 6 winners
+    jc = JobConstants.from_header_prefix(HEADER, target)
+    backend = PallasBackend(sub=1, interpret=True, winner_depth=2)
+    res = backend.search(jc, 0, tile)
+    assert sorted(w.nonce_word for w in res.winners) == sorted(
+        w for w, v in vals.items() if v <= target
+    )
