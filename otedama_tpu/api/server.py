@@ -604,6 +604,47 @@ class ApiServer:
                     help_="Share rejections by verification failure reason",
                 )
 
+    def sync_region_metrics(self, snapshot: dict,
+                            server_snapshot: dict | None = None) -> None:
+        """Multi-region replication health from a RegionReplicator
+        snapshot (+ the stratum server's handoff counters): is THIS
+        region the settlement leader, is the commit path keeping up
+        (pending commits draining, recommits healing fork races), and
+        are handoffs landing (resumes accepted vs rejected)."""
+        reg = self.registry
+        reg.gauge_set("otedama_region_id", snapshot.get("region_id", 0),
+                      help_="This front-end's region id / extranonce1 prefix")
+        reg.gauge_set("otedama_region_is_leader",
+                      1.0 if snapshot.get("is_leader") else 0.0,
+                      help_="1 when this region is the elected settlement writer")
+        reg.gauge_set("otedama_region_pending_commits",
+                      snapshot.get("pending_commits", 0),
+                      help_="Chain commits not yet settled-safe (reorg window)")
+        reg.counter_set("otedama_region_commits_total",
+                        snapshot.get("commits", 0),
+                        help_="Accepted shares committed to the share chain")
+        reg.counter_set("otedama_region_recommits_total",
+                        snapshot.get("recommits", 0),
+                        help_="Commits re-mined after falling off the best chain")
+        reg.counter_set("otedama_region_commit_failures_total",
+                        snapshot.get("commit_failures", 0),
+                        help_="Chain commits that failed (share was rejected)")
+        with reg.atomic():
+            reg.clear_family("otedama_region_share_rejects")
+            for reason, count in snapshot.get("share_rejects", {}).items():
+                reg.counter_set(
+                    "otedama_region_share_rejects", count,
+                    {"reason": reason},
+                    help_="Cross-region share rejections by reason",
+                )
+        if server_snapshot:
+            reg.counter_set("otedama_region_resumes_accepted_total",
+                            server_snapshot.get("resumes_accepted", 0),
+                            help_="Miner sessions resumed from a signed token")
+            reg.counter_set("otedama_region_resumes_rejected_total",
+                            server_snapshot.get("resumes_rejected", 0),
+                            help_="Resume tokens refused (fresh session instead)")
+
     def sync_settlement_metrics(self, snapshot: dict) -> None:
         """Settlement/payout pipeline health from a SettlementEngine
         snapshot: ledger progress (settled count, cursor vs horizon),

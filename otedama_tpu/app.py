@@ -61,6 +61,7 @@ class Application:
         self.db = None
         self.p2p = None
         self.settlement = None      # crash-safe settlement engine
+        self.regions = None         # multi-region replication layer
         self.api: ApiServer | None = None
         self.recovery = None
         self.failure_detector = None
@@ -180,10 +181,19 @@ class Application:
 
         if cfg.pool.enabled:
             await self._start_pool_side()
-        if cfg.mining.enabled:
-            await self._start_miner_side()
         if cfg.p2p.enabled:
             await self._start_p2p()
+        if cfg.region.enabled:
+            await self._start_regions()
+        # the stratum listening sockets open only now: every pool-side
+        # dependency (region replication wiring, the p2p chain) is in
+        # place before the FIRST miner can connect — a miner accepted
+        # earlier would mine an unprefixed extranonce lease, skip the
+        # cross-region duplicate check, and its accepted shares would
+        # never reach chain accounting
+        await self._start_stratum_listeners()
+        if cfg.mining.enabled:
+            await self._start_miner_side()
         if cfg.settlement.enabled:
             await self._start_settlement()
         if cfg.api.enabled:
@@ -247,7 +257,6 @@ class Application:
             on_share=self.pool.on_share,
             on_block=self.pool.on_block,
         )
-        await self.server.start()
         if cfg.stratum.v2_enabled:
             from otedama_tpu.stratum.v2 import Sv2MiningServer, Sv2ServerConfig
 
@@ -297,11 +306,19 @@ class Application:
                 on_share=self.pool.on_share,
                 on_block=self.pool.on_block,
             )
+        await self.pool.start()
+        self._started.append(self.pool)
+        self._tasks.append(asyncio.create_task(self._template_loop(chain)))
+
+    async def _start_stratum_listeners(self) -> None:
+        """Open the stratum listening sockets (see start() for why this
+        runs after region/p2p wiring, not at server construction)."""
+        if self.server is not None:
+            await self.server.start()
+            self._started.append(self.server)
+        if self.server_v2 is not None:
             await self.server_v2.start()
             self._started.append(self.server_v2)
-        await self.pool.start()
-        self._started += [self.pool, self.server]
-        self._tasks.append(asyncio.create_task(self._template_loop(chain)))
 
     async def _template_loop(self, chain) -> None:
         """Poll the chain for templates and broadcast jobs (pool mode)."""
@@ -476,6 +493,11 @@ class Application:
                 ),
                 on_job=self.engine.set_job,
             )
+            if old is not None:
+                # session handoff: present the dying upstream's resume
+                # token so a sibling region recovers our difficulty and
+                # extranonce lease instead of resetting the session
+                self.client.resume_token = old.resume_token
             self._active_upstream = selected
             await self.client.start()
             # keep shutdown bookkeeping pointed at the live client
@@ -553,6 +575,39 @@ class Application:
         await self.p2p.start()
         self._started.append(self.p2p)
 
+    async def _start_regions(self) -> None:
+        """Multi-region replication (pool/regions.py): this front-end
+        becomes one region of a replicated pool — extranonce1 space
+        partitioned by its region prefix byte, accepted shares committed
+        to the shared share chain before the miner's verdict, session
+        handoff via signed resume tokens any sibling region honours, and
+        chain-backed cross-region duplicate detection. Config validation
+        guarantees pool (front-end) and p2p (chain) are up."""
+        from otedama_tpu.pool.regions import RegionConfig, RegionReplicator
+
+        cfg = self.config.region
+        self.regions = RegionReplicator(self.p2p, RegionConfig(
+            region_id=cfg.region_id,
+            regions=tuple(cfg.regions or [cfg.region_id]),
+            session_secret=cfg.session_secret,
+            token_ttl=cfg.token_ttl,
+            recommit_interval=cfg.recommit_interval,
+        ))
+        if self.server is not None:
+            # V1 front-end joins the region: prefix allocation, resume
+            # tokens, chain dedup. (V2 session handoff is future work —
+            # its channel model replaces extranonce leases.)
+            sc = self.server.config
+            sc.extranonce1_prefix = cfg.region_id
+            sc.region_id = cfg.region_id
+            sc.session_secret = cfg.session_secret
+            sc.resume_token_ttl = cfg.token_ttl
+            sc.duplicate_checker = self.regions.seen_submission
+        if self.pool is not None:
+            self.pool.replicator = self.regions
+        await self.regions.start()
+        self._started.append(self.regions)
+
     async def _start_settlement(self) -> None:
         """Crash-safe settlement engine: share-chain PPLNS weights ->
         ledger -> balances -> exactly-once batched payouts. Config
@@ -568,6 +623,11 @@ class Application:
             config=SettlementConfig(
                 interval=cfg.interval, drain_timeout=cfg.drain_timeout,
             ),
+            # multi-region: only the deterministically elected region
+            # drives payouts over the converged chain (single writer);
+            # idempotency keys remain the split-leader backstop
+            leader_check=(self.regions.is_settlement_leader
+                          if self.regions is not None else None),
         )
         await self.settlement.start()
         self._started.append(self.settlement)
@@ -594,6 +654,8 @@ class Application:
             self.api.add_provider("pool", self.pool.snapshot)
         if self.p2p is not None:
             self.api.add_provider("p2p", self.p2p.snapshot)
+        if self.regions is not None:
+            self.api.add_provider("region", self.regions.snapshot)
         if self.settlement is not None:
             self.api.add_provider("settlement", self.settlement.snapshot)
             # operator surface: carried balances + pending/recent payouts
@@ -940,6 +1002,12 @@ class Application:
                 self.api.sync_pool_server_metrics(self.server, self.server_v2)
             if self.p2p is not None:
                 self.api.sync_p2p_metrics(self.p2p.snapshot())
+            if self.regions is not None:
+                self.api.sync_region_metrics(
+                    self.regions.snapshot(),
+                    self.server.snapshot() if self.server is not None
+                    else None,
+                )
             if self.settlement is not None:
                 self.api.sync_settlement_metrics(self.settlement.snapshot())
             self.api.sync_compile_metrics(
@@ -992,6 +1060,8 @@ class Application:
             out["pool"] = self.pool.snapshot()
         if self.p2p is not None:
             out["p2p"] = self.p2p.snapshot()
+        if self.regions is not None:
+            out["region"] = self.regions.snapshot()
         if self.settlement is not None:
             out["settlement"] = self.settlement.snapshot()
         return out

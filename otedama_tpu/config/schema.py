@@ -204,6 +204,32 @@ class SettlementSettings:
 
 
 @dataclasses.dataclass
+class RegionSettings:
+    """Multi-region pool replication (pool/regions.py): several stratum
+    front-ends ("regions") serve one logical pool over the shared share
+    chain. Requires pool mode (the front-end) AND p2p mode (the chain).
+    Each region gets a distinct ``region_id`` — its extranonce1 prefix
+    byte — and all regions share ``session_secret`` so miners hand off
+    between them with signed resume tokens."""
+
+    enabled: bool = False
+    # this front-end's region id / extranonce1 prefix byte (0..255);
+    # MUST be unique per region or their nonce spaces merge
+    region_id: int = 0
+    # every region id of the deployment (settlement leader election
+    # domain); [] = this region alone
+    regions: list = dataclasses.field(default_factory=list)
+    # deployment-wide HMAC secret signing session resume tokens; every
+    # region must hold the same value or handoff tokens verify nowhere
+    session_secret: str = ""
+    # resume tokens older than this are refused (fresh session instead)
+    token_ttl: float = 3600.0
+    # seconds between recommit sweeps re-committing shares that fell off
+    # the best chain past the reorg horizon (fork-race healing)
+    recommit_interval: float = 2.0
+
+
+@dataclasses.dataclass
 class P2PConfig:
     enabled: bool = False
     host: str = "0.0.0.0"
@@ -255,6 +281,7 @@ class AppConfig:
     pool: PoolSettings = dataclasses.field(default_factory=PoolSettings)
     settlement: SettlementSettings = dataclasses.field(
         default_factory=SettlementSettings)
+    region: RegionSettings = dataclasses.field(default_factory=RegionSettings)
     p2p: P2PConfig = dataclasses.field(default_factory=P2PConfig)
     api: ApiConfig = dataclasses.field(default_factory=ApiConfig)
     logging: LoggingConfig = dataclasses.field(default_factory=LoggingConfig)
@@ -266,6 +293,7 @@ _SECTIONS = {
     "stratum": StratumSettings,
     "pool": PoolSettings,
     "settlement": SettlementSettings,
+    "region": RegionSettings,
     "p2p": P2PConfig,
     "api": ApiConfig,
     "logging": LoggingConfig,
@@ -395,6 +423,43 @@ def validate_config(cfg: AppConfig) -> list[str]:
         errors.append("settlement.interval must be positive")
     if cfg.settlement.drain_timeout <= 0:
         errors.append("settlement.drain_timeout must be positive")
+    if cfg.region.enabled:
+        if not (cfg.pool.enabled and cfg.p2p.enabled):
+            errors.append(
+                "region.enabled requires pool.enabled (the stratum "
+                "front-end) and p2p.enabled (the shared share chain)"
+            )
+        if not cfg.region.session_secret:
+            errors.append(
+                "region.session_secret is required: without signed resume "
+                "tokens miners cannot hand off between regions"
+            )
+        if cfg.stratum.v2_enabled:
+            # the V2 server's channel extranonce assignment is a bare
+            # per-process counter and its submit path has no
+            # duplicate-checker hook: two regions would hand distinct
+            # miners identical search spaces, and replayed V2 shares
+            # would chain-commit twice. Refuse loudly until V2 grows
+            # the same partitioning/dedup seams as V1.
+            errors.append(
+                "region.enabled does not support stratum.v2_enabled yet "
+                "(V2 channels lack region extranonce partitioning and "
+                "cross-region duplicate detection)"
+            )
+    if not (0 <= cfg.region.region_id <= 255):
+        errors.append("region.region_id must fit one prefix byte (0..255)")
+    for rid in cfg.region.regions:
+        if not isinstance(rid, int) or not (0 <= rid <= 255):
+            errors.append(f"region.regions entry {rid!r} is not a byte")
+            break
+    if cfg.region.regions and cfg.region.region_id not in cfg.region.regions:
+        errors.append("region.region_id must appear in region.regions")
+    if len(set(cfg.region.regions)) != len(cfg.region.regions):
+        errors.append("region.regions must not repeat region ids")
+    if cfg.region.token_ttl <= 0:
+        errors.append("region.token_ttl must be positive")
+    if cfg.region.recommit_interval <= 0:
+        errors.append("region.recommit_interval must be positive")
     if cfg.p2p.share_difficulty <= 0:
         errors.append("p2p.share_difficulty must be positive")
     if cfg.p2p.pplns_window <= 0:
@@ -454,6 +519,14 @@ settlement:
   enabled: false       # crash-safe exactly-once payouts (needs pool + p2p)
   interval: 60.0       # seconds between settlement ticks
   drain_timeout: 10.0  # stop(): bound on waiting out an in-flight tick
+
+region:
+  enabled: false       # multi-region pool replication (needs pool + p2p)
+  region_id: 0         # THIS front-end's extranonce1 prefix byte (unique!)
+  regions: []          # all region ids, e.g. [0, 1, 2] (leader election)
+  session_secret: ""   # shared HMAC secret for miner handoff tokens
+  token_ttl: 3600.0    # resume tokens older than this start fresh
+  recommit_interval: 2.0  # fork-race healing sweep cadence, seconds
 
 p2p:
   enabled: false

@@ -46,6 +46,19 @@ class VardiffManager:
             self._workers[worker] = _WorkerWindow(self.initial_difficulty)
         return self._workers[worker]
 
+    def seed(self, worker: str, difficulty: float) -> None:
+        """Adopt an externally recovered difficulty as this worker's
+        baseline (session resume / region handoff): future retargets
+        step FROM it instead of snapping the worker back toward
+        ``initial_difficulty`` — the reset the resume token exists to
+        prevent."""
+        w = self._ensure(worker)
+        w.difficulty = min(
+            max(difficulty, self.config.min_difficulty),
+            self.config.max_difficulty,
+        )
+        w.last_retarget = time.time()
+
     def record_share(self, worker: str, when: float | None = None) -> None:
         w = self._ensure(worker)
         w.share_times.append(when if when is not None else time.time())

@@ -66,6 +66,11 @@ class P2PPool:
             "sync_pages_received": 0,
         }
         self.rejects: dict[str, int] = {}   # ShareInvalid.reason -> count
+        # region-loss chaos: a severed node keeps verifying and linking
+        # its OWN shares but neither floods nor answers/initiates sync
+        # until healed — the local chain diverges exactly like a region
+        # cut off at the network
+        self.severed = False
         self._verifying: set[bytes] = set()  # share ids in-flight on executor
         self._last_orphan_sync: dict[str, float] = {}
         self._last_prune = 0                 # shares_connected at last prune
@@ -80,6 +85,23 @@ class P2PPool:
 
     async def stop(self) -> None:
         await self.node.stop()
+
+    def sever(self) -> None:
+        """Cut this node off the overlay (region loss): close every peer
+        link and suppress gossip/sync until ``heal()``. The node keeps
+        serving local submits — a severed region's front-end does not
+        know it is severed."""
+        self.severed = True
+        for peer in list(self.node.peers.values()):
+            try:
+                peer.writer.close()
+            except Exception:
+                pass
+
+    def heal(self) -> None:
+        """Rejoin the overlay (callers re-link/redial peers) and pull
+        the survivors' suffix."""
+        self.severed = False
 
     # -- local events -> gossip ---------------------------------------------
 
@@ -116,9 +138,10 @@ class P2PPool:
         status = self.chain.connect(share)
         if status != "duplicate":
             self.stats["shares_accepted"] += 1
-            await self.node.broadcast(
-                P2PMessage(MessageType.SHARE, share.to_payload())
-            )
+            if not self.severed:
+                await self.node.broadcast(
+                    P2PMessage(MessageType.SHARE, share.to_payload())
+                )
         return status
 
     async def announce_block(self, block_hash: str, worker: str, height: int) -> None:
@@ -192,7 +215,8 @@ class P2PPool:
             self._request_sync_from(peer)
         # verified shares re-flood — orphans too: a peer further along may
         # hold the lineage we lack
-        await node.propagate(peer, msg)
+        if not self.severed:
+            await node.propagate(peer, msg)
 
     async def _on_block(self, node: P2PNode, peer: Peer, msg: P2PMessage) -> None:
         self.blocks_seen.append(dict(msg.payload))
@@ -217,6 +241,8 @@ class P2PPool:
         return False
 
     def _request_sync_from(self, peer: Peer, *, force: bool = False) -> None:
+        if self.severed:
+            return
         now = time.monotonic()
         if not force:
             last = self._last_orphan_sync.get(peer.node_id, 0.0)
@@ -248,7 +274,7 @@ class P2PPool:
 
     async def _on_sync_request(self, node: P2PNode, peer: Peer,
                                msg: P2PMessage) -> None:
-        if self._sync_fault(peer):
+        if self.severed or self._sync_fault(peer):
             return
         self.stats["sync_requests"] += 1
         locator = parse_locator(msg.payload.get("locator", []))
@@ -270,7 +296,7 @@ class P2PPool:
 
     async def _on_sync_response(self, node: P2PNode, peer: Peer,
                                 msg: P2PMessage) -> None:
-        if self._sync_fault(peer):
+        if self.severed or self._sync_fault(peer):
             return
         entries = msg.payload.get("shares", [])
         if not isinstance(entries, list):
@@ -341,6 +367,7 @@ class P2PPool:
         return {
             **self.node.snapshot(),
             **self.stats,
+            "severed": self.severed,
             "chain": self.chain.snapshot(),
             "rejects": dict(self.rejects),
             "blocks_seen": len(self.blocks_seen),

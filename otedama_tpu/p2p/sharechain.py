@@ -292,6 +292,11 @@ class ShareChain:
 
     def __init__(self, params: ChainParams | None = None):
         self.params = params or ChainParams()
+        # observer fired for EVERY share linked into the DAG (any
+        # branch, own or synced) — the multi-region replicator builds
+        # its cross-region submission index from it. Event-loop only,
+        # must not raise, must not call back into the chain.
+        self.on_connect: "Callable[[Share], None] | None" = None
         self.records: dict[bytes, _Rec] = {}
         self.orphans: dict[bytes, Share] = {}          # id -> share (FIFO)
         self._orphans_by_prev: dict[bytes, set[bytes]] = {}
@@ -401,6 +406,8 @@ class ShareChain:
         self.records[sid] = _Rec(share, height, cumwork)
         self.shares_connected += 1
         self._maybe_adopt(sid)
+        if self.on_connect is not None:
+            self.on_connect(share)
 
     def _maybe_adopt(self, sid: bytes) -> None:
         """Fork choice: heaviest cumulative work; ties break to the

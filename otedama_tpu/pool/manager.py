@@ -111,6 +111,11 @@ class PoolManager:
         self.payout_repo = PayoutRepository(db)
         self.calculator = PayoutCalculator(self.config.payout)
         self.submitter = BlockSubmitter(chain, self.blocks, SubmitterConfig())
+        # multi-region replication (pool/regions.py): when set, every
+        # accepted share is committed to the shared share chain BEFORE
+        # the local db write — the chain is the authoritative
+        # cross-region accounting, the db this region's operational copy
+        self.replicator = None
         self._job_counter = itertools.count(1)
         self._round_start = time.time()     # PROP round boundary
         self._current_reward = 0
@@ -150,6 +155,14 @@ class PoolManager:
 
     async def on_share(self, share: AcceptedShare) -> None:
         worker = share.worker_user
+        if self.replicator is not None:
+            # chain FIRST: if the commit fails the miner sees a reject
+            # and resubmits (to any region); if the db write below fails
+            # after the commit, the miner also sees a reject but its
+            # credit is already on the chain — the resubmit dies as a
+            # cross-region duplicate and settlement still pays it. Either
+            # failure order leaves chain accounting exactly-once.
+            await self.replicator.commit(share)
         # one transaction: a write failing mid-sequence (chaos: injected
         # db faults) must roll back the worker counters WITH the missing
         # share row — the servers turn the raised error into a reject, so

@@ -1,0 +1,346 @@
+"""Multi-region pool replication over the verified share chain.
+
+The decentralized-pool end state the reference sketches in
+``internal/p2p``: several stratum front-ends ("regions", separate
+processes or nodes) serve one logical pool. No front-end owns anything a
+miner would miss when it dies:
+
+- **Accounting** lives on the share chain. Every stratum share a region
+  accepts is committed as a real PoW'd chain share
+  (``P2PPool.submit_share``) whose commitment binds the worker and a
+  *submission id* — ``sha256d`` of the 80-byte stratum header, the
+  bitcoin share-id rule from ``p2p/sharechain.py`` — so converged nodes
+  agree not just on weights but on exactly WHICH submissions earned
+  them. Losing a region loses a TCP endpoint, not credit.
+
+- **Sessions** are recoverable anywhere. Extranonce1 space is
+  partitioned by a region prefix byte (two regions can never lease the
+  same nonce space), and session state travels with the miner as a
+  signed resume token (``stratum/resume.py``) any region can verify —
+  no replicated session tables.
+
+- **Duplicates** are detected across regions from the chain itself:
+  each region indexes the submission ids committed in every chain share
+  it links (best chain AND side branches), so a share replayed to a
+  second region is rejected as a duplicate even though that region's
+  per-session ``seen`` window never saw it.
+
+- **Settlement** stays single-writer by deterministic election over
+  converged chain state (``leader_region``): every converged region
+  derives the same leader from the same tip, so exactly one
+  ``SettlementEngine`` drives payouts. During a partition two sides may
+  each elect a leader — the wallet-level idempotency keys (PR 6) remain
+  the backstop for that window; the election is the mechanism, not the
+  only defence.
+
+Reorg-safe exactly-once commits: two regions extending the chain
+concurrently race forks, and the loser's shares fall off the best
+chain. The replicator therefore TRACKS every commit until it is
+settled-safe (on the best chain below ``settled_height()``), and
+re-commits a share only once its old chain record can never return —
+off the best chain and pruned past the reorg horizon. Re-committing any
+earlier could double-count the submission if the old branch were
+re-adopted; waiting for the prune makes double-count structurally
+impossible while guaranteeing eventual inclusion.
+
+Fault surface: ``region.sever`` fires on the commit path (drop = the
+verdict reached the miner but the chain commit vanished — the recommit
+loop must heal it; error = commit refused, the miner sees a reject;
+crash = the chaos driver's registered handler severs the region).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+from collections import OrderedDict
+
+from otedama_tpu.p2p import sharechain
+from otedama_tpu.stratum.server import AcceptedShare
+from otedama_tpu.utils import faults, pow_host
+
+log = logging.getLogger("otedama.pool.regions")
+
+# submission-id hex chars carried inside the chain share's committed
+# job-id field: 12 bytes = negligible collision odds within any dedup
+# window while leaving room for the human job id (MAX_JOB_ID_LEN = 64)
+SUBID_HEX = 24
+_SEVER_FAULTS = faults.STEP
+
+
+def submission_id(header: bytes) -> bytes:
+    """Region-agnostic identity of one stratum submission: ``sha256d``
+    of the exact 80 bytes the miner hashed (the share-id rule of
+    ``p2p/sharechain.py``). The same work replayed to ANY region
+    reproduces the same header, hence the same id."""
+    if len(header) != 80:
+        raise ValueError(f"stratum header must be 80 bytes, got {len(header)}")
+    return pow_host.sha256d(header)
+
+
+def encode_chain_claim(job_id: str, subid: bytes) -> str:
+    """Pack the submission id into the chain share's committed job-id
+    field (``job@subid24``) so the chain itself carries the cross-region
+    dedup index. Bounded to ``MAX_JOB_ID_LEN``."""
+    tag = subid.hex()[:SUBID_HEX]
+    keep = sharechain.MAX_JOB_ID_LEN - SUBID_HEX - 1
+    return f"{job_id[:keep]}@{tag}"
+
+
+def parse_chain_claim(chain_job_id: str) -> str | None:
+    """The submission-id hex tag of a committed chain share, or None for
+    shares not produced by a region front-end (bootstrap/test shares)."""
+    base, sep, tag = chain_job_id.rpartition("@")
+    if not sep or len(tag) != SUBID_HEX:
+        return None
+    try:
+        bytes.fromhex(tag)
+    except ValueError:
+        return None
+    return tag
+
+
+def leader_region(tip_id: bytes | None, regions: tuple[int, ...] | list[int]) -> int:
+    """Deterministic settlement leader over converged chain state: every
+    node holding the same tip derives the same leader, with the tip id
+    rotating leadership so one region's wallet outage cannot wedge
+    settlement forever. No election messages exist or are needed."""
+    rs = sorted(set(int(r) for r in regions))
+    if not rs:
+        raise ValueError("leader election needs at least one region id")
+    if tip_id is None:
+        return rs[0]
+    return rs[int.from_bytes(tip_id[:8], "big") % len(rs)]
+
+
+class RegionSevered(ConnectionError):
+    """Injected region loss refused this commit; the share is rejected
+    (the miner resubmits to a surviving region)."""
+
+
+@dataclasses.dataclass
+class RegionConfig:
+    region_id: int = 0                 # this front-end's prefix byte (0..255)
+    regions: tuple[int, ...] = (0,)    # every region id of the deployment
+    session_secret: str = ""           # shared resume-token HMAC secret
+    token_ttl: float = 3600.0
+    # seconds between recommit sweeps (dropped-commit healing); each
+    # sweep also prunes side branches so "pruned" stays current
+    recommit_interval: float = 2.0
+    # bounded cross-region dedup index (submission ids observed on the
+    # chain); like the per-session seen window, old entries age out
+    dedup_window: int = 1 << 16
+
+
+@dataclasses.dataclass
+class _Commit:
+    """One committed submission tracked until settled-safe."""
+
+    chain_id: bytes      # chain share id of the latest commit attempt
+    worker: str
+    job_id: str          # encoded chain claim (job@subid)
+    attempts: int = 1
+
+
+class RegionReplicator:
+    """One region front-end's replication layer over a ``P2PPool``."""
+
+    def __init__(self, pool, config: RegionConfig | None = None):
+        self.pool = pool
+        self.chain = pool.chain
+        self.config = config or RegionConfig()
+        if not (0 <= self.config.region_id <= 255):
+            raise ValueError("region_id must fit one extranonce1 prefix byte")
+        if self.config.region_id not in self.config.regions:
+            raise ValueError("region_id must be in the deployment's regions")
+        # subid hex tag -> chain share id, fed by chain observation (our
+        # own links AND gossiped/synced shares from other regions)
+        self._index: OrderedDict[str, bytes] = OrderedDict()
+        # commits this region owns, tracked until settled-safe
+        self._pending: dict[str, _Commit] = {}
+        # serialize local grinds so each commit extends the tip the
+        # previous one produced (self-forking would orphan our own work)
+        self._commit_lock = asyncio.Lock()
+        self._task: asyncio.Task | None = None
+        self.stats = {
+            "commits": 0,
+            "commit_failures": 0,
+            "recommits": 0,
+            "settled_safe": 0,
+            "share_rejects": {"duplicate": 0},
+        }
+        # observe every share the chain links (any branch): the chain IS
+        # the replicated dedup index. Chained so stacked observers (tests,
+        # future consumers) and the replicator can coexist.
+        prev_hook = getattr(self.chain, "on_connect", None)
+
+        def observe(share, _prev=prev_hook):
+            if _prev is not None:
+                _prev(share)
+            self._observe(share)
+
+        self.chain.on_connect = observe
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._recommit_loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+
+    # -- chain observation / cross-region dedup -------------------------------
+
+    def _observe(self, share: sharechain.Share) -> None:
+        tag = parse_chain_claim(share.job_id)
+        if tag is None:
+            return
+        self._index[tag] = share.share_id
+        self._index.move_to_end(tag)
+        while len(self._index) > self.config.dedup_window:
+            self._index.popitem(last=False)
+
+    def seen_submission(self, header: bytes) -> bool:
+        """Chain-backed duplicate check for the stratum servers
+        (``ServerConfig.duplicate_checker``): True when this 80-byte
+        submission was already committed by ANY region — here or
+        observed via gossip/sync. Counts the reject it causes."""
+        tag = submission_id(header).hex()[:SUBID_HEX]
+        if tag in self._index or tag in self._pending:
+            self.stats["share_rejects"]["duplicate"] += 1
+            return True
+        return False
+
+    # -- the commit path ------------------------------------------------------
+
+    async def commit(self, accepted: AcceptedShare) -> None:
+        """Commit one accepted stratum share to the share chain BEFORE
+        the miner sees its verdict. Raises to reject the share (the
+        chain is the authoritative accounting — a share we cannot commit
+        must not be told "accepted"); a local db failure AFTER this
+        call costs one region's operational copy, never miner credit."""
+        subid = submission_id(accepted.header)
+        tag = subid.hex()[:SUBID_HEX]
+        claim = encode_chain_claim(accepted.job_id, subid)
+        dropped = False
+        try:
+            d = faults.hit("region.sever", str(self.config.region_id),
+                           _SEVER_FAULTS)
+        except faults.FaultInjectedError:
+            self.stats["commit_failures"] += 1
+            raise
+        if d is not None:
+            if d.delay:
+                await asyncio.sleep(d.delay)
+            # drop = the nastiest split: the miner WILL see an accept but
+            # the chain commit vanishes — the recommit sweep must heal it
+            dropped = d.drop
+        try:
+            async with self._commit_lock:
+                share = await self._grind(claim, accepted.worker_user)
+                if not dropped:
+                    await self.pool.submit_share(share)
+        except Exception:
+            self.stats["commit_failures"] += 1
+            raise
+        self._pending[tag] = _Commit(
+            chain_id=b"" if dropped else share.share_id,
+            worker=accepted.worker_user, job_id=claim,
+        )
+        self.stats["commits"] += 1
+
+    async def _grind(self, claim: str, worker: str) -> sharechain.Share:
+        """Host-grind a chain share extending the local tip, off-loop
+        (the production device-derived path is future work; the grind at
+        chain ``min_difficulty`` is what ``P2PPool.announce_share``
+        already runs). One chain share per accepted stratum share:
+        uniform weight, exact PPLNS at uniform stratum difficulty."""
+        prev = self.chain.tip if self.chain.tip is not None else sharechain.GENESIS
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, lambda: sharechain.mine_share(
+                prev, worker, claim, self.chain.params.min_difficulty,
+                algorithm=self.chain.params.algorithm,
+            ),
+        )
+
+    # -- reorg-safe recommit ---------------------------------------------------
+
+    async def _recommit_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.config.recommit_interval)
+            try:
+                await self.recommit_dropped()
+            except Exception:
+                log.exception("recommit sweep failed (will retry)")
+
+    async def recommit_dropped(self) -> int:
+        """One healing sweep over tracked commits. A commit is:
+
+        - **settled-safe** (forgotten): on the best chain below
+          ``settled_height()`` — no permitted reorg can remove it;
+        - **waiting**: on the best chain above the horizon, or on a side
+          branch / in the orphan pool that could still be adopted;
+        - **gone** (re-committed): its record left the chain entirely —
+          pruned past the reorg horizon or evicted, so it can NEVER
+          return, and re-committing cannot double-count.
+        """
+        self.chain.prune_side_branches()
+        settled = self.chain.settled_height()
+        recommitted = 0
+        for tag, c in list(self._pending.items()):
+            pos = self.chain.position_of(c.chain_id) if c.chain_id else None
+            if pos is not None:
+                if pos < settled:
+                    del self._pending[tag]
+                    self.stats["settled_safe"] += 1
+                continue
+            if c.chain_id and c.chain_id in self.chain:
+                continue  # side branch / orphan: may yet be adopted
+            try:
+                async with self._commit_lock:
+                    share = await self._grind(c.job_id, c.worker)
+                    await self.pool.submit_share(share)
+            except Exception:
+                self.stats["commit_failures"] += 1
+                log.warning("recommit of %s failed (will retry)", tag)
+                continue
+            c.chain_id = share.share_id
+            c.attempts += 1
+            self.stats["recommits"] += 1
+            recommitted += 1
+        return recommitted
+
+    # -- settlement election ---------------------------------------------------
+
+    def settlement_leader(self) -> int:
+        return leader_region(self.chain.tip, self.config.regions)
+
+    def is_settlement_leader(self) -> bool:
+        """``SettlementEngine.leader_check`` hook: only the elected
+        region drives the payout pipeline this tick."""
+        return self.settlement_leader() == self.config.region_id
+
+    # -- reporting -------------------------------------------------------------
+
+    def pending_commits(self) -> int:
+        return len(self._pending)
+
+    def snapshot(self) -> dict:
+        return {
+            "region_id": self.config.region_id,
+            "regions": sorted(self.config.regions),
+            "settlement_leader": self.settlement_leader(),
+            "is_leader": self.is_settlement_leader(),
+            "pending_commits": len(self._pending),
+            "indexed_submissions": len(self._index),
+            **{k: (dict(v) if isinstance(v, dict) else v)
+               for k, v in self.stats.items()},
+        }

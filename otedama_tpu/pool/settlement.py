@@ -125,11 +125,18 @@ class SettlementEngine:
 
     def __init__(self, db, chain, wallet,
                  payout: PayoutConfig | None = None,
-                 config: SettlementConfig | None = None):
+                 config: SettlementConfig | None = None,
+                 leader_check=None):
         self.db = db
         self.chain = chain
         self.wallet = wallet
         self.config = config or SettlementConfig()
+        # multi-region single-writer election (pool/regions.py): fn() ->
+        # bool, False = another region's engine owns this tick. The
+        # wallet idempotency keys below remain the backstop for the
+        # split-leader window a partition can open — the election is the
+        # mechanism, not the only defence. None = sole writer (legacy).
+        self.leader_check = leader_check
         self.calculator = PayoutCalculator(payout)
         self.workers = WorkerRepository(db)
         self.blocks = BlockRepository(db)
@@ -147,6 +154,7 @@ class SettlementEngine:
             "resumes": 0,
             "submit_verdicts_lost": 0,
             "horizon_violations": 0,
+            "leader_skips": 0,
         }
         # one settlement pipeline at a time: ticks, manual settle_once()
         # calls, and the startup resume all serialize here
@@ -238,6 +246,13 @@ class SettlementEngine:
         """One settlement tick: finish unfinished work first, then (if
         the horizon advanced AND matured rewards exist) run one new
         settlement end to end. Returns a summary dict."""
+        if self.leader_check is not None and not self.leader_check():
+            # another region's engine is the elected writer over the
+            # converged tip — this node neither settles NEW work nor
+            # touches its ledger this tick (resume of our OWN unfinished
+            # rows still runs on start(), which is ours alone)
+            self.stats["leader_skips"] += 1
+            return {"resumed": 0, "settled": 0, "leader": False}
         async with self._gate:
             out = {"resumed": 0, "settled": 0}
             out["resumed"] = await self._resume_locked()
@@ -309,6 +324,19 @@ class SettlementEngine:
             [{"worker": s.worker, "difficulty": s.difficulty} for s in shares],
         )
         with self.db.transaction():
+            # cursor compare-and-set: with a SHARED ledger (multi-region),
+            # a fork race can let two regions' engines both pass the
+            # leader check over DIFFERENT local tips — and tip-derived
+            # keys make their settlements disjoint rows, so uniqueness
+            # alone cannot stop two overlapping windows from crediting
+            # the same shares twice. Re-reading the cursor inside the
+            # write transaction turns the race into one winner and one
+            # aborted tick that replays against the advanced cursor.
+            if self.settlements.last_tip_height() != start:
+                raise SettleInterrupted(
+                    "settlement cursor moved under us (concurrent writer "
+                    "on the shared ledger); tick will replay"
+                )
             self.settlements.create(
                 skey, tip_id.hex(), horizon, start, reward, result.pool_fee
             )
@@ -505,6 +533,8 @@ class SettlementEngine:
             "chain_height": self.chain.height,
             "horizon": self.chain.settled_height(),
             "payout_totals": totals,
+            "is_leader": (True if self.leader_check is None
+                          else bool(self.leader_check())),
             **self.stats,
         }
         out["unsettled_shares"] = max(

@@ -54,6 +54,12 @@ LATENCY_BUCKETS = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0
 )
 
+# a session that survived at least this long before dying counts as a
+# FRESH failure (reconnect backoff restarts); shorter-lived sessions
+# keep climbing the ladder so a pool that crash-loops seconds after
+# authorize still sees exponential backoff, not a reconnect storm
+BACKOFF_RESET_AFTER = 30.0
+
 
 class StratumClient:
     """One upstream pool connection."""
@@ -72,11 +78,18 @@ class StratumClient:
         self.difficulty = 1.0
         self.current_job: Job | None = None
         self.connected = asyncio.Event()
+        # signed session resume token (stratum/resume.py): captured from
+        # the subscribe result / set_resume_token notifications, presented
+        # as the 2nd subscribe param on every reconnect so ANY region of
+        # the pool recovers this session's extranonce1 + difficulty. The
+        # app's failover path carries it onto replacement clients.
+        self.resume_token = ""
         self.stats = {
             "shares_submitted": 0,
             "shares_accepted": 0,
             "shares_rejected": 0,
             "reconnects": 0,
+            "resumes_sent": 0,
             "last_accept_latency": 0.0,
         }
         # share-accept latency distribution (BASELINE config 4; the
@@ -94,6 +107,8 @@ class StratumClient:
         self._tasks: list[asyncio.Task] = []
         self._stop = False
         self._reconnect_requested = False
+        self._established = False   # this connection fully subscribed
+        self._established_at = 0.0
         # chaos runs target one upstream among several by this tag
         self._fault_tag = f"{config.host}:{config.port}"
 
@@ -133,7 +148,9 @@ class StratumClient:
 
     async def _session_loop(self) -> None:
         backoff = self.config.reconnect_initial
+        last_target: tuple[str, int] | None = None
         while not self._stop:
+            self._established = False
             try:
                 await self._connect_and_run()
                 backoff = self.config.reconnect_initial
@@ -145,6 +162,22 @@ class StratumClient:
             if self._stop:
                 return
             self.stats["reconnects"] += 1
+            # the ladder restarts for FRESH failures only: a re-pointed
+            # destination (failover / region handoff — a handoff must
+            # land in milliseconds, and the old ladder doubled across
+            # the client's whole lifetime because _connect_and_run only
+            # returns on cancel) or a session that lived long enough to
+            # prove the failure streak over. A pool that crash-loops
+            # seconds after authorize keeps climbing it.
+            target = (self.config.host, self.config.port)
+            long_lived = (
+                self._established
+                and time.monotonic() - self._established_at
+                >= min(BACKOFF_RESET_AFTER, self.config.reconnect_max)
+            )
+            if long_lived or target != last_target:
+                backoff = self.config.reconnect_initial
+            last_target = target
             delay = 0.1 if self._reconnect_requested else backoff
             self._reconnect_requested = False
             await asyncio.sleep(delay)
@@ -154,15 +187,27 @@ class StratumClient:
         cfg = self.config
         log.info("connecting to %s:%d", cfg.host, cfg.port)
         self._reader, self._writer = await asyncio.open_connection(cfg.host, cfg.port)
-        sub = await self._call("mining.subscribe", [cfg.user_agent])
-        # result: [[[notify_sub, id], ...], extranonce1, extranonce2_size]
+        params = [cfg.user_agent]
+        if self.resume_token:
+            # classic stratum's "previous session id" slot: a reconnect
+            # (to this pool OR a sibling region) resumes rather than
+            # resetting difficulty/extranonce state
+            params.append(self.resume_token)
+            self.stats["resumes_sent"] += 1
+        sub = await self._call("mining.subscribe", params)
+        # result: [[[notify_sub, id], ...], extranonce1, extranonce2_size,
+        #          resume_token?]
         if not isinstance(sub, list) or len(sub) < 3:
             raise sp.StratumError(sp.ERR_OTHER, f"bad subscribe result: {sub!r}")
         self.extranonce1 = bytes.fromhex(sub[1])
         self.extranonce2_size = int(sub[2])
+        if len(sub) > 3 and sub[3]:
+            self.resume_token = str(sub[3])
         ok = await self._call("mining.authorize", [cfg.username, cfg.password])
         if not ok:
             raise sp.StratumError(sp.ERR_UNAUTHORIZED, "authorize rejected")
+        self._established = True
+        self._established_at = time.monotonic()
         self.connected.set()
         log.info(
             "subscribed: extranonce1=%s en2_size=%d",
@@ -257,6 +302,11 @@ class StratumClient:
                 if self.on_difficulty:
                     self.on_difficulty(self.difficulty)
                 log.info("difficulty -> %g", self.difficulty)
+        elif msg.method == "mining.set_resume_token":
+            if isinstance(msg.params, list) and msg.params:
+                # refreshed after every vardiff retarget so a handoff
+                # always recovers the difficulty in force at disconnect
+                self.resume_token = str(msg.params[0])
         elif msg.method == "mining.set_extranonce":
             if isinstance(msg.params, list) and len(msg.params) >= 2:
                 self.extranonce1 = bytes.fromhex(msg.params[0])

@@ -1,0 +1,123 @@
+"""Signed stratum session resume tokens (multi-region miner handoff).
+
+A pool front-end that dies takes its session table with it. Rather than
+replicate session state between regions, every front-end of one
+deployment shares a secret and issues each subscriber a SIGNED token
+capturing the session state a reconnect must recover: the extranonce1
+(the miner's nonce-space lease — losing it would force a mid-flight
+work restart and, worse, could land the miner inside another session's
+space) and the current vardiff difficulty (losing it resets a tuned
+miner to ``initial_difficulty`` and burns minutes of retargeting).
+
+The token rides the standard stratum seams, so stock miners that echo
+the session-id parameter get handoff for free:
+
+- issued as the 4th element of the ``mining.subscribe`` result (clients
+  that read only the canonical 3 ignore it);
+- refreshed via a ``mining.set_resume_token`` notification whenever
+  vardiff retargets (the token must always describe the CURRENT state);
+- presented as the 2nd ``mining.subscribe`` parameter on reconnect —
+  the slot classic stratum reserves for "previous session id".
+
+Tokens are stateless on the server: any region verifies the HMAC with
+the shared ``session_secret`` and recovers the session without having
+ever seen the miner before. Forgery is an HMAC forgery. Replay — the
+token is a BEARER credential on a classic-stratum plaintext wire — is
+bounded by ``ttl``, and within one region by the live-session collision
+check at the accepting server (stratum/server.py); ACROSS regions a
+stolen token can alias the victim's extranonce1 lease until the ttl
+expires (each region sees only its own sessions), which costs the
+victim duplicate-rejected shares, not credit already earned. Where
+token theft is in the threat model, terminate V1 stratum behind TLS or
+a tunnel; chain-recorded single-use tokens are future work.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import hashlib
+import hmac
+import json
+import time
+
+TOKEN_VERSION = 1
+_SIG_BYTES = 16  # truncated HMAC-SHA256: 128-bit forgery resistance
+
+
+@dataclasses.dataclass(frozen=True)
+class ResumeState:
+    """What a verified token recovers on the accepting front-end."""
+
+    region_id: int        # region that ISSUED the token (telemetry only)
+    extranonce1: bytes
+    difficulty: float
+    issued_at: float
+
+
+def _sign(secret: str, payload: bytes) -> bytes:
+    return hmac.new(secret.encode(), payload, hashlib.sha256).digest()[
+        :_SIG_BYTES
+    ]
+
+
+def issue_token(secret: str, region_id: int, extranonce1: bytes,
+                difficulty: float, now: float | None = None) -> str:
+    """Encode + sign the resumable session state. ``secret`` must be the
+    deployment-wide ``region.session_secret`` or no other region will
+    honour the token."""
+    if not secret:
+        raise ValueError("resume tokens require a session secret")
+    payload = json.dumps(
+        {
+            "v": TOKEN_VERSION,
+            "r": int(region_id),
+            "e1": extranonce1.hex(),
+            "d": float(difficulty),
+            "t": round(time.time() if now is None else now, 3),
+        },
+        separators=(",", ":"),
+        sort_keys=True,
+    ).encode()
+    blob = payload + _sign(secret, payload)
+    return base64.urlsafe_b64encode(blob).decode().rstrip("=")
+
+
+def verify_token(secret: str, token: str, ttl: float,
+                 now: float | None = None) -> ResumeState | None:
+    """Verify signature + freshness and decode. Returns None for ANY
+    defect (malformed, forged, expired, future-dated) — a bad token must
+    degrade to a fresh subscribe, never to an error a miner chokes on."""
+    if not secret or not token or len(token) > 512:
+        return None
+    try:
+        blob = base64.urlsafe_b64decode(token + "=" * (-len(token) % 4))
+    except (ValueError, TypeError):
+        return None
+    if len(blob) <= _SIG_BYTES:
+        return None
+    payload, sig = blob[:-_SIG_BYTES], blob[-_SIG_BYTES:]
+    if not hmac.compare_digest(_sign(secret, payload), sig):
+        return None
+    try:
+        obj = json.loads(payload)
+        if obj.get("v") != TOKEN_VERSION:
+            return None
+        state = ResumeState(
+            region_id=int(obj["r"]),
+            extranonce1=bytes.fromhex(str(obj["e1"])),
+            difficulty=float(obj["d"]),
+            issued_at=float(obj["t"]),
+        )
+    except (KeyError, ValueError, TypeError):
+        return None
+    if not state.extranonce1 or len(state.extranonce1) > 8:
+        return None
+    if state.difficulty <= 0:
+        return None
+    now = time.time() if now is None else now
+    # expired or absurdly future-dated (a skewed issuer must not mint
+    # tokens that outlive the ttl policy)
+    if state.issued_at > now + 60.0 or now - state.issued_at > ttl:
+        return None
+    return state

@@ -29,6 +29,7 @@ from otedama_tpu.engine.types import Job, ShareOutcome
 from otedama_tpu.engine.vardiff import VardiffConfig, VardiffManager
 from otedama_tpu.kernels import target as tgt
 from otedama_tpu.stratum import protocol as sp
+from otedama_tpu.stratum import resume as session_resume
 from otedama_tpu.utils import faults
 from otedama_tpu.utils.histogram import LatencyHistogram
 from otedama_tpu.utils.pow_host import (
@@ -53,6 +54,23 @@ class ServerConfig:
     # optional custom extranonce1 allocator (session_id -> bytes); the proxy
     # uses this to nest downstream sessions inside an upstream allocation
     extranonce1_factory: Callable[[int], bytes] | None = None
+    # -- multi-region replication (pool/regions.py) --------------------------
+    # region prefix byte partitioning the extranonce1 space: front-ends
+    # with distinct prefixes can NEVER lease overlapping nonce spaces
+    # (the bare counter below would collide across processes and
+    # silently merge distinct miners' search spaces). None = single
+    # front-end legacy allocation.
+    extranonce1_prefix: int | None = None
+    region_id: int = 0                   # stamped into issued resume tokens
+    # deployment-wide HMAC secret for signed session resume tokens
+    # (stratum/resume.py); "" disables issuing AND honouring them
+    session_secret: str = ""
+    resume_token_ttl: float = 3600.0
+    # chain-backed cross-region duplicate detection: fn(header80) -> bool
+    # (True = this submission was already committed by SOME region). The
+    # per-session ``seen`` window is process-local; without this a share
+    # replayed to a second region is accepted twice.
+    duplicate_checker: Callable[[bytes], bool] | None = None
     # per-IP DDoS protection (reference: internal/security/ddos_protection.go).
     # Tunable like vardiff: operators behind NAT-heavy farms raise the
     # per-IP caps here instead of patching the guard after construction.
@@ -191,10 +209,18 @@ class StratumServer:
             "blocks_found": 0,
             "share_hook_failures": 0,
             "backlog_disconnects": 0,
+            "resumes_accepted": 0,
+            "resumes_rejected": 0,
+            "extranonce_collisions": 0,
         }
         self._server: asyncio.AbstractServer | None = None
         self._next_session = 1
         self._next_extranonce1 = 1
+        # region-prefixed lease counter: seeded randomly on first use
+        # (per boot) so a restart does not re-lease nonce spaces still
+        # alive in sibling-held resume tokens
+        self._region_counter: int | None = None
+        self._token_refresh: asyncio.Task | None = None
         from otedama_tpu.security.ddos import DDoSProtection
 
         self.ddos: DDoSProtection | None = (
@@ -209,9 +235,19 @@ class StratumServer:
         )
         addr = self._server.sockets[0].getsockname()
         self.config = dataclasses.replace(self.config, port=addr[1])
+        if self.config.session_secret:
+            self._token_refresh = asyncio.create_task(
+                self._token_refresh_loop())
         log.info("stratum server listening on %s:%d", addr[0], addr[1])
 
     async def stop(self) -> None:
+        if self._token_refresh is not None:
+            self._token_refresh.cancel()
+            try:
+                await self._token_refresh
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._token_refresh = None
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
@@ -219,6 +255,23 @@ class StratumServer:
         for s in list(self.sessions.values()):
             s.writer.close()
         self.sessions.clear()
+
+    async def _token_refresh_loop(self) -> None:
+        """Re-issue every subscribed session's resume token well inside
+        its ttl: vardiff retargets are the other refresh point, but a
+        miner that tuned early and then mined STABLY for longer than
+        ``resume_token_ttl`` would otherwise hold an expired token and
+        lose its state in exactly the long-lived-session handoff the
+        tokens exist for."""
+        interval = max(1.0, self.config.resume_token_ttl / 4)
+        while True:
+            await asyncio.sleep(interval)
+            for s in list(self.sessions.values()):
+                if s.subscribed:
+                    self._send_notification(
+                        s, "mining.set_resume_token",
+                        [self._issue_resume_token(s, s.difficulty)],
+                    )
 
     @property
     def port(self) -> int:
@@ -282,9 +335,43 @@ class StratumServer:
     def _alloc_extranonce1(self, session_id: int) -> bytes:
         if self.config.extranonce1_factory is not None:
             return self.config.extranonce1_factory(session_id)
-        v = self._next_extranonce1
-        self._next_extranonce1 += 1
-        return struct.pack(">I", v & 0xFFFFFFFF)
+        prefix = self.config.extranonce1_prefix
+        if prefix is None:
+            v = self._next_extranonce1
+            self._next_extranonce1 += 1
+            return struct.pack(">I", v & 0xFFFFFFFF)
+        # region-partitioned: [prefix byte | 24-bit counter]. The
+        # counter starts at a RANDOM point per boot: a restarted region
+        # would otherwise restart at 1 while pre-restart leases live on
+        # inside resume tokens (ttl-bounded) held by miners handed off
+        # to siblings, re-creating exactly the cross-front-end overlap
+        # this prefix exists to prevent. A collision with a LIVE local
+        # lease (a resumed pre-restart session) is skipped, counted, and
+        # logged — the collision assertion fires only when the scan
+        # cannot find a free lease at all (the space is saturated, or
+        # another allocator is flooding OUR prefix: two front-ends
+        # misconfigured with one region id).
+        if not (0 <= prefix <= 0xFF):
+            raise ValueError(f"extranonce1_prefix {prefix} is not a byte")
+        if self._region_counter is None:
+            import secrets
+
+            self._region_counter = secrets.randbits(24)
+        live = {s.extranonce1 for s in self.sessions.values()}
+        for _ in range(4096):
+            v = self._region_counter
+            self._region_counter = (v + 1) % (1 << 24)
+            en1 = bytes([prefix]) + v.to_bytes(3, "big")
+            if en1 not in live:
+                return en1
+            self.stats["extranonce_collisions"] += 1
+            log.warning(
+                "extranonce1 %s already leased (resumed pre-restart "
+                "session?); skipping", en1.hex())
+        raise AssertionError(
+            f"no free extranonce1 lease under region prefix {prefix}: "
+            "the space is saturated or the prefix is not exclusively ours"
+        )
 
     async def _handle_client(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
@@ -453,14 +540,77 @@ class StratumServer:
     def _send_notification(self, session: Session, method: str, params: list) -> None:
         self._write_line(session, sp.encode_line(sp.Message(method=method, params=params)))
 
+    def _issue_resume_token(self, session: Session, difficulty: float) -> str:
+        return session_resume.issue_token(
+            self.config.session_secret, self.config.region_id,
+            session.extranonce1, difficulty,
+        )
+
     def _send_difficulty(self, session: Session, difficulty: float) -> None:
         session.prev_difficulty = session.difficulty
         session.prev_target = session.target
         session.difficulty = difficulty
         session.target = tgt.difficulty_to_target(difficulty)
         self._send_notification(session, "mining.set_difficulty", [difficulty])
+        if self.config.session_secret and session.subscribed:
+            # the token must always describe the CURRENT session state:
+            # a handoff after a vardiff retarget must recover the tuned
+            # difficulty, not the one in force at subscribe time
+            self._send_notification(
+                session, "mining.set_resume_token",
+                [self._issue_resume_token(session, difficulty)],
+            )
+
+    async def _try_resume(self, session: Session, token: str) -> float | None:
+        """Validate a presented resume token (any region's). Returns the
+        recovered difficulty after adopting the token's extranonce1, or
+        None — every defect degrades to a fresh session, never a dead
+        one (the miner is mid-reconnect; an error would strand it)."""
+        state = None
+        try:
+            d = faults.hit("region.handoff", session.fault_tag, faults.POINT)
+            if d is not None and d.delay:
+                # a slow verifier delays only THIS miner's subscribe
+                await asyncio.sleep(d.delay)
+            state = session_resume.verify_token(
+                self.config.session_secret, token,
+                ttl=self.config.resume_token_ttl,
+            )
+        except faults.FaultInjectedError:
+            state = None
+        if state is not None and any(
+            s.extranonce1 == state.extranonce1
+            for s in self.sessions.values() if s is not session
+        ):
+            # the leased nonce space is live HERE (replayed token, or the
+            # "dead" session still draining) — refuse the alias
+            state = None
+        if state is None:
+            self.stats["resumes_rejected"] += 1
+            log.info("client %d resume token rejected; fresh session",
+                     session.id)
+            return None
+        session.extranonce1 = state.extranonce1
+        # seed vardiff with the recovered difficulty, or its fresh
+        # window (created at initial_difficulty) would snap the miner
+        # back on the very first retarget
+        self.vardiff.seed(session.vardiff_key, state.difficulty)
+        self.stats["resumes_accepted"] += 1
+        log.info("client %d resumed session issued by region %d (en1=%s)",
+                 session.id, state.region_id, state.extranonce1.hex())
+        return state.difficulty
 
     async def _on_subscribe(self, session: Session, msg: sp.Message) -> None:
+        params = msg.params or []
+        difficulty = self.config.initial_difficulty
+        # param 2 is classic stratum's "previous session id" slot: when
+        # session resume is configured it carries the signed token any
+        # region of the deployment can verify (stratum/resume.py)
+        token = str(params[1]) if len(params) > 1 and params[1] else ""
+        if token and self.config.session_secret:
+            recovered = await self._try_resume(session, token)
+            if recovered is not None:
+                difficulty = recovered
         session.subscribed = True
         result = [
             [
@@ -470,8 +620,12 @@ class StratumServer:
             session.extranonce1.hex(),
             session.extranonce2_size,
         ]
+        if self.config.session_secret:
+            # 4th element: the resume token (clients reading only the
+            # canonical 3 ignore it)
+            result.append(self._issue_resume_token(session, difficulty))
         await self._reply(session, msg.id, result)
-        self._send_difficulty(session, self.config.initial_difficulty)
+        self._send_difficulty(session, difficulty)
         session.prev_difficulty = None
         session.prev_target = None
         if self.current_job is not None:
@@ -629,6 +783,12 @@ class StratumServer:
             header = asm.header(sub.extranonce2, sub.ntime, sub.nonce_word)
         except ValueError:
             return ShareOutcome.REJECTED_INVALID, None, None
+        # cross-region duplicate window: ``session.seen`` above is
+        # process-local, so a share replayed to another front-end needs
+        # the chain-backed index (pool/regions.py) to die here too
+        checker = self.config.duplicate_checker
+        if checker is not None and checker(header):
+            return ShareOutcome.REJECTED_DUPLICATE, None, None
         return None, job, header
 
     def _judge(

@@ -42,6 +42,8 @@ Fault point registry (grep for ``faults.hit`` to verify):
     db.execute                                  (db/database.py writes)
     payout.settle                               (pool/settlement.py; tag pipeline stage)
     payout.submit                               (pool/settlement.py wallet send)
+    region.sever                                (pool/regions.py commit path; tag region id)
+    region.handoff                              (stratum/server.py resume verification; tag session id)
     pool.submitter.submit                       (pool/submitter.py retry loop)
     pool.failover.check                         (pool/failover.py; tag pool name)
     engine.batch                                (engine/engine.py; tag backend)

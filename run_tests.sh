@@ -22,6 +22,13 @@
 #                   rewind-and-replay reorg; writes a BENCH_SHARECHAIN
 #                   json artifact and fails if convergence or the reorg
 #                   never happened.
+#   region-bench    opt-in multi-region replication bench: cross-region
+#                   share-visibility convergence (accepted at region A
+#                   -> dedup-visible at region B) and kill-to-resumed
+#                   session-handoff latency between two front-ends
+#                   sharing a resume secret; writes a BENCH_REGION json
+#                   artifact and fails if visibility or any handoff
+#                   never happened.
 #   payout-bench    opt-in settlement-pipeline bench: settlement
 #                   throughput over the sqlite ledger, crash-restart
 #                   recovery time at the lost-verdict boundary, and a
@@ -73,8 +80,11 @@ case "$tier" in
   sharechain-bench)
     exec env JAX_PLATFORMS=cpu python tools/bench_sharechain.py \
       --out "${SHARECHAIN_BENCH_OUT:-BENCH_SHARECHAIN_manual.json}" "$@" ;;
+  region-bench)
+    exec env JAX_PLATFORMS=cpu python tools/bench_sharechain.py --region \
+      --out "${REGION_BENCH_OUT:-BENCH_REGION_manual.json}" "$@" ;;
   payout-bench)
     exec env JAX_PLATFORMS=cpu python tools/bench_payout.py \
       --out "${PAYOUT_BENCH_OUT:-BENCH_PAYOUT_manual.json}" "$@" ;;
-  *) echo "usage: $0 [fast|slow|all|audit|stratum-bench|switch-bench|degrade-bench|engine-bench|sharechain-bench|payout-bench] [pytest args...]" >&2; exit 2 ;;
+  *) echo "usage: $0 [fast|slow|all|audit|stratum-bench|switch-bench|degrade-bench|engine-bench|sharechain-bench|region-bench|payout-bench] [pytest args...]" >&2; exit 2 ;;
 esac

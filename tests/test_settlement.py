@@ -530,6 +530,37 @@ async def test_block_distribution_deferred_to_settlement_engine():
     assert await run(defer=True) == 0   # settlement mode: engine credits
 
 
+@pytest.mark.asyncio
+async def test_split_leader_overlapping_window_refused_by_cursor_cas():
+    """Multi-region split-leader race: two engines over ONE shared
+    ledger both pass their (local-tip) leader check during a fork race
+    and compute overlapping windows. Tip-derived keys make their rows
+    DISJOINT, so uniqueness cannot stop the double-credit — the cursor
+    compare-and-set inside the calculate transaction must: exactly one
+    writer consumes the window, the loser aborts and replays."""
+    chain = make_chain(DEPTH + 32)
+    db = Database()
+    wallet = MockWallet()
+    add_reward(db, 1_000_000)
+    eng_a = make_engine(db, chain, wallet)
+    out = await eng_a.settle_once()
+    assert out["settled"] == 1
+    horizon = chain.settled_height()
+    # engine B raced: it computed its window from the OLD cursor (0)
+    # over a slightly different local tip (horizon - 1 → different skey
+    # and payout keys than A's settlement)
+    eng_b = make_engine(db, chain, wallet)
+    stale_tip = chain.share_id_at(horizon - 2)
+    with pytest.raises(SettleInterrupted):
+        eng_b._begin(stale_tip, horizon - 1, 0,
+                     chain.chain_slice(0, horizon - 1), 1_000_000, [])
+    # nothing about A's settlement changed: balances still equal the
+    # single-winner recompute, no second settlement row exists
+    assert earned(eng_a) == expected_split(chain, 0, horizon, 1_000_000)
+    assert eng_a.settlements.counts()["total"] == 1
+    audit_ledger(eng_a, chain)
+
+
 def test_settlement_config_validation():
     from otedama_tpu.config.schema import AppConfig, validate_config
 

@@ -15,11 +15,25 @@ for, and emits a ``BENCH_SHARECHAIN_*.json`` artifact:
    single chain performs when a heavier fork lands, and how long the
    adoption (including window replay) takes.
 
-Fails loudly (exit 2) if convergence or the reorg never happens — a bench
-that silently measures a broken chain would report garbage as progress.
+``--region`` switches to the multi-region replication bench
+(pool/regions.py) and emits a ``BENCH_REGION_*.json`` artifact instead:
+
+4. **region_visibility_*** — time from a stratum share ACCEPTED (and
+   chain-committed) at region A to its submission id appearing in
+   region B's chain-backed duplicate index: the window during which a
+   cross-region replay could double-count.
+5. **handoff_*** — session-handoff latency: a miner's front-end dies
+   mid-session and the client reconnects to the sibling region with its
+   signed resume token; measured from kill to resumed-and-connected
+   with difficulty/extranonce recovered (p50/p99 over K handoffs).
+
+Fails loudly (exit 2) if convergence, the reorg, visibility, or any
+handoff never happens — a bench that silently measures a broken chain
+would report garbage as progress.
 
 Usage:
     python tools/bench_sharechain.py --out BENCH_SHARECHAIN_r09.json [--quick]
+    python tools/bench_sharechain.py --region --out BENCH_REGION_r12.json
 """
 
 from __future__ import annotations
@@ -171,14 +185,161 @@ async def bench_convergence(n_nodes: int, shares_a: int, shares_b: int) -> dict:
         await net.close()
 
 
+async def bench_region_visibility(n_shares: int) -> dict:
+    """Accepted-at-A -> dedup-visible-at-B latency over the in-memory
+    transport (commit grind + gossip + PoW verify + index)."""
+    import struct
+    import types
+
+    from otedama_tpu.p2p.memnet import MemoryNetwork
+    from otedama_tpu.pool.regions import (
+        RegionConfig,
+        RegionReplicator,
+        submission_id,
+    )
+
+    params = ChainParams(min_difficulty=BENCH_D, window=4 * n_shares,
+                         max_reorg_depth=16, sync_page=100)
+    pools = [P2PPool(NodeConfig(node_id=f"{i + 1:02x}" * 32), params)
+             for i in range(2)]
+    repls = [
+        RegionReplicator(pools[i], RegionConfig(
+            region_id=i, regions=(0, 1), session_secret="bench"))
+        for i in range(2)
+    ]
+    net = MemoryNetwork()
+    net.link(pools[0].node, pools[1].node)
+    lats: list[float] = []
+    try:
+        for k in range(n_shares):
+            header = struct.pack(">I", k) * 20
+            acc = types.SimpleNamespace(
+                header=header, worker_user="bench.w", job_id=f"jb{k}")
+            tag = submission_id(header).hex()[:24]
+            t0 = time.perf_counter()
+            await repls[0].commit(acc)
+            deadline = time.monotonic() + 30.0
+            while tag not in repls[1]._index:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"share {k} never became visible at region B")
+                await asyncio.sleep(0)
+            lats.append(time.perf_counter() - t0)
+    finally:
+        await net.close()
+    lats.sort()
+    return {
+        "visibility_shares": n_shares,
+        "region_visibility_p50_ms": round(lats[len(lats) // 2] * 1e3, 3),
+        "region_visibility_p99_ms": round(
+            lats[min(len(lats) - 1, int(len(lats) * 0.99))] * 1e3, 3),
+        "region_visibility_max_ms": round(lats[-1] * 1e3, 3),
+    }
+
+
+async def bench_region_handoff(handoffs: int) -> dict:
+    """Kill-to-resumed session-handoff latency between two front-ends
+    sharing a resume-token secret (the real StratumServer/StratumClient
+    pair over loopback TCP)."""
+    from otedama_tpu.stratum.client import ClientConfig, StratumClient
+    from otedama_tpu.stratum.server import ServerConfig, StratumServer
+
+    servers = [
+        StratumServer(ServerConfig(
+            port=0, initial_difficulty=1e-7, extranonce1_prefix=i,
+            region_id=i, session_secret="bench-handoff"))
+        for i in range(2)
+    ]
+    for s in servers:
+        await s.start()
+    client = StratumClient(ClientConfig(
+        host="127.0.0.1", port=servers[0].port, username="bench.rig",
+        reconnect_initial=0.01,
+    ))
+    lats: list[float] = []
+    try:
+        await asyncio.wait_for(client.start(), 10)
+        en1 = client.extranonce1
+        current, target = servers[0], servers[1]
+        for _ in range(handoffs):
+            client.config.port = target.port
+            before = target.stats["resumes_accepted"]
+            t0 = time.perf_counter()
+            for sess in list(current.sessions.values()):
+                if sess.writer.transport is not None:
+                    sess.writer.transport.abort()
+            deadline = time.monotonic() + 30.0
+            while (target.stats["resumes_accepted"] <= before
+                   or not client.connected.is_set()):
+                if time.monotonic() > deadline:
+                    raise RuntimeError("handoff never completed")
+                await asyncio.sleep(0.001)
+            lats.append(time.perf_counter() - t0)
+            if client.extranonce1 != en1:
+                raise RuntimeError("handoff lost the extranonce1 lease")
+            current, target = target, current
+        rejected = sum(s.stats["resumes_rejected"] for s in servers)
+        if rejected:
+            raise RuntimeError(f"{rejected} resume tokens were rejected")
+    finally:
+        await client.stop()
+        for s in servers:
+            await s.stop()
+    lats.sort()
+    return {
+        "handoffs": handoffs,
+        "handoff_p50_ms": round(lats[len(lats) // 2] * 1e3, 3),
+        "handoff_p99_ms": round(
+            lats[min(len(lats) - 1, int(len(lats) * 0.99))] * 1e3, 3),
+        "handoff_max_ms": round(lats[-1] * 1e3, 3),
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="BENCH_SHARECHAIN_manual.json")
     ap.add_argument("--nodes", type=int, default=16)
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--region", action="store_true",
+                    help="run the multi-region replication bench instead")
     args = ap.parse_args()
 
     failures: list[str] = []
+
+    if args.region:
+        n_shares, handoffs = (8, 5) if args.quick else (32, 20)
+        try:
+            vis = asyncio.run(bench_region_visibility(n_shares))
+        except RuntimeError as e:
+            vis = {}
+            failures.append(str(e))
+        try:
+            hand = asyncio.run(bench_region_handoff(handoffs))
+        except RuntimeError as e:
+            hand = {}
+            failures.append(str(e))
+        out = {
+            "bench": "region",
+            "created": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "platform": {
+                "python": platform.python_version(),
+                "machine": platform.machine(),
+            },
+            "config": {"share_difficulty": BENCH_D,
+                       "visibility_shares": n_shares,
+                       "handoffs": handoffs},
+            **vis,
+            **hand,
+            "failures": failures,
+        }
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+        print(json.dumps(out, indent=2))
+        if failures:
+            print("BENCH FAILED:", "; ".join(failures), file=sys.stderr)
+            return 2
+        return 0
     n_shares, passes, depth = (32, 2, 8) if args.quick else (64, 5, 48)
     shares_a, shares_b = (2, 4) if args.quick else (6, 10)
     nodes = max(4, args.nodes if not args.quick else 8)
