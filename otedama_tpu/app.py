@@ -243,20 +243,40 @@ class Application:
             pool_cfg.payout_interval = 0.0
             pool_cfg.defer_block_distribution = True
         self.pool = PoolManager(self.db, chain, config=pool_cfg)
-        self.server = StratumServer(
-            ServerConfig(
-                host=cfg.stratum.host,
-                port=cfg.stratum.port,
-                extranonce2_size=cfg.stratum.extranonce2_size,
-                initial_difficulty=cfg.stratum.initial_difficulty,
-                max_clients=cfg.stratum.max_clients,
-                vardiff=VardiffConfig(
-                    target_share_seconds=cfg.stratum.vardiff_target_seconds
-                ),
+        server_cfg = ServerConfig(
+            host=cfg.stratum.host,
+            port=cfg.stratum.port,
+            extranonce2_size=cfg.stratum.extranonce2_size,
+            initial_difficulty=cfg.stratum.initial_difficulty,
+            max_clients=cfg.stratum.max_clients,
+            vardiff=VardiffConfig(
+                target_share_seconds=cfg.stratum.vardiff_target_seconds
             ),
-            on_share=self.pool.on_share,
-            on_block=self.pool.on_block,
         )
+        if cfg.stratum.workers > 1:
+            # sharded front-end: N acceptor worker processes share the
+            # listening port (SO_REUSEPORT), THIS process stays the
+            # single owner of PoolManager/db/settlement and receives
+            # every accepted share over the unix-socket share bus —
+            # pool serving and mining now scale independently (the
+            # engine never competes with accept loops for this event
+            # loop). The supervisor is config/port/set_job/snapshot
+            # compatible with StratumServer, so the region wiring and
+            # metrics below don't care which one serves.
+            from otedama_tpu.stratum.shard import ShardConfig, ShardSupervisor
+
+            self.server = ShardSupervisor(
+                server_cfg,
+                ShardConfig(workers=cfg.stratum.workers),
+                on_share=self.pool.on_share,
+                on_block=self.pool.on_block,
+            )
+        else:
+            self.server = StratumServer(
+                server_cfg,
+                on_share=self.pool.on_share,
+                on_block=self.pool.on_block,
+            )
         if cfg.stratum.v2_enabled:
             from otedama_tpu.stratum.v2 import Sv2MiningServer, Sv2ServerConfig
 

@@ -145,6 +145,13 @@ class StratumSettings:
     extranonce2_size: int = 4
     max_clients: int = 10000
     vardiff_target_seconds: float = 10.0
+    # sharded front-end (stratum/shard.py): number of acceptor worker
+    # PROCESSES sharing the listening port via SO_REUSEPORT, each
+    # running its own StratumServer event loop, with shares flowing to
+    # the parent (the single PoolManager/db/settlement owner) over the
+    # unix-socket share bus. 0/1 = classic single-process serving.
+    # max_clients above is PER WORKER.
+    workers: int = 0
     # Stratum V2 (binary protocol, standard channels — stratum/v2.py);
     # served alongside V1 on its own port when enabled
     v2_enabled: bool = False
@@ -403,6 +410,16 @@ def validate_config(cfg: AppConfig) -> list[str]:
             errors.append(f"{name}.port out of range")
     if cfg.stratum.initial_difficulty <= 0:
         errors.append("stratum.initial_difficulty must be positive")
+    if not (0 <= cfg.stratum.workers <= 64):
+        # 64 acceptor processes saturate any single host long before
+        # the 16-bit worker-slice ceiling of the lease space matters
+        errors.append("stratum.workers out of range (0..64)")
+    if cfg.stratum.workers > 1 and cfg.stratum.v2_enabled:
+        errors.append(
+            "stratum.workers does not support stratum.v2_enabled yet "
+            "(V2 channels lack worker extranonce partitioning and the "
+            "share-bus duplicate seam, mirroring the region constraint)"
+        )
     if not (0 <= cfg.pool.fee_percent < 100):
         errors.append("pool.fee_percent out of range")
     if cfg.pool.pplns_window <= 0:
@@ -500,6 +517,7 @@ stratum:
   host: 0.0.0.0
   port: 3333
   initial_difficulty: 1.0
+  workers: 0          # acceptor worker processes (SO_REUSEPORT); 0 = in-process
   v2_enabled: false   # Stratum V2 binary protocol on its own port
   v2_port: 3336
   v2_noise: false     # Noise-NX encrypted transport for V2

@@ -116,6 +116,13 @@ class PoolManager:
         # the local db write — the chain is the authoritative
         # cross-region accounting, the db this region's operational copy
         self.replicator = None
+        # workers whose row this process has already ensured exists:
+        # the per-share upsert only matters for a worker's FIRST share
+        # (record_share refreshes last_seen on every share anyway), and
+        # on the submit hot path at four-digit share rates that
+        # redundant statement was a third of the ledger's db work.
+        # Names only — bounded by the real worker population.
+        self._known_workers: set[str] = set()
         self._job_counter = itertools.count(1)
         self._round_start = time.time()     # PROP round boundary
         self._current_reward = 0
@@ -168,7 +175,8 @@ class PoolManager:
         # share row — the servers turn the raised error into a reject, so
         # "every accept the miner saw is in the books exactly once" holds
         with self.db.transaction():
-            self.workers.upsert(worker)
+            if worker not in self._known_workers:
+                self.workers.upsert(worker)
             self.workers.record_share(worker, True)
             self.shares.create(
                 worker,
@@ -181,6 +189,9 @@ class PoolManager:
             credit = self.calculator.pps_credit(share.difficulty)
             if credit:
                 self.workers.credit(worker, credit)
+        # only after the commit: a rolled-back first share must retry
+        # its upsert, not skip it
+        self._known_workers.add(worker)
 
     async def on_block(self, header: bytes, job: Job, share: AcceptedShare) -> None:
         reward = self._job_rewards.get(job.job_id, self._current_reward)

@@ -61,6 +61,15 @@ class ServerConfig:
     # silently merge distinct miners' search spaces). None = single
     # front-end legacy allocation.
     extranonce1_prefix: int | None = None
+    # -- sharded front-end (stratum/shard.py) --------------------------------
+    # worker slice of the lease space, composed UNDER the region prefix:
+    # [region byte | worker_index (worker_bits) | counter]. N acceptor
+    # workers of one front-end partition the counter space exactly like
+    # regions partition the prefix space — a collision across workers
+    # would merge distinct miners' search spaces. worker_bits = 0 means
+    # unsharded (the whole counter space belongs to this process).
+    worker_index: int = 0
+    worker_bits: int = 0
     region_id: int = 0                   # stamped into issued resume tokens
     # deployment-wide HMAC secret for signed session resume tokens
     # (stratum/resume.py); "" disables issuing AND honouring them
@@ -208,6 +217,7 @@ class StratumServer:
             "shares_invalid": 0,
             "blocks_found": 0,
             "share_hook_failures": 0,
+            "hook_rejects": 0,
             "backlog_disconnects": 0,
             "resumes_accepted": 0,
             "resumes_rejected": 0,
@@ -229,10 +239,19 @@ class StratumServer:
 
     # -- lifecycle ----------------------------------------------------------
 
-    async def start(self) -> None:
-        self._server = await asyncio.start_server(
-            self._handle_client, self.config.host, self.config.port
-        )
+    async def start(self, sock=None) -> None:
+        """``sock``: an optional pre-made listening socket. The sharded
+        front-end (stratum/shard.py) binds its workers' sockets itself —
+        SO_REUSEPORT siblings on one port, or one inherited fd — and the
+        server must serve exactly that socket, not open its own."""
+        if sock is not None:
+            self._server = await asyncio.start_server(
+                self._handle_client, sock=sock
+            )
+        else:
+            self._server = await asyncio.start_server(
+                self._handle_client, self.config.host, self.config.port
+            )
         addr = self._server.sockets[0].getsockname()
         self.config = dataclasses.replace(self.config, port=addr[1])
         if self.config.session_secret:
@@ -336,32 +355,54 @@ class StratumServer:
         if self.config.extranonce1_factory is not None:
             return self.config.extranonce1_factory(session_id)
         prefix = self.config.extranonce1_prefix
-        if prefix is None:
+        wbits = self.config.worker_bits
+        if prefix is None and wbits == 0:
+            # single front-end, single process: the legacy bare counter
             v = self._next_extranonce1
             self._next_extranonce1 += 1
             return struct.pack(">I", v & 0xFFFFFFFF)
-        # region-partitioned: [prefix byte | 24-bit counter]. The
-        # counter starts at a RANDOM point per boot: a restarted region
-        # would otherwise restart at 1 while pre-restart leases live on
-        # inside resume tokens (ttl-bounded) held by miners handed off
-        # to siblings, re-creating exactly the cross-front-end overlap
-        # this prefix exists to prevent. A collision with a LIVE local
-        # lease (a resumed pre-restart session) is skipped, counted, and
-        # logged — the collision assertion fires only when the scan
-        # cannot find a free lease at all (the space is saturated, or
-        # another allocator is flooding OUR prefix: two front-ends
-        # misconfigured with one region id).
-        if not (0 <= prefix <= 0xFF):
+        # partitioned lease: [region prefix byte?][worker slice][counter].
+        # The region byte keeps FRONT-ENDS disjoint (pool/regions.py);
+        # the worker slice keeps one front-end's N acceptor WORKERS
+        # disjoint (stratum/shard.py). The counter starts at a RANDOM
+        # point per boot: a restarted process would otherwise restart at
+        # 1 while pre-restart leases live on inside resume tokens
+        # (ttl-bounded) held by miners handed off to siblings/survivors,
+        # re-creating exactly the overlap the partitioning prevents. A
+        # collision with a LIVE local lease (a resumed pre-restart
+        # session) is skipped, counted, and logged — the collision
+        # assertion fires only when the scan cannot find a free lease at
+        # all (the space is saturated, or another allocator is flooding
+        # OUR partition: two processes misconfigured with one slice).
+        if prefix is not None and not (0 <= prefix <= 0xFF):
             raise ValueError(f"extranonce1_prefix {prefix} is not a byte")
+        space_bits = 24 if prefix is not None else 32
+        counter_bits = space_bits - wbits
+        if counter_bits < 8:
+            raise ValueError(
+                f"worker_bits {wbits} leaves {counter_bits} counter bits "
+                f"in the {space_bits}-bit lease space (need >= 8)"
+            )
+        if wbits and not (0 <= self.config.worker_index < (1 << wbits)):
+            raise ValueError(
+                f"worker_index {self.config.worker_index} does not fit "
+                f"worker_bits {wbits}"
+            )
+        slice_base = self.config.worker_index << counter_bits
         if self._region_counter is None:
             import secrets
 
-            self._region_counter = secrets.randbits(24)
+            self._region_counter = secrets.randbits(counter_bits)
         live = {s.extranonce1 for s in self.sessions.values()}
         for _ in range(4096):
             v = self._region_counter
-            self._region_counter = (v + 1) % (1 << 24)
-            en1 = bytes([prefix]) + v.to_bytes(3, "big")
+            self._region_counter = (v + 1) % (1 << counter_bits)
+            lease = slice_base | v
+            en1 = (
+                bytes([prefix]) + lease.to_bytes(3, "big")
+                if prefix is not None
+                else lease.to_bytes(4, "big")
+            )
             if en1 not in live:
                 return en1
             self.stats["extranonce_collisions"] += 1
@@ -369,8 +410,9 @@ class StratumServer:
                 "extranonce1 %s already leased (resumed pre-restart "
                 "session?); skipping", en1.hex())
         raise AssertionError(
-            f"no free extranonce1 lease under region prefix {prefix}: "
-            "the space is saturated or the prefix is not exclusively ours"
+            f"no free extranonce1 lease in slice (prefix={prefix} "
+            f"worker={self.config.worker_index}/{wbits} bits): the space "
+            "is saturated or the slice is not exclusively ours"
         )
 
     async def _handle_client(
@@ -546,20 +588,29 @@ class StratumServer:
             session.extranonce1, difficulty,
         )
 
-    def _send_difficulty(self, session: Session, difficulty: float) -> None:
+    def _difficulty_lines(self, session: Session, difficulty: float) -> bytes:
+        """Retarget the session and return the wire bytes announcing it
+        (set_difficulty, plus the refreshed resume token — the token
+        must always describe the CURRENT session state: a handoff after
+        a vardiff retarget must recover the tuned difficulty, not the
+        one in force at subscribe time). Returned instead of written so
+        callers can coalesce the announcement with adjacent messages
+        into ONE transport write — a send syscall per message is the
+        dominant per-connection cost at five-digit connection counts."""
         session.prev_difficulty = session.difficulty
         session.prev_target = session.target
         session.difficulty = difficulty
         session.target = tgt.difficulty_to_target(difficulty)
-        self._send_notification(session, "mining.set_difficulty", [difficulty])
+        lines = sp.encode_line(sp.Message(
+            method="mining.set_difficulty", params=[difficulty]))
         if self.config.session_secret and session.subscribed:
-            # the token must always describe the CURRENT session state:
-            # a handoff after a vardiff retarget must recover the tuned
-            # difficulty, not the one in force at subscribe time
-            self._send_notification(
-                session, "mining.set_resume_token",
-                [self._issue_resume_token(session, difficulty)],
-            )
+            lines += sp.encode_line(sp.Message(
+                method="mining.set_resume_token",
+                params=[self._issue_resume_token(session, difficulty)]))
+        return lines
+
+    def _send_difficulty(self, session: Session, difficulty: float) -> None:
+        self._write_line(session, self._difficulty_lines(session, difficulty))
 
     async def _try_resume(self, session: Session, token: str) -> float | None:
         """Validate a presented resume token (any region's). Returns the
@@ -624,8 +675,13 @@ class StratumServer:
             # 4th element: the resume token (clients reading only the
             # canonical 3 ignore it)
             result.append(self._issue_resume_token(session, difficulty))
-        await self._reply(session, msg.id, result)
-        self._send_difficulty(session, difficulty)
+        # ONE wire flush for the whole subscribe dance: the reply,
+        # set_difficulty (+ resume token), and the current job's cached
+        # clean notify bytes were four separate transport writes — four
+        # send syscalls per connecting miner, which made the connect
+        # ramp's syscall bill the dominant cost of a five-digit fleet
+        lines = sp.encode_line(sp.Message(id=msg.id, result=result))
+        lines += self._difficulty_lines(session, difficulty)
         session.prev_difficulty = None
         session.prev_target = None
         if self.current_job is not None:
@@ -634,12 +690,12 @@ class StratumServer:
             # current_job always has an entry)
             cache = self.job_cache.get(self.current_job.job_id)
             if cache is not None:
-                self._write_line(session, cache.notify_clean_line)
+                lines += cache.notify_clean_line
             else:
-                self._send_notification(
-                    session, "mining.notify",
-                    sp.notify_params(self.current_job, True),
-                )
+                lines += sp.encode_line(sp.Message(
+                    method="mining.notify",
+                    params=sp.notify_params(self.current_job, True)))
+        self._write_line(session, lines)
         await self._maybe_drain(session)
 
     async def _on_authorize(self, session: Session, msg: sp.Message) -> None:
@@ -696,6 +752,19 @@ class StratumServer:
             if accepted is not None and self.on_share is not None:
                 try:
                     await self.on_share(accepted)
+                except sp.StratumError as e:
+                    # a POLICY reject decided by the ledger owner (e.g.
+                    # the shard supervisor or region replicator found a
+                    # cross-worker duplicate only the parent's window can
+                    # see): delivered to the miner verbatim. The share
+                    # stays in ``seen`` — it IS a known submission, and a
+                    # resubmit must reject the same way, not re-commit.
+                    session.shares_invalid += 1
+                    self.stats["shares_invalid"] += 1
+                    self.stats["hook_rejects"] += 1
+                    await self._reply_error(session, msg.id, e)
+                    self.latency.observe(time.monotonic() - t0)
+                    return
                 except Exception:
                     log.exception("share hook failed; rejecting share")
                     # un-remember the share: it was never credited, so a
@@ -732,7 +801,15 @@ class StratumServer:
             if accepted is not None and accepted.is_block:
                 self.stats["blocks_found"] += 1
                 if self.on_block is not None and job is not None:
-                    await self.on_block(accepted.header, job, accepted)
+                    try:
+                        await self.on_block(accepted.header, job, accepted)
+                    except Exception:
+                        # same guard as the hook-failure branch above:
+                        # a failing block hook (newly fallible through
+                        # the share bus) must not tear down the block
+                        # finder's session — submission has its own
+                        # retry loop
+                        log.exception("block hook failed")
         else:
             session.shares_invalid += 1
             self.stats["shares_invalid"] += 1

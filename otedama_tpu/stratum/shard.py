@@ -1,0 +1,1017 @@
+"""Sharded stratum front-end: N acceptor workers, one exact ledger.
+
+`BENCH_STRATUM_r06.json` proved the V1 share-accept SLO inside ONE
+asyncio process; millions of miners need horizontal fan-out without
+giving up the accounting guarantees the single process made easy. This
+module splits the front-end into:
+
+- **N acceptor worker processes**, each running the existing
+  ``StratumServer`` event loop unchanged — so the PR 2 hot-path caches
+  (per-job notify bytes, ``ShareAssembler`` midstates, per-session
+  target caches) stay worker-local and contention-free. Workers share
+  the listening port via ``SO_REUSEPORT`` (the kernel balances accepts
+  across them); where the platform lacks it, the supervisor opens ONE
+  listening socket and every worker serves the inherited fd.
+
+- **One supervisor** (the parent process) that remains the single
+  owner of everything money-shaped: ``PoolManager``, the database, the
+  region replicator, settlement. Workers validate shares on their own
+  loops, but every ACCEPT verdict still waits on the parent — shares
+  flow over a length-prefixed unix-socket **share bus**, and the
+  worker's ``on_share`` hook resolves only when the parent has
+  committed the share (chain-first via ``PoolManager.on_share``,
+  preserving PR 8's commit order and exactly-once guarantees). A
+  parent-side dedup window (plus the region replicator's chain-backed
+  checker, when configured) catches the duplicates no worker-local
+  ``seen`` window can see: the same submission replayed to two workers.
+
+- **Job fan-out the other way**: ``set_job`` broadcasts one wire frame
+  to every worker; each worker re-encodes its own notify bytes once
+  (the PR 2 cache) and fans them to its sessions.
+
+Ordering guarantee of the bus: each worker's shares are processed by
+the parent strictly in the order that worker forwarded them (one
+reader task per link, ack awaited before the next frame), so a
+worker's chain-first/db commit order is exactly its miners' submit
+order; shares from DIFFERENT workers interleave arbitrarily, which is
+the same freedom different regions already have.
+
+**Extranonce partitioning.** The lease space composes PR 8's region
+prefix with a worker slice: ``[region byte | worker_index
+(worker_bits) | counter]`` (no region: ``[worker_index | counter]`` in
+the 32-bit space). Two workers can never lease overlapping nonce
+spaces, collision-asserted in ``StratumServer._alloc_extranonce1``.
+
+**Crash handling.** The supervisor monitors its workers and respawns a
+dead one into the SAME slot (same worker_index, same lease slice).
+Miners of the dead worker reconnect — the kernel lands them on any
+surviving listener — and present their signed resume tokens
+(stratum/resume.py), which every worker honours because the supervisor
+gives all workers one ``session_secret`` (auto-generated per
+supervisor if the deployment didn't configure one). Shares committed
+before the crash are in the books; a share whose verdict died with the
+worker is resubmitted by the miner and either lands (never committed)
+or dies as a cross-worker duplicate (committed, verdict lost) — either
+order leaves the ledger exactly-once, the PR 8 rule.
+
+Chaos seam: the ``worker.crash`` fault point fires in each worker's
+share-forward path (tag = worker id); a seeded plan shipped via
+``ShardConfig.fault_spec`` (see ``FaultInjector.from_spec``) can crash
+a worker mid-traffic deterministically. Respawned incarnations run
+clean — the plan applies to first incarnations only, or a crash rule
+would re-fire forever and turn one injected death into a crash loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import itertools
+import json
+import logging
+import multiprocessing as mp
+import os
+import secrets
+import socket
+import struct
+import tempfile
+import time
+from collections import deque
+from typing import Awaitable, Callable
+
+from otedama_tpu.engine.types import Job
+from otedama_tpu.stratum import protocol as sp
+from otedama_tpu.stratum.server import (
+    AcceptedShare,
+    ServerConfig,
+    StratumServer,
+)
+from otedama_tpu.utils import faults
+from otedama_tpu.utils.histogram import LatencyHistogram, merge_counters
+
+log = logging.getLogger("otedama.stratum.shard")
+
+# one bus frame: 4-byte big-endian length + JSON body. Shares/jobs are
+# hundreds of bytes; anything near the cap is a protocol bug, not load.
+MAX_FRAME = 8 * 1024 * 1024
+_WORKER_CRASH_EXIT = 17  # exit code of an injected worker.crash
+
+
+# -- wire helpers -------------------------------------------------------------
+
+
+class CoalescingWriter:
+    """Batches small bus frames into ONE transport write per event-loop
+    pass. A loaded link writes a frame per share (acks parent-side,
+    share-forwards worker-side) and every ``StreamWriter.write`` is an
+    immediate ``send`` syscall — at thousands of shares/s the syscall
+    per frame IS the bus's cost (sandboxed kernels make it worse, not
+    different). Frames queued within one loop pass are joined and
+    written once via a ``call_soon`` flush; reads batch for free, so
+    this makes both directions amortized.
+
+    ``flush()`` exists for shutdown seams: a pending ``call_soon`` would
+    be lost if the writer closes first (the final worker snapshot rides
+    on it)."""
+
+    __slots__ = ("_writer", "_loop", "_chunks", "_scheduled")
+
+    def __init__(self, writer: asyncio.StreamWriter):
+        self._writer = writer
+        self._loop = asyncio.get_running_loop()
+        self._chunks: list[bytes] = []
+        self._scheduled = False
+
+    def send(self, data: bytes) -> None:
+        self._chunks.append(data)
+        if not self._scheduled:
+            self._scheduled = True
+            self._loop.call_soon(self.flush)
+
+    def flush(self) -> None:
+        self._scheduled = False
+        if not self._chunks:
+            return
+        data = b"".join(self._chunks)
+        self._chunks.clear()
+        if not self._writer.is_closing():
+            self._writer.write(data)
+
+
+def encode_frame(obj: dict) -> bytes:
+    body = json.dumps(obj, separators=(",", ":")).encode()
+    return struct.pack(">I", len(body)) + body
+
+
+async def read_frame(reader: asyncio.StreamReader) -> dict:
+    (n,) = struct.unpack(">I", await reader.readexactly(4))
+    if n > MAX_FRAME:
+        raise ValueError(f"bus frame of {n} bytes exceeds cap")
+    return json.loads(await reader.readexactly(n))
+
+
+def job_to_wire(job: Job) -> dict:
+    return {
+        "job_id": job.job_id,
+        "prev_hash": job.prev_hash.hex(),
+        "coinb1": job.coinb1.hex(),
+        "coinb2": job.coinb2.hex(),
+        "merkle_branch": [b.hex() for b in job.merkle_branch],
+        "version": job.version,
+        "nbits": job.nbits,
+        "ntime": job.ntime,
+        "clean": job.clean,
+        "algorithm": job.algorithm,
+        "block_number": job.block_number,
+        "share_target": job.share_target,
+        "received_at": job.received_at,
+    }
+
+
+def job_from_wire(d: dict) -> Job:
+    return Job(
+        job_id=str(d["job_id"]),
+        prev_hash=bytes.fromhex(d["prev_hash"]),
+        coinb1=bytes.fromhex(d["coinb1"]),
+        coinb2=bytes.fromhex(d["coinb2"]),
+        merkle_branch=[bytes.fromhex(b) for b in d["merkle_branch"]],
+        version=int(d["version"]),
+        nbits=int(d["nbits"]),
+        ntime=int(d["ntime"]),
+        clean=bool(d["clean"]),
+        algorithm=str(d["algorithm"]),
+        block_number=int(d["block_number"]),
+        share_target=int(d["share_target"]),
+        received_at=float(d["received_at"]),
+    )
+
+
+def share_to_wire(s: AcceptedShare) -> dict:
+    return {
+        "session_id": s.session_id,
+        "worker_user": s.worker_user,
+        "job_id": s.job_id,
+        "difficulty": s.difficulty,
+        "actual_difficulty": s.actual_difficulty,
+        "digest": s.digest.hex(),
+        "header": s.header.hex(),
+        "extranonce2": s.extranonce2.hex(),
+        "ntime": s.ntime,
+        "nonce_word": s.nonce_word,
+        "is_block": s.is_block,
+        "submitted_at": s.submitted_at,
+    }
+
+
+def share_from_wire(d: dict) -> AcceptedShare:
+    return AcceptedShare(
+        session_id=int(d["session_id"]),
+        worker_user=str(d["worker_user"]),
+        job_id=str(d["job_id"]),
+        difficulty=float(d["difficulty"]),
+        actual_difficulty=float(d["actual_difficulty"]),
+        digest=bytes.fromhex(d["digest"]),
+        header=bytes.fromhex(d["header"]),
+        extranonce2=bytes.fromhex(d["extranonce2"]),
+        ntime=int(d["ntime"]),
+        nonce_word=int(d["nonce_word"]),
+        is_block=bool(d["is_block"]),
+        submitted_at=float(d["submitted_at"]),
+    )
+
+
+# -- configuration ------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ShardConfig:
+    workers: int = 2
+    # bits of the lease space each worker's slice claims; 0 = auto
+    # (exactly enough for ``workers``). Respawns reuse their slot's
+    # index, so the space never needs headroom for worker churn.
+    worker_bits: int = 0
+    # unix-socket share-bus directory; "" = private tempdir
+    bus_dir: str = ""
+    respawn: bool = True
+    respawn_backoff: float = 0.5      # doubled per consecutive fast death
+    snapshot_interval: float = 1.0    # worker stats push cadence
+    hello_timeout: float = 30.0       # worker boot budget (imports + bind)
+    ack_timeout: float = 30.0         # share verdict budget on the bus
+    dedup_window: int = 1 << 16       # parent-side cross-worker dup window
+    # seeded fault plan shipped to FIRST-incarnation workers
+    # (FaultInjector.from_spec); respawns always run clean
+    fault_spec: dict | None = None
+    # multiprocessing start method; "" = fork where available (workers
+    # inherit the warm interpreter) else spawn
+    start_method: str = ""
+
+
+# fields of ServerConfig that cross the process boundary verbatim;
+# callables (extranonce1_factory, duplicate_checker) explicitly do NOT —
+# they are parent-side policy, applied on the bus before the ledger
+_WIRE_SERVER_FIELDS = (
+    "host", "port", "extranonce2_size", "initial_difficulty",
+    "job_max_age", "ntime_slack", "max_clients", "extranonce1_prefix",
+    "region_id", "session_secret", "resume_token_ttl", "ddos_enabled",
+    "max_line_bytes", "drain_high_water", "max_write_backlog",
+)
+
+
+# -- worker process -----------------------------------------------------------
+
+
+def worker_main(spec: dict) -> None:
+    """Entry point of one acceptor worker process (must stay a plain
+    top-level function: the spawn start method imports it by name)."""
+    logging.basicConfig(level=getattr(
+        logging, str(spec.get("log_level", "WARNING")).upper(), logging.WARNING))
+    # a FORKED worker inherits the supervisor's fd table: close our
+    # copies of the bus-link/listener/reserve sockets FIRST, or a
+    # respawned worker would keep siblings' parent-side bus ends alive
+    # past a supervisor crash and their EOF-based shutdown never fires
+    # (under the spawn start method these fds don't exist here — no-op)
+    for fd in spec.get("close_fds") or []:
+        try:
+            os.close(int(fd))
+        except OSError:
+            pass
+    # a forked worker inherits the parent's process-global injector —
+    # deactivate it; this worker's chaos plan (if any) is its own
+    faults.deactivate()
+    if spec.get("fault_spec"):
+        inj = faults.FaultInjector.from_spec(spec["fault_spec"])
+        # what "crash the worker" means here: die the way a segfault /
+        # OOM-kill would — no goodbye on the bus, sessions cut mid-verdict
+        inj.register_crash_handler(
+            "worker", lambda: os._exit(_WORKER_CRASH_EXIT))
+        faults.activate(inj)
+    profile_dir = os.environ.get("OTEDAMA_SHARD_PROFILE", "")
+    try:
+        if profile_dir:  # perf forensics: per-worker cProfile dump
+            import cProfile
+
+            prof = cProfile.Profile()
+            try:
+                prof.runcall(asyncio.run, _worker_async(spec))
+            finally:
+                prof.dump_stats(os.path.join(
+                    profile_dir, f"worker-{spec['worker_id']}.pstats"))
+        else:
+            asyncio.run(_worker_async(spec))
+    except KeyboardInterrupt:  # pragma: no cover - operator ^C
+        pass
+
+
+def _worker_listen_socket(spec: dict) -> socket.socket:
+    """The worker's listening socket: its own SO_REUSEPORT sibling on
+    the shared port, or the single listener inherited from the
+    supervisor by fd where the platform lacks SO_REUSEPORT."""
+    fd = spec.get("listen_fd")
+    if fd is not None:
+        sock = socket.socket(fileno=os.dup(int(fd)))
+        sock.setblocking(False)
+        return sock
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+    sock.bind((spec["host"], int(spec["port"])))
+    sock.listen(512)
+    sock.setblocking(False)
+    return sock
+
+
+async def _worker_async(spec: dict) -> None:
+    from otedama_tpu.engine.vardiff import VardiffConfig
+    from otedama_tpu.security.ddos import DDoSConfig
+
+    wid = int(spec["worker_id"])
+    reader, writer = await asyncio.open_unix_connection(spec["bus_path"])
+    loop = asyncio.get_running_loop()
+    bus = CoalescingWriter(writer)
+    ack_timeout = float(spec["ack_timeout"])
+    pending: dict[int, tuple[asyncio.Future, float]] = {}
+    seq = itertools.count(1)
+
+    async def bus_call(frame: dict) -> tuple[str, str]:
+        s = next(seq)
+        frame["seq"] = s
+        fut = loop.create_future()
+        pending[s] = (fut, loop.time() + ack_timeout)
+        bus.send(encode_frame(frame))
+        try:
+            # bare await, not wait_for: a per-call timeout wraps every
+            # share in an extra timer + callback chain (measurable at
+            # four-digit share rates); the COARSE watchdog below fails
+            # stuck acks instead, which is all the timeout ever was —
+            # protection against a wedged parent, not a latency SLO
+            return await fut
+        finally:
+            pending.pop(s, None)
+
+    async def ack_watchdog() -> None:
+        while True:
+            await asyncio.sleep(min(5.0, ack_timeout / 2))
+            now = loop.time()
+            for s, (fut, deadline) in list(pending.items()):
+                if not fut.done() and now > deadline:
+                    fut.set_exception(
+                        RuntimeError("share bus ack timeout"))
+
+    async def on_share(accepted: AcceptedShare) -> None:
+        # the worker's per-share heartbeat — chaos plans kill/stall a
+        # worker mid-traffic exactly here (before the bus send, so the
+        # dying share was never committed and the miner's resubmit to a
+        # survivor must LAND, not die as a phantom duplicate)
+        d = faults.hit("worker.crash", str(wid), faults.POINT)
+        if d is not None and d.delay:
+            await asyncio.sleep(d.delay)
+        status, error = await bus_call(
+            {"t": "share", "share": share_to_wire(accepted)})
+        if status == "dup":
+            # the parent's ledger (cross-worker window / chain index)
+            # already has this submission: a policy reject the server
+            # delivers verbatim, not an accounting failure
+            raise sp.StratumError(
+                sp.ERR_DUPLICATE, "duplicate (another worker committed it)")
+        if status != "ok":
+            raise RuntimeError(error or "share bus refused the commit")
+
+    async def on_block(header: bytes, job: Job,
+                       accepted: AcceptedShare) -> None:
+        status, error = await bus_call(
+            {"t": "block", "share": share_to_wire(accepted)})
+        if status != "ok":
+            raise RuntimeError(error or "share bus refused the block")
+
+    cfg = ServerConfig(
+        **{k: spec["server"][k] for k in _WIRE_SERVER_FIELDS},
+        vardiff=VardiffConfig(**spec["vardiff"]),
+        ddos=DDoSConfig(**spec["ddos"]) if spec.get("ddos") else None,
+        worker_index=wid,
+        worker_bits=int(spec["worker_bits"]),
+    )
+    server = StratumServer(cfg, on_share=on_share, on_block=on_block)
+    await server.start(sock=_worker_listen_socket(spec))
+
+    def push_snapshot() -> None:
+        try:
+            bus.send(encode_frame({
+                "t": "snap",
+                "worker": wid,
+                "stats": dict(server.stats),
+                "latency": server.latency.state(),
+                "sessions": len(server.sessions),
+            }))
+        except (ConnectionError, RuntimeError):  # bus gone mid-shutdown
+            pass
+
+    async def snapshot_loop() -> None:
+        while True:
+            await asyncio.sleep(float(spec["snapshot_interval"]))
+            push_snapshot()
+
+    pusher = asyncio.create_task(snapshot_loop())
+    watchdog = asyncio.create_task(ack_watchdog())
+    # hello AFTER the listener is up: the supervisor treats a hello as
+    # "this worker serves the port now"
+    bus.send(encode_frame({"t": "hello", "worker": wid, "pid": os.getpid()}))
+    try:
+        while True:
+            msg = await read_frame(reader)
+            t = msg.get("t")
+            if t == "ack":
+                entry = pending.get(int(msg.get("seq", 0)))
+                if entry is not None and not entry[0].done():
+                    entry[0].set_result(
+                        (str(msg.get("status", "err")),
+                         str(msg.get("error", "")))
+                    )
+            elif t == "job":
+                server.set_job(
+                    job_from_wire(msg["job"]), bool(msg.get("clean", True)))
+            elif t == "stop":
+                break
+            else:
+                log.warning("worker %d: unknown bus frame %r", wid, t)
+    except (asyncio.IncompleteReadError, ConnectionError):
+        # the supervisor died: no one owns the ledger — stop serving
+        log.warning("worker %d: share bus closed; shutting down", wid)
+    finally:
+        pusher.cancel()
+        watchdog.cancel()
+        push_snapshot()  # final counters for the supervisor's fold
+        bus.flush()      # a queued call_soon flush would lose the race
+        try:
+            await writer.drain()
+        except (ConnectionError, RuntimeError):
+            pass
+        await server.stop()
+        writer.close()
+
+
+# -- supervisor ---------------------------------------------------------------
+
+
+class _WorkerLink:
+    """One connected worker's bus endpoint + its latest pushed snapshot.
+    Snapshots are cumulative per incarnation; ``folded`` guards the
+    exactly-once fold into the supervisor's retired totals when the
+    link dies. Writes coalesce: under load the parent acks a frame per
+    share, and one send syscall per loop pass is the difference between
+    the bus being free and being the bottleneck."""
+
+    def __init__(self, worker_id: int, writer: asyncio.StreamWriter):
+        self.worker_id = worker_id
+        self.writer = writer
+        self.bus = CoalescingWriter(writer)
+        self.last_snap: dict | None = None
+        self.folded = False
+
+    def send(self, obj: dict) -> None:
+        if not self.writer.is_closing():
+            self.bus.send(encode_frame(obj))
+
+
+@dataclasses.dataclass
+class _WorkerProc:
+    proc: "mp.process.BaseProcess"
+    spawned_at: float
+    fast_deaths: int = 0
+
+
+ShareHook = Callable[[AcceptedShare], Awaitable[None]]
+BlockHook = Callable[[bytes, Job, AcceptedShare], Awaitable[None]]
+
+
+class ShardSupervisor:
+    """Parent-side owner of the sharded front-end.
+
+    Drop-in for ``StratumServer`` where the app composes pool serving
+    (``config``/``port``/``set_job``/``snapshot``/``latency``/lifecycle),
+    but accepts happen in N worker processes and ONLY the ledger-shaped
+    work (on_share / on_block, dedup, region duplicate_checker) runs
+    here. ``config`` is a real ``ServerConfig`` so the region wiring in
+    app.py mutates it exactly like the single-process server's.
+    """
+
+    def __init__(
+        self,
+        config: ServerConfig | None = None,
+        shard: ShardConfig | None = None,
+        on_share: ShareHook | None = None,
+        on_block: BlockHook | None = None,
+    ):
+        self.config = config or ServerConfig()
+        self.shard = shard or ShardConfig()
+        self.on_share = on_share
+        self.on_block = on_block
+        if self.config.extranonce1_factory is not None:
+            raise ValueError(
+                "extranonce1_factory cannot cross the worker process "
+                "boundary; sharded serving partitions the space instead"
+            )
+        self.stats = {
+            "shares_committed": 0,
+            "duplicates_refused": 0,
+            "share_errors": 0,
+            "blocks_relayed": 0,
+            "block_errors": 0,
+            "worker_deaths": 0,
+            "worker_respawns": 0,
+        }
+        self.jobs: dict[str, Job] = {}
+        self.current_job: Job | None = None
+        self._current_clean = True
+        self._links: dict[int, _WorkerLink] = {}
+        self._procs: dict[int, _WorkerProc] = {}
+        self._retired_stats: dict = {}
+        self._retired_latency = LatencyHistogram()
+        # header -> True (committed) | Future (commit in flight);
+        # _dedup_order tracks committed keys for O(1) oldest-first
+        # eviction — this sits on the single ledger-owner's hot path,
+        # where a full-window scan per share would be real CPU
+        self._dedup: dict[bytes, object] = {}
+        self._dedup_order: deque[bytes] = deque()
+        self._bus: asyncio.AbstractServer | None = None
+        self._bus_dir = ""
+        self._own_bus_dir = False
+        self._reserve_sock: socket.socket | None = None
+        self._listen_sock: socket.socket | None = None
+        self._monitor: asyncio.Task | None = None
+        self._respawns: set[asyncio.Task] = set()
+        self._stopping = False
+        self._ctx = None
+        self._worker_bits = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self.config.port
+
+    async def start(self) -> None:
+        shard = self.shard
+        n = max(1, int(shard.workers))
+        self._worker_bits = shard.worker_bits or (n - 1).bit_length()
+        if not self.config.session_secret:
+            # without a shared secret, a worker crash would cost every
+            # one of its miners their tuned difficulty and nonce lease.
+            # A supervisor-lifetime secret makes intra-front-end handoff
+            # work out of the box; deployments that also want CROSS
+            # front-end handoff configure region.session_secret, which
+            # the app wiring writes here before start()
+            self.config.session_secret = secrets.token_hex(32)
+        self._bus_dir = shard.bus_dir or tempfile.mkdtemp(prefix="otedama-bus-")
+        self._own_bus_dir = not shard.bus_dir
+        bus_path = os.path.join(self._bus_dir, "bus.sock")
+        self._bus = await asyncio.start_unix_server(
+            self._handle_bus_conn, path=bus_path)
+        self._bus_path = bus_path
+        self._resolve_listener()
+        method = shard.start_method or (
+            "fork" if "fork" in mp.get_all_start_methods() else "spawn")
+        if self._listen_sock is not None and method != "fork":
+            # the fd-inheritance fallback only survives into children
+            # that FORK; a spawned child closes non-passed fds and every
+            # worker would die at boot with EBADF — refuse with the
+            # cause named instead
+            raise RuntimeError(
+                "sharded serving without SO_REUSEPORT requires the fork "
+                f"start method (inherited listening fd); {method!r} "
+                "cannot carry the socket"
+            )
+        self._ctx = mp.get_context(method)
+        for wid in range(n):
+            self._spawn(wid, fault_spec=shard.fault_spec)
+        await self._await_hellos(n)
+        self._monitor = asyncio.create_task(self._monitor_loop())
+        log.info(
+            "shard supervisor serving %s:%d with %d workers (%s, %s)",
+            self.config.host, self.config.port, n, method,
+            "SO_REUSEPORT" if self._reserve_sock is not None
+            else "inherited fd",
+        )
+
+    def _resolve_listener(self) -> None:
+        """Pin down the shared port BEFORE any worker binds.
+
+        SO_REUSEPORT path: the supervisor binds (but never listens) a
+        reserve socket — port 0 resolves to a concrete port every
+        worker then binds its own listening sibling to, and the reserve
+        keeps the port ours across total worker loss (the kernel
+        balances accepts only among LISTENING sockets, so the reserve
+        never eats a connection). Fallback: one supervisor-opened
+        listening socket whose inheritable fd every worker serves.
+        """
+        host, port = self.config.host, self.config.port
+        if hasattr(socket, "SO_REUSEPORT"):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+            s.bind((host, port))
+            self._reserve_sock = s
+        else:  # pragma: no cover - non-Linux fallback
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind((host, port))
+            s.listen(512)
+            s.set_inheritable(True)
+            self._listen_sock = s
+        self.config = dataclasses.replace(
+            self.config, port=s.getsockname()[1])
+
+    def _worker_spec(self, wid: int, fault_spec: dict | None) -> dict:
+        cfg = self.config
+        return {
+            "worker_id": wid,
+            "worker_bits": self._worker_bits,
+            "bus_path": self._bus_path,
+            "host": cfg.host,
+            "port": cfg.port,
+            "listen_fd": (self._listen_sock.fileno()
+                          if self._listen_sock is not None else None),
+            "server": {k: getattr(cfg, k) for k in _WIRE_SERVER_FIELDS},
+            "vardiff": dataclasses.asdict(cfg.vardiff),
+            "ddos": dataclasses.asdict(cfg.ddos) if cfg.ddos else None,
+            "snapshot_interval": self.shard.snapshot_interval,
+            "ack_timeout": self.shard.ack_timeout,
+            "fault_spec": fault_spec,
+            "log_level": logging.getLevelName(
+                logging.getLogger().getEffectiveLevel()),
+        }
+
+    def _parent_fds(self) -> list[int]:
+        """Supervisor-side fds a forked worker must NOT keep: the live
+        siblings' accepted bus sockets (a child holding duplicates of
+        those parent-side ends would stop a supervisor crash from
+        EOFing the siblings' bus reads — their "supervisor died, stop
+        serving" path would never fire), the bus listener, and the port
+        reserve socket. Collected synchronously at spawn time (no await
+        between here and fork, so the set is exact); under the spawn
+        start method these fds don't exist in the child and closing
+        them is a no-op."""
+        fds: list[int] = []
+        for link in self._links.values():
+            sock = link.writer.get_extra_info("socket")
+            if sock is not None:
+                fds.append(sock.fileno())
+        if self._bus is not None:
+            for s in self._bus.sockets:
+                fds.append(s.fileno())
+        if self._reserve_sock is not None:
+            fds.append(self._reserve_sock.fileno())
+        return [fd for fd in fds if isinstance(fd, int) and fd >= 0]
+
+    def _spawn(self, wid: int, fault_spec: dict | None = None) -> None:
+        prev = self._procs.get(wid)
+        spec = self._worker_spec(wid, fault_spec)
+        spec["close_fds"] = self._parent_fds()
+        proc = self._ctx.Process(
+            target=worker_main,
+            args=(spec,),
+            name=f"stratum-shard-{wid}",
+            daemon=True,
+        )
+        proc.start()
+        self._procs[wid] = _WorkerProc(
+            proc=proc,
+            spawned_at=time.monotonic(),
+            fast_deaths=prev.fast_deaths if prev else 0,
+        )
+
+    async def _await_hellos(self, n: int) -> None:
+        deadline = time.monotonic() + self.shard.hello_timeout
+        while len(self._links) < n:
+            for wid, wp in self._procs.items():
+                if not wp.proc.is_alive() and wid not in self._links:
+                    raise RuntimeError(
+                        f"shard worker {wid} died during startup "
+                        f"(exit {wp.proc.exitcode})"
+                    )
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"only {len(self._links)}/{n} shard workers reported "
+                    f"in within {self.shard.hello_timeout}s"
+                )
+            await asyncio.sleep(0.05)
+
+    async def stop(self) -> None:
+        self._stopping = True
+        if self._monitor is not None:
+            self._monitor.cancel()
+            try:
+                await self._monitor
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._monitor = None
+        for t in list(self._respawns):
+            t.cancel()
+        for link in list(self._links.values()):
+            try:
+                link.send({"t": "stop"})
+                link.bus.flush()
+            except Exception:
+                pass
+        loop = asyncio.get_running_loop()
+        for wp in self._procs.values():
+            await loop.run_in_executor(None, wp.proc.join, 5.0)
+            if wp.proc.is_alive():
+                wp.proc.terminate()
+                await loop.run_in_executor(None, wp.proc.join, 1.0)
+                if wp.proc.is_alive():  # pragma: no cover - last resort
+                    wp.proc.kill()
+        self._procs.clear()
+        if self._bus is not None:
+            self._bus.close()
+            await self._bus.wait_closed()
+            self._bus = None
+        for link in list(self._links.values()):
+            self._fold_link(link)
+            link.writer.close()
+        self._links.clear()
+        for s in (self._reserve_sock, self._listen_sock):
+            if s is not None:
+                s.close()
+        self._reserve_sock = self._listen_sock = None
+        if self._own_bus_dir and self._bus_dir:
+            try:
+                os.unlink(self._bus_path)
+                os.rmdir(self._bus_dir)
+            except OSError:
+                pass
+        log.info("shard supervisor stopped")
+
+    def kill_worker(self, worker_id: int) -> None:
+        """Chaos/ops override: hard-kill one worker (SIGKILL — the
+        crash the respawn + resume-token machinery exists for)."""
+        wp = self._procs.get(worker_id)
+        if wp is not None and wp.proc.is_alive():
+            wp.proc.kill()
+
+    # -- worker supervision --------------------------------------------------
+
+    async def _monitor_loop(self) -> None:
+        while True:
+            await asyncio.sleep(0.2)
+            for wid, wp in list(self._procs.items()):
+                if wp.proc.is_alive() or self._stopping:
+                    continue
+                del self._procs[wid]
+                self.stats["worker_deaths"] += 1
+                log.warning(
+                    "shard worker %d died (exit %s); miners will resume "
+                    "on survivors", wid, wp.proc.exitcode)
+                link = self._links.pop(wid, None)
+                if link is not None:
+                    self._fold_link(link)
+                    link.writer.close()
+                if not self.shard.respawn:
+                    continue
+                lived = time.monotonic() - wp.spawned_at
+                fast = wp.fast_deaths + 1 if lived < 5.0 else 0
+                delay = min(
+                    self.shard.respawn_backoff * (2 ** fast), 10.0)
+                self.stats["worker_respawns"] += 1
+                task = asyncio.create_task(
+                    self._respawn_later(wid, delay, fast))
+                self._respawns.add(task)
+                task.add_done_callback(self._respawns.discard)
+
+    async def _respawn_later(self, wid: int, delay: float,
+                             fast_deaths: int) -> None:
+        await asyncio.sleep(delay)
+        if self._stopping:
+            return
+        # respawns run WITHOUT the chaos plan: the injected crash
+        # proved its point; a re-armed rule would crash-loop the slot
+        self._spawn(wid, fault_spec=None)
+        self._procs[wid].fast_deaths = fast_deaths
+
+    # -- bus ----------------------------------------------------------------
+
+    async def _handle_bus_conn(self, reader: asyncio.StreamReader,
+                               writer: asyncio.StreamWriter) -> None:
+        try:
+            hello = await asyncio.wait_for(
+                read_frame(reader), self.shard.hello_timeout)
+        except (asyncio.TimeoutError, asyncio.IncompleteReadError,
+                ValueError, ConnectionError):
+            writer.close()
+            return
+        if hello.get("t") != "hello":
+            writer.close()
+            return
+        wid = int(hello["worker"])
+        link = _WorkerLink(wid, writer)
+        self._links[wid] = link
+        if self.current_job is not None:
+            link.send({
+                "t": "job",
+                "job": job_to_wire(self.current_job),
+                "clean": self._current_clean,
+            })
+        try:
+            while True:
+                msg = await read_frame(reader)
+                t = msg.get("t")
+                if t == "share":
+                    await self._handle_share(link, msg)
+                elif t == "block":
+                    await self._handle_block(link, msg)
+                elif t == "snap":
+                    link.last_snap = msg
+                else:
+                    log.warning("bus: unknown frame %r from worker %d",
+                                t, wid)
+        except (asyncio.IncompleteReadError, ConnectionError, ValueError):
+            pass
+        finally:
+            if self._links.get(wid) is link:
+                del self._links[wid]
+            self._fold_link(link)
+            link.bus.flush()
+            writer.close()
+
+    async def _handle_share(self, link: _WorkerLink, msg: dict) -> None:
+        share = share_from_wire(msg["share"])
+        status, error = "ok", ""
+        key = share.header
+        # window entries: True = committed; a Future = a commit IN
+        # FLIGHT on another link. A replay racing an in-flight commit
+        # must wait for ITS outcome — answering "dup" from an entry
+        # whose commit then fails would permanently refuse a share that
+        # was never committed anywhere (the resubmitting miner's
+        # session remembers the duplicate verdict), breaking the
+        # exactly-once contract's "an uncommitted share's resubmit must
+        # LAND" half.
+        while True:
+            entry = self._dedup.get(key)
+            if entry is None:
+                break
+            if entry is True:
+                status = "dup"
+                break
+            if await entry:          # in-flight commit landed
+                status = "dup"
+                break
+            # the in-flight commit failed and popped its entry; loop —
+            # this replay may now claim the key and commit it
+        checker = self.config.duplicate_checker
+        if status == "ok" and checker is not None and checker(key):
+            # already in another region's books (chain-backed index)
+            status = "dup"
+        if status == "dup":
+            self.stats["duplicates_refused"] += 1
+        else:
+            # claim BEFORE the await: two workers racing the same
+            # header must serialize through this dict, and the handler
+            # is single-threaded only between awaits
+            claim = asyncio.get_running_loop().create_future()
+            self._dedup[key] = claim
+            try:
+                if self.on_share is not None:
+                    await self.on_share(share)
+            except Exception as e:
+                # never credited: drop the window entry so the miner's
+                # resubmit can land once accounting recovers
+                self._dedup.pop(key, None)
+                claim.set_result(False)
+                status, error = "err", str(e) or type(e).__name__
+                self.stats["share_errors"] += 1
+            else:
+                self._dedup[key] = True
+                self._dedup_order.append(key)
+                # O(1) eviction of the oldest COMMITTED entries (a key
+                # whose entry was error-popped, or re-committed later,
+                # just skips); in-flight futures are never evicted —
+                # their claim must hold until it resolves
+                while len(self._dedup_order) > self.shard.dedup_window:
+                    old = self._dedup_order.popleft()
+                    if self._dedup.get(old) is True:
+                        del self._dedup[old]
+                claim.set_result(True)
+                self.stats["shares_committed"] += 1
+            finally:
+                if not claim.done():
+                    # a BaseException (handler cancellation mid-commit)
+                    # skipped both arms: an unresolved claim would wedge
+                    # every sibling link awaiting it FOREVER — release
+                    # it as failed so replays can re-claim and commit
+                    if self._dedup.get(key) is claim:
+                        del self._dedup[key]
+                    claim.set_result(False)
+        link.send({
+            "t": "ack", "seq": msg["seq"], "status": status, "error": error,
+        })
+
+    async def _handle_block(self, link: _WorkerLink, msg: dict) -> None:
+        share = share_from_wire(msg["share"])
+        job = self.jobs.get(share.job_id)
+        status, error = "ok", ""
+        if job is None:
+            status, error = "err", f"unknown job {share.job_id!r}"
+        elif self.on_block is not None:
+            try:
+                await self.on_block(share.header, job, share)
+            except Exception as e:
+                log.exception("block hook failed")
+                status, error = "err", str(e) or type(e).__name__
+        if status == "ok":
+            self.stats["blocks_relayed"] += 1
+        else:
+            self.stats["block_errors"] += 1
+        link.send({
+            "t": "ack", "seq": msg["seq"], "status": status, "error": error,
+        })
+
+    # -- jobs ----------------------------------------------------------------
+
+    def set_job(self, job: Job, clean: bool = True) -> None:
+        """Fan one job out to every worker (each re-encodes its notify
+        bytes once, worker-locally). The supervisor keeps the Job for
+        the block path and replays the current one to (re)spawned
+        workers at hello."""
+        self.jobs[job.job_id] = job
+        if len(self.jobs) > 512:
+            for jid in list(self.jobs)[:-256]:
+                del self.jobs[jid]
+        self.current_job = job
+        self._current_clean = clean
+        frame = {"t": "job", "job": job_to_wire(job), "clean": clean}
+        for link in list(self._links.values()):
+            try:
+                link.send(frame)
+            except Exception:
+                log.exception("job fan-out to worker %d failed",
+                              link.worker_id)
+
+    # -- reporting -----------------------------------------------------------
+
+    def _fold_link(self, link: _WorkerLink) -> None:
+        """Fold a dead incarnation's LAST pushed counters into the
+        retired totals (exactly once per link). Worker snapshots lag by
+        up to one push interval, so merged WORKER counters are
+        monitoring-grade; the supervisor's own ``stats`` (every bus
+        verdict) are the exact ledger-side numbers."""
+        if link.folded or link.last_snap is None:
+            return
+        link.folded = True
+        merge_counters(self._retired_stats, link.last_snap.get("stats", {}))
+        try:
+            self._retired_latency.merge(LatencyHistogram.from_state(
+                link.last_snap["latency"]))
+        except (KeyError, ValueError):
+            log.warning("worker %d pushed a malformed latency state",
+                        link.worker_id)
+
+    @property
+    def latency(self) -> LatencyHistogram:
+        """Merged share-accept histogram across all worker incarnations
+        (the one `/metrics` SLO surface)."""
+        merged = LatencyHistogram(self._retired_latency.bounds)
+        merged.merge(self._retired_latency)
+        for link in self._links.values():
+            if link.last_snap is None:
+                continue
+            try:
+                merged.merge(LatencyHistogram.from_state(
+                    link.last_snap["latency"]))
+            except (KeyError, ValueError):
+                continue
+        return merged
+
+    def snapshot(self) -> dict:
+        merged: dict = {}
+        merge_counters(merged, self._retired_stats)
+        sessions = 0
+        per_worker: dict[int, dict] = {}
+        for wid, link in sorted(self._links.items()):
+            snap = link.last_snap
+            if snap is None:
+                continue
+            merge_counters(merged, snap.get("stats", {}))
+            sessions += int(snap.get("sessions", 0))
+            per_worker[wid] = {
+                "sessions": snap.get("sessions", 0),
+                "shares_valid": snap.get("stats", {}).get("shares_valid", 0),
+            }
+        merged.update({
+            "sessions": sessions,
+            "jobs_cached": len(self.jobs),
+            "current_job": (self.current_job.job_id
+                            if self.current_job else None),
+            "accept_latency": self.latency.snapshot(),
+            "workers": {
+                "configured": max(1, int(self.shard.workers)),
+                "alive": sum(
+                    1 for wp in self._procs.values() if wp.proc.is_alive()),
+                "deaths": self.stats["worker_deaths"],
+                "respawns": self.stats["worker_respawns"],
+                "per_worker": per_worker,
+            },
+            "bus": {k: self.stats[k] for k in (
+                "shares_committed", "duplicates_refused", "share_errors",
+                "blocks_relayed", "block_errors",
+            )},
+        })
+        return merged

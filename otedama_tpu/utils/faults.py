@@ -44,6 +44,7 @@ Fault point registry (grep for ``faults.hit`` to verify):
     payout.submit                               (pool/settlement.py wallet send)
     region.sever                                (pool/regions.py commit path; tag region id)
     region.handoff                              (stratum/server.py resume verification; tag session id)
+    worker.crash                                (stratum/shard.py worker share-forward; tag worker id)
     pool.submitter.submit                       (pool/submitter.py retry loop)
     pool.failover.check                         (pool/failover.py; tag pool name)
     engine.batch                                (engine/engine.py; tag backend)
@@ -173,6 +174,39 @@ class FaultInjector:
     one lock. The lock is only ever taken while an injector is active —
     the disabled path never reaches it.
     """
+
+    @classmethod
+    def from_spec(cls, spec: dict) -> "FaultInjector":
+        """Rebuild an injector from a plain-data plan in ANOTHER process.
+
+        The shard supervisor (stratum/shard.py) ships seeded fault plans
+        to its acceptor workers over process spawn args, so a chaos run
+        stays deterministic per worker even though each worker owns its
+        own process-global injector. Only data-only rules round-trip:
+        ``exc`` callables cannot cross the boundary (error rules raise
+        the default ``FaultInjectedError``), and crash components are
+        names the RECEIVING process must register handlers for.
+
+            {"seed": 7, "rules": [
+                {"point": "worker.crash:*", "action": "crash",
+                 "component": "worker", "every_nth": 4, "max_fires": 1}]}
+        """
+        inj = cls(seed=int(spec.get("seed", 0)))
+        for r in spec.get("rules", []):
+            window = r.get("window")
+            inj.add(FaultRule(
+                point=str(r["point"]),
+                action=str(r["action"]),
+                seconds=float(r.get("seconds", 0.0)),
+                keep_bytes=int(r.get("keep_bytes", 0)),
+                component=str(r.get("component", "")),
+                probability=float(r.get("probability", 1.0)),
+                every_nth=int(r.get("every_nth", 0)),
+                once=bool(r.get("once", False)),
+                window=(float(window[0]), float(window[1])) if window else None,
+                max_fires=int(r.get("max_fires", 0)),
+            ))
+        return inj
 
     def __init__(self, seed: int = 0):
         self.seed = int(seed)

@@ -11,6 +11,12 @@
 #                   loopback miners and writes a BENCH_STRATUM json
 #                   artifact. FAILS LOUDLY (exit 2) if the fd limit
 #                   cannot fit the soak — never silently under-tests.
+#   stratum-shard-bench  opt-in sharded front-end soak: the 10k+
+#                   connection run across STRATUM_BENCH_WORKERS
+#                   (default 4) SO_REUSEPORT acceptor processes with a
+#                   single-process control leg; asserts exact
+#                   accounting AND an identical PPLNS split between
+#                   legs; writes a BENCH_STRATUM json artifact.
 #   switch-bench    opt-in compilation-lifecycle bench: cold-start with
 #                   cold vs warm persistent XLA cache + mid-run
 #                   sha256d->scrypt warm switch; writes a BENCH_SWITCH
@@ -64,6 +70,13 @@ case "$tier" in
     exec env JAX_PLATFORMS=cpu python tools/bench_stratum.py \
       --connections "${STRATUM_BENCH_CONNS:-1000}" \
       --out "${STRATUM_BENCH_OUT:-BENCH_STRATUM_manual.json}" "$@" ;;
+  stratum-shard-bench)
+    exec env JAX_PLATFORMS=cpu python tools/bench_stratum.py \
+      --workers "${STRATUM_BENCH_WORKERS:-4}" \
+      --connections "${STRATUM_BENCH_CONNS:-10000}" \
+      --window "${STRATUM_BENCH_WINDOW:-15}" \
+      --control \
+      --out "${STRATUM_BENCH_OUT:-BENCH_STRATUM_manual.json}" "$@" ;;
   switch-bench)
     exec env JAX_PLATFORMS=cpu python tools/bench_switch.py \
       --out "${SWITCH_BENCH_OUT:-BENCH_SWITCH_manual.json}" "$@" ;;
@@ -86,5 +99,5 @@ case "$tier" in
   payout-bench)
     exec env JAX_PLATFORMS=cpu python tools/bench_payout.py \
       --out "${PAYOUT_BENCH_OUT:-BENCH_PAYOUT_manual.json}" "$@" ;;
-  *) echo "usage: $0 [fast|slow|all|audit|stratum-bench|switch-bench|degrade-bench|engine-bench|sharechain-bench|region-bench|payout-bench] [pytest args...]" >&2; exit 2 ;;
+  *) echo "usage: $0 [fast|slow|all|audit|stratum-bench|stratum-shard-bench|switch-bench|degrade-bench|engine-bench|sharechain-bench|region-bench|payout-bench] [pytest args...]" >&2; exit 2 ;;
 esac

@@ -88,8 +88,11 @@ async def test_proxy_relays_shares_upstream():
     async def on_up_share(s):
         upstream_accepted.append(s)
 
+    # 1e-5, not 0.001: the 2^24-nonce search below expected only ~4
+    # hits at 0.001 — a ~2% chance per run of finding NONE (ntime is
+    # wall-clock, so every run was a fresh lottery)
     upstream = StratumServer(
-        ServerConfig(port=0, initial_difficulty=0.001, extranonce2_size=4),
+        ServerConfig(port=0, initial_difficulty=1e-5, extranonce2_size=4),
         on_share=on_up_share,
     )
     await upstream.start()
@@ -100,7 +103,7 @@ async def test_proxy_relays_shares_upstream():
         upstream=ClientConfig(host="127.0.0.1", port=upstream.port,
                               username="proxywallet.agg"),
         session_prefix_bytes=2,
-        downstream_difficulty=0.001,
+        downstream_difficulty=1e-5,
     ))
     await proxy.start()
     await asyncio.sleep(0.2)  # upstream job propagates downstream
@@ -122,7 +125,7 @@ async def test_proxy_relays_shares_upstream():
     # mine a share against the downstream job
     en2 = b"\x00" * job.extranonce2_size
     prefix76 = jobmod.build_header_prefix(job, en2)
-    target = tgt.difficulty_to_target(0.001)
+    target = tgt.difficulty_to_target(1e-5)
     nonce = next(
         n for n in range(1 << 24)
         if tgt.hash_meets_target(pow_digest(prefix76 + struct.pack(">I", n)), target)
@@ -164,8 +167,11 @@ async def test_proxy_zero_width_prefix_upstream_en2_size_one():
     async def on_up_share(s):
         upstream_accepted.append(s)
 
+    # 1e-5, not 0.001: at 0.001 the 2^24-nonce search below expected
+    # only ~4 hits — a ~2% chance per run of finding NONE (ntime is
+    # wall-clock, so every run was a fresh lottery; it bit in CI)
     upstream = StratumServer(
-        ServerConfig(port=0, initial_difficulty=0.001, extranonce2_size=1),
+        ServerConfig(port=0, initial_difficulty=1e-5, extranonce2_size=1),
         on_share=on_up_share,
     )
     await upstream.start()
@@ -176,7 +182,7 @@ async def test_proxy_zero_width_prefix_upstream_en2_size_one():
         upstream=ClientConfig(host="127.0.0.1", port=upstream.port,
                               username="proxywallet.agg"),
         session_prefix_bytes=2,  # impossible: must shrink to 0
-        downstream_difficulty=0.001,
+        downstream_difficulty=1e-5,
     ))
     await proxy.start()
     assert proxy.config.session_prefix_bytes == 0
@@ -198,7 +204,7 @@ async def test_proxy_zero_width_prefix_upstream_en2_size_one():
 
     en2 = b"\x00"
     prefix76 = jobmod.build_header_prefix(job, en2)
-    target = tgt.difficulty_to_target(0.001)
+    target = tgt.difficulty_to_target(1e-5)
     nonce = next(
         n for n in range(1 << 24)
         if tgt.hash_meets_target(pow_digest(prefix76 + struct.pack(">I", n)), target)

@@ -1,0 +1,593 @@
+"""Sharded stratum front-end tests (stratum/shard.py).
+
+Covers the merge helpers the aggregated snapshot rides on, the
+worker-sliced extranonce partitioning (disjointness + the saturation
+assertion), the share-bus wire forms, supervisor end-to-end exact
+accounting over real TCP + real worker processes, cross-worker
+duplicate refusal through the parent ledger, and the worker-crash
+chaos scenario: a seeded ``worker.crash`` plan kills workers
+mid-traffic, the supervisor respawns them, and miners resume via PR 8
+tokens on surviving workers with every share in the books exactly once.
+
+The 10k-connection soak lives in the slow tier
+(``test_shard_soak_10k_connections``) and as the opt-in
+``./run_tests.sh stratum-shard-bench`` target.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import importlib.util
+import os
+import struct
+import time
+
+import pytest
+
+from otedama_tpu.engine import jobs as jobmod
+from otedama_tpu.engine.types import Job
+from otedama_tpu.kernels import target as tgt
+from otedama_tpu.stratum import protocol as sp
+from otedama_tpu.stratum.server import ServerConfig, Session, StratumServer
+from otedama_tpu.stratum.shard import (
+    ShardConfig,
+    ShardSupervisor,
+    job_from_wire,
+    job_to_wire,
+    share_from_wire,
+    share_to_wire,
+)
+from otedama_tpu.utils import faults
+from otedama_tpu.utils.histogram import LatencyHistogram, merge_counters
+from otedama_tpu.utils.sha256_host import sha256d
+
+EASY = 1e-7
+
+
+def _bench_module():
+    """Import tools/bench_stratum.py by path (tools/ is not a package)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_stratum", os.path.join(root, "tools", "bench_stratum.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def make_job(job_id: str = "sj1") -> Job:
+    return Job(
+        job_id=job_id,
+        prev_hash=bytes(32),
+        coinb1=bytes.fromhex("01000000010000000000000000"),
+        coinb2=bytes.fromhex("ffffffff0100f2052a01000000"),
+        merkle_branch=[bytes(range(32))],
+        version=0x20000000,
+        nbits=0x1D00FFFF,
+        ntime=1_700_000_000,
+        clean=True,
+        algorithm="sha256d",
+    )
+
+
+def mine(job: Job, en1: bytes, en2: bytes, difficulty: float = EASY) -> int:
+    target = tgt.difficulty_to_target(difficulty)
+    j = dataclasses.replace(job, extranonce1=en1)
+    prefix = jobmod.build_header_prefix(j, en2)
+    for nonce in range(1 << 22):
+        if tgt.hash_meets_target(
+                sha256d(prefix + struct.pack(">I", nonce)), target):
+            return nonce
+    raise AssertionError("unlucky premine")
+
+
+# -- merge helpers (satellite) ------------------------------------------------
+
+
+def test_histogram_merge_bucketwise_sum():
+    a, b = LatencyHistogram(), LatencyHistogram()
+    for v in (0.002, 0.004, 0.04):
+        a.observe(v)
+    for v in (0.002, 0.3, 4.0):
+        b.observe(v)
+    a.merge(b)
+    assert a.count == 6
+    assert a.sum == pytest.approx(0.002 + 0.004 + 0.04 + 0.002 + 0.3 + 4.0)
+    # cumulative counts are the sum of both inputs' cumulative counts
+    assert a.cumulative()[0.0025] == 2
+    assert a.cumulative()[5.0] == 6
+    assert a.quantile(0.99) == 5.0
+
+
+def test_histogram_merge_bounds_checked():
+    a = LatencyHistogram()
+    b = LatencyHistogram((0.5, 1.0))
+    with pytest.raises(ValueError):
+        a.merge(b)
+    # malformed worker state fails loudly too
+    with pytest.raises(ValueError):
+        LatencyHistogram.from_state(
+            {"bounds": [0.5, 1.0], "counts": [1], "sum": 0.1, "count": 1})
+    with pytest.raises(ValueError):
+        LatencyHistogram.from_state(
+            {"bounds": [0.5], "counts": [-1], "sum": 0.1, "count": 1})
+
+
+def test_histogram_state_roundtrip():
+    a = LatencyHistogram()
+    for v in (0.001, 0.02, 0.7):
+        a.observe(v)
+    b = LatencyHistogram.from_state(a.state())
+    assert b.cumulative() == a.cumulative()
+    assert b.sum == a.sum and b.count == a.count
+    assert b.snapshot() == a.snapshot()
+
+
+def test_merge_counters():
+    dst = {"shares_valid": 3, "rejects": {"stale": 1}, "ok": True,
+           "name": "w0"}
+    out = merge_counters(dst, {
+        "shares_valid": 2, "shares_invalid": 5,
+        "rejects": {"stale": 2, "dup": 1},
+        "ok": False, "name": "w1", "rate": 0.5,
+    })
+    assert out is dst
+    assert dst["shares_valid"] == 5 and dst["shares_invalid"] == 5
+    assert dst["rejects"] == {"stale": 3, "dup": 1}
+    # bools and strings are not counters: first value wins
+    assert dst["ok"] is True and dst["name"] == "w0"
+    assert dst["rate"] == 0.5
+
+
+# -- extranonce worker slices -------------------------------------------------
+
+
+def test_worker_slices_disjoint():
+    # no region prefix: slices partition the 32-bit space
+    s0 = StratumServer(ServerConfig(worker_index=0, worker_bits=2))
+    s1 = StratumServer(ServerConfig(worker_index=3, worker_bits=2))
+    a = {s0._alloc_extranonce1(i) for i in range(500)}
+    b = {s1._alloc_extranonce1(i) for i in range(500)}
+    assert len(a) == len(b) == 500
+    assert not (a & b)
+    assert all(int.from_bytes(x, "big") >> 30 == 0 for x in a)
+    assert all(int.from_bytes(x, "big") >> 30 == 3 for x in b)
+
+
+def test_worker_slices_compose_under_region_prefix():
+    # [region byte | worker bits | counter]
+    s = StratumServer(ServerConfig(
+        extranonce1_prefix=7, worker_index=2, worker_bits=3))
+    for i in range(100):
+        en1 = s._alloc_extranonce1(i)
+        assert len(en1) == 4
+        assert en1[0] == 7
+        assert int.from_bytes(en1[1:], "big") >> 21 == 2
+    # a sibling worker under the same region can never overlap
+    sib = StratumServer(ServerConfig(
+        extranonce1_prefix=7, worker_index=5, worker_bits=3))
+    mine_ = {s._alloc_extranonce1(i) for i in range(200)}
+    theirs = {sib._alloc_extranonce1(i) for i in range(200)}
+    assert not (mine_ & theirs)
+
+
+def test_worker_slice_saturation_asserts():
+    # worker_bits=16 under a region prefix leaves an 8-bit counter:
+    # occupy all 256 leases with live sessions and the scan must refuse
+    # loudly instead of silently re-leasing a live nonce space
+    s = StratumServer(ServerConfig(
+        extranonce1_prefix=1, worker_index=9, worker_bits=16))
+    for i in range(256):
+        lease = (9 << 8) | i
+        s.sessions[i] = Session(
+            id=i, peer="t", extranonce1=b"\x01" + lease.to_bytes(3, "big"),
+            extranonce2_size=4, writer=None,
+        )
+    with pytest.raises(AssertionError):
+        s._alloc_extranonce1(1000)
+    assert s.stats["extranonce_collisions"] >= 256
+
+
+def test_worker_bits_floor_refused():
+    s = StratumServer(ServerConfig(
+        extranonce1_prefix=1, worker_index=0, worker_bits=17))
+    with pytest.raises(ValueError):
+        s._alloc_extranonce1(1)
+    s2 = StratumServer(ServerConfig(worker_index=4, worker_bits=2))
+    with pytest.raises(ValueError):
+        s2._alloc_extranonce1(1)  # index does not fit the bits
+
+
+# -- wire forms ---------------------------------------------------------------
+
+
+def test_share_bus_wire_roundtrip():
+    job = make_job()
+    assert job_from_wire(job_to_wire(job)) == job
+    from otedama_tpu.stratum.server import AcceptedShare
+
+    share = AcceptedShare(
+        session_id=42, worker_user="w.1", job_id="sj1", difficulty=EASY,
+        actual_difficulty=3e-7, digest=b"\x01" * 32, header=b"\x02" * 80,
+        extranonce2=b"\x00\x00\x00\x07", ntime=1_700_000_000,
+        nonce_word=0xDEADBEEF, is_block=False, submitted_at=123.5,
+    )
+    assert share_from_wire(share_to_wire(share)) == share
+
+
+def test_fault_spec_determinism():
+    spec = {"seed": 11, "rules": [
+        {"point": "worker.crash:*", "action": "error",
+         "probability": 0.5, "max_fires": 3},
+    ]}
+
+    def pattern(inj):
+        out = []
+        for _ in range(20):
+            try:
+                inj.hit("worker.crash", "2", faults.POINT)
+                out.append(0)
+            except faults.FaultInjectedError:
+                out.append(1)
+        return out
+
+    a = pattern(faults.FaultInjector.from_spec(spec))
+    b = pattern(faults.FaultInjector.from_spec(spec))
+    assert a == b and sum(a) == 3
+    # and matches a directly-built injector with the same plan
+    c = pattern(faults.FaultInjector(seed=11).error(
+        "worker.crash:*", probability=0.5, max_fires=3))
+    assert a == c
+
+
+# -- live supervisor ----------------------------------------------------------
+
+
+class _MinerConn:
+    """Raw-wire test miner with PR 8 resume-token handoff: stores the
+    token from subscribe/set_resume_token and re-presents it in the
+    classic previous-session-id slot on reconnect."""
+
+    def __init__(self, ident: int, port: int):
+        self.ident = ident
+        self.port = port
+        self.reader = None
+        self.writer = None
+        self.extranonce1 = b""
+        self.token = ""
+        self.reconnects = 0
+        self.resumed_all = True  # every reconnect recovered our lease
+        self._msg_id = 100
+
+    async def connect(self) -> None:
+        last: Exception | None = None
+        for attempt in range(60):
+            try:
+                await self._handshake()
+                return
+            except (OSError, ConnectionError, asyncio.TimeoutError) as e:
+                # every worker may be down mid-respawn, or the accepting
+                # worker may crash mid-handshake: retry the whole dance
+                last = e
+                if self.writer is not None:
+                    self.writer.close()
+                await asyncio.sleep(0.25)
+        raise ConnectionError(f"no worker ever accepted: {last}")
+
+    async def _handshake(self) -> None:
+        self.reader, self.writer = await asyncio.open_connection(
+            "127.0.0.1", self.port)
+        params = [f"miner-{self.ident}"]
+        if self.token:
+            params.append(self.token)
+        sub = await self.call("mining.subscribe", params)
+        en1 = bytes.fromhex(sub.result[1])
+        if self.token and self.extranonce1 and en1 != self.extranonce1:
+            self.resumed_all = False
+        self.extranonce1 = en1
+        if len(sub.result) > 3:
+            self.token = str(sub.result[3])
+        await self.call("mining.authorize", [f"w.{self.ident}", "x"])
+
+    async def call(self, method: str, params: list) -> sp.Message:
+        self._msg_id += 1
+        mid = self._msg_id
+        self.writer.write(sp.encode_line(
+            sp.Message(id=mid, method=method, params=params)))
+        await self.writer.drain()
+        while True:
+            line = await asyncio.wait_for(self.reader.readline(), 30)
+            if not line:
+                raise ConnectionError("server closed")
+            m = sp.decode_line(line)
+            if m.method == "mining.set_resume_token" and m.params:
+                self.token = str(m.params[0])
+            if m.is_response and m.id == mid:
+                return m
+
+    def close(self) -> None:
+        if self.writer is not None:
+            self.writer.close()
+
+
+async def _submit(m: _MinerConn, job: Job, en2: bytes, nonce: int):
+    return await m.call("mining.submit", [
+        f"w.{m.ident}", job.job_id, en2.hex(),
+        f"{job.ntime:08x}", f"{nonce:08x}",
+    ])
+
+
+@pytest.mark.asyncio
+async def test_supervisor_exact_accounting_two_workers():
+    hooked = []
+
+    async def on_share(s):
+        hooked.append(s)
+
+    sup = ShardSupervisor(
+        ServerConfig(port=0, initial_difficulty=EASY, max_clients=64),
+        ShardConfig(workers=2, snapshot_interval=0.2),
+        on_share=on_share,
+    )
+    await sup.start()
+    try:
+        job = make_job()
+        sup.set_job(job)
+        miners = [_MinerConn(i, sup.port) for i in range(6)]
+        for m in miners:
+            await m.connect()
+        # the worker slices must be disjoint across the live fleet
+        leases = {m.extranonce1 for m in miners}
+        assert len(leases) == 6
+        for i, m in enumerate(miners):
+            en2 = struct.pack(">I", i)
+            nonce = mine(job, m.extranonce1, en2)
+            r = await _submit(m, job, en2, nonce)
+            assert r.result is True
+            # an exact resubmit dies in the worker-local seen window
+            r2 = await _submit(m, job, en2, nonce)
+            assert r2.error and r2.error[0] == sp.ERR_DUPLICATE
+        await asyncio.sleep(0.5)  # one snapshot push interval
+        snap = sup.snapshot()
+        assert len(hooked) == 6
+        assert snap["shares_valid"] == 6
+        assert snap["shares_invalid"] == 6  # the resubmits
+        assert snap["bus"]["shares_committed"] == 6
+        assert snap["bus"]["duplicates_refused"] == 0
+        assert snap["sessions"] == 6
+        assert snap["accept_latency"]["count"] == 12
+        assert snap["workers"]["alive"] == 2
+        # both workers actually served sessions (SO_REUSEPORT balanced)
+        per = snap["workers"]["per_worker"]
+        assert sum(p["sessions"] for p in per.values()) == 6
+        for m in miners:
+            m.close()
+    finally:
+        await sup.stop()
+
+
+@pytest.mark.asyncio
+async def test_cross_worker_duplicate_refused_via_parent_ledger():
+    """A share committed through one worker, replayed after a token
+    handoff (same lease, fresh session, possibly another worker), must
+    die at the parent's dedup window with ERR_DUPLICATE — and the books
+    must not change."""
+    hooked = []
+
+    async def on_share(s):
+        hooked.append(s)
+
+    sup = ShardSupervisor(
+        ServerConfig(port=0, initial_difficulty=EASY, max_clients=64),
+        ShardConfig(workers=2, snapshot_interval=0.2),
+        on_share=on_share,
+    )
+    await sup.start()
+    try:
+        job = make_job()
+        sup.set_job(job)
+        m = _MinerConn(0, sup.port)
+        await m.connect()
+        assert m.token  # the supervisor auto-secret issues tokens
+        en1 = m.extranonce1
+        en2 = struct.pack(">I", 1)
+        nonce = mine(job, en1, en2)
+        r = await _submit(m, job, en2, nonce)
+        assert r.result is True
+        # handoff: drop the session, reconnect presenting the token
+        m.close()
+        await asyncio.sleep(0.1)
+        await m.connect()
+        assert m.extranonce1 == en1, "resume token must recover the lease"
+        # the fresh session's seen-window is empty, so the replay sails
+        # through worker-local checks and MUST be caught by the parent
+        r2 = await _submit(m, job, en2, nonce)
+        assert r2.error and r2.error[0] == sp.ERR_DUPLICATE
+        assert len(hooked) == 1
+        await asyncio.sleep(0.5)
+        snap = sup.snapshot()
+        assert snap["bus"]["duplicates_refused"] == 1
+        assert snap["hook_rejects"] == 1
+        assert snap["resumes_accepted"] == 1
+        # a FRESH share from the resumed session still lands
+        en2b = struct.pack(">I", 2)
+        r3 = await _submit(m, job, en2b, mine(job, en1, en2b))
+        assert r3.result is True
+        assert len(hooked) == 2
+        m.close()
+    finally:
+        await sup.stop()
+
+
+@pytest.mark.asyncio
+async def test_worker_crash_chaos_exact_accounting():
+    """The tentpole chaos scenario: a seeded ``worker.crash`` plan
+    kills every worker that reaches its 3rd forwarded share (crash
+    BEFORE the bus send — the share was never committed). The
+    supervisor respawns dead workers; miners reconnect into survivors
+    with resume tokens, keep their leases, and retry. At the end every
+    submitted share is in the parent ledger EXACTLY once and no miner
+    lost or double-earned credit."""
+    hooked = []
+
+    async def on_share(s):
+        hooked.append(s)
+
+    sup = ShardSupervisor(
+        ServerConfig(port=0, initial_difficulty=EASY, max_clients=64),
+        ShardConfig(
+            workers=3, snapshot_interval=0.2, respawn_backoff=0.1,
+            fault_spec={"seed": 5, "rules": [{
+                "point": "worker.crash:*", "action": "crash",
+                "component": "worker", "every_nth": 3, "max_fires": 1,
+            }]},
+        ),
+        on_share=on_share,
+    )
+    await sup.start()
+    try:
+        job = make_job()
+        sup.set_job(job)
+        miners = [_MinerConn(i, sup.port) for i in range(9)]
+        for m in miners:
+            await m.connect()
+
+        async def drive(m: _MinerConn) -> tuple[int, int]:
+            accepted = dup_rejected = 0
+            for i in range(5):
+                en2 = struct.pack(">I", (m.ident << 8) | i)
+                nonce = mine(job, m.extranonce1, en2)
+                for attempt in range(8):
+                    try:
+                        r = await _submit(m, job, en2, nonce)
+                    except (ConnectionError, asyncio.TimeoutError, OSError):
+                        m.reconnects += 1
+                        await m.connect()
+                        continue
+                    if r.result is True:
+                        accepted += 1
+                    elif r.error and r.error[0] == sp.ERR_DUPLICATE:
+                        # verdict lost mid-crash but the commit landed:
+                        # credit exists exactly once — the reject is the
+                        # correct second answer
+                        dup_rejected += 1
+                    else:
+                        raise AssertionError(f"unexpected verdict {r}")
+                    break
+                else:
+                    raise AssertionError("share never got a verdict")
+            return accepted, dup_rejected
+
+        results = await asyncio.gather(*[drive(m) for m in miners])
+        accepted = sum(a for a, _ in results)
+        dup_rejected = sum(d for _, d in results)
+
+        # exact accounting: every one of the 45 logical shares is in
+        # the parent ledger exactly once, no matter how many crashes
+        # and retries it took to get there
+        headers = [s.header for s in hooked]
+        assert len(headers) == len(set(headers)), "double-committed share"
+        assert accepted + dup_rejected == 45
+        assert len(hooked) == 45, (
+            f"{len(hooked)} committed != 45 submitted"
+        )
+        reconnects = sum(m.reconnects for m in miners)
+        assert reconnects >= 1, "the chaos plan never bit"
+        # handoff: every reconnect recovered its lease via the token
+        assert all(m.resumed_all for m in miners)
+        await asyncio.sleep(0.5)
+        snap = sup.snapshot()
+        assert snap["workers"]["deaths"] >= 1
+        assert snap["workers"]["respawns"] >= 1
+        assert snap["workers"]["alive"] == 3  # everyone respawned
+        assert snap["resumes_accepted"] >= 1
+        for m in miners:
+            m.close()
+    finally:
+        await sup.stop()
+
+
+@pytest.mark.asyncio
+async def test_app_sharded_stratum_wiring():
+    from otedama_tpu.app import Application
+    from otedama_tpu.config.schema import AppConfig, validate_config
+
+    cfg = AppConfig()
+    cfg.mining.enabled = False
+    cfg.api.enabled = False
+    cfg.pool.enabled = True
+    cfg.pool.database = ":memory:"
+    cfg.stratum.host = "127.0.0.1"
+    cfg.stratum.port = 0
+    cfg.stratum.workers = 2
+    cfg.stratum.initial_difficulty = EASY  # host-mineable shares
+    assert validate_config(cfg) == []
+    app = Application(cfg)
+    await app.start()
+    try:
+        assert isinstance(app.server, ShardSupervisor)
+        assert app.server.port > 0
+        await asyncio.sleep(0.7)
+        snap = app.snapshot()
+        assert snap["stratum"]["workers"]["alive"] == 2
+        # the template loop's job fanned out through the supervisor
+        assert snap["stratum"]["current_job"] is not None
+        # a real miner connects and lands a share into the PoolManager
+        m = _MinerConn(0, app.server.port)
+        await m.connect()
+        job = app.server.current_job
+        en2 = struct.pack(">I", 1)
+        nonce = mine(job, m.extranonce1, en2)
+        r = await m.call("mining.submit", [
+            "w.0", job.job_id, en2.hex(),
+            f"{job.ntime:08x}", f"{nonce:08x}",
+        ])
+        assert r.result is True
+        assert app.pool.shares.count() == 1
+        m.close()
+    finally:
+        await app.stop()
+
+
+def test_config_validation_workers():
+    from otedama_tpu.config.schema import AppConfig, validate_config
+
+    cfg = AppConfig()
+    cfg.stratum.workers = 99
+    assert any("stratum.workers" in e for e in validate_config(cfg))
+    cfg.stratum.workers = 4
+    cfg.stratum.v2_enabled = True
+    assert any("v2_enabled" in e for e in validate_config(cfg))
+    cfg.stratum.v2_enabled = False
+    assert validate_config(cfg) == []
+
+
+def test_fd_budget_multiprocess_aware():
+    bench = _bench_module()
+    # single process holds both socket ends
+    assert bench.fd_budget(1000, 1) == 2 * 1000 + 128
+    # sharded: the raise happens BEFORE fork and must cover the
+    # worst-case skew (all connections on one worker) + bus overhead
+    sharded = bench.fd_budget(10_000, 4)
+    assert sharded >= 10_000 + 64
+    assert sharded < bench.fd_budget(10_000, 1)
+    # more workers never shrink the budget below the skew floor
+    assert bench.fd_budget(10_000, 16) >= 10_000 + 64
+
+
+@pytest.mark.slow
+@pytest.mark.asyncio
+async def test_shard_soak_10k_connections():
+    """The six-digit-direction soak (slow tier; the committed artifact
+    comes from ``./run_tests.sh stratum-shard-bench``): 10k concurrent
+    connections across 4 acceptor workers with exact accounting."""
+    bench = _bench_module()
+    bench.ensure_fd_budget(10_000, 4)
+    result, _split, _books = await bench.run_leg(
+        connections=10_000, shares_per_conn=2, window=15.0,
+        workers=4, connect_rate=800.0,
+    )
+    assert result["exact_accounting"], result
+    assert result["shares_accepted"] == 20_000
+    assert result["worker_deaths"] == 0
+    assert len(result["sessions_per_worker"]) == 4

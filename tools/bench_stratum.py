@@ -1,35 +1,57 @@
-"""Stratum V1 pool-server latency/throughput bench (four-digit SLO).
+"""Stratum V1 pool front-end latency/throughput bench (sharded soak).
 
-Drives the REAL asyncio ``StratumServer`` (loopback TCP, full JSON-RPC
-wire, full share validation — the exact submit hot path production
-runs) with N concurrent miner connections submitting pre-mined valid
-shares, and emits a ``BENCH_STRATUM_*.json`` artifact so the pool
-latency trajectory is tracked like the kernel benches:
+Drives the REAL serving path (loopback TCP, full JSON-RPC wire, full
+share validation) with N concurrent miner connections submitting
+pre-mined valid shares, and emits a ``BENCH_STRATUM_*.json`` artifact
+so the pool latency trajectory is tracked like the kernel benches.
 
-    {"connections": N, "shares": M, "shares_per_sec": ...,
-     "server_p50_ms": ..., "server_p99_ms": ...,
-     "client_p50_ms": ..., "client_p99_ms": ...}
+Two serving modes, selected by ``--workers``:
 
-Server percentiles come from the server's own share-accept histogram
-(submit-received -> verdict-written — the SLO the reference's 10k/<50ms
-claim is about); client percentiles additionally include wire +
-event-loop scheduling from a miner's seat.
+- ``--workers 0/1``: the classic single-process ``StratumServer``
+  (the r06 configuration).
+- ``--workers N``: the sharded front-end (stratum/shard.py) — N
+  acceptor worker processes sharing the port via SO_REUSEPORT, shares
+  flowing over the unix-socket share bus to THIS process, which owns
+  the one ``PoolManager`` ledger.
 
-FD-limit aware and LOUD about it: the bench needs ~2 fds per connection
-(both socket ends live in this process). It tries to raise RLIMIT_NOFILE
-to the hard limit and **exits 2 with a clear message** if the budget
-still doesn't fit — a silently skipped soak is how scale claims rot.
+Both modes account every share through a real ``PoolManager`` over an
+in-memory db, so the artifact can assert EXACT accounting three ways:
+client ground truth (what each miner saw accepted) == hook deliveries
+== db rows, per worker. ``--control`` additionally runs a
+single-process control leg with the identical workload and asserts the
+sharded leg's accepted totals and PPLNS payout split are byte-identical
+to it — horizontal fan-out must never change the books.
+
+Latency is reported PER PHASE (the r06 artifact's client p99 of 245 ms
+against a server p99 of 5 ms was connect-burst queueing bleeding into
+the submit window): the connect ramp is paced (``--connect-rate``) and
+measured separately (``connect_p50_ms``/``connect_p99_ms`` = TCP
+connect + subscribe + authorize per miner), while ``client_p50_ms``/
+``client_p99_ms`` cover ONLY the submit phase. Server percentiles come
+from the server's own share-accept histogram (submit-received ->
+verdict-written; merged across workers in sharded mode).
+
+FD-limit aware and LOUD about it — and multi-process aware: in sharded
+mode the server-side socket ends live in the worker processes, which
+INHERIT the limit at fork, so the budget is raised here BEFORE workers
+spawn and must fit the worst-case skew (every connection landing on
+one worker). Exits 2 with a clear message if the budget cannot fit — a
+silently skipped soak is how scale claims rot.
 
 Usage:
     python tools/bench_stratum.py --connections 1000 --shares 3 \
         --out BENCH_STRATUM_r06.json
+    python tools/bench_stratum.py --workers 4 --connections 10000 \
+        --control --out BENCH_STRATUM_r13.json
 """
 
 from __future__ import annotations
 
 import argparse
 import asyncio
+import dataclasses
 import json
+import multiprocessing as mp
 import os
 import random
 import resource
@@ -39,21 +61,53 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from otedama_tpu.engine import jobs as jobmod          # noqa: E402
-from otedama_tpu.engine.types import Job               # noqa: E402
-from otedama_tpu.kernels import target as tgt          # noqa: E402
-from otedama_tpu.stratum import protocol as sp         # noqa: E402
-from otedama_tpu.stratum.server import (               # noqa: E402
+from otedama_tpu.db import connect_database                # noqa: E402
+from otedama_tpu.engine import jobs as jobmod              # noqa: E402
+from otedama_tpu.engine.types import Job                   # noqa: E402
+from otedama_tpu.engine.vardiff import VardiffConfig       # noqa: E402
+from otedama_tpu.kernels import target as tgt              # noqa: E402
+from otedama_tpu.pool.blockchain import MockChainClient    # noqa: E402
+from otedama_tpu.pool.manager import PoolConfig, PoolManager  # noqa: E402
+from otedama_tpu.pool.payouts import PayoutConfig, PayoutScheme  # noqa: E402
+from otedama_tpu.security.ddos import DDoSConfig           # noqa: E402
+from otedama_tpu.stratum import protocol as sp             # noqa: E402
+from otedama_tpu.stratum.server import (                   # noqa: E402
     ServerConfig, StratumServer,
 )
-from otedama_tpu.utils.sha256_host import sha256d      # noqa: E402
+from otedama_tpu.stratum.shard import (                    # noqa: E402
+    ShardConfig, ShardSupervisor,
+)
+from otedama_tpu.utils.sha256_host import sha256d          # noqa: E402
 
 EASY = 1e-7  # ~2.3e-3 hit probability per hash: shares mine in ~430 tries
+REWARD = 50 * 10**8  # block reward the PPLNS control split divides
 
 
-def ensure_fd_budget(connections: int) -> None:
-    """Raise RLIMIT_NOFILE if needed; exit 2 loudly if it can't fit."""
-    need = 2 * connections + 128  # both socket ends + process baseline
+def fd_budget(connections: int, workers: int = 1) -> int:
+    """Pure fd-need estimate for the soak's rlimit (shared by every
+    process — children inherit the raise at fork).
+
+    Classic single-process mode (``workers <= 1``) keeps BOTH socket
+    ends of every connection in this one process (2x). At ``workers >
+    1`` no process holds both ends: server ends live in the acceptor
+    workers (SO_REUSEPORT makes no skew promise, so the worst case is
+    every connection landing on ONE worker), client ends live in the
+    dedicated miner-fleet child — the limit must fit ``connections`` +
+    per-worker bus/listen overhead + baseline in EVERY process, not 2x
+    in one. That halved per-process budget is exactly what lets a 10k+
+    soak (and its same-workload control leg, which also drives its
+    miners from the fleet child) run under fd ceilings the 2x estimate
+    could never fit.
+    """
+    if workers <= 1:
+        return 2 * connections + 128
+    return connections + 64 * max(1, workers) + 256
+
+
+def ensure_fd_budget(connections: int, workers: int = 1) -> None:
+    """Raise RLIMIT_NOFILE to fit ``fd_budget`` (BEFORE any worker
+    forks, so the raise is inherited); exit 2 loudly if it can't fit."""
+    need = fd_budget(connections, workers)
     soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
     if soft < need:
         try:
@@ -66,9 +120,10 @@ def ensure_fd_budget(connections: int) -> None:
     if soft < need:
         print(
             f"FATAL: fd limit too low for the soak: need {need} "
-            f"(2 x {connections} connections + slack), have soft={soft} "
-            f"hard={hard}. Raise it (ulimit -n {need}) or lower "
-            f"--connections. Refusing to silently under-test.",
+            f"({connections} connections x {max(1, workers)} worker(s) "
+            f"budget), have soft={soft} hard={hard}. Raise it "
+            f"(ulimit -n {need}) or lower --connections. Refusing to "
+            "silently under-test.",
             file=sys.stderr,
         )
         sys.exit(2)
@@ -92,8 +147,6 @@ def make_job(job_id: str = "bench1") -> Job:
 def mine_share(job: Job, extranonce1: bytes, en2: bytes,
                target: int) -> int | None:
     """Find a nonce for (job, en1, en2) meeting target; None if unlucky."""
-    import dataclasses
-
     j = dataclasses.replace(job, extranonce1=extranonce1)
     prefix = jobmod.build_header_prefix(j, en2)
     for nonce in range(1 << 20):
@@ -112,24 +165,27 @@ class Miner:
         self.reader: asyncio.StreamReader | None = None
         self.writer: asyncio.StreamWriter | None = None
         self.extranonce1 = b""
-        self.latencies: list[float] = []
+        self.connect_latency = 0.0    # connect + subscribe + authorize
+        self.latencies: list[float] = []  # submit phase only
         self.accepted = 0
         self.rejected = 0
 
     async def connect(self) -> None:
+        t0 = time.monotonic()
         self.reader, self.writer = await asyncio.open_connection(
             "127.0.0.1", self.port
         )
         sub = await self._call(1, "mining.subscribe", [f"bench-{self.ident}"])
         self.extranonce1 = bytes.fromhex(sub.result[1])
         await self._call(2, "mining.authorize", [f"w.{self.ident}", "x"])
+        self.connect_latency = time.monotonic() - t0
 
     async def _call(self, msg_id, method, params) -> sp.Message:
         self.writer.write(sp.encode_line(
             sp.Message(id=msg_id, method=method, params=params)))
         await self.writer.drain()
         while True:
-            line = await asyncio.wait_for(self.reader.readline(), 30)
+            line = await asyncio.wait_for(self.reader.readline(), 60)
             if not line:
                 raise ConnectionError("server closed")
             m = sp.decode_line(line)
@@ -138,17 +194,41 @@ class Miner:
 
     async def submit_all(self, job: Job,
                          shares: list[tuple[bytes, int]],
-                         window: float) -> None:
+                         window: float, t_start: float) -> None:
+        """Submit against an ABSOLUTE uniform schedule over ``window``
+        (relative jitter per share let early sleeps stack into a tail
+        herd); each share's latency is submit-write -> verdict-read.
+
+        The hot loop is deliberately lean — the fleet is the load
+        GENERATOR, and every cycle it burns is a cycle the servers
+        under test can't show: submit lines are pre-encoded (the share
+        set is known), notifications are skipped without a JSON parse
+        (one in-flight request per miner means the next response line
+        IS ours), and there's no per-call timer or drain."""
         rng = random.Random(self.ident)
-        for i, (en2, nonce) in enumerate(shares):
-            # jittered pacing spreads the fleet's submits over `window`
-            await asyncio.sleep(rng.random() * window / len(shares))
+        deadlines = sorted(rng.random() * window for _ in shares)
+        lines = [
+            sp.encode_line(sp.Message(
+                id=10 + i, method="mining.submit",
+                params=[f"w.{self.ident}", job.job_id, en2.hex(),
+                        f"{job.ntime:08x}", f"{nonce:08x}"]))
+            for i, (en2, nonce) in enumerate(shares)
+        ]
+        for line, deadline in zip(lines, deadlines):
+            delay = t_start + deadline - time.monotonic()
+            if delay > 0:
+                await asyncio.sleep(delay)
             t0 = time.monotonic()
-            m = await self._call(10 + i, "mining.submit",
-                                 [f"w.{self.ident}", job.job_id, en2.hex(),
-                                  f"{job.ntime:08x}", f"{nonce:08x}"])
+            self.writer.write(line)
+            while True:
+                resp = await self.reader.readline()
+                if not resp:
+                    raise ConnectionError("server closed")
+                if b'"method"' in resp:
+                    continue  # notification (set_difficulty/notify/...)
+                break
             self.latencies.append(time.monotonic() - t0)
-            if m.result is True:
+            if b'"result":true' in resp:
                 self.accepted += 1
             else:
                 self.rejected += 1
@@ -165,37 +245,63 @@ def percentile(values: list[float], q: float) -> float:
     return s[min(len(s) - 1, int(q * len(s)))]
 
 
-async def run_bench(connections: int, shares_per_conn: int,
-                    window: float) -> dict:
-    hook_count = 0
-
-    async def on_share(_s):
-        nonlocal hook_count
-        hook_count += 1
-
-    server = StratumServer(
-        ServerConfig(port=0, initial_difficulty=EASY, max_clients=65536),
-        on_share=on_share,
+def _bench_server_config(max_clients: int) -> ServerConfig:
+    # loopback fleet: the whole swarm shares one IP — lift the per-IP
+    # caps IN CONFIG (sharded workers build their own guards from it),
+    # keep the guard code in the path. Vardiff retargets are pushed out
+    # of the run so every share is credited at EASY in every leg — the
+    # PPLNS comparison needs identical credit, not mid-run retunes.
+    return ServerConfig(
+        host="127.0.0.1", port=0, initial_difficulty=EASY,
+        max_clients=max_clients,
+        vardiff=VardiffConfig(retarget_seconds=3600.0),
+        ddos=DDoSConfig(
+            max_concurrent_per_ip=1 << 20, connects_per_minute=1e12,
+            bytes_per_window=1 << 40,
+        ),
     )
-    # loopback fleet: the whole swarm shares one IP — lift per-IP caps,
-    # keep the guard code in the path (same approach as tests/test_soak)
-    from otedama_tpu.security.ddos import DDoSConfig, DDoSProtection
 
-    server.ddos = DDoSProtection(DDoSConfig(
-        max_concurrent_per_ip=1 << 20, connects_per_minute=1e12,
-        bytes_per_window=1 << 40,
+
+def _make_ledger() -> PoolManager:
+    db = connect_database(":memory:")
+    return PoolManager(db, MockChainClient(), config=PoolConfig(
+        payout=PayoutConfig(
+            scheme=PayoutScheme.PPLNS, pplns_window=1 << 22,
+        ),
     ))
-    await server.start()
-    job = make_job()
-    server.set_job(job)
-    target = tgt.difficulty_to_target(EASY)
 
-    miners = [Miner(i, server.port) for i in range(connections)]
+
+def _pplns_split(pool: PoolManager) -> dict[str, int]:
+    """The PPLNS payout split the leg's db would produce for one block:
+    the cross-leg invariant (worker -> atomic units)."""
+    window = pool.shares.last_n(pool.config.payout.pplns_window)
+    result = pool.calculator.calculate_block(REWARD, window)
+    return {p.worker: p.amount for p in result.payouts}
+
+
+async def _drive_fleet(port: int, connections: int, shares_per_conn: int,
+                       window: float, connect_rate: float,
+                       job: Job, ident_base: int = 0) -> dict:
+    """The miner swarm: paced connect ramp, off-window premine, uniform
+    submit schedule. Runs inline (classic mode) or inside dedicated
+    fleet child processes (``workers > 1`` legs), where each shard
+    holds ONLY its own client socket ends. ``ident_base`` keeps worker
+    names globally unique across fleet shards."""
+    target = tgt.difficulty_to_target(EASY)
+    miners = [Miner(ident_base + i, port) for i in range(connections)]
+
+    # -- connect phase: paced ramp ----------------------------------------
+    # a simultaneous connect storm measures the kernel accept queue, not
+    # the server — and its queueing previously bled into the submit
+    # window's client percentiles (r06: client p99 245 ms vs server 5 ms)
+    batch = 50
     t_conn0 = time.monotonic()
-    # staggered connect (batches): a 1000-way simultaneous connect storm
-    # measures the kernel's accept queue, not the server
-    for i in range(0, connections, 100):
-        await asyncio.gather(*[m.connect() for m in miners[i:i + 100]])
+    for i in range(0, connections, batch):
+        t_sched = t_conn0 + i / connect_rate
+        delay = t_sched - time.monotonic()
+        if delay > 0:
+            await asyncio.sleep(delay)
+        await asyncio.gather(*[m.connect() for m in miners[i:i + batch]])
     connect_seconds = time.monotonic() - t_conn0
 
     # pre-mine every share OFF the measured window (pure hashlib; the
@@ -212,39 +318,256 @@ async def run_bench(connections: int, shares_per_conn: int,
         mined.append(lst)
     mine_seconds = time.monotonic() - t_mine0
 
+    # -- submit phase ------------------------------------------------------
+    # ONE coarse deadline for the whole phase (the hot loop stays
+    # timer-free): a wedged server must fail the bench loudly, never
+    # hang it past any artifact
     t0 = time.monotonic()
-    await asyncio.gather(*[
-        m.submit_all(job, lst, window) for m, lst in zip(miners, mined)
-    ])
+    await asyncio.wait_for(
+        asyncio.gather(*[
+            m.submit_all(job, lst, window, t0)
+            for m, lst in zip(miners, mined)
+        ]),
+        timeout=window + 600.0,
+    )
     elapsed = time.monotonic() - t0
+    out = {
+        "accepted": sum(m.accepted for m in miners),
+        "rejected": sum(m.rejected for m in miners),
+        "connect_seconds": connect_seconds,
+        "connect_lat": [m.connect_latency for m in miners],
+        "client_lat": [lat for m in miners for lat in m.latencies],
+        "premine_seconds": mine_seconds,
+        "elapsed": elapsed,
+        "per_worker_client": {
+            f"w.{m.ident}": m.accepted for m in miners if m.accepted
+        },
+    }
+    for m in miners:
+        m.close()
+    return out
 
-    accepted = sum(m.accepted for m in miners)
-    rejected = sum(m.rejected for m in miners)
-    client_lat = [lat for m in miners for lat in m.latencies]
-    snap = server.latency.snapshot()
+
+def _fleet_proc(conn, port: int, connections: int, shares_per_conn: int,
+                window: float, connect_rate: float, job_wire: dict,
+                ident_base: int) -> None:
+    """Child-process wrapper around ``_drive_fleet`` (top-level for the
+    spawn start method)."""
+    from otedama_tpu.stratum.shard import job_from_wire
+
+    try:
+        res = asyncio.run(_drive_fleet(
+            port, connections, shares_per_conn, window, connect_rate,
+            job_from_wire(job_wire), ident_base))
+        conn.send(res)
+    except Exception as e:  # surfaced parent-side as a loud failure
+        conn.send({"error": repr(e)})
+    finally:
+        conn.close()
+
+
+def _merge_fleets(parts: list[dict]) -> dict:
+    out = {
+        "accepted": sum(p["accepted"] for p in parts),
+        "rejected": sum(p["rejected"] for p in parts),
+        "connect_seconds": max(p["connect_seconds"] for p in parts),
+        "connect_lat": [v for p in parts for v in p["connect_lat"]],
+        "client_lat": [v for p in parts for v in p["client_lat"]],
+        "premine_seconds": max(p["premine_seconds"] for p in parts),
+        "elapsed": max(p["elapsed"] for p in parts),
+        "per_worker_client": {},
+    }
+    for p in parts:
+        out["per_worker_client"].update(p["per_worker_client"])
+    return out
+
+
+async def _run_fleet_children(port: int, connections: int,
+                              shares_per_conn: int, window: float,
+                              connect_rate: float, job: Job,
+                              procs: int = 2) -> dict:
+    """Run the swarm as ``procs`` child processes, each driving an even
+    split of the connections (paced so the AGGREGATE connect rate is
+    ``connect_rate``). One process per ~5k connections keeps the driver
+    loops small enough that the fleet never becomes the measurement."""
+    from otedama_tpu.stratum.shard import job_to_wire
+
+    ctx = mp.get_context(
+        "fork" if "fork" in mp.get_all_start_methods() else "spawn")
+    procs = max(1, min(procs, connections))
+    split = [connections // procs] * procs
+    for i in range(connections % procs):
+        split[i] += 1
+    children = []
+    base = 0
+    for n in split:
+        parent_conn, child_conn = ctx.Pipe()
+        proc = ctx.Process(
+            target=_fleet_proc,
+            args=(child_conn, port, n, shares_per_conn, window,
+                  connect_rate / procs, job_to_wire(job), base),
+            daemon=True,
+        )
+        proc.start()
+        child_conn.close()
+        children.append((proc, parent_conn))
+        base += n
+    loop = asyncio.get_running_loop()
+
+    def _recv(proc, conn) -> dict:
+        # the fleet runs for minutes; poll so a dead child fails loudly
+        # instead of blocking an executor thread forever
+        while not conn.poll(1.0):
+            if not proc.is_alive():
+                raise RuntimeError(
+                    f"miner fleet died (exit {proc.exitcode})")
+        return conn.recv()
+
+    parts = []
+    try:
+        parts = list(await asyncio.gather(*[
+            loop.run_in_executor(None, _recv, proc, conn)
+            for proc, conn in children
+        ]))
+    finally:
+        for proc, _ in children:
+            await loop.run_in_executor(None, proc.join, 10.0)
+            if proc.is_alive():
+                proc.kill()
+    for p in parts:
+        if "error" in p:
+            raise RuntimeError(f"miner fleet failed: {p['error']}")
+    return _merge_fleets(parts)
+
+
+async def run_leg(connections: int, shares_per_conn: int, window: float,
+                  workers: int, connect_rate: float,
+                  remote_miners: bool | None = None) -> dict:
+    """One full soak leg (either serving mode) with PoolManager
+    accounting; returns metrics + the per-worker books for cross-leg
+    comparison. ``remote_miners`` (default: on for multi-worker runs
+    and their controls) drives the swarm from a child process so no
+    process holds both socket ends — the fd shape six-digit soaks need,
+    and client latencies measured from a seat the serving loops never
+    contend with."""
+    pool = _make_ledger()
+    hook_count = 0
+
+    async def on_share(s):
+        nonlocal hook_count
+        hook_count += 1
+        await pool.on_share(s)
+
+    sharded = workers > 1
+    if sharded:
+        server = ShardSupervisor(
+            _bench_server_config(max_clients=connections + 64),
+            ShardConfig(workers=workers, snapshot_interval=0.5),
+            on_share=on_share,
+        )
+    else:
+        server = StratumServer(
+            _bench_server_config(max_clients=connections + 64),
+            on_share=on_share,
+        )
+    await server.start()
+    job = make_job()
+    server.set_job(job)
+
+    if remote_miners is None:
+        remote_miners = sharded
+    if remote_miners:
+        fleet = await _run_fleet_children(
+            server.port, connections, shares_per_conn, window,
+            connect_rate, job, procs=max(1, connections // 5000) + 1)
+    else:
+        fleet = await _drive_fleet(
+            server.port, connections, shares_per_conn, window,
+            connect_rate, job)
+
+    accepted = fleet["accepted"]
+    rejected = fleet["rejected"]
+    client_lat = fleet["client_lat"]
+    connect_lat = fleet["connect_lat"]
+    connect_seconds = fleet["connect_seconds"]
+    mine_seconds = fleet["premine_seconds"]
+    elapsed = fleet["elapsed"]
+    if sharded:
+        # one final push interval so every worker's counters land
+        await asyncio.sleep(2 * server.shard.snapshot_interval)
+    snap_stats = server.snapshot()
+    hist = server.latency.snapshot()
+
+    # exact accounting, three independent ledgers:
+    #   client ground truth == hook deliveries == db rows (+ per-worker)
+    db_rows = pool.shares.count()
+    per_worker_client = fleet["per_worker_client"]
+    per_worker_db = {
+        w["name"]: int(w["shares_valid"]) for w in pool.workers.list()
+    }
+    exact = (
+        accepted == hook_count == db_rows
+        and per_worker_client == per_worker_db
+        and accepted == snap_stats.get("shares_valid")
+    )
+    split = _pplns_split(pool)
+
     result = {
+        "workers": max(1, workers),
         "connections": connections,
         "shares_submitted": accepted + rejected,
         "shares_accepted": accepted,
         "shares_rejected": rejected,
         "hook_deliveries": hook_count,
+        "db_share_rows": db_rows,
         "server_sessions_peak": connections,
         "connect_seconds": round(connect_seconds, 3),
+        "connect_p50_ms": round(1e3 * percentile(connect_lat, 0.50), 3),
+        "connect_p99_ms": round(1e3 * percentile(connect_lat, 0.99), 3),
         "premine_seconds": round(mine_seconds, 3),
         "submit_window_seconds": round(elapsed, 3),
         "shares_per_sec": round((accepted + rejected) / elapsed, 1),
-        "server_p50_ms": snap["p50_ms"],
-        "server_p99_ms": snap["p99_ms"],
-        "server_avg_ms": snap["avg_ms"],
+        "server_p50_ms": hist["p50_ms"],
+        "server_p99_ms": hist["p99_ms"],
+        "server_avg_ms": hist["avg_ms"],
         "client_p50_ms": round(1e3 * percentile(client_lat, 0.50), 3),
         "client_p99_ms": round(1e3 * percentile(client_lat, 0.99), 3),
-        "exact_accounting": (
-            accepted == hook_count == server.stats["shares_valid"]
-        ),
+        "exact_accounting": exact,
     }
-    for m in miners:
-        m.close()
+    if sharded:
+        w = snap_stats.get("workers", {})
+        result["worker_deaths"] = w.get("deaths", 0)
+        result["sessions_per_worker"] = {
+            wid: pw.get("sessions", 0)
+            for wid, pw in w.get("per_worker", {}).items()
+        }
+        result["bus"] = snap_stats.get("bus", {})
     await server.stop()
+    pool.db.close()
+    return result, split, per_worker_db
+
+
+async def run_bench(connections: int, shares_per_conn: int, window: float,
+                    workers: int, connect_rate: float,
+                    control: bool) -> dict:
+    result, split, books = await run_leg(
+        connections, shares_per_conn, window, workers, connect_rate)
+    if control and workers > 1:
+        # single-process control: the IDENTICAL workload through the
+        # proven r06 path — fan-out must not change the books. The
+        # control's miners also run from the fleet child so the control
+        # server process holds only its own socket ends (the 2x
+        # single-process estimate cannot fit a 10k soak under capped
+        # hard limits — the point of the multi-process fd budget)
+        ctrl, ctrl_split, ctrl_books = await run_leg(
+            connections, shares_per_conn, window, 1, connect_rate,
+            remote_miners=True)
+        result["control"] = ctrl
+        result["accepted_matches_control"] = (
+            result["shares_accepted"] == ctrl["shares_accepted"]
+            and books == ctrl_books
+        )
+        result["pplns_identical_to_control"] = split == ctrl_split
     return result
 
 
@@ -255,20 +578,38 @@ def main() -> None:
                     help="shares submitted per connection")
     ap.add_argument("--window", type=float, default=10.0,
                     help="seconds the submit load is spread over")
+    ap.add_argument("--workers", type=int, default=0,
+                    help="acceptor worker processes (0/1 = single-process)")
+    ap.add_argument("--connect-rate", type=float, default=500.0,
+                    help="paced connect ramp, connections per second")
+    ap.add_argument("--control", action="store_true",
+                    help="also run a single-process control leg and "
+                         "assert identical accounting + PPLNS split")
     ap.add_argument("--out", default="BENCH_STRATUM_manual.json")
     args = ap.parse_args()
 
-    ensure_fd_budget(args.connections)
-    result = asyncio.run(
-        run_bench(args.connections, args.shares, args.window)
-    )
+    # raise BEFORE any worker/fleet process forks (they inherit it).
+    # Multi-worker runs (and their control legs) never hold both socket
+    # ends in one process, so the per-process budget is 1x connections;
+    # only the classic inline mode needs the 2x estimate
+    ensure_fd_budget(args.connections, max(1, args.workers))
+    result = asyncio.run(run_bench(
+        args.connections, args.shares, args.window, args.workers,
+        args.connect_rate, args.control,
+    ))
     result["bench"] = "stratum_v1_share_accept"
     result["timestamp"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     with open(args.out, "w") as f:
         json.dump(result, f, indent=2, sort_keys=True)
         f.write("\n")
     print(json.dumps(result, indent=2, sort_keys=True))
-    if not result["exact_accounting"]:
+    failed = not result["exact_accounting"]
+    if args.control and args.workers > 1:
+        failed = failed or not result.get("accepted_matches_control")
+        failed = failed or not result.get("pplns_identical_to_control")
+        failed = failed or not result.get("control", {}).get(
+            "exact_accounting")
+    if failed:
         print("FATAL: share accounting mismatch", file=sys.stderr)
         sys.exit(1)
 
