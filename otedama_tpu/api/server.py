@@ -715,3 +715,24 @@ class ApiServer:
                 labels={"protocol": protocol},
                 help_="Pool share submit-received->verdict-written latency",
             )
+        # group-commit ledger shape (ShardSupervisor only): how many
+        # shares each flush carried and how long it took — the knee of
+        # the batched-commit curve, alarmed on like any latency SLO
+        batches = getattr(server, "batch_sizes", None)
+        if batches is not None and batches.count > 0:
+            self.registry.histogram_set(
+                "otedama_ledger_batch_size",
+                batches.cumulative(),
+                batches.sum,
+                batches.count,
+                help_="Shares per group-commit ledger flush",
+            )
+        flushes = getattr(server, "flush_latency", None)
+        if flushes is not None and flushes.count > 0:
+            self.registry.histogram_set(
+                "otedama_ledger_flush_seconds",
+                flushes.cumulative(),
+                flushes.sum,
+                flushes.count,
+                help_="Group-commit ledger flush latency",
+            )

@@ -270,6 +270,10 @@ class Application:
                 ShardConfig(workers=cfg.stratum.workers),
                 on_share=self.pool.on_share,
                 on_block=self.pool.on_block,
+                # group-commit: the supervisor drains the share bus into
+                # batches and each flushes as ONE chain batch-commit +
+                # ONE db transaction (per-share verdicts unchanged)
+                on_share_batch=self.pool.on_share_batch,
             )
         else:
             self.server = StratumServer(

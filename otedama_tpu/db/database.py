@@ -345,6 +345,27 @@ class Database(AuditMixin):
     def transaction(self):
         return _Transaction(self)
 
+    # -- savepoints (group-commit ledger) ------------------------------------
+    # Like BEGIN/COMMIT/ROLLBACK these are transaction CONTROL and
+    # bypass the db.execute fault point: an injected statement failure
+    # inside a savepoint must always leave a rollbackable scope, and a
+    # fault firing on the rollback itself would wedge the batch.
+
+    def savepoint(self, name: str) -> None:
+        with self._lock:
+            self._conn.execute(f"SAVEPOINT {name}")
+
+    def release(self, name: str) -> None:
+        with self._lock:
+            self._conn.execute(f"RELEASE SAVEPOINT {name}")
+
+    def rollback_to(self, name: str) -> None:
+        """Rolls back the savepoint's effects AND releases it (plain
+        ROLLBACK TO keeps the savepoint on the stack)."""
+        with self._lock:
+            self._conn.execute(f"ROLLBACK TO SAVEPOINT {name}")
+            self._conn.execute(f"RELEASE SAVEPOINT {name}")
+
     def snapshot(self) -> dict:
         """Write-path health for operator surfaces (settlement snapshot,
         chaos runs): every executed statement and every failure, injected

@@ -199,6 +199,21 @@ class PostgresDatabase(AuditMixin):
     def transaction(self):
         return _PgTransaction(self)
 
+    # -- savepoints (group-commit ledger; sqlite Database parity) ------------
+
+    def savepoint(self, name: str) -> None:
+        with self._lock, self._cursor() as cur:
+            cur.execute(f"SAVEPOINT {name}")
+
+    def release(self, name: str) -> None:
+        with self._lock, self._cursor() as cur:
+            cur.execute(f"RELEASE SAVEPOINT {name}")
+
+    def rollback_to(self, name: str) -> None:
+        with self._lock, self._cursor() as cur:
+            cur.execute(f"ROLLBACK TO SAVEPOINT {name}")
+            cur.execute(f"RELEASE SAVEPOINT {name}")
+
     # audit()/query_audit() come from AuditMixin — execute/query translate
     # the placeholders, so the SQL stays shared with the sqlite backend
 

@@ -69,6 +69,17 @@ class WorkerRepository:
             [(amount, name) for name, amount in pairs],
         )
 
+    def record_shares_many(self, counts: list[tuple[str, int]]) -> None:
+        """Batch share-count bump: (name, valid_count) rows in one
+        statement (the group-commit ledger aggregates a batch's shares
+        per worker before touching the table)."""
+        now = time.time()
+        self.db.executemany(
+            "UPDATE workers SET shares_valid = shares_valid + ?, "
+            "last_seen=? WHERE name=?",
+            [(n, now, name) for name, n in counts],
+        )
+
     def debit_for_payout(self, name: str, amount: int) -> None:
         self.db.execute(
             "UPDATE workers SET balance = balance - ?, paid_total = paid_total + ? WHERE name=?",
@@ -112,6 +123,17 @@ class ShareRepository:
             ),
         )
         return cur.lastrowid
+
+    def create_many(self, rows: list[tuple]) -> None:
+        """(worker, job_id, difficulty, actual_difficulty, is_block,
+        created_at) rows in one statement — the group-commit ledger's
+        per-batch share insert."""
+        self.db.executemany(
+            """INSERT INTO shares (worker, job_id, difficulty,
+               actual_difficulty, is_block, created_at)
+               VALUES (?,?,?,?,?,?)""",
+            [(w, j, d, a, int(b), t) for w, j, d, a, b, t in rows],
+        )
 
     def last_n(self, n: int) -> list[dict]:
         """The PPLNS window: most recent ``n`` shares, oldest first."""

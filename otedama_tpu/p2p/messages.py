@@ -45,8 +45,18 @@ MAX_FRAME = 4 << 20  # 4 MiB
 # Shares are the best-chain suffix after the highest recognized locator
 # hash, oldest first, at most MAX_SYNC_PAGE per page; "more" drives the
 # requester's next page.
+#
+# SHARE_BATCH payload (group-commit ledger, one flood per ledger batch):
+#     {"shares": [<SHARE payload>, ...]}
+# A lineage-ordered run of shares committed together (each extends the
+# previous, oldest first, at most MAX_SHARE_BATCH). Receivers verify
+# every member's PoW exactly like single SHARE gossip and connect in
+# payload order; only the verified members are re-flooded — a Byzantine
+# entry dies at the first honest hop without dragging its batchmates
+# down.
 
 MAX_SYNC_PAGE = 500
+MAX_SHARE_BATCH = 500
 MAX_LOCATOR = 64
 
 
@@ -81,6 +91,7 @@ class MessageType(enum.IntEnum):
     SYNC_RESPONSE = 11
     TX = 12             # payout transaction gossip
     LEDGER = 13         # balance snapshot gossip
+    SHARE_BATCH = 14    # one ledger batch of chained shares, one flood
 
 
 @dataclasses.dataclass

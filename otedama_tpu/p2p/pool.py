@@ -21,6 +21,7 @@ import time
 
 from otedama_tpu.p2p import sharechain
 from otedama_tpu.p2p.messages import (
+    MAX_SHARE_BATCH,
     MAX_SYNC_PAGE,
     MessageType,
     P2PMessage,
@@ -75,6 +76,7 @@ class P2PPool:
         self._last_orphan_sync: dict[str, float] = {}
         self._last_prune = 0                 # shares_connected at last prune
         self.node.on(MessageType.SHARE, self._on_share)
+        self.node.on(MessageType.SHARE_BATCH, self._on_share_batch)
         self.node.on(MessageType.BLOCK, self._on_block)
         self.node.on(MessageType.JOB, self._on_job)
         self.node.on(MessageType.SYNC_REQUEST, self._on_sync_request)
@@ -143,6 +145,29 @@ class P2PPool:
                     P2PMessage(MessageType.SHARE, share.to_payload())
                 )
         return status
+
+    async def submit_share_batch(self, shares: list[Share]) -> list[str]:
+        """Group-commit form of ``submit_share``: verify a
+        lineage-ordered run of locally-produced shares CONCURRENTLY on
+        the validation executor, link them in order, then flood the
+        whole batch as ONE ``SHARE_BATCH`` message — one broadcast (and
+        one dedup id, one drain sweep) per ledger batch instead of one
+        per share. Raises (rejecting the batch) if any member fails
+        verification: members are our own product, and a bad one means
+        a producer bug, not peer noise."""
+        if len(shares) > MAX_SHARE_BATCH:
+            raise ValueError(
+                f"share batch of {len(shares)} exceeds {MAX_SHARE_BATCH}")
+        await asyncio.gather(*(self._verify_off_loop(s) for s in shares))
+        statuses = [self.chain.connect(s) for s in shares]
+        fresh = [s for s, st in zip(shares, statuses) if st != "duplicate"]
+        self.stats["shares_accepted"] += len(fresh)
+        if fresh and not self.severed:
+            await self.node.broadcast(P2PMessage(
+                MessageType.SHARE_BATCH,
+                {"shares": [s.to_payload() for s in fresh]},
+            ))
+        return statuses
 
     async def announce_block(self, block_hash: str, worker: str, height: int) -> None:
         block = {"hash": block_hash, "worker": worker, "height": height}
@@ -217,6 +242,106 @@ class P2PPool:
         # hold the lineage we lack
         if not self.severed:
             await node.propagate(peer, msg)
+
+    async def _on_share_batch(self, node: P2PNode, peer: Peer,
+                              msg: P2PMessage) -> None:
+        """One received ledger batch: the same per-share verification
+        contract as single SHARE gossip (every member's PoW checked on
+        the validation executor, CONCURRENTLY like a sync page; the
+        ``p2p.share.verify`` fault point fires per member, so chaos
+        schedules see the same per-share hit sequence either way),
+        linked in payload order so the lineage connects without orphan
+        churn. Only the verified members re-flood, rebuilt as a new
+        batch — an invalid entry is never re-propagated and never drags
+        its batchmates down."""
+        entries = msg.payload.get("shares")
+        if not isinstance(entries, list):
+            return
+        fresh: list[Share] = []
+        tainted = len(entries) > MAX_SHARE_BATCH  # oversize: never re-flood whole
+        for obj in entries[:MAX_SHARE_BATCH]:
+            try:
+                share = Share.from_payload(obj)
+            except ShareFormatError as e:
+                self.stats["shares_rejected"] += 1
+                self.rejects["format"] = self.rejects.get("format", 0) + 1
+                log.warning("malformed share in batch from %s: %s",
+                            peer.node_id[:12], e)
+                tainted = True
+                continue
+            sid = share.share_id
+            if sid in self.chain or sid in self._verifying:
+                continue
+            try:
+                d = faults.hit("p2p.share.verify", sid.hex()[:12],
+                               _VERIFY_FAULTS)
+            except faults.FaultInjectedError:
+                self.stats["verify_failures"] += 1
+                tainted = True  # unverified here: never re-flood as-is
+                continue
+            if d is not None:
+                if d.drop:
+                    self.stats["verify_failures"] += 1
+                    tainted = True
+                    continue
+                if d.delay:
+                    await asyncio.sleep(d.delay)
+            fresh.append(share)
+        if not fresh:
+            return
+        for s in fresh:
+            self._verifying.add(s.share_id)
+        try:
+            verdicts = await asyncio.gather(
+                *(self._verify_off_loop(s) for s in fresh),
+                return_exceptions=True,
+            )
+        finally:
+            for s in fresh:
+                self._verifying.discard(s.share_id)
+        verified: list[Share] = []
+        saw_orphan = False
+        # NB: ``tainted`` carries over from the parse loop — a
+        # malformed/oversize/fault-skipped member taints the batch just
+        # like a verification failure below, or the original message
+        # (bad members included) would re-flood
+        for share, verdict in zip(fresh, verdicts):
+            if isinstance(verdict, ShareInvalid):
+                self.stats["shares_rejected"] += 1
+                self.rejects[verdict.reason] = (
+                    self.rejects.get(verdict.reason, 0) + 1)
+                log.warning("rejected batched share %s from %s (%s)",
+                            share.share_id.hex()[:12], peer.node_id[:12],
+                            verdict)
+                tainted = True
+                continue
+            if isinstance(verdict, BaseException):
+                self.stats["verify_failures"] += 1
+                tainted = True
+                continue
+            status = self.chain.connect(share)
+            if status == "duplicate":
+                continue
+            self.stats["shares_accepted"] += 1
+            saw_orphan = saw_orphan or status == "orphan"
+            verified.append(share)
+        if verified:
+            self._maybe_prune()
+            if saw_orphan:
+                self._request_sync_from(peer)
+            if not self.severed:
+                if not tainted:
+                    # every member verified: re-flood the ORIGINAL
+                    # message so its flood id keeps deduplicating hops
+                    await node.propagate(peer, msg)
+                else:
+                    # strip the invalid members — they are never
+                    # re-propagated — and flood only the verified run
+                    await node.propagate(peer, P2PMessage(
+                        MessageType.SHARE_BATCH,
+                        {"shares": [s.to_payload() for s in verified]},
+                        sender=msg.sender,
+                    ))
 
     async def _on_block(self, node: P2PNode, peer: Peer, msg: P2PMessage) -> None:
         self.blocks_seen.append(dict(msg.payload))

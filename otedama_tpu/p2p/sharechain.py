@@ -272,6 +272,29 @@ def mine_share(prev_hash: bytes, worker: str, job_id: str,
     )
 
 
+def mine_share_chain(prev_hash: bytes, claims: list[tuple[str, str]],
+                     difficulty: float, algorithm: str = "sha256d",
+                     block_number: int = 0,
+                     advance: list[bool] | None = None) -> list[Share]:
+    """Grind a lineage-ordered RUN of shares in one host call — the
+    group-commit ledger's batch form of ``mine_share``: share i+1
+    extends share i, so a whole accepted-share batch costs one executor
+    hop instead of one per share. ``claims`` is ``(worker, job_id)``
+    per share; ``advance[i] = False`` grinds share i off the current
+    tip WITHOUT advancing it (the region replicator's dropped-commit
+    fault semantics: a share that will not be submitted must not become
+    anyone's parent)."""
+    prev = prev_hash
+    out: list[Share] = []
+    for i, (worker, job_id) in enumerate(claims):
+        share = mine_share(prev, worker, job_id, difficulty,
+                           algorithm=algorithm, block_number=block_number)
+        out.append(share)
+        if advance is None or advance[i]:
+            prev = share.share_id
+    return out
+
+
 # -- the chain ----------------------------------------------------------------
 
 @dataclasses.dataclass

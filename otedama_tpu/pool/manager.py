@@ -33,6 +33,7 @@ from otedama_tpu.pool.payouts import (
 )
 from otedama_tpu.pool.submitter import BlockSubmitter, SubmitterConfig
 from otedama_tpu.stratum.server import AcceptedShare
+from otedama_tpu.utils import faults
 
 log = logging.getLogger("otedama.pool.manager")
 
@@ -192,6 +193,134 @@ class PoolManager:
         # only after the commit: a rolled-back first share must retry
         # its upsert, not skip it
         self._known_workers.add(worker)
+
+    # -- group-commit share intake (sharded front-end) -----------------------
+
+    async def on_share_batch(
+        self, batch: list[AcceptedShare]
+    ) -> list[tuple[str, str]]:
+        """Batched twin of :meth:`on_share` — the group-commit ledger's
+        entry point (stratum/shard.py drains the share bus into batches
+        and flushes each through here). Semantics are per-share
+        identical to N sequential ``on_share`` calls; only the
+        amortization changes:
+
+        - chain FIRST, as ever, but the whole batch commits through
+          ``RegionReplicator.commit_batch`` — one lock acquisition, one
+          grind, one flood;
+        - the db work lands in ONE transaction. The happy path writes
+          the batch as four grouped statements; if any statement fails
+          (constraint violation, injected db fault) the batch rolls
+          back to its savepoint and replays per share under individual
+          savepoints, so ONLY the offending share is rejected and every
+          other share's rows commit with the batch.
+
+        Returns one ``(status, error)`` per input share: ``("ok", "")``
+        or ``("err", reason)``. Never raises for per-share failures —
+        the caller delivers each verdict to its own miner.
+        """
+        outcomes: list[tuple[str, str]] = [("ok", "")] * len(batch)
+        live = list(range(len(batch)))
+        if self.replicator is not None:
+            chain_outcomes = await self.replicator.commit_batch(batch)
+            live = []
+            for i, exc in enumerate(chain_outcomes):
+                if exc is None:
+                    live.append(i)
+                else:
+                    outcomes[i] = ("err", str(exc) or type(exc).__name__)
+        if not live:
+            return outcomes
+        # ledger.flush: THE crash window of the group-commit pipeline —
+        # after the batch is on the chain, before its db transaction.
+        # A parent dying here loses the db copy but never chain credit:
+        # resubmits die as cross-region duplicates while settlement
+        # still pays the committed shares (the chaos test in
+        # tests/test_group_commit.py kills exactly this boundary).
+        try:
+            d = faults.hit("ledger.flush", supports=faults.STEP)
+        except Exception as e:
+            msg = str(e) or type(e).__name__
+            for i in live:
+                outcomes[i] = ("err", msg)
+            return outcomes
+        if d is not None:
+            if d.delay:
+                await asyncio.sleep(d.delay)
+            if d.drop:
+                # the db flush vanishes while the verdicts stand — the
+                # operational copy diverges from the chain (recoverable
+                # from chain state); without a replicator this is a
+                # share the books silently miss, which is exactly the
+                # audit hole chaos runs exist to surface
+                return outcomes
+        try:
+            self._flush_db_batch([(i, batch[i]) for i in live], outcomes)
+        except Exception as e:
+            # the transaction itself failed (BEGIN/COMMIT, not a
+            # statement): nothing landed, every live share is rejected
+            # and its miner resubmits once accounting recovers
+            msg = str(e) or type(e).__name__
+            for i in live:
+                if outcomes[i][0] == "ok":
+                    outcomes[i] = ("err", msg)
+        return outcomes
+
+    def _flush_db_batch(
+        self, entries: list[tuple[int, AcceptedShare]],
+        outcomes: list[tuple[str, str]],
+    ) -> None:
+        """One db transaction for a whole batch: grouped statements on
+        the happy path, per-share savepoint isolation on any failure."""
+        shares = [s for _, s in entries]
+        committed = shares
+        with self.db.transaction():
+            try:
+                self.db.savepoint("ledger_batch")
+                self._write_share_rows(shares)
+                self.db.release("ledger_batch")
+            except Exception:
+                self.db.rollback_to("ledger_batch")
+                committed = []
+                for i, s in entries:
+                    try:
+                        self.db.savepoint("ledger_share")
+                        self._write_share_rows([s])
+                        self.db.release("ledger_share")
+                        committed.append(s)
+                    except Exception as e:
+                        self.db.rollback_to("ledger_share")
+                        outcomes[i] = ("err", str(e) or type(e).__name__)
+        for s in committed:
+            self._known_workers.add(s.worker_user)
+
+    def _write_share_rows(self, shares: list[AcceptedShare]) -> None:
+        """The statements one batch owes the db, grouped: one upsert for
+        unseen workers, one share-count bump per worker, one insert for
+        the share rows, one credit per PPS-credited worker. Row order is
+        batch order, so PPLNS windows read exactly what N per-share
+        inserts would have written."""
+        unseen: list[str] = []
+        counts: dict[str, int] = {}
+        credits: dict[str, int] = {}
+        for s in shares:
+            w = s.worker_user
+            if w not in self._known_workers and w not in counts:
+                unseen.append(w)
+            counts[w] = counts.get(w, 0) + 1
+            credit = self.calculator.pps_credit(s.difficulty)
+            if credit:
+                credits[w] = credits.get(w, 0) + credit
+        if unseen:
+            self.workers.upsert_many(unseen)
+        self.workers.record_shares_many(list(counts.items()))
+        self.shares.create_many([
+            (s.worker_user, s.job_id, s.difficulty, s.actual_difficulty,
+             s.is_block, s.submitted_at)
+            for s in shares
+        ])
+        if credits:
+            self.workers.credit_many(list(credits.items()))
 
     async def on_block(self, header: bytes, job: Job, share: AcceptedShare) -> None:
         reward = self._job_rewards.get(job.job_id, self._current_reward)

@@ -59,6 +59,7 @@ from collections import OrderedDict
 from otedama_tpu.p2p import sharechain
 from otedama_tpu.stratum.server import AcceptedShare
 from otedama_tpu.utils import faults, pow_host
+from otedama_tpu.utils.sha256_host import sha256d_batch
 
 log = logging.getLogger("otedama.pool.regions")
 
@@ -255,6 +256,93 @@ class RegionReplicator:
             worker=accepted.worker_user, job_id=claim,
         )
         self.stats["commits"] += 1
+
+    async def commit_batch(
+        self, batch: list[AcceptedShare]
+    ) -> list[Exception | None]:
+        """Group-commit form of :meth:`commit`: N accepted stratum
+        shares become N chained chain shares under ONE lock
+        acquisition, ONE executor grind (``mine_share_chain``) and ONE
+        gossip flood (``P2PPool.submit_share_batch``) — the submission
+        ids come from one ``sha256d_batch`` pass over the 80-byte
+        headers instead of one host hash per share.
+
+        Per-share semantics are exactly :meth:`commit`'s: the
+        ``region.sever`` fault point is evaluated per share (same tag,
+        same hit sequence a per-share run would see), a dropped share
+        grinds but is neither submitted nor made anyone's parent (the
+        recommit sweep heals it), and every share is tracked in
+        ``_pending`` until settled-safe. Returns one entry per input:
+        ``None`` (committed) or the exception that refused THAT share
+        (the caller rejects only the offender, not the batch)."""
+        outcomes: list[Exception | None] = [None] * len(batch)
+        # the per-share path's 80-byte contract (submission_id raises on
+        # anything else) holds per share here too: a malformed header
+        # rejects ITS share loudly instead of silently committing a
+        # claim derived from the wrong-length hash — which would never
+        # match a correctly-hashed replay's dedup identity
+        for i, accepted in enumerate(batch):
+            if len(accepted.header) != 80:
+                outcomes[i] = ValueError(
+                    f"stratum header must be 80 bytes, "
+                    f"got {len(accepted.header)}")
+        subids = sha256d_batch(
+            [s.header for i, s in enumerate(batch) if outcomes[i] is None])
+        subids_iter = iter(subids)
+        plan: list[tuple[int, str, bool]] = []  # (idx, claim, dropped)
+        for i, accepted in enumerate(batch):
+            if outcomes[i] is not None:
+                continue
+            claim = encode_chain_claim(accepted.job_id, next(subids_iter))
+            try:
+                d = faults.hit("region.sever", str(self.config.region_id),
+                               _SEVER_FAULTS)
+            except faults.FaultInjectedError as e:
+                self.stats["commit_failures"] += 1
+                outcomes[i] = e
+                continue
+            dropped = False
+            if d is not None:
+                if d.delay:
+                    await asyncio.sleep(d.delay)
+                dropped = d.drop
+            plan.append((i, claim, dropped))
+        if not plan:
+            return outcomes
+        try:
+            async with self._commit_lock:
+                prev = (self.chain.tip if self.chain.tip is not None
+                        else sharechain.GENESIS)
+                loop = asyncio.get_running_loop()
+                shares = await loop.run_in_executor(
+                    None, lambda: sharechain.mine_share_chain(
+                        prev,
+                        [(batch[i].worker_user, claim)
+                         for i, claim, _ in plan],
+                        self.chain.params.min_difficulty,
+                        algorithm=self.chain.params.algorithm,
+                        advance=[not dropped for _, _, dropped in plan],
+                    ),
+                )
+                submit = [s for s, (_, _, dropped) in zip(shares, plan)
+                          if not dropped]
+                if submit:
+                    await self.pool.submit_share_batch(submit)
+        except Exception as e:
+            # the grind/flood failed as a unit: every share of the run
+            # is refused (none was linked), and each miner resubmits
+            self.stats["commit_failures"] += len(plan)
+            for i, _, _ in plan:
+                outcomes[i] = e
+            return outcomes
+        for share, (i, claim, dropped) in zip(shares, plan):
+            tag = parse_chain_claim(claim)
+            self._pending[tag] = _Commit(
+                chain_id=b"" if dropped else share.share_id,
+                worker=batch[i].worker_user, job_id=claim,
+            )
+            self.stats["commits"] += 1
+        return outcomes
 
     async def _grind(self, claim: str, worker: str) -> sharechain.Share:
         """Host-grind a chain share extending the local tip, off-loop

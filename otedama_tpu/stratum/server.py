@@ -796,7 +796,17 @@ class StratumServer:
             session.shares_valid += 1
             self.stats["shares_valid"] += 1
             self.vardiff.record_share(session.vardiff_key)
-            await self._reply(session, msg.id, True)
+            # accepted-verdict fast path: this exact reply is written
+            # once per accepted share — the single hottest line on the
+            # server — and its JSON shape is fixed, so skip the
+            # Message/json.dumps round trip for the common integer id
+            if type(msg.id) is int:
+                self._write_line(
+                    session,
+                    b'{"id":%d,"result":true,"error":null}\n' % msg.id)
+                await self._maybe_drain(session)
+            else:
+                await self._reply(session, msg.id, True)
             self.latency.observe(time.monotonic() - t0)
             if accepted is not None and accepted.is_block:
                 self.stats["blocks_found"] += 1

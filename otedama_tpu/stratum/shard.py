@@ -30,11 +30,25 @@ module splits the front-end into:
   (the PR 2 cache) and fans them to its sessions.
 
 Ordering guarantee of the bus: each worker's shares are processed by
-the parent strictly in the order that worker forwarded them (one
-reader task per link, ack awaited before the next frame), so a
-worker's chain-first/db commit order is exactly its miners' submit
-order; shares from DIFFERENT workers interleave arbitrarily, which is
-the same freedom different regions already have.
+the parent strictly in the order that worker forwarded them (each
+link's reader enqueues to the ledger queue in read order, and the one
+committer drains it FIFO), so a worker's chain-first/db commit order
+is exactly its miners' submit order; shares from DIFFERENT workers
+interleave arbitrarily, which is the same freedom different regions
+already have.
+
+**Group-commit ledger.** The committer drains every frame pending at
+the queue into ONE batch per pass and flushes it as a unit: one dedup
+sweep over the parent window, one ``on_share_batch`` hook call (one
+chain batch-commit + one db transaction in pool wiring), and one
+coalesced multi-verdict ``acks`` frame per link, from which each
+worker releases its per-share futures. The batch is amortization, not
+a semantic change — per-share verdicts, the dedup window's
+committed/in-flight claim discipline and chain-first ordering are
+bit-for-bit the per-share path's (an in-batch replay of a key claimed
+by the same batch defers to the next pass, exactly the "await the
+in-flight outcome" rule). Batch shape is observable:
+``otedama_ledger_batch_size`` / ``otedama_ledger_flush_seconds``.
 
 **Extranonce partitioning.** The lease space composes PR 8's region
 prefix with a worker slice: ``[region byte | worker_index
@@ -101,35 +115,51 @@ _WORKER_CRASH_EXIT = 17  # exit code of an injected worker.crash
 
 
 class CoalescingWriter:
-    """Batches small bus frames into ONE transport write per event-loop
-    pass. A loaded link writes a frame per share (acks parent-side,
+    """Batches small bus frames into ONE transport write per coalescing
+    window. A loaded link writes a frame per share (acks parent-side,
     share-forwards worker-side) and every ``StreamWriter.write`` is an
     immediate ``send`` syscall — at thousands of shares/s the syscall
     per frame IS the bus's cost (sandboxed kernels make it worse, not
-    different). Frames queued within one loop pass are joined and
-    written once via a ``call_soon`` flush; reads batch for free, so
-    this makes both directions amortized.
+    different: interposition serializes the whole BOX's syscalls, so a
+    syscall spent on the bus is a syscall the accept path can't have).
 
-    ``flush()`` exists for shutdown seams: a pending ``call_soon`` would
-    be lost if the writer closes first (the final worker snapshot rides
+    ``delay`` = 0 flushes on the next event-loop pass (``call_soon`` —
+    frames queued within one pass share one write). A small positive
+    ``delay`` (the shard bus uses a few ms) holds the flush open across
+    passes so sparse traffic ALSO amortizes: at one share per pass, a
+    per-pass flush degenerates to a syscall per share, which is exactly
+    the cost the writer exists to kill. The delay bounds added verdict
+    latency; against a 50 ms accept SLO it is noise.
+
+    ``flush()`` exists for shutdown seams: a pending flush would be
+    lost if the writer closes first (the final worker snapshot rides
     on it)."""
 
-    __slots__ = ("_writer", "_loop", "_chunks", "_scheduled")
+    __slots__ = ("_writer", "_loop", "_chunks", "_scheduled", "_delay",
+                 "_handle")
 
-    def __init__(self, writer: asyncio.StreamWriter):
+    def __init__(self, writer: asyncio.StreamWriter, delay: float = 0.0):
         self._writer = writer
         self._loop = asyncio.get_running_loop()
         self._chunks: list[bytes] = []
         self._scheduled = False
+        self._delay = delay
+        self._handle = None
 
     def send(self, data: bytes) -> None:
         self._chunks.append(data)
         if not self._scheduled:
             self._scheduled = True
-            self._loop.call_soon(self.flush)
+            if self._delay > 0:
+                self._handle = self._loop.call_later(self._delay, self.flush)
+            else:
+                self._loop.call_soon(self.flush)
 
     def flush(self) -> None:
         self._scheduled = False
+        if self._handle is not None:
+            self._handle.cancel()
+            self._handle = None
         if not self._chunks:
             return
         data = b"".join(self._chunks)
@@ -143,11 +173,121 @@ def encode_frame(obj: dict) -> bytes:
     return struct.pack(">I", len(body)) + body
 
 
-async def read_frame(reader: asyncio.StreamReader) -> dict:
+# Binary hot-path frames. Control frames (hello/job/snap/stop/block)
+# stay JSON — they are rare and debuggable; the per-share frames are
+# the bus's entire volume, and at four-digit share rates the
+# json.dumps/loads pair per share (plus hex-encoding the 80-byte
+# header and 32-byte digest into text) is measurable CPU on BOTH ends.
+# A binary body is distinguished from JSON by its first byte: JSON
+# bodies always start with "{", binary bodies with a type tag.
+_BIN_SHARE = 0x01   # worker -> parent: one accepted share + its seq
+_BIN_ACKS = 0x02    # parent -> worker: one ledger batch's verdicts
+_ACK_STATUS = ("ok", "dup", "err")
+_ACK_CODE = {"ok": 0, "dup": 1, "err": 2}
+
+
+def encode_share_frame(seq: int, s: AcceptedShare) -> bytes:
+    worker = s.worker_user.encode()
+    job = s.job_id.encode()
+    body = b"".join((
+        struct.pack(">BQIH", _BIN_SHARE, seq, s.session_id & 0xFFFFFFFF,
+                    len(worker)),
+        worker,
+        struct.pack(">H", len(job)),
+        job,
+        struct.pack(">dd", s.difficulty, s.actual_difficulty),
+        struct.pack(">H", len(s.digest)),
+        s.digest,
+        s.header,                      # exactly 80 bytes by contract
+        struct.pack(">H", len(s.extranonce2)),
+        s.extranonce2,
+        struct.pack(">IIBd", s.ntime & 0xFFFFFFFF,
+                    s.nonce_word & 0xFFFFFFFF,
+                    1 if s.is_block else 0, s.submitted_at),
+    ))
+    return struct.pack(">I", len(body)) + body
+
+
+def decode_share_frame(body: bytes) -> tuple[int, AcceptedShare]:
+    seq, session_id, wlen = struct.unpack_from(">QIH", body, 1)
+    off = 15
+    worker = body[off:off + wlen].decode()
+    off += wlen
+    (jlen,) = struct.unpack_from(">H", body, off)
+    off += 2
+    job_id = body[off:off + jlen].decode()
+    off += jlen
+    difficulty, actual = struct.unpack_from(">dd", body, off)
+    off += 16
+    (dlen,) = struct.unpack_from(">H", body, off)
+    off += 2
+    digest = body[off:off + dlen]
+    off += dlen
+    header = body[off:off + 80]
+    off += 80
+    (elen,) = struct.unpack_from(">H", body, off)
+    off += 2
+    extranonce2 = body[off:off + elen]
+    off += elen
+    ntime, nonce_word, is_block, submitted_at = struct.unpack_from(
+        ">IIBd", body, off)
+    if len(header) != 80:
+        raise ValueError("binary share frame truncated")
+    return seq, AcceptedShare(
+        session_id=session_id, worker_user=worker, job_id=job_id,
+        difficulty=difficulty, actual_difficulty=actual, digest=digest,
+        header=header, extranonce2=extranonce2, ntime=ntime,
+        nonce_word=nonce_word, is_block=bool(is_block),
+        submitted_at=submitted_at,
+    )
+
+
+def encode_acks_frame(acks: list[tuple[int, str, str]]) -> bytes:
+    parts = [struct.pack(">BH", _BIN_ACKS, len(acks))]
+    for seq, status, error in acks:
+        err = error.encode() if error else b""
+        parts.append(struct.pack(">QBH", seq, _ACK_CODE[status], len(err)))
+        parts.append(err)
+    body = b"".join(parts)
+    return struct.pack(">I", len(body)) + body
+
+
+def decode_acks_frame(body: bytes) -> list[tuple[int, str, str]]:
+    (count,) = struct.unpack_from(">H", body, 1)
+    off = 3
+    out = []
+    for _ in range(count):
+        seq, code, elen = struct.unpack_from(">QBH", body, off)
+        off += 11
+        err = body[off:off + elen].decode()
+        off += elen
+        out.append((seq, _ACK_STATUS[code], err))
+    return out
+
+
+async def read_frame(reader: asyncio.StreamReader):
+    """One bus frame: a dict (JSON control frame) or a decoded binary
+    hot-path tuple ``("share", seq, AcceptedShare)`` /
+    ``("acks", [(seq, status, error), ...])``."""
     (n,) = struct.unpack(">I", await reader.readexactly(4))
     if n > MAX_FRAME:
         raise ValueError(f"bus frame of {n} bytes exceeds cap")
-    return json.loads(await reader.readexactly(n))
+    body = await reader.readexactly(n)
+    first = body[:1]
+    try:
+        if first == b"{":
+            return json.loads(body)
+        if first == bytes([_BIN_SHARE]):
+            seq, share = decode_share_frame(body)
+            return ("share", seq, share)
+        if first == bytes([_BIN_ACKS]):
+            return ("acks", decode_acks_frame(body))
+    except (struct.error, IndexError, UnicodeDecodeError) as e:
+        # a truncated/corrupted body is a WIRE defect: surface it as
+        # the same ValueError every reader already treats as "this
+        # link is broken", never as an unhandled decoder crash
+        raise ValueError(f"malformed bus frame: {e}") from e
+    raise ValueError(f"unknown bus frame tag {body[:1]!r}")
 
 
 def job_to_wire(job: Job) -> dict:
@@ -238,6 +378,20 @@ class ShardConfig:
     hello_timeout: float = 30.0       # worker boot budget (imports + bind)
     ack_timeout: float = 30.0         # share verdict budget on the bus
     dedup_window: int = 1 << 16       # parent-side cross-worker dup window
+    # group-commit ledger: most shares one flush may carry (the batch
+    # grows naturally with load — one queued frame per pending share —
+    # and the cap bounds worst-case flush latency, not throughput)
+    ledger_batch_max: int = 256
+    # bounded ledger queue: a parent that cannot keep up stalls the bus
+    # reads (kernel-buffered backpressure) instead of growing memory
+    ledger_queue_max: int = 16384
+    # bus coalescing window, seconds: frames queued within it share ONE
+    # send syscall per link direction. 0 = flush per event-loop pass
+    # (which degenerates to a syscall per share when traffic is sparse
+    # per pass — the measured bus cost on syscall-serialized kernels);
+    # the few-ms default trades that for a bounded latency add that is
+    # noise against the 50 ms accept SLO
+    bus_coalesce_seconds: float = 0.003
     # seeded fault plan shipped to FIRST-incarnation workers
     # (FaultInjector.from_spec); respawns always run clean
     fault_spec: dict | None = None
@@ -327,7 +481,7 @@ async def _worker_async(spec: dict) -> None:
     wid = int(spec["worker_id"])
     reader, writer = await asyncio.open_unix_connection(spec["bus_path"])
     loop = asyncio.get_running_loop()
-    bus = CoalescingWriter(writer)
+    bus = CoalescingWriter(writer, float(spec.get("bus_coalesce", 0.0)))
     ack_timeout = float(spec["ack_timeout"])
     pending: dict[int, tuple[asyncio.Future, float]] = {}
     seq = itertools.count(1)
@@ -344,6 +498,18 @@ async def _worker_async(spec: dict) -> None:
             # four-digit share rates); the COARSE watchdog below fails
             # stuck acks instead, which is all the timeout ever was —
             # protection against a wedged parent, not a latency SLO
+            return await fut
+        finally:
+            pending.pop(s, None)
+
+    async def share_call(accepted: AcceptedShare) -> tuple[str, str]:
+        # the binary hot-path twin of bus_call: one struct pack instead
+        # of share_to_wire + json.dumps per share
+        s = next(seq)
+        fut = loop.create_future()
+        pending[s] = (fut, loop.time() + ack_timeout)
+        bus.send(encode_share_frame(s, accepted))
+        try:
             return await fut
         finally:
             pending.pop(s, None)
@@ -365,8 +531,7 @@ async def _worker_async(spec: dict) -> None:
         d = faults.hit("worker.crash", str(wid), faults.POINT)
         if d is not None and d.delay:
             await asyncio.sleep(d.delay)
-        status, error = await bus_call(
-            {"t": "share", "share": share_to_wire(accepted)})
+        status, error = await share_call(accepted)
         if status == "dup":
             # the parent's ledger (cross-worker window / chain index)
             # already has this submission: a policy reject the server
@@ -418,6 +583,15 @@ async def _worker_async(spec: dict) -> None:
     try:
         while True:
             msg = await read_frame(reader)
+            if type(msg) is tuple:
+                # binary acks frame: one coalesced multi-verdict frame
+                # per ledger batch — each entry releases its own
+                # share's pending future
+                for ack_seq, ack_status, ack_error in msg[1]:
+                    entry = pending.get(ack_seq)
+                    if entry is not None and not entry[0].done():
+                        entry[0].set_result((ack_status, ack_error))
+                continue
             t = msg.get("t")
             if t == "ack":
                 entry = pending.get(int(msg.get("seq", 0)))
@@ -433,8 +607,10 @@ async def _worker_async(spec: dict) -> None:
                 break
             else:
                 log.warning("worker %d: unknown bus frame %r", wid, t)
-    except (asyncio.IncompleteReadError, ConnectionError):
-        # the supervisor died: no one owns the ledger — stop serving
+    except (asyncio.IncompleteReadError, ConnectionError, ValueError):
+        # the supervisor died — or fed us garbage, which means the
+        # ledger side is broken either way: no one owns the ledger,
+        # stop serving (the supervisor respawns this slot)
         log.warning("worker %d: share bus closed; shutting down", wid)
     finally:
         pusher.cancel()
@@ -460,16 +636,22 @@ class _WorkerLink:
     share, and one send syscall per loop pass is the difference between
     the bus being free and being the bottleneck."""
 
-    def __init__(self, worker_id: int, writer: asyncio.StreamWriter):
+    def __init__(self, worker_id: int, writer: asyncio.StreamWriter,
+                 coalesce: float = 0.0):
         self.worker_id = worker_id
         self.writer = writer
-        self.bus = CoalescingWriter(writer)
+        self.bus = CoalescingWriter(writer, coalesce)
         self.last_snap: dict | None = None
         self.folded = False
 
     def send(self, obj: dict) -> None:
         if not self.writer.is_closing():
             self.bus.send(encode_frame(obj))
+
+    def send_acks(self, acks: list) -> None:
+        """One binary multi-verdict frame (the per-batch ack)."""
+        if not self.writer.is_closing():
+            self.bus.send(encode_acks_frame(acks))
 
 
 @dataclasses.dataclass
@@ -481,6 +663,12 @@ class _WorkerProc:
 
 ShareHook = Callable[[AcceptedShare], Awaitable[None]]
 BlockHook = Callable[[bytes, Job, AcceptedShare], Awaitable[None]]
+# group-commit hook: one call per ledger batch, one (status, error)
+# verdict per share — "ok" or "err" (duplicates never reach it, the
+# supervisor's window refuses them first)
+BatchShareHook = Callable[
+    [list[AcceptedShare]], Awaitable[list[tuple[str, str]]]
+]
 
 
 class ShardSupervisor:
@@ -500,11 +688,17 @@ class ShardSupervisor:
         shard: ShardConfig | None = None,
         on_share: ShareHook | None = None,
         on_block: BlockHook | None = None,
+        on_share_batch: BatchShareHook | None = None,
     ):
         self.config = config or ServerConfig()
         self.shard = shard or ShardConfig()
         self.on_share = on_share
         self.on_block = on_block
+        # group-commit entry point (PoolManager.on_share_batch): when
+        # set, a whole ledger batch flushes through ONE call; otherwise
+        # the batch falls back to sequential per-share on_share calls
+        # (same verdicts, none of the amortization)
+        self.on_share_batch = on_share_batch
         if self.config.extranonce1_factory is not None:
             raise ValueError(
                 "extranonce1_factory cannot cross the worker process "
@@ -518,7 +712,15 @@ class ShardSupervisor:
             "block_errors": 0,
             "worker_deaths": 0,
             "worker_respawns": 0,
+            "ledger_flushes": 0,
         }
+        # batch-shape observability: how many shares each flush carried
+        # and how long the flush took — the knee of the group-commit
+        # curve lives in these two histograms (`/metrics`:
+        # otedama_ledger_batch_size / otedama_ledger_flush_seconds)
+        self.batch_sizes = LatencyHistogram(
+            bounds=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024))
+        self.flush_latency = LatencyHistogram()
         self.jobs: dict[str, Job] = {}
         self.current_job: Job | None = None
         self._current_clean = True
@@ -537,6 +739,12 @@ class ShardSupervisor:
         self._own_bus_dir = False
         self._reserve_sock: socket.socket | None = None
         self._listen_sock: socket.socket | None = None
+        # the ledger queue: every link's reader enqueues share frames in
+        # its read order; ONE committer task drains whatever is pending
+        # into a batch per pass — per-worker FIFO holds because a link's
+        # frames enter (and leave) the queue in order
+        self._ledger_q: asyncio.Queue | None = None
+        self._ledger_task: asyncio.Task | None = None
         self._monitor: asyncio.Task | None = None
         self._respawns: set[asyncio.Task] = set()
         self._stopping = False
@@ -561,6 +769,11 @@ class ShardSupervisor:
             # front-end handoff configure region.session_secret, which
             # the app wiring writes here before start()
             self.config.session_secret = secrets.token_hex(32)
+        # the ledger queue must exist BEFORE the bus accepts its first
+        # link — a worker's first share races supervisor startup
+        self._ledger_q = asyncio.Queue(
+            maxsize=max(1, int(shard.ledger_queue_max)))
+        self._ledger_task = asyncio.create_task(self._ledger_loop())
         self._bus_dir = shard.bus_dir or tempfile.mkdtemp(prefix="otedama-bus-")
         self._own_bus_dir = not shard.bus_dir
         bus_path = os.path.join(self._bus_dir, "bus.sock")
@@ -635,6 +848,7 @@ class ShardSupervisor:
             "ddos": dataclasses.asdict(cfg.ddos) if cfg.ddos else None,
             "snapshot_interval": self.shard.snapshot_interval,
             "ack_timeout": self.shard.ack_timeout,
+            "bus_coalesce": self.shard.bus_coalesce_seconds,
             "fault_spec": fault_spec,
             "log_level": logging.getLevelName(
                 logging.getLogger().getEffectiveLevel()),
@@ -704,6 +918,17 @@ class ShardSupervisor:
             except (asyncio.CancelledError, Exception):
                 pass
             self._monitor = None
+        if self._ledger_task is not None:
+            # cancellation mid-flush is safe: the committer's finally
+            # releases every unresolved claim as failed, and the dying
+            # workers' unacked shares are exactly the crash case the
+            # resubmit/dedup machinery already covers
+            self._ledger_task.cancel()
+            try:
+                await self._ledger_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._ledger_task = None
         for t in list(self._respawns):
             t.cancel()
         for link in list(self._links.values()):
@@ -802,7 +1027,7 @@ class ShardSupervisor:
             writer.close()
             return
         wid = int(hello["worker"])
-        link = _WorkerLink(wid, writer)
+        link = _WorkerLink(wid, writer, self.shard.bus_coalesce_seconds)
         self._links[wid] = link
         if self.current_job is not None:
             link.send({
@@ -813,17 +1038,24 @@ class ShardSupervisor:
         try:
             while True:
                 msg = await read_frame(reader)
+                if type(msg) is tuple:
+                    # binary share frame, decoded at the read seam (a
+                    # malformed frame kills this link, exactly like any
+                    # other wire defect — never the shared committer);
+                    # a full queue stalls this link's reads, which is
+                    # the backpressure, not an error
+                    await self._ledger_q.put((link, msg[1], msg[2]))
+                    continue
                 t = msg.get("t")
-                if t == "share":
-                    await self._handle_share(link, msg)
-                elif t == "block":
+                if t == "block":
                     await self._handle_block(link, msg)
                 elif t == "snap":
                     link.last_snap = msg
                 else:
                     log.warning("bus: unknown frame %r from worker %d",
                                 t, wid)
-        except (asyncio.IncompleteReadError, ConnectionError, ValueError):
+        except (asyncio.IncompleteReadError, ConnectionError, ValueError,
+                KeyError):
             pass
         finally:
             if self._links.get(wid) is link:
@@ -832,77 +1064,176 @@ class ShardSupervisor:
             link.bus.flush()
             writer.close()
 
-    async def _handle_share(self, link: _WorkerLink, msg: dict) -> None:
-        share = share_from_wire(msg["share"])
-        status, error = "ok", ""
-        key = share.header
-        # window entries: True = committed; a Future = a commit IN
-        # FLIGHT on another link. A replay racing an in-flight commit
-        # must wait for ITS outcome — answering "dup" from an entry
-        # whose commit then fails would permanently refuse a share that
-        # was never committed anywhere (the resubmitting miner's
-        # session remembers the duplicate verdict), breaking the
-        # exactly-once contract's "an uncommitted share's resubmit must
-        # LAND" half.
+    # -- the group-commit ledger loop ----------------------------------------
+
+    async def _ledger_loop(self) -> None:
+        """THE committer: drains whatever the links queued into one
+        batch per pass and flushes it as a unit — one dedup sweep, one
+        hook call (one chain commit + one db transaction when the pool
+        manager provides ``on_share_batch``), one coalesced ack frame
+        per link. The batch is pure amortization: per-share verdicts,
+        dedup-window semantics, in-flight-claim replay behavior and
+        chain-first ordering are exactly the per-share path's."""
+        q = self._ledger_q
+        max_batch = max(1, int(self.shard.ledger_batch_max))
+        carry: list = []
         while True:
-            entry = self._dedup.get(key)
-            if entry is None:
-                break
-            if entry is True:
-                status = "dup"
-                break
-            if await entry:          # in-flight commit landed
-                status = "dup"
-                break
-            # the in-flight commit failed and popped its entry; loop —
-            # this replay may now claim the key and commit it
-        checker = self.config.duplicate_checker
-        if status == "ok" and checker is not None and checker(key):
-            # already in another region's books (chain-backed index)
-            status = "dup"
-        if status == "dup":
-            self.stats["duplicates_refused"] += 1
-        else:
-            # claim BEFORE the await: two workers racing the same
-            # header must serialize through this dict, and the handler
-            # is single-threaded only between awaits
-            claim = asyncio.get_running_loop().create_future()
-            self._dedup[key] = claim
+            # deferred frames (in-batch replays + their links' later
+            # frames) go FIRST — their worker's FIFO must not see a
+            # younger frame overtake them out of the queue
+            batch = carry if carry else [await q.get()]
+            carry = []
+            while len(batch) < max_batch and not q.empty():
+                batch.append(q.get_nowait())
             try:
-                if self.on_share is not None:
-                    await self.on_share(share)
-            except Exception as e:
-                # never credited: drop the window entry so the miner's
-                # resubmit can land once accounting recovers
-                self._dedup.pop(key, None)
-                claim.set_result(False)
-                status, error = "err", str(e) or type(e).__name__
-                self.stats["share_errors"] += 1
-            else:
-                self._dedup[key] = True
-                self._dedup_order.append(key)
-                # O(1) eviction of the oldest COMMITTED entries (a key
-                # whose entry was error-popped, or re-committed later,
-                # just skips); in-flight futures are never evicted —
-                # their claim must hold until it resolves
-                while len(self._dedup_order) > self.shard.dedup_window:
-                    old = self._dedup_order.popleft()
-                    if self._dedup.get(old) is True:
-                        del self._dedup[old]
-                claim.set_result(True)
-                self.stats["shares_committed"] += 1
-            finally:
-                if not claim.done():
-                    # a BaseException (handler cancellation mid-commit)
-                    # skipped both arms: an unresolved claim would wedge
-                    # every sibling link awaiting it FOREVER — release
-                    # it as failed so replays can re-claim and commit
+                carry = await self._commit_batch(batch)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("ledger batch commit failed internally")
+
+    async def _commit_batch(
+        self, entries: list[tuple[_WorkerLink, int, AcceptedShare]]
+    ) -> list[tuple[_WorkerLink, int, AcceptedShare]]:
+        """Flush one batch; returns the frames deferred to the next pass.
+
+        Window entries: True = committed; a Future = a commit IN
+        FLIGHT. A replay racing an in-flight commit must wait for ITS
+        outcome — answering "dup" from an entry whose commit then fails
+        would permanently refuse a share that was never committed
+        anywhere. In batch form the race appears as a replay INSIDE the
+        batch that claimed the key: that frame (and every later frame
+        from its link, preserving the worker's FIFO) defers to the next
+        pass, by which time the claim has resolved to committed (dup)
+        or failed (the replay may claim and commit)."""
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        checker = self.config.duplicate_checker
+        deferred: list = []
+        deferred_links: set = set()
+        fresh: list = []                      # (link, seq, share, key)
+        claims: dict[bytes, asyncio.Future] = {}
+        acks: dict[_WorkerLink, list] = {}
+        for link, seq, share in entries:
+            if link in deferred_links:
+                deferred.append((link, seq, share))
+                continue
+            key = share.header
+            status = ""
+            while True:
+                entry = self._dedup.get(key)
+                if entry is None:
+                    break
+                if entry is True:
+                    status = "dup"
+                    break
+                if key in claims:
+                    # claimed earlier IN THIS BATCH: outcome unknown
+                    # until the flush — defer (with this link's tail)
+                    status = "defer"
+                    break
+                # a claim from outside this batch (single committer
+                # makes this unreachable today; kept for the semantics)
+                if await entry:
+                    status = "dup"
+                    break
+                # that commit failed and popped its entry; loop
+            if status == "defer":
+                deferred.append((link, seq, share))
+                deferred_links.add(link)
+                continue
+            if not status and checker is not None and checker(key):
+                # already in another region's books (chain-backed index)
+                status = "dup"
+            if status == "dup":
+                self.stats["duplicates_refused"] += 1
+                acks.setdefault(link, []).append([seq, "dup", ""])
+                continue
+            # claim BEFORE the flush await: frames racing the same
+            # header serialize through this dict
+            claim = loop.create_future()
+            self._dedup[key] = claim
+            claims[key] = claim
+            fresh.append((link, seq, share, key))
+        try:
+            statuses: list[tuple[str, str]] = []
+            if fresh:
+                statuses = await self._flush_shares(
+                    [share for _, _, share, _ in fresh])
+            for (link, seq, share, key), (status, error) in zip(
+                    fresh, statuses):
+                claim = claims[key]
+                if status == "ok":
+                    self._dedup[key] = True
+                    self._dedup_order.append(key)
+                    # O(1) eviction of the oldest COMMITTED entries
+                    # (error-popped or re-committed keys just skip);
+                    # in-flight futures are never evicted
+                    while len(self._dedup_order) > self.shard.dedup_window:
+                        old = self._dedup_order.popleft()
+                        if self._dedup.get(old) is True:
+                            del self._dedup[old]
+                    claim.set_result(True)
+                    self.stats["shares_committed"] += 1
+                else:
+                    # never credited: drop the window entry so the
+                    # miner's resubmit can land once accounting recovers
                     if self._dedup.get(key) is claim:
                         del self._dedup[key]
                     claim.set_result(False)
-        link.send({
-            "t": "ack", "seq": msg["seq"], "status": status, "error": error,
-        })
+                    self.stats["share_errors"] += 1
+                acks.setdefault(link, []).append([seq, status, error])
+        finally:
+            # a BaseException (committer cancellation mid-flush) can
+            # leave claims unresolved: release them as failed so replays
+            # can re-claim — a wedged claim would block siblings forever
+            for key, claim in claims.items():
+                if not claim.done():
+                    if self._dedup.get(key) is claim:
+                        del self._dedup[key]
+                    claim.set_result(False)
+        # ONE coalesced multi-verdict binary frame per link per batch
+        # (the ack path's per-share encode/parse and framing now
+        # amortize like the send syscalls already did)
+        for link, lst in acks.items():
+            link.send_acks(lst)
+        flushed = len(entries) - len(deferred)
+        if flushed > 0:
+            self.batch_sizes.observe(float(flushed))
+            self.flush_latency.observe(loop.time() - t0)
+            self.stats["ledger_flushes"] += 1
+        return deferred
+
+    async def _flush_shares(
+        self, shares: list[AcceptedShare]
+    ) -> list[tuple[str, str]]:
+        """One hook call per batch when the batch hook exists; the
+        sequential per-share fallback otherwise. Always returns one
+        (status, error) per share — a hook failure maps to per-share
+        "err" verdicts, never an exception into the committer."""
+        if self.on_share_batch is not None:
+            try:
+                statuses = list(await self.on_share_batch(list(shares)))
+            except Exception as e:
+                msg = str(e) or type(e).__name__
+                return [("err", msg)] * len(shares)
+            if len(statuses) != len(shares):
+                log.error(
+                    "on_share_batch returned %d verdicts for %d shares",
+                    len(statuses), len(shares))
+                return [("err", "batch hook verdict mismatch")] * len(shares)
+            return statuses
+        if self.on_share is None:
+            return [("ok", "")] * len(shares)
+        out: list[tuple[str, str]] = []
+        for share in shares:
+            try:
+                await self.on_share(share)
+            except Exception as e:
+                out.append(("err", str(e) or type(e).__name__))
+            else:
+                out.append(("ok", ""))
+        return out
 
     async def _handle_block(self, link: _WorkerLink, msg: dict) -> None:
         share = share_from_wire(msg["share"])
@@ -1013,5 +1344,21 @@ class ShardSupervisor:
                 "shares_committed", "duplicates_refused", "share_errors",
                 "blocks_relayed", "block_errors",
             )},
+            "ledger": {
+                "flushes": self.stats["ledger_flushes"],
+                # batch size is a SHARE COUNT distribution: raw units,
+                # not the latency snapshot's *_ms fields
+                "batch_size": {
+                    "count": self.batch_sizes.count,
+                    "avg": round(
+                        self.batch_sizes.sum / self.batch_sizes.count, 2)
+                    if self.batch_sizes.count else 0.0,
+                    "p50": self.batch_sizes.quantile(0.5),
+                    "p99": self.batch_sizes.quantile(0.99),
+                },
+                "flush_latency": self.flush_latency.snapshot(),
+                "pending": (self._ledger_q.qsize()
+                            if self._ledger_q is not None else 0),
+            },
         })
         return merged

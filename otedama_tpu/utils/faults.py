@@ -43,6 +43,7 @@ Fault point registry (grep for ``faults.hit`` to verify):
     payout.settle                               (pool/settlement.py; tag pipeline stage)
     payout.submit                               (pool/settlement.py wallet send)
     region.sever                                (pool/regions.py commit path; tag region id)
+    ledger.flush                                (pool/manager.py on_share_batch, between chain and db commit)
     region.handoff                              (stratum/server.py resume verification; tag session id)
     worker.crash                                (stratum/shard.py worker share-forward; tag worker id)
     pool.submitter.submit                       (pool/submitter.py retry loop)

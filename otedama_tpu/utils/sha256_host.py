@@ -128,6 +128,106 @@ def sha256d(data: bytes) -> bytes:
     return hashlib.sha256(hashlib.sha256(data).digest()).digest()
 
 
+# Lane-parallel numpy sha256d pays ~64 rounds x ~12 ops x 3 blocks of
+# numpy dispatch overhead PER BATCH (measured ~12 ms at any lane count
+# on this class of host) while OpenSSL costs ~2 us per hash — the
+# vectorized path only wins once a batch is thousands of headers deep.
+# Group-commit batches are tens-to-hundreds, so the default "one pass"
+# is the hoisted-constructor OpenSSL sweep; the numpy lanes exist for
+# bulk rescans/audits and as the oracle-tested twin.
+NUMPY_LANE_MIN_BATCH = 8192
+
+
+def sha256d_batch(items: list[bytes]) -> list[bytes]:
+    """One host pass of ``sha256d`` over N same-shaped messages (the
+    group-commit ledger hashes a batch of 80-byte stratum headers per
+    flush instead of one header per share). Dispatches to the numpy
+    lane implementation only past ``NUMPY_LANE_MIN_BATCH`` — below it,
+    one tight OpenSSL sweep with the constructor lookup hoisted is
+    strictly faster (see the crossover note above)."""
+    if len(items) >= NUMPY_LANE_MIN_BATCH:
+        try:
+            return _sha256d_lanes(items)
+        except ImportError:
+            pass
+    _new = hashlib.sha256
+    return [_new(_new(d).digest()).digest() for d in items]
+
+
+def _sha256d_lanes(items: list[bytes]) -> list[bytes]:
+    """numpy lane-parallel sha256d: every compression round is one
+    elementwise op across all N lanes. Messages must share one length
+    (the 80-byte header shape); output is bit-identical to hashlib
+    (pinned in tests/test_group_commit.py)."""
+    import numpy as np
+
+    if not items:
+        return []
+    n = len(items)
+    ln = len(items[0])
+    if any(len(d) != ln for d in items):
+        raise ValueError("sha256d_batch lanes require same-length items")
+
+    mask = np.uint32(0xFFFFFFFF)
+
+    def rotr(x, r):
+        return ((x >> np.uint32(r)) | (x << np.uint32(32 - r))) & mask
+
+    def compress(state, w):
+        # w: list of 64 arrays (n,) uint32 — message schedule per round
+        for i in range(16, 64):
+            s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> np.uint32(3))
+            s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> np.uint32(10))
+            w.append((w[i - 16] + s0 + w[i - 7] + s1) & mask)
+        a, b, c, d, e, f, g, h = state
+        for i in range(64):
+            s1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25)
+            ch = (e & f) ^ (~e & g)
+            t1 = (h + s1 + ch + np.uint32(SHA256_K[i]) + w[i]) & mask
+            s0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22)
+            maj = (a & b) ^ (a & c) ^ (b & c)
+            t2 = (s0 + maj) & mask
+            h, g, f, e, d, c, b, a = (
+                g, f, e, (d + t1) & mask, c, b, a, (t1 + t2) & mask)
+        return [(s + v) & mask for s, v in zip(state, (a, b, c, d, e, f, g, h))]
+
+    def run(msgs: np.ndarray) -> np.ndarray:
+        # msgs: (n, L) uint8, already padded to a 64-byte multiple
+        words = msgs.reshape(n, -1, 4)
+        w32 = (
+            (words[:, :, 0].astype(np.uint32) << 24)
+            | (words[:, :, 1].astype(np.uint32) << 16)
+            | (words[:, :, 2].astype(np.uint32) << 8)
+            | words[:, :, 3].astype(np.uint32)
+        )
+        state = [np.full(n, iv, dtype=np.uint32) for iv in SHA256_IV]
+        for blk in range(w32.shape[1] // 16):
+            w = [w32[:, blk * 16 + i].copy() for i in range(16)]
+            state = compress(state, w)
+        out = np.zeros((n, 32), dtype=np.uint8)
+        for i, s in enumerate(state):
+            out[:, 4 * i] = (s >> np.uint32(24)).astype(np.uint8)
+            out[:, 4 * i + 1] = ((s >> np.uint32(16)) & np.uint32(0xFF)).astype(np.uint8)
+            out[:, 4 * i + 2] = ((s >> np.uint32(8)) & np.uint32(0xFF)).astype(np.uint8)
+            out[:, 4 * i + 3] = (s & np.uint32(0xFF)).astype(np.uint8)
+        return out
+
+    def pad(raw: np.ndarray, msg_len: int) -> np.ndarray:
+        total = ((msg_len + 8) // 64 + 1) * 64
+        padded = np.zeros((n, total), dtype=np.uint8)
+        padded[:, :msg_len] = raw
+        padded[:, msg_len] = 0x80
+        bitlen = msg_len * 8
+        for i in range(8):
+            padded[:, total - 1 - i] = (bitlen >> (8 * i)) & 0xFF
+        return padded
+
+    raw = np.frombuffer(b"".join(items), dtype=np.uint8).reshape(n, ln)
+    first = run(pad(raw, ln))
+    second = run(pad(first, 32))
+    return [second[i].tobytes() for i in range(n)]
+
+
 class Sha256Midstate:
     """Resumable SHA-256 over a fixed prefix — the VALIDATION-side
     midstate trick.

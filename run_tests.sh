@@ -14,9 +14,13 @@
 #   stratum-shard-bench  opt-in sharded front-end soak: the 10k+
 #                   connection run across STRATUM_BENCH_WORKERS
 #                   (default 4) SO_REUSEPORT acceptor processes with a
-#                   single-process control leg; asserts exact
-#                   accounting AND an identical PPLNS split between
-#                   legs; writes a BENCH_STRATUM json artifact.
+#                   single-process control leg; sweeps the offered
+#                   share rate over STRATUM_BENCH_PACES (default
+#                   1500,3000,4500,6500 shares/s) so the artifact commits
+#                   shares/s vs server p99 at every point (the knee of
+#                   the group-commit curve); asserts exact accounting
+#                   AND an identical PPLNS split between legs; writes a
+#                   BENCH_STRATUM json artifact.
 #   switch-bench    opt-in compilation-lifecycle bench: cold-start with
 #                   cold vs warm persistent XLA cache + mid-run
 #                   sha256d->scrypt warm switch; writes a BENCH_SWITCH
@@ -74,8 +78,9 @@ case "$tier" in
     exec env JAX_PLATFORMS=cpu python tools/bench_stratum.py \
       --workers "${STRATUM_BENCH_WORKERS:-4}" \
       --connections "${STRATUM_BENCH_CONNS:-10000}" \
-      --window "${STRATUM_BENCH_WINDOW:-15}" \
+      --window "${STRATUM_BENCH_WINDOW:-12}" \
       --control \
+      --pace "${STRATUM_BENCH_PACES:-1500,3000,4500,6500}" \
       --out "${STRATUM_BENCH_OUT:-BENCH_STRATUM_manual.json}" "$@" ;;
   switch-bench)
     exec env JAX_PLATFORMS=cpu python tools/bench_switch.py \

@@ -5,8 +5,11 @@ Postgres tier could never execute (r4 verdict weak #4). This emulator
 speaks the REAL v3 frontend/backend protocol over a real socket —
 startup, cleartext-password auth, simple queries, RowDescription/
 DataRow/CommandComplete/ErrorResponse framing — and executes the SQL
-on sqlite (3.40: native RETURNING) after reverse-translating the few
-postgres-only spellings the repo's migrations emit.
+on sqlite after reverse-translating the few postgres-only spellings
+the repo's migrations emit. ``INSERT ... RETURNING <col>`` runs
+natively on sqlite >= 3.35 and as a ``last_insert_rowid()``-style
+two-step on older runtimes (``SQLITE_HAS_RETURNING``), so the tier
+runs clean on sandbox sqlite builds either way.
 
 What this proves: the vendored driver (db/pgwire.py) and every layer
 above it (db/postgres.py dialect translation, RETURNING-id plumbing,
@@ -23,6 +26,14 @@ import socket
 import sqlite3
 import struct
 import threading
+
+# Native INSERT ... RETURNING needs sqlite >= 3.35; older runtimes (the
+# sandbox ships 3.34) emulate it as a two-step: run the INSERT, then
+# answer the RETURNING columns from last_insert_rowid() — detected ONCE
+# at import so the fallback never masks a real syntax error elsewhere.
+SQLITE_HAS_RETURNING = sqlite3.sqlite_version_info >= (3, 35, 0)
+_RETURNING = re.compile(r"^(?P<body>.*?)\s+RETURNING\s+(?P<col>\w+)\s*;?\s*$",
+                        re.IGNORECASE | re.DOTALL)
 
 # type OIDs the emulator emits (mirrors pgwire's decode table)
 OID_INT8, OID_FLOAT8, OID_TEXT, OID_BOOL, OID_BYTEA = 20, 701, 25, 16, 17
@@ -179,10 +190,24 @@ class PgEmulator:
                 self._send_rows(sock, ["pg_advisory_lock"],
                                 [OID_TEXT], [(None,)], "SELECT 1")
                 return
+            sql_run = _reverse_ddl(sql)
+            returning_col = None
+            m = _RETURNING.match(sql_run)
+            if (m and not SQLITE_HAS_RETURNING
+                    and sql_run.lstrip()[:6].upper() == "INSERT"):
+                # lastrowid-style two-step fallback for pre-3.35 sqlite
+                sql_run = m.group("body")
+                returning_col = m.group("col")
             with self._dblock:
-                cur = self._db.execute(_reverse_ddl(sql))
+                cur = self._db.execute(sql_run)
                 rows = cur.fetchall()
                 rc = cur.rowcount
+                lastrowid = cur.lastrowid
+            if returning_col is not None:
+                self._send_rows(
+                    sock, [returning_col], [OID_INT8],
+                    [(lastrowid,)], f"INSERT 0 {max(rc, 1)}")
+                return
             verb = (sql.strip().split() or ["?"])[0].upper()
             if rows or (cur.description and verb in ("SELECT", "INSERT",
                                                      "UPDATE", "DELETE")):

@@ -503,6 +503,10 @@ async def test_stratum_oversized_line_cut():
 # -- at-rest encryption (reference: internal/security/encryption.go) ---------
 
 def test_encryption_roundtrip_and_tamper():
+    pytest.importorskip(
+        "cryptography",
+        reason="at-rest encryption needs the optional `cryptography` "
+               "package (pip install cryptography) — see README")
     from otedama_tpu.security import encryption as enc
 
     sealed = enc.encrypt_bytes(b"wallet seed material", "pass-phrase")
@@ -524,6 +528,10 @@ def test_encryption_roundtrip_and_tamper():
 
 
 def test_secret_store(tmp_path):
+    pytest.importorskip(
+        "cryptography",
+        reason="at-rest encryption needs the optional `cryptography` "
+               "package (pip install cryptography) — see README")
     from otedama_tpu.security.encryption import SecretStore, DecryptionError
 
     p = str(tmp_path / "secrets.enc")

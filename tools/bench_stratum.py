@@ -38,11 +38,19 @@ spawn and must fit the worst-case skew (every connection landing on
 one worker). Exits 2 with a clear message if the budget cannot fit — a
 silently skipped soak is how scale claims rot.
 
+``--pace "r1,r2,r3"`` turns the submit window into a SWEEP: one
+connected fleet runs one paced submit phase per offered rate
+(shares/s), and the artifact's ``pace_sweep`` records achieved
+shares/s vs per-phase server p50/p99 (histogram-diffed between phase
+boundaries) at every point — committing the knee of the accept-path
+curve, not just one operating point. Headline numbers become the best
+sustained phase's.
+
 Usage:
     python tools/bench_stratum.py --connections 1000 --shares 3 \
         --out BENCH_STRATUM_r06.json
     python tools/bench_stratum.py --workers 4 --connections 10000 \
-        --control --out BENCH_STRATUM_r13.json
+        --control --pace 2000,4500,6500 --out BENCH_STRATUM_r14.json
 """
 
 from __future__ import annotations
@@ -53,8 +61,10 @@ import dataclasses
 import json
 import multiprocessing as mp
 import os
+import queue
 import random
 import resource
+import socket
 import struct
 import sys
 import time
@@ -192,6 +202,15 @@ class Miner:
             if m.is_response and m.id == msg_id:
                 return m
 
+    async def submit_phase(self, job: Job,
+                           shares: list[tuple[bytes, int]],
+                           window: float, t_start: float) -> list[float]:
+        """One paced submit phase; returns ITS latencies (``--pace``
+        sweep legs run several phases over one connected fleet)."""
+        start = len(self.latencies)
+        await self.submit_all(job, shares, window, t_start)
+        return self.latencies[start:]
+
     async def submit_all(self, job: Job,
                          shares: list[tuple[bytes, int]],
                          window: float, t_start: float) -> None:
@@ -206,7 +225,16 @@ class Miner:
         (one in-flight request per miner means the next response line
         IS ours), and there's no per-call timer or drain."""
         rng = random.Random(self.ident)
-        deadlines = sorted(rng.random() * window for _ in shares)
+        # deadlines quantize to a 20 ms grid: pacing is statistically
+        # unchanged (miners land uniformly over the window), but the
+        # fleet's wakeups collapse from one loop timer PER SHARE to one
+        # per tick serving a herd — on this class of sandbox kernel the
+        # syscall BUDGET is global (~40k/s, interposer-serialized), and
+        # a timer wakeup per share was a real bite out of the rate the
+        # servers under test could be offered
+        grid = 0.02
+        deadlines = sorted(
+            round(rng.random() * window / grid) * grid for _ in shares)
         lines = [
             sp.encode_line(sp.Message(
                 id=10 + i, method="mining.submit",
@@ -245,6 +273,127 @@ def percentile(values: list[float], q: float) -> float:
     return s[min(len(s) - 1, int(q * len(s)))]
 
 
+def _echo_server_proc(q, reuse_port: int) -> None:
+    """Bare asyncio echo worker for the harness calibration below."""
+    async def main():
+        async def handle(r, w):
+            try:
+                while True:
+                    w.write(await r.readexactly(64))
+            except (asyncio.IncompleteReadError, ConnectionError):
+                pass
+
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        sock.bind(("127.0.0.1", reuse_port))
+        sock.listen(512)
+        sock.setblocking(False)
+        srv = await asyncio.start_server(handle, sock=sock)
+        q.put(srv.sockets[0].getsockname()[1])
+        # generous lifetime: on the interposed sandbox the client
+        # shards' 1,000-connection setup alone can take tens of
+        # seconds, and a server dying mid-pump aborts the sample
+        await asyncio.sleep(300)
+
+    asyncio.run(main())
+
+
+def _echo_client_proc(port: int, out, conns: int, dur: float) -> None:
+    async def main():
+        cs = [await asyncio.open_connection("127.0.0.1", port)
+              for _ in range(conns)]
+        count = 0
+        stop = time.monotonic() + dur
+
+        async def pump(r, w):
+            nonlocal count
+            payload = b"y" * 64
+            while time.monotonic() < stop:
+                w.write(payload)
+                await r.readexactly(64)
+                count += 1
+
+        await asyncio.gather(*[pump(r, w) for r, w in cs])
+        for _, w in cs:
+            w.close()
+        out.put(count / dur)
+
+    try:
+        asyncio.run(main())
+    except Exception:
+        # a reset/slow connect must degrade to a zero sample, never
+        # leave the parent blocked on a result that will never come
+        out.put(0.0)
+
+
+def harness_calibration(workers: int = 4, fleet: int = 2,
+                        conns: int = 1000, dur: float = 8.0,
+                        trials: int = 3) -> float:
+    """Measure what THIS host's kernel/scheduler can move at all: a
+    bare 64-byte asyncio echo in the soak's exact process topology
+    (``workers`` SO_REUSEPORT echo servers + ``fleet`` client shards,
+    one request in flight per connection) with zero pool logic. On
+    syscall-interposed sandbox kernels the whole box shares one
+    serialized syscall/wakeup budget, so this round-trip rate — not
+    CPU, not the ledger — is the bench's true ceiling; committing it
+    with the artifact makes the achieved shares/s interpretable as a
+    fraction of what the harness could carry.
+
+    The interposed scheduler is NOISY (same topology measures 3x apart
+    run to run), so the ceiling is the MAX over ``trials`` — a lower
+    trial means the scheduler was having a bad day, not that the box
+    shrank."""
+    if trials > 1:
+        return max(
+            harness_calibration(workers, fleet, conns, dur, trials=1)
+            for _ in range(trials)
+        )
+    ctx = mp.get_context(
+        "fork" if "fork" in mp.get_all_start_methods() else "spawn")
+    q = ctx.Queue()
+    out = ctx.Queue()
+    servers = [ctx.Process(target=_echo_server_proc, args=(q, 0),
+                           daemon=True)]
+    servers[0].start()
+    port = q.get()
+    for _ in range(workers - 1):
+        p = ctx.Process(target=_echo_server_proc, args=(q, port),
+                        daemon=True)
+        p.start()
+        q.get()
+        servers.append(p)
+    clients = [
+        ctx.Process(target=_echo_client_proc,
+                    args=(port, out, conns // fleet, dur), daemon=True)
+        for _ in range(fleet)
+    ]
+    for c in clients:
+        c.start()
+    # liveness-polled collection (the _Fleet._recv_all rule): a child
+    # that died without reporting yields a zero sample instead of
+    # wedging the whole bench on a Queue.get that can never return
+    total = 0.0
+    deadline = time.monotonic() + dur + 120.0
+    for c in clients:
+        while True:
+            try:
+                total += out.get(timeout=1.0)
+                break
+            except queue.Empty:
+                if not c.is_alive():
+                    break
+                if time.monotonic() > deadline:
+                    break
+    for c in clients:
+        c.join(10.0)
+        if c.is_alive():
+            c.kill()
+    for p in servers:
+        p.terminate()
+    return total
+
+
 def _bench_server_config(max_clients: int) -> ServerConfig:
     # loopback fleet: the whole swarm shares one IP — lift the per-IP
     # caps IN CONFIG (sharded workers build their own guards from it),
@@ -279,35 +428,27 @@ def _pplns_split(pool: PoolManager) -> dict[str, int]:
     return {p.worker: p.amount for p in result.payouts}
 
 
-async def _drive_fleet(port: int, connections: int, shares_per_conn: int,
-                       window: float, connect_rate: float,
-                       job: Job, ident_base: int = 0) -> dict:
-    """The miner swarm: paced connect ramp, off-window premine, uniform
-    submit schedule. Runs inline (classic mode) or inside dedicated
-    fleet child processes (``workers > 1`` legs), where each shard
-    holds ONLY its own client socket ends. ``ident_base`` keeps worker
-    names globally unique across fleet shards."""
-    target = tgt.difficulty_to_target(EASY)
-    miners = [Miner(ident_base + i, port) for i in range(connections)]
-
-    # -- connect phase: paced ramp ----------------------------------------
-    # a simultaneous connect storm measures the kernel accept queue, not
-    # the server — and its queueing previously bled into the submit
-    # window's client percentiles (r06: client p99 245 ms vs server 5 ms)
+async def _connect_ramp(miners: list[Miner], connect_rate: float) -> float:
+    """Paced connect ramp — a simultaneous connect storm measures the
+    kernel accept queue, not the server, and its queueing previously
+    bled into the submit window's client percentiles (r06: client p99
+    245 ms vs server 5 ms)."""
     batch = 50
-    t_conn0 = time.monotonic()
-    for i in range(0, connections, batch):
-        t_sched = t_conn0 + i / connect_rate
-        delay = t_sched - time.monotonic()
+    t0 = time.monotonic()
+    for i in range(0, len(miners), batch):
+        delay = t0 + i / connect_rate - time.monotonic()
         if delay > 0:
             await asyncio.sleep(delay)
         await asyncio.gather(*[m.connect() for m in miners[i:i + batch]])
-    connect_seconds = time.monotonic() - t_conn0
+    return time.monotonic() - t0
 
-    # pre-mine every share OFF the measured window (pure hashlib; the
-    # miners' cost is not the system under test)
+
+def _premine(miners: list[Miner], job: Job, shares_per_conn: int,
+             target: int) -> tuple[list[list[tuple[bytes, int]]], float]:
+    """Pre-mine every share OFF the measured window (pure hashlib; the
+    miners' cost is not the system under test)."""
     mined: list[list[tuple[bytes, int]]] = []
-    t_mine0 = time.monotonic()
+    t0 = time.monotonic()
     for m in miners:
         lst = []
         for i in range(shares_per_conn):
@@ -316,9 +457,19 @@ async def _drive_fleet(port: int, connections: int, shares_per_conn: int,
             if nonce is not None:
                 lst.append((en2, nonce))
         mined.append(lst)
-    mine_seconds = time.monotonic() - t_mine0
+    return mined, time.monotonic() - t0
 
-    # -- submit phase ------------------------------------------------------
+
+async def _drive_fleet(port: int, connections: int, shares_per_conn: int,
+                       window: float, connect_rate: float,
+                       job: Job, ident_base: int = 0) -> dict:
+    """The inline miner swarm (classic single-process legs): paced
+    connect ramp, off-window premine, one uniform submit phase."""
+    target = tgt.difficulty_to_target(EASY)
+    miners = [Miner(ident_base + i, port) for i in range(connections)]
+    connect_seconds = await _connect_ramp(miners, connect_rate)
+    mined, mine_seconds = _premine(miners, job, shares_per_conn, target)
+
     # ONE coarse deadline for the whole phase (the hot loop stays
     # timer-free): a wedged server must fail the bench loudly, never
     # hang it past any artifact
@@ -339,6 +490,12 @@ async def _drive_fleet(port: int, connections: int, shares_per_conn: int,
         "client_lat": [lat for m in miners for lat in m.latencies],
         "premine_seconds": mine_seconds,
         "elapsed": elapsed,
+        "phases": [{
+            "accepted": sum(m.accepted for m in miners),
+            "rejected": sum(m.rejected for m in miners),
+            "client_lat": [lat for m in miners for lat in m.latencies],
+            "elapsed": elapsed,
+        }],
         "per_worker_client": {
             f"w.{m.ident}": m.accepted for m in miners if m.accepted
         },
@@ -348,48 +505,178 @@ async def _drive_fleet(port: int, connections: int, shares_per_conn: int,
     return out
 
 
-def _fleet_proc(conn, port: int, connections: int, shares_per_conn: int,
+def _fleet_proc(conn, port: int, connections: int, phase_shares: list[int],
                 window: float, connect_rate: float, job_wire: dict,
                 ident_base: int) -> None:
-    """Child-process wrapper around ``_drive_fleet`` (top-level for the
-    spawn start method)."""
+    """Child-process fleet driver (top-level for the spawn start
+    method). Speaks a phased protocol over its Pipe so one connected
+    fleet can run several paced submit phases (the ``--pace`` sweep):
+
+        child -> {"t": "ready", connect/premine stats}
+        parent -> {"t": "go", "t_start": <wall clock>}     (per phase)
+        child -> {"t": "phase", per-phase deltas}          (per phase)
+        child -> {"t": "done", totals}
+    """
     from otedama_tpu.stratum.shard import job_from_wire
 
     try:
-        res = asyncio.run(_drive_fleet(
-            port, connections, shares_per_conn, window, connect_rate,
-            job_from_wire(job_wire), ident_base))
-        conn.send(res)
+        profile_dir = os.environ.get("OTEDAMA_FLEET_PROFILE", "")
+        if profile_dir:  # perf forensics: per-shard cProfile dump
+            import cProfile
+
+            prof = cProfile.Profile()
+            try:
+                prof.runcall(asyncio.run, _fleet_child(
+                    conn, port, connections, phase_shares, window,
+                    connect_rate, job_from_wire(job_wire), ident_base))
+            finally:
+                prof.dump_stats(os.path.join(
+                    profile_dir, f"fleet-{ident_base}.pstats"))
+        else:
+            asyncio.run(_fleet_child(
+                conn, port, connections, phase_shares, window, connect_rate,
+                job_from_wire(job_wire), ident_base))
     except Exception as e:  # surfaced parent-side as a loud failure
-        conn.send({"error": repr(e)})
+        try:
+            conn.send({"t": "error", "error": repr(e)})
+        except OSError:
+            pass
     finally:
         conn.close()
 
 
-def _merge_fleets(parts: list[dict]) -> dict:
-    out = {
-        "accepted": sum(p["accepted"] for p in parts),
-        "rejected": sum(p["rejected"] for p in parts),
-        "connect_seconds": max(p["connect_seconds"] for p in parts),
-        "connect_lat": [v for p in parts for v in p["connect_lat"]],
-        "client_lat": [v for p in parts for v in p["client_lat"]],
-        "premine_seconds": max(p["premine_seconds"] for p in parts),
-        "elapsed": max(p["elapsed"] for p in parts),
-        "per_worker_client": {},
-    }
-    for p in parts:
-        out["per_worker_client"].update(p["per_worker_client"])
-    return out
+async def _fleet_child(conn, port: int, connections: int,
+                       phase_shares: list[int], window: float,
+                       connect_rate: float, job: Job,
+                       ident_base: int) -> None:
+    loop = asyncio.get_running_loop()
+    target = tgt.difficulty_to_target(EASY)
+    miners = [Miner(ident_base + i, port) for i in range(connections)]
+    connect_seconds = await _connect_ramp(miners, connect_rate)
+    mined, mine_seconds = _premine(miners, job, sum(phase_shares), target)
+    conn.send({
+        "t": "ready",
+        "connect_seconds": connect_seconds,
+        "connect_lat": [m.connect_latency for m in miners],
+        "premine_seconds": mine_seconds,
+    })
+    offset = 0
+    for n in phase_shares:
+        msg = await loop.run_in_executor(None, conn.recv)
+        if msg.get("t") != "go":
+            raise RuntimeError(f"fleet child expected go, got {msg!r}")
+        # wall-clock sync: every child (and the parent's window math)
+        # starts the phase at the same instant
+        t_start = time.monotonic() + max(0.0, float(msg["t_start"])
+                                         - time.time())
+        a0 = sum(m.accepted for m in miners)
+        r0 = sum(m.rejected for m in miners)
+        lats = await asyncio.wait_for(
+            asyncio.gather(*[
+                m.submit_phase(job, lst[offset:offset + n], window, t_start)
+                for m, lst in zip(miners, mined)
+            ]),
+            timeout=(t_start - time.monotonic()) + window + 600.0,
+        )
+        conn.send({
+            "t": "phase",
+            "accepted": sum(m.accepted for m in miners) - a0,
+            "rejected": sum(m.rejected for m in miners) - r0,
+            "client_lat": [v for ls in lats for v in ls],
+            "elapsed": time.monotonic() - t_start,
+        })
+        offset += n
+    conn.send({
+        "t": "done",
+        "accepted": sum(m.accepted for m in miners),
+        "rejected": sum(m.rejected for m in miners),
+        "per_worker_client": {
+            f"w.{m.ident}": m.accepted for m in miners if m.accepted
+        },
+    })
+    for m in miners:
+        m.close()
 
 
-async def _run_fleet_children(port: int, connections: int,
-                              shares_per_conn: int, window: float,
-                              connect_rate: float, job: Job,
-                              procs: int = 2) -> dict:
-    """Run the swarm as ``procs`` child processes, each driving an even
-    split of the connections (paced so the AGGREGATE connect rate is
-    ``connect_rate``). One process per ~5k connections keeps the driver
-    loops small enough that the fleet never becomes the measurement."""
+class _Fleet:
+    """Parent-side handle over the fleet child processes: broadcasts
+    phase starts, merges per-child frames, fails loudly on a dead
+    child."""
+
+    def __init__(self, children: list):
+        self.children = children          # [(proc, conn), ...]
+
+    async def _recv_all(self) -> list[dict]:
+        loop = asyncio.get_running_loop()
+
+        def _recv(proc, conn) -> dict:
+            # the fleet runs for minutes; poll so a dead child fails
+            # loudly instead of blocking an executor thread forever
+            while not conn.poll(1.0):
+                if not proc.is_alive():
+                    raise RuntimeError(
+                        f"miner fleet died (exit {proc.exitcode})")
+            return conn.recv()
+
+        parts = list(await asyncio.gather(*[
+            loop.run_in_executor(None, _recv, proc, conn)
+            for proc, conn in self.children
+        ]))
+        for p in parts:
+            if p.get("t") == "error":
+                raise RuntimeError(f"miner fleet failed: {p['error']}")
+        return parts
+
+    async def ready(self) -> dict:
+        parts = await self._recv_all()
+        return {
+            "connect_seconds": max(p["connect_seconds"] for p in parts),
+            "connect_lat": [v for p in parts for v in p["connect_lat"]],
+            "premine_seconds": max(p["premine_seconds"] for p in parts),
+        }
+
+    async def run_phase(self) -> dict:
+        t_start = time.time() + 0.5
+        for _, conn in self.children:
+            conn.send({"t": "go", "t_start": t_start})
+        parts = await self._recv_all()
+        return {
+            "accepted": sum(p["accepted"] for p in parts),
+            "rejected": sum(p["rejected"] for p in parts),
+            "client_lat": [v for p in parts for v in p["client_lat"]],
+            "elapsed": max(p["elapsed"] for p in parts),
+        }
+
+    async def finish(self) -> dict:
+        parts = await self._recv_all()
+        out = {
+            "accepted": sum(p["accepted"] for p in parts),
+            "rejected": sum(p["rejected"] for p in parts),
+            "per_worker_client": {},
+        }
+        for p in parts:
+            out["per_worker_client"].update(p["per_worker_client"])
+        loop = asyncio.get_running_loop()
+        for proc, _ in self.children:
+            await loop.run_in_executor(None, proc.join, 10.0)
+            if proc.is_alive():
+                proc.kill()
+        return out
+
+    def kill(self) -> None:
+        for proc, _ in self.children:
+            if proc.is_alive():
+                proc.kill()
+
+
+def _spawn_fleet(port: int, connections: int, phase_shares: list[int],
+                 window: float, connect_rate: float, job: Job,
+                 procs: int = 2) -> _Fleet:
+    """Spawn the swarm as ``procs`` child processes, each driving an
+    even split of the connections (paced so the AGGREGATE connect rate
+    is ``connect_rate``). One process per ~5k connections keeps the
+    driver loops small enough that the fleet never becomes the
+    measurement."""
     from otedama_tpu.stratum.shard import job_to_wire
 
     ctx = mp.get_context(
@@ -404,7 +691,7 @@ async def _run_fleet_children(port: int, connections: int,
         parent_conn, child_conn = ctx.Pipe()
         proc = ctx.Process(
             target=_fleet_proc,
-            args=(child_conn, port, n, shares_per_conn, window,
+            args=(child_conn, port, n, phase_shares, window,
                   connect_rate / procs, job_to_wire(job), base),
             daemon=True,
         )
@@ -412,44 +699,49 @@ async def _run_fleet_children(port: int, connections: int,
         child_conn.close()
         children.append((proc, parent_conn))
         base += n
-    loop = asyncio.get_running_loop()
+    return _Fleet(children)
 
-    def _recv(proc, conn) -> dict:
-        # the fleet runs for minutes; poll so a dead child fails loudly
-        # instead of blocking an executor thread forever
-        while not conn.poll(1.0):
-            if not proc.is_alive():
-                raise RuntimeError(
-                    f"miner fleet died (exit {proc.exitcode})")
-        return conn.recv()
 
-    parts = []
-    try:
-        parts = list(await asyncio.gather(*[
-            loop.run_in_executor(None, _recv, proc, conn)
-            for proc, conn in children
-        ]))
-    finally:
-        for proc, _ in children:
-            await loop.run_in_executor(None, proc.join, 10.0)
-            if proc.is_alive():
-                proc.kill()
-    for p in parts:
-        if "error" in p:
-            raise RuntimeError(f"miner fleet failed: {p['error']}")
-    return _merge_fleets(parts)
+def _hist_state(server) -> tuple[dict, int, float]:
+    """Snapshot the server-side accept histogram (cumulative counts,
+    count, sum) — phase percentiles come from DIFFS of these."""
+    h = server.latency
+    return h.cumulative(), h.count, h.sum
+
+
+def _diff_quantile(before: tuple, after: tuple, q: float) -> float:
+    """Bucket-resolution quantile of the observations BETWEEN two
+    cumulative-histogram snapshots (the per-phase server percentile of
+    the ``--pace`` sweep). Same conservative upper-bound semantics as
+    LatencyHistogram.quantile."""
+    dcount = after[1] - before[1]
+    if dcount <= 0:
+        return 0.0
+    rank = q * dcount
+    for bound in sorted(after[0]):
+        if after[0][bound] - before[0].get(bound, 0) >= rank:
+            return bound
+    return float("inf")
 
 
 async def run_leg(connections: int, shares_per_conn: int, window: float,
                   workers: int, connect_rate: float,
-                  remote_miners: bool | None = None) -> dict:
+                  remote_miners: bool | None = None,
+                  paces: list[float] | None = None) -> dict:
     """One full soak leg (either serving mode) with PoolManager
     accounting; returns metrics + the per-worker books for cross-leg
     comparison. ``remote_miners`` (default: on for multi-worker runs
     and their controls) drives the swarm from a child process so no
     process holds both socket ends — the fd shape six-digit soaks need,
     and client latencies measured from a seat the serving loops never
-    contend with."""
+    contend with.
+
+    ``paces`` (the ``--pace`` sweep): offered aggregate share rates,
+    each run as its own paced submit phase over the SAME connected
+    fleet, with per-phase shares/s and server percentiles reported in
+    ``pace_sweep`` — the knee of the accept-path curve, committed in
+    the artifact instead of one operating point. The leg's headline
+    numbers are then the best sustained phase's."""
     pool = _make_ledger()
     hook_count = 0
 
@@ -458,12 +750,25 @@ async def run_leg(connections: int, shares_per_conn: int, window: float,
         hook_count += 1
         await pool.on_share(s)
 
+    async def on_share_batch(shares):
+        nonlocal hook_count
+        hook_count += len(shares)
+        return await pool.on_share_batch(shares)
+
     sharded = workers > 1
     if sharded:
         server = ShardSupervisor(
             _bench_server_config(max_clients=connections + 64),
-            ShardConfig(workers=workers, snapshot_interval=0.5),
+            # ack_timeout far above any sweep point's queue wait: a
+            # deliberately-overloaded pace phase must show up as
+            # QUEUEING (the p99 the artifact exists to record), not as
+            # a mass ack-timeout reject storm that breaks the exactness
+            # audit — production keeps the tight default, where a
+            # 3-minute-stuck ledger IS an accounting outage
+            ShardConfig(workers=workers, snapshot_interval=0.5,
+                        ack_timeout=180.0),
             on_share=on_share,
+            on_share_batch=on_share_batch,
         )
     else:
         server = StratumServer(
@@ -474,12 +779,49 @@ async def run_leg(connections: int, shares_per_conn: int, window: float,
     job = make_job()
     server.set_job(job)
 
+    if paces:
+        # offered rate pace -> shares per connection per phase
+        phase_shares = [
+            max(1, round(p * window / connections)) for p in paces
+        ]
+    else:
+        phase_shares = [shares_per_conn]
     if remote_miners is None:
-        remote_miners = sharded
+        remote_miners = sharded or bool(paces)
     if remote_miners:
-        fleet = await _run_fleet_children(
-            server.port, connections, shares_per_conn, window,
-            connect_rate, job, procs=max(1, connections // 5000) + 1)
+        # fleet shards: one per ~4k connections, few in total. On this
+        # class of sandbox kernel the syscall budget is GLOBAL
+        # (interposer-serialized) and SHRINKS as runnable processes
+        # multiply — more fleet shards reduce the rate the servers
+        # under test can even be offered. Two-to-three hot shards beat
+        # five lukewarm ones (measured: the 8-process fleet lost ~25%
+        # of the aggregate send budget to scheduler churn).
+        procs = min(int(os.environ.get('STRATUM_FLEET_PROCS', 3)), max(1, connections // 4000) + 1)
+        handle = _spawn_fleet(
+            server.port, connections, phase_shares, window, connect_rate,
+            job, procs=procs)
+        try:
+            fleet = await handle.ready()
+            phases = []
+            prev = _hist_state(server)
+            for n in phase_shares:
+                res = await handle.run_phase()
+                if sharded:
+                    # let every worker's histogram push land before the
+                    # phase's closing snapshot
+                    await asyncio.sleep(2 * server.shard.snapshot_interval)
+                cur = _hist_state(server)
+                res["server_hist"] = (prev, cur)
+                prev = cur
+                phases.append(res)
+            totals = await handle.finish()
+        except BaseException:
+            handle.kill()
+            raise
+        fleet.update(totals)
+        fleet["phases"] = phases
+        fleet["client_lat"] = [v for p in phases for v in p["client_lat"]]
+        fleet["elapsed"] = sum(p["elapsed"] for p in phases)
     else:
         fleet = await _drive_fleet(
             server.port, connections, shares_per_conn, window,
@@ -534,6 +876,35 @@ async def run_leg(connections: int, shares_per_conn: int, window: float,
         "client_p99_ms": round(1e3 * percentile(client_lat, 0.99), 3),
         "exact_accounting": exact,
     }
+    if paces:
+        sweep = []
+        for pace, n, p in zip(paces, phase_shares, fleet["phases"]):
+            before, after = p["server_hist"]
+            done = p["accepted"] + p["rejected"]
+            sweep.append({
+                "offered_per_sec": round(connections * n / window, 1),
+                "pace_requested": pace,
+                "shares_per_conn": n,
+                "shares_submitted": done,
+                "shares_per_sec": round(done / p["elapsed"], 1),
+                "submit_window_seconds": round(p["elapsed"], 3),
+                "server_p50_ms": 1e3 * _diff_quantile(before, after, 0.5),
+                "server_p99_ms": 1e3 * _diff_quantile(before, after, 0.99),
+                "client_p50_ms": round(
+                    1e3 * percentile(p["client_lat"], 0.50), 3),
+                "client_p99_ms": round(
+                    1e3 * percentile(p["client_lat"], 0.99), 3),
+            })
+        result["pace_sweep"] = sweep
+        # headline = the best SUSTAINED phase (highest achieved rate),
+        # with its own phase-local percentiles; the whole sweep stays
+        # in the artifact so the knee is committed, not just the peak
+        best = max(sweep, key=lambda s: s["shares_per_sec"])
+        result["shares_per_sec"] = best["shares_per_sec"]
+        result["server_p50_ms"] = best["server_p50_ms"]
+        result["server_p99_ms"] = best["server_p99_ms"]
+        result["client_p50_ms"] = best["client_p50_ms"]
+        result["client_p99_ms"] = best["client_p99_ms"]
     if sharded:
         w = snap_stats.get("workers", {})
         result["worker_deaths"] = w.get("deaths", 0)
@@ -542,6 +913,7 @@ async def run_leg(connections: int, shares_per_conn: int, window: float,
             for wid, pw in w.get("per_worker", {}).items()
         }
         result["bus"] = snap_stats.get("bus", {})
+        result["ledger"] = snap_stats.get("ledger", {})
     await server.stop()
     pool.db.close()
     return result, split, per_worker_db
@@ -549,19 +921,22 @@ async def run_leg(connections: int, shares_per_conn: int, window: float,
 
 async def run_bench(connections: int, shares_per_conn: int, window: float,
                     workers: int, connect_rate: float,
-                    control: bool) -> dict:
+                    control: bool, paces: list[float] | None = None) -> dict:
     result, split, books = await run_leg(
-        connections, shares_per_conn, window, workers, connect_rate)
+        connections, shares_per_conn, window, workers, connect_rate,
+        paces=paces)
     if control and workers > 1:
         # single-process control: the IDENTICAL workload through the
         # proven r06 path — fan-out must not change the books. The
         # control's miners also run from the fleet child so the control
         # server process holds only its own socket ends (the 2x
         # single-process estimate cannot fit a 10k soak under capped
-        # hard limits — the point of the multi-process fd budget)
+        # hard limits — the point of the multi-process fd budget). A
+        # pace sweep runs the SAME phases on the control so the total
+        # share set (and with it the PPLNS split) stays comparable.
         ctrl, ctrl_split, ctrl_books = await run_leg(
             connections, shares_per_conn, window, 1, connect_rate,
-            remote_miners=True)
+            remote_miners=True, paces=paces)
         result["control"] = ctrl
         result["accepted_matches_control"] = (
             result["shares_accepted"] == ctrl["shares_accepted"]
@@ -585,18 +960,38 @@ def main() -> None:
     ap.add_argument("--control", action="store_true",
                     help="also run a single-process control leg and "
                          "assert identical accounting + PPLNS split")
+    ap.add_argument("--pace", default="",
+                    help="comma-separated offered share rates (shares/s) "
+                         "to sweep, each as its own paced submit phase "
+                         "over one connected fleet; per-phase shares/s "
+                         "vs server p99 lands in the artifact's "
+                         "pace_sweep (the knee of the curve, not one "
+                         "operating point)")
     ap.add_argument("--out", default="BENCH_STRATUM_manual.json")
     args = ap.parse_args()
+    paces = [float(p) for p in args.pace.split(",") if p.strip()] or None
 
     # raise BEFORE any worker/fleet process forks (they inherit it).
     # Multi-worker runs (and their control legs) never hold both socket
     # ends in one process, so the per-process budget is 1x connections;
     # only the classic inline mode needs the 2x estimate
     ensure_fd_budget(args.connections, max(1, args.workers))
+    harness = None
+    if args.workers > 1:
+        # the ceiling this harness can carry AT ALL for the soak's
+        # process topology (bare echo, no pool logic) — committed so
+        # the artifact's shares/s reads as a fraction of the possible
+        harness = round(harness_calibration(
+            workers=args.workers, fleet=2), 1)
+        print(f"harness calibration: {harness} bare echo round-trips/s "
+              f"({args.workers} echo servers + 2 client shards)",
+              file=sys.stderr)
     result = asyncio.run(run_bench(
         args.connections, args.shares, args.window, args.workers,
-        args.connect_rate, args.control,
+        args.connect_rate, args.control, paces=paces,
     ))
+    if harness is not None:
+        result["harness_echo_rt_per_sec"] = harness
     result["bench"] = "stratum_v1_share_accept"
     result["timestamp"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     with open(args.out, "w") as f:
