@@ -697,6 +697,55 @@ class ApiServer:
                         snapshot.get("wallet_duplicates_avoided", 0),
                         help_="Re-submitted batches deduplicated by idempotency key")
 
+    def sync_validation_metrics(self, validator) -> None:
+        """Device-batched share-validation health (runtime/validate.py
+        ValidationBackend): the device/host split, the batch-size shape
+        (is batching actually amortizing?), the executor queue depth
+        (host-path backpressure), and the corruption alarms."""
+        reg = self.registry
+        snap = validator.snapshot()
+        for path in ("device", "host"):
+            reg.counter_set(
+                "otedama_validation_shares_total",
+                snap.get(f"validated_{path}", 0),
+                labels={"path": path},
+                help_="Shares validated, by execution path")
+        reg.counter_set("otedama_validation_rejects_total",
+                        snap.get("rejects", 0),
+                        help_="Shares that failed batched validation")
+        reg.counter_set("otedama_validation_device_errors_total",
+                        snap.get("device_errors", 0),
+                        help_="Device validation dispatch failures")
+        reg.counter_set("otedama_validation_overflows_total",
+                        snap.get("overflows", 0),
+                        help_="Failure tables overflowed (batch re-verified on host)")
+        reg.counter_set("otedama_validation_tripwire_checks_total",
+                        snap.get("tripwire_checks", 0),
+                        help_="Host-oracle tripwire samples")
+        reg.counter_set("otedama_validation_tripwire_mismatches_total",
+                        snap.get("tripwire_mismatches", 0),
+                        help_="Device verdicts contradicted by the host oracle")
+        reg.gauge_set("otedama_validation_device_ok",
+                      1 if snap.get("device_ok") else 0,
+                      help_="Device validation path live (0 = quarantined/off)")
+        reg.gauge_set("otedama_validation_executor_queue_depth",
+                      snap.get("executor_queue_depth", 0),
+                      help_="Pending host validations on the shared executor")
+        batches = validator.batch_sizes
+        if batches.count > 0:
+            reg.histogram_set(
+                "otedama_validation_batch_size",
+                batches.cumulative(), batches.sum, batches.count,
+                help_="Shares per validation batch")
+        for path, hist in (("device", validator.device_seconds),
+                           ("host", validator.host_seconds)):
+            if hist.count > 0:
+                reg.histogram_set(
+                    "otedama_validation_seconds",
+                    hist.cumulative(), hist.sum, hist.count,
+                    labels={"path": path},
+                    help_="Validation batch latency, by execution path")
+
     def sync_pool_server_metrics(self, server=None, server_v2=None) -> None:
         """Export the POOL-side share-accept latency SLO histograms
         (submit-received -> verdict-written, per protocol). The client

@@ -62,6 +62,7 @@ class Application:
         self.p2p = None
         self.settlement = None      # crash-safe settlement engine
         self.regions = None         # multi-region replication layer
+        self.validator = None       # device-batched share validation
         self.api: ApiServer | None = None
         self.recovery = None
         self.failure_detector = None
@@ -183,6 +184,23 @@ class Application:
             await self._start_pool_side()
         if cfg.p2p.enabled:
             await self._start_p2p()
+        if cfg.validation.enabled:
+            # ONE backend for every batch producer: the ledger flush and
+            # the gossip handlers share the stats surface AND the
+            # quarantine state (a device that corrupted a ledger batch
+            # must not keep verifying gossip)
+            from otedama_tpu.runtime.validate import ValidationBackend
+
+            self.validator = ValidationBackend(
+                min_batch=cfg.validation.min_batch,
+                tripwire_rate=cfg.validation.tripwire_rate,
+                quarantine_seconds=cfg.validation.quarantine_seconds,
+                x11_chain=cfg.validation.x11_chain,
+            )
+            if self.pool is not None:
+                self.pool.validator = self.validator
+            if self.p2p is not None:
+                self.p2p.validator = self.validator
         if cfg.region.enabled:
             await self._start_regions()
         # the stratum listening sockets open only now: every pool-side
@@ -1034,6 +1052,8 @@ class Application:
                 )
             if self.settlement is not None:
                 self.api.sync_settlement_metrics(self.settlement.snapshot())
+            if self.validator is not None:
+                self.api.sync_validation_metrics(self.validator)
             self.api.sync_compile_metrics(
                 compile_cache.counters(), compile_cache.histograms()
             )

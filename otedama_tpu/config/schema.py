@@ -266,6 +266,31 @@ class P2PConfig:
 
 
 @dataclasses.dataclass
+class ValidationSettings:
+    """Device-batched share validation (runtime/validate.py): the
+    group-commit ledger and the p2p gossip handlers re-verify share
+    batches on the accelerator (one dispatch per batch) with host
+    fallback, a measured batch-size crossover, and a sampled host-oracle
+    corruption tripwire. Disabled = the per-share host validation path
+    (``pow_host``) everywhere, exactly as before."""
+
+    enabled: bool = False
+    # batches under this many shares skip the device (dispatch overhead
+    # loses below a measured knee — tools/bench_validate.py measures it)
+    min_batch: int = 32
+    # fraction of every device batch re-verified through the host
+    # oracle (0 disables the tripwire — not recommended; >0 always
+    # re-checks at least one share per batch)
+    tripwire_rate: float = 0.05
+    # seconds the device path stays quarantined after an error or a
+    # tripwire mismatch (host validation carries the load meanwhile)
+    quarantine_seconds: float = 60.0
+    # x11 tier: "numpy" = lane-parallel host pipeline (no multi-minute
+    # XLA compile; the CPU-fallback default), "jax" = the device chain
+    x11_chain: str = "numpy"
+
+
+@dataclasses.dataclass
 class ApiConfig:
     enabled: bool = True
     host: str = "127.0.0.1"
@@ -289,6 +314,8 @@ class AppConfig:
     settlement: SettlementSettings = dataclasses.field(
         default_factory=SettlementSettings)
     region: RegionSettings = dataclasses.field(default_factory=RegionSettings)
+    validation: ValidationSettings = dataclasses.field(
+        default_factory=ValidationSettings)
     p2p: P2PConfig = dataclasses.field(default_factory=P2PConfig)
     api: ApiConfig = dataclasses.field(default_factory=ApiConfig)
     logging: LoggingConfig = dataclasses.field(default_factory=LoggingConfig)
@@ -301,6 +328,7 @@ _SECTIONS = {
     "pool": PoolSettings,
     "settlement": SettlementSettings,
     "region": RegionSettings,
+    "validation": ValidationSettings,
     "p2p": P2PConfig,
     "api": ApiConfig,
     "logging": LoggingConfig,
@@ -473,6 +501,20 @@ def validate_config(cfg: AppConfig) -> list[str]:
         errors.append("region.region_id must appear in region.regions")
     if len(set(cfg.region.regions)) != len(cfg.region.regions):
         errors.append("region.regions must not repeat region ids")
+    if cfg.validation.enabled:
+        if not (cfg.pool.enabled or cfg.p2p.enabled):
+            errors.append(
+                "validation.enabled requires pool.enabled or p2p.enabled "
+                "(there is no share intake to validate otherwise)"
+            )
+    if cfg.validation.min_batch < 1:
+        errors.append("validation.min_batch must be >= 1")
+    if not (0.0 <= cfg.validation.tripwire_rate <= 1.0):
+        errors.append("validation.tripwire_rate must be in [0, 1]")
+    if cfg.validation.quarantine_seconds < 0:
+        errors.append("validation.quarantine_seconds must be >= 0")
+    if cfg.validation.x11_chain not in ("numpy", "jax"):
+        errors.append("validation.x11_chain must be 'numpy' or 'jax'")
     if cfg.region.token_ttl <= 0:
         errors.append("region.token_ttl must be positive")
     if cfg.region.recommit_interval <= 0:
@@ -545,6 +587,13 @@ region:
   session_secret: ""   # shared HMAC secret for miner handoff tokens
   token_ttl: 3600.0    # resume tokens older than this start fresh
   recommit_interval: 2.0  # fork-race healing sweep cadence, seconds
+
+validation:
+  enabled: false       # device-batched share validation (needs pool or p2p)
+  min_batch: 32        # below this many shares the host path is faster
+  tripwire_rate: 0.05  # host-oracle sample per device batch (corruption trap)
+  quarantine_seconds: 60.0  # device-path timeout after an error/mismatch
+  x11_chain: numpy     # x11 tier: numpy (lane-parallel host) | jax (device)
 
 p2p:
   enabled: false

@@ -376,19 +376,51 @@ def _derive_items_device(cache_d, rows: int, idx):
     return _keccak512_words_device(mix, 64)
 
 
-def _hashimoto_device(full_size: int, page_fn, header_hash: bytes,
-                      nonces: np.ndarray):
-    """Batched hashimoto given ``page_fn(page) -> [B, 32]`` — one CALL
-    per 128-byte mix page (so a resident-DAG tier pays ONE row gather
-    per access, not two 64-byte ones) — with ONE device copy of the
-    access loop, cmix fold, and keccak-256 seal.
-    Returns (mix_digests [B, 32] u8, results [B, 32] u8)."""
+def _swords_multi_device(header_hashes: np.ndarray, nonces: np.ndarray):
+    """Per-LANE header hashes (share validation: every submitted header
+    differs) -> ``[B, 16]`` u32 s-words. ``header_hashes``: ``[B, 32]``
+    uint8."""
+    import jax.numpy as jnp
+
+    B = len(nonces)
+    hh = np.ascontiguousarray(
+        np.asarray(header_hashes, dtype=np.uint8)
+    ).view("<u4").reshape(B, 8)
+    inp = np.zeros((B, 10), dtype=np.uint32)
+    inp[:, :8] = hh
+    nn = np.asarray(nonces, dtype=np.uint64)
+    inp[:, 8] = (nn & 0xFFFFFFFF).astype(np.uint32)
+    inp[:, 9] = (nn >> 32).astype(np.uint32)
+    return _keccak512_words_device(jnp.asarray(inp), 40)
+
+
+def _light_page_fn(cache_d, rows: int):
+    """Light-mode ``page_fn``: each 128-byte mix page derives as two
+    64-byte dataset items via FNV folds over cache gathers — the ONE
+    copy shared by the dense, winner and verify hashimoto flavors (a
+    derivation fix must hit all three or they silently diverge)."""
+    import jax.numpy as jnp
+
+    def page_fn(page):
+        p = page * jnp.uint32(2)
+        return jnp.concatenate(
+            [_derive_items_device(cache_d, rows, p),
+             _derive_items_device(cache_d, rows, p + 1)],
+            axis=1,
+        )
+
+    return page_fn
+
+
+def _hashimoto_device_words(full_size: int, page_fn, s_words):
+    """The device core shared by every batched hashimoto flavor: access
+    loop, cmix fold and keccak-256 seal over prebuilt s-words. Returns
+    (cmix [B, 8] u32, results_words [B, 8] u32) STILL ON DEVICE so
+    winner/verify wrappers can compact before any host transfer."""
     import jax.numpy as jnp
     from jax import lax
 
     n_pages = full_size // MIX_BYTES
-    B = len(nonces)
-    s_words = _swords_device(header_hash, nonces)
     mix = jnp.concatenate([s_words, s_words], axis=1)  # [B, 32]
 
     def access(mix, i):
@@ -406,11 +438,134 @@ def _hashimoto_device(full_size: int, page_fn, header_hash: bytes,
     # serializes through a host loop
     seal_words = jnp.concatenate([s_words, cmix], axis=1)  # [B, 24] u32
     results_words = _keccak256_words_device(seal_words, 96)  # [B, 8]
+    return cmix, results_words
+
+
+def _hashimoto_device(full_size: int, page_fn, header_hash: bytes,
+                      nonces: np.ndarray):
+    """Batched hashimoto given ``page_fn(page) -> [B, 32]`` — one CALL
+    per 128-byte mix page (so a resident-DAG tier pays ONE row gather
+    per access, not two 64-byte ones) — with ONE device copy of the
+    access loop, cmix fold, and keccak-256 seal.
+    Returns (mix_digests [B, 32] u8, results [B, 32] u8)."""
+    B = len(nonces)
+    s_words = _swords_device(header_hash, nonces)
+    cmix, results_words = _hashimoto_device_words(full_size, page_fn,
+                                                  s_words)
     cmix_np = np.asarray(cmix)
     mix_digests = np.ascontiguousarray(cmix_np).view(np.uint8).reshape(B, 32)
     res_np = np.asarray(results_words)
     results = np.ascontiguousarray(res_np).view(np.uint8).reshape(B, 32)
     return mix_digests, results
+
+
+def _result_limbs(results_words):
+    """Framework compare-order limbs of a batched hashimoto result.
+
+    The framework digest is ``result[::-1]`` compared as a little-endian
+    int, whose value equals the BE-int read of the raw result bytes — so
+    the most-significant-first uint32 limbs are simply the byte-swapped
+    LE result words, in word order."""
+    from otedama_tpu.kernels import sha256_jax as sj
+
+    return tuple(sj.bswap32(results_words[:, i]) for i in range(8))
+
+
+def _compact_device(results_words, limbs8, last, k: int, *, invert: bool):
+    """Shared compaction tail: exact per-lane 256-bit compare of the
+    batched results against target limbs (scalar limbs broadcast for the
+    search path; per-lane rows for validation), then the rare lanes —
+    winners (``invert=False``) or failures (``invert=True``) — compact
+    into one ``uint32[2k+3]`` buffer with LANE OFFSETS in the nonce
+    slots (``sha256_pallas.unpack_winner_buffer`` layout)."""
+    import jax
+    import jax.numpy as jnp
+
+    from otedama_tpu.kernels import sha256_jax as sj
+
+    h = _result_limbs(results_words)
+    limbs8 = jnp.asarray(limbs8, dtype=jnp.uint32)
+    if limbs8.ndim == 2:
+        t = tuple(limbs8[:, i] for i in range(8))
+    else:
+        t = tuple(limbs8[i] for i in range(8))
+    le = sj.le256(h, t)
+    n = h[0].shape[0]
+    offs = jax.lax.iota(jnp.uint32, n)
+    rng = offs <= last
+    flagged = ((~le) if invert else le) & rng
+    h0m = jnp.where(rng, h[0], jnp.uint32(0xFFFFFFFF))
+    return sj.compact_winners(flagged, h0m, offs, k)
+
+
+def hashimoto_winners_device(
+    full_size: int,
+    cache_or_pages,
+    header_hash: bytes,
+    nonces: np.ndarray,
+    limbs8,
+    count: int,
+    k: int,
+    *,
+    full: bool = False,
+) -> np.ndarray:
+    """Batched hashimoto SEARCH step with on-device winner compaction:
+    the chunk's single host transfer is the ``uint32[2k+3]`` winner
+    buffer (lane offsets + top limbs + true count + min-top-limb
+    telemetry) instead of the dense ``[B, 32]`` result tensor — the
+    ethash realization of the K-slot winner-buffer contract. ``full``
+    selects the resident-DAG page gather over light-mode derivation."""
+    import jax.numpy as jnp
+
+    with jaxcompat.enable_x64():
+        s_words = _swords_device(header_hash, nonces)
+        if full:
+            pages_d = (cache_or_pages
+                       if cache_or_pages.shape[-1] == 32
+                       else jnp.reshape(cache_or_pages, (-1, 32)))
+
+            def page_fn(page):
+                return jnp.take(pages_d, page, axis=0)
+        else:
+            cache_d = jnp.asarray(cache_or_pages)
+            page_fn = _light_page_fn(cache_d, cache_d.shape[0])
+
+        _, results_words = _hashimoto_device_words(full_size, page_fn,
+                                                   s_words)
+        buf = _compact_device(
+            results_words, limbs8, jnp.uint32(max(count - 1, 0)), k,
+            invert=False,
+        )
+    return np.asarray(buf)
+
+
+def hashimoto_verify_device(
+    full_size: int,
+    cache,
+    header_hashes: np.ndarray,
+    nonces: np.ndarray,
+    limbs,
+    count: int,
+    k: int,
+) -> np.ndarray:
+    """Device-batched ethash share VALIDATION: N submitted shares (each
+    with its OWN 76-byte-prefix header hash, nonce and share target) run
+    one batched light hashimoto, failures compact into the
+    ``uint32[2k+3]`` buffer (``sha256_jax.compact_failures`` semantics).
+    The epoch ``cache`` must match the shares' epoch — callers group by
+    epoch (``utils.pow_host`` holds the registry)."""
+    import jax.numpy as jnp
+
+    with jaxcompat.enable_x64():
+        cache_d = jnp.asarray(cache)
+        s_words = _swords_multi_device(header_hashes, nonces)
+        _, results_words = _hashimoto_device_words(
+            full_size, _light_page_fn(cache_d, cache_d.shape[0]), s_words)
+        buf = _compact_device(
+            results_words, limbs, jnp.uint32(max(count - 1, 0)), k,
+            invert=True,
+        )
+    return np.asarray(buf)
 
 
 def hashimoto_light_device(
@@ -437,16 +592,9 @@ def hashimoto_light_device(
         # array (EthashLightBackend keeps the epoch cache HBM-resident);
         # a numpy cache uploads here
         cache_d = jnp.asarray(cache)
-
-        def page_fn(page):
-            p = page * jnp.uint32(2)
-            return jnp.concatenate(
-                [_derive_items_device(cache_d, rows, p),
-                 _derive_items_device(cache_d, rows, p + 1)],
-                axis=1,
-            )
-
-        return _hashimoto_device(full_size, page_fn, header_hash, nonces)
+        return _hashimoto_device(
+            full_size, _light_page_fn(cache_d, rows), header_hash, nonces
+        )
 
 
 def hashimoto_full(

@@ -121,7 +121,13 @@ def scrypt_1024_1_1(header_words, nonces, *, rolled: bool = True,
     """
     comp = sj.compress_rolled if rolled else sj.compress
     zero = jnp.zeros_like(nonces)
-    hw = [zero + _U32(w) for w in header_words] + [nonces]  # 20 words
+    # header words may be python ints (the search path: one job, many
+    # nonces) OR per-lane arrays (the validation path: every submitted
+    # header differs in every word) — broadcast either against the lanes
+    hw = [
+        zero + (w if isinstance(w, jax.Array) else _U32(w))
+        for w in header_words
+    ] + [nonces]  # 20 words
 
     # key0 = SHA256(header80): block1 = words 0..15, block2 = tail + padding
     iv = tuple(zero + _U32(v) for v in SHA256_IV)
@@ -248,6 +254,26 @@ def scrypt_search_winners(header19, base, limbs8, last, *, n: int, k: int,
     hits = sj.le256(h, tuple(limbs8[i] for i in range(8))) & rng
     h0m = jnp.where(rng, h[0], _U32(0xFFFFFFFF))
     return sj.compact_winners(hits, h0m, nonces, k)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "k", "rolled", "blockmix"))
+def scrypt_verify_step(words20, limbs, last, *, n: int, k: int,
+                       rolled: bool = True, blockmix: str = "xla"):
+    """Device-batched scrypt share VALIDATION (the scrypt twin of
+    ``sha256_jax.sha256d_verify_step``): N distinct submitted headers run
+    the full PBKDF2 -> ROMix -> PBKDF2 pipeline in one dispatch, each
+    lane compared exactly against its OWN share target, and the rare
+    failures compact into one ``uint32[2k+3]`` buffer
+    (``sha256_jax.compact_failures`` — lane offsets in the nonce slots).
+
+    ``words20``: uint32 ``[B, 20]`` big-endian header words per share;
+    ``limbs``: uint32 ``[B, 8]`` per-share target limbs."""
+    cols = tuple(words20[:, i] for i in range(19))
+    d = scrypt_1024_1_1(cols, words20[:, 19], rolled=rolled,
+                        blockmix=blockmix)
+    h = sj.digest_words_to_compare_order(d)
+    passes = sj.le256(h, tuple(limbs[:, i] for i in range(8)))
+    return sj.compact_failures(passes, h[0], last, k)
 
 
 def scrypt_digest_host(header80: bytes) -> bytes:

@@ -25,6 +25,8 @@ Wire conventions (bitcoin family):
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -232,6 +234,79 @@ def compact_winners(hits, h0_masked, nonces, k: int):
         jnp.min(h0_masked),
     ])
     return jnp.concatenate([win_nonce, win_limb, stats])
+
+
+def sha256d_words80(cols20, *, rolled: bool = False):
+    """sha256d of N DISTINCT 80-byte headers across the lane axis.
+
+    The search kernels hash one job's midstate against a nonce range;
+    share VALIDATION hashes N submitted headers that differ in every
+    field (extranonce -> merkle root, ntime, nonce), so there is no
+    midstate to share — both 64-byte blocks run per lane. ``cols20``:
+    20 uint32 arrays (big-endian header words, one array per word
+    position, each shaped ``[B]``). Returns the 8 big-endian digest
+    words of ``sha256d(header)`` per lane.
+    """
+    comp = compress_rolled if rolled else compress
+    zero = jnp.zeros_like(cols20[0])
+    pad1 = zero + _U32(0x80000000)
+    iv = tuple(zero + _U32(v) for v in _IV_NP)
+    st = comp(iv, list(cols20[:16]))
+    w2 = list(cols20[16:20]) + [pad1] + [zero] * 10 + [zero + _U32(640)]
+    d = comp(st, w2)
+    w3 = list(d) + [pad1] + [zero] * 6 + [zero + _U32(256)]
+    return comp(iv, w3)
+
+
+def compact_failures(passes, h0, last, k: int):
+    """Validation twin of ``compact_winners``: the interesting lanes of
+    a verify batch are the FAILURES (miner-submitted shares were mined
+    to target, so failures are Byzantine/corrupt — rare), and compacting
+    them gives the same fixed ``uint32[2k+3]`` transfer the search path
+    has. Buffer layout is ``unpack_winner_buffer``'s with LANE OFFSETS
+    in the nonce slots: ``[fail_off[k] | fail_limb[k] | n_fails, 0,
+    min_h0]``. ``n_fails > k`` is the overflow signal (a heavily
+    Byzantine batch) and callers re-verify on the host. ``last`` is the
+    last in-range lane offset (padding lanes past it never count)."""
+    n = passes.size
+    offs = jax.lax.iota(jnp.uint32, n)
+    rng = offs <= last
+    fails = (~passes) & rng
+    h0m = jnp.where(rng, h0, _U32(0xFFFFFFFF))
+    return compact_winners(fails, h0m, offs, k)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "k", "rolled"))
+def sha256d_verify_step(words20, limbs, last, *, n: int, k: int,
+                        rolled: bool = True):
+    """Device-batched sha256d share validation: N headers hashed in one
+    dispatch, each compared EXACTLY (256-bit lexicographic) against its
+    OWN share target, failures compacted into one ``uint32[2k+3]``
+    buffer (``compact_failures``) — the launch's single host transfer.
+
+    ``words20``: uint32 ``[B, 20]`` big-endian header words per share;
+    ``limbs``: uint32 ``[B, 8]`` per-share target limbs
+    (most-significant-first); ``last``: last in-range lane (rows past it
+    are shape padding).
+    """
+    cols = tuple(words20[:, i] for i in range(20))
+    d = sha256d_words80(cols, rolled=rolled)
+    h = digest_words_to_compare_order(d)
+    # le256 takes per-lane limb arrays just as happily as scalars: the
+    # compare broadcasts element-wise down the limb chain
+    passes = le256(h, tuple(limbs[:, i] for i in range(8)))
+    return compact_failures(passes, h[0], last, k)
+
+
+def headers_to_words(headers: list[bytes] | np.ndarray) -> np.ndarray:
+    """Pack N 80-byte headers into the ``[N, 20]`` uint32 big-endian
+    word array the verify steps consume."""
+    arr = np.frombuffer(
+        b"".join(headers) if isinstance(headers, list) else
+        np.ascontiguousarray(headers).tobytes(),
+        dtype=">u4",
+    ).astype(np.uint32)
+    return arr.reshape(-1, 20)
 
 
 def sha256d_search(midstate, tail, nonces, target_limbs):

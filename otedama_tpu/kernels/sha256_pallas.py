@@ -436,6 +436,161 @@ def _on_tpu() -> bool:
     return safe_default_backend() == "tpu"  # hang-safe platform query
 
 
+# ---------------------------------------------------------------------------
+# Device-batched share VALIDATION — the search machinery run in reverse.
+#
+# The search kernel hashes one job across a nonce range and compacts the
+# rare WINNERS into a K-slot table. Validation hashes N miner-submitted
+# headers (each a distinct 80-byte header with its own share target) and
+# compacts the rare FAILURES — honest shares were mined to target, so a
+# failing lane is Byzantine input or corruption — into the same
+# ``uint32[2k+3]`` buffer (`unpack_winner_buffer` layout, lane OFFSETS in
+# the nonce slots). One fixed-size transfer per batch either way.
+# ---------------------------------------------------------------------------
+
+def _verify_kernel(scal_ref, hdr_ref, tgt_ref, out_ref, *, sub: int, k: int):
+    tile = sub * 128
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _init():
+        for i in range(k):
+            out_ref[i] = _U32(0)
+            out_ref[k + i] = _U32(NO_WINNER)
+        out_ref[2 * k] = _U32(0)              # n_fails (true count)
+        out_ref[2 * k + 1] = _U32(0)          # reserved
+        out_ref[2 * k + 2] = _U32(NO_WINNER)  # min top limb, in-range
+
+    last_f = _flip(scal_ref[0])
+    not_empty = scal_ref[1] == _U32(0)
+
+    # this tile's 20 header words / 8 target limbs, each (sub, 128):
+    # per-LANE values — validation has no scalar job constants to fold,
+    # every field differs per submitted share
+    w = [hdr_ref[0, j] for j in range(20)]
+    d1 = compress_pe(tuple(int(v) for v in SHA256_IV), w[:16])
+    w2 = list(w[16:20]) + [0x80000000] + [0] * 10 + [640]
+    d2 = compress_pe(d1, w2)
+    w3 = list(d2) + [0x80000000] + [0] * 6 + [256]
+    d = compress_pe(tuple(int(v) for v in SHA256_IV), w3)
+
+    h_f = tuple(_flip(_bswap32(d[7 - j])) for j in range(8))
+    t_f = tuple(_flip(tgt_ref[0, j]) for j in range(8))
+    le = h_f[7] <= t_f[7]
+    for j in range(6, -1, -1):
+        le = (h_f[j] < t_f[j]) | ((h_f[j] == t_f[j]) & le)
+
+    lanes = (
+        jax.lax.broadcasted_iota(_U32, (sub, 128), 0) * _U32(128)
+        + jax.lax.broadcasted_iota(_U32, (sub, 128), 1)
+    )
+    offs = step.astype(_U32) * _U32(tile) + lanes
+    rng = (_flip(offs) <= last_f) & not_empty
+    fails = (~le) & rng
+    h0 = _bswap32(d[7])
+    h0m = jnp.where(rng, h0, _U32(NO_WINNER))
+
+    out_ref[2 * k + 2] = _umin_s(out_ref[2 * k + 2], _umin(h0m))
+    n_fail = jnp.sum(fails.astype(jnp.int32)).astype(_U32)
+    idx0 = out_ref[2 * k]
+    out_ref[2 * k] = idx0 + n_fail
+
+    @pl.when(n_fail > _U32(0))
+    def _compact():
+        # same iterated masked min-reduce as the search kernel's winner
+        # table: deterministic lane order, no scatter, no atomics
+        def extract(s, cand):
+            m = _umin(cand)
+
+            @pl.when(m != _U32(NO_WINNER))
+            def _record():
+                slot = jnp.minimum(
+                    idx0 + s.astype(_U32), _U32(k - 1)
+                ).astype(jnp.int32)
+                out_ref[slot] = step.astype(_U32) * _U32(tile) + m
+                out_ref[k + slot] = _umin(
+                    jnp.where(lanes == m, h0, _U32(NO_WINNER))
+                )
+
+            return jnp.where(cand == m, _U32(NO_WINNER), cand)
+
+        jax.lax.fori_loop(
+            0, k, extract, jnp.where(fails, lanes, _U32(NO_WINNER))
+        )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_tiles", "sub", "k", "interpret")
+)
+def _verify_call(scalars, headers, targets, *, num_tiles: int, sub: int,
+                 k: int, interpret: bool):
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(num_tiles,),
+        in_specs=[
+            # index_map's trailing arg is the scalar-prefetch ref
+            pl.BlockSpec((1, 20, sub, 128), lambda i, s: (i, 0, 0, 0)),
+            pl.BlockSpec((1, 8, sub, 128), lambda i, s: (i, 0, 0, 0)),
+        ],
+        out_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)],
+        scratch_shapes=[],
+    )
+    kernel = functools.partial(_verify_kernel, sub=sub, k=k)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((winner_buffer_words(k),), jnp.uint32),
+        ],
+        interpret=interpret,
+    )(scalars, headers, targets)[0]
+
+
+def sha256d_verify_pallas(
+    words20: np.ndarray,
+    limbs: np.ndarray,
+    count: int,
+    *,
+    sub: int = 8,
+    k: int | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Validate ``count`` submitted headers in ONE launch.
+
+    ``words20``: uint32 ``[B, 20]`` big-endian header words (B padded to
+    a tile multiple by the caller or here); ``limbs``: uint32 ``[B, 8]``
+    per-share target limbs. Returns the ``uint32[2k+3]`` FAILURE buffer
+    (``unpack_winner_buffer``: lane offsets of failing shares, their top
+    limbs, the true failure count — ``> k`` means overflow, re-verify on
+    the host — and the batch's min top limb as best-share telemetry).
+    """
+    if k is None:
+        k = K_WINNERS
+    tile = sub * 128
+    b = words20.shape[0]
+    padded = (max(b, 1) + tile - 1) // tile * tile
+    if padded != b:
+        words20 = np.pad(words20, ((0, padded - b), (0, 0)))
+        limbs = np.pad(limbs, ((0, padded - b), (0, 0)))
+    num_tiles = padded // tile
+    # lane (t, r, c) reads its word j at [t, j, r, c]
+    hdr = np.ascontiguousarray(
+        words20.reshape(num_tiles, sub, 128, 20).transpose(0, 3, 1, 2)
+    )
+    tgt = np.ascontiguousarray(
+        limbs.reshape(num_tiles, sub, 128, 8).transpose(0, 3, 1, 2)
+    )
+    scalars = np.array(
+        [max(count - 1, 0) & _M32, 0 if count > 0 else 1], dtype=np.uint32
+    )
+    if interpret is None:
+        interpret = not _on_tpu()
+    return _verify_call(
+        scalars, jnp.asarray(hdr), jnp.asarray(tgt),
+        num_tiles=num_tiles, sub=sub, k=k, interpret=interpret,
+    )
+
+
 def sha256d_pallas_search(
     job_words,
     *,

@@ -163,6 +163,27 @@ def x11_digest_batch(headers: "np.ndarray") -> "np.ndarray":
     return b[:, :32]
 
 
+def x11_verify_batch(headers: "np.ndarray", targets: list[int]):
+    """Lane-parallel x11 share validation: one pipeline pass over N
+    submitted 80-byte headers, each digest compared EXACTLY against its
+    own share target. The x11 tier of the device-batched validation
+    path (runtime/validate.py): the 11 stages are lane-axis numpy (the
+    vectorized tier), with the jnp chain injectable where a TPU is
+    paying the compile anyway. Returns ``(verdicts bool[N], min_h0)``
+    where ``min_h0`` is the minimum top compare limb (best-share
+    telemetry, same unit as the search kernels')."""
+    h = np.atleast_2d(headers)
+    digests = x11_digest_batch(h)
+    n = h.shape[0]
+    verdicts = np.zeros((n,), dtype=bool)
+    best = 0xFFFFFFFF
+    for i in range(n):
+        v = int.from_bytes(digests[i].tobytes(), "little")
+        verdicts[i] = v <= targets[i]
+        best = min(best, v >> 224)
+    return verdicts, best
+
+
 def missing_stages() -> list[str]:
     return [s for s in ORDER if s not in STAGES_BYTES]
 

@@ -834,6 +834,63 @@ def x11_digest_chain(headers, sbox_mode: str | None = None,
     return h[:, :32]
 
 
+def digest_limbs(d):
+    """``[B, 32]`` uint8 digests -> 8 most-significant-first uint32 limb
+    arrays of the little-endian 256-bit digest value (the order
+    ``sha256_jax.le256`` compares in): limb 0 packs bytes 28..31 LE."""
+    limbs = []
+    for j in range(8):
+        b = 28 - 4 * j
+        limbs.append(
+            d[:, b].astype(U32)
+            | (d[:, b + 1].astype(U32) << U32(8))
+            | (d[:, b + 2].astype(U32) << U32(16))
+            | (d[:, b + 3].astype(U32) << U32(24))
+        )
+    return tuple(limbs)
+
+
+def x11_winner_step(headers, limbs8, last, *, k: int,
+                    sbox_mode: str | None = None,
+                    cnt_variant: str | None = None):
+    """x11 SEARCH step with on-device winner compaction: the full
+    11-stage chain over a header batch, an EXACT per-lane 256-bit
+    compare (no top-limb-only prefilter — winners need no host
+    re-filter), and the rare winning lanes compacted into ONE
+    ``uint32[2k+3]`` buffer with lane offsets in the nonce slots
+    (``sha256_pallas.unpack_winner_buffer`` layout) — the x11
+    realization of the K-slot winner-buffer contract. ``limbs8``:
+    uint32[8] target limbs, most-significant-first."""
+    import jax.numpy as jnp
+
+    from otedama_tpu.kernels import sha256_jax as sj
+
+    d = x11_digest_chain(headers, sbox_mode, cnt_variant)
+    h = digest_limbs(d)
+    hits = sj.le256(h, tuple(limbs8[i] for i in range(8)))
+    n = headers.shape[0]
+    offs = jax.lax.iota(U32, n)
+    rng = offs <= last
+    h0m = jnp.where(rng, h[0], U32(0xFFFFFFFF))
+    return sj.compact_winners(hits & rng, h0m, offs, k)
+
+
+def x11_verify_step(headers, limbs, last, *, k: int,
+                    sbox_mode: str | None = None,
+                    cnt_variant: str | None = None):
+    """x11 share VALIDATION step (the x11 twin of
+    ``sha256_jax.sha256d_verify_step``): N submitted headers through the
+    device chain, each compared against its OWN target row
+    (``limbs``: uint32 ``[B, 8]``), failures compacted into the
+    ``uint32[2k+3]`` buffer (``sha256_jax.compact_failures``)."""
+    from otedama_tpu.kernels import sha256_jax as sj
+
+    d = x11_digest_chain(headers, sbox_mode, cnt_variant)
+    h = digest_limbs(d)
+    passes = sj.le256(h, tuple(limbs[:, i] for i in range(8)))
+    return sj.compact_failures(passes, h[0], last, k)
+
+
 # one shared jit wrapper: jax caches the compiled executable per input
 # shape internally, and a single wrapper means a new batch size never
 # evicts another's multi-minute XLA compile. sbox_mode is static: each
@@ -841,6 +898,10 @@ def x11_digest_chain(headers, sbox_mode: str | None = None,
 # measurement never reuses a stale trace.
 _jitted_chain = jax.jit(x11_digest_chain,
                         static_argnames=("sbox_mode", "cnt_variant"))
+_jitted_winner_step = jax.jit(
+    x11_winner_step, static_argnames=("k", "sbox_mode", "cnt_variant"))
+_jitted_verify_step = jax.jit(
+    x11_verify_step, static_argnames=("k", "sbox_mode", "cnt_variant"))
 
 
 def compiled_chain(batch: int = 0):

@@ -72,6 +72,12 @@ class P2PPool:
         # until healed — the local chain diverges exactly like a region
         # cut off at the network
         self.severed = False
+        # device-batched PoW verification (runtime/validate.py): when
+        # set, batch handlers (SHARE_BATCH gossip, sync pages, local
+        # batch submits) run the structural checks per share on the host
+        # and the N digest+compare checks as ONE device dispatch instead
+        # of N executor hashes; None = the per-share executor fan-out
+        self.validator = None
         self._verifying: set[bytes] = set()  # share ids in-flight on executor
         self._last_orphan_sync: dict[str, float] = {}
         self._last_prune = 0                 # shares_connected at last prune
@@ -158,7 +164,9 @@ class P2PPool:
         if len(shares) > MAX_SHARE_BATCH:
             raise ValueError(
                 f"share batch of {len(shares)} exceeds {MAX_SHARE_BATCH}")
-        await asyncio.gather(*(self._verify_off_loop(s) for s in shares))
+        for verdict in await self._verify_many(shares):
+            if isinstance(verdict, BaseException):
+                raise verdict
         statuses = [self.chain.connect(s) for s in shares]
         fresh = [s for s, st in zip(shares, statuses) if st != "duplicate"]
         self.stats["shares_accepted"] += len(fresh)
@@ -190,6 +198,60 @@ class P2PPool:
             pow_host.validation_executor(),
             sharechain.verify_share, share, self.chain.params,
         )
+
+    async def _verify_many(
+        self, shares: list[Share]
+    ) -> list[BaseException | None]:
+        """Batched verification: one entry per share — ``None``
+        (verified), ``ShareInvalid``, or an internal error. With a
+        ``validator`` the structural checks run per share on the loop
+        (cheap: one commitment hash) and the N PoW digest+compare
+        checks become ONE device dispatch (runtime/validate.py, which
+        owns crossover/fallback/tripwire); without one this is exactly
+        the old concurrent executor fan-out."""
+        if self.validator is None or len(shares) < 2:
+            return list(await asyncio.gather(
+                *(self._verify_off_loop(s) for s in shares),
+                return_exceptions=True,
+            ))
+        from otedama_tpu.runtime.validate import ShareCheck
+
+        verdicts: list[BaseException | None] = [None] * len(shares)
+        checks: list[ShareCheck] = []
+        idxs: list[int] = []
+        for i, s in enumerate(shares):
+            try:
+                target = sharechain.verify_share_claim(s, self.chain.params)
+            except BaseException as e:
+                verdicts[i] = e
+                continue
+            checks.append(ShareCheck(
+                header=s.header, target=target, algorithm=s.algorithm,
+                block_number=s.block_number,
+            ))
+            idxs.append(i)
+        if not checks:
+            return verdicts
+        try:
+            oks = await self.validator.verify_batch(checks)
+        except Exception:
+            # the validation layer itself failed: degrade to the exact
+            # per-share path — a verdict must never depend on the
+            # batching machinery being healthy
+            log.exception("batched share verification failed; "
+                          "falling back to per-share")
+            results = await asyncio.gather(
+                *(self._verify_off_loop(shares[i]) for i in idxs),
+                return_exceptions=True,
+            )
+            for i, r in zip(idxs, results):
+                verdicts[i] = r if isinstance(r, BaseException) else None
+            return verdicts
+        for i, ok in zip(idxs, oks):
+            if not ok:
+                verdicts[i] = ShareInvalid(
+                    "pow", "digest does not meet claimed target")
+        return verdicts
 
     async def _on_share(self, node: P2PNode, peer: Peer, msg: P2PMessage) -> None:
         try:
@@ -292,10 +354,7 @@ class P2PPool:
         for s in fresh:
             self._verifying.add(s.share_id)
         try:
-            verdicts = await asyncio.gather(
-                *(self._verify_off_loop(s) for s in fresh),
-                return_exceptions=True,
-            )
+            verdicts = await self._verify_many(fresh)
         finally:
             for s in fresh:
                 self._verifying.discard(s.share_id)
@@ -441,10 +500,7 @@ class P2PPool:
                 continue
             if share.share_id not in self.chain:
                 fresh.append(share)
-        verdicts = await asyncio.gather(
-            *(self._verify_off_loop(s) for s in fresh),
-            return_exceptions=True,
-        )
+        verdicts = await self._verify_many(fresh)
         progressed = 0
         for share, verdict in zip(fresh, verdicts):
             if isinstance(verdict, ShareInvalid):

@@ -205,6 +205,23 @@ def verify_share(share: Share, params: ChainParams,
     power (sync after a partition legitimately delivers old shares); local
     consumers reading timestamps must clamp into ``[0, now + skew]``.
     """
+    target = verify_share_claim(share, params, now)
+    digest = pow_host.pow_digest(
+        share.header, share.algorithm, block_number=share.block_number
+    )
+    if int.from_bytes(digest, "little") > target:
+        raise ShareInvalid("pow", "digest does not meet claimed target")
+
+
+def verify_share_claim(share: Share, params: ChainParams,
+                       now: float | None = None) -> int:
+    """The structural half of ``verify_share`` — commitment binding,
+    difficulty floor, clock-skew clamp — WITHOUT the PoW digest (the
+    expensive half). Returns the share's claimed target so batched
+    verification (runtime/validate.py: one device dispatch hashes a
+    whole gossip batch) can run the digest+compare elsewhere. Raises
+    ``ShareInvalid`` exactly like ``verify_share`` for every
+    non-digest defect."""
     if share.algorithm != params.algorithm:
         raise ShareInvalid(
             "algorithm",
@@ -224,11 +241,7 @@ def verify_share(share: Share, params: ChainParams,
     now = time.time() if now is None else now
     if share.ts_ms / 1000.0 > now + params.max_time_skew:
         raise ShareInvalid("time-future", "share dated beyond allowed skew")
-    digest = pow_host.pow_digest(
-        share.header, share.algorithm, block_number=share.block_number
-    )
-    if int.from_bytes(digest, "little") > target:
-        raise ShareInvalid("pow", "digest does not meet claimed target")
+    return target
 
 
 def clamp_timestamp(ts_ms: int, now: float, skew: float) -> float:

@@ -24,6 +24,7 @@ from otedama_tpu.db import (
     WorkerRepository,
 )
 from otedama_tpu.engine.types import Job
+from otedama_tpu.kernels import target as tgt
 from otedama_tpu.pool.blockchain import BlockchainClient, BlockTemplate
 from otedama_tpu.pool.payouts import (
     PayoutCalculator,
@@ -117,6 +118,12 @@ class PoolManager:
         # the local db write — the chain is the authoritative
         # cross-region accounting, the db this region's operational copy
         self.replicator = None
+        # device-batched re-validation (runtime/validate.py): when set,
+        # every ledger batch is re-verified on the accelerator BEFORE
+        # anything is chain-committed or booked — the authoritative
+        # check at the single ledger owner, with host fallback and a
+        # sampled host-oracle tripwire inside the backend itself
+        self.validator = None
         # workers whose row this process has already ensured exists:
         # the per-share upsert only matters for a worker's FIRST share
         # (record_share refreshes last_seen on every share anyway), and
@@ -221,14 +228,47 @@ class PoolManager:
         """
         outcomes: list[tuple[str, str]] = [("ok", "")] * len(batch)
         live = list(range(len(batch)))
-        if self.replicator is not None:
-            chain_outcomes = await self.replicator.commit_batch(batch)
+        if self.validator is not None:
+            # device re-validation FIRST: a share that fails the exact
+            # PoW check must never reach the chain or the books — it is
+            # Byzantine input (a compromised worker process, bus
+            # corruption) that per-share host validation would also
+            # have refused. Only the offender rejects; batchmates
+            # proceed exactly as in every other per-share-verdict path.
+            from otedama_tpu.runtime.validate import ShareCheck
+
+            verdicts = await self.validator.verify_batch([
+                ShareCheck(
+                    header=s.header,
+                    target=tgt.difficulty_to_target(s.difficulty),
+                    algorithm=s.algorithm,
+                    block_number=s.block_number,
+                )
+                for s in batch
+            ])
             live = []
-            for i, exc in enumerate(chain_outcomes):
-                if exc is None:
+            for i, ok in enumerate(verdicts):
+                if ok:
                     live.append(i)
                 else:
-                    outcomes[i] = ("err", str(exc) or type(exc).__name__)
+                    outcomes[i] = ("err", "share failed validation")
+            if not live:
+                return outcomes
+            if len(live) < len(batch):
+                batch_live = [batch[i] for i in live]
+            else:
+                batch_live = batch
+        else:
+            batch_live = batch
+        if self.replicator is not None:
+            chain_outcomes = await self.replicator.commit_batch(batch_live)
+            chain_live = []
+            for pos, exc in zip(live, chain_outcomes):
+                if exc is None:
+                    chain_live.append(pos)
+                else:
+                    outcomes[pos] = ("err", str(exc) or type(exc).__name__)
+            live = chain_live
         if not live:
             return outcomes
         # ledger.flush: THE crash window of the group-commit pipeline —
@@ -427,9 +467,12 @@ class PoolManager:
     # -- reporting ----------------------------------------------------------
 
     def snapshot(self) -> dict:
-        return {
+        snap = {
             "workers": len(self.workers.list()),
             "shares": self.shares.count(),
             "blocks": len(self.blocks.list()),
             "scheme": self.config.payout.scheme.value,
         }
+        if self.validator is not None:
+            snap["validation"] = self.validator.snapshot()
+        return snap

@@ -80,6 +80,21 @@ def submission_id(header: bytes) -> bytes:
     return pow_host.sha256d(header)
 
 
+def _accepted_subid(accepted: AcceptedShare) -> bytes | None:
+    """The submission id a share's validation already paid for: a
+    sha256d share's PoW digest IS ``sha256d(header)``, so re-hashing the
+    same 80 bytes here (once per share, on the commit hot path) was pure
+    waste — the server threads the digest through ``AcceptedShare`` and
+    this picks it up. Non-sha256d algorithms (scrypt digest != sha256d)
+    and anything malformed return None (hash fresh)."""
+    algorithm = getattr(accepted, "algorithm", "")
+    digest = getattr(accepted, "digest", b"")
+    if (algorithm in ("sha256d", "sha256double", "bitcoin")
+            and len(digest) == 32 and len(accepted.header) == 80):
+        return digest
+    return None
+
+
 def encode_chain_claim(job_id: str, subid: bytes) -> str:
     """Pack the submission id into the chain share's committed job-id
     field (``job@subid24``) so the chain itself carries the cross-region
@@ -227,7 +242,7 @@ class RegionReplicator:
         chain is the authoritative accounting — a share we cannot commit
         must not be told "accepted"); a local db failure AFTER this
         call costs one region's operational copy, never miner credit."""
-        subid = submission_id(accepted.header)
+        subid = _accepted_subid(accepted) or submission_id(accepted.header)
         tag = subid.hex()[:SUBID_HEX]
         claim = encode_chain_claim(accepted.job_id, subid)
         dropped = False
@@ -286,14 +301,25 @@ class RegionReplicator:
                 outcomes[i] = ValueError(
                     f"stratum header must be 80 bytes, "
                     f"got {len(accepted.header)}")
-        subids = sha256d_batch(
-            [s.header for i, s in enumerate(batch) if outcomes[i] is None])
+        # memoization seam (the _judge digest threads through): sha256d
+        # shares already paid sha256d(header) at validation — only the
+        # shares whose digest is NOT the submission id (other algorithm
+        # families) go through the batch hash pass
+        prehashed = {
+            i: sid for i, s in enumerate(batch)
+            if outcomes[i] is None and (sid := _accepted_subid(s))
+        }
+        subids = sha256d_batch([
+            s.header for i, s in enumerate(batch)
+            if outcomes[i] is None and i not in prehashed
+        ])
         subids_iter = iter(subids)
         plan: list[tuple[int, str, bool]] = []  # (idx, claim, dropped)
         for i, accepted in enumerate(batch):
             if outcomes[i] is not None:
                 continue
-            claim = encode_chain_claim(accepted.job_id, next(subids_iter))
+            subid = prehashed.get(i) or next(subids_iter)
+            claim = encode_chain_claim(accepted.job_id, subid)
             try:
                 d = faults.hit("region.sever", str(self.config.region_id),
                                _SEVER_FAULTS)

@@ -681,15 +681,18 @@ class X11PodSearch:
 
     Third instantiation of the pod shape (PodSearch: sha256d,
     ScryptPodSearch: scrypt): host rows are extranonce2 spaces, the chip
-    axis strides each row's nonce range, pmin telemetry rides ICI. The
-    per-chip local is the full 11-stage device chain
+    axis strides each row's nonce range, psum/pmin telemetry rides ICI.
+    The per-chip local is the full 11-stage device chain
     (kernels/x11/jnp_chain — one XLA program), with the 80-byte headers
     assembled ON DEVICE (fixed 76-byte prefix broadcast + big-endian
     nonce bytes), since host-side header building cannot reach inside a
-    shard_map. The device applies the no-false-negative top-limb
-    prefilter; flagged lanes are exact-verified on the host through the
-    independent numpy oracle chain (cross-implementation check, same as
-    X11JaxBackend).
+    shard_map. Winner recovery matches the other pods: every chip
+    decides winners EXACTLY on device (full 256-bit compare,
+    lane-granular range clamp) and emits the compact ``uint32[2k+3]``
+    winner buffer, so host extraction — and the fused-mode all-gather —
+    is O(k) per chip with no dense digest/hit transfer. Each winner's
+    digest is re-derived through the INDEPENDENT numpy oracle chain
+    (the corruption tripwire, as in X11JaxBackend).
 
     NB compile cost: the chain costs minutes per (mesh, per_chip) shape —
     production picks one chunk and keeps it (the persistent compilation
@@ -699,6 +702,7 @@ class X11PodSearch:
     mesh: Mesh
     chain_fn: callable = None  # tests inject a cheap stand-in
     chunk: int = 1 << 12       # per-chip lanes per step — ONE compiled shape
+    winner_depth: int = K      # K-slot winner buffer per chip
     multiprocess: bool = False  # fused mode: replicated outputs (see
     # ScryptPodSearch.multiprocess)
 
@@ -709,6 +713,9 @@ class X11PodSearch:
         if self.multiprocess and len(self._axes) != 2:
             raise ValueError(
                 "multiprocess X11PodSearch needs a (host, chip) mesh")
+        if self.winner_depth < 1:
+            raise ValueError(
+                f"winner_depth must be >= 1, got {self.winner_depth}")
         if self.chain_fn is None:
             from otedama_tpu.kernels.x11 import jnp_chain, shavite
 
@@ -723,22 +730,25 @@ class X11PodSearch:
         self._steps: dict[int, callable] = {}
 
     def _build_step(self, per_chip: int):
+        from otedama_tpu.kernels.x11 import jnp_chain
+
         axes = self._axes
         chip_axis = axes[-1]
         host_spec = P(axes[0]) if len(axes) == 2 else P()
+        chip_spec = P(axes[-1])
         chain = self.chain_fn
+        k = self.winner_depth
         replicate_out = self.multiprocess
-        out_specs = ((P(), P()) if replicate_out
-                     else (P(*axes), P(*axes)))
+        buf_spec = P() if replicate_out else P(*axes)
 
         @functools.partial(
             shard_map,
             mesh=self.mesh,
-            in_specs=(host_spec, P(), P()),
-            out_specs=out_specs,
+            in_specs=(host_spec, P(), P(), chip_spec, chip_spec),
+            out_specs=(buf_spec, P(), P()),
             check_vma=False,
         )
-        def _step(h76_rows, t0_limb, base):
+        def _step(h76_rows, limbs8, base, lasts, empties):
             h76 = h76_rows[0]  # this row's 76 header bytes, uint8
             chip = jax.lax.axis_index(chip_axis).astype(jnp.uint32)
             my_base = base + chip * jnp.uint32(per_chip)
@@ -751,24 +761,25 @@ class X11PodSearch:
                 [jnp.broadcast_to(h76[None, :], (per_chip, 76)), nb], axis=1
             )
             d = chain(headers)  # [per_chip, 32] uint8 digests
-            h0 = (
-                d[:, 28].astype(jnp.uint32)
-                | (d[:, 29].astype(jnp.uint32) << 8)
-                | (d[:, 30].astype(jnp.uint32) << 16)
-                | (d[:, 31].astype(jnp.uint32) << 24)
-            )
-            hits = h0 <= t0_limb  # prefilter: no false negatives
-            # (no device-side pmin telemetry: best-hash stats come from
-            # the host over requested lanes only, so overscan lanes can't
-            # leak in and the chain avoids a dead cross-pod collective)
+            h = jnp_chain.digest_limbs(d)
+            # EXACT winner decision on device: full 256-bit compare +
+            # lane-granular range clamp, compacted into the K-slot
+            # buffer — no prefilter transfer, no host re-filtering
+            hits = sj.le256(h, tuple(limbs8[i] for i in range(8)))
+            offs = jax.lax.iota(jnp.uint32, per_chip)
+            rng = (offs <= lasts[0]) & (empties[0] == jnp.uint32(0))
+            h0m = jnp.where(rng, h[0], jnp.uint32(NO_WINNER))
+            buf = sj.compact_winners(hits & rng, h0m, nonces, k)
+            pod_winners = jax.lax.psum(buf[2 * k], axes)
+            pod_best = _unflip(jax.lax.pmin(_flip(buf[2 * k + 2]), axes))
             if replicate_out:
-                return tuple(
-                    jax.lax.all_gather(jax.lax.all_gather(x, chip_axis),
-                                       axes[0])
-                    for x in (hits, h0)
+                buf = jax.lax.all_gather(
+                    jax.lax.all_gather(buf, chip_axis), axes[0]
                 )
-            shape = (1, 1, per_chip) if len(axes) == 2 else (1, per_chip)
-            return hits.reshape(shape), h0.reshape(shape)
+                return buf, pod_winners, pod_best
+            shape = ((1, 1, buf.shape[0]) if len(axes) == 2
+                     else (1, buf.shape[0]))
+            return buf.reshape(shape), pod_winners, pod_best
 
         return jax.jit(_step)
 
@@ -777,6 +788,23 @@ class X11PodSearch:
         if step is None:
             step = self._steps[per_chip] = self._build_step(per_chip)
         return step
+
+    def _oracle_rescan(self, jc: JobConstants, base: int,
+                       count: int) -> SearchResult:
+        """k-overflow fallback (> winner_depth exact winners on one chip
+        — test-easy targets only): exact scalar scan of that chip's
+        window through the independent numpy oracle chain."""
+        from otedama_tpu.kernels import x11 as x11_mod
+
+        winners: list[Winner] = []
+        best = 0xFFFFFFFF
+        for off in range(count):
+            nonce = (base + off) & 0xFFFFFFFF
+            digest = x11_mod.x11_digest(jc.header_for(nonce))
+            best = min(best, int.from_bytes(digest[28:32], "little"))
+            if tgt.hash_meets_target(digest, jc.target):
+                winners.append(Winner(nonce, digest))
+        return SearchResult(winners, count, best)
 
     def search_jobs(
         self, jcs: list[JobConstants], base: int, count: int
@@ -792,12 +820,14 @@ class X11PodSearch:
         if count <= 0:
             self.last_pod_best = 0xFFFFFFFF
             return [SearchResult([], 0, 0xFFFFFFFF) for _ in jcs]
-        t0_limb = int(jcs[0].limbs[0])
+        limbs = jcs[0].limbs
         # FIXED compiled shape: per_chip is always self.chunk (the chain
         # costs minutes per shape — X11JaxBackend's fixed_shape lesson);
-        # the last window overscans and extraction filters idx >= count
+        # the last window overscans and the IN-KERNEL clamp (lasts /
+        # empties) keeps overscan lanes out of winners AND telemetry
         per_chip = self.chunk
         window = per_chip * self.n_chips
+        k = self.winner_depth
 
         # numpy (uncommitted) inputs — multi-controller rule, see above
         h76 = np.stack([
@@ -805,34 +835,43 @@ class X11PodSearch:
         ])
         winners_per_row: list[list[Winner]] = [[] for _ in jcs]
         best_per_row = [0xFFFFFFFF] * len(jcs)
+        pod_flagged = 0
+        pod_best_acc = 0xFFFFFFFF
         done = 0
         while done < count:
             wbase = (base + done) & 0xFFFFFFFF
             valid = min(window, count - done)
+            lasts, empties = _chip_windows(self.n_chips, per_chip, valid)
             with jaxcompat.enable_x64():
                 out = self._step_for(per_chip)(
-                    h76, np.uint32(t0_limb), np.uint32(wbase)
+                    h76, np.asarray(limbs, dtype=np.uint32),
+                    np.uint32(wbase), lasts, empties,
                 )
-                hits, h0 = (np.asarray(o) for o in out)
-            if hits.ndim == 2:
-                hits, h0 = hits[None], h0[None]
+                buf, pod_winners, pod_best = (np.asarray(o) for o in out)
+            if buf.ndim == 2:  # 1D mesh: add the row axis
+                buf = buf[None]
+            pod_flagged += int(pod_winners)
+            pod_best_acc = min(pod_best_acc, int(pod_best))
             for r, jc in enumerate(jcs):
-                row = hits[r].reshape(-1)
-                # telemetry over requested lanes only (advisor r3): lanes
-                # >= valid hash nonces outside the asked-for range
-                best_per_row[r] = min(
-                    best_per_row[r], int(h0[r].reshape(-1)[:valid].min())
+                def digest_fn(w, jc=jc):
+                    # INDEPENDENT numpy oracle chain — looked up at call
+                    # time so the certification-day module state applies
+                    return x11_mod.x11_digest(jc.header_for(w))
+
+                row_winners, row_best = _extract_row_winners(
+                    buf[r], k, wbase, per_chip, lasts, empties, jc.target,
+                    digest_fn,
+                    lambda b, c, jc=jc: self._oracle_rescan(jc, b, c),
+                    f"x11 pod row {r}",
                 )
-                for idx in np.nonzero(row)[0].tolist():
-                    if idx >= valid:
-                        continue  # overscan lane beyond the request
-                    nonce = (wbase + idx) & 0xFFFFFFFF
-                    # exact verify via the INDEPENDENT numpy oracle chain
-                    digest = x11_mod.x11_digest(jc.header_for(nonce))
-                    if tgt.hash_meets_target(digest, jc.target):
-                        winners_per_row[r].append(Winner(nonce, digest))
+                winners_per_row[r].extend(row_winners)
+                best_per_row[r] = min(best_per_row[r], row_best)
             done += valid
-        self.last_pod_best = min(best_per_row)
+        self.last_pod_flagged = pod_flagged
+        # the ICI pmin IS the pod-level telemetry (already paid for on
+        # the interconnect, same as the sha256d/scrypt pods); the
+        # per-row bests above feed the per-row SearchResults
+        self.last_pod_best = pod_best_acc
         return [
             SearchResult(winners_per_row[r], count, best_per_row[r])
             for r in range(len(jcs))

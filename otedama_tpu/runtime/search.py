@@ -612,11 +612,14 @@ class X11JaxBackend:
 
     The full 11-stage chain jits into one XLA program per chunk shape
     (scan-based round loops — see jnp_chain's docstring for why). Per
-    chunk: headers are built on the host, digests computed on device, only
-    the top LE limb is transferred for the prefilter; candidate digests are
-    gathered device-side and exact-verified against the 256-bit target on
-    the host (and re-verified through the numpy oracle chain, which shares
-    no code with the jnp path beyond constants).
+    chunk: headers are built on the host, digests computed AND winners
+    decided exactly on device (full 256-bit compare, range clamp), and
+    the host reads ONE ``uint32[2k+3]`` compact winner buffer
+    (``jnp_chain.x11_winner_step`` — the K-slot winner-buffer contract
+    shared with the sha256d/scrypt tiers; the dense ``[B, 32]`` digest
+    transfer is gone). Each winner's digest is re-derived through the
+    INDEPENDENT numpy oracle chain, which shares no code with the jnp
+    path beyond constants — the corruption tripwire.
 
     NB: first call per chunk shape pays a large XLA compile (~4 min on
     CPU); subsequent calls are cached. Choose one chunk and keep it.
@@ -625,32 +628,27 @@ class X11JaxBackend:
     name = "x11-jax"
     algorithm = "x11"
 
-    def __init__(self, chunk: int = 1 << 12):
+    def __init__(self, chunk: int = 1 << 12, winner_depth: int | None = None):
         self.chunk = chunk
         self.max_batch = 4 * chunk  # see ScryptXlaBackend.max_batch
-        self._fn = None
+        self.k = int(winner_depth or sp.K_WINNERS)
+        if self.k < 1:
+            raise ValueError(f"winner_depth must be >= 1, got {self.k}")
+        self._winner_fn = None
 
-    def _compiled(self):
-        if self._fn is None:
+    def _winner_step(self):
+        if self._winner_fn is None:
             import functools
 
-            import jax
+            from otedama_tpu.kernels.x11 import jnp_chain, shavite
 
-            from otedama_tpu.kernels.x11 import jnp_chain
-
-            from otedama_tpu.kernels.x11 import shavite
-
-            with jaxcompat.enable_x64():
-                # resolve the sbox mode AND shavite counter-order OUTSIDE
-                # jit so the compile cache is keyed on the actual values
-                # (see x11_digest_device) — a certification-day variant
-                # flip is then a fresh cache entry, never a stale trace
-                self._fn = functools.partial(
-                    jnp_chain.compiled_chain(self.chunk),
-                    sbox_mode=jnp_chain._default_sbox_mode(),
-                    cnt_variant=shavite.active_cnt_variant(),
-                )
-        return self._fn
+            self._winner_fn = functools.partial(
+                jnp_chain._jitted_winner_step,
+                k=self.k,
+                sbox_mode=jnp_chain._default_sbox_mode(),
+                cnt_variant=shavite.active_cnt_variant(),
+            )
+        return self._winner_fn
 
     def precompile(self, jc: JobConstants | None = None,
                    count: int | None = None) -> float:
@@ -664,16 +662,57 @@ class X11JaxBackend:
         import jax
         import jax.numpy as jnp
 
-        fn = self._compiled()
+        from otedama_tpu.kernels import x11 as x11_mod
 
-        def digest_batch(headers: np.ndarray) -> np.ndarray:
+        step = self._winner_step()
+        limbs = jnp.asarray(jc.limbs)
+        prefix = np.frombuffer(jc.header76, dtype=np.uint8)
+        winners: list[Winner] = []
+        best = 0xFFFFFFFF
+        done = 0
+        while done < count:
+            valid = min(self.chunk, count - done)
+            wbase = (base + done) & 0xFFFFFFFF
+            headers = np.empty((self.chunk, 80), dtype=np.uint8)
+            headers[:, :76] = prefix
+            nonces = (wbase + np.arange(self.chunk, dtype=np.uint64)
+                      ) & 0xFFFFFFFF
+            headers[:, 76:] = (
+                nonces.astype(">u4").view(np.uint8).reshape(self.chunk, 4)
+            )
             with jaxcompat.enable_x64():
-                return np.asarray(fn(jnp.asarray(headers)))
-
-        return _x11_chunk_search(
-            jc, base, count, self.chunk, digest_batch,
-            fixed_shape=True, cross_check=True,
-        )
+                buf = np.asarray(step(
+                    jnp.asarray(headers), limbs, jnp.uint32(valid - 1)
+                ))
+            offs, _, n, min_hash = sp.unpack_winner_buffer(buf, self.k)
+            best = min(best, min_hash)
+            if n > self.k:
+                # winner table overflowed (test-easy targets): dense
+                # fallback over THIS chunk through the lane-parallel
+                # NUMPY pipeline — exact (it IS the oracle) and free of
+                # XLA compiles, so an overflow never stalls the live
+                # search loop for the chain's multi-minute compile
+                res = _x11_chunk_search(
+                    jc, wbase, valid, valid, x11_mod.x11_digest_batch,
+                    fixed_shape=False,
+                )
+                winners.extend(res.winners)
+                done += valid
+                continue
+            for s in range(n):
+                nonce = (wbase + int(offs[s])) & 0xFFFFFFFF
+                # the device decision is exact; materialize (and
+                # cross-check) the digest via the INDEPENDENT oracle
+                digest = x11_mod.x11_digest(jc.header_for(nonce))
+                if not tgt.hash_meets_target(digest, jc.target):
+                    log.error(
+                        "x11 device winner %#010x fails the oracle chain "
+                        "— device result corrupt?", nonce,
+                    )
+                    continue
+                winners.append(Winner(nonce, digest))
+            done += valid
+        return SearchResult(winners, count, best)
 
 
 def _x11_chunk_search(
@@ -755,13 +794,17 @@ class EthashLightBackend:
                  full_pages: int | None = None,
                  block_number: int | None = None, device: bool = True,
                  chunk: int = 256, full_dataset: bool = False,
-                 cache: "np.ndarray | None" = None, cache_dev=None):
+                 cache: "np.ndarray | None" = None, cache_dev=None,
+                 winner_depth: int | None = None):
         from otedama_tpu.kernels import ethash as eth
 
         self._eth = eth
         self.device = device
         self.chunk = chunk
         self.max_batch = 4 * chunk  # see ScryptXlaBackend.max_batch
+        self.k = int(winner_depth or sp.K_WINNERS)
+        if self.k < 1:
+            raise ValueError(f"winner_depth must be >= 1, got {self.k}")
         if full_dataset and not device:
             # silently measuring the light tier under the full tier's name
             # would be exactly the mislabeling this ctor refuses elsewhere
@@ -832,6 +875,48 @@ class EthashLightBackend:
         a shape the hot loop never dispatches."""
         return warmup_backend(self, jc, self.chunk)
 
+    def _winner_digest(self, header_hash: bytes, nonce: int) -> bytes:
+        """Materialize one winner's 32-byte framework digest. Light
+        tiers re-derive through the HOST oracle (``hashimoto_light`` —
+        the independent corruption tripwire); the full tier holds no
+        host cache, so it runs a 1-nonce dense device pass and the
+        256-bit target re-check is the tripwire."""
+        eth = self._eth
+        if self.cache is not None:
+            _, res = eth.hashimoto_light(
+                self.full_size, self.cache, header_hash, nonce)
+            return res[::-1]
+        _, results = eth.hashimoto_full_device(
+            self.full_size, self._dataset_dev, header_hash,
+            np.array([nonce], dtype=np.uint64),
+        )
+        return results[0, ::-1].tobytes()
+
+    def _dense_chunk(self, header_hash: bytes,
+                     nonces: np.ndarray) -> np.ndarray:
+        """Dense per-lane results for one chunk — the k-overflow
+        fallback and the host (device=False) tier."""
+        eth = self._eth
+        if self._dataset_dev is not None:
+            _, results = eth.hashimoto_full_device(
+                self.full_size, self._dataset_dev, header_hash, nonces
+            )
+        elif self.device:
+            _, results = eth.hashimoto_light_device(
+                self.full_size, self._cache_dev, header_hash, nonces
+            )
+        else:
+            results = np.stack([
+                np.frombuffer(
+                    eth.hashimoto_light(
+                        self.full_size, self.cache, header_hash, int(v)
+                    )[1],
+                    dtype=np.uint8,
+                )
+                for v in nonces
+            ])
+        return results
+
     def search(self, jc: JobConstants, base: int, count: int) -> SearchResult:
         eth = self._eth
         header_hash = eth.keccak256(jc.header76)
@@ -843,24 +928,37 @@ class EthashLightBackend:
             nonces = (
                 base + done + np.arange(n, dtype=np.uint64)
             ) & 0xFFFFFFFF
-            if self._dataset_dev is not None:
-                _, results = eth.hashimoto_full_device(
-                    self.full_size, self._dataset_dev, header_hash, nonces
+            if self.device or self._dataset_dev is not None:
+                # device tiers: winners decided exactly on device (full
+                # 256-bit compare) and compacted into the K-slot buffer
+                # — the chunk's single transfer is uint32[2k+3], never
+                # the dense [n, 32] result tensor
+                buf = eth.hashimoto_winners_device(
+                    self.full_size,
+                    (self._dataset_dev if self._dataset_dev is not None
+                     else self._cache_dev),
+                    header_hash, nonces, jc.limbs, n, self.k,
+                    full=self._dataset_dev is not None,
                 )
-            elif self.device:
-                _, results = eth.hashimoto_light_device(
-                    self.full_size, self._cache_dev, header_hash, nonces
-                )
-            else:
-                results = np.stack([
-                    np.frombuffer(
-                        eth.hashimoto_light(
-                            self.full_size, self.cache, header_hash, int(v)
-                        )[1],
-                        dtype=np.uint8,
-                    )
-                    for v in nonces
-                ])
+                offs, _, nw, min_hash = sp.unpack_winner_buffer(buf, self.k)
+                best = min(best, min_hash)
+                if nw <= self.k:
+                    for s in range(nw):
+                        nonce = int(nonces[int(offs[s])])
+                        digest = self._winner_digest(header_hash, nonce)
+                        if not tgt.hash_meets_target(digest, jc.target):
+                            log.error(
+                                "ethash device winner %#010x failed host "
+                                "verification — device result corrupt?",
+                                nonce,
+                            )
+                            continue
+                        winners.append(Winner(nonce, digest))
+                    done += n
+                    continue
+                # winner table overflowed (test-easy targets): dense
+                # exact fallback over this chunk only
+            results = self._dense_chunk(header_hash, nonces)
             # framework convention: digests compare as LE integers, so the
             # BE ethash result is byte-reversed once here
             digests = results[:, ::-1]
@@ -912,10 +1010,12 @@ class EthashManagedBackend:
                  device: bool | None = None, chunk: int = 256,
                  sizing=None, prefetch_blocks: int = 64,
                  max_full_tiers: int = 2, max_light_tiers: int = 3,
-                 build_retry_seconds: float = 300.0):
+                 build_retry_seconds: float = 300.0,
+                 winner_depth: int | None = None):
         from otedama_tpu.kernels import ethash as eth
 
         self._eth = eth
+        self.winner_depth = winner_depth
         if device is None or full_dataset is None:
             from otedama_tpu.utils.platform_probe import (
                 safe_default_backend,
@@ -984,6 +1084,7 @@ class EthashManagedBackend:
                 return tier
             tier = EthashLightBackend(
                 device=self.device, chunk=self.chunk,
+                winner_depth=self.winner_depth,
                 **self._sizing(epoch),
             )
             with self._lock:
@@ -1023,6 +1124,7 @@ class EthashManagedBackend:
             tier = EthashLightBackend(
                 device=True, chunk=self.chunk, full_dataset=True,
                 cache=light.cache, cache_dev=light._cache_dev,
+                winner_depth=self.winner_depth,
                 **self._sizing(epoch),
             )
         except Exception:
@@ -1158,6 +1260,11 @@ class PythonBackend:
 _WINNER_DEPTH_KINDS = {
     ("pallas-tpu", "sha256d"), ("pod", "sha256d"),
     ("pallas-tpu", "scrypt"), ("xla", "scrypt"), ("pod", "scrypt"),
+    # x11/ethash winner-buffer parity (ISSUE 12): every device tier of
+    # both algorithms now emits the same compact K-slot buffer
+    ("jax", "x11"), ("xla", "x11"), ("pod", "x11"),
+    ("jax", "ethash"), ("xla", "ethash"), ("full", "ethash"),
+    ("managed", "ethash"),
 }
 
 

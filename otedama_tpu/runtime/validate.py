@@ -1,0 +1,452 @@
+"""Device-batched share validation — the device INGEST path.
+
+The reference ships full CUDA/OpenCL validation kernels that never
+execute (its host stubs return nil); this module is the working
+realization: miner-submitted shares, already assembled into batches by
+the group-commit ledger (``PoolManager.on_share_batch``) and the gossip
+batch handlers (``P2PPool``), are verified on the accelerator as ONE
+dispatch per algorithm group instead of one host hash per share.
+
+Contract (mirrors the search path's winner buffers, run in reverse):
+every tier's verify kernel hashes N submitted 80-byte headers, compares
+each lane EXACTLY (256-bit lexicographic) against its OWN share target,
+and compacts the rare FAILURES — honest shares were mined to target, so
+a failing lane is Byzantine input or corruption — into one fixed
+``uint32[2k+3]`` buffer (``sha256_pallas.unpack_winner_buffer`` layout,
+lane offsets in the nonce slots). One transfer per batch; a failure
+count past ``k`` (a heavily Byzantine batch) falls back to exact host
+verification of the whole batch.
+
+Safety rails, in the same shape as the device SEARCH path's:
+
+- **Crossover**: batches under ``min_batch`` shares go straight to the
+  host (``pow_host.pow_digest`` on the validation executor) — device
+  dispatch overhead loses below a measured size, exactly like
+  ``sha256_host.NUMPY_LANE_MIN_BATCH``.
+- **Fallback**: a device error quarantines the device path for
+  ``quarantine_seconds`` and every batch host-validates meanwhile; an
+  absent/refusing device never blocks a verdict.
+- **Tripwire**: a seeded sample of every device batch is re-verified
+  through the independent host oracle (PR 7's winner re-check, applied
+  to ingest). A mismatch means the DEVICE verdict is corrupt: the whole
+  batch degrades to host validation, the event is counted and logged
+  loudly, and the device path quarantines.
+- **Fault point** ``validation.verify`` (error / corrupt / delay on the
+  device verdict) makes all three rails testable deterministically.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import random
+import threading
+import time
+
+import numpy as np
+
+from otedama_tpu.kernels import sha256_pallas as sp
+from otedama_tpu.kernels import target as tgt
+from otedama_tpu.utils import faults
+from otedama_tpu.utils import pow_host
+from otedama_tpu.utils.histogram import LatencyHistogram
+
+log = logging.getLogger("otedama.runtime.validate")
+
+_VERIFY_FAULTS = faults.DEVICE
+
+# device dispatch pays off only past this batch size (measured on the
+# CPU-fallback sandbox with tools/bench_validate.py: below ~tens of
+# shares the jnp dispatch overhead loses to a tight host hash loop; the
+# exact knee is platform-dependent, hence the knob)
+VALIDATE_MIN_BATCH = 32
+
+# compiled-shape pool: batches pad up to the next of these so the jit
+# cache holds a handful of programs instead of one per batch size
+_SHAPES = (32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+# share-count distribution bounds for the batch-size histogram (the
+# latency histogram class is unit-agnostic: bounds are just numbers)
+_BATCH_BOUNDS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
+                 512.0, 1024.0, 4096.0)
+
+# algorithms with a device verify tier. Deliberately NARROW: "sha256"
+# (single hash) has no device twin and must not fall into the sha256d
+# kernel, and the certification-gated coin aliases ("dash", "etchash")
+# stay on the host oracle path, whose pow_digest enforces the registry
+# gate — the device path must never let an uncertified alias bypass it.
+_DEVICE_ALGOS = frozenset({
+    "sha256d", "sha256double", "bitcoin", "scrypt", "litecoin",
+    "x11", "ethash",
+})
+
+
+@dataclasses.dataclass(frozen=True)
+class ShareCheck:
+    """One share's validation claim: the exact 80 bytes the miner
+    hashed, the target its credited difficulty demands, and the
+    algorithm/height that pick the digest function."""
+
+    header: bytes
+    target: int
+    algorithm: str = "sha256d"
+    block_number: int = 0
+
+
+def _padded_shape(n: int) -> int:
+    for s in _SHAPES:
+        if n <= s:
+            return s
+    return -(-n // _SHAPES[-1]) * _SHAPES[-1]
+
+
+class ValidationBackend:
+    """Batches share-validation work onto the device, with host
+    fallback, a measured crossover, and a sampled host-oracle tripwire.
+
+    One instance serves every producer in the process (the pool
+    manager's ledger flush AND the p2p gossip handlers): the stats and
+    histograms are one surface, and the quarantine state is shared —
+    a device that corrupted a ledger batch must not keep verifying
+    gossip.
+    """
+
+    def __init__(self, *, min_batch: int = VALIDATE_MIN_BATCH,
+                 tripwire_rate: float = 0.05, k: int | None = None,
+                 quarantine_seconds: float = 60.0, device: bool = True,
+                 seed: int = 0, rolled: bool | None = None,
+                 x11_chain: str = "numpy"):
+        self.min_batch = max(1, int(min_batch))
+        self.tripwire_rate = float(tripwire_rate)
+        self.k = int(k or sp.K_WINNERS)
+        if self.k < 1:
+            raise ValueError(f"winner_depth must be >= 1, got {self.k}")
+        self.quarantine_seconds = float(quarantine_seconds)
+        self.device = bool(device)
+        # "numpy" = the lane-parallel host pipeline (vectorized tier,
+        # no multi-minute XLA compile); "jax" = the device jnp chain
+        # (TPU deployments that pay the compile once)
+        if x11_chain not in ("numpy", "jax"):
+            raise ValueError(f"unknown x11 validation chain {x11_chain!r}")
+        self.x11_chain = x11_chain
+        if rolled is None:
+            from otedama_tpu.utils.platform_probe import safe_default_backend
+
+            rolled = safe_default_backend() != "tpu"
+        self.rolled = bool(rolled)
+        # deterministic tripwire sampling: chaos runs replay exactly
+        self._rng = random.Random(seed)
+        self._quarantined_until = 0.0
+        self._lock = threading.Lock()
+        self.stats = {
+            "validated_device": 0,
+            "validated_host": 0,
+            "device_batches": 0,
+            "host_batches": 0,
+            "crossover_batches": 0,   # host because under min_batch
+            "device_errors": 0,
+            "overflows": 0,           # failure table overflowed (> k)
+            "tripwire_checks": 0,
+            "tripwire_mismatches": 0,
+            "rejects": 0,             # shares that failed validation
+        }
+        self.batch_sizes = LatencyHistogram(bounds=_BATCH_BOUNDS)
+        self.device_seconds = LatencyHistogram()
+        self.host_seconds = LatencyHistogram()
+        # min top compare limb ever observed (best-share telemetry, the
+        # unit the search kernels report)
+        self.best_hash_hi = 0xFFFFFFFF
+
+    # -- device state ---------------------------------------------------------
+
+    def device_ok(self) -> bool:
+        return self.device and time.monotonic() >= self._quarantined_until
+
+    def _quarantine(self) -> None:
+        self._quarantined_until = (
+            time.monotonic() + self.quarantine_seconds)
+
+    # -- the host oracle ------------------------------------------------------
+
+    @staticmethod
+    def _host_verdict(check: ShareCheck) -> bool:
+        digest = pow_host.pow_digest(
+            check.header, check.algorithm,
+            block_number=check.block_number,
+        )
+        return tgt.hash_meets_target(digest, check.target)
+
+    async def _verify_host(self, checks: list[ShareCheck]) -> list[bool]:
+        """Exact per-share host validation, CONCURRENT on the validation
+        executor (the same pool the slow-algo stratum checks use)."""
+        t0 = time.monotonic()
+        loop = asyncio.get_running_loop()
+        pool = pow_host.validation_executor()
+        verdicts = list(await asyncio.gather(*(
+            loop.run_in_executor(pool, self._host_verdict, c)
+            for c in checks
+        )))
+        self.host_seconds.observe(time.monotonic() - t0)
+        with self._lock:
+            self.stats["host_batches"] += 1
+            self.stats["validated_host"] += len(checks)
+        return verdicts
+
+    # -- the device kernels ---------------------------------------------------
+
+    def _device_buffer(self, algorithm: str, checks: list[ShareCheck],
+                       block_number: int) -> np.ndarray:
+        """One device dispatch: the algorithm tier's verify kernel over
+        the padded batch. Returns the ``uint32[2k+3]`` failure buffer.
+        Runs on an executor thread (jnp dispatch blocks)."""
+        import jax.numpy as jnp
+
+        from otedama_tpu.kernels import sha256_jax as sj
+
+        n = len(checks)
+        shape = _padded_shape(n)
+        if algorithm == "x11":
+            headers = np.zeros((shape, 80), dtype=np.uint8)
+            for i, c in enumerate(checks):
+                headers[i] = np.frombuffer(c.header, dtype=np.uint8)
+            if self.x11_chain == "numpy":
+                # lane-parallel host pipeline: verdicts computed exactly
+                # here; emit the same failure buffer shape so every tier
+                # is one code path downstream
+                from otedama_tpu.kernels import x11 as x11_mod
+
+                verdicts, best = x11_mod.x11_verify_batch(
+                    headers[:n], [c.target for c in checks])
+                fails = np.nonzero(~verdicts)[0]
+                buf = np.zeros((sp.winner_buffer_words(self.k),),
+                               dtype=np.uint32)
+                buf[self.k:2 * self.k] = 0xFFFFFFFF
+                for s, off in enumerate(fails[:self.k]):
+                    buf[s] = off
+                buf[2 * self.k] = len(fails)
+                buf[2 * self.k + 2] = best
+                return buf
+            from otedama_tpu.kernels.x11 import jnp_chain, shavite
+            from otedama_tpu.utils import jaxcompat
+
+            limbs = np.full((shape, 8), 0xFFFFFFFF, dtype=np.uint32)
+            for i, c in enumerate(checks):
+                limbs[i] = tgt.target_to_limbs(c.target)
+            with jaxcompat.enable_x64():
+                return np.asarray(jnp_chain._jitted_verify_step(
+                    jnp.asarray(headers), jnp.asarray(limbs),
+                    jnp.uint32(n - 1), k=self.k,
+                    sbox_mode=jnp_chain._default_sbox_mode(),
+                    cnt_variant=shavite.active_cnt_variant(),
+                ))
+        if algorithm == "ethash":
+            from otedama_tpu.kernels import ethash as eth
+
+            epoch = block_number // eth.EPOCH_LENGTH
+            full_size, cache = pow_host._epoch_cache(epoch)
+            hhs = np.zeros((shape, 32), dtype=np.uint8)
+            nonces = np.zeros((shape,), dtype=np.uint64)
+            limbs = np.full((shape, 8), 0xFFFFFFFF, dtype=np.uint32)
+            for i, c in enumerate(checks):
+                hhs[i] = np.frombuffer(eth.keccak256(c.header[:76]),
+                                       dtype=np.uint8)
+                nonces[i] = int.from_bytes(c.header[76:80], "big")
+                limbs[i] = tgt.target_to_limbs(c.target)
+            return eth.hashimoto_verify_device(
+                full_size, cache, hhs, nonces, limbs, n, self.k)
+        # headers pad with zeros -> limbs rows past n never count (the
+        # kernels clamp to `last`), so padding content is irrelevant
+        words = np.zeros((shape, 20), dtype=np.uint32)
+        words[:n] = sj.headers_to_words([c.header for c in checks])
+        limbs = np.full((shape, 8), 0xFFFFFFFF, dtype=np.uint32)
+        for i, c in enumerate(checks):
+            limbs[i] = tgt.target_to_limbs(c.target)
+        if algorithm in ("scrypt", "litecoin"):
+            from otedama_tpu.kernels import scrypt_jax as scj
+
+            return np.asarray(scj.scrypt_verify_step(
+                jnp.asarray(words), jnp.asarray(limbs),
+                jnp.uint32(n - 1), n=shape, k=self.k, rolled=self.rolled,
+            ))
+        if algorithm not in ("sha256d", "sha256double", "bitcoin"):
+            # defensive: verify_batch's _DEVICE_ALGOS routing should
+            # make this unreachable — an unknown algorithm must fail
+            # loudly, never silently run the wrong kernel
+            raise ValueError(f"no device verify tier for {algorithm!r}")
+        # sha256d family: the jnp twin is the portable dispatch; the
+        # Pallas kernel (sha256d_verify_pallas) runs the same contract
+        # on TPU — both are exercised against the oracle in tests
+        return np.asarray(sj.sha256d_verify_step(
+            jnp.asarray(words), jnp.asarray(limbs), jnp.uint32(n - 1),
+            n=shape, k=self.k, rolled=self.rolled,
+        ))
+
+    async def _verify_device_group(
+        self, algorithm: str, block_number: int, checks: list[ShareCheck]
+    ) -> list[bool] | None:
+        """One algorithm group through the device path. Returns verdicts
+        or None (device refused / overflowed / tripwire fired — caller
+        falls back to host)."""
+        loop = asyncio.get_running_loop()
+        t0 = time.monotonic()
+        try:
+            d = faults.hit("validation.verify", algorithm, _VERIFY_FAULTS)
+        except Exception:
+            with self._lock:
+                self.stats["device_errors"] += 1
+            self._quarantine()
+            return None
+        corrupt = False
+        if d is not None:
+            if d.delay:
+                await asyncio.sleep(d.delay)
+            corrupt = d.corrupt
+        try:
+            buf = await loop.run_in_executor(
+                None, self._device_buffer, algorithm, checks, block_number
+            )
+        except Exception:
+            log.exception(
+                "device validation dispatch failed (%s x%d) — "
+                "quarantining the device path", algorithm, len(checks))
+            with self._lock:
+                self.stats["device_errors"] += 1
+            self._quarantine()
+            return None
+        offs, _, n_fails, min_h0 = sp.unpack_winner_buffer(buf, self.k)
+        if n_fails > self.k:
+            # heavily Byzantine batch: the compact table cannot name
+            # every failure — re-verify the whole batch exactly on host
+            with self._lock:
+                self.stats["overflows"] += 1
+            return None
+        verdicts = [True] * len(checks)
+        for s in range(n_fails):
+            off = int(offs[s])
+            if off < len(verdicts):
+                verdicts[off] = False
+        if corrupt:
+            # injected wrong-result mode: the device "answered" with
+            # every verdict inverted — exactly what the tripwire exists
+            # to catch
+            verdicts = [not v for v in verdicts]
+
+        # sampled host-oracle tripwire (PR 7's winner re-check applied
+        # to ingest): per batch, at least one share re-verified host-side
+        # — CONCURRENTLY on the executor (the cost is one slowest hash,
+        # not the sum), and BEFORE the device path's success accounting
+        # so a discarded batch never inflates the device/host split
+        if self.tripwire_rate > 0:
+            sample = [i for i in range(len(checks))
+                      if self._rng.random() < self.tripwire_rate]
+            if not sample:
+                sample = [self._rng.randrange(len(checks))]
+            with self._lock:
+                self.stats["tripwire_checks"] += len(sample)
+            pool = pow_host.validation_executor()
+            host_oks = await asyncio.gather(*(
+                loop.run_in_executor(pool, self._host_verdict, checks[i])
+                for i in sample
+            ))
+            mismatch = False
+            for i, host_ok in zip(sample, host_oks):
+                if host_ok != verdicts[i]:
+                    mismatch = True
+                    log.error(
+                        "validation tripwire: device verdict %s for "
+                        "share %d (%s) but host oracle says %s — device "
+                        "result corrupt; degrading batch to host "
+                        "validation", verdicts[i], i, algorithm, host_ok,
+                    )
+            if mismatch:
+                with self._lock:
+                    self.stats["tripwire_mismatches"] += 1
+                self._quarantine()
+                return None
+        self.device_seconds.observe(time.monotonic() - t0)
+        with self._lock:
+            self.stats["device_batches"] += 1
+            self.stats["validated_device"] += len(checks)
+            self.best_hash_hi = min(self.best_hash_hi, int(min_h0))
+        return verdicts
+
+    # -- public API -----------------------------------------------------------
+
+    async def verify_batch(self, checks: list[ShareCheck]) -> list[bool]:
+        """Validate one batch of submitted shares. Returns one verdict
+        per share (True = the header's PoW digest meets its target),
+        bit-identical to the host oracle's answer by construction —
+        the device compare is exact and every degradation path ends at
+        ``pow_host``."""
+        if not checks:
+            return []
+        self.batch_sizes.observe(float(len(checks)))
+        verdicts: list[bool | None] = [None] * len(checks)
+        # group by (algorithm tier, ethash epoch): each group is one
+        # device dispatch (mixed-algorithm batches cross region/chain
+        # boundaries legitimately)
+        groups: dict[tuple[str, int], list[int]] = {}
+        for i, c in enumerate(checks):
+            algo = (c.algorithm or "sha256d").lower()
+            epoch = 0
+            if algo in ("ethash", "etchash"):
+                from otedama_tpu.kernels import ethash as eth
+
+                epoch = c.block_number // eth.EPOCH_LENGTH
+            groups.setdefault((algo, epoch), []).append(i)
+        for (algo, _epoch), idxs in groups.items():
+            sub = [checks[i] for i in idxs]
+            group_verdicts: list[bool] | None = None
+            device_eligible = algo in _DEVICE_ALGOS
+            if (device_eligible and self.device_ok()
+                    and len(sub) >= self.min_batch):
+                group_verdicts = await self._verify_device_group(
+                    algo, sub[0].block_number, sub)
+            elif device_eligible and len(sub) < self.min_batch:
+                with self._lock:
+                    self.stats["crossover_batches"] += 1
+            if group_verdicts is None:
+                group_verdicts = await self._verify_host(sub)
+            for i, v in zip(idxs, group_verdicts):
+                verdicts[i] = v
+        rejects = sum(1 for v in verdicts if not v)
+        if rejects:
+            with self._lock:
+                self.stats["rejects"] += rejects
+        return [bool(v) for v in verdicts]
+
+    # -- reporting ------------------------------------------------------------
+
+    def executor_queue_depth(self) -> int:
+        """Pending work on the shared validation executor — the
+        host-path backpressure signal (a deep queue means host
+        validation is the wall again)."""
+        pool = pow_host._VALIDATION_POOL
+        if pool is None:
+            return 0
+        try:
+            return pool._work_queue.qsize()
+        except Exception:
+            return 0
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            stats = dict(self.stats)
+        return {
+            **stats,
+            "device_ok": self.device_ok(),
+            "min_batch": self.min_batch,
+            "executor_queue_depth": self.executor_queue_depth(),
+            "best_hash_hi": self.best_hash_hi,
+            "batch_size": {
+                "count": self.batch_sizes.count,
+                "avg": round(
+                    self.batch_sizes.sum / self.batch_sizes.count, 2)
+                if self.batch_sizes.count else 0.0,
+                "p50": self.batch_sizes.quantile(0.5),
+                "p99": self.batch_sizes.quantile(0.99),
+            },
+            "device_seconds": self.device_seconds.snapshot(),
+            "host_seconds": self.host_seconds.snapshot(),
+        }

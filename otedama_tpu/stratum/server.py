@@ -113,6 +113,12 @@ class AcceptedShare:
     nonce_word: int
     is_block: bool
     submitted_at: float
+    # the job's algorithm and chain height, carried so downstream batch
+    # consumers (device re-validation, the region replicator) never
+    # re-derive them — and so a sha256d share's ``digest`` can serve as
+    # its submission id without a second host hash of the same header
+    algorithm: str = "sha256d"
+    block_number: int = 0
 
 
 ShareHook = Callable[[AcceptedShare], Awaitable[None]]
@@ -919,6 +925,8 @@ class StratumServer:
             nonce_word=sub.nonce_word,
             is_block=is_block,
             submitted_at=time.time(),
+            algorithm=job.algorithm,
+            block_number=job.block_number,
         )
         outcome = ShareOutcome.BLOCK_FOUND if is_block else ShareOutcome.ACCEPTED
         return outcome, accepted

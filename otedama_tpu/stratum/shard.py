@@ -189,6 +189,7 @@ _ACK_CODE = {"ok": 0, "dup": 1, "err": 2}
 def encode_share_frame(seq: int, s: AcceptedShare) -> bytes:
     worker = s.worker_user.encode()
     job = s.job_id.encode()
+    algo = s.algorithm.encode()
     body = b"".join((
         struct.pack(">BQIH", _BIN_SHARE, seq, s.session_id & 0xFFFFFFFF,
                     len(worker)),
@@ -204,6 +205,9 @@ def encode_share_frame(seq: int, s: AcceptedShare) -> bytes:
         struct.pack(">IIBd", s.ntime & 0xFFFFFFFF,
                     s.nonce_word & 0xFFFFFFFF,
                     1 if s.is_block else 0, s.submitted_at),
+        struct.pack(">H", len(algo)),
+        algo,
+        struct.pack(">I", s.block_number & 0xFFFFFFFF),
     ))
     return struct.pack(">I", len(body)) + body
 
@@ -231,6 +235,12 @@ def decode_share_frame(body: bytes) -> tuple[int, AcceptedShare]:
     off += elen
     ntime, nonce_word, is_block, submitted_at = struct.unpack_from(
         ">IIBd", body, off)
+    off += 17
+    (alen,) = struct.unpack_from(">H", body, off)
+    off += 2
+    algorithm = body[off:off + alen].decode()
+    off += alen
+    (block_number,) = struct.unpack_from(">I", body, off)
     if len(header) != 80:
         raise ValueError("binary share frame truncated")
     return seq, AcceptedShare(
@@ -238,7 +248,8 @@ def decode_share_frame(body: bytes) -> tuple[int, AcceptedShare]:
         difficulty=difficulty, actual_difficulty=actual, digest=digest,
         header=header, extranonce2=extranonce2, ntime=ntime,
         nonce_word=nonce_word, is_block=bool(is_block),
-        submitted_at=submitted_at,
+        submitted_at=submitted_at, algorithm=algorithm,
+        block_number=block_number,
     )
 
 
@@ -340,6 +351,8 @@ def share_to_wire(s: AcceptedShare) -> dict:
         "nonce_word": s.nonce_word,
         "is_block": s.is_block,
         "submitted_at": s.submitted_at,
+        "algorithm": s.algorithm,
+        "block_number": s.block_number,
     }
 
 
@@ -357,6 +370,8 @@ def share_from_wire(d: dict) -> AcceptedShare:
         nonce_word=int(d["nonce_word"]),
         is_block=bool(d["is_block"]),
         submitted_at=float(d["submitted_at"]),
+        algorithm=str(d.get("algorithm", "sha256d")),
+        block_number=int(d.get("block_number", 0)),
     )
 
 

@@ -1023,6 +1023,8 @@ class Sv2MiningServer:
             nonce_word=msg.nonce,
             is_block=is_block,
             submitted_at=time.time(),
+            algorithm=job.algorithm,
+            block_number=job.block_number,
         )
         # persist BEFORE the success frame (V1 server parity): an accept
         # the miner saw must be in the books exactly once, so a failing

@@ -45,6 +45,7 @@ Fault point registry (grep for ``faults.hit`` to verify):
     region.sever                                (pool/regions.py commit path; tag region id)
     ledger.flush                                (pool/manager.py on_share_batch, between chain and db commit)
     region.handoff                              (stratum/server.py resume verification; tag session id)
+    validation.verify                           (runtime/validate.py device verdict; tag algorithm)
     worker.crash                                (stratum/shard.py worker share-forward; tag worker id)
     pool.submitter.submit                       (pool/submitter.py retry loop)
     pool.failover.check                         (pool/failover.py; tag pool name)

@@ -52,6 +52,12 @@
 #                   time, and drain-bounded stop(); writes a
 #                   BENCH_DEGRADE json artifact and fails if quarantine
 #                   or reintegration never happened or stop() hung.
+#   validate-bench  opt-in share-validation bench: device-batched vs
+#                   host validated shares/s on identical batches per
+#                   algorithm tier (sha256d/scrypt/x11/ethash), with a
+#                   batch-size crossover probe; asserts device and host
+#                   verdicts bit-identical (exit 2 otherwise); writes a
+#                   BENCH_VALIDATE json artifact.
 #   engine-bench    opt-in live-engine throughput bench: drives the real
 #                   mining engine loop (pipelined dispatch, on-device
 #                   winner selection, share path) on the production
@@ -82,6 +88,9 @@ case "$tier" in
       --control \
       --pace "${STRATUM_BENCH_PACES:-1500,3000,4500,6500}" \
       --out "${STRATUM_BENCH_OUT:-BENCH_STRATUM_manual.json}" "$@" ;;
+  validate-bench)
+    exec env JAX_PLATFORMS=cpu python tools/bench_validate.py \
+      --out "${VALIDATE_BENCH_OUT:-BENCH_VALIDATE_manual.json}" "$@" ;;
   switch-bench)
     exec env JAX_PLATFORMS=cpu python tools/bench_switch.py \
       --out "${SWITCH_BENCH_OUT:-BENCH_SWITCH_manual.json}" "$@" ;;
@@ -104,5 +113,5 @@ case "$tier" in
   payout-bench)
     exec env JAX_PLATFORMS=cpu python tools/bench_payout.py \
       --out "${PAYOUT_BENCH_OUT:-BENCH_PAYOUT_manual.json}" "$@" ;;
-  *) echo "usage: $0 [fast|slow|all|audit|stratum-bench|stratum-shard-bench|switch-bench|degrade-bench|engine-bench|sharechain-bench|region-bench|payout-bench] [pytest args...]" >&2; exit 2 ;;
+  *) echo "usage: $0 [fast|slow|all|audit|stratum-bench|stratum-shard-bench|switch-bench|degrade-bench|engine-bench|validate-bench|sharechain-bench|region-bench|payout-bench] [pytest args...]" >&2; exit 2 ;;
 esac

@@ -1,0 +1,516 @@
+"""Device-batched share validation (ISSUE 12): verify kernels vs the
+host oracle on adversarial batches, the ValidationBackend's
+crossover/fallback/tripwire rails, producer wiring (PoolManager ledger
+batches, P2P gossip batches), the submission-id memoization seam, and
+the ethash epoch-cache registry under concurrency.
+"""
+
+import asyncio
+import hashlib
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from otedama_tpu.kernels import sha256_jax as sj
+from otedama_tpu.kernels import sha256_pallas as sp
+from otedama_tpu.kernels import target as tgt
+from otedama_tpu.runtime.validate import ShareCheck, ValidationBackend
+from otedama_tpu.utils import faults, pow_host
+
+
+def _sha256d(b: bytes) -> bytes:
+    return hashlib.sha256(hashlib.sha256(b).digest()).digest()
+
+
+def _headers(n: int, seed: int = 0) -> list[bytes]:
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 256, 80, dtype=np.uint8).tobytes()
+            for _ in range(n)]
+
+
+def _boundary_checks(headers, algorithm="sha256d", block_number=0):
+    """Adversarial per-share targets: exactly the digest value (pass),
+    one below (fail), comfortably above (pass). Returns (checks,
+    expected verdicts) against the host oracle."""
+    checks, expected = [], []
+    for i, h in enumerate(headers):
+        v = int.from_bytes(
+            pow_host.pow_digest(h, algorithm, block_number=block_number),
+            "little")
+        t = v if i % 3 == 0 else (v - 1 if i % 3 == 1 else v + 1)
+        checks.append(ShareCheck(h, t, algorithm, block_number))
+        expected.append(v <= t)
+    return checks, expected
+
+
+def _unpack_fails(buf, k):
+    offs, _, n, min_h0 = sp.unpack_winner_buffer(np.asarray(buf), k)
+    return set(int(o) for o in offs[:min(n, k)]), int(n), min_h0
+
+
+# -- the verify kernels vs the oracle ----------------------------------------
+
+
+def test_sha256d_verify_step_boundary_targets():
+    import jax.numpy as jnp
+
+    headers = _headers(37, seed=7)
+    vals = [int.from_bytes(_sha256d(h), "little") for h in headers]
+    targets = [v if i % 3 == 0 else (v - 1 if i % 3 == 1 else v + 1)
+               for i, v in enumerate(vals)]
+    exp_fails = {i for i, v in enumerate(vals) if v > targets[i]}
+    words = sj.headers_to_words(headers)
+    limbs = np.stack([tgt.target_to_limbs(t) for t in targets])
+    buf = sj.sha256d_verify_step(
+        jnp.asarray(words), jnp.asarray(limbs), jnp.uint32(36), n=37, k=16)
+    fails, n, min_h0 = _unpack_fails(buf, 16)
+    assert n == len(exp_fails) and fails == exp_fails
+    # best-hash telemetry: min top compare limb over in-range lanes
+    assert min_h0 == min(v >> 224 for v in vals)
+
+    # range clamp: the padding rows after `last` never count
+    words_p = np.pad(words, ((0, 11), (0, 0)))
+    limbs_p = np.pad(limbs, ((0, 11), (0, 0)))  # zero targets: all "fail"
+    buf = sj.sha256d_verify_step(
+        jnp.asarray(words_p), jnp.asarray(limbs_p), jnp.uint32(36),
+        n=48, k=16)
+    fails_p, n_p, _ = _unpack_fails(buf, 16)
+    assert (fails_p, n_p) == (fails, n)
+
+
+def test_sha256d_verify_pallas_twin_bit_identical():
+    """The Pallas verify kernel (interpret mode off-TPU) must emit the
+    EXACT buffer the jnp twin does — same failures, same telemetry."""
+    import jax.numpy as jnp
+
+    headers = _headers(23, seed=3)
+    vals = [int.from_bytes(_sha256d(h), "little") for h in headers]
+    targets = [v if i % 2 == 0 else v - 1 for i, v in enumerate(vals)]
+    words = sj.headers_to_words(headers)
+    limbs = np.stack([tgt.target_to_limbs(t) for t in targets])
+    jbuf = np.asarray(sj.sha256d_verify_step(
+        jnp.asarray(np.pad(words, ((0, 1024 - 23), (0, 0)))),
+        jnp.asarray(np.pad(limbs, ((0, 1024 - 23), (0, 0)))),
+        jnp.uint32(22), n=1024, k=8))
+    pbuf = np.asarray(sp.sha256d_verify_pallas(
+        words, limbs, 23, sub=8, k=8))
+    assert np.array_equal(jbuf, pbuf)
+    # empty batch: zero failures, sentinel telemetry
+    ebuf = np.asarray(sp.sha256d_verify_pallas(
+        np.zeros((0, 20), np.uint32), np.zeros((0, 8), np.uint32), 0,
+        sub=8, k=8))
+    assert int(ebuf[16]) == 0 and int(ebuf[18]) == 0xFFFFFFFF
+
+
+def test_scrypt_verify_step_vs_oracle():
+    import jax.numpy as jnp
+
+    from otedama_tpu.kernels import scrypt_jax as sc
+
+    headers = _headers(9, seed=5)
+    vals = [int.from_bytes(pow_host.scrypt_1024_1_1(h), "little")
+            for h in headers]
+    targets = [v if i % 3 == 0 else (v - 1 if i % 3 == 1 else v + 1)
+               for i, v in enumerate(vals)]
+    exp_fails = {i for i, v in enumerate(vals) if v > targets[i]}
+    words = sj.headers_to_words(headers)
+    limbs = np.stack([tgt.target_to_limbs(t) for t in targets])
+    buf = sc.scrypt_verify_step(
+        jnp.asarray(words), jnp.asarray(limbs), jnp.uint32(8), n=9, k=16)
+    fails, n, min_h0 = _unpack_fails(buf, 16)
+    assert n == len(exp_fails) and fails == exp_fails
+    assert min_h0 == min(v >> 224 for v in vals)
+
+
+def test_x11_verify_batch_vs_oracle():
+    from otedama_tpu.kernels import x11 as x11_mod
+
+    headers = _headers(6, seed=9)
+    vals = [int.from_bytes(x11_mod.x11_digest(h), "little")
+            for h in headers]
+    targets = [v if i % 3 == 0 else (v - 1 if i % 3 == 1 else v + 1)
+               for i, v in enumerate(vals)]
+    arr = np.stack([np.frombuffer(h, dtype=np.uint8) for h in headers])
+    verdicts, best = x11_mod.x11_verify_batch(arr, targets)
+    assert list(verdicts) == [v <= t for v, t in zip(vals, targets)]
+    assert best == min(v >> 224 for v in vals)
+
+
+def _miniature_ethash_epoch():
+    """Install a miniature epoch-0 cache into the pow_host registry so
+    BOTH the device verify path and the host oracle size ethash
+    identically (the registry is the single source of epoch caches)."""
+    from otedama_tpu.kernels import ethash as eth
+
+    cache = eth.make_cache(64 * eth.HASH_BYTES, eth.seed_hash(0))
+    full_size = 32 * eth.MIX_BYTES
+    pow_host._ETHASH_CACHES[0] = (full_size, cache)
+    return full_size, cache
+
+
+def test_ethash_verify_device_vs_oracle():
+    full_size, cache = _miniature_ethash_epoch()
+    try:
+        headers = _headers(7, seed=13)
+        checks, expected = _boundary_checks(headers, "ethash", 0)
+        vb = ValidationBackend(min_batch=1, tripwire_rate=0.3, seed=4)
+        got = asyncio.run(vb.verify_batch(checks))
+        assert got == expected
+        snap = vb.snapshot()
+        assert snap["device_batches"] == 1
+        assert snap["tripwire_mismatches"] == 0
+    finally:
+        pow_host._ETHASH_CACHES.pop(0, None)
+
+
+# -- the ValidationBackend rails ----------------------------------------------
+
+
+def test_backend_mixed_algorithms_bit_identical_to_oracle():
+    """One batch mixing sha256d and scrypt shares, Byzantine members
+    included: verdicts must equal the per-share host oracle's exactly,
+    and each algorithm group is one device dispatch."""
+    sha_checks, sha_exp = _boundary_checks(_headers(12, seed=21))
+    sc_checks, sc_exp = _boundary_checks(
+        _headers(6, seed=22), algorithm="scrypt")
+    checks = []
+    expected = []
+    for pair in zip(sha_checks + sc_checks[:6], sha_exp + sc_exp[:6]):
+        checks.append(pair[0])
+        expected.append(pair[1])
+    vb = ValidationBackend(min_batch=2, tripwire_rate=0.2, seed=6)
+    got = asyncio.run(vb.verify_batch(checks))
+    assert got == expected
+    snap = vb.snapshot()
+    assert snap["device_batches"] == 2  # one per algorithm group
+    assert snap["rejects"] == sum(1 for e in expected if not e)
+    assert snap["tripwire_mismatches"] == 0
+    assert snap["batch_size"]["count"] == 1
+
+
+def test_backend_crossover_and_device_absent():
+    checks, expected = _boundary_checks(_headers(5, seed=31))
+    vb = ValidationBackend(min_batch=64)  # batch under the crossover
+    got = asyncio.run(vb.verify_batch(checks))
+    assert got == expected
+    snap = vb.snapshot()
+    assert snap["device_batches"] == 0
+    assert snap["crossover_batches"] == 1
+    assert snap["host_batches"] == 1
+
+    # device disabled outright: host path, verdicts identical
+    vb2 = ValidationBackend(min_batch=1, device=False)
+    assert asyncio.run(vb2.verify_batch(checks)) == expected
+    assert vb2.snapshot()["device_batches"] == 0
+
+
+def test_backend_device_error_quarantines_and_falls_back():
+    checks, expected = _boundary_checks(_headers(8, seed=41))
+    inj = faults.FaultInjector(seed=1).error("validation.verify", once=True)
+    vb = ValidationBackend(min_batch=2, tripwire_rate=0.0,
+                           quarantine_seconds=3600.0)
+    with faults.active(inj):
+        got = asyncio.run(vb.verify_batch(checks))
+        assert got == expected          # fallback is exact
+        assert not vb.device_ok()       # quarantined
+        got2 = asyncio.run(vb.verify_batch(checks))
+        assert got2 == expected
+    snap = vb.snapshot()
+    assert snap["device_errors"] == 1
+    assert snap["host_batches"] == 2    # both batches host-validated
+
+
+def test_corrupt_device_verdict_caught_by_tripwire():
+    """The satellite's seeded scenario: a corrupted device verdict
+    (validation.verify corrupt action inverts every verdict) is caught
+    by the sampled host tripwire, the batch degrades to host validation
+    (verdicts stay bit-identical to the oracle), and the device path
+    quarantines."""
+    checks, expected = _boundary_checks(_headers(16, seed=51))
+    inj = faults.FaultInjector(seed=9).corrupt("validation.verify",
+                                               once=True)
+    vb = ValidationBackend(min_batch=2, tripwire_rate=0.1, seed=2,
+                           quarantine_seconds=3600.0)
+    with faults.active(inj):
+        got = asyncio.run(vb.verify_batch(checks))
+    assert got == expected
+    snap = vb.snapshot()
+    assert snap["tripwire_mismatches"] == 1
+    assert snap["host_batches"] == 1
+    assert not vb.device_ok()
+
+
+def test_failure_table_overflow_reverifies_on_host():
+    """More Byzantine members than k failure slots: the compact table
+    cannot name every failure, so the batch must re-verify on the host
+    — never trust a truncated table."""
+    headers = _headers(12, seed=61)
+    checks = []
+    expected = []
+    for i, h in enumerate(headers):
+        v = int.from_bytes(_sha256d(h), "little")
+        checks.append(ShareCheck(h, v - 1 if i % 2 else v))
+        expected.append(i % 2 == 0)
+    vb = ValidationBackend(min_batch=2, k=2, tripwire_rate=0.0)
+    got = asyncio.run(vb.verify_batch(checks))
+    assert got == expected
+    snap = vb.snapshot()
+    assert snap["overflows"] == 1
+    assert snap["host_batches"] == 1
+
+
+# -- producer wiring ----------------------------------------------------------
+
+
+def _make_accepted(i: int, *, corrupt: bool = False):
+    from otedama_tpu.stratum.server import AcceptedShare
+
+    header = struct.pack(">I", i) * 20
+    digest = _sha256d(header)
+    # difficulty chosen so the share genuinely meets its credited target
+    diff = tgt.target_to_difficulty(int.from_bytes(digest, "little")) * 0.5
+    if corrupt:
+        # a target the digest does NOT meet: the share should never
+        # have been accepted — Byzantine worker / bus corruption
+        diff = tgt.target_to_difficulty(int.from_bytes(digest, "little")) * 4
+    return AcceptedShare(
+        session_id=i, worker_user=f"w.{i}", job_id="j1",
+        difficulty=diff, actual_difficulty=diff, digest=digest,
+        header=header, extranonce2=struct.pack(">I", i),
+        ntime=1_700_000_000, nonce_word=i, is_block=False,
+        submitted_at=1_700_000_000.0,
+    )
+
+
+def test_pool_manager_batch_validation_rejects_only_offender():
+    from otedama_tpu.db import connect_database
+    from otedama_tpu.pool.blockchain import MockChainClient
+    from otedama_tpu.pool.manager import PoolManager
+
+    pm = PoolManager(connect_database(":memory:"), MockChainClient())
+    pm.validator = ValidationBackend(min_batch=1, tripwire_rate=0.0)
+    batch = [_make_accepted(1), _make_accepted(2, corrupt=True),
+             _make_accepted(3)]
+    outcomes = asyncio.run(pm.on_share_batch(batch))
+    assert outcomes[0] == ("ok", "")
+    assert outcomes[2] == ("ok", "")
+    assert outcomes[1][0] == "err" and "validation" in outcomes[1][1]
+    # only the two valid shares reached the books
+    assert pm.shares.count() == 2
+    assert pm.validator.snapshot()["rejects"] == 1
+
+
+def test_p2p_batch_verification_matches_per_share_path():
+    """submit_share_batch with a validator links exactly what the
+    per-share executor path would, and a Byzantine member (PoW below
+    its claimed target) still rejects the batch."""
+    from otedama_tpu.p2p import sharechain
+    from otedama_tpu.p2p.pool import P2PPool
+    from otedama_tpu.p2p.sharechain import GENESIS, ShareInvalid
+
+    from otedama_tpu.p2p.sharechain import ChainParams
+
+    async def run():
+        pool = P2PPool(params=ChainParams(min_difficulty=1e-6))
+        pool.validator = ValidationBackend(min_batch=1, tripwire_rate=0.0)
+        prev = GENESIS
+        shares = []
+        for i in range(4):
+            s = sharechain.mine_share(prev, f"w{i}", f"job{i}", 1e-6)
+            shares.append(s)
+            prev = s.share_id
+        statuses = await pool.submit_share_batch(shares)
+        assert statuses == ["accepted"] * 4
+        assert pool.chain.tip == shares[-1].share_id
+        assert pool.validator.snapshot()["device_batches"] == 1
+
+        # Byzantine member: flip a nonce byte so the PoW no longer
+        # meets the claimed target — the batch must reject
+        bad = sharechain.mine_share(prev, "evil", "jobX", 1e-6)
+        raw = bytearray(bad.header)
+        raw[76] ^= 0xFF
+        forged = sharechain.Share.from_payload({
+            **bad.to_payload(), "header": bytes(raw).hex(),
+        })
+        try:
+            ok = True
+            await pool.submit_share_batch([forged])
+        except ShareInvalid as e:
+            ok = False
+            assert e.reason in ("pow", "commitment")
+        assert not ok
+    asyncio.run(run())
+
+
+def test_submission_id_reuses_judged_digest():
+    """The memoization seam: sha256d shares thread their validation
+    digest through AcceptedShare, so commit_batch derives submission
+    ids without re-hashing — sha256d_batch sees ZERO sha256d shares."""
+    from otedama_tpu.p2p.pool import P2PPool
+    from otedama_tpu.pool import regions as regions_mod
+    from otedama_tpu.pool.regions import RegionConfig, RegionReplicator
+
+    hashed = []
+    real_batch = regions_mod.sha256d_batch
+
+    def spy(items):
+        hashed.extend(items)
+        return real_batch(items)
+
+    from otedama_tpu.p2p.sharechain import ChainParams
+
+    async def run():
+        pool = P2PPool(params=ChainParams(min_difficulty=1e-6))
+        rep = RegionReplicator(pool, RegionConfig(region_id=0, regions=(0,)))
+        batch = [_make_accepted(i) for i in range(1, 4)]
+        outcomes = await rep.commit_batch(batch)
+        assert outcomes == [None, None, None]
+        # every pending tag is the sha256d(header) identity — derived
+        # from the THREADED digest, with zero re-hashing
+        for s in batch:
+            assert _sha256d(s.header).hex()[:24] in rep._pending
+        assert hashed == []
+
+        # a non-sha256d share cannot reuse its digest (scrypt digest !=
+        # submission id): it must go through the hash pass
+        import dataclasses
+
+        other = dataclasses.replace(_make_accepted(9), algorithm="scrypt")
+        assert (await rep.commit_batch([other])) == [None]
+        assert hashed == [other.header]
+    regions_mod.sha256d_batch = spy
+    try:
+        asyncio.run(run())
+    finally:
+        regions_mod.sha256d_batch = real_batch
+
+
+def test_accepted_share_wire_carries_algorithm_and_height():
+    from otedama_tpu.stratum import shard
+
+    import dataclasses
+
+    s = dataclasses.replace(_make_accepted(5), algorithm="scrypt",
+                            block_number=123456)
+    frame = shard.encode_share_frame(11, s)
+    seq, decoded = shard.decode_share_frame(frame[4:])
+    assert seq == 11
+    assert decoded == s
+    assert shard.share_from_wire(shard.share_to_wire(s)) == s
+
+
+# -- pow_host epoch-cache registry (satellite) --------------------------------
+
+
+def test_epoch_cache_pruning_keeps_two_newest():
+    saved = dict(pow_host._ETHASH_CACHES)
+    pow_host._ETHASH_CACHES.clear()
+    try:
+        with pow_host._ETHASH_LOCK:
+            for epoch in (3, 7, 5, 9):
+                pow_host._ETHASH_CACHES[epoch] = (epoch, object())
+                pow_host._prune_caches_locked()
+        assert sorted(pow_host._ETHASH_CACHES) == [7, 9]
+    finally:
+        pow_host._ETHASH_CACHES.clear()
+        pow_host._ETHASH_CACHES.update(saved)
+
+
+def test_register_epoch_cache_refuses_noncanonical_sizing():
+    from otedama_tpu.kernels import ethash as eth
+
+    saved = dict(pow_host._ETHASH_CACHES)
+    pow_host._ETHASH_CACHES.clear()
+    try:
+        mini = eth.make_cache(64 * eth.HASH_BYTES, eth.seed_hash(0))
+        # miniature sizing: refused (the registry is real-chain-keyed)
+        assert not pow_host.register_epoch_cache(
+            0, 32 * eth.MIX_BYTES, mini)
+        assert 0 not in pow_host._ETHASH_CACHES
+        # wrong full_size against a real cache row count: refused
+        rows = eth.cache_size(0) // eth.HASH_BYTES
+        fake = np.zeros((rows, 16), dtype=np.uint32)
+        assert not pow_host.register_epoch_cache(
+            0, eth.dataset_size(0) + eth.MIX_BYTES, fake)
+        # canonical sizing: adopted exactly once
+        assert pow_host.register_epoch_cache(0, eth.dataset_size(0), fake)
+        assert pow_host._ETHASH_CACHES[0][1] is fake
+        other = np.zeros((rows, 16), dtype=np.uint32)
+        assert pow_host.register_epoch_cache(0, eth.dataset_size(0), other)
+        assert pow_host._ETHASH_CACHES[0][1] is fake  # first donation wins
+    finally:
+        pow_host._ETHASH_CACHES.clear()
+        pow_host._ETHASH_CACHES.update(saved)
+
+
+def test_epoch_cache_concurrent_builders_build_once():
+    """N threads racing _epoch_cache for one absent epoch: exactly one
+    build runs (the builder event gate), every thread gets the same
+    cache object, and validation against it is consistent."""
+    saved = dict(pow_host._ETHASH_CACHES)
+    pow_host._ETHASH_CACHES.clear()
+    builds = []
+    real_make = None
+    from otedama_tpu.kernels import ethash as eth
+
+    real_make = eth.make_cache
+
+    def counting_make(size, seed):
+        builds.append(size)
+        return real_make(64 * eth.HASH_BYTES, seed)
+
+    eth.make_cache = counting_make
+    try:
+        results = []
+        threads = [
+            threading.Thread(
+                target=lambda: results.append(pow_host._epoch_cache(0)))
+            for _ in range(6)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(builds) == 1
+        assert len(results) == 6
+        assert all(r[1] is results[0][1] for r in results)
+    finally:
+        eth.make_cache = real_make
+        pow_host._ETHASH_CACHES.clear()
+        pow_host._ETHASH_CACHES.update(saved)
+
+
+def test_validation_config_knobs():
+    from otedama_tpu.config.schema import AppConfig, validate_config
+
+    cfg = AppConfig()
+    cfg.validation.enabled = True
+    errors = validate_config(cfg)
+    assert any("validation.enabled requires" in e for e in errors)
+    cfg.pool.enabled = True
+    cfg.validation.tripwire_rate = 1.5
+    cfg.validation.min_batch = 0
+    cfg.validation.x11_chain = "cuda"
+    errors = validate_config(cfg)
+    assert any("tripwire_rate" in e for e in errors)
+    assert any("min_batch" in e for e in errors)
+    assert any("x11_chain" in e for e in errors)
+
+
+def test_validation_metrics_export():
+    from otedama_tpu.api.server import ApiServer
+
+    checks, _ = _boundary_checks(_headers(8, seed=71))
+    vb = ValidationBackend(min_batch=2, tripwire_rate=0.2, seed=3)
+    asyncio.run(vb.verify_batch(checks))
+    api = ApiServer()
+    api.sync_validation_metrics(vb)
+    text = api.registry.render()
+    assert 'otedama_validation_shares_total{path="device"}' in text
+    assert "otedama_validation_batch_size_bucket" in text
+    assert 'otedama_validation_seconds_bucket{le="0.001",path="device"}' in text \
+        or 'otedama_validation_seconds_bucket{le="0.001",path="host"}' in text \
+        or "otedama_validation_seconds_sum" in text
+    assert "otedama_validation_executor_queue_depth" in text

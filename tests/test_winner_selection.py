@@ -370,3 +370,139 @@ def test_pallas_interpret_k_overflow():
     assert sorted(w.nonce_word for w in res.winners) == sorted(
         w for w, v in vals.items() if v <= target
     )
+
+
+# -- x11 / ethash winner-buffer parity (ISSUE 12) -----------------------------
+
+
+def _fake_x11(headers):
+    """Cheap header-dependent device chain stand-in (see
+    test_runtime.test_x11_pod_search_cpu_mesh)."""
+    import jax.numpy as jnp
+
+    h = headers.astype(jnp.uint32)
+    folded = (h[:, :32] * 3 + h[:, 32:64] * 5 + h[:, 48:80] * 7)
+    return (folded & 0xFF).astype(jnp.uint8)
+
+
+def _fake_x11_digest(header80: bytes) -> bytes:
+    h = np.frombuffer(header80, dtype=np.uint8).astype(np.uint32)
+    return bytes(((h[:32] * 3 + h[32:64] * 5 + h[48:80] * 7) & 0xFF)
+                 .astype(np.uint8))
+
+
+def test_x11_pod_winner_buffer_overflow_rescan():
+    """x11 pod with a tiny winner table: the per-chip buffer reports the
+    true count past K and the oracle rescan of THAT chip's window
+    recovers the exact winner set — overflow semantics identical to the
+    sha256d/scrypt pods. Also checks the psum'd pod winner count."""
+    import jax
+
+    from otedama_tpu.kernels import x11 as x11_mod
+    from otedama_tpu.runtime.mesh import X11PodSearch, make_pod_mesh
+
+    mesh = make_pod_mesh(jax.devices(), n_hosts=2)
+    pod = X11PodSearch(mesh, chain_fn=_fake_x11, chunk=8, winner_depth=2)
+    orig = x11_mod.x11_digest
+    x11_mod.x11_digest = _fake_x11_digest
+    try:
+        h0 = bytes(range(64)) + struct.pack(">3I", 0xA1, 0xB2, 0xC3)
+        h1 = bytes(range(64)) + struct.pack(">3I", 0xD4, 0xE5, 0xF6)
+        base, count = 10, 30  # mid-window count: last chip clamps
+        vals = {
+            n: int.from_bytes(
+                _fake_x11_digest(h0 + struct.pack(">I", n)), "little")
+            for n in range(base, base + count)
+        }
+        target = sorted(vals.values())[7]  # 8 winners > K=2 per chip
+        jc0 = JobConstants.from_header_prefix(h0, target)
+        jc1 = JobConstants.from_header_prefix(h1, target)
+        r0, r1 = pod.search_jobs([jc0, jc1], base, count)
+        expect0 = sorted(n for n, v in vals.items() if v <= target)
+        assert sorted(w.nonce_word for w in r0.winners) == expect0
+        for w in r0.winners:
+            assert w.digest == _fake_x11_digest(jc0.header_for(w.nonce_word))
+        expect1 = sorted(
+            n for n in range(base, base + count)
+            if int.from_bytes(
+                _fake_x11_digest(h1 + struct.pack(">I", n)), "little")
+            <= target
+        )
+        assert sorted(w.nonce_word for w in r1.winners) == expect1
+        # best-hash telemetry clamps to the requested window
+        assert r0.best_hash_hi == min(v >> 224 for v in vals.values())
+    finally:
+        x11_mod.x11_digest = orig
+
+
+def test_ethash_device_winner_buffer_matches_dense():
+    """EthashLightBackend device search now reads the compact K-slot
+    buffer per chunk (no dense result transfer): winners, digests and
+    best-hash telemetry must equal the host (device=False) dense tier
+    bit-for-bit, and a K overflow must recover via the dense fallback."""
+    from otedama_tpu.kernels import ethash as eth
+    from otedama_tpu.runtime.search import EthashLightBackend
+
+    kwargs = dict(cache_rows=64, full_pages=32, chunk=16)
+    host = EthashLightBackend(device=False, **kwargs)
+    dev = EthashLightBackend(device=True, **kwargs)
+    header76 = bytes(range(64)) + struct.pack(">3I", 0x77, 0x88, 0x99)
+    probe = JobConstants.from_header_prefix(header76, 1)
+    hh = eth.keccak256(header76)
+    vals = {}
+    for n in range(40):
+        _, res = eth.hashimoto_light(host.full_size, host.cache, hh, n)
+        vals[n] = int.from_bytes(res[::-1], "little")
+    target = sorted(vals.values())[4]  # 5 winners over the window
+    jc = JobConstants.from_header_prefix(header76, target)
+    r_host = host.search(jc, 0, 40)
+    r_dev = dev.search(jc, 0, 40)
+    expect = sorted(n for n, v in vals.items() if v <= target)
+    assert sorted(w.nonce_word for w in r_dev.winners) == expect
+    assert sorted(w.nonce_word for w in r_host.winners) == expect
+    assert {w.nonce_word: w.digest for w in r_dev.winners} == {
+        w.nonce_word: w.digest for w in r_host.winners}
+    assert r_dev.best_hash_hi == r_host.best_hash_hi
+
+    # K overflow (winner_depth=2 < 5 winners in one 16-lane chunk):
+    # dense fallback recovers the exact set
+    tight = EthashLightBackend(device=True, winner_depth=2, **kwargs)
+    easy = sorted(vals[n] for n in range(16))[7]  # 8 winners, chunk 0
+    jc2 = JobConstants.from_header_prefix(header76, easy)
+    r2 = tight.search(jc2, 0, 16)
+    assert sorted(w.nonce_word for w in r2.winners) == sorted(
+        n for n in range(16) if vals[n] <= easy)
+
+
+@pytest.mark.slow
+def test_x11_jax_backend_winner_buffer_real_chain():
+    """The REAL device chain through the new X11JaxBackend winner-buffer
+    path (minutes of XLA compile — slow tier): winners and digests must
+    match the independent numpy oracle chain exactly, and a K overflow
+    must fall back to the dense scan."""
+    from otedama_tpu.kernels import x11 as x11_mod
+    from otedama_tpu.runtime.search import X11JaxBackend
+
+    header76 = bytes(range(64)) + struct.pack(">3I", 0x31, 0x42, 0x53)
+    vals = {
+        n: int.from_bytes(
+            x11_mod.x11_digest(header76 + struct.pack(">I", n)), "little")
+        for n in range(8)
+    }
+    target = sorted(vals.values())[3]  # 4 winners
+    jc = JobConstants.from_header_prefix(header76, target)
+    backend = X11JaxBackend(chunk=4)
+    res = backend.search(jc, 0, 8)
+    expect = sorted(n for n, v in vals.items() if v <= target)
+    assert sorted(w.nonce_word for w in res.winners) == expect
+    for w in res.winners:
+        assert w.digest == x11_mod.x11_digest(jc.header_for(w.nonce_word))
+    assert res.best_hash_hi == min(v >> 224 for v in vals.values())
+
+    # K overflow -> dense fallback, same chain program (chunk=4 reused)
+    tight = X11JaxBackend(chunk=4, winner_depth=1)
+    easy = sorted(vals[n] for n in range(4))[2]  # 3 winners in chunk 0
+    res2 = tight.search(
+        JobConstants.from_header_prefix(header76, easy), 0, 4)
+    assert sorted(w.nonce_word for w in res2.winners) == sorted(
+        n for n in range(4) if vals[n] <= easy)

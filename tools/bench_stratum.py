@@ -727,7 +727,8 @@ def _diff_quantile(before: tuple, after: tuple, q: float) -> float:
 async def run_leg(connections: int, shares_per_conn: int, window: float,
                   workers: int, connect_rate: float,
                   remote_miners: bool | None = None,
-                  paces: list[float] | None = None) -> dict:
+                  paces: list[float] | None = None,
+                  validate: bool = False) -> dict:
     """One full soak leg (either serving mode) with PoolManager
     accounting; returns metrics + the per-worker books for cross-leg
     comparison. ``remote_miners`` (default: on for multi-worker runs
@@ -743,6 +744,13 @@ async def run_leg(connections: int, shares_per_conn: int, window: float,
     the artifact instead of one operating point. The leg's headline
     numbers are then the best sustained phase's."""
     pool = _make_ledger()
+    if validate:
+        # device-batched re-validation on the ledger flush path
+        # (runtime/validate.py): the pace sweep's knee then reflects
+        # device validation in the end-to-end accept pipeline
+        from otedama_tpu.runtime.validate import ValidationBackend
+
+        pool.validator = ValidationBackend(tripwire_rate=0.02)
     hook_count = 0
 
     async def on_share(s):
@@ -914,6 +922,8 @@ async def run_leg(connections: int, shares_per_conn: int, window: float,
         }
         result["bus"] = snap_stats.get("bus", {})
         result["ledger"] = snap_stats.get("ledger", {})
+    if pool.validator is not None:
+        result["validation"] = pool.validator.snapshot()
     await server.stop()
     pool.db.close()
     return result, split, per_worker_db
@@ -921,10 +931,11 @@ async def run_leg(connections: int, shares_per_conn: int, window: float,
 
 async def run_bench(connections: int, shares_per_conn: int, window: float,
                     workers: int, connect_rate: float,
-                    control: bool, paces: list[float] | None = None) -> dict:
+                    control: bool, paces: list[float] | None = None,
+                    validate: bool = False) -> dict:
     result, split, books = await run_leg(
         connections, shares_per_conn, window, workers, connect_rate,
-        paces=paces)
+        paces=paces, validate=validate)
     if control and workers > 1:
         # single-process control: the IDENTICAL workload through the
         # proven r06 path — fan-out must not change the books. The
@@ -967,6 +978,11 @@ def main() -> None:
                          "vs server p99 lands in the artifact's "
                          "pace_sweep (the knee of the curve, not one "
                          "operating point)")
+    ap.add_argument("--validate", action="store_true",
+                    help="attach the device-batched ValidationBackend to "
+                         "the ledger flush path so the pace sweep's knee "
+                         "reflects device validation end-to-end (the "
+                         "control leg stays host-only)")
     ap.add_argument("--out", default="BENCH_STRATUM_manual.json")
     args = ap.parse_args()
     paces = [float(p) for p in args.pace.split(",") if p.strip()] or None
@@ -989,6 +1005,7 @@ def main() -> None:
     result = asyncio.run(run_bench(
         args.connections, args.shares, args.window, args.workers,
         args.connect_rate, args.control, paces=paces,
+        validate=args.validate,
     ))
     if harness is not None:
         result["harness_echo_rt_per_sec"] = harness

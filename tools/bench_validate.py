@@ -1,0 +1,178 @@
+"""Validated shares/s: device-batched vs host on IDENTICAL batches.
+
+Measures the device validation path (runtime/validate.py) against the
+per-share host oracle (``pow_host.pow_digest`` on the validation
+executor) for every algorithm tier, on the same share batches, and
+asserts the verdicts are bit-identical — the artifact is only worth
+committing if the speedup costs zero correctness.
+
+Methodology (same discipline as BENCH_ENGINE_r11):
+
+- the device leg warms its compiled program first (one throwaway batch)
+  so the committed rate is steady-state dispatch, not XLA compile;
+- both legs validate the SAME checks (mixed pass/fail at boundary
+  targets), repeats interleaved, median-of-runs committed;
+- on a host with no accelerator the "device" leg runs on the jax CPU
+  backend — the committed ratio is then the STRUCTURAL one (batched
+  one-dispatch pipeline vs per-share host hashing) and the artifact
+  says so; re-run on TPU hardware for the real knee;
+- a crossover probe times both legs across batch sizes so
+  ``validation.min_batch`` is a measured knob, not a guess.
+
+Exit 2 on any device/host verdict mismatch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import statistics
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from otedama_tpu.runtime.validate import ShareCheck, ValidationBackend  # noqa: E402
+from otedama_tpu.utils import pow_host                     # noqa: E402
+
+
+def _checks(algorithm: str, n: int, seed: int,
+            block_number: int = 0) -> tuple[list[ShareCheck], list[bool]]:
+    """n shares with boundary targets: most pass at exactly their digest
+    value, every 8th fails by one — verdicts are non-trivial both ways."""
+    rng = np.random.default_rng(seed)
+    checks, expected = [], []
+    for i in range(n):
+        h = rng.integers(0, 256, 80, dtype=np.uint8).tobytes()
+        v = int.from_bytes(
+            pow_host.pow_digest(h, algorithm, block_number=block_number),
+            "little")
+        t = v - 1 if i % 8 == 7 else v
+        checks.append(ShareCheck(h, t, algorithm, block_number))
+        expected.append(v <= t)
+    return checks, expected
+
+
+async def _time_leg(backend: ValidationBackend, checks, repeats: int):
+    """Median wall seconds per verify_batch call over ``repeats``."""
+    times = []
+    verdicts = None
+    for _ in range(repeats):
+        t0 = time.monotonic()
+        verdicts = await backend.verify_batch(checks)
+        times.append(time.monotonic() - t0)
+    return statistics.median(times), verdicts
+
+
+async def bench_algorithm(algorithm: str, n: int, repeats: int,
+                          block_number: int = 0) -> dict:
+    print(f"[bench_validate] {algorithm}: building {n} checks...",
+          file=sys.stderr, flush=True)
+    checks, expected = _checks(algorithm, n, seed=42,
+                               block_number=block_number)
+    print(f"[bench_validate] {algorithm}: timing legs...",
+          file=sys.stderr, flush=True)
+    device = ValidationBackend(min_batch=1, tripwire_rate=0.0)
+    host = ValidationBackend(device=False)
+    # warm the device program (compile excluded from the timed runs)
+    await device.verify_batch(checks[: min(n, 8)])
+    dev_s, dev_verdicts = await _time_leg(device, checks, repeats)
+    host_s, host_verdicts = await _time_leg(host, checks, repeats)
+    ok = dev_verdicts == host_verdicts == expected
+    snap = device.snapshot()
+    return {
+        "batch": n,
+        "device_shares_per_sec": round(n / dev_s, 1),
+        "host_shares_per_sec": round(n / host_s, 1),
+        "speedup": round(host_s / dev_s, 3),
+        "verdicts_bit_identical": ok,
+        "rejects_per_batch": sum(1 for e in expected if not e),
+        "device_path_used": snap["device_batches"] > 0,
+    }
+
+
+async def crossover_probe(repeats: int) -> list[dict]:
+    """Per-share cost of each leg across batch sizes: where the device
+    dispatch starts winning is the measured ``validation.min_batch``."""
+    out = []
+    for size in (8, 32, 128, 512):
+        checks, _ = _checks("sha256d", size, seed=7)
+        device = ValidationBackend(min_batch=1, tripwire_rate=0.0)
+        host = ValidationBackend(device=False)
+        await device.verify_batch(checks[: min(size, 8)])  # warm shape
+        dev_s, _ = await _time_leg(device, checks, repeats)
+        host_s, _ = await _time_leg(host, checks, repeats)
+        out.append({
+            "batch": size,
+            "device_us_per_share": round(1e6 * dev_s / size, 2),
+            "host_us_per_share": round(1e6 * host_s / size, 2),
+            "device_wins": dev_s < host_s,
+        })
+    return out
+
+
+async def run(args) -> dict:
+    from otedama_tpu.kernels import ethash as eth
+
+    result: dict = {"algorithms": {}}
+    result["algorithms"]["sha256d"] = await bench_algorithm(
+        "sha256d", args.sha256d_batch, args.repeats)
+    result["algorithms"]["scrypt"] = await bench_algorithm(
+        "scrypt", args.scrypt_batch, max(1, args.repeats // 2))
+    result["algorithms"]["x11"] = await bench_algorithm(
+        "x11", args.x11_batch, args.repeats)
+    # ethash: a miniature epoch keyed into the pow_host registry so the
+    # device path and the host oracle size identically WITHOUT a
+    # multi-minute real-chain cache build on the sandbox (flagged)
+    cache = eth.make_cache(64 * eth.HASH_BYTES, eth.seed_hash(0))
+    pow_host._ETHASH_CACHES[0] = (32 * eth.MIX_BYTES, cache)
+    try:
+        result["algorithms"]["ethash"] = await bench_algorithm(
+            "ethash", args.ethash_batch, max(1, args.repeats // 2))
+        result["algorithms"]["ethash"]["miniature_epoch"] = True
+    finally:
+        pow_host._ETHASH_CACHES.pop(0, None)
+    result["crossover_sha256d"] = await crossover_probe(args.repeats)
+    return result
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--sha256d-batch", type=int, default=2048)
+    ap.add_argument("--scrypt-batch", type=int, default=128)
+    ap.add_argument("--x11-batch", type=int, default=128)
+    ap.add_argument("--ethash-batch", type=int, default=128)
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--out", default="BENCH_VALIDATE_manual.json")
+    args = ap.parse_args()
+
+    import jax
+
+    result = asyncio.run(run(args))
+    result["bench"] = "device_batched_share_validation"
+    result["jax_backend"] = jax.default_backend()
+    result["structural_note"] = (
+        "no accelerator visible: the device leg ran the batched jnp "
+        "pipeline on the jax CPU backend, so ratios are structural "
+        "(one dispatch per batch vs one host hash per share); re-run "
+        "on TPU for hardware rates"
+    ) if result["jax_backend"] == "cpu" else ""
+    result["timestamp"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(result, indent=2, sort_keys=True))
+    bad = [a for a, r in result["algorithms"].items()
+           if not r["verdicts_bit_identical"]]
+    if bad:
+        print(f"FATAL: device/host verdict mismatch for {bad}",
+              file=sys.stderr)
+        sys.exit(2)
+
+
+if __name__ == "__main__":
+    main()
