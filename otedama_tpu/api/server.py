@@ -697,6 +697,65 @@ class ApiServer:
                         snapshot.get("wallet_duplicates_avoided", 0),
                         help_="Re-submitted batches deduplicated by idempotency key")
 
+    def sync_chain_metrics(self, chain: dict) -> None:
+        """Durable share-chain health from a ShareChain snapshot (the
+        ``chain`` sub-dict of the P2P snapshot): the memory bound (tail
+        vs archived), the durability gap (persist lag = best-chain
+        events a kill -9 right now would lose), segment/snapshot
+        pressure, and the boot replay cost."""
+        reg = self.registry
+        reg.gauge_set("otedama_chain_archived_height",
+                      chain.get("archived_height", 0),
+                      help_="Best-chain positions archived out of memory")
+        reg.gauge_set("otedama_chain_tail_shares", chain.get("tail", 0),
+                      help_="Best-chain positions held in memory")
+        reg.gauge_set("otedama_chain_window_workers",
+                      chain.get("acc_workers", 0),
+                      help_="Workers in the incremental PPLNS window accumulator")
+        reg.counter_set("otedama_chain_persist_failures_total",
+                        chain.get("persist_failures", 0),
+                        help_="Chain persistence operations that failed "
+                              "(chain served on, durability degraded)")
+        store = chain.get("store")
+        if not store:
+            return
+        reg.gauge_set("otedama_chain_persist_lag", store.get("persist_lag", 0),
+                      help_="Best-chain events linked but not yet fsynced "
+                            "(lost by a crash right now; peers restore them)")
+        reg.gauge_set("otedama_chain_snapshot_age_seconds",
+                      store.get("snapshot_age_seconds", -1),
+                      help_="Seconds since the last chain snapshot (-1 = none)")
+        reg.gauge_set("otedama_chain_snapshot_height",
+                      store.get("snapshot_height", -1),
+                      help_="Archived boundary of the last chain snapshot")
+        reg.gauge_set("otedama_chain_replay_seconds",
+                      store.get("replay_seconds", 0.0),
+                      help_="Journal replay duration of the last cold boot")
+        reg.counter_set("otedama_chain_replayed_records_total",
+                        store.get("replayed_records", 0),
+                        help_="Journal events replayed on the last cold boot")
+        reg.counter_set("otedama_chain_snapshot_failures_total",
+                        store.get("snapshot_failures", 0),
+                        help_="Chain snapshots refused or lost")
+        for kind in ("journal", "archive"):
+            log_ = store.get(kind, {})
+            labels = {"log": kind}
+            reg.gauge_set("otedama_chain_segments", log_.get("segments", 0),
+                          labels=labels,
+                          help_="Chain store segment files, by log")
+            reg.gauge_set("otedama_chain_segment_bytes", log_.get("bytes", 0),
+                          labels=labels,
+                          help_="Chain store bytes on disk, by log")
+            reg.counter_set("otedama_chain_appends_total",
+                            log_.get("appends", 0), labels=labels,
+                            help_="Records appended, by log")
+            reg.counter_set("otedama_chain_fsyncs_total",
+                            log_.get("fsyncs", 0), labels=labels,
+                            help_="Batched fsyncs performed, by log")
+            reg.counter_set("otedama_chain_torn_records_total",
+                            log_.get("torn_records", 0), labels=labels,
+                            help_="Torn/corrupt records detected at replay")
+
     def sync_validation_metrics(self, validator) -> None:
         """Device-batched share-validation health (runtime/validate.py
         ValidationBackend): the device/host split, the batch-size shape

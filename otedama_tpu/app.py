@@ -596,6 +596,21 @@ class Application:
             host, _, port = str(entry).rpartition(":")
             if host:
                 bootstrap.append((host, int(port)))
+        store = None
+        if cfg.chain_dir:
+            # durable share chain: WAL segments + settled archive +
+            # snapshots under chain_dir; the node cold-boots from them
+            # below, BEFORE joining the overlay, so locator sync only
+            # covers what a crash cut off past the last durable record
+            from otedama_tpu.p2p.chainstore import ChainStore, ChainStoreConfig
+
+            store = ChainStore(ChainStoreConfig(
+                path=cfg.chain_dir,
+                segment_bytes=cfg.chain_segment_bytes,
+                fsync_interval=cfg.chain_fsync_interval,
+                snapshot_interval=cfg.chain_snapshot_interval,
+                tail_shares=cfg.chain_tail_shares,
+            ))
         self.p2p = P2PPool(
             NodeConfig(
                 host=cfg.host, port=cfg.port, max_peers=cfg.max_peers,
@@ -613,7 +628,16 @@ class Application:
                 share_interval=cfg.share_interval,
                 sync_page=cfg.sync_page,
             ),
+            store=store,
         )
+        if store is not None:
+            info = self.p2p.chain.load()
+            log.info(
+                "share chain restored from %s: height %d via %s "
+                "(%d events replayed in %.3fs)", cfg.chain_dir,
+                info["height"], info["source"],
+                info["replayed"] + info["reorgs_replayed"], info["seconds"],
+            )
         await self.p2p.start()
         self._started.append(self.p2p)
 
@@ -647,6 +671,20 @@ class Application:
             sc.duplicate_checker = self.regions.seen_submission
         if self.pool is not None:
             self.pool.replicator = self.regions
+        if self.p2p.chain.store is not None and self.p2p.chain.height:
+            # cold boot: the dedup index died with the old process —
+            # rebuild it from chain replay (archived segments included)
+            # before the front-end accepts its first share, or replayed
+            # submissions would double-count. A corrupt archived record
+            # degrades the index (logged) rather than wedging startup:
+            # an unbootable node protects nothing
+            try:
+                walked = self.regions.rebuild_index()
+                log.info("region dedup index rebuilt from %d replayed "
+                         "chain shares", walked)
+            except Exception:
+                log.exception("region dedup index rebuild incomplete "
+                              "(duplicate detection degraded)")
         await self.regions.start()
         self._started.append(self.regions)
 
@@ -1043,7 +1081,9 @@ class Application:
             if self.server is not None or self.server_v2 is not None:
                 self.api.sync_pool_server_metrics(self.server, self.server_v2)
             if self.p2p is not None:
-                self.api.sync_p2p_metrics(self.p2p.snapshot())
+                snap = self.p2p.snapshot()
+                self.api.sync_p2p_metrics(snap)
+                self.api.sync_chain_metrics(snap.get("chain", {}))
             if self.regions is not None:
                 self.api.sync_region_metrics(
                     self.regions.snapshot(),

@@ -263,6 +263,26 @@ class P2PConfig:
     # shares per locator-sync response page (bounded catch-up after
     # partitions; clamped to the wire MAX_SYNC_PAGE)
     sync_page: int = 200
+    # -- durable chain store (p2p/chainstore.py) -----------------------------
+    # directory for WAL segments + settled archive + snapshots; empty =
+    # in-memory only (a reboot forfeits the window and re-syncs from
+    # peers — the pre-persistence behavior)
+    chain_dir: str = ""
+    # journal appends per fsync: 1 = every best-chain event durable
+    # before the next (slowest, zero persist lag); larger batches trade
+    # a bounded crash-loss window (visible as otedama_chain_persist_lag)
+    # for connect throughput
+    chain_fsync_interval: int = 64
+    # segment file rotation threshold, bytes
+    chain_segment_bytes: int = 8 << 20
+    # write a snapshot each time the archived boundary advances this
+    # many shares (bounds cold-boot replay to ~this + max_reorg_depth)
+    chain_snapshot_interval: int = 8192
+    # in-memory best-chain tail, shares: settled positions beyond it are
+    # archived out of RAM. THIS is what lets pplns_window reach millions
+    # of shares with flat memory — the window is an incremental
+    # accumulator, not a resident walk.
+    chain_tail_shares: int = 16384
 
 
 @dataclasses.dataclass
@@ -531,6 +551,17 @@ def validate_config(cfg: AppConfig) -> list[str]:
         errors.append("p2p.share_interval must be positive")
     if cfg.p2p.sync_page < 1:
         errors.append("p2p.sync_page must be >= 1")
+    if cfg.p2p.chain_fsync_interval < 1:
+        errors.append("p2p.chain_fsync_interval must be >= 1")
+    if cfg.p2p.chain_segment_bytes < 4096:
+        errors.append("p2p.chain_segment_bytes must be >= 4096")
+    if cfg.p2p.chain_snapshot_interval < 1:
+        errors.append("p2p.chain_snapshot_interval must be >= 1")
+    if cfg.p2p.chain_tail_shares < cfg.p2p.max_reorg_depth:
+        errors.append(
+            "p2p.chain_tail_shares must be >= p2p.max_reorg_depth "
+            "(the mutable suffix must stay in memory)"
+        )
     return errors
 
 
@@ -606,6 +637,11 @@ p2p:
   max_time_skew: 300.0    # reject shares dated further into the future
   share_interval: 10.0    # intended share cadence, seconds
   sync_page: 200          # shares per locator-sync page
+  chain_dir: ""           # durable chain store directory (empty = memory only)
+  chain_fsync_interval: 64     # journal appends per fsync (1 = per event)
+  chain_segment_bytes: 8388608 # segment rotation threshold
+  chain_snapshot_interval: 8192  # shares archived between snapshots
+  chain_tail_shares: 16384     # in-memory best-chain tail (bounds RAM)
 
 api:
   enabled: true

@@ -1,0 +1,673 @@
+"""Durable share-chain store: WAL segments, settled archive, snapshots.
+
+The verified share chain (p2p/sharechain.py) is the substrate for
+regions, settlement and cross-region dedup — and until this module it
+lived entirely in memory: a pool reboot forfeited the whole PPLNS window
+and every region's dedup index. This is the reference's SQLite/Postgres
+persistence pillar (PAPER.md) rebuilt for the chain's actual write
+pattern, three layers under one directory:
+
+- **Journal** (``wal-<seq>.seg``): an append-only, CRC-framed log of
+  every BEST-CHAIN event — one EXTEND record per best-chain extension
+  (the full 80-byte PoW'd header + claim metadata, so the share is
+  reconstructible bit-exactly) and one REORG record per rewind. Side
+  branches are NOT journaled: on adoption their shares re-enter the log
+  as ordinary extensions, so replay is a pure fold over events. Writes
+  are buffered and fsync-BATCHED (``fsync_interval`` appends per
+  fsync); the gap between linked and fsynced events is exported as
+  ``persist_lag`` — shares inside it are lost by a crash and must come
+  back from peers (locator sync), which is the honest durability
+  statement a batched-fsync WAL can make.
+
+- **Archive** (``arc-<height>.seg``): the settled prefix — positions
+  below ``ShareChain.settled_height()`` are immutable by construction
+  (deeper forks are refused), so once a share settles its record is
+  appended here exactly once, in strict height order, and the in-memory
+  chain drops it. Height IS the archive sequence number, which makes
+  point reads (window-edge accounting, settlement cursor checks) a
+  bisect + seek and range reads (settlement slices, dedup-index
+  rebuild, locator service for far-behind peers) a sequential scan.
+  This is what bounds memory: a million-share PPLNS window keeps only
+  the mutable tail in RAM.
+
+- **Snapshot** (``snapshot.json``, atomic tmp+rename): a checkpoint of
+  the chain state AT the archived boundary — settled height, tip id,
+  cumulative work, and the exact integer PPLNS window accumulator — so
+  a rebooted node restores the prefix in O(1), replays only journal
+  events after the snapshot (bounded by the unsnapshotted suffix +
+  ``max_reorg_depth``, never chain length), and converges in seconds
+  regardless of how long the chain is. A torn or missing snapshot
+  degrades to an O(window) archive walk, never to wrong state.
+
+Crash semantics at each boundary (seeded-testable via the
+``chain.persist`` / ``chain.snapshot`` fault points):
+
+- torn final journal/archive record (kill -9 mid-write): detected by
+  CRC, truncated at replay, counted in ``torn_records`` — the chain
+  boots to the last durable event and pulls the rest from peers;
+- journal events lost before fsync: same recovery, sized by
+  ``persist_lag`` at the crash;
+- torn snapshot (kill -9 mid-rename is impossible — rename is atomic —
+  but a corrupted file is not): checksum-refused, boot falls back to
+  the previous snapshot or the archive walk;
+- snapshot ahead of a lost archive write: impossible by ordering — the
+  archive is flushed+fsynced before any snapshot referencing it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import struct
+import time
+import zlib
+from bisect import bisect_right
+from collections import OrderedDict
+
+from otedama_tpu.utils import faults
+
+log = logging.getLogger("otedama.p2p.chainstore")
+
+SNAPSHOT_VERSION = 1
+_MAGIC = 0xC5
+REC_EXTEND = 1
+REC_REORG = 2
+
+# frame: magic(1) type(1) payload_len(4) | payload | crc32(4, over
+# type+len+payload) — the CRC covers the length so a torn length field
+# cannot send the reader seeking into garbage that happens to parse
+_FRAME = struct.Struct("<BBI")
+_CRC = struct.Struct("<I")
+_EXTEND_FIX = struct.Struct("<Q32s80sQq")  # height, share_id, header, ts_ms, block#
+_REORG = struct.Struct("<Q")               # new best-chain length
+
+# both persistence seams are skippable steps under chaos: error = the
+# IO failed loudly (the chain keeps serving, durability degraded and
+# counted), drop = the write is silently LOST (the torn-recovery case:
+# replay stops at the hole and peer sync covers the rest), crash = the
+# chaos driver's registered handler kills the node at this boundary
+_PERSIST_FAULTS = faults.STEP
+_SNAPSHOT_FAULTS = faults.STEP
+
+
+class ChainStoreError(RuntimeError):
+    """A persistence operation failed (IO error, injected fault). The
+    in-memory chain is never poisoned by one: callers count and carry on
+    with durability degraded-but-visible."""
+
+
+@dataclasses.dataclass
+class ChainStoreConfig:
+    path: str = "chainstore"
+    # journal/archive segment rotation threshold, bytes
+    segment_bytes: int = 8 << 20
+    # journal appends per fsync (1 = every event durable before the next;
+    # the default trades a bounded persist_lag window for throughput)
+    fsync_interval: int = 64
+    # write a snapshot every time the archived boundary advances this
+    # many shares (bounds boot replay to ~this + max_reorg_depth events).
+    # NOTE each snapshot rewrites the in-memory tail into the journal —
+    # an O(tail_shares) synchronous write + two fsyncs on the event loop
+    # (a periodic stall of tens of ms at the default sizes); raise this
+    # interval or shrink tail_shares if that matters to your latency SLO
+    snapshot_interval: int = 8192
+    # in-memory best-chain tail floor, shares: positions below
+    # height - tail_shares (and below the settled horizon) are archived
+    # out of RAM. This is what bounds memory under million-share windows.
+    tail_shares: int = 16384
+    # archived share ids remembered for duplicate detection, so a peer
+    # replaying ancient best-chain shares gets "duplicate" (no orphan
+    # churn, no gossip re-flood) instead of being mistaken for news —
+    # the in-memory records used to provide this from genesis; this
+    # bounds it (32 B/id; replays older than the cap die at the flood
+    # dedup / verification layers like any other stale gossip)
+    dup_cache_shares: int = 65536
+
+
+def encode_extend(height: int, share, share_id: bytes, cumwork: int) -> bytes:
+    worker = share.worker.encode()
+    job = share.job_id.encode()
+    algo = share.algorithm.encode()
+    # cumulative work is an exact 256-bit-scale integer: variable-length
+    # big-endian bytes (the archive's last record is what lets a
+    # snapshot-less boot restore tip work in O(1))
+    cw = cumwork.to_bytes((cumwork.bit_length() + 7) // 8 or 1, "big")
+    return (
+        _EXTEND_FIX.pack(height, share_id, share.header, share.ts_ms,
+                         share.block_number)
+        + struct.pack("<H", len(cw)) + cw
+        + struct.pack("<B", len(algo)) + algo
+        + struct.pack("<H", len(worker)) + worker
+        + struct.pack("<H", len(job)) + job
+    )
+
+
+def decode_extend(payload: bytes):
+    """-> (height, share_id, Share, cumwork). Raises on malformed
+    payloads (the CRC passed, so malformed means a format bug, not rot)."""
+    from otedama_tpu.p2p.sharechain import Share
+
+    height, share_id, header, ts_ms, block_number = _EXTEND_FIX.unpack_from(
+        payload, 0)
+    off = _EXTEND_FIX.size
+    (clen,) = struct.unpack_from("<H", payload, off)
+    off += 2
+    cumwork = int.from_bytes(payload[off:off + clen], "big")
+    off += clen
+    (alen,) = struct.unpack_from("<B", payload, off)
+    off += 1
+    algo = payload[off:off + alen].decode()
+    off += alen
+    (wlen,) = struct.unpack_from("<H", payload, off)
+    off += 2
+    worker = payload[off:off + wlen].decode()
+    off += wlen
+    (jlen,) = struct.unpack_from("<H", payload, off)
+    off += 2
+    job = payload[off:off + jlen].decode()
+    share = Share(header, worker, job, ts_ms, algo, block_number)
+    return height, share_id, share, cumwork
+
+
+def _frame(rtype: int, payload: bytes) -> bytes:
+    head = _FRAME.pack(_MAGIC, rtype, len(payload))
+    return head + payload + _CRC.pack(zlib.crc32(head[1:] + payload))
+
+
+class SegmentLog:
+    """One directory of append-only, CRC-framed segment files.
+
+    Files are named ``<prefix>-<first_seq:016d>.seg`` so the record a
+    sequence number lives in is a filename bisect; rotation happens at
+    ``segment_bytes``. Replay tolerates a torn FINAL record (the
+    kill -9 tail) by truncating at it; a bad frame anywhere stops the
+    iteration there and is counted — the honest move, because nothing
+    after an unreadable record can be trusted to be at the right offset.
+    """
+
+    def __init__(self, dirpath: str, prefix: str, segment_bytes: int):
+        self.dir = dirpath
+        self.prefix = prefix
+        self.segment_bytes = segment_bytes
+        os.makedirs(dirpath, exist_ok=True)
+        self._bases: list[int] = []        # first seq per segment, sorted
+        self._counts: dict[int, int] = {}  # base -> records in that segment
+        self._fh = None                    # active write handle
+        self._active_base = 0
+        self._active_bytes = 0
+        self.seq = 0                       # next seq to assign
+        self.torn_records = 0
+        self.appends = 0
+        self.fsyncs = 0
+        self._pending = 0                  # appends since last fsync
+        # lazy per-segment record-offset indexes (point/range reads)
+        self._offsets: OrderedDict[int, list[int]] = OrderedDict()
+        self._scan_dir()
+
+    # -- layout ---------------------------------------------------------------
+
+    def _path(self, base: int) -> str:
+        return os.path.join(self.dir, f"{self.prefix}-{base:016d}.seg")
+
+    def _scan_dir(self) -> None:
+        bases = []
+        for name in os.listdir(self.dir):
+            if name.startswith(self.prefix + "-") and name.endswith(".seg"):
+                try:
+                    bases.append(int(name[len(self.prefix) + 1:-4]))
+                except ValueError:
+                    continue
+        self._bases = sorted(bases)
+        if not self._bases:
+            return
+        # only the LAST segment needs a scan to learn the total record
+        # count (earlier segments' counts are the base deltas) — this is
+        # what keeps opening a million-share store off the O(chain) path
+        for a, b in zip(self._bases, self._bases[1:]):
+            self._counts[a] = b - a
+        last = self._bases[-1]
+        offsets = self._scan_segment(last, truncate_torn=True)
+        self._counts[last] = len(offsets)
+        self._offsets[last] = offsets
+        self.seq = last + len(offsets)
+        self._active_base = last
+        self._active_bytes = os.path.getsize(self._path(last))
+
+    def _scan_segment(self, base: int, truncate_torn: bool = False) -> list[int]:
+        """Record byte offsets of one segment; optionally truncate a torn
+        tail in place (only ever done for the final segment on open)."""
+        offsets: list[int] = []
+        path = self._path(base)
+        good_end = 0
+        with open(path, "rb") as f:
+            data = f.read()
+        pos = 0
+        while pos + _FRAME.size <= len(data):
+            magic, rtype, plen = _FRAME.unpack_from(data, pos)
+            end = pos + _FRAME.size + plen + _CRC.size
+            if magic != _MAGIC or end > len(data):
+                break
+            (crc,) = _CRC.unpack_from(data, end - _CRC.size)
+            if zlib.crc32(data[pos + 1:end - _CRC.size]) != crc:
+                break
+            offsets.append(pos)
+            pos = good_end = end
+        if good_end < len(data):
+            self.torn_records += 1
+            log.warning("%s: torn/corrupt record at offset %d of %s "
+                        "(truncating=%s)", self.prefix, good_end, path,
+                        truncate_torn)
+            if truncate_torn:
+                with open(path, "r+b") as f:
+                    f.truncate(good_end)
+        return offsets
+
+    def _offsets_for(self, base: int) -> list[int]:
+        offsets = self._offsets.get(base)
+        if offsets is None:
+            offsets = self._scan_segment(base)
+            self._offsets[base] = offsets
+            while len(self._offsets) > 8:   # a few hot segments is plenty
+                victim = next((b for b in self._offsets
+                               if b != self._active_base), None)
+                if victim is None:
+                    break
+                del self._offsets[victim]
+        return offsets
+
+    # -- writes ---------------------------------------------------------------
+
+    def append(self, rtype: int, payload: bytes) -> int:
+        """Append one record; returns its sequence number. Buffered —
+        durability happens at flush()."""
+        if self._fh is None or self._active_bytes >= self.segment_bytes:
+            self._rotate()
+        frame = _frame(rtype, payload)
+        self._fh.write(frame)
+        count = self._counts.get(self._active_base, 0)
+        offs = self._offsets.get(self._active_base)
+        # only extend an offset index that is COMPLETE for this segment;
+        # an evicted-then-partially-rebuilt list would misalign seq→offset
+        if offs is not None and len(offs) == count:
+            offs.append(self._active_bytes)
+        self._active_bytes += len(frame)
+        seq = self.seq
+        self.seq += 1
+        self._counts[self._active_base] = count + 1
+        self.appends += 1
+        self._pending += 1
+        return seq
+
+    def _rotate(self) -> None:
+        if self._fh is not None:
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._fh.close()
+        self._active_base = self.seq
+        # a crash right after a rotation (or a rewrite of an empty tail)
+        # leaves an empty segment on disk whose base == seq: reuse it
+        # instead of registering a duplicate base
+        if not self._bases or self._bases[-1] != self._active_base:
+            self._bases.append(self._active_base)
+        self._offsets[self._active_base] = []
+        self._counts[self._active_base] = 0
+        self._active_bytes = 0
+        self._fh = open(self._path(self._active_base), "ab")
+
+    def flush(self, fsync: bool = True) -> None:
+        if self._fh is None:
+            return
+        self._fh.flush()
+        if fsync and self._pending:
+            os.fsync(self._fh.fileno())
+            self.fsyncs += 1
+            self._pending = 0
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self.flush(fsync=True)
+            self._fh.close()
+            self._fh = None
+
+    def drop_below(self, seq: int) -> int:
+        """Delete whole segments every record of which precedes ``seq``
+        (journal truncation after a snapshot). Never touches a segment a
+        needed record might share."""
+        dropped = 0
+        while len(self._bases) > 1 and self._bases[1] <= seq:
+            base = self._bases.pop(0)
+            self._counts.pop(base, None)
+            self._offsets.pop(base, None)
+            try:
+                os.remove(self._path(base))
+                dropped += 1
+            except OSError:
+                pass
+        return dropped
+
+    # -- reads ----------------------------------------------------------------
+
+    def _read_at(self, base: int, offsets: list[int], idx: int):
+        if idx >= len(offsets):
+            # the offset scan stopped early at a torn/corrupt record:
+            # this seq is unreadable even though the segment exists
+            raise ChainStoreError(
+                f"record {base}+{idx} unreadable in {self.prefix} "
+                f"(segment holds {len(offsets)} good records)")
+        with open(self._path(base), "rb") as f:
+            f.seek(offsets[idx])
+            head = f.read(_FRAME.size)
+            magic, rtype, plen = _FRAME.unpack(head)
+            payload = f.read(plen)
+            (crc,) = _CRC.unpack(f.read(_CRC.size))
+        if magic != _MAGIC or zlib.crc32(head[1:] + payload) != crc:
+            raise ChainStoreError(
+                f"corrupt record {base}+{idx} in {self.prefix}")
+        return rtype, payload
+
+    def read(self, seq: int):
+        """-> (rtype, payload) of one record by sequence number."""
+        if not (0 <= seq < self.seq) or not self._bases:
+            raise ChainStoreError(f"{self.prefix} seq {seq} out of range")
+        if seq < self._bases[0]:
+            # dropped by truncation (drop_below): without this guard the
+            # bisect would land on the LAST segment and a negative index
+            # would silently return some other record's bytes
+            raise ChainStoreError(
+                f"{self.prefix} seq {seq} precedes retained segments")
+        self.flush(fsync=False)  # point reads must see buffered appends
+        i = bisect_right(self._bases, seq) - 1
+        base = self._bases[i]
+        return self._read_at(base, self._offsets_for(base), seq - base)
+
+    def iter_from(self, seq: int):
+        """Yield (seq, rtype, payload) for every record >= seq, in order.
+        Stops (without raising) at a torn/corrupt record — everything
+        after it is untrusted; the caller heals from peers."""
+        self.flush(fsync=False)
+        start = max(0, seq)
+        i = max(0, bisect_right(self._bases, start) - 1)
+        for base in self._bases[i:]:
+            offsets = self._offsets_for(base)
+            for idx in range(max(0, start - base), len(offsets)):
+                try:
+                    rtype, payload = self._read_at(base, offsets, idx)
+                except ChainStoreError:
+                    return
+                yield base + idx, rtype, payload
+
+    def snapshot(self) -> dict:
+        total = sum(
+            os.path.getsize(self._path(b))
+            for b in self._bases if os.path.exists(self._path(b))
+        )
+        return {
+            "segments": len(self._bases),
+            "bytes": total,
+            "records": self.seq - (self._bases[0] if self._bases else 0),
+            "appends": self.appends,
+            "fsyncs": self.fsyncs,
+            "pending_fsync": self._pending,
+            "torn_records": self.torn_records,
+        }
+
+
+class ChainStore:
+    """The facade ``ShareChain`` persists through: journal + archive +
+    snapshot under one directory, with fsync batching and fault points.
+
+    All methods are synchronous and called from the event loop — the
+    writes are buffered appends (µs), and the fsyncs are batched; a
+    deployment whose fsync latency matters tunes ``fsync_interval`` up
+    or moves the directory to faster media, it does not get a second
+    event-loop-off thread to race the chain state against.
+    """
+
+    def __init__(self, config: ChainStoreConfig | None = None):
+        self.config = config or ChainStoreConfig()
+        os.makedirs(self.config.path, exist_ok=True)
+        self.journal = SegmentLog(
+            self.config.path, "wal", self.config.segment_bytes)
+        self.archive = SegmentLog(
+            self.config.path, "arc", self.config.segment_bytes)
+        self.stats = {
+            "persist_failures": 0,
+            "snapshot_failures": 0,
+            "snapshots_written": 0,
+            "replayed_records": 0,
+            "replay_seconds": 0.0,
+        }
+        self.snapshot_height = -1          # height of the last good snapshot
+        self.snapshot_time = 0.0
+        self.fsynced_seq = self.journal.seq  # journal seq covered by fsync
+        # archive sequence == settled height by construction; cross-check
+        # the invariant at open (one point read of the newest record) so
+        # a mixed-up directory — segments copied in from another store —
+        # fails loudly here, not as confusing replay skips later
+        self.archived_height = self.archive.seq
+        if self.archived_height > 0:
+            rtype, payload = self.archive.read(self.archived_height - 1)
+            h, _sid, _share, _cw = decode_extend(payload)
+            if rtype != REC_EXTEND or h != self.archived_height - 1:
+                raise ChainStoreError(
+                    f"archive end claims height {h}, expected "
+                    f"{self.archived_height - 1} — mixed-up chain_dir?")
+
+    # -- journal --------------------------------------------------------------
+
+    def append_extend(self, height: int, share, share_id: bytes,
+                      cumwork: int) -> None:
+        self._append(REC_EXTEND,
+                     encode_extend(height, share, share_id, cumwork))
+
+    def append_reorg(self, new_height: int) -> None:
+        self._append(REC_REORG, _REORG.pack(new_height))
+
+    def _append(self, rtype: int, payload: bytes) -> None:
+        d = faults.hit("chain.persist", "journal", _PERSIST_FAULTS)
+        if d is not None:
+            if d.delay:
+                d.sleep_sync()
+            if d.drop:
+                return  # the write is silently LOST (torn-recovery case)
+        try:
+            self.journal.append(rtype, payload)
+            if self.journal._pending >= self.config.fsync_interval:
+                self.flush()
+        except OSError as e:
+            raise ChainStoreError(f"journal append failed: {e}") from e
+
+    def flush(self) -> None:
+        """Batched durability point for the journal."""
+        try:
+            self.journal.flush(fsync=True)
+            self.fsynced_seq = self.journal.seq
+        except OSError as e:
+            raise ChainStoreError(f"journal fsync failed: {e}") from e
+
+    @property
+    def persist_lag(self) -> int:
+        """Best-chain events linked in memory but not yet fsynced — the
+        shares a kill -9 right now would lose (peers would restore them)."""
+        return self.journal.seq - self.fsynced_seq
+
+    def iter_journal(self, after_seq: int):
+        """Yield (seq, rtype, payload) for journal records with
+        seq > after_seq; stops at the first torn/corrupt record."""
+        return self.journal.iter_from(after_seq + 1)
+
+    # -- archive --------------------------------------------------------------
+
+    def archive_extend(self, height: int, share, share_id: bytes,
+                       cumwork: int) -> None:
+        if height < self.archived_height:
+            return  # already archived (a reboot re-archives the overlap)
+        if height != self.archived_height:
+            raise ChainStoreError(
+                f"archive must grow in height order: expected "
+                f"{self.archived_height}, got {height}")
+        d = faults.hit("chain.persist", "archive", _PERSIST_FAULTS)
+        if d is not None:
+            if d.delay:
+                d.sleep_sync()
+            if d.drop:
+                raise ChainStoreError("injected archive write loss")
+        try:
+            self.archive.append(REC_EXTEND,
+                                encode_extend(height, share, share_id,
+                                              cumwork))
+        except OSError as e:
+            raise ChainStoreError(f"archive append failed: {e}") from e
+        self.archived_height = height + 1
+
+    def read_record(self, height: int):
+        """-> (share_id, Share, cumwork) of the archived best-chain share
+        at an absolute position below the archived boundary."""
+        rtype, payload = self.archive.read(height)
+        if rtype != REC_EXTEND:
+            raise ChainStoreError(f"archive record {height} is not EXTEND")
+        h, share_id, share, cumwork = decode_extend(payload)
+        if h != height:
+            raise ChainStoreError(
+                f"archive record at {height} claims height {h}")
+        return share_id, share, cumwork
+
+    def read_share_id(self, height: int) -> bytes:
+        return self.read_record(height)[0]
+
+    def read_share(self, height: int):
+        return self.read_record(height)[1]
+
+    def read_range(self, start: int, end: int):
+        """Yield (height, share_id, Share) for archived positions
+        [start, end), sequentially. Raises ``ChainStoreError`` if the
+        range cannot be served CONTIGUOUSLY (a torn/corrupt record mid-
+        archive): a silent hole here would let a settlement slice drop
+        shares from a payout without anyone noticing — better to fail
+        the consumer loudly."""
+        end = min(end, self.archived_height)
+        if start >= end:
+            return
+        expect = start
+        for seq, rtype, payload in self.archive.iter_from(start):
+            if seq >= end:
+                return
+            if rtype != REC_EXTEND or seq != expect:
+                raise ChainStoreError(
+                    f"archive discontinuity at {seq} (expected {expect})")
+            height, share_id, share, _cumwork = decode_extend(payload)
+            yield height, share_id, share
+            expect = seq + 1
+        if expect < end:
+            raise ChainStoreError(
+                f"archive truncated at {expect} "
+                f"(wanted [{start}, {end})) — restore from a peer")
+
+    def journal_rewrite_tail(self, tail) -> None:
+        """Rewrite the in-memory tail as fresh journal records in a NEW
+        segment (``tail`` = iterable of (height, share, share_id,
+        cumwork)). Called right before a snapshot: everything at or
+        below the snapshot's ``journal_seq`` boundary becomes droppable,
+        and replay = snapshot + this suffix. Raises on failure — the
+        caller aborts the snapshot and the previous one stays in force."""
+        self.journal.flush(fsync=True)
+        self.journal._rotate()
+        for height, share, share_id, cumwork in tail:
+            self.journal.append(
+                REC_EXTEND, encode_extend(height, share, share_id, cumwork))
+        self.journal.flush(fsync=True)
+        self.fsynced_seq = self.journal.seq
+
+    # -- snapshots ------------------------------------------------------------
+
+    def _snapshot_path(self) -> str:
+        return os.path.join(self.config.path, "snapshot.json")
+
+    def write_snapshot(self, state: dict) -> bool:
+        """Atomically persist a chain checkpoint; returns False when the
+        write was refused/lost (injected or real — the previous snapshot
+        stays in force, boot just replays more journal)."""
+        try:
+            d = faults.hit("chain.snapshot", None, _SNAPSHOT_FAULTS)
+        except faults.FaultInjectedError:
+            self.stats["snapshot_failures"] += 1
+            return False
+        if d is not None:
+            if d.delay:
+                d.sleep_sync()
+            if d.drop:
+                self.stats["snapshot_failures"] += 1
+                return False
+        # the snapshot references archived heights: the archive (and the
+        # journal truncation point) must be durable BEFORE the snapshot
+        # that points at them exists
+        try:
+            self.archive.flush(fsync=True)
+            self.flush()
+            body = json.dumps(state, sort_keys=True)
+            doc = {"version": SNAPSHOT_VERSION, "state": state,
+                   "crc": zlib.crc32(body.encode())}
+            tmp = self._snapshot_path() + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._snapshot_path())
+        except OSError as e:
+            self.stats["snapshot_failures"] += 1
+            log.warning("snapshot write failed (previous stays): %s", e)
+            return False
+        self.snapshot_height = int(state.get("height", -1))
+        self.snapshot_time = time.time()
+        self.stats["snapshots_written"] += 1
+        self.journal.drop_below(int(state.get("journal_seq", -1)) + 1)
+        return True
+
+    def read_snapshot(self) -> dict | None:
+        """The last good snapshot state, or None (absent OR torn — a
+        checksum-refused snapshot degrades to the archive walk, it never
+        restores wrong state)."""
+        try:
+            with open(self._snapshot_path()) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return None
+        state = doc.get("state")
+        if not isinstance(state, dict) or doc.get("version") != SNAPSHOT_VERSION:
+            return None
+        body = json.dumps(state, sort_keys=True)
+        if zlib.crc32(body.encode()) != doc.get("crc"):
+            log.warning("snapshot checksum mismatch — ignoring torn snapshot")
+            return None
+        self.snapshot_height = int(state.get("height", -1))
+        try:
+            self.snapshot_time = os.path.getmtime(self._snapshot_path())
+        except OSError:
+            self.snapshot_time = time.time()
+        return state
+
+    # -- lifecycle / reporting ------------------------------------------------
+
+    def close(self) -> None:
+        try:
+            self.flush()
+        except ChainStoreError:
+            pass
+        self.journal.close()
+        self.archive.close()
+
+    def snapshot(self) -> dict:
+        return {
+            "path": self.config.path,
+            "archived_height": self.archived_height,
+            "persist_lag": self.persist_lag,
+            "snapshot_height": self.snapshot_height,
+            "snapshot_age_seconds": (
+                round(time.time() - self.snapshot_time, 1)
+                if self.snapshot_time else -1.0),
+            "journal": self.journal.snapshot(),
+            "archive": self.archive.snapshot(),
+            **self.stats,
+        }

@@ -53,9 +53,13 @@ class P2PPool:
     """A pool node in the gossip overlay, accounting on the share chain."""
 
     def __init__(self, config: NodeConfig | None = None,
-                 params: ChainParams | None = None):
+                 params: ChainParams | None = None, store=None):
         self.node = P2PNode(config)
-        self.chain = ShareChain(params)
+        # optional durable chain store (p2p/chainstore.py): callers run
+        # ``chain.load()`` BEFORE start() so the node boots from its
+        # segments+snapshot and locator sync only covers what a crash
+        # cut off past the last durable record
+        self.chain = ShareChain(params, store=store)
         self.blocks_seen: list[dict] = []
         self.jobs_seen: dict[str, dict] = {}
         self.stats = {
@@ -93,6 +97,13 @@ class P2PPool:
 
     async def stop(self) -> None:
         await self.node.stop()
+        if self.chain.store is not None:
+            # final fsync + handle close; a hard kill skipping this is
+            # exactly the crash load() replays
+            try:
+                self.chain.store.close()
+            except Exception:
+                log.exception("chain store close failed")
 
     def sever(self) -> None:
         """Cut this node off the overlay (region loss): close every peer
@@ -144,8 +155,9 @@ class P2PPool:
         not poison our own chain (or waste a broadcast)."""
         await self._verify_off_loop(share)
         status = self.chain.connect(share)
-        if status != "duplicate":
+        if status in ("accepted", "orphan"):
             self.stats["shares_accepted"] += 1
+            self._maybe_prune()
             if not self.severed:
                 await self.node.broadcast(
                     P2PMessage(MessageType.SHARE, share.to_payload())
@@ -168,8 +180,11 @@ class P2PPool:
             if isinstance(verdict, BaseException):
                 raise verdict
         statuses = [self.chain.connect(s) for s in shares]
-        fresh = [s for s, st in zip(shares, statuses) if st != "duplicate"]
+        fresh = [s for s, st in zip(shares, statuses)
+                 if st in ("accepted", "orphan")]
         self.stats["shares_accepted"] += len(fresh)
+        if fresh:
+            self._maybe_prune()
         if fresh and not self.severed:
             await self.node.broadcast(P2PMessage(
                 MessageType.SHARE_BATCH,
@@ -292,8 +307,8 @@ class P2PPool:
         finally:
             self._verifying.discard(sid)
         status = self.chain.connect(share)
-        if status == "duplicate":
-            return
+        if status not in ("accepted", "orphan"):
+            return  # duplicate, or stale (extends an archived ancestor)
         self.stats["shares_accepted"] += 1
         self._maybe_prune()
         if status == "orphan":
@@ -379,8 +394,8 @@ class P2PPool:
                 tainted = True
                 continue
             status = self.chain.connect(share)
-            if status == "duplicate":
-                continue
+            if status not in ("accepted", "orphan"):
+                continue  # duplicate or stale: never re-flooded
             self.stats["shares_accepted"] += 1
             saw_orphan = saw_orphan or status == "orphan"
             verified.append(share)
@@ -511,7 +526,7 @@ class P2PPool:
             if isinstance(verdict, BaseException):
                 self.stats["verify_failures"] += 1
                 continue
-            if self.chain.connect(share) != "duplicate":
+            if self.chain.connect(share) in ("accepted", "orphan"):
                 self.stats["shares_accepted"] += 1
                 progressed += 1
         if progressed:
@@ -527,14 +542,16 @@ class P2PPool:
 
     def _maybe_prune(self) -> None:
         """Periodic housekeeping on the connect path: side branches past
-        the reorg horizon can never be adopted again and are dropped.
-        (Best-chain records are retained to serve locator sync from
-        genesis; a checkpoint scheme bounding those is future work.)
-        Delta-gated, not modulo: orphan adoption and sync pages link
-        several shares per call and would step over exact multiples."""
+        the reorg horizon are dropped, and — with a chain store — the
+        settled prefix is archived out of memory, snapshots checkpoint
+        the boundary, and the journal's batched fsync flushes
+        (``ShareChain.compact``), which is what bounds both RAM and the
+        persist lag under sustained traffic. Delta-gated, not modulo:
+        orphan adoption and sync pages link several shares per call and
+        would step over exact multiples."""
         if self.chain.shares_connected - self._last_prune >= 256:
             self._last_prune = self.chain.shares_connected
-            self.chain.prune_side_branches()
+            self.chain.compact()
 
     # -- reporting ------------------------------------------------------------
 

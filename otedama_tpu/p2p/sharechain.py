@@ -44,16 +44,21 @@ This module is that construction, asyncio/host-side:
 from __future__ import annotations
 
 import dataclasses
+import logging
 import struct
 import time
+from collections import OrderedDict
 
 from otedama_tpu.kernels.target import (
+    DIFF1_TARGET,
     bits_to_target,
     difficulty_to_target,
     target_to_bits,
     target_to_difficulty,
 )
 from otedama_tpu.utils import pow_host
+
+log = logging.getLogger("otedama.p2p.sharechain")
 
 GENESIS = b"\x00" * 32
 HEADER_VERSION = 0x20000000
@@ -192,6 +197,25 @@ def effective_difficulty(difficulty: float) -> float:
     )
 
 
+# PPLNS weights are accumulated in EXACT fixed-point integers (64
+# fractional bits of difficulty) rather than floats: integer addition is
+# associative, so an accumulator maintained incrementally across
+# connects and reorgs equals the full window walk BIT-FOR-BIT on every
+# node, regardless of the order history arrived in — float summation
+# could never promise that, and byte-identical splits are the chain's
+# whole contract. ``weights()`` divides back to a float only at the
+# read edge.
+WEIGHT_FRAC_BITS = 64
+_WEIGHT_SCALE = 1 << WEIGHT_FRAC_BITS
+
+
+def weight_units(target: int) -> int:
+    """One share's exact integer PPLNS weight (fixed-point difficulty)."""
+    if target <= 0:
+        return 0
+    return (DIFF1_TARGET << WEIGHT_FRAC_BITS) // target
+
+
 def verify_share(share: Share, params: ChainParams,
                  now: float | None = None) -> None:
     """Full share verification — pure CPU, executor-safe. Raises
@@ -324,10 +348,22 @@ class ShareChain:
     executor threads, but ``connect``/fork choice/window maintenance run
     on the event loop only — linking is dict work, and serializing it
     makes the reorg bookkeeping trivially race-free.
+
+    With a ``store`` (p2p/chainstore.py) attached, the chain is durable
+    and MEMORY-BOUNDED: every best-chain extension/reorg is journaled
+    (fsync-batched), settled positions are archived out of RAM behind a
+    fixed in-memory tail (``compact()``), checkpointed snapshots make a
+    reboot replay only the mutable tail (``load()``), and the PPLNS
+    window — maintained as an exact integer per-worker accumulator, not
+    an O(window) walk — can span millions of shares while memory holds
+    only ``tail_shares`` records. Without a store nothing changes
+    except ``weights()`` getting O(workers) instead of O(window).
     """
 
-    def __init__(self, params: ChainParams | None = None):
+    def __init__(self, params: ChainParams | None = None, store=None):
         self.params = params or ChainParams()
+        # optional durable chain store (p2p/chainstore.py ChainStore)
+        self.store = store
         # observer fired for EVERY share linked into the DAG (any
         # branch, own or synced) — the multi-region replicator builds
         # its cross-region submission index from it. Event-loop only,
@@ -337,8 +373,31 @@ class ShareChain:
         self.orphans: dict[bytes, Share] = {}          # id -> share (FIFO)
         self._orphans_by_prev: dict[bytes, set[bytes]] = {}
         self.tip: bytes | None = None
-        self._chain: list[bytes] = []                  # best chain, by height
+        # the in-memory TAIL of the best chain: _chain[i] is the share at
+        # absolute height _base + i; positions below _base live only in
+        # the archive. _pos values are ABSOLUTE heights.
+        self._chain: list[bytes] = []
         self._pos: dict[bytes, int] = {}               # id -> height on best
+        self._base = 0                                 # archived prefix length
+        self._base_tip: bytes = GENESIS                # share id at _base - 1
+        self._base_cumwork = 0                         # cumwork at _base - 1
+        # exact integer PPLNS window accumulator: worker -> weight units
+        # over the last `window` best-chain shares, maintained on every
+        # extend/rewind (checked against the full walk in tests)
+        self._acc: dict[str, int] = {}
+        # read-ahead cache for window-edge archive lookups (the share
+        # leaving the window advances sequentially with the tip)
+        self._edge_cache: OrderedDict[int, tuple[str, int]] = OrderedDict()
+        # archived ids remembered for duplicate detection (bounded by
+        # store.config.dup_cache_shares) — records used to provide this
+        # from genesis; without it a replayed ancient share would file
+        # as an orphan and re-flood
+        self._archived_ids: OrderedDict[bytes, None] = OrderedDict()
+        # memo for archived share_id_at point reads (locator entries are
+        # exponentially spaced and immutable once archived — without
+        # this every locator() call re-reads segments off disk)
+        self._id_cache: OrderedDict[int, bytes] = OrderedDict()
+        self._replaying = False            # load() suppresses journaling
         # stats
         self.shares_connected = 0
         self.orphans_adopted = 0
@@ -346,29 +405,53 @@ class ShareChain:
         self.reorgs = 0
         self.deepest_reorg = 0
         self.reorgs_refused = 0
+        self.stale_refused = 0
+        self.persist_failures = 0
 
     # -- views ---------------------------------------------------------------
 
     @property
     def height(self) -> int:
-        """Number of shares on the best chain."""
-        return len(self._chain)
+        """Number of shares on the best chain (archived + in memory)."""
+        return self._base + len(self._chain)
+
+    @property
+    def archived_height(self) -> int:
+        """Best-chain positions archived out of memory (the in-memory
+        tail starts here) — the public form of the store boundary that
+        downstream consumers (regions' recommit sweep) reason about."""
+        return self._base
 
     @property
     def tip_work(self) -> int:
-        return self.records[self.tip].cumwork if self.tip is not None else 0
+        if self.tip is None:
+            return 0
+        rec = self.records.get(self.tip)
+        return rec.cumwork if rec is not None else self._base_cumwork
 
     def __contains__(self, share_id: bytes) -> bool:
-        return share_id in self.records or share_id in self.orphans
+        return (share_id in self.records or share_id in self.orphans
+                or (self._base > 0 and share_id == self._base_tip)
+                or share_id in self._archived_ids)
 
     def weights(self) -> dict[str, float]:
-        """PPLNS weights over the window of the best chain, walked in
-        chain order — identical on every converged node by construction."""
-        out: dict[str, float] = {}
-        for sid in self._chain[-self.params.window:]:
-            share = self.records[sid].share
-            out[share.worker] = out.get(share.worker, 0.0) + share.difficulty
-        return out
+        """PPLNS weights over the window of the best chain — identical
+        on every converged node by construction. O(active workers): the
+        window is an incrementally maintained exact integer accumulator,
+        not a chain walk, so a million-share window costs the same as a
+        thousand-share one."""
+        return {w: u / _WEIGHT_SCALE for w, u in self._acc.items()}
+
+    def weights_full(self) -> dict[str, float]:
+        """The full-window walk oracle for ``weights()`` — O(window),
+        reads archived segments as needed. Tests and audits assert the
+        incremental accumulator equals this bit-for-bit."""
+        acc: dict[str, int] = {}
+        for share in self.chain_slice(max(0, self.height - self.params.window),
+                                      self.height):
+            acc[share.worker] = acc.get(share.worker, 0) + weight_units(
+                share.target)
+        return {w: u / _WEIGHT_SCALE for w, u in acc.items()}
 
     # -- settlement horizon --------------------------------------------------
 
@@ -378,36 +461,78 @@ class ShareChain:
         position below this can never be rewound — the settlement engine
         (pool/settlement.py) snapshots only below it, which is what makes
         settled credit un-reorgable by construction."""
-        return max(0, len(self._chain) - self.params.max_reorg_depth)
+        return max(0, self.height - self.params.max_reorg_depth)
 
     def share_id_at(self, height: int) -> bytes:
-        """Best-chain share id at a 0-based chain position."""
-        return self._chain[height]
+        """Best-chain share id at a 0-based chain position (archived
+        positions are a memoized store point-read — archived ids are
+        immutable, so the cache never invalidates)."""
+        if height >= self._base:
+            return self._chain[height - self._base]
+        sid = self._id_cache.get(height)
+        if sid is None:
+            sid = self.store.read_share_id(height)
+            self._id_cache[height] = sid
+            while len(self._id_cache) > 512:
+                self._id_cache.popitem(last=False)
+        return sid
 
     def chain_slice(self, start: int, end: int) -> list[Share]:
         """Best-chain shares for positions ``[start, end)``, chain order.
         Positions below ``settled_height()`` are stable; callers slicing
-        above it own the reorg risk."""
-        return [self.records[sid].share for sid in self._chain[start:end]]
+        above it own the reorg risk. Archived positions stream from the
+        store, so settlement cursors resume over segments a reboot (or
+        long downtime) left behind."""
+        end = min(end, self.height)
+        if start >= end:
+            return []
+        out: list[Share] = []
+        if start < self._base:
+            out.extend(share for _h, _sid, share
+                       in self.store.read_range(start, min(end, self._base)))
+        if end > self._base:
+            lo = max(start, self._base) - self._base
+            out.extend(self.records[sid].share
+                       for sid in self._chain[lo:end - self._base])
+        return out
 
     def position_of(self, share_id: bytes) -> int | None:
-        """Best-chain position of a share id (None when off-chain) —
-        settlement uses it to assert its persisted cursor still lies on
-        THIS chain before consuming more of it."""
+        """Best-chain position of a share id (None when off-chain or
+        archived out of the in-memory tail) — settlement uses
+        ``on_best_chain_at`` for cursor checks, which also covers the
+        archived prefix."""
+        if self._base > 0 and share_id == self._base_tip:
+            return self._base - 1
         return self._pos.get(share_id)
+
+    def on_best_chain_at(self, share_id: bytes, height: int) -> bool:
+        """True when ``share_id`` is the best-chain share at absolute
+        position ``height`` — a point check that works for archived
+        positions too (one store read), unlike ``position_of``."""
+        if not (0 <= height < self.height):
+            return False
+        return self.share_id_at(height) == share_id
 
     # -- linking -------------------------------------------------------------
 
     def connect(self, share: Share) -> str:
         """Link one VERIFIED share. Returns ``accepted`` (linked, possibly
-        adopting queued orphans), ``orphan`` (parent unknown — held), or
-        ``duplicate``. Never verifies: callers run ``verify_share`` first,
-        off the loop."""
+        adopting queued orphans), ``orphan`` (parent unknown — held),
+        ``duplicate``, or ``stale`` (extends an ARCHIVED ancestor — by
+        construction deeper than any permitted reorg, so it can never be
+        adopted; refusing outright keeps replayed ancient lineages from
+        churning the orphan pen or re-flooding). Never verifies: callers
+        run ``verify_share`` first, off the loop."""
         sid = share.share_id
-        if sid in self.records or sid in self.orphans:
+        if sid in self:
             return "duplicate"
         prev = share.prev_hash
-        if prev != GENESIS and prev not in self.records:
+        if prev in self._archived_ids and not (
+                self._base > 0 and prev == self._base_tip):
+            self.stale_refused += 1
+            return "stale"
+        if (prev != GENESIS and prev not in self.records
+                and not (self._base > 0 and prev == self._base_tip)):
             while len(self.orphans) >= self.params.max_orphans:
                 old_id, old = next(iter(self.orphans.items()))
                 del self.orphans[old_id]
@@ -436,8 +561,16 @@ class ShareChain:
     def _link(self, share: Share) -> None:
         prev = share.prev_hash
         parent = self.records.get(prev)
-        height = 0 if parent is None else parent.height + 1
-        cumwork = (0 if parent is None else parent.cumwork) + share.work
+        if parent is not None:
+            height = parent.height + 1
+            cumwork = parent.cumwork + share.work
+        elif self._base > 0 and prev == self._base_tip:
+            # extending the archived boundary share (fresh boot, empty tail)
+            height = self._base
+            cumwork = self._base_cumwork + share.work
+        else:
+            height = 0
+            cumwork = share.work
         sid = share.share_id
         self.records[sid] = _Rec(share, height, cumwork)
         self.shares_connected += 1
@@ -450,8 +583,7 @@ class ShareChain:
         smaller id so every converged node picks the same tip."""
         rec = self.records[sid]
         if self.tip is not None:
-            cur = self.records[self.tip]
-            if (rec.cumwork, self.tip) <= (cur.cumwork, sid):
+            if (rec.cumwork, self.tip) <= (self.tip_work, sid):
                 # strictly-more work wins; equal work wins only on a
                 # smaller id (note the swapped ids in the comparison)
                 return
@@ -459,41 +591,168 @@ class ShareChain:
         path: list[bytes] = []
         h = sid
         while h != GENESIS and h not in self._pos:
+            if self._base > 0 and h == self._base_tip:
+                break
             r = self.records.get(h)
             if r is None:
                 return  # lineage pruned from under us: cannot adopt
             path.append(h)
             h = r.share.prev_hash
-        fork_height = -1 if h == GENESIS else self._pos[h]
-        depth = len(self._chain) - (fork_height + 1)
+        if h in self._pos:
+            fork_height = self._pos[h]
+        elif h == GENESIS:
+            if self._base > 0:
+                # a from-genesis lineage while our prefix is archived
+                # would rewind below the archive — structurally refused
+                # (it is deeper than any permitted reorg by definition)
+                self.reorgs_refused += 1
+                return
+            fork_height = -1
+        else:                        # h == self._base_tip
+            fork_height = self._base - 1
+        depth = self.height - (fork_height + 1)
         if self.tip is not None and depth > self.params.max_reorg_depth:
             self.reorgs_refused += 1
             return
         if depth > 0 and self.tip is not None:
             self.reorgs += 1
             self.deepest_reorg = max(self.deepest_reorg, depth)
-        for old in self._chain[fork_height + 1:]:
-            del self._pos[old]
-        del self._chain[fork_height + 1:]
+        if depth > 0:
+            self._rewind_to(fork_height + 1)
         for h in reversed(path):
-            self._pos[h] = len(self._chain)
-            self._chain.append(h)
+            self._append_best(h)
         self.tip = sid
+
+    def _rewind_to(self, new_height: int) -> None:
+        """Drop best-chain positions >= ``new_height`` (reorg rewind),
+        maintaining the window accumulator and journaling the event.
+        Rewound records stay linked as a side branch."""
+        if self.store is not None and not self._replaying:
+            self._persist("journal",
+                          lambda: self.store.append_reorg(new_height))
+        while self.height > new_height:
+            old = self._chain.pop()
+            del self._pos[old]
+            self._pop_acc(self.records[old].share)
+
+    def _append_best(self, sid: bytes) -> None:
+        """Append one linked record to the best chain, maintaining the
+        window accumulator and journaling the extension."""
+        h = self.height
+        self._pos[sid] = h
+        self._chain.append(sid)
+        share = self.records[sid].share
+        self._push_acc(share)
+        if self.store is not None and not self._replaying:
+            cumwork = self.records[sid].cumwork
+            self._persist("journal", lambda: self.store.append_extend(
+                h, share, sid, cumwork))
+
+    def _persist(self, what: str, fn) -> None:
+        """Run one store operation; a persistence failure NEVER poisons
+        the in-memory chain — it is counted, logged, and visible as
+        degraded durability (metrics), while consensus carries on."""
+        try:
+            fn()
+        except Exception as e:
+            self.persist_failures += 1
+            log.warning("chain %s persistence failed (continuing "
+                        "in-memory): %s", what, e)
+
+    # -- PPLNS window accumulator ---------------------------------------------
+
+    def _push_acc(self, share: Share) -> None:
+        """Window maintenance for one best-chain append: the new share
+        enters; the share falling off the window's far edge leaves. An
+        unreadable archived edge (corrupt segment) degrades the
+        accumulator VISIBLY (counted + logged) instead of crashing the
+        connect path — consensus must outlive a bad disk sector."""
+        self._acc[share.worker] = (
+            self._acc.get(share.worker, 0) + weight_units(share.target))
+        lo = self.height - self.params.window
+        if lo > 0:
+            try:
+                worker, units = self._window_entry(lo - 1)
+            except Exception as e:
+                self.persist_failures += 1
+                log.error("window-edge read failed at %d (weights "
+                          "degraded until restored from peers): %s",
+                          lo - 1, e)
+                return
+            self._acc_sub(worker, units)
+
+    def _pop_acc(self, share: Share) -> None:
+        """Window maintenance for one rewind: the popped share leaves;
+        the share that re-enters at the far edge (if the window was
+        full) comes back — possibly from the archive, bounded by
+        ``max_reorg_depth`` reads per reorg."""
+        self._acc_sub(share.worker, weight_units(share.target))
+        lo = self.height + 1 - self.params.window
+        if lo > 0:
+            try:
+                worker, units = self._window_entry(lo - 1)
+            except Exception as e:
+                self.persist_failures += 1
+                log.error("window-edge read failed at %d (weights "
+                          "degraded until restored from peers): %s",
+                          lo - 1, e)
+                return
+            self._acc[worker] = self._acc.get(worker, 0) + units
+
+    def _acc_sub(self, worker: str, units: int) -> None:
+        left = self._acc.get(worker, 0) - units
+        if left == 0:
+            self._acc.pop(worker, None)
+        else:
+            # a negative residue would be an accounting bug — keep it
+            # visible in weights() rather than silently clamping
+            self._acc[worker] = left
+
+    def _window_entry(self, height: int) -> tuple[str, int]:
+        """(worker, weight units) of the best-chain share at an absolute
+        position — from memory, or from the archive via a sequential
+        read-ahead cache (window edges advance with the tip, so one
+        archive scan serves hundreds of connects)."""
+        if height >= self._base:
+            share = self.records[self._chain[height - self._base]].share
+            return share.worker, weight_units(share.target)
+        entry = self._edge_cache.get(height)
+        if entry is None:
+            try:
+                for h, _sid, share in self.store.read_range(height,
+                                                            height + 256):
+                    self._edge_cache[h] = (share.worker,
+                                           weight_units(share.target))
+                    self._edge_cache.move_to_end(h)
+            except Exception:
+                pass  # partial read-ahead is fine; the point read decides
+            while len(self._edge_cache) > 4096:
+                self._edge_cache.popitem(last=False)
+            entry = self._edge_cache.get(height)
+            if entry is None:
+                # a direct point read raises ChainStoreError on a truly
+                # unreadable record — the caller degrades visibly
+                share = self.store.read_share(height)
+                entry = (share.worker, weight_units(share.target))
+                self._edge_cache[height] = entry
+        return entry
 
     # -- locator sync --------------------------------------------------------
 
     def locator(self) -> list[str]:
         """Block-locator hashes: dense near the tip, exponentially sparse
-        toward genesis, genesis-most element always included."""
+        toward genesis, genesis-most element always included. Entries
+        below the archived boundary are store point-reads (a handful —
+        the spacing is exponential)."""
         out: list[str] = []
-        step, h = 1, len(self._chain) - 1
+        step, h = 1, self.height - 1
         while h >= 0:
-            out.append(self._chain[h].hex())
+            out.append(self.share_id_at(h).hex())
             if len(out) >= 10:
                 step *= 2
             h -= step
-        if self._chain:
-            first = self._chain[0].hex()
+        if self.height:
+            first = self.share_id_at(0).hex()
             if out[-1] != first:
                 out.append(first)
         return out
@@ -502,29 +761,35 @@ class ShareChain:
                      limit: int | None = None) -> tuple[list[Share], bool]:
         """The suffix of the best chain after the highest locator hash we
         recognize (or from genesis when none match), oldest first, at most
-        ``limit`` shares. Returns ``(shares, more)``."""
+        ``limit`` shares. Returns ``(shares, more)``. Pages below the
+        archived boundary stream from the store, so this node can fully
+        bootstrap a peer (or its own wiped sibling) from disk. Locator
+        entries pointing into our archived prefix are not matched by id
+        (no id→height index is kept for the archive) — such a far-behind
+        peer is served from genesis, which is correct, merely unsparing."""
         limit = self.params.sync_page if limit is None else max(1, int(limit))
         start = 0
         for hh in locator_hex[:MAX_LOCATOR_LEN]:
             try:
-                pos = self._pos.get(bytes.fromhex(str(hh)))
+                pos = self.position_of(bytes.fromhex(str(hh)))
             except ValueError:
                 continue
             if pos is not None:
                 start = pos + 1
                 break
-        page = [self.records[sid].share for sid in self._chain[start:start + limit]]
-        return page, start + limit < len(self._chain)
+        page = self.chain_slice(start, start + limit)
+        return page, start + limit < self.height
 
     # -- housekeeping --------------------------------------------------------
 
     def prune_side_branches(self) -> int:
         """Drop records that can never matter again: off the best chain
         AND deeper below the tip than any permitted reorg. Best-chain
-        records are kept (they serve locator sync from genesis)."""
+        records are kept until ``compact()`` archives them (with a
+        store) — they serve locator sync from genesis either way."""
         if self.tip is None:
             return 0
-        horizon = len(self._chain) - 1 - self.params.max_reorg_depth
+        horizon = self.height - 1 - self.params.max_reorg_depth
         doomed = [
             sid for sid, rec in self.records.items()
             if sid not in self._pos and rec.height < horizon
@@ -533,12 +798,240 @@ class ShareChain:
             del self.records[sid]
         return len(doomed)
 
-    def snapshot(self) -> dict:
+    def compact(self) -> int:
+        """One housekeeping pass: prune dead side branches, archive the
+        settled best-chain prefix out of memory behind the configured
+        tail, snapshot if the archived boundary advanced enough, and
+        flush the journal's batched fsync. This is what bounds memory:
+        after a compact, RAM holds at most ``tail_shares`` + the reorg
+        horizon + live side branches, regardless of window or chain
+        length. No-op beyond pruning when no store is attached."""
+        pruned = self.prune_side_branches()
+        if self.store is None:
+            return pruned
+        new_base = max(self._base, min(
+            self.settled_height(),
+            self.height - self.store.config.tail_shares))
+        done = 0
+        for i in range(new_base - self._base):
+            sid = self._chain[i]
+            rec = self.records[sid]
+            try:
+                self.store.archive_extend(self._base + i, rec.share, sid,
+                                          rec.cumwork)
+            except Exception as e:
+                self.persist_failures += 1
+                log.warning("chain archive persistence failed "
+                            "(keeping records in memory): %s", e)
+                break
+            done += 1
+        if done:
+            last = self._chain[done - 1]
+            self._base_cumwork = self.records[last].cumwork
+            self._base_tip = last
+            for sid in self._chain[:done]:
+                del self.records[sid]
+                del self._pos[sid]
+                self._archived_ids[sid] = None
+            del self._chain[:done]
+            self._base += done
+            cap = self.store.config.dup_cache_shares
+            while len(self._archived_ids) > cap:
+                self._archived_ids.popitem(last=False)
+            interval = self.store.config.snapshot_interval
+            if self._base - max(self.store.snapshot_height, 0) >= interval:
+                # guarded like every other store operation: a failing
+                # snapshot (corrupt archive read in _acc_at_base, ENOSPC
+                # on the fsync) must degrade durability visibly, never
+                # reject the share being connected right now
+                self._persist("snapshot", self.write_snapshot)
+        self._persist("flush", self.store.flush)
+        return pruned
+
+    # -- snapshots / cold boot ------------------------------------------------
+
+    def write_snapshot(self) -> bool:
+        """Checkpoint the archived boundary: per-worker window
+        accumulator AT the boundary (exact integers), tip/cumwork there,
+        and the journal boundary — after rewriting the in-memory tail as
+        fresh journal records so replay is exactly snapshot + suffix.
+        A failed snapshot leaves the previous one in force."""
+        if self.store is None:
+            return False
+        boundary = self.store.journal.seq - 1
+        try:
+            self.store.journal_rewrite_tail(
+                (self._base + i, self.records[sid].share, sid,
+                 self.records[sid].cumwork)
+                for i, sid in enumerate(self._chain))
+        except Exception as e:
+            self.persist_failures += 1
+            self.store.stats["snapshot_failures"] += 1
+            log.warning("snapshot tail rewrite failed (previous snapshot "
+                        "stays): %s", e)
+            return False
+        state = {
+            "height": self._base,
+            "tip": self._base_tip.hex(),
+            "cumwork": str(self._base_cumwork),
+            "acc": {w: str(u) for w, u in self._acc_at_base().items()},
+            "journal_seq": boundary,
+            "params": {"algorithm": self.params.algorithm,
+                       "window": self.params.window},
+        }
+        return self.store.write_snapshot(state)
+
+    def _acc_at_base(self) -> dict[str, int]:
+        """The window accumulator AS OF the archived boundary: the live
+        accumulator minus the in-memory tail's contributions plus the
+        archived shares that were still in-window back then. Both
+        adjustment ranges are bounded by the tail length."""
+        acc = dict(self._acc)
+        h, base, w = self.height, self._base, self.params.window
+        lo_now, lo_base = max(0, h - w), max(0, base - w)
+        for share in self.chain_slice(max(lo_now, base), h):
+            units = weight_units(share.target)
+            left = acc.get(share.worker, 0) - units
+            if left == 0:
+                acc.pop(share.worker, None)
+            else:
+                acc[share.worker] = left
+        for share in self.chain_slice(lo_base, min(lo_now, base)):
+            acc[share.worker] = (
+                acc.get(share.worker, 0) + weight_units(share.target))
+        return acc
+
+    def load(self) -> dict:
+        """Cold boot from the attached store: restore the archived
+        boundary from the snapshot (O(1)) — or, with a torn/absent
+        snapshot, from the archive itself (O(window) accumulator walk,
+        the honest degraded path) — then fold the journal suffix to the
+        converged tip. Replay work is bounded by the unsnapshotted
+        suffix + ``max_reorg_depth``, never chain length. Whatever a
+        crash cut off past the last durable record comes back from
+        peers via ordinary locator sync."""
+        if self.store is None:
+            raise ValueError("no chain store attached")
+        if self.height or self.records or self._base:
+            raise RuntimeError("load() requires an empty chain")
+        t0 = time.perf_counter()
+        snap = self.store.read_snapshot()
+        source = "empty"
+        if snap is not None:
+            p = snap.get("params", {})
+            if p.get("algorithm") != self.params.algorithm:
+                raise ValueError(
+                    f"chain store belongs to a {p.get('algorithm')!r} "
+                    f"chain, this node runs {self.params.algorithm!r}")
+            if (int(p.get("window", -1)) != self.params.window
+                    or int(snap["height"]) > self.store.archived_height):
+                # window changed (accumulator scale differs) or the
+                # snapshot claims archive state we cannot see: rebuild
+                # from the archive instead of trusting it
+                snap = None
+        after_seq = -1
+        if snap is not None:
+            self._base = int(snap["height"])
+            self._base_tip = (bytes.fromhex(snap["tip"]) if self._base
+                              else GENESIS)
+            self._base_cumwork = int(snap["cumwork"])
+            self._acc = {w: int(u) for w, u in snap.get("acc", {}).items()}
+            after_seq = int(snap["journal_seq"])
+            source = "snapshot"
+        elif self.store.archived_height:
+            S = self.store.archived_height
+            self._base = S
+            self._base_tip, last_share, self._base_cumwork = (
+                self.store.read_record(S - 1))
+            if last_share.algorithm != self.params.algorithm:
+                # same refusal the snapshot path makes: a torn snapshot
+                # must not let a foreign chain's archive restore silently
+                raise ValueError(
+                    f"chain store belongs to a {last_share.algorithm!r} "
+                    f"chain, this node runs {self.params.algorithm!r}")
+            for _h, _sid, share in self.store.read_range(
+                    max(0, S - self.params.window), S):
+                self._acc[share.worker] = (
+                    self._acc.get(share.worker, 0)
+                    + weight_units(share.target))
+            source = "archive"
+        self.tip = self._base_tip if self._base else None
+        # re-arm archived-id duplicate detection over the most recent
+        # archived span (bounded by the cache cap, not chain length)
+        cap = self.store.config.dup_cache_shares
+        if self._base and cap:
+            try:
+                for _h, sid, _share in self.store.read_range(
+                        max(0, self._base - cap), self._base):
+                    self._archived_ids[sid] = None
+            except Exception as e:
+                log.warning("archived-id dup cache rebuild incomplete: %s", e)
+        replayed = reorgs_replayed = skipped = 0
+        self._replaying = True
+        try:
+            from otedama_tpu.p2p import chainstore as cs
+
+            for _seq, rtype, payload in self.store.iter_journal(after_seq):
+                if rtype == cs.REC_REORG:
+                    (nh,) = cs._REORG.unpack(payload)
+                    if self._base <= nh < self.height:
+                        self._rewind_to(nh)
+                        self.tip = (self._chain[-1] if self._chain
+                                    else (self._base_tip if self._base
+                                          else None))
+                        self.reorgs += 1
+                        reorgs_replayed += 1
+                    else:
+                        skipped += 1
+                    continue
+                height, sid, share, _cumwork = cs.decode_extend(payload)
+                expected_prev = (
+                    self._chain[-1] if self._chain
+                    else (self._base_tip if self._base else GENESIS))
+                if (height != self.height
+                        or share.prev_hash != expected_prev
+                        or pow_host.sha256d(share.header) != sid):
+                    # pre-snapshot event, a stale branch, or a hole left
+                    # by a lost write: skip — whatever cannot be folded
+                    # here comes back from peers
+                    skipped += 1
+                    continue
+                # cumwork is re-derived, never trusted from disk — only
+                # the PoW'd header bytes are authoritative
+                parent = self.records.get(expected_prev)
+                cumwork = (parent.cumwork if parent is not None
+                           else self._base_cumwork) + share.work
+                self.records[sid] = _Rec(share, height, cumwork)
+                self._append_best(sid)
+                self.tip = sid
+                self.shares_connected += 1
+                replayed += 1
+                if self.on_connect is not None:
+                    self.on_connect(share)
+        finally:
+            self._replaying = False
+        dt = time.perf_counter() - t0
+        self.store.stats["replayed_records"] = replayed + reorgs_replayed
+        self.store.stats["replay_seconds"] = round(dt, 4)
         return {
+            "source": source,
+            "snapshot_height": self._base if source == "snapshot" else -1,
+            "height": self.height,
+            "replayed": replayed,
+            "reorgs_replayed": reorgs_replayed,
+            "skipped": skipped,
+            "seconds": round(dt, 4),
+        }
+
+    def snapshot(self) -> dict:
+        out = {
             "height": self.height,
             "tip": self.tip.hex() if self.tip is not None else "",
             "tip_work": self.tip_work,
             "records": len(self.records),
+            "archived_height": self._base,
+            "tail": len(self._chain),
+            "acc_workers": len(self._acc),
             "orphans": len(self.orphans),
             "orphans_adopted": self.orphans_adopted,
             "orphans_evicted": self.orphans_evicted,
@@ -546,7 +1039,12 @@ class ShareChain:
             "reorgs": self.reorgs,
             "deepest_reorg": self.deepest_reorg,
             "reorgs_refused": self.reorgs_refused,
+            "stale_refused": self.stale_refused,
+            "persist_failures": self.persist_failures,
             "window": self.params.window,
             "min_difficulty": self.params.min_difficulty,
             "algorithm": self.params.algorithm,
         }
+        if self.store is not None:
+            out["store"] = self.store.snapshot()
+        return out

@@ -156,6 +156,7 @@ class _Commit:
     chain_id: bytes      # chain share id of the latest commit attempt
     worker: str
     job_id: str          # encoded chain claim (job@subid)
+    height: int = -1     # chain height of the latest attempt (-1 = unknown)
     attempts: int = 1
 
 
@@ -223,6 +224,23 @@ class RegionReplicator:
         while len(self._index) > self.config.dedup_window:
             self._index.popitem(last=False)
 
+    def rebuild_index(self) -> int:
+        """Rebuild the cross-region dedup index from chain REPLAY after
+        a cold boot: walk the last ``dedup_window`` best-chain shares —
+        streaming archived segments through the durable chain store as
+        needed — and re-observe each committed submission id, oldest
+        first, exactly as live ``on_connect`` observation would have.
+        Without this a rebooted region forgets every submission it ever
+        committed and a replayed share double-counts; with it the index
+        is byte-identical to a never-crashed region's (tested). Returns
+        the number of chain shares walked."""
+        start = max(0, self.chain.height - self.config.dedup_window)
+        walked = 0
+        for share in self.chain.chain_slice(start, self.chain.height):
+            self._observe(share)
+            walked += 1
+        return walked
+
     def seen_submission(self, header: bytes) -> bool:
         """Chain-backed duplicate check for the stratum servers
         (``ServerConfig.duplicate_checker``): True when this 80-byte
@@ -269,8 +287,16 @@ class RegionReplicator:
         self._pending[tag] = _Commit(
             chain_id=b"" if dropped else share.share_id,
             worker=accepted.worker_user, job_id=claim,
+            height=-1 if dropped else self._height_of(share),
         )
         self.stats["commits"] += 1
+
+    def _height_of(self, share: sharechain.Share) -> int:
+        """The linked height of a just-submitted share — remembered so
+        the recommit sweep can recognize it later even after the chain
+        archives it out of the in-memory records."""
+        rec = self.chain.records.get(share.share_id)
+        return rec.height if rec is not None else -1
 
     async def commit_batch(
         self, batch: list[AcceptedShare]
@@ -366,6 +392,7 @@ class RegionReplicator:
             self._pending[tag] = _Commit(
                 chain_id=b"" if dropped else share.share_id,
                 worker=batch[i].worker_user, job_id=claim,
+                height=-1 if dropped else self._height_of(share),
             )
             self.stats["commits"] += 1
         return outcomes
@@ -408,6 +435,7 @@ class RegionReplicator:
         """
         self.chain.prune_side_branches()
         settled = self.chain.settled_height()
+        base = getattr(self.chain, "archived_height", 0)
         recommitted = 0
         for tag, c in list(self._pending.items()):
             pos = self.chain.position_of(c.chain_id) if c.chain_id else None
@@ -416,6 +444,22 @@ class RegionReplicator:
                     del self._pending[tag]
                     self.stats["settled_safe"] += 1
                 continue
+            # archived out of the in-memory tail: the archive only ever
+            # holds settled BEST-CHAIN positions, so a confirmed point
+            # read means this commit is settled-safe — without the check
+            # an archived pending commit would read as "gone" and be
+            # re-committed, double-counting the submission
+            if c.chain_id and 0 <= c.height < base:
+                try:
+                    on_chain = self.chain.on_best_chain_at(c.chain_id,
+                                                           c.height)
+                except Exception:
+                    continue  # store hiccup: retry next sweep, never
+                              # re-commit blind
+                if on_chain:
+                    del self._pending[tag]
+                    self.stats["settled_safe"] += 1
+                    continue
             if c.chain_id and c.chain_id in self.chain:
                 continue  # side branch / orphan: may yet be adopted
             try:
@@ -427,6 +471,7 @@ class RegionReplicator:
                 log.warning("recommit of %s failed (will retry)", tag)
                 continue
             c.chain_id = share.share_id
+            c.height = self._height_of(share)
             c.attempts += 1
             self.stats["recommits"] += 1
             recommitted += 1

@@ -287,18 +287,28 @@ class SettlementEngine:
         """The persisted cursor must still lie on THIS chain at the
         recorded position — a ledger re-attached to a different chain
         (operator error, wiped node) must refuse loudly, not settle the
-        same shares twice or skip earned ones."""
+        same shares twice or skip earned ones. The check is a point read
+        that also resolves cursors deep in the chain's ARCHIVED segments
+        (long downtime, a node rebooted behind its ledger): the durable
+        chain store serves positions the in-memory tail dropped, so the
+        next tick's ``chain_slice`` resumes over archived history."""
         prev = self.settlements.latest()
         if prev is None:
             return True
-        pos = self.chain.position_of(bytes.fromhex(prev["tip_hash"]))
-        if pos == prev["tip_height"] - 1:
+        pos = int(prev["tip_height"]) - 1
+        checker = getattr(self.chain, "on_best_chain_at", None)
+        if checker is not None:
+            ok = checker(bytes.fromhex(prev["tip_hash"]), pos)
+        else:  # legacy chains without the point check
+            ok = self.chain.position_of(
+                bytes.fromhex(prev["tip_hash"])) == pos
+        if ok:
             return True
         self.stats["horizon_violations"] += 1
         log.error(
             "settlement cursor %s@%d is not on the local chain "
-            "(position %s) — refusing to settle",
-            prev["tip_hash"][:16], prev["tip_height"], pos,
+            "— refusing to settle",
+            prev["tip_hash"][:16], prev["tip_height"],
         )
         return False
 

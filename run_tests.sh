@@ -39,6 +39,14 @@
 #                   sharing a resume secret; writes a BENCH_REGION json
 #                   artifact and fails if visibility or any handoff
 #                   never happened.
+#   chain-bench     opt-in durable-chain bench: cold-boot-to-converged-tip
+#                   vs chain length (10k/100k/1M shares), steady-state
+#                   connect overhead vs the in-memory r09/r14 chain,
+#                   snapshot write/restore cost, and a million-share
+#                   PPLNS window with memory bounded by the in-memory
+#                   tail; asserts incremental weights == full-walk
+#                   oracle (exit 2 otherwise); writes a BENCH_CHAIN
+#                   json artifact.
 #   payout-bench    opt-in settlement-pipeline bench: settlement
 #                   throughput over the sqlite ledger, crash-restart
 #                   recovery time at the lost-verdict boundary, and a
@@ -113,5 +121,8 @@ case "$tier" in
   payout-bench)
     exec env JAX_PLATFORMS=cpu python tools/bench_payout.py \
       --out "${PAYOUT_BENCH_OUT:-BENCH_PAYOUT_manual.json}" "$@" ;;
-  *) echo "usage: $0 [fast|slow|all|audit|stratum-bench|stratum-shard-bench|switch-bench|degrade-bench|engine-bench|validate-bench|sharechain-bench|region-bench|payout-bench] [pytest args...]" >&2; exit 2 ;;
+  chain-bench)
+    exec env JAX_PLATFORMS=cpu python tools/bench_chain.py \
+      --out "${CHAIN_BENCH_OUT:-BENCH_CHAIN_manual.json}" "$@" ;;
+  *) echo "usage: $0 [fast|slow|all|audit|stratum-bench|stratum-shard-bench|switch-bench|degrade-bench|engine-bench|validate-bench|sharechain-bench|region-bench|payout-bench|chain-bench] [pytest args...]" >&2; exit 2 ;;
 esac

@@ -1,0 +1,580 @@
+"""Durable share chain: segment persistence, snapshot cold boot, recovery.
+
+The invariants under test (ISSUE 13 acceptance):
+
+- a node killed at ANY persist boundary (crash images taken after every
+  connect, torn final records, lost journal writes, torn snapshots)
+  cold-boots from segments+snapshot to a converged tip whose weights,
+  height and tip are byte-identical to a never-crashed control — or to
+  a strict prefix that ordinary locator sync completes;
+- replay work is bounded by the unsnapshotted suffix + max_reorg_depth,
+  never chain length (the snapshot carries the archived boundary);
+- the incremental PPLNS window accumulator equals the full-walk oracle
+  bit-for-bit, including across reorgs AT the archive boundary;
+- a million-share-class window runs with memory bounded by the
+  in-memory tail (records never grow with the window);
+- the settlement cursor resumes over archived segments and the region
+  dedup index rebuilds from chain replay, identical to an uncrashed
+  control.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import struct
+import types
+
+import pytest
+
+from otedama_tpu.p2p import chainstore as cs
+from otedama_tpu.p2p import sharechain as sc
+from otedama_tpu.p2p.chainstore import ChainStore, ChainStoreConfig
+from otedama_tpu.p2p.sharechain import ChainParams, ShareChain
+from otedama_tpu.utils import faults
+
+# trivially easy PoW: persistence tests exercise the store, not the
+# grind — a share costs a handful of hashes
+D = 1e-9
+
+
+def params(**kw) -> ChainParams:
+    base = dict(min_difficulty=D, window=8, max_reorg_depth=4,
+                sync_page=5)
+    base.update(kw)
+    return ChainParams(**base)
+
+
+def store_cfg(path, **kw) -> ChainStoreConfig:
+    base = dict(path=str(path), fsync_interval=1, snapshot_interval=4,
+                tail_shares=6, segment_bytes=4096)
+    base.update(kw)
+    return ChainStoreConfig(**base)
+
+
+def mine(n, worker="w", prev=sc.GENESIS, start=0):
+    out = []
+    for i in range(n):
+        s = sc.mine_share(prev, worker, f"j{start + i}", D)
+        out.append(s)
+        prev = s.share_id
+    return out
+
+
+def wjson(chain) -> str:
+    return json.dumps(chain.weights(), sort_keys=True)
+
+
+def assert_weights_match_oracle(chain) -> None:
+    assert wjson(chain) == json.dumps(chain.weights_full(), sort_keys=True)
+
+
+def reboot(path, p=None) -> ShareChain:
+    chain = ShareChain(p or params(), store=ChainStore(store_cfg(path)))
+    chain.load()
+    return chain
+
+
+# -- segment log --------------------------------------------------------------
+
+def test_segment_log_roundtrip_rotation_and_torn_tail(tmp_path):
+    log = cs.SegmentLog(str(tmp_path), "wal", segment_bytes=64)
+    payloads = [bytes([i]) * (i + 1) for i in range(20)]
+    for p in payloads:
+        log.append(cs.REC_EXTEND, p)
+    log.close()
+
+    log2 = cs.SegmentLog(str(tmp_path), "wal", segment_bytes=64)
+    assert log2.seq == 20
+    assert log2.snapshot()["segments"] > 1          # rotation happened
+    got = [(seq, payload) for seq, _t, payload in log2.iter_from(0)]
+    assert got == list(enumerate(payloads))
+    assert [p for _s, _t, p in log2.iter_from(17)] == payloads[17:]
+    log2.close()
+
+    # torn tail: a kill -9 mid-write leaves a partial final record —
+    # truncated at open, everything before it intact
+    last = sorted(f for f in os.listdir(tmp_path) if f.endswith(".seg"))[-1]
+    with open(tmp_path / last, "ab") as f:
+        f.write(b"\xc5\x01")                        # half a frame header
+    log3 = cs.SegmentLog(str(tmp_path), "wal", segment_bytes=64)
+    assert log3.torn_records == 1
+    assert [p for _s, _t, p in log3.iter_from(0)] == payloads
+    log3.close()
+
+
+def test_segment_log_mid_file_corruption_stops_iteration(tmp_path):
+    log = cs.SegmentLog(str(tmp_path), "wal", segment_bytes=1 << 20)
+    for i in range(6):
+        log.append(cs.REC_EXTEND, struct.pack("<I", i))
+    log.close()
+    # flip a byte inside record 3's payload: CRC catches it, iteration
+    # stops THERE — nothing after an unreadable record can be trusted
+    path = tmp_path / sorted(os.listdir(tmp_path))[0]
+    offsets = cs.SegmentLog(str(tmp_path), "wal", 1 << 20)._offsets_for(0)
+    data = bytearray(path.read_bytes())
+    data[offsets[3] + cs._FRAME.size] ^= 0xFF
+    path.write_bytes(bytes(data))
+    log2 = cs.SegmentLog(str(tmp_path), "wal", segment_bytes=1 << 20)
+    assert [struct.unpack("<I", p)[0] for _s, _t, p in log2.iter_from(0)] == [
+        0, 1, 2]
+    log2.close()
+
+
+def test_journal_truncation_after_snapshot(tmp_path):
+    chain = ShareChain(params(), store=ChainStore(store_cfg(
+        tmp_path, snapshot_interval=2, tail_shares=6, segment_bytes=512)))
+    for s in mine(40, "alice"):
+        chain.connect(s)
+        chain.compact()
+    st = chain.store.snapshot()
+    assert st["snapshot_height"] > 0
+    # old journal segments below the snapshot boundary were deleted:
+    # disk does not grow with chain length between snapshots
+    assert st["journal"]["segments"] < 8
+    chain.store.close()
+
+
+# -- cold boot ----------------------------------------------------------------
+
+def test_reboot_identical_to_control_and_oracle(tmp_path):
+    p = params()
+    control = ShareChain(p)
+    durable = ShareChain(p, store=ChainStore(store_cfg(tmp_path)))
+    for s in mine(40, "alice"):
+        assert control.connect(s) == durable.connect(s)
+        durable.compact()
+    durable.store.close()
+
+    booted = reboot(tmp_path, p)
+    assert booted.tip == control.tip
+    assert booted.height == control.height == 40
+    assert wjson(booted) == wjson(control)
+    assert_weights_match_oracle(booted)
+    # replay was bounded: only the unsnapshotted suffix was folded, not
+    # the whole chain
+    assert booted.store.stats["replayed_records"] <= (
+        booted.store.config.snapshot_interval
+        + booted.store.config.tail_shares + p.max_reorg_depth)
+    # the booted node keeps extending where it left off
+    for s in mine(3, "bob", booted.tip, start=100):
+        assert booted.connect(s) == "accepted"
+    assert booted.height == 43
+    assert_weights_match_oracle(booted)
+    booted.store.close()
+
+
+def test_crash_image_at_every_persist_boundary(tmp_path):
+    """The kill -9 sweep: after EVERY connect (fsync_interval=1 makes
+    each best-chain event durable immediately), take a crash image of
+    the store directory; reboot each image and assert tip/height/weights
+    byte-identical to the never-crashed control at that point."""
+    p = params()
+    src = tmp_path / "live"
+    durable = ShareChain(p, store=ChainStore(store_cfg(src)))
+    control = ShareChain(p)
+
+    base = mine(10, "alice")
+    forked = mine(3, "bob", base[5].share_id, start=50)     # depth-4 reorg
+    more = mine(6, "cat", forked[-1].share_id, start=80)
+    script = base + forked + more
+
+    checkpoints = []    # (tip, height, weights json) per boundary
+    for i, s in enumerate(script):
+        control.connect(s)
+        durable.connect(s)
+        durable.compact()
+        durable.store.flush()
+        checkpoints.append((control.tip, control.height, wjson(control)))
+        img = tmp_path / f"img{i:03d}"
+        shutil.copytree(src, img)
+
+    assert control.reorgs == 1 and control.deepest_reorg == 4
+    for i in range(len(script)):
+        booted = reboot(tmp_path / f"img{i:03d}", p)
+        tip, height, weights = checkpoints[i]
+        assert booted.tip == tip, f"boundary {i}: tip diverged"
+        assert booted.height == height, f"boundary {i}: height diverged"
+        assert wjson(booted) == weights, f"boundary {i}: weights diverged"
+        assert_weights_match_oracle(booted)
+        booted.store.close()
+    durable.store.close()
+
+
+def test_torn_snapshot_falls_back_to_archive_walk(tmp_path):
+    p = params()
+    durable = ShareChain(p, store=ChainStore(store_cfg(tmp_path)))
+    for s in mine(30, "alice"):
+        durable.connect(s)
+        durable.compact()
+    durable.store.close()
+    (tmp_path / "snapshot.json").write_text("{torn garbage")
+
+    booted = reboot(tmp_path, p)
+    assert booted.height == 30 and booted.tip == durable.tip
+    assert wjson(booted) == wjson(durable)
+    assert_weights_match_oracle(booted)
+    booted.store.close()
+
+
+def test_dropped_journal_write_heals_via_locator_sync(tmp_path):
+    """chain.persist drop = one best-chain event silently lost: replay
+    stops folding at the hole (the suffix cannot be trusted into the
+    chain), and ordinary locator sync from a peer restores the rest —
+    the documented recovery for every in-flight loss."""
+    p = params()
+    control = ShareChain(p)
+    durable = ShareChain(p, store=ChainStore(store_cfg(tmp_path)))
+    shares = mine(12, "alice")
+    inj = faults.FaultInjector(seed=7).drop(
+        "chain.persist:journal", every_nth=5, max_fires=1)
+    with faults.active(inj):
+        for s in shares:
+            control.connect(s)
+            durable.connect(s)
+    assert inj.rules[0].fires == 1
+    durable.store.close()
+
+    booted = reboot(tmp_path, p)
+    assert booted.height == 4               # prefix up to the hole (event 5)
+    # heal exactly like a partition: paged locator sync from the peer
+    while booted.height < control.height:
+        page, more = control.shares_after(booted.locator())
+        assert page, "sync must make progress"
+        for s in page:
+            booted.connect(s)
+    assert booted.tip == control.tip
+    assert wjson(booted) == wjson(control)
+    assert_weights_match_oracle(booted)
+    booted.store.close()
+
+
+def test_persist_error_degrades_visibly_not_fatally(tmp_path):
+    durable = ShareChain(params(), store=ChainStore(store_cfg(tmp_path)))
+    inj = faults.FaultInjector(seed=3).error("chain.persist:journal",
+                                             every_nth=3)
+    with faults.active(inj):
+        for s in mine(9, "alice"):
+            assert durable.connect(s) == "accepted"
+    assert durable.persist_failures == 3
+    assert durable.height == 9              # consensus never stalled
+    assert durable.snapshot()["store"]["journal"]["appends"] == 6
+    durable.store.close()
+
+
+def test_snapshot_drop_keeps_previous_snapshot(tmp_path):
+    durable = ShareChain(params(), store=ChainStore(store_cfg(
+        tmp_path, snapshot_interval=2)))
+    for s in mine(20, "alice"):
+        durable.connect(s)
+        durable.compact()
+    h1 = durable.store.snapshot_height
+    assert h1 > 0
+    inj = faults.FaultInjector(seed=5).drop("chain.snapshot")
+    with faults.active(inj):
+        for s in mine(10, "bob", durable.tip, start=40):
+            durable.connect(s)
+            durable.compact()
+    assert durable.store.snapshot_height == h1          # old one in force
+    assert durable.store.stats["snapshot_failures"] > 0
+    durable.store.close()
+    booted = reboot(tmp_path)
+    assert booted.height == 30 and booted.tip == durable.tip
+    assert wjson(booted) == wjson(durable)
+    booted.store.close()
+
+
+# -- archived window / weights ------------------------------------------------
+
+def test_archive_boundary_reorg_weights_equal_oracle(tmp_path):
+    """A reorg whose fork point IS the archived boundary share: the
+    rewind pops into window positions that must be re-read from the
+    archive. The incremental accumulator must stay bit-identical to the
+    full walk through it."""
+    p = params(window=8, max_reorg_depth=4)
+    durable = ShareChain(p, store=ChainStore(store_cfg(tmp_path,
+                                                       tail_shares=4)))
+    for s in mine(20, "alice"):
+        durable.connect(s)
+    durable.compact()
+    assert durable._base == 16
+    side = mine(5, "bob", durable._base_tip, start=60)   # fork at base-1
+    for s in side:
+        durable.connect(s)
+    assert durable.tip == side[-1].share_id
+    assert durable.deepest_reorg == 4
+    assert_weights_match_oracle(durable)
+    durable.store.close()
+    booted = reboot(tmp_path, p)
+    assert booted.tip == durable.tip
+    assert wjson(booted) == wjson(durable)
+    assert_weights_match_oracle(booted)
+    booted.store.close()
+
+
+def test_million_class_window_bounded_memory(tmp_path):
+    """A window far larger than RAM should ever hold: memory stays
+    bounded by the tail while the window accumulator spans the whole
+    (archived) history, equal to the full-walk oracle."""
+    p = params(window=1_000_000, max_reorg_depth=8)
+    durable = ShareChain(p, store=ChainStore(store_cfg(
+        tmp_path, tail_shares=64, snapshot_interval=256,
+        fsync_interval=64, segment_bytes=1 << 20)))
+    prev = sc.GENESIS
+    peak_records = 0
+    for i in range(1500):
+        s = sc.mine_share(prev, f"w{i % 7}", f"j{i}", D)
+        durable.connect(s)
+        prev = s.share_id
+        if i % 64 == 63:
+            durable.compact()
+            peak_records = max(peak_records, len(durable.records))
+    durable.compact()
+    # memory bound: records never grow with the window — tail + the
+    # compaction cadence, not 1500 (let alone a million)
+    assert peak_records <= 64 + 8 + 64 + 1
+    assert durable.height == 1500
+    assert_weights_match_oracle(durable)
+    durable.store.close()
+    booted = reboot(tmp_path, p)
+    assert booted.tip == durable.tip
+    assert wjson(booted) == wjson(durable)
+    booted.store.close()
+
+
+# -- downstream consumers -----------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_region_dedup_index_rebuilds_from_replay(tmp_path):
+    """A rebooted region rebuilds its cross-region dedup index from
+    chain replay (archived segments included) identical to the control
+    that never crashed — a replayed submission must still be refused."""
+    from otedama_tpu.p2p.node import NodeConfig
+    from otedama_tpu.p2p.pool import P2PPool
+    from otedama_tpu.pool.regions import RegionConfig, RegionReplicator
+
+    p = params(window=64, max_reorg_depth=4)
+    pool = P2PPool(NodeConfig(node_id="aa" * 32), p,
+                   store=ChainStore(store_cfg(tmp_path)))
+    repl = RegionReplicator(pool, RegionConfig(
+        region_id=0, regions=(0,), session_secret="t"))
+    headers = [struct.pack(">I", k) * 20 for k in range(24)]
+    for k, header in enumerate(headers):
+        await repl.commit(types.SimpleNamespace(
+            header=header, worker_user="ann.w1", job_id=f"jb{k}"))
+    pool.chain.compact()
+    assert pool.chain._base > 0              # dedup span crosses archive
+    pool.chain.store.close()
+    control_index = dict(repl._index)
+
+    pool2 = P2PPool(NodeConfig(node_id="bb" * 32), p,
+                    store=ChainStore(store_cfg(tmp_path)))
+    pool2.chain.load()
+    repl2 = RegionReplicator(pool2, RegionConfig(
+        region_id=0, regions=(0,), session_secret="t"))
+    assert repl2.rebuild_index() == 24
+    assert dict(repl2._index) == control_index
+    for header in headers:
+        assert repl2.seen_submission(header)
+    pool2.chain.store.close()
+
+
+@pytest.mark.asyncio
+async def test_p2p_pool_compacts_and_persists_on_connect_path(tmp_path):
+    """The pool's periodic housekeeping drives archival + fsync without
+    anyone calling compact() by hand."""
+    from otedama_tpu.p2p.node import NodeConfig
+    from otedama_tpu.p2p.pool import P2PPool
+
+    p = params(window=64, max_reorg_depth=4)
+    pool = P2PPool(NodeConfig(node_id="cc" * 32), p,
+                   store=ChainStore(store_cfg(tmp_path, tail_shares=16)))
+    for i in range(300):
+        await pool.announce_share("alice", D, f"j{i}")
+    assert pool.chain._base > 0
+    assert pool.chain.store.persist_lag < 300
+    await pool.stop()                        # closes the store cleanly
+    booted = reboot(tmp_path, p)
+    assert booted.height == 300
+    assert_weights_match_oracle(booted)
+    booted.store.close()
+
+
+def test_chain_metrics_exported(tmp_path):
+    from otedama_tpu.api.server import ApiConfig, ApiServer
+
+    durable = ShareChain(params(), store=ChainStore(store_cfg(tmp_path)))
+    for s in mine(20, "alice"):
+        durable.connect(s)
+        durable.compact()
+    api = ApiServer(ApiConfig(port=0))
+    api.sync_chain_metrics(durable.snapshot())
+    text = api.registry.render()
+    for name in (
+        "otedama_chain_archived_height",
+        "otedama_chain_tail_shares",
+        "otedama_chain_persist_lag",
+        "otedama_chain_snapshot_height",
+        "otedama_chain_segments",
+        "otedama_chain_segment_bytes",
+        "otedama_chain_fsyncs_total",
+        "otedama_chain_replay_seconds",
+    ):
+        assert name in text, f"missing metric {name}"
+    assert 'otedama_chain_segments{log="archive"}' in text
+    durable.store.close()
+
+
+@pytest.mark.asyncio
+async def test_app_wires_durable_chain_and_restores_on_boot(tmp_path):
+    """p2p.chain_dir wires a ChainStore into the app's P2P pool, loads
+    the chain BEFORE the overlay starts, and a restarted app resumes at
+    the converged tip with identical weights."""
+    from otedama_tpu.app import Application
+    from otedama_tpu.config.schema import AppConfig, validate_config
+
+    def make_cfg():
+        cfg = AppConfig()
+        cfg.mining.enabled = False
+        cfg.api.enabled = False
+        cfg.p2p.enabled = True
+        cfg.p2p.host = "127.0.0.1"
+        cfg.p2p.port = 0
+        cfg.p2p.share_difficulty = D
+        cfg.p2p.chain_dir = str(tmp_path / "chain")
+        cfg.p2p.chain_fsync_interval = 1
+        cfg.p2p.chain_snapshot_interval = 8
+        cfg.p2p.chain_tail_shares = 16
+        cfg.p2p.max_reorg_depth = 8
+        assert validate_config(cfg) == []
+        return cfg
+
+    app = Application(make_cfg())
+    await app.start()
+    try:
+        assert app.p2p.chain.store is not None
+        for i in range(20):
+            await app.p2p.announce_share("alice", D, f"j{i}")
+        tip, weights = app.p2p.chain.tip, wjson(app.p2p.chain)
+    finally:
+        await app.stop()
+
+    app2 = Application(make_cfg())
+    await app2.start()
+    try:
+        assert app2.p2p.chain.height == 20
+        assert app2.p2p.chain.tip == tip
+        assert wjson(app2.p2p.chain) == weights
+        assert_weights_match_oracle(app2.p2p.chain)
+        snap = app2.p2p.snapshot()
+        assert snap["chain"]["store"]["archived_height"] >= 0
+    finally:
+        await app2.stop()
+
+
+def test_archived_shares_still_detected_as_duplicates(tmp_path):
+    """Records below the in-memory tail used to live in RAM forever and
+    answered 'duplicate' to replayed gossip; the bounded archived-id
+    cache must keep doing that — across a reboot too — so ancient
+    replays neither churn the orphan pool nor re-flood."""
+    p = params()
+    durable = ShareChain(p, store=ChainStore(store_cfg(tmp_path)))
+    shares = mine(30, "alice")
+    for s in shares:
+        durable.connect(s)
+    durable.compact()
+    assert durable._base > 0
+    for s in shares:                         # includes archived positions
+        assert durable.connect(s) == "duplicate"
+    assert not durable.orphans
+    # a NEW share extending an archived ancestor is refused as stale —
+    # it forks deeper than any permitted reorg by construction, so it
+    # must neither occupy the orphan pen nor read as fresh news
+    stale = sc.mine_share(shares[2].share_id, "mallory", "jx", D)
+    assert durable.connect(stale) == "stale"
+    assert durable.stale_refused == 1 and not durable.orphans
+    durable.store.close()
+
+    booted = reboot(tmp_path, p)
+    for s in shares:
+        assert booted.connect(s) == "duplicate"
+    assert not booted.orphans
+    booted.store.close()
+
+
+@pytest.mark.asyncio
+async def test_recommit_sweep_forgets_archived_commits(tmp_path):
+    """A pending region commit whose chain share gets archived out of
+    the in-memory tail is settled-safe BY CONSTRUCTION (only settled
+    best-chain positions archive) — the sweep must forget it, never
+    re-commit it (which would double-count the submission)."""
+    from otedama_tpu.p2p.node import NodeConfig
+    from otedama_tpu.p2p.pool import P2PPool
+    from otedama_tpu.pool.regions import RegionConfig, RegionReplicator
+
+    p = params(window=64, max_reorg_depth=4)
+    pool = P2PPool(NodeConfig(node_id="dd" * 32), p,
+                   store=ChainStore(store_cfg(tmp_path, tail_shares=4)))
+    repl = RegionReplicator(pool, RegionConfig(
+        region_id=0, regions=(0,), session_secret="t"))
+    for k in range(20):
+        await repl.commit(types.SimpleNamespace(
+            header=struct.pack(">I", k) * 20, worker_user="ann.w1",
+            job_id=f"jb{k}"))
+    pool.chain.compact()
+    # every tracked commit now sits below the archived boundary or in
+    # the short tail; the sweep must classify them settled-safe/waiting
+    assert any(c.height < pool.chain._base
+               for c in repl._pending.values() if c.chain_id)
+    height_before = pool.chain.height
+    assert await repl.recommit_dropped() == 0
+    assert repl.stats["recommits"] == 0
+    assert pool.chain.height == height_before   # nothing re-ground
+    assert repl.pending_commits() < 20          # archived ones forgotten
+    pool.chain.store.close()
+
+
+def test_archive_truncation_fails_slices_loudly(tmp_path):
+    """A hole mid-archive must make range consumers (settlement slices,
+    oracle walks) raise — not silently return a window with shares
+    missing — while the connect path merely degrades and counts."""
+    durable = ShareChain(params(), store=ChainStore(store_cfg(
+        tmp_path, segment_bytes=1024)))
+    for s in mine(40, "alice"):
+        durable.connect(s)
+    durable.compact()
+    assert durable._base >= 10
+    durable.store.close()
+
+    # corrupt a record in the FIRST archive segment (not the tail — the
+    # tail-truncation policy owns that case, covered above)
+    arcs = sorted(f for f in os.listdir(tmp_path) if f.startswith("arc-"))
+    assert len(arcs) > 1
+    data = bytearray((tmp_path / arcs[0]).read_bytes())
+    data[cs._FRAME.size + 2] ^= 0xFF
+    (tmp_path / arcs[0]).write_bytes(bytes(data))
+
+    store = ChainStore(ChainStoreConfig(path=str(tmp_path),
+                                        segment_bytes=1024))
+    with pytest.raises(cs.ChainStoreError):
+        list(store.read_range(0, store.archived_height))
+    store.close()
+
+
+def test_archive_fallback_refuses_foreign_chain(tmp_path):
+    """A torn snapshot must not let a foreign chain's archive restore
+    silently: the archive-walk fallback makes the same algorithm
+    refusal the snapshot path does."""
+    durable = ShareChain(params(), store=ChainStore(store_cfg(tmp_path)))
+    for s in mine(20, "alice"):
+        durable.connect(s)
+    durable.compact()
+    durable.store.close()
+    (tmp_path / "snapshot.json").write_text("{torn")
+    wrong = ShareChain(ChainParams(algorithm="scrypt", min_difficulty=D,
+                                   window=8, max_reorg_depth=4),
+                       store=ChainStore(store_cfg(tmp_path)))
+    with pytest.raises(ValueError, match="sha256d"):
+        wrong.load()
+    wrong.store.close()
