@@ -720,8 +720,41 @@ class ApiServer:
         if not store:
             return
         reg.gauge_set("otedama_chain_persist_lag", store.get("persist_lag", 0),
-                      help_="Best-chain events linked but not yet fsynced "
-                            "(lost by a crash right now; peers restore them)")
+                      help_="Best-chain events linked but not yet covered by "
+                            "the durability watermark (lost by a crash right "
+                            "now; peers restore them)")
+        reg.gauge_set("otedama_chain_persisted_height",
+                      store.get("persisted_height", -1),
+                      help_="Durability watermark: highest best-chain "
+                            "position the journal fsync has covered")
+        reg.gauge_set("otedama_chain_writer_ring_depth",
+                      store.get("ring_depth", 0),
+                      help_="Events queued between the commit path and the "
+                            "journal writer thread")
+        reg.gauge_set("otedama_chain_writer_degraded",
+                      1.0 if store.get("degraded") else 0.0,
+                      help_="1 while the journal writer's last pass hit an "
+                            "IO failure (durability degraded, not wedged)")
+        reg.gauge_set("otedama_chain_persist_lag_alarm",
+                      1.0 if store.get("lag_alarm") else 0.0,
+                      help_="1 while the persist lag has stayed above the "
+                            "sustained-lag threshold (writer not keeping up)")
+        reg.counter_set("otedama_chain_writer_errors_total",
+                        store.get("writer_errors", 0),
+                        help_="Writer-thread IO/fsync failures (the "
+                              "watermark advanced degraded-but-visible)")
+        reg.counter_set("otedama_chain_ring_dropped_total",
+                        store.get("ring_dropped", 0),
+                        help_="Journal events dropped because the writer "
+                              "ring was full (wedged disk backpressure)")
+        fb = store.get("fsync_batch") or {}
+        if fb.get("count"):
+            reg.histogram_set(
+                "otedama_chain_fsync_batch_size",
+                dict(zip(fb.get("bounds", []), fb.get("counts", []))),
+                fb.get("sum", 0.0), fb.get("count", 0),
+                help_="Best-chain events folded into each writer "
+                      "group-fsync")
         reg.gauge_set("otedama_chain_snapshot_age_seconds",
                       store.get("snapshot_age_seconds", -1),
                       help_="Seconds since the last chain snapshot (-1 = none)")

@@ -610,6 +610,8 @@ class Application:
                 fsync_interval=cfg.chain_fsync_interval,
                 snapshot_interval=cfg.chain_snapshot_interval,
                 tail_shares=cfg.chain_tail_shares,
+                durability=cfg.chain_durability,
+                ring_max=cfg.chain_ring_max,
             ))
         self.p2p = P2PPool(
             NodeConfig(
@@ -634,9 +636,10 @@ class Application:
             info = self.p2p.chain.load()
             log.info(
                 "share chain restored from %s: height %d via %s "
-                "(%d events replayed in %.3fs)", cfg.chain_dir,
-                info["height"], info["source"],
+                "(%d events replayed in %.3fs; durability mode %s)",
+                cfg.chain_dir, info["height"], info["source"],
                 info["replayed"] + info["reorgs_replayed"], info["seconds"],
+                cfg.chain_durability,
             )
         await self.p2p.start()
         self._started.append(self.p2p)

@@ -268,13 +268,28 @@ class P2PConfig:
     # in-memory only (a reboot forfeits the window and re-syncs from
     # peers — the pre-persistence behavior)
     chain_dir: str = ""
-    # journal appends per fsync: 1 = every best-chain event durable
-    # before the next (slowest, zero persist lag); larger batches trade
-    # a bounded crash-loss window (visible as otedama_chain_persist_lag)
-    # for connect throughput
+    # MOST journal events the store's writer thread folds into one
+    # group-fsync (1 = every best-chain event fsynced individually).
+    # The commit path never waits on this — it enqueues and returns;
+    # the knob shapes the watermark's advance granularity and the
+    # crash-loss window (visible as otedama_chain_persist_lag)
     chain_fsync_interval: int = 64
     # segment file rotation threshold, bytes
     chain_segment_bytes: int = 8 << 20
+    # durability contract consumers honour ("ack" | "async"):
+    #   ack   = the group-commit ledger awaits the durability watermark
+    #           between chain commit and db transaction, so a miner is
+    #           never told "accepted" for a share the journal could
+    #           lose (durable-before-verdict, the r16 guarantee at
+    #           pipeline cost instead of synchronous-write cost);
+    #   async = verdicts return after the in-memory link; a crash loses
+    #           at most the exported persist lag (gossip-only /
+    #           non-ledger nodes, where no miner verdict exists anyway)
+    chain_durability: str = "ack"
+    # bounded event ring between the commit path and the writer thread;
+    # a wedged disk that fills it DROPS further journal events (counted,
+    # alarmed, healed from peers) instead of stalling the event loop
+    chain_ring_max: int = 65536
     # write a snapshot each time the archived boundary advances this
     # many shares (bounds cold-boot replay to ~this + max_reorg_depth)
     chain_snapshot_interval: int = 8192
@@ -296,8 +311,14 @@ class ValidationSettings:
 
     enabled: bool = False
     # batches under this many shares skip the device (dispatch overhead
-    # loses below a measured knee — tools/bench_validate.py measures it)
-    min_batch: int = 32
+    # loses below a measured knee — tools/bench_validate.py measures
+    # it). Default from the BENCH_VALIDATE_r15 sha256d crossover probe:
+    # the device path first wins at batch 128 (14.9 vs 25.2 µs/share)
+    # and LOSES at 8/32 — and that probe ran the batched pipeline on an
+    # accelerator-shaped backend; CPU-fallback hosts should keep the
+    # host path outright (enabled: false, or quarantine does it for
+    # you), not lower this knob
+    min_batch: int = 128
     # fraction of every device batch re-verified through the host
     # oracle (0 disables the tripwire — not recommended; >0 always
     # re-checks at least one share per batch)
@@ -562,6 +583,13 @@ def validate_config(cfg: AppConfig) -> list[str]:
             "p2p.chain_tail_shares must be >= p2p.max_reorg_depth "
             "(the mutable suffix must stay in memory)"
         )
+    if cfg.p2p.chain_durability not in ("ack", "async"):
+        errors.append("p2p.chain_durability must be 'ack' or 'async'")
+    if cfg.p2p.chain_ring_max < cfg.p2p.chain_fsync_interval:
+        errors.append(
+            "p2p.chain_ring_max must be >= p2p.chain_fsync_interval "
+            "(the writer must be able to assemble one fsync group)"
+        )
     return errors
 
 
@@ -621,7 +649,9 @@ region:
 
 validation:
   enabled: false       # device-batched share validation (needs pool or p2p)
-  min_batch: 32        # below this many shares the host path is faster
+  min_batch: 128       # measured sha256d crossover (BENCH_VALIDATE_r15):
+                       # device wins only at batch >= 128, and only WITH an
+                       # accelerator — CPU-fallback hosts keep the host path
   tripwire_rate: 0.05  # host-oracle sample per device batch (corruption trap)
   quarantine_seconds: 60.0  # device-path timeout after an error/mismatch
   x11_chain: numpy     # x11 tier: numpy (lane-parallel host) | jax (device)
@@ -638,10 +668,14 @@ p2p:
   share_interval: 10.0    # intended share cadence, seconds
   sync_page: 200          # shares per locator-sync page
   chain_dir: ""           # durable chain store directory (empty = memory only)
-  chain_fsync_interval: 64     # journal appends per fsync (1 = per event)
+  chain_fsync_interval: 64     # max journal events per writer group-fsync
   chain_segment_bytes: 8388608 # segment rotation threshold
   chain_snapshot_interval: 8192  # shares archived between snapshots
   chain_tail_shares: 16384     # in-memory best-chain tail (bounds RAM)
+  chain_durability: ack   # ack = ledger awaits the journal watermark before
+                          # any verdict/db row; async = ack immediately,
+                          # crash loss bounded by the persist-lag export
+  chain_ring_max: 65536   # bounded commit->writer event ring
 
 api:
   enabled: true

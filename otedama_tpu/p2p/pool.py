@@ -543,12 +543,13 @@ class P2PPool:
     def _maybe_prune(self) -> None:
         """Periodic housekeeping on the connect path: side branches past
         the reorg horizon are dropped, and — with a chain store — the
-        settled prefix is archived out of memory, snapshots checkpoint
-        the boundary, and the journal's batched fsync flushes
-        (``ShareChain.compact``), which is what bounds both RAM and the
-        persist lag under sustained traffic. Delta-gated, not modulo:
-        orphan adoption and sync pages link several shares per call and
-        would step over exact multiples."""
+        settled prefix is STAGED out of memory and snapshots are queued
+        (``ShareChain.compact``). All disk work (archive appends, the
+        O(tail) snapshot rewrite, fsyncs) happens on the store's writer
+        thread; this call is dict work only, so the gossip pump never
+        stalls behind persistence. Delta-gated, not modulo: orphan
+        adoption and sync pages link several shares per call and would
+        step over exact multiples."""
         if self.chain.shares_connected - self._last_prune >= 256:
             self._last_prune = self.chain.shares_connected
             self.chain.compact()

@@ -350,14 +350,21 @@ class ShareChain:
     makes the reorg bookkeeping trivially race-free.
 
     With a ``store`` (p2p/chainstore.py) attached, the chain is durable
-    and MEMORY-BOUNDED: every best-chain extension/reorg is journaled
-    (fsync-batched), settled positions are archived out of RAM behind a
-    fixed in-memory tail (``compact()``), checkpointed snapshots make a
-    reboot replay only the mutable tail (``load()``), and the PPLNS
-    window — maintained as an exact integer per-worker accumulator, not
-    an O(window) walk — can span millions of shares while memory holds
-    only ``tail_shares`` records. Without a store nothing changes
-    except ``weights()`` getting O(workers) instead of O(window).
+    and MEMORY-BOUNDED: every best-chain extension/reorg is enqueued
+    onto the store's event ring (µs — the encode/CRC/write/fsync all
+    happen on the store's dedicated writer thread), settled positions
+    are archived out of RAM behind a fixed in-memory tail
+    (``compact()`` stages them; the writer lands them), checkpointed
+    snapshots make a reboot replay only the mutable tail (``load()``),
+    and the PPLNS window — maintained as an exact integer per-worker
+    accumulator, not an O(window) walk — can span millions of shares
+    while memory holds only ``tail_shares`` records. Durability is a
+    WATERMARK, not a blocking write: consumers that must not ack before
+    the disk has the share (the group-commit ledger in
+    ``chain.durability: ack`` mode) ``await wait_persisted()``; everyone
+    else proceeds after the in-memory link with crash loss bounded by
+    the exported persist lag. Without a store nothing changes except
+    ``weights()`` getting O(workers) instead of O(window).
     """
 
     def __init__(self, params: ChainParams | None = None, store=None):
@@ -385,6 +392,12 @@ class ShareChain:
         # over the last `window` best-chain shares, maintained on every
         # extend/rewind (checked against the full walk in tests)
         self._acc: dict[str, int] = {}
+        # its twin AT the archived boundary: the window accumulator as
+        # of position _base, advanced incrementally each compact() so a
+        # snapshot captures it in O(workers) instead of re-deriving it
+        # with an O(tail) walk on the event loop (kept equal to
+        # _acc_at_base() by construction; crash-image tests pin it)
+        self._acc_base: dict[str, int] = {}
         # read-ahead cache for window-edge archive lookups (the share
         # leaving the window advances sequentially with the tip)
         self._edge_cache: OrderedDict[int, tuple[str, int]] = OrderedDict()
@@ -406,7 +419,7 @@ class ShareChain:
         self.deepest_reorg = 0
         self.reorgs_refused = 0
         self.stale_refused = 0
-        self.persist_failures = 0
+        self._persist_failures = 0
 
     # -- views ---------------------------------------------------------------
 
@@ -428,6 +441,54 @@ class ShareChain:
             return 0
         rec = self.records.get(self.tip)
         return rec.cumwork if rec is not None else self._base_cumwork
+
+    @property
+    def persist_failures(self) -> int:
+        """Chain-side staging failures + the store writer's journal/
+        archive failures — one degraded-durability counter however the
+        loss happened (the metric surface r16 exported, preserved)."""
+        total = self._persist_failures
+        if self.store is not None:
+            total += self.store.stats.get("persist_failures", 0)
+        return total
+
+    # -- durability watermark -------------------------------------------------
+
+    def durability_barrier(self) -> int:
+        """The store watermark value covering every best-chain event
+        submitted so far (0 without a store)."""
+        return self.store.barrier_seq() if self.store is not None else 0
+
+    async def wait_persisted(self, seq: int | None = None) -> None:
+        """Await the durability watermark covering ``seq`` (default:
+        everything submitted so far). THE ack-mode primitive: the
+        group-commit ledger calls this between its chain commit and its
+        db transaction, so no miner is ever told "accepted" for a share
+        a crash could take from the journal. Returns immediately without
+        a store, and returns (rather than wedging) when the writer is
+        degraded — failures are counted and alarmed, never blocking."""
+        if self.store is None:
+            return
+        await self.store.wait_seq(
+            self.store.barrier_seq() if seq is None else seq)
+
+    def persisted_height(self) -> int:
+        """Monotonic height watermark: the highest best-chain position
+        ever covered by a journal fsync (+1 semantics match ``height``:
+        positions <= this are durable). Without a store the whole chain
+        counts (memory is all the durability there is). Downstream
+        consumers — the region recommit sweep — use this to avoid
+        forgetting a tracked commit before the journal can prove it."""
+        if self.store is None:
+            return self.height - 1
+        return self.store.persisted_height
+
+    def drain(self, timeout: float = 60.0) -> bool:
+        """Thread-blocking flush of the store's writer pipeline (tests,
+        benches, shutdown — never the event loop)."""
+        if self.store is None:
+            return True
+        return self.store.drain(timeout)
 
     def __contains__(self, share_id: bytes) -> bool:
         return (share_id in self.records or share_id in self.orphans
@@ -641,12 +702,19 @@ class ShareChain:
         h = self.height
         self._pos[sid] = h
         self._chain.append(sid)
-        share = self.records[sid].share
-        self._push_acc(share)
+        rec = self.records[sid]
+        self._push_acc(rec.share)
         if self.store is not None and not self._replaying:
-            cumwork = self.records[sid].cumwork
-            self._persist("journal", lambda: self.store.append_extend(
-                h, share, sid, cumwork))
+            # inline rather than through _persist: this is THE hottest
+            # persistence call and a closure allocation per connect was
+            # measurable at bench rates (the submit only enqueues; real
+            # IO failures surface on the writer thread, counted there)
+            try:
+                self.store.append_extend(h, rec.share, sid, rec.cumwork)
+            except Exception as e:
+                self._persist_failures += 1
+                log.warning("chain journal persistence failed "
+                            "(continuing in-memory): %s", e)
 
     def _persist(self, what: str, fn) -> None:
         """Run one store operation; a persistence failure NEVER poisons
@@ -655,7 +723,7 @@ class ShareChain:
         try:
             fn()
         except Exception as e:
-            self.persist_failures += 1
+            self._persist_failures += 1
             log.warning("chain %s persistence failed (continuing "
                         "in-memory): %s", what, e)
 
@@ -674,7 +742,7 @@ class ShareChain:
             try:
                 worker, units = self._window_entry(lo - 1)
             except Exception as e:
-                self.persist_failures += 1
+                self._persist_failures += 1
                 log.error("window-edge read failed at %d (weights "
                           "degraded until restored from peers): %s",
                           lo - 1, e)
@@ -692,21 +760,23 @@ class ShareChain:
             try:
                 worker, units = self._window_entry(lo - 1)
             except Exception as e:
-                self.persist_failures += 1
+                self._persist_failures += 1
                 log.error("window-edge read failed at %d (weights "
                           "degraded until restored from peers): %s",
                           lo - 1, e)
                 return
             self._acc[worker] = self._acc.get(worker, 0) + units
 
-    def _acc_sub(self, worker: str, units: int) -> None:
-        left = self._acc.get(worker, 0) - units
+    def _acc_sub(self, worker: str, units: int,
+                 acc: dict[str, int] | None = None) -> None:
+        acc = self._acc if acc is None else acc
+        left = acc.get(worker, 0) - units
         if left == 0:
-            self._acc.pop(worker, None)
+            acc.pop(worker, None)
         else:
             # a negative residue would be an accounting bug — keep it
             # visible in weights() rather than silently clamping
-            self._acc[worker] = left
+            acc[worker] = left
 
     def _window_entry(self, height: int) -> tuple[str, int]:
         """(worker, weight units) of the best-chain share at an absolute
@@ -789,6 +859,12 @@ class ShareChain:
         store) — they serve locator sync from genesis either way."""
         if self.tip is None:
             return 0
+        if len(self.records) == len(self._pos):
+            # every linked record is ON the best chain: nothing to scan.
+            # The full-records sweep below is O(tail) — paying it every
+            # housekeeping pass when no fork ever happened was a
+            # measurable slice of the durable connect path.
+            return 0
         horizon = self.height - 1 - self.params.max_reorg_depth
         doomed = [
             sid for sid, rec in self.records.items()
@@ -799,87 +875,127 @@ class ShareChain:
         return len(doomed)
 
     def compact(self) -> int:
-        """One housekeeping pass: prune dead side branches, archive the
+        """One housekeeping pass: prune dead side branches, STAGE the
         settled best-chain prefix out of memory behind the configured
-        tail, snapshot if the archived boundary advanced enough, and
-        flush the journal's batched fsync. This is what bounds memory:
-        after a compact, RAM holds at most ``tail_shares`` + the reorg
-        horizon + live side branches, regardless of window or chain
-        length. No-op beyond pruning when no store is attached."""
+        tail (the store's writer thread lands the records on disk), and
+        queue a snapshot if the archived boundary advanced enough. This
+        is what bounds memory: after a compact, RAM holds at most
+        ``tail_shares`` + the reorg horizon + live side branches +
+        whatever the writer has not flushed yet, regardless of window or
+        chain length. Nothing here touches the disk on the calling
+        thread — the event loop pays dict work only. No-op beyond
+        pruning when no store is attached."""
         pruned = self.prune_side_branches()
         if self.store is None:
             return pruned
         new_base = max(self._base, min(
             self.settled_height(),
             self.height - self.store.config.tail_shares))
-        done = 0
-        for i in range(new_base - self._base):
-            sid = self._chain[i]
-            rec = self.records[sid]
+        count = new_base - self._base
+        if count > 0:
+            batch = []
+            for i in range(count):
+                sid = self._chain[i]
+                rec = self.records[sid]
+                batch.append((self._base + i, sid, rec.share, rec.cumwork))
             try:
-                self.store.archive_extend(self._base + i, rec.share, sid,
-                                          rec.cumwork)
+                self.store.stage_archive(batch)
             except Exception as e:
-                self.persist_failures += 1
-                log.warning("chain archive persistence failed "
+                self._persist_failures += 1
+                log.warning("chain archive staging failed "
                             "(keeping records in memory): %s", e)
-                break
-            done += 1
-        if done:
-            last = self._chain[done - 1]
-            self._base_cumwork = self.records[last].cumwork
-            self._base_tip = last
-            for sid in self._chain[:done]:
-                del self.records[sid]
-                del self._pos[sid]
-                self._archived_ids[sid] = None
-            del self._chain[:done]
-            self._base += done
-            cap = self.store.config.dup_cache_shares
-            while len(self._archived_ids) > cap:
-                self._archived_ids.popitem(last=False)
-            interval = self.store.config.snapshot_interval
-            if self._base - max(self.store.snapshot_height, 0) >= interval:
-                # guarded like every other store operation: a failing
-                # snapshot (corrupt archive read in _acc_at_base, ENOSPC
-                # on the fsync) must degrade durability visibly, never
-                # reject the share being connected right now
-                self._persist("snapshot", self.write_snapshot)
-        self._persist("flush", self.store.flush)
+            else:
+                # advance the boundary accumulator over the archived
+                # span: each share enters its window, the share falling
+                # off that window's far edge leaves (mirror of
+                # _push_acc, at the boundary instead of the tip)
+                w = self.params.window
+                for i, (h, _sid, share, _cw) in enumerate(batch):
+                    self._acc_base[share.worker] = (
+                        self._acc_base.get(share.worker, 0)
+                        + weight_units(share.target))
+                    lo = h + 1 - w
+                    if lo > 0:
+                        try:
+                            worker, units = self._window_entry(lo - 1)
+                        except Exception as e:
+                            self._persist_failures += 1
+                            log.error("boundary window-edge read failed "
+                                      "at %d: %s", lo - 1, e)
+                            continue
+                        self._acc_sub(worker, units, self._acc_base)
+                last = self._chain[count - 1]
+                self._base_cumwork = self.records[last].cumwork
+                self._base_tip = last
+                for sid in self._chain[:count]:
+                    del self.records[sid]
+                    del self._pos[sid]
+                    self._archived_ids[sid] = None
+                del self._chain[:count]
+                self._base += count
+                cap = self.store.config.dup_cache_shares
+                while len(self._archived_ids) > cap:
+                    self._archived_ids.popitem(last=False)
+                interval = self.store.config.snapshot_interval
+                if self._base - max(self.store.snapshot_height, 0) >= interval:
+                    # guarded like every other store operation: a failing
+                    # snapshot submission must degrade durability visibly,
+                    # never reject the share being connected right now
+                    self._persist("snapshot", self.request_snapshot)
         return pruned
 
     # -- snapshots / cold boot ------------------------------------------------
 
-    def write_snapshot(self) -> bool:
-        """Checkpoint the archived boundary: per-worker window
-        accumulator AT the boundary (exact integers), tip/cumwork there,
-        and the journal boundary — after rewriting the in-memory tail as
-        fresh journal records so replay is exactly snapshot + suffix.
-        A failed snapshot leaves the previous one in force."""
+    def _snapshot_job(self) -> tuple[dict, list | None] | None:
+        """Capture the checkpoint INPUTS on the calling thread: the
+        boundary state (per-worker window accumulator AT the boundary —
+        the incrementally maintained ``_acc_base``, O(workers) to copy;
+        tip/cumwork there) and, only when the store's height->seq map
+        cannot name the replay boundary (pre-boot heights, dropped
+        events), a copy-on-write view of the in-memory tail for the
+        writer's fallback rewrite. The chain mutating afterwards cannot
+        skew the captures, and the event ring's FIFO orders the
+        snapshot after every event already submitted."""
         if self.store is None:
-            return False
-        boundary = self.store.journal.seq - 1
-        try:
-            self.store.journal_rewrite_tail(
-                (self._base + i, self.records[sid].share, sid,
-                 self.records[sid].cumwork)
-                for i, sid in enumerate(self._chain))
-        except Exception as e:
-            self.persist_failures += 1
-            self.store.stats["snapshot_failures"] += 1
-            log.warning("snapshot tail rewrite failed (previous snapshot "
-                        "stays): %s", e)
-            return False
+            return None
         state = {
             "height": self._base,
             "tip": self._base_tip.hex(),
             "cumwork": str(self._base_cumwork),
-            "acc": {w: str(u) for w, u in self._acc_at_base().items()},
-            "journal_seq": boundary,
+            "acc": {w: str(u) for w, u in self._acc_base.items()},
             "params": {"algorithm": self.params.algorithm,
                        "window": self.params.window},
         }
-        return self.store.write_snapshot(state)
+        tail: list | None = None
+        if self._chain and not self.store.can_bound(self._base):
+            tail = [(self._base + i, self.records[sid].share, sid,
+                     self.records[sid].cumwork)
+                    for i, sid in enumerate(self._chain)]
+        elif not self._chain:
+            tail = []
+        return state, tail
+
+    def request_snapshot(self) -> bool:
+        """Queue a checkpoint onto the store's writer (non-blocking —
+        the connect path's spelling). False when one is already in
+        flight or the store refused the submission."""
+        job = self._snapshot_job()
+        if job is None:
+            return False
+        return self.store.submit_snapshot(*job) is not None
+
+    def write_snapshot(self, timeout: float = 120.0) -> bool:
+        """Blocking checkpoint (benches, tests, shutdown hooks — never
+        the event loop): queue the snapshot and wait for the writer to
+        land it. A failed snapshot leaves the previous one in force."""
+        job = self._snapshot_job()
+        if job is None:
+            return False
+        box = self.store.submit_snapshot(*job)
+        if box is None:
+            return False
+        box["done"].wait(timeout)
+        return bool(box.get("ok"))
 
     def _acc_at_base(self) -> dict[str, int]:
         """The window accumulator AS OF the archived boundary: the live
@@ -955,6 +1071,9 @@ class ShareChain:
                     self._acc.get(share.worker, 0)
                     + weight_units(share.target))
             source = "archive"
+        # the restored accumulator IS the boundary accumulator (nothing
+        # above _base is folded yet); keep its incremental twin in step
+        self._acc_base = dict(self._acc)
         self.tip = self._base_tip if self._base else None
         # re-arm archived-id duplicate detection over the most recent
         # archived span (bounded by the cache cap, not chain length)
@@ -1013,6 +1132,10 @@ class ShareChain:
         dt = time.perf_counter() - t0
         self.store.stats["replayed_records"] = replayed + reorgs_replayed
         self.store.stats["replay_seconds"] = round(dt, 4)
+        # everything restored from disk is durable by definition: seed
+        # the watermark so ack-mode consumers and the recommit sweep
+        # never wait on (or refuse to trust) pre-boot history
+        self.store.note_boot(self.height)
         return {
             "source": source,
             "snapshot_height": self._base if source == "snapshot" else -1,

@@ -178,6 +178,13 @@ class PoolManager:
             # cross-region duplicate and settlement still pays it. Either
             # failure order leaves chain accounting exactly-once.
             await self.replicator.commit(share)
+            # durability watermark (chain.durability: ack): the chain
+            # commit above only LINKED in memory — the store's writer
+            # thread journals it asynchronously. Await the watermark so
+            # the verdict (and the db row) never outruns the journal.
+            wait = getattr(self.replicator, "wait_durable", None)
+            if wait is not None:
+                await wait()
         # one transaction: a write failing mid-sequence (chaos: injected
         # db faults) must roll back the worker counters WITH the missing
         # share row — the servers turn the raised error into a reject, so
@@ -269,6 +276,18 @@ class PoolManager:
                 else:
                     outcomes[pos] = ("err", str(exc) or type(exc).__name__)
             live = chain_live
+            if live:
+                # durability watermark barrier (chain.durability: ack):
+                # ONE await for the whole batch — the writer thread
+                # group-fsyncs the batch's chain events while this
+                # coroutine parks, so durable-before-verdict costs the
+                # pipeline one watermark wait per flush instead of one
+                # synchronous write per share. In async mode this
+                # returns immediately and crash loss is bounded by the
+                # exported persist lag.
+                wait = getattr(self.replicator, "wait_durable", None)
+                if wait is not None:
+                    await wait()
         if not live:
             return outcomes
         # ledger.flush: THE crash window of the group-commit pipeline —

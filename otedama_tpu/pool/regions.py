@@ -298,6 +298,21 @@ class RegionReplicator:
         rec = self.chain.records.get(share.share_id)
         return rec.height if rec is not None else -1
 
+    async def wait_durable(self) -> None:
+        """Durability barrier for the ledger (PoolManager) between the
+        chain commit and the db transaction. In the default
+        ``chain.durability: ack`` mode this awaits the store's
+        watermark covering everything committed so far, so a miner is
+        never told "accepted" for a share a crash could take from the
+        journal; in ``async`` mode (gossip-only / non-ledger nodes) it
+        returns immediately and crash loss is bounded by the exported
+        persist lag. No-op without a durable store."""
+        store = getattr(self.chain, "store", None)
+        if store is None or getattr(store.config, "durability",
+                                    "ack") != "ack":
+            return
+        await self.chain.wait_persisted()
+
     async def commit_batch(
         self, batch: list[AcceptedShare]
     ) -> list[Exception | None]:
@@ -436,11 +451,18 @@ class RegionReplicator:
         self.chain.prune_side_branches()
         settled = self.chain.settled_height()
         base = getattr(self.chain, "archived_height", 0)
+        # the durability watermark: a commit is only FORGOTTEN once the
+        # journal can prove it survived a crash — settled-safe in memory
+        # but past the watermark means a kill -9 right now would boot a
+        # chain without it, and a forgotten commit is one this sweep can
+        # never heal (peers usually restore the tail; the watermark gate
+        # covers the node that was the only holder)
+        durable = self.chain.persisted_height()
         recommitted = 0
         for tag, c in list(self._pending.items()):
             pos = self.chain.position_of(c.chain_id) if c.chain_id else None
             if pos is not None:
-                if pos < settled:
+                if pos < settled and pos <= durable:
                     del self._pending[tag]
                     self.stats["settled_safe"] += 1
                 continue
@@ -456,10 +478,13 @@ class RegionReplicator:
                 except Exception:
                     continue  # store hiccup: retry next sweep, never
                               # re-commit blind
-                if on_chain:
+                if on_chain and c.height <= durable:
                     del self._pending[tag]
                     self.stats["settled_safe"] += 1
                     continue
+                if on_chain:
+                    continue  # archived (staged) but the watermark has
+                              # not covered it yet: keep tracking
             if c.chain_id and c.chain_id in self.chain:
                 continue  # side branch / orphan: may yet be adopted
             try:

@@ -47,7 +47,12 @@ a semantic change — per-share verdicts, the dedup window's
 committed/in-flight claim discipline and chain-first ordering are
 bit-for-bit the per-share path's (an in-batch replay of a key claimed
 by the same batch defers to the next pass, exactly the "await the
-in-flight outcome" rule). Batch shape is observable:
+in-flight outcome" rule). With a durable share chain in
+``chain.durability: ack`` mode, the hook additionally parks on the
+chain store's durability watermark between the chain commit and the
+db transaction — so ``otedama_ledger_flush_seconds`` honestly carries
+the persistence cost, one watermark wait per BATCH instead of one
+synchronous journal write per share. Batch shape is observable:
 ``otedama_ledger_batch_size`` / ``otedama_ledger_flush_seconds``.
 
 **Extranonce partitioning.** The lease space composes PR 8's region

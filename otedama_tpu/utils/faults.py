@@ -43,8 +43,9 @@ Fault point registry (grep for ``faults.hit`` to verify):
     payout.settle                               (pool/settlement.py; tag pipeline stage)
     payout.submit                               (pool/settlement.py wallet send)
     region.sever                                (pool/regions.py commit path; tag region id)
-    chain.persist                               (p2p/chainstore.py journal/archive appends; tag journal|archive)
-    chain.snapshot                              (p2p/chainstore.py write_snapshot)
+    chain.persist                               (p2p/chainstore.py journal/archive appends on the writer thread; tag journal|archive)
+    chain.snapshot                              (p2p/chainstore.py write_snapshot, on the writer thread)
+    chain.fsync                                 (p2p/chainstore.py writer thread, once per journal group-fsync)
     ledger.flush                                (pool/manager.py on_share_batch, between chain and db commit)
     region.handoff                              (stratum/server.py resume verification; tag session id)
     validation.verify                           (runtime/validate.py device verdict; tag algorithm)

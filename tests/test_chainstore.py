@@ -1,12 +1,20 @@
 """Durable share chain: segment persistence, snapshot cold boot, recovery.
 
-The invariants under test (ISSUE 13 acceptance):
+The invariants under test (ISSUE 13 + ISSUE 14 acceptance):
 
 - a node killed at ANY persist boundary (crash images taken after every
   connect, torn final records, lost journal writes, torn snapshots)
   cold-boots from segments+snapshot to a converged tip whose weights,
   height and tip are byte-identical to a never-crashed control — or to
   a strict prefix that ordinary locator sync completes;
+- the PIPELINED writer's new boundary: killed between the in-memory
+  link and the watermark advance, boot converges TO the watermark and
+  peers heal the lost tail; in ``chain.durability: ack`` mode the
+  ledger never acked a share the journal lost (the flush parks on the
+  watermark), while ``async`` acks immediately with loss bounded by
+  the exported persist lag;
+- writer-thread IO errors quarantine LOUDLY (counted, alarmed, visible)
+  and never wedge the commit path behind dead media;
 - replay work is bounded by the unsnapshotted suffix + max_reorg_depth,
   never chain length (the snapshot carries the archived boundary);
 - the incremental PPLNS window accumulator equals the full-walk oracle
@@ -16,14 +24,21 @@ The invariants under test (ISSUE 13 acceptance):
 - the settlement cursor resumes over archived segments and the region
   dedup index rebuilds from chain replay, identical to an uncrashed
   control.
+
+Pipelining note: persistence now happens on the store's writer thread,
+so tests that seed per-event faults or assert on-disk state call
+``chain.drain()`` (the flush barrier) INSIDE the fault scope / before
+inspecting the directory — exactly what a production shutdown hook does.
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
 import os
 import shutil
 import struct
+import time
 import types
 
 import pytest
@@ -128,6 +143,10 @@ def test_journal_truncation_after_snapshot(tmp_path):
     for s in mine(40, "alice"):
         chain.connect(s)
         chain.compact()
+        # lockstep with the writer: this test asserts the DISK shape at
+        # a steady snapshot cadence, so don't let the ring coalesce the
+        # whole run into one lazy checkpoint
+        chain.drain()
     st = chain.store.snapshot()
     assert st["snapshot_height"] > 0
     # old journal segments below the snapshot boundary were deleted:
@@ -145,6 +164,8 @@ def test_reboot_identical_to_control_and_oracle(tmp_path):
     for s in mine(40, "alice"):
         assert control.connect(s) == durable.connect(s)
         durable.compact()
+        durable.drain()   # steady cadence: the replay bound below is a
+        #                   statement about snapshots keeping up
     durable.store.close()
 
     booted = reboot(tmp_path, p)
@@ -185,7 +206,7 @@ def test_crash_image_at_every_persist_boundary(tmp_path):
         control.connect(s)
         durable.connect(s)
         durable.compact()
-        durable.store.flush()
+        assert durable.drain()     # the flush barrier: image = watermark
         checkpoints.append((control.tip, control.height, wjson(control)))
         img = tmp_path / f"img{i:03d}"
         shutil.copytree(src, img)
@@ -233,6 +254,9 @@ def test_dropped_journal_write_heals_via_locator_sync(tmp_path):
         for s in shares:
             control.connect(s)
             durable.connect(s)
+        # the per-event chain.persist hits happen on the writer thread:
+        # drain INSIDE the fault scope so the seeded schedule fires
+        assert durable.drain()
     assert inj.rules[0].fires == 1
     durable.store.close()
 
@@ -257,8 +281,12 @@ def test_persist_error_degrades_visibly_not_fatally(tmp_path):
     with faults.active(inj):
         for s in mine(9, "alice"):
             assert durable.connect(s) == "accepted"
+        assert durable.drain()
     assert durable.persist_failures == 3
     assert durable.height == 9              # consensus never stalled
+    # the watermark advanced past the failed events too: quarantine-
+    # loudly, never wedge (an ack-mode waiter would have been released)
+    assert durable.store.persisted_seq == durable.store.submitted_seq
     assert durable.snapshot()["store"]["journal"]["appends"] == 6
     durable.store.close()
 
@@ -269,6 +297,7 @@ def test_snapshot_drop_keeps_previous_snapshot(tmp_path):
     for s in mine(20, "alice"):
         durable.connect(s)
         durable.compact()
+        durable.drain()
     h1 = durable.store.snapshot_height
     assert h1 > 0
     inj = faults.FaultInjector(seed=5).drop("chain.snapshot")
@@ -276,6 +305,7 @@ def test_snapshot_drop_keeps_previous_snapshot(tmp_path):
         for s in mine(10, "bob", durable.tip, start=40):
             durable.connect(s)
             durable.compact()
+            durable.drain()
     assert durable.store.snapshot_height == h1          # old one in force
     assert durable.store.stats["snapshot_failures"] > 0
     durable.store.close()
@@ -408,6 +438,7 @@ def test_chain_metrics_exported(tmp_path):
     for s in mine(20, "alice"):
         durable.connect(s)
         durable.compact()
+    durable.drain()
     api = ApiServer(ApiConfig(port=0))
     api.sync_chain_metrics(durable.snapshot())
     text = api.registry.render()
@@ -415,6 +446,11 @@ def test_chain_metrics_exported(tmp_path):
         "otedama_chain_archived_height",
         "otedama_chain_tail_shares",
         "otedama_chain_persist_lag",
+        "otedama_chain_persisted_height",
+        "otedama_chain_writer_ring_depth",
+        "otedama_chain_writer_errors_total",
+        "otedama_chain_persist_lag_alarm",
+        "otedama_chain_fsync_batch_size",
         "otedama_chain_snapshot_height",
         "otedama_chain_segments",
         "otedama_chain_segment_bytes",
@@ -523,6 +559,9 @@ async def test_recommit_sweep_forgets_archived_commits(tmp_path):
             header=struct.pack(">I", k) * 20, worker_user="ann.w1",
             job_id=f"jb{k}"))
     pool.chain.compact()
+    # the sweep only forgets commits the durability watermark covers:
+    # wait for the writer to catch up, as steady-state operation does
+    await pool.chain.wait_persisted()
     # every tracked commit now sits below the archived boundary or in
     # the short tail; the sweep must classify them settled-safe/waiting
     assert any(c.height < pool.chain._base
@@ -560,6 +599,225 @@ def test_archive_truncation_fails_slices_loudly(tmp_path):
     with pytest.raises(cs.ChainStoreError):
         list(store.read_range(0, store.archived_height))
     store.close()
+
+
+# -- pipelined writer / durability watermark (ISSUE 14) -----------------------
+
+def _hold_writer(seconds: float) -> faults.FaultInjector:
+    """A seeded plan that stalls the writer's NEXT journal group for
+    ``seconds`` (the chain.fsync delay fires BEFORE the group writes, so
+    nothing of that group reaches disk while it holds)."""
+    return faults.FaultInjector(seed=11).delay(
+        "chain.fsync", seconds=seconds, once=True)
+
+
+def _await_stall(inj: faults.FaultInjector, timeout: float = 5.0) -> None:
+    deadline = time.monotonic() + timeout
+    while inj.rules[0].fires == 0:
+        assert time.monotonic() < deadline, "writer never hit the stall"
+        time.sleep(0.01)
+
+
+async def _await_stall_async(inj: faults.FaultInjector,
+                             timeout: float = 5.0) -> None:
+    """Event-loop-friendly twin: the stalled path (commit -> ring ->
+    writer) needs loop cycles to reach the fault, so poll with awaits."""
+    deadline = time.monotonic() + timeout
+    while inj.rules[0].fires == 0:
+        assert time.monotonic() < deadline, "writer never hit the stall"
+        await asyncio.sleep(0.01)
+
+
+def test_crash_between_link_and_watermark_converges_to_watermark(tmp_path):
+    """THE new boundary: shares linked in memory whose journal group the
+    writer has not fsynced yet. A kill -9 there boots to exactly the
+    watermark, and ordinary locator sync heals the lost tail."""
+    p = params()
+    src = tmp_path / "live"
+    control = ShareChain(p)
+    durable = ShareChain(p, store=ChainStore(store_cfg(src)))
+    shares = mine(12, "alice")
+    for s in shares[:8]:
+        control.connect(s)
+        durable.connect(s)
+    assert durable.drain()
+    assert durable.store.persisted_seq == 8
+    inj = _hold_writer(4.0)
+    with faults.active(inj):
+        for s in shares[8:]:
+            control.connect(s)
+            durable.connect(s)
+        _await_stall(inj)
+        # linked (height 12) but the watermark holds at 8: exactly the
+        # window a crash right now loses
+        assert durable.height == 12
+        assert durable.store.persisted_seq == 8
+        assert durable.store.persist_lag == 4
+        img = tmp_path / "img"
+        shutil.copytree(src, img)       # the kill -9 image
+        assert durable.drain(timeout=30.0)   # writer resumes, catches up
+    assert durable.store.persist_lag == 0
+    durable.store.close()
+
+    booted = reboot(img, p)
+    assert booted.height == 8            # converged TO the watermark
+    assert booted.tip == shares[7].share_id
+    while booted.height < control.height:     # peers heal the lost tail
+        page, _more = control.shares_after(booted.locator())
+        assert page, "locator sync must make progress"
+        for s in page:
+            booted.connect(s)
+    assert booted.tip == control.tip
+    assert wjson(booted) == wjson(control)
+    assert_weights_match_oracle(booted)
+    booted.store.close()
+
+
+def test_fsync_error_quarantines_loudly_never_wedges(tmp_path):
+    """A writer-thread IO failure must be COUNTED and ALARM-visible
+    while the watermark keeps advancing — commits (and ack-mode
+    waiters) are never wedged behind dead media."""
+    durable = ShareChain(params(), store=ChainStore(store_cfg(
+        tmp_path, fsync_interval=1)))   # one event per group: exact plan
+    inj = faults.FaultInjector(seed=5).error("chain.fsync", every_nth=2)
+    with faults.active(inj):
+        for s in mine(8, "alice"):
+            assert durable.connect(s) == "accepted"
+        assert durable.drain()
+        assert durable.store.stats["writer_errors"] == 4
+        # quarantine-loudly: the SEQ watermark advanced for every event
+        # (ack waiters never wedge) ...
+        assert durable.store.persisted_seq == durable.store.submitted_seq
+        # ... but the HEIGHT watermark is pinned below the first hole
+        # the loud loss punched, so durability-gated consumers (the
+        # recommit sweep) never read a lost position as durable
+        assert durable.persisted_height() == 0   # first lost group: h1
+        assert durable.store.degraded
+    assert durable.height == 8
+    snap = durable.snapshot()["store"]
+    assert snap["writer_errors"] == 4
+    durable.store.close()
+    # groups 2,4,6,8 never reached the journal: boot folds to the first
+    # hole and (in production) peers restore the rest via locator sync
+    booted = reboot(tmp_path)
+    assert booted.height == 1
+    booted.store.close()
+
+
+def _accepted(k: int, worker: str = "ann.w1"):
+    from otedama_tpu.stratum.server import AcceptedShare
+    from otedama_tpu.utils import pow_host
+
+    header = struct.pack(">I", k) * 20
+    return AcceptedShare(
+        session_id=1, worker_user=worker, job_id=f"jb{k}",
+        difficulty=1.0, actual_difficulty=1.0,
+        # a sha256d share's digest IS its submission id downstream (the
+        # replicator's memoization seam) — carry the real one
+        digest=pow_host.sha256d(header), header=header,
+        extranonce2=b"\x00" * 4,
+        ntime=0, nonce_word=k, is_block=False, submitted_at=1e9 + k,
+    )
+
+
+def _ledger_fixture(tmp_path, durability: str):
+    from otedama_tpu.db import connect_database
+    from otedama_tpu.p2p.node import NodeConfig
+    from otedama_tpu.p2p.pool import P2PPool
+    from otedama_tpu.pool.blockchain import MockChainClient
+    from otedama_tpu.pool.manager import PoolManager
+    from otedama_tpu.pool.regions import RegionConfig, RegionReplicator
+
+    p = params(window=64, max_reorg_depth=4)
+    store = ChainStore(store_cfg(tmp_path, fsync_interval=8,
+                                 durability=durability))
+    pool = P2PPool(NodeConfig(node_id="ab" * 32), p, store=store)
+    repl = RegionReplicator(pool, RegionConfig(
+        region_id=0, regions=(0,), session_secret="t"))
+    mgr = PoolManager(connect_database(":memory:"), MockChainClient())
+    mgr.replicator = repl
+    return mgr, repl, pool
+
+
+@pytest.mark.asyncio
+async def test_ack_mode_never_acks_a_share_the_journal_lost(tmp_path):
+    """The durable-before-verdict audit at the new boundary: with the
+    writer stalled, the ack-mode ledger flush PARKS on the watermark —
+    no verdict, no db row — so a crash image taken inside the stall
+    contains neither the chain events nor any ack that references them.
+    Once the watermark advances, verdicts and rows land, and every db
+    row's submission is on the (now durable) chain: the three-way audit
+    db rows == dedup index == chain claims."""
+    from otedama_tpu.pool.regions import parse_chain_claim
+
+    mgr, repl, pool = _ledger_fixture(tmp_path / "chain", "ack")
+    batch = [_accepted(k) for k in range(4)]
+    inj = _hold_writer(3.0)
+    with faults.active(inj):
+        task = asyncio.create_task(mgr.on_share_batch(batch))
+        await _await_stall_async(inj)
+        await asyncio.sleep(0.3)
+        # the flush is parked on the watermark: linked in memory, but no
+        # verdict delivered and NOTHING booked
+        assert not task.done()
+        assert mgr.shares.count() == 0
+        img = tmp_path / "img"
+        shutil.copytree(tmp_path / "chain", img)   # kill -9 image
+        outcomes = await asyncio.wait_for(task, timeout=30.0)
+    assert [s for s, _ in outcomes] == ["ok"] * 4
+    assert mgr.shares.count() == 4
+    assert pool.chain.store.persist_lag == 0
+    # three-way audit, live side: every booked share's submission id is
+    # a chain claim the dedup index carries
+    for a in batch:
+        assert repl.seen_submission(a.header)
+    # crash-image side: the image was taken BEFORE any ack — its chain
+    # must hold NONE of the batch (the ledger never acked a share this
+    # journal image lost)
+    pool.chain.store.close()
+    booted = reboot(img, params(window=64, max_reorg_depth=4))
+    claims = {parse_chain_claim(s.job_id)
+              for s in booted.chain_slice(0, booted.height)}
+    from otedama_tpu.pool.regions import submission_id
+    for a in batch:
+        tag = submission_id(a.header).hex()[:24]
+        assert tag not in claims
+    booted.store.close()
+
+
+@pytest.mark.asyncio
+async def test_async_mode_acks_immediately_with_bounded_lag(tmp_path):
+    """chain.durability: async — the opt-in for gossip-only/non-ledger
+    nodes: verdicts return after the in-memory link even while the
+    writer is stalled, and the exposure is exactly the exported
+    persist lag."""
+    mgr, _repl, pool = _ledger_fixture(tmp_path / "chain", "async")
+    batch = [_accepted(k) for k in range(4)]
+    inj = _hold_writer(3.0)
+    with faults.active(inj):
+        outcomes = await asyncio.wait_for(
+            mgr.on_share_batch(batch), timeout=2.0)
+        assert [s for s, _ in outcomes] == ["ok"] * 4
+        assert mgr.shares.count() == 4          # booked before durable:
+        lag = pool.chain.store.persist_lag      # the documented exposure
+        assert lag > 0
+        assert pool.chain.drain(timeout=30.0)
+    assert pool.chain.store.persist_lag == 0
+    pool.chain.store.close()
+
+
+def test_chain_durability_config_validation():
+    from otedama_tpu.config.schema import AppConfig, validate_config
+
+    cfg = AppConfig()
+    cfg.p2p.chain_durability = "maybe"
+    assert any("chain_durability" in e for e in validate_config(cfg))
+    cfg.p2p.chain_durability = "async"
+    cfg.p2p.chain_ring_max = 4
+    cfg.p2p.chain_fsync_interval = 64
+    assert any("chain_ring_max" in e for e in validate_config(cfg))
+    cfg.p2p.chain_ring_max = 65536
+    assert validate_config(cfg) == []
 
 
 def test_archive_fallback_refuses_foreign_chain(tmp_path):

@@ -64,6 +64,7 @@ import os
 import queue
 import random
 import resource
+import shutil
 import socket
 import struct
 import sys
@@ -728,7 +729,8 @@ async def run_leg(connections: int, shares_per_conn: int, window: float,
                   workers: int, connect_rate: float,
                   remote_miners: bool | None = None,
                   paces: list[float] | None = None,
-                  validate: bool = False) -> dict:
+                  validate: bool = False,
+                  durable: bool = False) -> dict:
     """One full soak leg (either serving mode) with PoolManager
     accounting; returns metrics + the per-worker books for cross-leg
     comparison. ``remote_miners`` (default: on for multi-worker runs
@@ -751,6 +753,33 @@ async def run_leg(connections: int, shares_per_conn: int, window: float,
         from otedama_tpu.runtime.validate import ValidationBackend
 
         pool.validator = ValidationBackend(tripwire_rate=0.02)
+    chain_p2p = None
+    chain_dir = None
+    if durable:
+        # durable share chain on the ledger leg: every accepted share
+        # chain-commits through a RegionReplicator backed by a REAL
+        # ChainStore in ack mode, so the flush additionally parks on
+        # the journal's durability watermark — the end-to-end artifact
+        # then carries the persistence cost (ledger flush latency +
+        # pace knee), not just tools/bench_chain.py's isolated number
+        import tempfile
+
+        from otedama_tpu.p2p.chainstore import ChainStore, ChainStoreConfig
+        from otedama_tpu.p2p.node import NodeConfig
+        from otedama_tpu.p2p.pool import P2PPool
+        from otedama_tpu.p2p.sharechain import ChainParams
+        from otedama_tpu.pool.regions import RegionConfig, RegionReplicator
+
+        chain_dir = tempfile.mkdtemp(prefix="bench_stratum_chain_")
+        chain_p2p = P2PPool(
+            NodeConfig(node_id="be" * 32),
+            ChainParams(min_difficulty=1e-9, window=1 << 20,
+                        max_reorg_depth=96),
+            store=ChainStore(ChainStoreConfig(
+                path=chain_dir, fsync_interval=1024, durability="ack")),
+        )
+        pool.replicator = RegionReplicator(chain_p2p, RegionConfig(
+            region_id=0, regions=(0,), session_secret="bench"))
     hook_count = 0
 
     async def on_share(s):
@@ -924,6 +953,22 @@ async def run_leg(connections: int, shares_per_conn: int, window: float,
         result["ledger"] = snap_stats.get("ledger", {})
     if pool.validator is not None:
         result["validation"] = pool.validator.snapshot()
+    if chain_p2p is not None:
+        chain_snap = chain_p2p.chain.snapshot()
+        result["chain"] = {
+            "height": chain_snap["height"],
+            "durability": chain_snap["store"]["durability"],
+            "persist_lag_end": chain_snap["store"]["persist_lag"],
+            "journal_fsyncs": chain_snap["store"]["journal"]["fsyncs"],
+            "snapshots_written": chain_snap["store"]["snapshots_written"],
+            "writer_errors": chain_snap["store"]["writer_errors"],
+        }
+        # accepted shares and chain commits must agree exactly — the
+        # chain IS the authoritative ledger when a replicator is wired
+        result["chain_commits_match_accepted"] = (
+            chain_snap["height"] == accepted)
+        chain_p2p.chain.store.close()
+        shutil.rmtree(chain_dir, ignore_errors=True)
     await server.stop()
     pool.db.close()
     return result, split, per_worker_db
@@ -932,10 +977,10 @@ async def run_leg(connections: int, shares_per_conn: int, window: float,
 async def run_bench(connections: int, shares_per_conn: int, window: float,
                     workers: int, connect_rate: float,
                     control: bool, paces: list[float] | None = None,
-                    validate: bool = False) -> dict:
+                    validate: bool = False, durable: bool = False) -> dict:
     result, split, books = await run_leg(
         connections, shares_per_conn, window, workers, connect_rate,
-        paces=paces, validate=validate)
+        paces=paces, validate=validate, durable=durable)
     if control and workers > 1:
         # single-process control: the IDENTICAL workload through the
         # proven r06 path — fan-out must not change the books. The
@@ -983,6 +1028,12 @@ def main() -> None:
                          "the ledger flush path so the pace sweep's knee "
                          "reflects device validation end-to-end (the "
                          "control leg stays host-only)")
+    ap.add_argument("--durable", action="store_true",
+                    help="chain-commit every accepted share through a "
+                         "durable ChainStore in ack mode (the ledger "
+                         "flush parks on the journal watermark) so the "
+                         "end-to-end artifact carries the persistence "
+                         "cost; the control leg stays chain-less")
     ap.add_argument("--out", default="BENCH_STRATUM_manual.json")
     args = ap.parse_args()
     paces = [float(p) for p in args.pace.split(",") if p.strip()] or None
@@ -1005,7 +1056,7 @@ def main() -> None:
     result = asyncio.run(run_bench(
         args.connections, args.shares, args.window, args.workers,
         args.connect_rate, args.control, paces=paces,
-        validate=args.validate,
+        validate=args.validate, durable=args.durable,
     ))
     if harness is not None:
         result["harness_echo_rt_per_sec"] = harness
