@@ -856,6 +856,38 @@ class ApiServer:
                 labels={"protocol": protocol},
                 help_="Pool share submit-received->verdict-written latency",
             )
+        if server_v2 is not None:
+            # V2 scale seams (PR 15): channel-resume handoffs and
+            # duplicate refusals (local window + cross-worker bus +
+            # chain-backed region index) — the counters an operator
+            # watches during a worker crash or a region failover.
+            # counters(), not snapshot(): the latency histogram was
+            # already merged + exported above, and the sharded view's
+            # snapshot would merge every worker's histogram AGAIN
+            snap = server_v2.counters()
+            reg = self.registry
+            for verdict, key in (("accepted", "resumes_accepted"),
+                                 ("rejected", "resumes_rejected")):
+                reg.counter_set(
+                    "otedama_sv2_channel_resumes_total",
+                    snap.get(key, 0), {"verdict": verdict},
+                    help_="SV2 channel-resume token verdicts",
+                )
+            reg.counter_set(
+                "otedama_sv2_duplicates_refused_total",
+                snap.get("duplicates_refused", 0),
+                help_="SV2 shares refused as duplicates beyond the "
+                      "channel-local window",
+            )
+            reg.gauge_set(
+                "otedama_sv2_channels", snap.get("channels", 0),
+                help_="Open SV2 channels",
+            )
+            reg.gauge_set(
+                "otedama_sv2_channels_resumed",
+                snap.get("channels_resumed", 0),
+                help_="Open SV2 channels recovered via resume tokens",
+            )
         # group-commit ledger shape (ShardSupervisor only): how many
         # shares each flush carried and how long it took — the knee of
         # the batched-commit curve, alarmed on like any latency SLO

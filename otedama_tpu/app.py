@@ -271,36 +271,9 @@ class Application:
                 target_share_seconds=cfg.stratum.vardiff_target_seconds
             ),
         )
-        if cfg.stratum.workers > 1:
-            # sharded front-end: N acceptor worker processes share the
-            # listening port (SO_REUSEPORT), THIS process stays the
-            # single owner of PoolManager/db/settlement and receives
-            # every accepted share over the unix-socket share bus —
-            # pool serving and mining now scale independently (the
-            # engine never competes with accept loops for this event
-            # loop). The supervisor is config/port/set_job/snapshot
-            # compatible with StratumServer, so the region wiring and
-            # metrics below don't care which one serves.
-            from otedama_tpu.stratum.shard import ShardConfig, ShardSupervisor
-
-            self.server = ShardSupervisor(
-                server_cfg,
-                ShardConfig(workers=cfg.stratum.workers),
-                on_share=self.pool.on_share,
-                on_block=self.pool.on_block,
-                # group-commit: the supervisor drains the share bus into
-                # batches and each flushes as ONE chain batch-commit +
-                # ONE db transaction (per-share verdicts unchanged)
-                on_share_batch=self.pool.on_share_batch,
-            )
-        else:
-            self.server = StratumServer(
-                server_cfg,
-                on_share=self.pool.on_share,
-                on_block=self.pool.on_block,
-            )
+        v2_server_cfg = None
         if cfg.stratum.v2_enabled:
-            from otedama_tpu.stratum.v2 import Sv2MiningServer, Sv2ServerConfig
+            from otedama_tpu.stratum.v2 import Sv2ServerConfig
 
             # a wrong file must kill STARTUP with the file named —
             # served as-is it would only fail on the miners' side,
@@ -335,19 +308,59 @@ class Application:
                         f"{cfg.stratum.v2_noise_cert_file}: certificate "
                         "validity window is not current"
                     )
-            self.server_v2 = Sv2MiningServer(
-                Sv2ServerConfig(
-                    host=cfg.stratum.host,
-                    port=cfg.stratum.v2_port,
-                    initial_difficulty=cfg.stratum.initial_difficulty,
-                    max_clients=cfg.stratum.max_clients,
-                    noise=cfg.stratum.v2_noise,
-                    noise_static_key=noise_key,
-                    noise_certificate=noise_cert,
-                ),
+            v2_server_cfg = Sv2ServerConfig(
+                host=cfg.stratum.host,
+                port=cfg.stratum.v2_port,
+                initial_difficulty=cfg.stratum.initial_difficulty,
+                max_clients=cfg.stratum.max_clients,
+                extranonce2_size=cfg.stratum.extranonce2_size,
+                noise=cfg.stratum.v2_noise,
+                noise_static_key=noise_key,
+                noise_certificate=noise_cert,
+            )
+        if cfg.stratum.workers > 1:
+            # sharded front-end: N acceptor worker processes share the
+            # listening port (SO_REUSEPORT), THIS process stays the
+            # single owner of PoolManager/db/settlement and receives
+            # every accepted share over the unix-socket share bus —
+            # pool serving and mining now scale independently (the
+            # engine never competes with accept loops for this event
+            # loop). The supervisor is config/port/set_job/snapshot
+            # compatible with StratumServer, so the region wiring and
+            # metrics below don't care which one serves. With
+            # v2_enabled the workers ALSO serve Stratum V2 siblings of
+            # v2_port, sliced channel leases and all, and accepted V2
+            # shares ride the same bus into the group-commit ledger —
+            # there is no separate in-process V2 server then
+            # (self.server_v2 stays None; the supervisor's v2_view()
+            # feeds the API/metrics surfaces instead).
+            from otedama_tpu.stratum.shard import ShardConfig, ShardSupervisor
+
+            self.server = ShardSupervisor(
+                server_cfg,
+                ShardConfig(workers=cfg.stratum.workers),
+                on_share=self.pool.on_share,
+                on_block=self.pool.on_block,
+                # group-commit: the supervisor drains the share bus into
+                # batches and each flushes as ONE chain batch-commit +
+                # ONE db transaction (per-share verdicts unchanged)
+                on_share_batch=self.pool.on_share_batch,
+                v2_config=v2_server_cfg,
+            )
+        else:
+            self.server = StratumServer(
+                server_cfg,
                 on_share=self.pool.on_share,
                 on_block=self.pool.on_block,
             )
+            if v2_server_cfg is not None:
+                from otedama_tpu.stratum.v2 import Sv2MiningServer
+
+                self.server_v2 = Sv2MiningServer(
+                    v2_server_cfg,
+                    on_share=self.pool.on_share,
+                    on_block=self.pool.on_block,
+                )
         await self.pool.start()
         self._started.append(self.pool)
         self._tasks.append(asyncio.create_task(self._template_loop(chain)))
@@ -664,14 +677,35 @@ class Application:
         ))
         if self.server is not None:
             # V1 front-end joins the region: prefix allocation, resume
-            # tokens, chain dedup. (V2 session handoff is future work —
-            # its channel model replaces extranonce leases.)
+            # tokens, chain dedup
             sc = self.server.config
             sc.extranonce1_prefix = cfg.region_id
             sc.region_id = cfg.region_id
             sc.session_secret = cfg.session_secret
             sc.resume_token_ttl = cfg.token_ttl
             sc.duplicate_checker = self.regions.seen_submission
+            # sharded V2 joins through the supervisor: channel leases
+            # carry the region byte, tokens the region secret; the
+            # chain-backed duplicate check runs parent-side at the bus
+            # (sc.duplicate_checker above covers BOTH protocols there —
+            # the dedup key is the 80-byte header either wire produces)
+            vc = getattr(self.server, "v2_config", None)
+            if vc is not None:
+                vc.extranonce_prefix_byte = cfg.region_id
+                vc.region_id = cfg.region_id
+                vc.session_secret = cfg.session_secret
+                vc.resume_token_ttl = cfg.token_ttl
+        if self.server_v2 is not None:
+            # in-process V2 front-end joins the region the same way the
+            # V1 server does: region-sliced channel leases, resume
+            # tokens any sibling honours, chain-backed replay refusal
+            # on the submit path
+            vc = self.server_v2.config
+            vc.extranonce_prefix_byte = cfg.region_id
+            vc.region_id = cfg.region_id
+            vc.session_secret = cfg.session_secret
+            vc.resume_token_ttl = cfg.token_ttl
+            vc.duplicate_checker = self.regions.seen_submission
         if self.pool is not None:
             self.pool.replicator = self.regions
         if self.p2p.chain.store is not None and self.p2p.chain.height:
@@ -715,6 +749,18 @@ class Application:
         await self.settlement.start()
         self._started.append(self.settlement)
 
+    def _v2_metrics_surface(self):
+        """The object whose ``latency``/``snapshot()`` describe V2
+        serving: the in-process Sv2MiningServer, or the shard
+        supervisor's merged view when the workers own the V2 listeners
+        (sharded mode has no single V2 server object). None = V2 off."""
+        if self.server_v2 is not None:
+            return self.server_v2
+        if self.server is not None and getattr(
+                self.server, "v2_config", None) is not None:
+            return self.server.v2_view()
+        return None
+
     async def _start_api(self) -> None:
         cfg = self.config.api
         self.api = ApiServer(ApiServerConfig(
@@ -731,8 +777,9 @@ class Application:
             self.api.add_provider("upstream", lambda: dict(self.client.stats))
         if self.server is not None:
             self.api.add_provider("stratum", self.server.snapshot)
-        if self.server_v2 is not None:
-            self.api.add_provider("stratum_v2", self.server_v2.snapshot)
+        v2_surface = self._v2_metrics_surface()
+        if v2_surface is not None:
+            self.api.add_provider("stratum_v2", v2_surface.snapshot)
         if self.pool is not None:
             self.api.add_provider("pool", self.pool.snapshot)
         if self.p2p is not None:
@@ -1081,8 +1128,9 @@ class Application:
             }
             if chains:
                 self.api.sync_rpc_pool_metrics(chains)
-            if self.server is not None or self.server_v2 is not None:
-                self.api.sync_pool_server_metrics(self.server, self.server_v2)
+            v2_surface = self._v2_metrics_surface()
+            if self.server is not None or v2_surface is not None:
+                self.api.sync_pool_server_metrics(self.server, v2_surface)
             if self.p2p is not None:
                 snap = self.p2p.snapshot()
                 self.api.sync_p2p_metrics(snap)
@@ -1143,6 +1191,9 @@ class Application:
             out["engine"] = self.engine.snapshot()
         if self.server is not None:
             out["stratum"] = self.server.snapshot()
+        v2_surface = self._v2_metrics_surface()
+        if v2_surface is not None:
+            out["stratum_v2"] = v2_surface.snapshot()
         if self.pool is not None:
             out["pool"] = self.pool.snapshot()
         if self.p2p is not None:

@@ -153,7 +153,13 @@ class StratumSettings:
     # max_clients above is PER WORKER.
     workers: int = 0
     # Stratum V2 (binary protocol, standard channels — stratum/v2.py);
-    # served alongside V1 on its own port when enabled
+    # served alongside V1 on its own port when enabled. Composes with
+    # workers > 1 (each acceptor worker serves an SO_REUSEPORT sibling
+    # of v2_port; accepted V2 shares cross the binary share bus into
+    # the group-commit ledger) and with region.enabled (channel ids
+    # carry the region prefix byte; replays die at the chain-backed
+    # duplicate index) — both need extranonce2_size >= 4 so the channel
+    # prefix can carry the [region|worker|counter] lease
     v2_enabled: bool = False
     v2_port: int = 3336
     # Noise-NX encrypted transport for V2 (stratum/noise.py). The static
@@ -483,11 +489,18 @@ def validate_config(cfg: AppConfig) -> list[str]:
         # 64 acceptor processes saturate any single host long before
         # the 16-bit worker-slice ceiling of the lease space matters
         errors.append("stratum.workers out of range (0..64)")
-    if cfg.stratum.workers > 1 and cfg.stratum.v2_enabled:
+    if cfg.stratum.v2_enabled and cfg.stratum.extranonce2_size < 4 and (
+            cfg.stratum.workers > 1 or cfg.region.enabled):
+        # sharded/multi-region V2 allocates channel ids (and with them
+        # the channels' fixed extranonce prefixes) from the 32-bit
+        # [region byte | worker slice | counter] lease space — a
+        # narrower prefix cannot carry the lease (stratum/v2.py
+        # _alloc_channel refuses it at the first channel open; refuse
+        # it here at config time instead, with the knob named)
         errors.append(
-            "stratum.workers does not support stratum.v2_enabled yet "
-            "(V2 channels lack worker extranonce partitioning and the "
-            "share-bus duplicate seam, mirroring the region constraint)"
+            "stratum.extranonce2_size must be >= 4 when stratum.v2_enabled "
+            "combines with stratum.workers > 1 or region.enabled (the V2 "
+            "channel prefix carries the [region|worker|counter] lease)"
         )
     if not (0 <= cfg.pool.fee_percent < 100):
         errors.append("pool.fee_percent out of range")
@@ -519,18 +532,6 @@ def validate_config(cfg: AppConfig) -> list[str]:
             errors.append(
                 "region.session_secret is required: without signed resume "
                 "tokens miners cannot hand off between regions"
-            )
-        if cfg.stratum.v2_enabled:
-            # the V2 server's channel extranonce assignment is a bare
-            # per-process counter and its submit path has no
-            # duplicate-checker hook: two regions would hand distinct
-            # miners identical search spaces, and replayed V2 shares
-            # would chain-commit twice. Refuse loudly until V2 grows
-            # the same partitioning/dedup seams as V1.
-            errors.append(
-                "region.enabled does not support stratum.v2_enabled yet "
-                "(V2 channels lack region extranonce partitioning and "
-                "cross-region duplicate detection)"
             )
     if not (0 <= cfg.region.region_id <= 255):
         errors.append("region.region_id must fit one prefix byte (0..255)")
@@ -619,7 +620,12 @@ stratum:
   port: 3333
   initial_difficulty: 1.0
   workers: 0          # acceptor worker processes (SO_REUSEPORT); 0 = in-process
-  v2_enabled: false   # Stratum V2 binary protocol on its own port
+  v2_enabled: false   # Stratum V2 binary protocol on its own port; composes
+                      # with workers > 1 (every worker serves a V2 sibling,
+                      # shares cross the same bus ledger) AND with
+                      # region.enabled (channel ids carry the region byte,
+                      # replays die at the chain-backed duplicate index);
+                      # needs extranonce2_size >= 4 in those combinations
   v2_port: 3336
   v2_noise: false     # Noise-NX encrypted transport for V2
   v2_noise_key_file: ""  # hex X25519 static key (empty = fresh each start)

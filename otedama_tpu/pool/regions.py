@@ -17,13 +17,20 @@ miner would miss when it dies:
   partitioned by a region prefix byte (two regions can never lease the
   same nonce space), and session state travels with the miner as a
   signed resume token (``stratum/resume.py``) any region can verify —
-  no replicated session tables.
+  no replicated session tables. Stratum V2 front-ends participate
+  identically (PR 15): channel ids/extranonce prefixes carry the same
+  region byte (``Sv2ServerConfig.extranonce_prefix_byte``) and channel
+  state rides the same token, so a V2 miner hands off between regions
+  exactly like a V1 miner.
 
 - **Duplicates** are detected across regions from the chain itself:
   each region indexes the submission ids committed in every chain share
   it links (best chain AND side branches), so a share replayed to a
   second region is rejected as a duplicate even though that region's
-  per-session ``seen`` window never saw it.
+  per-session ``seen`` window never saw it. The index keys on the
+  80-byte header, which both stratum wires produce — a submission
+  replayed across PROTOCOLS (accepted over V1, replayed over V2, or
+  vice versa) dies here too.
 
 - **Settlement** stays single-writer by deterministic election over
   converged chain state (``leader_region``): every converged region

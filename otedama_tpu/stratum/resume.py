@@ -19,6 +19,20 @@ the session-id parameter get handoff for free:
 - presented as the 2nd ``mining.subscribe`` parameter on reconnect —
   the slot classic stratum reserves for "previous session id".
 
+Stratum V2 rides the SAME token (stratum/v2.py): the ``extranonce1``
+field carries the channel's fixed extranonce prefix — whose big-endian
+value IS the 32-bit ``[region byte | worker slice | counter]`` channel
+id — so one verified token recovers channel id, search space, and
+difficulty on any front-end sharing the secret. V2 tokens are
+protocol-TYPED (``"p": "v2"`` in the signed payload; absence means V1)
+because the two wires' allocators draw from one lease space with
+independent live-collision scans — a token must only resume on the
+wire that issued it. V2 delivers it via the ``SetResumeToken`` vendor
+frame and presents it via ``ResumeChannel``; the verification, TTL,
+and threat-model notes below apply unchanged
+(V2 deployments running the Noise transport additionally close the
+plaintext-bearer-token exposure V1 documents).
+
 Tokens are stateless on the server: any region verifies the HMAC with
 the shared ``session_secret`` and recovers the session without having
 ever seen the miner before. Forgery is an HMAC forgery. Replay — the
@@ -62,32 +76,42 @@ def _sign(secret: str, payload: bytes) -> bytes:
 
 
 def issue_token(secret: str, region_id: int, extranonce1: bytes,
-                difficulty: float, now: float | None = None) -> str:
+                difficulty: float, now: float | None = None,
+                protocol: str = "v1") -> str:
     """Encode + sign the resumable session state. ``secret`` must be the
     deployment-wide ``region.session_secret`` or no other region will
-    honour the token."""
+    honour the token. ``protocol`` types the token: the V1 and V2
+    lease allocators draw from ONE partitioned space with independent
+    live-collision scans (V1 sees only its sessions, V2 only its
+    channels), so a token must only ever resume on the wire that
+    issued it — a cross-protocol replay could alias a lease still
+    live under the other server. "v1" is encoded as ABSENCE for
+    wire-compatibility with pre-PR-15 tokens."""
     if not secret:
         raise ValueError("resume tokens require a session secret")
+    fields = {
+        "v": TOKEN_VERSION,
+        "r": int(region_id),
+        "e1": extranonce1.hex(),
+        "d": float(difficulty),
+        "t": round(time.time() if now is None else now, 3),
+    }
+    if protocol != "v1":
+        fields["p"] = protocol
     payload = json.dumps(
-        {
-            "v": TOKEN_VERSION,
-            "r": int(region_id),
-            "e1": extranonce1.hex(),
-            "d": float(difficulty),
-            "t": round(time.time() if now is None else now, 3),
-        },
-        separators=(",", ":"),
-        sort_keys=True,
+        fields, separators=(",", ":"), sort_keys=True,
     ).encode()
     blob = payload + _sign(secret, payload)
     return base64.urlsafe_b64encode(blob).decode().rstrip("=")
 
 
 def verify_token(secret: str, token: str, ttl: float,
-                 now: float | None = None) -> ResumeState | None:
+                 now: float | None = None,
+                 protocol: str = "v1") -> ResumeState | None:
     """Verify signature + freshness and decode. Returns None for ANY
-    defect (malformed, forged, expired, future-dated) — a bad token must
-    degrade to a fresh subscribe, never to an error a miner chokes on."""
+    defect (malformed, forged, expired, future-dated, or a token typed
+    for the OTHER protocol) — a bad token must degrade to a fresh
+    subscribe, never to an error a miner chokes on."""
     if not secret or not token or len(token) > 512:
         return None
     try:
@@ -102,6 +126,8 @@ def verify_token(secret: str, token: str, ttl: float,
     try:
         obj = json.loads(payload)
         if obj.get("v") != TOKEN_VERSION:
+            return None
+        if obj.get("p", "v1") != protocol:
             return None
         state = ResumeState(
             region_id=int(obj["r"]),

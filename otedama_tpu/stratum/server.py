@@ -41,6 +41,39 @@ from otedama_tpu.utils.pow_host import (
 log = logging.getLogger("otedama.stratum.server")
 
 
+def lease_slice_params(prefix: int | None, worker_index: int,
+                       worker_bits: int) -> tuple[int, int]:
+    """Validate the ``[region byte | worker_index (worker_bits) |
+    counter]`` slice parameters and return ``(counter_bits,
+    slice_base)``. ONE function defines the partitioned lease space for
+    BOTH stratum wires — V1 extranonce1 (`_alloc_extranonce1`) and V2
+    channel ids (`stratum/v2.py _alloc_channel`) — so the slice math
+    can never drift between them."""
+    if prefix is not None and not (0 <= prefix <= 0xFF):
+        raise ValueError(f"region prefix {prefix} is not a byte")
+    space_bits = 24 if prefix is not None else 32
+    counter_bits = space_bits - worker_bits
+    if counter_bits < 8:
+        raise ValueError(
+            f"worker_bits {worker_bits} leaves {counter_bits} counter "
+            f"bits in the {space_bits}-bit lease space (need >= 8)"
+        )
+    if worker_bits and not (0 <= worker_index < (1 << worker_bits)):
+        raise ValueError(
+            f"worker_index {worker_index} does not fit "
+            f"worker_bits {worker_bits}"
+        )
+    return counter_bits, worker_index << counter_bits
+
+
+def compose_lease(prefix: int | None, lease: int) -> int:
+    """The full 32-bit lease value: region byte (when sliced) over the
+    24-bit [worker|counter] lease, or the bare 32-bit lease. Its
+    4-byte big-endian encoding IS the V1 extranonce1 / the V2
+    extranonce_prefix suffix."""
+    return ((prefix << 24) | lease) if prefix is not None else lease
+
+
 @dataclasses.dataclass
 class ServerConfig:
     host: str = "127.0.0.1"
@@ -380,21 +413,8 @@ class StratumServer:
         # assertion fires only when the scan cannot find a free lease at
         # all (the space is saturated, or another allocator is flooding
         # OUR partition: two processes misconfigured with one slice).
-        if prefix is not None and not (0 <= prefix <= 0xFF):
-            raise ValueError(f"extranonce1_prefix {prefix} is not a byte")
-        space_bits = 24 if prefix is not None else 32
-        counter_bits = space_bits - wbits
-        if counter_bits < 8:
-            raise ValueError(
-                f"worker_bits {wbits} leaves {counter_bits} counter bits "
-                f"in the {space_bits}-bit lease space (need >= 8)"
-            )
-        if wbits and not (0 <= self.config.worker_index < (1 << wbits)):
-            raise ValueError(
-                f"worker_index {self.config.worker_index} does not fit "
-                f"worker_bits {wbits}"
-            )
-        slice_base = self.config.worker_index << counter_bits
+        counter_bits, slice_base = lease_slice_params(
+            prefix, self.config.worker_index, wbits)
         if self._region_counter is None:
             import secrets
 
@@ -403,12 +423,7 @@ class StratumServer:
         for _ in range(4096):
             v = self._region_counter
             self._region_counter = (v + 1) % (1 << counter_bits)
-            lease = slice_base | v
-            en1 = (
-                bytes([prefix]) + lease.to_bytes(3, "big")
-                if prefix is not None
-                else lease.to_bytes(4, "big")
-            )
+            en1 = compose_lease(prefix, slice_base | v).to_bytes(4, "big")
             if en1 not in live:
                 return en1
             self.stats["extranonce_collisions"] += 1

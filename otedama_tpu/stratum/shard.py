@@ -430,6 +430,18 @@ _WIRE_SERVER_FIELDS = (
     "max_line_bytes", "drain_high_water", "max_write_backlog",
 )
 
+# Sv2ServerConfig fields that cross verbatim for sharded V2 serving.
+# Same exclusions as V1: duplicate_checker stays parent-side (the bus
+# window + chain index refuse replays before the ledger), and the
+# noise key/certificate bytes travel hex-encoded beside these
+_WIRE_V2_FIELDS = (
+    "host", "port", "initial_difficulty", "job_max_age", "ntime_slack",
+    "max_channels_per_conn", "max_clients", "extranonce2_size",
+    "version_rolling_mask", "max_write_backlog", "drain_high_water",
+    "noise", "handshake_timeout", "extranonce_prefix_byte", "region_id",
+    "session_secret", "resume_token_ttl", "coalesce_seconds",
+)
+
 
 # -- worker process -----------------------------------------------------------
 
@@ -476,11 +488,11 @@ def worker_main(spec: dict) -> None:
         pass
 
 
-def _worker_listen_socket(spec: dict) -> socket.socket:
-    """The worker's listening socket: its own SO_REUSEPORT sibling on
+def _reuseport_socket(host: str, port: int,
+                      fd: int | None = None) -> socket.socket:
+    """One worker-owned listening socket: an SO_REUSEPORT sibling on
     the shared port, or the single listener inherited from the
     supervisor by fd where the platform lacks SO_REUSEPORT."""
-    fd = spec.get("listen_fd")
     if fd is not None:
         sock = socket.socket(fileno=os.dup(int(fd)))
         sock.setblocking(False)
@@ -488,10 +500,15 @@ def _worker_listen_socket(spec: dict) -> socket.socket:
     sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
-    sock.bind((spec["host"], int(spec["port"])))
+    sock.bind((host, port))
     sock.listen(512)
     sock.setblocking(False)
     return sock
+
+
+def _worker_listen_socket(spec: dict) -> socket.socket:
+    return _reuseport_socket(
+        spec["host"], int(spec["port"]), spec.get("listen_fd"))
 
 
 async def _worker_async(spec: dict) -> None:
@@ -543,28 +560,42 @@ async def _worker_async(spec: dict) -> None:
                     fut.set_exception(
                         RuntimeError("share bus ack timeout"))
 
-    async def on_share(accepted: AcceptedShare) -> None:
-        # the worker's per-share heartbeat — chaos plans kill/stall a
-        # worker mid-traffic exactly here (before the bus send, so the
-        # dying share was never committed and the miner's resubmit to a
-        # survivor must LAND, not die as a phantom duplicate)
-        d = faults.hit("worker.crash", str(wid), faults.POINT)
-        if d is not None and d.delay:
-            await asyncio.sleep(d.delay)
-        status, error = await share_call(accepted)
-        if status == "dup":
-            # the parent's ledger (cross-worker window / chain index)
-            # already has this submission: a policy reject the server
-            # delivers verbatim, not an accounting failure
-            raise sp.StratumError(
-                sp.ERR_DUPLICATE, "duplicate (another worker committed it)")
-        if status != "ok":
-            raise RuntimeError(error or "share bus refused the commit")
+    def make_share_hook(dup_error):
+        """One bus-backed share hook for BOTH stratum wires; only the
+        protocol's duplicate-verdict exception differs."""
+
+        async def on_share(accepted: AcceptedShare) -> None:
+            # the worker's per-share heartbeat — chaos plans kill/stall
+            # a worker mid-traffic exactly here (before the bus send,
+            # so the dying share was never committed and the miner's
+            # resubmit to a survivor must LAND, not die as a phantom
+            # duplicate)
+            d = faults.hit("worker.crash", str(wid), faults.POINT)
+            if d is not None and d.delay:
+                await asyncio.sleep(d.delay)
+            status, error = await share_call(accepted)
+            if status == "dup":
+                # the parent's ledger (cross-worker window / chain
+                # index) already has this submission: a policy reject
+                # the server delivers verbatim, not an accounting
+                # failure
+                raise dup_error()
+            if status != "ok":
+                raise RuntimeError(error or "share bus refused the commit")
+
+        return on_share
+
+    on_share = make_share_hook(lambda: sp.StratumError(
+        sp.ERR_DUPLICATE, "duplicate (another worker committed it)"))
 
     async def on_block(header: bytes, job: Job,
                        accepted: AcceptedShare) -> None:
+        # job_id rides explicitly: V2 AcceptedShare.job_id is the SV2
+        # per-server job counter, not the template id the supervisor
+        # keys its job table on (for V1 the two coincide)
         status, error = await bus_call(
-            {"t": "block", "share": share_to_wire(accepted)})
+            {"t": "block", "share": share_to_wire(accepted),
+             "job_id": job.job_id})
         if status != "ok":
             raise RuntimeError(error or "share bus refused the block")
 
@@ -578,15 +609,55 @@ async def _worker_async(spec: dict) -> None:
     server = StratumServer(cfg, on_share=on_share, on_block=on_block)
     await server.start(sock=_worker_listen_socket(spec))
 
+    # sharded Stratum V2: the same worker also serves the binary
+    # protocol on its SO_REUSEPORT sibling of the V2 port. Accepted V2
+    # shares cross the SAME binary share bus into the parent's
+    # group-commit ledger — the verdict awaits the parent ack exactly
+    # like V1, and a parent-window "dup" comes back as the protocol's
+    # duplicate-share reject
+    server_v2 = None
+    v2spec = spec.get("v2")
+    if v2spec:
+        from otedama_tpu.stratum import v2 as v2mod
+
+        v2cfg = v2mod.Sv2ServerConfig(
+            **{k: v2spec[k] for k in _WIRE_V2_FIELDS},
+            noise_static_key=(bytes.fromhex(v2spec["noise_static_key"])
+                              if v2spec.get("noise_static_key") else None),
+            noise_certificate=(bytes.fromhex(v2spec["noise_certificate"])
+                               if v2spec.get("noise_certificate") else None),
+            worker_index=wid,
+            worker_bits=int(spec["worker_bits"]),
+        )
+        server_v2 = v2mod.Sv2MiningServer(
+            v2cfg,
+            on_share=make_share_hook(lambda: v2mod.DuplicateShareError(
+                "duplicate (another worker committed it)")),
+            on_block=on_block)
+        await server_v2.start(sock=_reuseport_socket(
+            v2cfg.host, v2cfg.port, v2spec.get("listen_fd")))
+
     def push_snapshot() -> None:
         try:
-            bus.send(encode_frame({
+            frame = {
                 "t": "snap",
                 "worker": wid,
                 "stats": dict(server.stats),
                 "latency": server.latency.state(),
                 "sessions": len(server.sessions),
-            }))
+            }
+            if server_v2 is not None:
+                # counters and gauges travel apart: dead incarnations'
+                # COUNTERS fold into retired totals, but their live
+                # channel gauges must die with them
+                frame["v2_latency"] = server_v2.latency.state()
+                frame["v2_stats"] = dict(server_v2.stats)
+                frame["v2_channels"] = len(server_v2._channels)
+                frame["v2_channels_resumed"] = sum(
+                    1 for c, _ in server_v2._channels.values() if c.resumed)
+                frame["v2_channel_duplicates"] = sum(
+                    c.duplicates for c, _ in server_v2._channels.values())
+            bus.send(encode_frame(frame))
         except (ConnectionError, RuntimeError):  # bus gone mid-shutdown
             pass
 
@@ -621,8 +692,15 @@ async def _worker_async(spec: dict) -> None:
                          str(msg.get("error", "")))
                     )
             elif t == "job":
-                server.set_job(
-                    job_from_wire(msg["job"]), bool(msg.get("clean", True)))
+                job = job_from_wire(msg["job"])
+                server.set_job(job, bool(msg.get("clean", True)))
+                if server_v2 is not None:
+                    try:
+                        server_v2.set_job(job, bool(msg.get("clean", True)))
+                    except ValueError:
+                        # divergent extranonce width: set_job already
+                        # logged it loudly; V1 serving must keep going
+                        pass
             elif t == "stop":
                 break
             else:
@@ -642,6 +720,8 @@ async def _worker_async(spec: dict) -> None:
         except (ConnectionError, RuntimeError):
             pass
         await server.stop()
+        if server_v2 is not None:
+            await server_v2.stop()
         writer.close()
 
 
@@ -681,6 +761,26 @@ class _WorkerProc:
     fast_deaths: int = 0
 
 
+class _SupervisorV2View:
+    """Duck-typed stand-in for ``Sv2MiningServer`` over a supervisor's
+    merged V2 state — what ``ApiServer.sync_pool_server_metrics`` and
+    the ``stratum_v2`` snapshot provider read when sharded serving owns
+    the V2 listeners (there is no single in-process V2 server then)."""
+
+    def __init__(self, supervisor: "ShardSupervisor"):
+        self._supervisor = supervisor
+
+    @property
+    def latency(self) -> LatencyHistogram:
+        return self._supervisor.v2_latency
+
+    def counters(self) -> dict:
+        return self._supervisor.v2_counters()
+
+    def snapshot(self) -> dict:
+        return self._supervisor.v2_snapshot()
+
+
 ShareHook = Callable[[AcceptedShare], Awaitable[None]]
 BlockHook = Callable[[bytes, Job, AcceptedShare], Awaitable[None]]
 # group-commit hook: one call per ledger batch, one (status, error)
@@ -709,9 +809,15 @@ class ShardSupervisor:
         on_share: ShareHook | None = None,
         on_block: BlockHook | None = None,
         on_share_batch: BatchShareHook | None = None,
+        v2_config=None,
     ):
         self.config = config or ServerConfig()
         self.shard = shard or ShardConfig()
+        # sharded Stratum V2 (an Sv2ServerConfig): every worker also
+        # serves the binary protocol on an SO_REUSEPORT sibling of
+        # v2_config.port, with accepted V2 shares crossing the SAME
+        # share bus into the group-commit ledger. None = V1 only.
+        self.v2_config = v2_config
         self.on_share = on_share
         self.on_block = on_block
         # group-commit entry point (PoolManager.on_share_batch): when
@@ -748,6 +854,9 @@ class ShardSupervisor:
         self._procs: dict[int, _WorkerProc] = {}
         self._retired_stats: dict = {}
         self._retired_latency = LatencyHistogram()
+        self._retired_v2_stats: dict = {}
+        self._retired_v2_latency = LatencyHistogram()
+        self._v2_reserve_sock: socket.socket | None = None
         # header -> True (committed) | Future (commit in flight);
         # _dedup_order tracks committed keys for O(1) oldest-first
         # eviction — this sits on the single ledger-owner's hot path,
@@ -789,6 +898,23 @@ class ShardSupervisor:
             # front-end handoff configure region.session_secret, which
             # the app wiring writes here before start()
             self.config.session_secret = secrets.token_hex(32)
+        if self.v2_config is not None:
+            if not self.v2_config.session_secret:
+                # V2 channel-resume tokens ride the SAME supervisor
+                # secret: a V2 miner on a dead worker must reopen its
+                # channel on any survivor out of the box, exactly like
+                # a V1 miner's lease
+                self.v2_config.session_secret = self.config.session_secret
+            if self.v2_config.noise and self.v2_config.noise_static_key is None:
+                # ONE Noise identity for the whole fleet: letting each
+                # worker generate its own key would present N divergent
+                # identities on one v2_port — a key-pinning miner whose
+                # worker died could then never complete the handshake
+                # on a survivor, and the resume machinery it needs
+                # would be unreachable behind the failed handshake
+                from otedama_tpu.stratum import noise as noise_mod
+
+                self.v2_config.noise_static_key = noise_mod.x25519_keypair()[0]
         # the ledger queue must exist BEFORE the bus accepts its first
         # link — a worker's first share races supervisor startup
         self._ledger_q = asyncio.Queue(
@@ -852,10 +978,25 @@ class ShardSupervisor:
             self._listen_sock = s
         self.config = dataclasses.replace(
             self.config, port=s.getsockname()[1])
+        if self.v2_config is not None:
+            if not hasattr(socket, "SO_REUSEPORT"):
+                # pragma: no cover - non-Linux fallback; doubling the
+                # inherited-fd machinery for a second port buys nothing
+                # on the platforms that lack SO_REUSEPORT today
+                raise RuntimeError(
+                    "sharded Stratum V2 serving requires SO_REUSEPORT "
+                    "(the V2 port gets one listening sibling per worker)"
+                )
+            v = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            v.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            v.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+            v.bind((self.v2_config.host, self.v2_config.port))
+            self._v2_reserve_sock = v
+            self.v2_config.port = v.getsockname()[1]
 
     def _worker_spec(self, wid: int, fault_spec: dict | None) -> dict:
         cfg = self.config
-        return {
+        spec = {
             "worker_id": wid,
             "worker_bits": self._worker_bits,
             "bus_path": self._bus_path,
@@ -873,6 +1014,18 @@ class ShardSupervisor:
             "log_level": logging.getLevelName(
                 logging.getLogger().getEffectiveLevel()),
         }
+        if self.v2_config is not None:
+            vc = self.v2_config
+            spec["v2"] = {
+                **{k: getattr(vc, k) for k in _WIRE_V2_FIELDS},
+                # bytes fields travel hex (the spec must survive both
+                # the fork AND spawn start methods' plain-data paths)
+                "noise_static_key": (vc.noise_static_key.hex()
+                                     if vc.noise_static_key else ""),
+                "noise_certificate": (vc.noise_certificate.hex()
+                                      if vc.noise_certificate else ""),
+            }
+        return spec
 
     def _parent_fds(self) -> list[int]:
         """Supervisor-side fds a forked worker must NOT keep: the live
@@ -894,6 +1047,8 @@ class ShardSupervisor:
                 fds.append(s.fileno())
         if self._reserve_sock is not None:
             fds.append(self._reserve_sock.fileno())
+        if self._v2_reserve_sock is not None:
+            fds.append(self._v2_reserve_sock.fileno())
         return [fd for fd in fds if isinstance(fd, int) and fd >= 0]
 
     def _spawn(self, wid: int, fault_spec: dict | None = None) -> None:
@@ -974,10 +1129,12 @@ class ShardSupervisor:
             self._fold_link(link)
             link.writer.close()
         self._links.clear()
-        for s in (self._reserve_sock, self._listen_sock):
+        for s in (self._reserve_sock, self._listen_sock,
+                  self._v2_reserve_sock):
             if s is not None:
                 s.close()
         self._reserve_sock = self._listen_sock = None
+        self._v2_reserve_sock = None
         if self._own_bus_dir and self._bus_dir:
             try:
                 os.unlink(self._bus_path)
@@ -1257,10 +1414,13 @@ class ShardSupervisor:
 
     async def _handle_block(self, link: _WorkerLink, msg: dict) -> None:
         share = share_from_wire(msg["share"])
-        job = self.jobs.get(share.job_id)
+        # workers ship the template id explicitly (V2's
+        # AcceptedShare.job_id is the SV2 per-server job counter)
+        jid = msg.get("job_id") or share.job_id
+        job = self.jobs.get(jid)
         status, error = "ok", ""
         if job is None:
-            status, error = "err", f"unknown job {share.job_id!r}"
+            status, error = "err", f"unknown job {jid!r}"
         elif self.on_block is not None:
             try:
                 await self.on_block(share.header, job, share)
@@ -1308,9 +1468,14 @@ class ShardSupervisor:
             return
         link.folded = True
         merge_counters(self._retired_stats, link.last_snap.get("stats", {}))
+        merge_counters(self._retired_v2_stats,
+                       link.last_snap.get("v2_stats", {}))
         try:
             self._retired_latency.merge(LatencyHistogram.from_state(
                 link.last_snap["latency"]))
+            if "v2_latency" in link.last_snap:
+                self._retired_v2_latency.merge(LatencyHistogram.from_state(
+                    link.last_snap["v2_latency"]))
         except (KeyError, ValueError):
             log.warning("worker %d pushed a malformed latency state",
                         link.worker_id)
@@ -1319,21 +1484,70 @@ class ShardSupervisor:
     def latency(self) -> LatencyHistogram:
         """Merged share-accept histogram across all worker incarnations
         (the one `/metrics` SLO surface)."""
-        merged = LatencyHistogram(self._retired_latency.bounds)
-        merged.merge(self._retired_latency)
+        return self._merged_latency("latency", self._retired_latency)
+
+    @property
+    def v2_latency(self) -> LatencyHistogram:
+        """The V2 twin: merged SV2 share-accept histogram (feeds the
+        ``protocol="v2"`` label of the pool latency metric)."""
+        return self._merged_latency("v2_latency", self._retired_v2_latency)
+
+    def _merged_latency(self, key: str,
+                        retired: LatencyHistogram) -> LatencyHistogram:
+        merged = LatencyHistogram(retired.bounds)
+        merged.merge(retired)
         for link in self._links.values():
-            if link.last_snap is None:
+            if link.last_snap is None or key not in link.last_snap:
                 continue
             try:
                 merged.merge(LatencyHistogram.from_state(
-                    link.last_snap["latency"]))
+                    link.last_snap[key]))
             except (KeyError, ValueError):
                 continue
         return merged
 
+    def v2_counters(self) -> dict:
+        """Merged SV2 counters + channel gauges across worker
+        incarnations — no histogram merge (the metrics exporter reads
+        the latency separately via ``v2_latency``)."""
+        merged: dict = {}
+        merge_counters(merged, self._retired_v2_stats)
+        channels = resumed = chan_dups = 0
+        for link in self._links.values():
+            snap = link.last_snap
+            if snap is None:
+                continue
+            merge_counters(merged, snap.get("v2_stats", {}))
+            channels += int(snap.get("v2_channels", 0))
+            resumed += int(snap.get("v2_channels_resumed", 0))
+            chan_dups += int(snap.get("v2_channel_duplicates", 0))
+        merged.update({
+            "channels": channels,
+            "channels_resumed": resumed,
+            "channel_duplicates": chan_dups,
+        })
+        return merged
+
+    def v2_snapshot(self) -> dict:
+        """Merged SV2 serving state, shaped like
+        ``Sv2MiningServer.snapshot`` (counters + channel gauges +
+        accept latency) for the API provider."""
+        return {
+            **self.v2_counters(),
+            "accept_latency": self.v2_latency.snapshot(),
+        }
+
+    def v2_view(self):
+        """Read-only facade shaped like ``Sv2MiningServer`` where the
+        API/metrics wiring only needs ``latency`` + ``snapshot()`` —
+        lifecycle and job fan-out stay with the supervisor."""
+        return _SupervisorV2View(self)
+
     def snapshot(self) -> dict:
         merged: dict = {}
         merge_counters(merged, self._retired_stats)
+        if self.v2_config is not None:
+            merged["v2"] = self.v2_snapshot()
         sessions = 0
         per_worker: dict[int, dict] = {}
         for wid, link in sorted(self._links.items()):

@@ -36,6 +36,35 @@ Scope notes (stated, not hidden):
   is fixed by the server; shares vary nonce/ntime/version) — the mode
   ASIC-style devices use and the one that maps onto this framework's
   fixed-prefix search kernels.
+
+Scale parity with V1 (PR 15): the V2 server now grows the same seams
+the V1 server grew for sharded/multi-region serving —
+
+- **Channel slicing**: channel ids and the channel's fixed
+  ``extranonce_prefix`` are allocated from the SAME partitioned lease
+  space as V1 extranonce1 (``[region byte | worker_index(worker_bits)
+  | counter]``, random counter start, live-collision scan, loud
+  saturation assertion), so N acceptor workers and M regions hand V2
+  miners disjoint search spaces exactly like V1 miners.
+- **Cross-worker/region dedup**: ``Sv2ServerConfig.duplicate_checker``
+  (the chain-backed region index) fires on the submit path, and a
+  ledger-side hook may raise ``DuplicateShareError`` to deliver a
+  parent-window duplicate verdict (the shard bus "dup" ack) as a
+  ``duplicate-share`` reject.
+- **Session resume**: signed stateless tokens (stratum/resume.py, the
+  PR 8 machinery) ride two VENDOR messages — ``SetResumeToken``
+  (server->client, issued at channel open) and ``ResumeChannel``
+  (client->server, an OpenStandardMiningChannel carrying the token) —
+  so a miner whose worker died reopens its channel id, extranonce
+  prefix, and difficulty on any survivor sharing ``session_secret``.
+  These two message ids are NOT in the public SV2 spec (the spec has
+  no session-resume story); they live in an unused id range and are
+  covered by the same ``INTEROP_VERIFIED`` gate as everything else.
+- **Wire-level perf**: per-job broadcast frames are encoded ONCE and
+  channel-id/merkle-root-patched per channel (the V1 ``set_job``
+  bytes-once trick), and ``FrameConn`` sends can route through a
+  ``CoalescingWriter`` timed window (``coalesce_seconds``) so
+  submit/ack bursts amortize to ~one send syscall per window.
 """
 
 from __future__ import annotations
@@ -43,11 +72,14 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import logging
+import secrets
 import struct
 import time
+from typing import Callable
 
 from otedama_tpu.engine import jobs as jobmod
 from otedama_tpu.stratum import noise
+from otedama_tpu.stratum import resume as session_resume
 from otedama_tpu.engine.types import Job
 from otedama_tpu.kernels import target as tgt
 from otedama_tpu.utils import faults
@@ -76,6 +108,12 @@ MSG_SUBMIT_SHARES_ERROR = 0x1D
 MSG_NEW_MINING_JOB = 0x15
 MSG_SET_NEW_PREV_HASH = 0x20
 MSG_SET_TARGET = 0x21
+# vendor extension (NOT in the public SV2 spec, which has no session
+# resume): signed stateless resume tokens for worker/region handoff,
+# ids parked far above the spec's mining range. Guarded by the same
+# INTEROP_VERIFIED gate as the recalled spec ids.
+MSG_SET_RESUME_TOKEN = 0x74
+MSG_RESUME_CHANNEL = 0x75
 
 # channel-scoped message types carry the spec's channel_msg bit in
 # extension_type (bit 15); connection-setup and channel-open requests
@@ -84,8 +122,16 @@ CHANNEL_MSG_BIT = 0x8000
 CHANNEL_SCOPED = frozenset({
     MSG_NEW_MINING_JOB, MSG_SET_NEW_PREV_HASH, MSG_SET_TARGET,
     MSG_SUBMIT_SHARES_STANDARD, MSG_SUBMIT_SHARES_SUCCESS,
-    MSG_SUBMIT_SHARES_ERROR,
+    MSG_SUBMIT_SHARES_ERROR, MSG_SET_RESUME_TOKEN,
 })
+
+
+class DuplicateShareError(Exception):
+    """Raised by a ledger-side ``on_share`` hook when the submission is
+    already in the books somewhere this server cannot see locally (the
+    shard supervisor's parent dedup window, another region's chain
+    commits). The submit path delivers it as a ``duplicate-share``
+    reject — a POLICY verdict, never a hook failure."""
 
 # Interop gate (advisor r4 / verdict r4 item 3): the message-type table
 # above is offline recall, never verified against a third-party SV2
@@ -213,13 +259,28 @@ class FrameConn:
     """One connection's framing endpoint: cleartext SV2 frames straight
     on TCP, or whole frames sealed one-per-noise-message when a
     ``stratum.noise.NoiseSession`` is attached — server and client get a
-    single send/recv surface either way."""
+    single send/recv surface either way.
+
+    ``coalesce`` > 0 routes writes through a ``CoalescingWriter`` timed
+    window (stratum/shard.py): frames queued within the window share
+    ONE transport write, so submit/ack bursts cost ~one send syscall
+    per window instead of one per frame — the same amortization the
+    share bus runs on, applied to the miner-facing wire. Frames are
+    still sealed individually (the noise receiver reassembles by SV2
+    frame header), only the socket writes coalesce."""
 
     def __init__(self, reader: asyncio.StreamReader,
-                 writer: asyncio.StreamWriter, session=None):
+                 writer: asyncio.StreamWriter, session=None,
+                 coalesce: float = 0.0):
         self.reader = reader
         self.writer = writer
         self.session = session
+        if coalesce > 0:
+            from otedama_tpu.stratum.shard import CoalescingWriter
+
+            self._coalescer = CoalescingWriter(writer, coalesce)
+        else:
+            self._coalescer = None
 
     async def recv(self) -> tuple[int, int, bytes]:
         d = faults.hit("sv2.conn.recv", supports=faults.POINT)
@@ -231,11 +292,16 @@ class FrameConn:
 
     def send(self, msg_type: int, payload: bytes,
              max_backlog: int | None = None) -> None:
+        self.send_frame(pack_frame(msg_type, payload), max_backlog)
+
+    def send_frame(self, frame: bytes,
+                   max_backlog: int | None = None) -> None:
+        """Send one pre-assembled SV2 frame (the job broadcast path
+        patches cached per-job bytes instead of re-encoding)."""
         transport = self.writer.transport
         if (max_backlog is not None and transport is not None
                 and transport.get_write_buffer_size() > max_backlog):
             raise ConnectionError("write backlog over cap (stalled peer)")
-        frame = pack_frame(msg_type, payload)
         wire = frame if self.session is None else self.session.seal(frame)
         d = faults.hit("sv2.conn.send", supports=faults.SEND_SYNC)
         if d is not None:
@@ -245,15 +311,26 @@ class FrameConn:
                 # a partial binary frame desyncs the peer's length-
                 # delimited reader mid-header/payload: the read side must
                 # treat it as a dead connection, not a parse crash
+                if self._coalescer is not None:
+                    self._coalescer.flush()
                 self.writer.write(wire[:d.truncate])
                 self.writer.close()
                 raise ConnectionError("injected short write")
-        self.writer.write(wire)
+        if self._coalescer is not None:
+            self._coalescer.send(wire)
+        else:
+            self.writer.write(wire)
 
     async def drain(self) -> None:
+        if self._coalescer is not None:
+            # drain()'s contract is "these bytes reached the transport";
+            # a window still pending would make that a lie
+            self._coalescer.flush()
         await self.writer.drain()
 
     def close(self) -> None:
+        if self._coalescer is not None:
+            self._coalescer.flush()
         self.writer.close()
 
 
@@ -560,6 +637,68 @@ class SubmitSharesError:
         return out
 
 
+@dataclasses.dataclass
+class SetResumeToken:
+    """VENDOR message (server -> client): the signed stateless resume
+    token describing the channel's CURRENT state (stratum/resume.py) —
+    the V2 twin of V1's ``mining.set_resume_token`` notification.
+    Issued right after channel open; presented back via
+    ``ResumeChannel`` on any sibling front-end sharing the secret."""
+
+    channel_id: int
+    token: str
+
+    MSG = MSG_SET_RESUME_TOKEN
+
+    def encode(self) -> bytes:
+        return struct.pack("<I", self.channel_id) + _str0_255(self.token)
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "SetResumeToken":
+        r = Reader(payload)
+        out = cls(channel_id=r.u32(), token=r.str0_255())
+        r.done()
+        return out
+
+
+@dataclasses.dataclass
+class ResumeChannel:
+    """VENDOR message (client -> server): OpenStandardMiningChannel
+    plus a resume token — reopen the channel id, extranonce prefix,
+    and difficulty the token captures. Every defect degrades to a
+    fresh channel open (the miner is mid-reconnect; an error would
+    strand it — the V1 ``_try_resume`` rule), so the reply is always
+    the STANDARD open success/error pair."""
+
+    request_id: int
+    user_identity: str
+    token: str
+    nominal_hash_rate: float = 0.0
+    max_target: int = (1 << 256) - 1
+
+    MSG = MSG_RESUME_CHANNEL
+
+    def encode(self) -> bytes:
+        return (
+            struct.pack("<I", self.request_id)
+            + _str0_255(self.user_identity)
+            + struct.pack("<f", self.nominal_hash_rate)
+            + _u256(self.max_target)
+            + _str0_255(self.token)
+        )
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "ResumeChannel":
+        r = Reader(payload)
+        out = cls(
+            request_id=r.u32(), user_identity=r.str0_255(),
+            nominal_hash_rate=r.f32(), max_target=r.u256(),
+            token=r.str0_255(),
+        )
+        r.done()
+        return out
+
+
 MESSAGE_TYPES = {
     m.MSG: m for m in (
         SetupConnection, SetupConnectionSuccess, SetupConnectionError,
@@ -567,6 +706,7 @@ MESSAGE_TYPES = {
         OpenStandardMiningChannelError,
         NewMiningJob, SetNewPrevHash, SetTarget,
         SubmitSharesStandard, SubmitSharesSuccess, SubmitSharesError,
+        SetResumeToken, ResumeChannel,
     )
 }
 
@@ -619,6 +759,31 @@ class Sv2ServerConfig:
     noise_static_key: bytes | None = None
     noise_certificate: bytes | None = None
     handshake_timeout: float = 10.0
+    # -- scale seams (V1 ServerConfig parity) --------------------------------
+    # region prefix byte partitioning the channel lease space across
+    # FRONT-ENDS (pool/regions.py wires region_id here); None = no
+    # region slicing
+    extranonce_prefix_byte: int | None = None
+    # worker slice of the lease space, composed UNDER the region byte:
+    # channel ids (and with them the fixed extranonce prefixes) come
+    # from [region byte | worker_index (worker_bits) | counter], so N
+    # acceptor workers can never hand out overlapping search spaces.
+    # worker_bits = 0 disables worker slicing (single process)
+    worker_index: int = 0
+    worker_bits: int = 0
+    region_id: int = 0                 # stamped into issued resume tokens
+    # shared HMAC secret for signed channel-resume tokens
+    # (stratum/resume.py); "" disables resume
+    session_secret: str = ""
+    resume_token_ttl: float = 3600.0
+    # chain-backed cross-region duplicate detection: fn(header80) ->
+    # bool (True = already committed by SOME region) — the exact V1
+    # duplicate_checker seam, fired on the V2 submit path
+    duplicate_checker: Callable[[bytes], bool] | None = None
+    # FrameConn write-coalescing window, seconds: reply/broadcast
+    # frames queued within it share ONE send syscall per connection.
+    # 0 = write per frame (the pre-PR 15 behavior)
+    coalesce_seconds: float = 0.003
 
 
 @dataclasses.dataclass
@@ -627,6 +792,12 @@ class Sv2Channel:
     user: str
     extranonce2: bytes     # the channel's FIXED rolled space (standard mode)
     target: int
+    # the difficulty the channel is credited at — the CONFIGURED float
+    # (or the resume token's), not a target round-trip: V1 sessions
+    # credit session.difficulty, and a share must earn bit-identical
+    # credit regardless of which wire carried it (the bench's
+    # cross-protocol PPLNS audit pins this)
+    difficulty: float = 1.0
     seen_shares: set = dataclasses.field(default_factory=set)
     accepted: int = 0
     shares_sum: int = 0
@@ -635,6 +806,11 @@ class Sv2Channel:
     # the NewMiningJob frame); the submit path then assembles headers
     # with zero hashing. Pruned with the job window in set_job.
     roots: dict[int, bytes] = dataclasses.field(default_factory=dict)
+    # scale telemetry: duplicate verdicts delivered on this channel
+    # (local window + cross-worker/region), and whether the channel
+    # was opened via a resume token
+    duplicates: int = 0
+    resumed: bool = False
 
 
 class Sv2MiningServer:
@@ -658,21 +834,47 @@ class Sv2MiningServer:
         # sv2 job id -> (job, born, network_target): the decoded nbits
         # target rides the entry so the submit path never re-derives it
         self._jobs: dict[int, tuple[Job, float, int]] = {}
+        # sv2 job id -> (NewMiningJob frame, SetNewPrevHash frame)
+        # templates, encoded ONCE per job; the broadcast path patches
+        # channel id + merkle root per channel instead of re-encoding
+        # (the V1 set_job bytes-once trick). Pruned with _jobs.
+        self._job_frames: dict[int, tuple[bytearray, bytearray]] = {}
         self._job_seq = 0
         self._chan_seq = 0
+        # sliced channel allocation (worker/region mode): counter part
+        # of [region byte | worker slice | counter], random start per
+        # boot — pre-restart channel ids live on inside resume tokens,
+        # exactly the V1 _alloc_extranonce1 rationale
+        self._chan_counter: int | None = None
         # share-accept latency, submit-received -> verdict-written
         # (same histogram shape as the V1 server / stratum client)
         self.latency = LatencyHistogram()
         self.stats = {"connections": 0, "shares_accepted": 0,
                       "shares_rejected": 0, "blocks": 0,
-                      "handshake_failures": 0, "share_hook_failures": 0}
+                      "handshake_failures": 0, "share_hook_failures": 0,
+                      "resumes_accepted": 0, "resumes_rejected": 0,
+                      "duplicates_refused": 0, "channel_collisions": 0}
         # rate-limited handshake-failure warnings: a port scan must not
         # flood the log, but a fleet of miners failing auth (wrong pinned
         # key after a rotation) must be VISIBLE, not buried at debug
         self._hs_warn_at = 0.0
         self._hs_suppressed = 0
 
-    async def start(self) -> None:
+    async def start(self, sock=None) -> None:
+        """``sock``: serve an externally prepared listening socket (the
+        shard workers' SO_REUSEPORT siblings) instead of binding
+        host/port here — same seam StratumServer.start grew for PR 9."""
+        if self.config.session_secret and self.config.extranonce2_size < 4:
+            # resume tokens carry the channel lease in the prefix; a
+            # narrower prefix can never verify, so every handoff would
+            # SILENTLY lose its lease — fail startup with the knob
+            # named instead (config validation enforces this for the
+            # sharded/region combinations; this covers direct use)
+            raise ValueError(
+                "session_secret (channel resume) requires "
+                f"extranonce2_size >= 4, got {self.config.extranonce2_size}: "
+                "tokens carry the 32-bit channel lease in the prefix"
+            )
         if self.config.noise:
             if self.config.noise_static_key is None:
                 self.config.noise_static_key = noise.x25519_keypair()[0]
@@ -683,9 +885,12 @@ class Sv2MiningServer:
                     f"noise_static_key must be 32 bytes, got "
                     f"{len(self.config.noise_static_key)}"
                 )
-        self._server = await asyncio.start_server(
-            self._handle, self.config.host, self.config.port
-        )
+        if sock is not None:
+            self._server = await asyncio.start_server(self._handle, sock=sock)
+        else:
+            self._server = await asyncio.start_server(
+                self._handle, self.config.host, self.config.port
+            )
 
     async def stop(self) -> None:
         if self._server is not None:
@@ -727,8 +932,12 @@ class Sv2MiningServer:
         self._job_seq += 1
         jid = self._job_seq
         self._jobs[jid] = (job, time.time(), tgt.bits_to_target(job.nbits))
+        self._job_frames[jid] = self._encode_job_frames(jid, job)
         cutoff = time.time() - self.config.job_max_age
         self._jobs = {k: v for k, v in self._jobs.items() if v[1] >= cutoff}
+        self._job_frames = {
+            k: v for k, v in self._job_frames.items() if k in self._jobs
+        }
         for chan, conn in list(self._channels.values()):
             # duplicate window and root cache stay bounded: drop keys of
             # pruned jobs
@@ -751,6 +960,29 @@ class Sv2MiningServer:
         conn.send(msg_type, payload,
                   max_backlog=self.config.max_write_backlog)
 
+    def _encode_job_frames(self, jid: int,
+                           job: Job) -> tuple[bytearray, bytearray]:
+        """Encode the job's broadcast pair ONCE; per channel only the
+        channel id (both frames) and merkle root (NewMiningJob) differ,
+        and they sit at fixed offsets in the fixed-size payloads — the
+        broadcast loop patches bytes instead of re-running the message
+        encoders for every channel."""
+        nmj = bytearray(pack_frame(MSG_NEW_MINING_JOB, NewMiningJob(
+            channel_id=0, job_id=jid, future_job=False,
+            version=job.version, merkle_root=bytes(32),
+        ).encode()))
+        pnh = bytearray(pack_frame(MSG_SET_NEW_PREV_HASH, SetNewPrevHash(
+            channel_id=0, job_id=jid, prev_hash=job.prev_hash,
+            min_ntime=job.ntime, nbits=job.nbits,
+        ).encode()))
+        return nmj, pnh
+
+    # fixed patch offsets into the cached frames: 6-byte frame header,
+    # then channel_id leads both payloads; NewMiningJob's root follows
+    # its <IIBI (13-byte) prefix
+    _CID_OFF = slice(6, 10)
+    _ROOT_OFF = slice(19, 51)
+
     def _send_job(self, chan: Sv2Channel, conn: FrameConn,
                   jid: int, job: Job) -> None:
         # header-only mining: the server resolves the coinbase/merkle for
@@ -766,14 +998,20 @@ class Sv2MiningServer:
         # the submit path reuses this root: per (channel, job) the whole
         # coinbase/merkle derivation happens exactly once — here
         chan.roots[jid] = root
-        self._write(conn, MSG_NEW_MINING_JOB, NewMiningJob(
-            channel_id=chan.channel_id, job_id=jid, future_job=False,
-            version=job.version, merkle_root=root,
-        ).encode())
-        self._write(conn, MSG_SET_NEW_PREV_HASH, SetNewPrevHash(
-            channel_id=chan.channel_id, job_id=jid, prev_hash=job.prev_hash,
-            min_ntime=job.ntime, nbits=job.nbits,
-        ).encode())
+        frames = self._job_frames.get(jid)
+        if frames is None:  # channel-open replay of a pre-cache job
+            frames = self._job_frames[jid] = self._encode_job_frames(jid, job)
+        nmj, pnh = frames
+        cid = struct.pack("<I", chan.channel_id)
+        nmj[self._CID_OFF] = cid
+        nmj[self._ROOT_OFF] = root
+        pnh[self._CID_OFF] = cid
+        # two frames, sealed separately (the noise receiver reassembles
+        # per SV2 frame) but coalesced into one transport write when the
+        # connection runs a coalescing window
+        backlog = self.config.max_write_backlog
+        conn.send_frame(bytes(nmj), max_backlog=backlog)
+        conn.send_frame(bytes(pnh), max_backlog=backlog)
 
     # -- connection handling -------------------------------------------------
 
@@ -800,7 +1038,8 @@ class Sv2MiningServer:
         # the connection counts against the cap (and is reapable by
         # stop()) from TCP-accept on: a peer stalling the noise
         # handshake must not hold sockets OUTSIDE the cap
-        conn = FrameConn(reader, writer)
+        conn = FrameConn(reader, writer,
+                         coalesce=self.config.coalesce_seconds)
         self._conns.add(conn)
         if self.config.noise:
             try:
@@ -861,6 +1100,14 @@ class Sv2MiningServer:
                 if isinstance(msg, OpenStandardMiningChannel):
                     await self._on_open_channel(
                         msg, conn, conn_channels)
+                elif isinstance(msg, ResumeChannel):
+                    await self._on_open_channel(
+                        OpenStandardMiningChannel(
+                            request_id=msg.request_id,
+                            user_identity=msg.user_identity,
+                            nominal_hash_rate=msg.nominal_hash_rate,
+                            max_target=msg.max_target,
+                        ), conn, conn_channels, token=msg.token)
                 elif isinstance(msg, SubmitSharesStandard):
                     await self._on_submit(msg, conn)
                 else:
@@ -883,29 +1130,157 @@ class Sv2MiningServer:
             self._conns.discard(conn)
             conn.close()
 
+    def _alloc_channel(self) -> tuple[int, bytes]:
+        """Lease one channel id + its fixed extranonce prefix.
+
+        Single process, no region: the legacy per-process counter. With
+        ``worker_bits``/``extranonce_prefix_byte`` set, the id comes
+        from the SAME partitioned lease space V1 extranonce1 uses —
+        ``[region byte | worker_index (worker_bits) | counter]`` in 32
+        bits (24 under a region byte) — and the prefix is its
+        big-endian encoding, so two workers (or two regions) can never
+        hand V2 miners overlapping search spaces. The counter starts
+        at a RANDOM point per boot (pre-restart channel ids live on
+        inside resume tokens held by handed-off miners); a collision
+        with a LIVE local channel (a resumed pre-restart lease) is
+        skipped and counted, and the assertion fires only when the
+        scan finds no free lease at all — saturation, or another
+        allocator flooding OUR slice."""
+        from otedama_tpu.stratum.server import compose_lease, lease_slice_params
+
+        cfg = self.config
+        prefix = cfg.extranonce_prefix_byte
+        wbits = cfg.worker_bits
+        width = cfg.extranonce2_size
+        if prefix is None and wbits == 0:
+            # single front-end, single process: the legacy counter —
+            # but the liveness check still applies: with resume
+            # enabled, a post-restart counter can walk into a channel
+            # id a token already recovered, and handing it out twice
+            # would overwrite the resumed miner's live channel
+            for _ in range(4096):
+                self._chan_seq += 1
+                cid = self._chan_seq
+                if cid not in self._channels:
+                    return cid, cid.to_bytes(width, "big")
+                self.stats["channel_collisions"] += 1
+            raise AssertionError(
+                "no free sv2 channel id after 4096 scans of the "
+                "legacy counter (resumed channels saturating it?)"
+            )
+        if width < 4:
+            raise ValueError(
+                f"extranonce2_size {width} cannot carry the 32-bit "
+                "[region|worker|counter] channel lease (need >= 4)"
+            )
+        # ONE definition of the slice math, shared with V1's
+        # _alloc_extranonce1 (stratum/server.py) — the two allocators
+        # partition the same space and must never drift
+        counter_bits, slice_base = lease_slice_params(
+            prefix, cfg.worker_index, wbits)
+        if self._chan_counter is None:
+            self._chan_counter = secrets.randbits(counter_bits)
+        for _ in range(4096):
+            v = self._chan_counter
+            self._chan_counter = (v + 1) % (1 << counter_bits)
+            cid = compose_lease(prefix, slice_base | v)
+            if cid == 0:
+                # reserved, never leased (a zero channel id is
+                # indistinguishable from an unset field in too many
+                # tooling paths) — not a collision, just skipped
+                continue
+            if cid not in self._channels:
+                return cid, cid.to_bytes(width, "big")
+            self.stats["channel_collisions"] += 1
+            log.warning(
+                "sv2 channel id %d already live (resumed pre-restart "
+                "channel?); skipping", cid)
+        raise AssertionError(
+            f"no free sv2 channel lease in slice (prefix={prefix} "
+            f"worker={cfg.worker_index}/{wbits} bits): the space is "
+            "saturated or the slice is not exclusively ours"
+        )
+
+    def _try_resume_channel(
+            self, token: str) -> tuple[int, bytes, float] | None:
+        """Validate a presented channel-resume token. Returns the
+        recovered (channel_id, extranonce_prefix, difficulty), or None
+        — every defect degrades to a fresh channel, never an error (the
+        miner is mid-reconnect; the V1 ``_try_resume`` rule). Only
+        tokens TYPED "v2" verify: the V1 allocator's live-collision
+        scan cannot see V2 channels (and vice versa), so a V1 session
+        token replayed here could alias a lease still live on the V1
+        server."""
+        cfg = self.config
+        state = session_resume.verify_token(
+            cfg.session_secret, token, ttl=cfg.resume_token_ttl,
+            protocol="v2")
+        if state is None:
+            return None
+        en2 = state.extranonce1  # V2 tokens carry the channel prefix here
+        if len(en2) != cfg.extranonce2_size or len(en2) < 4:
+            return None
+        cid = int.from_bytes(en2, "big")
+        if not (0 < cid < (1 << 32)):
+            return None
+        if cid in self._channels:
+            # the leased space is live HERE (replayed token, or the
+            # "dead" channel still draining) — refuse the alias
+            return None
+        return cid, en2, state.difficulty
+
+    def _issue_resume_token(self, chan: Sv2Channel) -> str:
+        return session_resume.issue_token(
+            self.config.session_secret, self.config.region_id,
+            chan.extranonce2, chan.difficulty, protocol="v2",
+        )
+
     async def _on_open_channel(self, msg: OpenStandardMiningChannel,
                                conn: FrameConn,
-                               conn_channels: list[int]) -> None:
+                               conn_channels: list[int],
+                               token: str = "") -> None:
         if len(conn_channels) >= self.config.max_channels_per_conn:
             self._write(conn, MSG_OPEN_STANDARD_MINING_CHANNEL_ERROR,
                         OpenStandardMiningChannelError(
                             msg.request_id, "too-many-channels").encode())
             await conn.drain()
             return
-        self._chan_seq += 1
-        cid = self._chan_seq
-        target = min(
-            tgt.difficulty_to_target(self.config.initial_difficulty),
-            msg.max_target,
-        )
+        resumed = None
+        if token and self.config.session_secret:
+            resumed = self._try_resume_channel(token)
+            if resumed is None:
+                self.stats["resumes_rejected"] += 1
+                log.info("sv2 resume token rejected; fresh channel")
+        if resumed is not None:
+            cid, en2, difficulty = resumed
+            self.stats["resumes_accepted"] += 1
+        else:
+            try:
+                cid, en2 = self._alloc_channel()
+            except Exception as e:
+                # e.g. a saturated slice — refuse this open, keep serving
+                log.warning("sv2 channel allocation refused: %s", e)
+                self._write(conn, MSG_OPEN_STANDARD_MINING_CHANNEL_ERROR,
+                            OpenStandardMiningChannelError(
+                                msg.request_id,
+                                "channel-allocation-failed").encode())
+                await conn.drain()
+                return
+            difficulty = self.config.initial_difficulty
+        target = min(tgt.difficulty_to_target(difficulty), msg.max_target)
+        if target != tgt.difficulty_to_target(difficulty):
+            # the miner's max_target clamped us: the credited difficulty
+            # must describe the target actually enforced
+            difficulty = tgt.target_to_difficulty(target)
         # the advertised prefix and the mined space derive from the SAME
-        # source: the configured channel width, fixed for the channel's
-        # lifetime (set_job rejects jobs of any other width)
+        # source: the leased channel id at the configured channel width,
+        # fixed for the channel's lifetime (set_job rejects jobs of any
+        # other width)
         latest = self._jobs[max(self._jobs)][0] if self._jobs else None
         chan = Sv2Channel(
             channel_id=cid, user=msg.user_identity,
-            extranonce2=cid.to_bytes(self.config.extranonce2_size, "big"),
-            target=target,
+            extranonce2=en2, target=target, difficulty=difficulty,
+            resumed=resumed is not None,
         )
         self._channels[cid] = (chan, conn)
         conn_channels.append(cid)
@@ -914,6 +1289,13 @@ class Sv2MiningServer:
                         request_id=msg.request_id, channel_id=cid,
                         target=target, extranonce_prefix=chan.extranonce2,
                     ).encode())
+        if self.config.session_secret:
+            # issued immediately (and always describing CURRENT state):
+            # the token must already be in the miner's hands when this
+            # worker dies — V1 sends its twin inside the subscribe reply
+            self._write(conn, MSG_SET_RESUME_TOKEN, SetResumeToken(
+                channel_id=cid,
+                token=self._issue_resume_token(chan)).encode())
         # the freshest job goes out immediately (SV2 channels are useless
         # until the first NewMiningJob + SetNewPrevHash pair lands)
         if latest is not None:
@@ -944,6 +1326,21 @@ class Sv2MiningServer:
             await self._maybe_drain(conn)
             self.latency.observe(time.monotonic() - t0)
 
+        # chaos seam (docs/FAULT_INJECTION.md): drop = the submission
+        # is lost in flight (no verdict — the miner resubmits), delay =
+        # a stalled validator, error = server-side processing failure
+        # delivered as a visible reject, never a dropped peer
+        try:
+            d = faults.hit("sv2.submit", str(msg.channel_id), faults.STEP)
+        except faults.FaultInjectedError:
+            await reject("share-processing-failure")
+            return
+        if d is not None:
+            if d.drop:
+                return
+            if d.delay:
+                await asyncio.sleep(d.delay)
+
         if entry is None:
             await reject("invalid-channel-id")
             return
@@ -966,6 +1363,7 @@ class Sv2MiningServer:
             return
         key = (msg.job_id, msg.nonce, msg.ntime, msg.version)
         if key in chan.seen_shares:
+            chan.duplicates += 1
             await reject("duplicate-share")
             return
         # exact reconstruction: channel-fixed extranonce2, share-rolled
@@ -989,6 +1387,16 @@ class Sv2MiningServer:
             + struct.pack("<I", job.nbits)
             + struct.pack(">I", msg.nonce)
         )
+        # cross-region duplicate window: ``chan.seen_shares`` above is
+        # process-local, so a share replayed to another front-end needs
+        # the chain-backed index (pool/regions.py) to die here too —
+        # checked BEFORE the PoW digest, exactly like the V1 server
+        checker = self.config.duplicate_checker
+        if checker is not None and checker(header):
+            chan.duplicates += 1
+            self.stats["duplicates_refused"] += 1
+            await reject("duplicate-share")
+            return
         if job.algorithm in SLOW_HOST_ALGOS:
             # same discipline as the V1 server: heavyweight host digests
             # (ethash cache builds!) run off the event loop, on the
@@ -1014,7 +1422,7 @@ class Sv2MiningServer:
             session_id=chan.channel_id,
             worker_user=chan.user,
             job_id=str(msg.job_id),
-            difficulty=tgt.target_to_difficulty(chan.target),
+            difficulty=chan.difficulty,
             actual_difficulty=tgt.difficulty_of_digest(digest),
             digest=digest,
             header=header,
@@ -1032,6 +1440,16 @@ class Sv2MiningServer:
         if self.on_share is not None:
             try:
                 await self.on_share(accepted)
+            except DuplicateShareError:
+                # a POLICY reject decided by the ledger owner (the shard
+                # supervisor's parent window, another region's chain
+                # index): delivered verbatim. The share STAYS in
+                # seen_shares — it IS a known submission, and a resubmit
+                # must reject the same way, not re-commit (V1 parity)
+                chan.duplicates += 1
+                self.stats["duplicates_refused"] += 1
+                await reject("duplicate-share")
+                return
             except Exception:
                 log.exception("sv2 share hook failed; rejecting share")
                 # un-remember: the uncredited share must be resubmittable
@@ -1070,10 +1488,26 @@ class Sv2MiningServer:
             if self.on_block is not None:
                 await self.on_block(header, job, accepted)
 
-    def snapshot(self) -> dict:
+    def counters(self) -> dict:
+        """Counters + channel gauges WITHOUT the latency snapshot —
+        the cheap surface the metrics exporter reads (it exports the
+        latency histogram separately via ``.latency``)."""
         return {
             **self.stats,
             "channels": len(self._channels),
+            # live channels opened via a resume token (handoff survivors)
+            "channels_resumed": sum(
+                1 for c, _ in self._channels.values() if c.resumed),
+            # duplicate verdicts summed over LIVE channels (includes the
+            # channel-local window rejects, which the server-level
+            # duplicates_refused counter — cross-window only — does not)
+            "channel_duplicates": sum(
+                c.duplicates for c, _ in self._channels.values()),
+        }
+
+    def snapshot(self) -> dict:
+        return {
+            **self.counters(),
             "jobs": len(self._jobs),
             "accept_latency": self.latency.snapshot(),
         }
@@ -1089,7 +1523,8 @@ class Sv2MiningClient:
     def __init__(self, host: str, port: int, user: str = "worker",
                  allow_uninterop: bool = False, noise: bool = False,
                  expected_server_key: bytes | None = None,
-                 authority_key: bytes | None = None):
+                 authority_key: bytes | None = None,
+                 resume_token: str = ""):
         if (not INTEROP_VERIFIED and not allow_uninterop
                 and host not in ("127.0.0.1", "::1", "localhost")):
             # enforced in code, not prose (verdict r4 weak #5): the
@@ -1116,6 +1551,10 @@ class Sv2MiningClient:
         # servers, instead of expected_server_key's exact-match pin
         self.authority_key = authority_key
         self.noise_server_key: bytes | None = None
+        # channel-resume handoff: the last SetResumeToken the server
+        # issued (presented on the next connect to recover the channel
+        # id / extranonce prefix / difficulty on any sibling front-end)
+        self.resume_token = resume_token
         self.reader: asyncio.StreamReader | None = None
         self.writer: asyncio.StreamWriter | None = None
         self._conn: FrameConn | None = None
@@ -1166,12 +1605,26 @@ class Sv2MiningClient:
         msg = decode_message(mtype, payload)
         if not isinstance(msg, SetupConnectionSuccess):
             raise ConnectionError(f"setup rejected: {msg}")
-        self._conn.send(
-            MSG_OPEN_STANDARD_MINING_CHANNEL,
-            OpenStandardMiningChannel(
-                request_id=request_id, user_identity=self.user
-            ).encode(),
-        )
+        if self.resume_token:
+            # channel reopen: the signed token recovers channel id,
+            # extranonce prefix, and difficulty on this front-end (any
+            # sibling sharing the secret); a stale/foreign token
+            # degrades server-side to a fresh channel — the reply is
+            # the standard open success either way
+            self._conn.send(
+                MSG_RESUME_CHANNEL,
+                ResumeChannel(
+                    request_id=request_id, user_identity=self.user,
+                    token=self.resume_token,
+                ).encode(),
+            )
+        else:
+            self._conn.send(
+                MSG_OPEN_STANDARD_MINING_CHANNEL,
+                OpenStandardMiningChannel(
+                    request_id=request_id, user_identity=self.user
+                ).encode(),
+            )
         _, mtype, payload = await self._conn.recv()
         msg = decode_message(mtype, payload)
         if not isinstance(msg, OpenStandardMiningChannelSuccess):
@@ -1189,6 +1642,8 @@ class Sv2MiningClient:
             self.prevhash = msg
         elif isinstance(msg, SetTarget):
             self.target = msg.maximum_target
+        elif isinstance(msg, SetResumeToken):
+            self.resume_token = msg.token
         elif isinstance(msg, (SubmitSharesSuccess, SubmitSharesError)):
             await self._results.put(msg)
         return msg

@@ -35,6 +35,7 @@ Fault point registry (grep for ``faults.hit`` to verify):
     stratum.client.read / stratum.client.send   (stratum/client.py; tag host:port)
     stratum.server.read / stratum.server.write  (stratum/server.py; tag session id)
     sv2.conn.send / sv2.conn.recv               (stratum/v2.py FrameConn)
+    sv2.submit                                  (stratum/v2.py submit path; tag channel id)
     p2p.peer.send / p2p.peer.recv               (p2p/node.py; tag peer id prefix)
     p2p.mem.send                                (p2p/memnet.py MemoryWriter)
     p2p.share.verify                            (p2p/pool.py; tag share id prefix)

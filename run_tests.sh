@@ -21,6 +21,15 @@
 #                   the group-commit curve); asserts exact accounting
 #                   AND an identical PPLNS split between legs; writes a
 #                   BENCH_STRATUM json artifact.
+#   stratum-v2-bench  opt-in Stratum V2 sharded soak (PR 15): the same
+#                   10k-connection x N-worker pace sweep driven over
+#                   the BINARY protocol (Noise-NX transport on; the
+#                   handshake's share of the connect ramp reported
+#                   separately) against the workers' V2 siblings, with
+#                   a single-process V1 control leg asserting accepted
+#                   totals + PPLNS split byte-identical ACROSS
+#                   PROTOCOLS and measured per-share wire bytes
+#                   V2 < V1; writes a BENCH_STRATUM json artifact.
 #   switch-bench    opt-in compilation-lifecycle bench: cold-start with
 #                   cold vs warm persistent XLA cache + mid-run
 #                   sha256d->scrypt warm switch; writes a BENCH_SWITCH
@@ -96,6 +105,16 @@ case "$tier" in
       --control \
       --pace "${STRATUM_BENCH_PACES:-1500,3000,4500,6500}" \
       --out "${STRATUM_BENCH_OUT:-BENCH_STRATUM_manual.json}" "$@" ;;
+  stratum-v2-bench)
+    exec env JAX_PLATFORMS=cpu python tools/bench_stratum.py \
+      --v2 \
+      --workers "${STRATUM_BENCH_WORKERS:-4}" \
+      --connections "${STRATUM_BENCH_CONNS:-10000}" \
+      --window "${STRATUM_BENCH_WINDOW:-12}" \
+      --connect-rate "${STRATUM_BENCH_CONNECT_RATE:-250}" \
+      --control \
+      --pace "${STRATUM_BENCH_PACES:-1500,3000,4500,6500}" \
+      --out "${STRATUM_BENCH_OUT:-BENCH_STRATUM_manual.json}" "$@" ;;
   validate-bench)
     exec env JAX_PLATFORMS=cpu python tools/bench_validate.py \
       --out "${VALIDATE_BENCH_OUT:-BENCH_VALIDATE_manual.json}" "$@" ;;
@@ -124,5 +143,5 @@ case "$tier" in
   chain-bench)
     exec env JAX_PLATFORMS=cpu python tools/bench_chain.py \
       --out "${CHAIN_BENCH_OUT:-BENCH_CHAIN_manual.json}" "$@" ;;
-  *) echo "usage: $0 [fast|slow|all|audit|stratum-bench|stratum-shard-bench|switch-bench|degrade-bench|engine-bench|validate-bench|sharechain-bench|region-bench|payout-bench|chain-bench] [pytest args...]" >&2; exit 2 ;;
+  *) echo "usage: $0 [fast|slow|all|audit|stratum-bench|stratum-shard-bench|stratum-v2-bench|switch-bench|degrade-bench|engine-bench|validate-bench|sharechain-bench|region-bench|payout-bench|chain-bench] [pytest args...]" >&2; exit 2 ;;
 esac

@@ -757,6 +757,13 @@ def test_region_config_validation():
     assert any("repeat" in e for e in validate_config(cfg))
     cfg.region.regions = [0, 1]
     assert validate_config(cfg) == []
-    # V2 channels lack region partitioning/dedup seams: refused loudly
+    # PR 15 lifted the region+v2 refusal: V2 channel leases carry the
+    # region byte and replays die at the chain-backed index, so the
+    # combination is VALID — unless the channel prefix is too narrow
+    # to carry the [region|worker|counter] lease
     cfg.stratum.v2_enabled = True
-    assert any("stratum.v2_enabled" in e for e in validate_config(cfg))
+    assert validate_config(cfg) == []
+    cfg.stratum.extranonce2_size = 3
+    assert any("extranonce2_size" in e for e in validate_config(cfg))
+    cfg.stratum.extranonce2_size = 4
+    assert validate_config(cfg) == []

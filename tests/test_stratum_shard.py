@@ -507,6 +507,305 @@ async def test_worker_crash_chaos_exact_accounting():
         await sup.stop()
 
 
+# -- sharded Stratum V2 (PR 15) -----------------------------------------------
+
+
+def _mine_v2(job: Job, en2: bytes, target: int, version: int,
+             start: int = 0) -> int:
+    """Find a nonce for a V2 standard channel (fixed en2, rolled
+    version) using the server's own validation math."""
+    import struct as _s
+
+    prefix = jobmod.build_header_prefix(
+        dataclasses.replace(job, extranonce1=b""), en2)
+    prefix = _s.pack("<I", version) + prefix[4:]
+    for nonce in range(start, start + (1 << 22)):
+        if tgt.hash_meets_target(
+                sha256d(prefix + _s.pack(">I", nonce)), target):
+            return nonce
+    raise AssertionError("unlucky premine")
+
+
+async def _v2_connect(port: int, user: str, token: str = "",
+                      attempts: int = 60):
+    """Connect an Sv2 client with retries (every worker may be down
+    mid-respawn during chaos runs); waits for job + prevhash + token."""
+    from otedama_tpu.stratum import v2
+
+    last: Exception | None = None
+    for _ in range(attempts):
+        c = v2.Sv2MiningClient("127.0.0.1", port, user=user,
+                               resume_token=token)
+        try:
+            await asyncio.wait_for(c.connect(), 10)
+            while not (c.jobs and c.prevhash and (
+                    c.resume_token or not token)):
+                await asyncio.wait_for(c.pump(), 10)
+            return c
+        except (OSError, ConnectionError, asyncio.TimeoutError,
+                asyncio.IncompleteReadError) as e:
+            last = e
+            await c.close()
+            await asyncio.sleep(0.25)
+    raise ConnectionError(f"no worker ever accepted v2: {last}")
+
+
+@pytest.mark.asyncio
+async def test_sharded_v2_exact_accounting_and_cross_worker_replay():
+    """Tentpole proof at test scale: 2 workers serve V2 siblings of the
+    v2 port, accepted V2 shares cross the binary share bus into the
+    parent ledger (verdict awaits the ack), a token handoff preserves
+    the channel lease, and a replay through the fresh channel-local
+    window dies at the PARENT dedup window as duplicate-share."""
+    from otedama_tpu.stratum import v2
+
+    hooked = []
+
+    async def on_share(s):
+        hooked.append(s)
+
+    sup = ShardSupervisor(
+        ServerConfig(port=0, initial_difficulty=EASY, max_clients=64),
+        ShardConfig(workers=2, snapshot_interval=0.2),
+        on_share=on_share,
+        v2_config=v2.Sv2ServerConfig(port=0, initial_difficulty=EASY),
+    )
+    await sup.start()
+    try:
+        job = make_job()
+        sup.set_job(job)
+        # channel leases must be disjoint across the live fleet
+        clients = [await _v2_connect(sup.v2_config.port, f"w.{i}")
+                   for i in range(4)]
+        assert len({c.channel.channel_id for c in clients}) == 4
+        assert len({c.channel.extranonce_prefix for c in clients}) == 4
+        for i, c in enumerate(clients):
+            en2 = c.channel.extranonce_prefix
+            nonce = _mine_v2(job, en2, c.target, job.version)
+            res = await c.submit(max(c.jobs), nonce, job.ntime, job.version)
+            assert isinstance(res, v2.SubmitSharesSuccess)
+            if i == 0:
+                # token handoff: reconnect (any worker), lease intact,
+                # replay refused by the PARENT window, fresh share lands
+                token = c.resume_token
+                await c.close()
+                c2 = await _v2_connect(sup.v2_config.port, "w.0", token)
+                assert c2.channel.channel_id == c.channel.channel_id
+                assert c2.channel.extranonce_prefix == en2
+                assert c2.target == c.target
+                r2 = await c2.submit(max(c2.jobs), nonce, job.ntime,
+                                     job.version)
+                assert isinstance(r2, v2.SubmitSharesError)
+                assert r2.error_code == "duplicate-share"
+                n2 = _mine_v2(job, en2, c2.target, job.version,
+                              start=nonce + 1)
+                r3 = await c2.submit(max(c2.jobs), n2, job.ntime,
+                                     job.version)
+                assert isinstance(r3, v2.SubmitSharesSuccess)
+                clients[0] = c2
+        await asyncio.sleep(0.5)  # one snapshot push interval
+        snap = sup.snapshot()
+        assert len(hooked) == 5
+        headers = [s.header for s in hooked]
+        assert len(headers) == len(set(headers))
+        assert snap["bus"]["shares_committed"] == 5
+        assert snap["bus"]["duplicates_refused"] == 1
+        assert snap["v2"]["shares_accepted"] == 5
+        assert snap["v2"]["duplicates_refused"] == 1
+        assert snap["v2"]["resumes_accepted"] == 1
+        assert snap["v2"]["channels"] == 4
+        assert snap["v2"]["channels_resumed"] == 1
+        assert snap["v2"]["accept_latency"]["count"] >= 6
+        # the metrics facade mirrors the merged view
+        view = sup.v2_view()
+        assert view.snapshot()["shares_accepted"] == 5
+        assert view.latency.count >= 6
+        for c in clients:
+            await c.close()
+    finally:
+        await sup.stop()
+
+
+@pytest.mark.asyncio
+async def test_sharded_v2_noise_one_fleet_identity():
+    """With v2_noise and no configured static key, the SUPERVISOR mints
+    one key for the whole fleet (not one per worker): a key-pinning
+    miner must be able to complete the handshake on ANY worker, or a
+    crash handoff would die at the transport before resume ever ran."""
+    from otedama_tpu.stratum import noise, v2
+
+    sup = ShardSupervisor(
+        ServerConfig(port=0, initial_difficulty=EASY, max_clients=64),
+        ShardConfig(workers=2, snapshot_interval=0.2),
+        v2_config=v2.Sv2ServerConfig(port=0, initial_difficulty=EASY,
+                                     noise=True),
+    )
+    await sup.start()
+    try:
+        assert sup.v2_config.noise_static_key is not None
+        pub = noise.x25519_keypair(sup.v2_config.noise_static_key)[1]
+        sup.set_job(make_job())
+        # several pinned connects: SO_REUSEPORT spreads them over both
+        # workers, and every one must see the SAME fleet identity
+        for i in range(4):
+            c = v2.Sv2MiningClient("127.0.0.1", sup.v2_config.port,
+                                   user=f"w.{i}", noise=True,
+                                   expected_server_key=pub)
+            await c.connect()
+            assert c.noise_server_key == pub
+            await c.close()
+    finally:
+        await sup.stop()
+
+
+@pytest.mark.asyncio
+async def test_sharded_v2_worker_crash_token_resume():
+    """Satellite: a seeded ``worker.crash`` plan kills every worker
+    that reaches its 2nd forwarded share (V2 shares drive the same
+    heartbeat), miners token-resume onto survivors with channel id,
+    extranonce prefix, AND difficulty intact, and every logical share
+    lands in the parent ledger exactly once."""
+    from otedama_tpu.stratum import v2
+
+    hooked = []
+
+    async def on_share(s):
+        hooked.append(s)
+
+    sup = ShardSupervisor(
+        ServerConfig(port=0, initial_difficulty=EASY, max_clients=64),
+        ShardConfig(
+            workers=3, snapshot_interval=0.2, respawn_backoff=0.1,
+            fault_spec={"seed": 9, "rules": [{
+                "point": "worker.crash:*", "action": "crash",
+                "component": "worker", "every_nth": 2, "max_fires": 1,
+            }]},
+        ),
+        on_share=on_share,
+        v2_config=v2.Sv2ServerConfig(port=0, initial_difficulty=EASY),
+    )
+    await sup.start()
+    try:
+        job = make_job()
+        sup.set_job(job)
+        miners = [await _v2_connect(sup.v2_config.port, f"w.{i}")
+                  for i in range(6)]
+        resumed_exactly = {"ok": True}
+
+        async def drive(idx: int) -> tuple[int, int]:
+            c = miners[idx]
+            accepted = dup_rejected = 0
+            lease = (c.channel.channel_id, c.channel.extranonce_prefix,
+                     c.target)
+            nonce = -1
+            for i in range(4):
+                en2 = c.channel.extranonce_prefix
+                nonce = _mine_v2(job, en2, c.target, job.version,
+                                 start=nonce + 1)
+                for attempt in range(8):
+                    try:
+                        res = await asyncio.wait_for(
+                            c.submit(max(c.jobs), nonce, job.ntime,
+                                     job.version), 15)
+                    except (ConnectionError, asyncio.TimeoutError, OSError,
+                            asyncio.IncompleteReadError):
+                        # the worker died mid-verdict: resume onto a
+                        # survivor with the token and retry
+                        token = c.resume_token
+                        await c.close()
+                        c = await _v2_connect(sup.v2_config.port,
+                                              f"w.{idx}", token)
+                        miners[idx] = c
+                        if (c.channel.channel_id,
+                                c.channel.extranonce_prefix,
+                                c.target) != lease:
+                            resumed_exactly["ok"] = False
+                        continue
+                    if isinstance(res, v2.SubmitSharesSuccess):
+                        accepted += 1
+                    elif (isinstance(res, v2.SubmitSharesError)
+                          and res.error_code == "duplicate-share"):
+                        # verdict died with the worker but the commit
+                        # landed: exactly-once says the reject is right
+                        dup_rejected += 1
+                    else:
+                        raise AssertionError(f"unexpected verdict {res}")
+                    break
+                else:
+                    raise AssertionError("share never got a verdict")
+            return accepted, dup_rejected
+
+        results = await asyncio.gather(*[drive(i) for i in range(6)])
+        accepted = sum(a for a, _ in results)
+        dup_rejected = sum(d for _, d in results)
+        assert accepted + dup_rejected == 24
+        assert len(hooked) == 24, f"{len(hooked)} committed != 24"
+        headers = [s.header for s in hooked]
+        assert len(headers) == len(set(headers)), "double-committed share"
+        assert resumed_exactly["ok"], (
+            "a resume lost channel id / prefix / difficulty")
+        await asyncio.sleep(0.5)
+        snap = sup.snapshot()
+        assert snap["workers"]["deaths"] >= 1
+        assert snap["v2"]["resumes_accepted"] >= 1
+        for c in miners:
+            await c.close()
+    finally:
+        await sup.stop()
+
+
+@pytest.mark.asyncio
+async def test_app_sharded_v2_wiring():
+    """stratum.workers > 1 + v2_enabled through the real Application:
+    the supervisor owns the V2 listeners, a V2 share lands in POOL
+    ACCOUNTING, and the stratum_v2 snapshot provider serves the merged
+    view."""
+    from otedama_tpu.app import Application
+    from otedama_tpu.config.schema import AppConfig, validate_config
+    from otedama_tpu.stratum import v2
+
+    cfg = AppConfig()
+    cfg.mining.enabled = False
+    cfg.api.enabled = False
+    cfg.pool.enabled = True
+    cfg.pool.database = ":memory:"
+    cfg.stratum.host = "127.0.0.1"
+    cfg.stratum.port = 0
+    cfg.stratum.workers = 2
+    cfg.stratum.v2_enabled = True
+    cfg.stratum.v2_port = 0
+    cfg.stratum.initial_difficulty = EASY
+    assert validate_config(cfg) == []
+    app = Application(cfg)
+    await app.start()
+    try:
+        assert isinstance(app.server, ShardSupervisor)
+        assert app.server_v2 is None  # the supervisor owns V2 serving
+        assert app.server.v2_config.port > 0
+        for _ in range(100):
+            if app.server.current_job is not None:
+                break
+            await asyncio.sleep(0.05)
+        job = app.server.current_job
+        c = await _v2_connect(app.server.v2_config.port, "w.0")
+        nonce = _mine_v2(job, c.channel.extranonce_prefix, c.target,
+                         job.version)
+        res = await c.submit(max(c.jobs), nonce, job.ntime, job.version)
+        assert isinstance(res, v2.SubmitSharesSuccess)
+        assert app.pool.shares.count() == 1
+        # worker counters land on the next snapshot push interval
+        for _ in range(100):
+            snap = app.snapshot()
+            if snap["stratum"].get("v2", {}).get("shares_accepted"):
+                break
+            await asyncio.sleep(0.1)
+        assert snap["stratum"]["v2"]["shares_accepted"] == 1
+        assert snap["stratum_v2"]["shares_accepted"] == 1
+        await c.close()
+    finally:
+        await app.stop()
+
+
 @pytest.mark.asyncio
 async def test_app_sharded_stratum_wiring():
     from otedama_tpu.app import Application
@@ -555,9 +854,16 @@ def test_config_validation_workers():
     cfg = AppConfig()
     cfg.stratum.workers = 99
     assert any("stratum.workers" in e for e in validate_config(cfg))
+    # PR 15 lifted the workers+v2 refusal: the sharded front-end serves
+    # V2 siblings with sliced channel leases, so the combination is
+    # VALID now — what gets validated instead is that the channel
+    # prefix is wide enough to carry the [region|worker|counter] lease
     cfg.stratum.workers = 4
     cfg.stratum.v2_enabled = True
-    assert any("v2_enabled" in e for e in validate_config(cfg))
+    assert validate_config(cfg) == []
+    cfg.stratum.extranonce2_size = 2
+    assert any("extranonce2_size" in e for e in validate_config(cfg))
+    cfg.stratum.extranonce2_size = 4
     cfg.stratum.v2_enabled = False
     assert validate_config(cfg) == []
 

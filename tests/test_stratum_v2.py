@@ -267,6 +267,365 @@ def test_set_job_rejects_divergent_extranonce_width():
     assert srv.set_job(job) == 1  # configured width still publishes
 
 
+# -- worker/region channel slicing (PR 15) ------------------------------------
+
+
+def test_channel_slices_disjoint_across_workers():
+    # no region prefix: worker slices partition the 32-bit channel space
+    s0 = v2.Sv2MiningServer(v2.Sv2ServerConfig(worker_index=0, worker_bits=2))
+    s1 = v2.Sv2MiningServer(v2.Sv2ServerConfig(worker_index=3, worker_bits=2))
+    a, b = set(), set()
+    for i in range(500):
+        cid, en2 = s0._alloc_channel()
+        s0._channels[cid] = (None, None)  # occupy like a live channel
+        assert en2 == cid.to_bytes(4, "big")
+        a.add(cid)
+        cid, en2 = s1._alloc_channel()
+        s1._channels[cid] = (None, None)
+        b.add(cid)
+    assert len(a) == len(b) == 500
+    assert not (a & b)
+    assert all(cid >> 30 == 0 for cid in a)
+    assert all(cid >> 30 == 3 for cid in b)
+
+
+def test_channel_slices_compose_under_region_prefix():
+    # [region byte | worker bits | counter] — V1 slice-scheme parity
+    s = v2.Sv2MiningServer(v2.Sv2ServerConfig(
+        extranonce_prefix_byte=7, worker_index=2, worker_bits=3))
+    sib = v2.Sv2MiningServer(v2.Sv2ServerConfig(
+        extranonce_prefix_byte=7, worker_index=5, worker_bits=3))
+    mine_, theirs = set(), set()
+    for i in range(200):
+        cid, en2 = s._alloc_channel()
+        s._channels[cid] = (None, None)
+        assert en2[0] == 7 and len(en2) == 4
+        assert (cid >> 24) == 7
+        assert ((cid >> 21) & 0x7) == 2
+        mine_.add(cid)
+        cid, _ = sib._alloc_channel()
+        sib._channels[cid] = (None, None)
+        theirs.add(cid)
+    assert not (mine_ & theirs)
+
+
+def test_channel_slice_saturation_asserts():
+    # worker_bits=16 under a region prefix leaves an 8-bit counter:
+    # occupy every lease and the scan must refuse loudly instead of
+    # silently re-leasing a live channel's search space
+    s = v2.Sv2MiningServer(v2.Sv2ServerConfig(
+        extranonce_prefix_byte=1, worker_index=9, worker_bits=16))
+    for i in range(256):
+        cid = (1 << 24) | (9 << 8) | i
+        s._channels[cid] = (None, None)
+    with pytest.raises(AssertionError):
+        s._alloc_channel()
+    assert s.stats["channel_collisions"] >= 256
+
+
+def test_channel_slice_bounds_refused():
+    with pytest.raises(ValueError, match="counter bits"):
+        v2.Sv2MiningServer(v2.Sv2ServerConfig(
+            extranonce_prefix_byte=1, worker_bits=17))._alloc_channel()
+    with pytest.raises(ValueError, match="worker_index"):
+        v2.Sv2MiningServer(v2.Sv2ServerConfig(
+            worker_index=4, worker_bits=2))._alloc_channel()
+    with pytest.raises(ValueError, match="extranonce2_size"):
+        v2.Sv2MiningServer(v2.Sv2ServerConfig(
+            extranonce2_size=2, worker_bits=2))._alloc_channel()
+
+
+@pytest.mark.asyncio
+async def test_resume_requires_lease_wide_prefix():
+    # resume enabled + a prefix too narrow to carry the lease would
+    # issue tokens that can never verify (every handoff silently loses
+    # its lease) — startup must refuse with the knob named
+    server = v2.Sv2MiningServer(v2.Sv2ServerConfig(
+        port=0, session_secret="x", extranonce2_size=3))
+    with pytest.raises(ValueError, match="extranonce2_size"):
+        await server.start()
+
+
+def test_legacy_alloc_skips_resumed_channels():
+    # the unsliced counter path must honour the SAME liveness check as
+    # the sliced scan: after a restart, a token-resumed channel can
+    # occupy an id the fresh counter would otherwise walk straight into
+    # — handing it out twice would overwrite the resumed miner's channel
+    s = v2.Sv2MiningServer(v2.Sv2ServerConfig(session_secret="x"))
+    s._channels[1] = (None, None)   # resumed pre-restart channels
+    s._channels[2] = (None, None)
+    cid, en2 = s._alloc_channel()
+    assert cid == 3 and en2 == (3).to_bytes(4, "big")
+    assert s.stats["channel_collisions"] == 2
+
+
+def test_config_validation_lifted_combinations():
+    """Both PR 15 refusals are gone: workers+v2 and region+v2 validate,
+    with the positive slice-parameter check in their place."""
+    from otedama_tpu.config.schema import AppConfig, validate_config
+
+    cfg = AppConfig()
+    cfg.pool.enabled = True
+    cfg.p2p.enabled = True
+    cfg.stratum.v2_enabled = True
+    cfg.stratum.workers = 4
+    cfg.region.enabled = True
+    cfg.region.session_secret = "s"
+    assert validate_config(cfg) == []
+    cfg.stratum.extranonce2_size = 2
+    errs = validate_config(cfg)
+    assert any("extranonce2_size" in e for e in errs)
+    # the narrow prefix is fine again once neither scale feature is on
+    cfg.stratum.workers = 0
+    cfg.region.enabled = False
+    assert validate_config(cfg) == []
+
+
+# -- channel resume (PR 15) ---------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_sv2_channel_resume_roundtrip():
+    """A resume token reopens the channel id, extranonce prefix, AND
+    difficulty on a front-end sharing the secret; a live collision or a
+    garbage token degrades to a fresh channel, never an error."""
+    cfg = v2.Sv2ServerConfig(port=0, initial_difficulty=1 / (1 << 24),
+                             session_secret="handoff", worker_bits=1)
+    server = v2.Sv2MiningServer(cfg)
+    await server.start()
+    try:
+        server.set_job(_test_job(share_target=tgt.difficulty_to_target(
+            cfg.initial_difficulty)))
+        c1 = v2.Sv2MiningClient("127.0.0.1", server.port, user="w.r")
+        await c1.connect()
+        while not c1.resume_token:
+            await c1.pump()
+        cid, en2, tg = (c1.channel.channel_id,
+                        c1.channel.extranonce_prefix, c1.target)
+        token = c1.resume_token
+
+        # the channel is still LIVE: a replayed token must not alias it
+        c_alias = v2.Sv2MiningClient("127.0.0.1", server.port, user="w.r",
+                                     resume_token=token)
+        await c_alias.connect()
+        assert c_alias.channel.channel_id != cid
+        assert server.stats["resumes_rejected"] == 1
+        await c_alias.close()
+
+        # drop the session; the token now recovers everything
+        await c1.close()
+        await asyncio.sleep(0.05)
+        c2 = v2.Sv2MiningClient("127.0.0.1", server.port, user="w.r",
+                                resume_token=token)
+        await c2.connect()
+        assert c2.channel.channel_id == cid
+        assert c2.channel.extranonce_prefix == en2
+        assert c2.target == tg, "difficulty must survive the handoff"
+        assert server.stats["resumes_accepted"] == 1
+        assert server.snapshot()["channels_resumed"] == 1
+        await c2.close()
+
+        # garbage token: fresh channel, no error
+        c3 = v2.Sv2MiningClient("127.0.0.1", server.port, user="w.r",
+                                resume_token="not-a-token")
+        await c3.connect()
+        assert c3.channel is not None
+        assert server.stats["resumes_rejected"] == 2
+        await c3.close()
+
+        # a V1 SESSION token (same secret, untyped) must NOT resume a
+        # V2 channel: the V1 allocator's live scan cannot see V2
+        # channels, so honouring it could alias a lease still live on
+        # the V1 server — typed tokens keep the wires apart
+        from otedama_tpu.stratum import resume as session_resume
+
+        v1_token = session_resume.issue_token(
+            "handoff", 0, b"\x00\x01\x02\x03", 0.5)
+        c4 = v2.Sv2MiningClient("127.0.0.1", server.port, user="w.r",
+                                resume_token=v1_token)
+        await c4.connect()
+        assert c4.channel.channel_id != int.from_bytes(
+            b"\x00\x01\x02\x03", "big")
+        assert server.stats["resumes_rejected"] == 3
+        # and a V2 token fails V1-typed verification symmetrically
+        v2_token = session_resume.issue_token(
+            "handoff", 0, b"\x00\x01\x02\x03", 0.5, protocol="v2")
+        assert session_resume.verify_token(
+            "handoff", v2_token, ttl=60.0) is None
+        assert session_resume.verify_token(
+            "handoff", v2_token, ttl=60.0, protocol="v2") is not None
+        await c4.close()
+    finally:
+        await server.stop()
+
+
+# -- cross-front-end dedup hooks (PR 15) --------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_sv2_duplicate_checker_and_hook_reject():
+    """The chain-backed duplicate_checker fires on the submit path, and
+    an on_share hook raising DuplicateShareError (the shard bus "dup"
+    ack) is delivered as duplicate-share — both count as duplicates,
+    neither as hook failures."""
+    committed: set[bytes] = set()
+    hook_dup = {"armed": False}
+
+    async def on_share(share):
+        if hook_dup["armed"]:
+            raise v2.DuplicateShareError("parent window has it")
+        committed.add(share.header)
+
+    cfg = v2.Sv2ServerConfig(port=0, initial_difficulty=1 / (1 << 24),
+                             duplicate_checker=lambda h: h in committed)
+    server = v2.Sv2MiningServer(cfg, on_share=on_share)
+    await server.start()
+    try:
+        job = _test_job(share_target=tgt.difficulty_to_target(
+            cfg.initial_difficulty))
+        server.set_job(job)
+        client = v2.Sv2MiningClient("127.0.0.1", server.port, user="w.d")
+        await client.connect()
+        while not (client.jobs and client.prevhash):
+            await client.pump()
+        jid = max(client.jobs)
+        en2 = client.channel.extranonce_prefix
+        nonce = _mine(job, en2, client.target, job.version)
+        res = await client.submit(jid, nonce, job.ntime, job.version)
+        assert isinstance(res, v2.SubmitSharesSuccess)
+
+        # replay with an EMPTY channel-local window (the cross-region
+        # replay shape — another front-end's window never saw it): only
+        # the chain-backed checker can catch it
+        chan = server._channels[client.channel.channel_id][0]
+        chan.seen_shares.clear()
+        res2 = await client.submit(jid, nonce, job.ntime, job.version)
+        assert isinstance(res2, v2.SubmitSharesError)
+        assert res2.error_code == "duplicate-share"
+        assert server.stats["duplicates_refused"] == 1
+
+        # ledger-side dup verdict (shard bus): DuplicateShareError maps
+        # to duplicate-share, and the share STAYS refused on resubmit
+        hook_dup["armed"] = True
+        chan.seen_shares.clear()
+        committed.clear()
+        res3 = await client.submit(jid, nonce, job.ntime, job.version)
+        assert isinstance(res3, v2.SubmitSharesError)
+        assert res3.error_code == "duplicate-share"
+        assert server.stats["duplicates_refused"] == 2
+        assert server.stats["share_hook_failures"] == 0
+        # per-channel duplicate telemetry rides the snapshot
+        assert server.snapshot()["channel_duplicates"] == 2
+        await client.close()
+    finally:
+        await server.stop()
+
+
+# -- sv2.submit fault point (PR 15 chaos seam) --------------------------------
+
+
+@pytest.mark.asyncio
+async def test_sv2_submit_fault_point_seeded_chaos():
+    """Seeded sv2.submit plan: the FIRST submission is dropped in
+    flight (no verdict — the miner's resubmit must LAND, exactly once),
+    a later one takes an injected processing error delivered as a
+    visible reject. Same seed, same schedule."""
+    from otedama_tpu.utils import faults
+
+    hooked = []
+
+    async def on_share(share):
+        hooked.append(share)
+
+    cfg = v2.Sv2ServerConfig(port=0, initial_difficulty=1 / (1 << 24))
+    server = v2.Sv2MiningServer(cfg, on_share=on_share)
+    await server.start()
+    # rule 1 claims hit 1 (drop, once); rule 2 then counts hits 2, 3,
+    # ... and fires its single error on ITS 2nd eligible hit — the 3rd
+    # submission overall
+    inj = (faults.FaultInjector(seed=77)
+           .drop("sv2.submit:*", once=True)
+           .error("sv2.submit:*", every_nth=2, max_fires=1))
+    try:
+        job = _test_job(share_target=tgt.difficulty_to_target(
+            cfg.initial_difficulty))
+        server.set_job(job)
+        client = v2.Sv2MiningClient("127.0.0.1", server.port, user="w.f")
+        await client.connect()
+        while not (client.jobs and client.prevhash):
+            await client.pump()
+        jid = max(client.jobs)
+        en2 = client.channel.extranonce_prefix
+        nonce = _mine(job, en2, client.target, job.version)
+        with faults.active(inj):
+            # hit 1: dropped — the submission vanishes in flight
+            client._seq += 1
+            client._conn.send(v2.MSG_SUBMIT_SHARES_STANDARD,
+                              v2.SubmitSharesStandard(
+                                  channel_id=client.channel.channel_id,
+                                  sequence_number=client._seq, job_id=jid,
+                                  nonce=nonce, ntime=job.ntime,
+                                  version=job.version).encode())
+            with pytest.raises(asyncio.TimeoutError):
+                await asyncio.wait_for(client.pump(), timeout=0.4)
+            # hit 2: the resubmit lands, exactly once in the books
+            res = await client.submit(jid, nonce, job.ntime, job.version)
+            assert isinstance(res, v2.SubmitSharesSuccess)
+            assert len(hooked) == 1
+            # hit 3: injected processing failure -> visible reject
+            nonce2 = _mine(job, en2, client.target, job.version, start=nonce + 1)
+            res = await client.submit(jid, nonce2, job.ntime, job.version)
+            assert isinstance(res, v2.SubmitSharesError)
+            assert res.error_code == "share-processing-failure"
+            # hit 4: clean resubmit of the failed share lands (it was
+            # never remembered — the failure hit before validation)
+            res = await client.submit(jid, nonce2, job.ntime, job.version)
+            assert isinstance(res, v2.SubmitSharesSuccess)
+        assert len(hooked) == 2
+        snap = inj.snapshot()
+        point = next(v for k, v in snap["points"].items()
+                     if k.startswith("sv2.submit"))
+        assert point["faults"] == 2
+        await client.close()
+    finally:
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_sv2_job_broadcast_bytes_once_per_channel():
+    """The cached per-job frames are channel-id/root-patched per
+    channel: two channels must each receive THEIR channel id and THEIR
+    extranonce-specific merkle root, not a shared template's."""
+    cfg = v2.Sv2ServerConfig(port=0, initial_difficulty=1 / (1 << 24))
+    server = v2.Sv2MiningServer(cfg)
+    await server.start()
+    try:
+        clients = []
+        for i in range(2):
+            c = v2.Sv2MiningClient("127.0.0.1", server.port, user=f"w.{i}")
+            await c.connect()
+            clients.append(c)
+        job = _test_job(share_target=tgt.difficulty_to_target(
+            cfg.initial_difficulty))
+        jid = server.set_job(job)
+        for c in clients:
+            while jid not in c.jobs or c.prevhash is None:
+                await c.pump()
+            nm = c.jobs[jid]
+            assert nm.channel_id == c.channel.channel_id
+            want = jobmod.merkle_root(
+                jobmod.build_coinbase(job, c.channel.extranonce_prefix),
+                job.merkle_branch)
+            assert nm.merkle_root == want
+            assert c.prevhash.channel_id == c.channel.channel_id
+        assert (clients[0].jobs[jid].merkle_root
+                != clients[1].jobs[jid].merkle_root)
+        for c in clients:
+            await c.close()
+    finally:
+        await server.stop()
+
+
 @pytest.mark.asyncio
 async def test_sv2_noise_rides_pool_mode(tmp_path):
     """v2_noise serves the encrypted transport from the app, with the

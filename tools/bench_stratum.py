@@ -1,9 +1,23 @@
-"""Stratum V1 pool front-end latency/throughput bench (sharded soak).
+"""Stratum V1/V2 pool front-end latency/throughput bench (sharded soak).
 
 Drives the REAL serving path (loopback TCP, full JSON-RPC wire, full
 share validation) with N concurrent miner connections submitting
 pre-mined valid shares, and emits a ``BENCH_STRATUM_*.json`` artifact
 so the pool latency trajectory is tracked like the kernel benches.
+
+``--v2`` (PR 15) runs the miner fleet over Stratum V2 instead: binary
+frames against the worker processes' SO_REUSEPORT V2 siblings, shares
+crossing the same binary share bus into the group-commit ledger, with
+the Noise-NX encrypted transport ON by default (``--v2-cleartext``
+disables it). The noise handshake is timed SEPARATELY inside the
+connect ramp (``noise_handshake_p50_ms``) — PR 9 taught us connect
+bursts dominate client p99, and the handshake's 3 pure-Python X25519
+ops are the V2 ramp's dominant term. Per-share wire bytes are measured
+on both legs (``wire_bytes_per_share``), so the artifact records the
+V2-vs-V1 bytes/share win next to the throughput numbers. ``--control``
+still runs the SAME workload through the single-process V1 path and
+asserts accepted totals + PPLNS split byte-identical — the
+cross-PROTOCOL exactness audit.
 
 Two serving modes, selected by ``--workers``:
 
@@ -88,6 +102,8 @@ from otedama_tpu.stratum.server import (                   # noqa: E402
 from otedama_tpu.stratum.shard import (                    # noqa: E402
     ShardConfig, ShardSupervisor,
 )
+from otedama_tpu.stratum import noise as noise_mod        # noqa: E402
+from otedama_tpu.stratum import v2 as v2mod               # noqa: E402
 from otedama_tpu.utils.sha256_host import sha256d          # noqa: E402
 
 EASY = 1e-7  # ~2.3e-3 hit probability per hash: shares mine in ~430 tries
@@ -177,9 +193,15 @@ class Miner:
         self.writer: asyncio.StreamWriter | None = None
         self.extranonce1 = b""
         self.connect_latency = 0.0    # connect + subscribe + authorize
+        self.handshake_latency = 0.0  # V1: no transport handshake
         self.latencies: list[float] = []  # submit phase only
         self.accepted = 0
         self.rejected = 0
+        # per-share wire accounting: submit line out, verdict line in
+        # (notifications excluded — they are broadcast cost, not
+        # per-share cost)
+        self.bytes_out = 0
+        self.bytes_in = 0
 
     async def connect(self) -> None:
         t0 = time.monotonic()
@@ -249,6 +271,7 @@ class Miner:
                 await asyncio.sleep(delay)
             t0 = time.monotonic()
             self.writer.write(line)
+            self.bytes_out += len(line)
             while True:
                 resp = await self.reader.readline()
                 if not resp:
@@ -256,6 +279,7 @@ class Miner:
                 if b'"method"' in resp:
                     continue  # notification (set_difficulty/notify/...)
                 break
+            self.bytes_in += len(resp)
             self.latencies.append(time.monotonic() - t0)
             if b'"result":true' in resp:
                 self.accepted += 1
@@ -265,6 +289,170 @@ class Miner:
     def close(self) -> None:
         if self.writer is not None:
             self.writer.close()
+
+
+class Sv2Miner:
+    """One raw-wire loopback Stratum V2 miner (standard channel):
+    setup, channel open, paced binary submits — lean on purpose (the
+    fleet is the load generator). With ``noise_on`` the Noise-NX
+    handshake runs inside connect() and is timed SEPARATELY
+    (``handshake_latency``), and every frame is sealed/opened through
+    the real AEAD transport."""
+
+    def __init__(self, ident: int, port: int, noise_on: bool = False):
+        self.ident = ident
+        self.port = port
+        self.noise_on = noise_on
+        self.reader: asyncio.StreamReader | None = None
+        self.writer: asyncio.StreamWriter | None = None
+        self.session = None
+        self.channel_id = 0
+        self.en2 = b""
+        self.target = 0
+        self.job_id = 0
+        self.ntime = 0
+        self.version = 0
+        self.connect_latency = 0.0
+        self.handshake_latency = 0.0
+        self.latencies: list[float] = []
+        self.accepted = 0
+        self.rejected = 0
+        self.bytes_out = 0
+        self.bytes_in = 0
+        self._seq = 0
+        self.nonces: list[int] = []   # premined, fixed channel en2
+        self.wires: list[bytes] = []  # pre-encoded (+pre-sealed) submits
+
+    async def _read_frame(self):
+        if self.session is None:
+            return await v2mod.read_frame(self.reader)
+        return v2mod.parse_frame(
+            await self.session.recv_frame_bytes(self.reader))
+
+    def _send(self, msg_type: int, payload: bytes) -> None:
+        frame = v2mod.pack_frame(msg_type, payload)
+        wire = frame if self.session is None else self.session.seal(frame)
+        self.writer.write(wire)
+
+    async def connect(self) -> None:
+        t0 = time.monotonic()
+        self.reader, self.writer = await asyncio.open_connection(
+            "127.0.0.1", self.port
+        )
+        if self.noise_on:
+            h0 = time.monotonic()
+            self.session = await noise_mod.client_handshake(
+                self.reader, self.writer)
+            self.handshake_latency = time.monotonic() - h0
+        self._send(v2mod.MSG_SETUP_CONNECTION,
+                   v2mod.SetupConnection().encode())
+        await self.writer.drain()
+        _, mtype, _payload = await self._read_frame()
+        if mtype != v2mod.MSG_SETUP_CONNECTION_SUCCESS:
+            raise ConnectionError(f"sv2 setup rejected: 0x{mtype:02x}")
+        self._send(v2mod.MSG_OPEN_STANDARD_MINING_CHANNEL,
+                   v2mod.OpenStandardMiningChannel(
+                       request_id=1,
+                       user_identity=f"w.{self.ident}").encode())
+        await self.writer.drain()
+        # open success + the first job pair land here (resume-token
+        # frames from a secret-bearing supervisor fall through)
+        got_prevhash = False
+        while not (self.channel_id and self.job_id and got_prevhash):
+            _, mtype, payload = await self._read_frame()
+            if mtype == v2mod.MSG_OPEN_STANDARD_MINING_CHANNEL_SUCCESS:
+                ok = v2mod.OpenStandardMiningChannelSuccess.decode(payload)
+                self.channel_id = ok.channel_id
+                self.en2 = ok.extranonce_prefix
+                self.target = ok.target
+            elif mtype == v2mod.MSG_OPEN_STANDARD_MINING_CHANNEL_ERROR:
+                raise ConnectionError("sv2 channel rejected")
+            elif mtype == v2mod.MSG_NEW_MINING_JOB:
+                nm = v2mod.NewMiningJob.decode(payload)
+                self.job_id = nm.job_id
+                self.version = nm.version
+            elif mtype == v2mod.MSG_SET_NEW_PREV_HASH:
+                self.ntime = v2mod.SetNewPrevHash.decode(payload).min_ntime
+                got_prevhash = True
+        self.connect_latency = time.monotonic() - t0
+
+    def prepare(self, nonces: list[int]) -> list[bytes]:
+        """Pre-encode — and under noise, pre-SEAL — every submit frame
+        OFF the measured window (premine discipline: the fleet is the
+        load generator, and a seal per share inside the window is CPU
+        the servers under test can't be offered). Pre-sealing is sound
+        because the client->server cipher stream carries nothing but
+        these frames after connect, in exactly this order."""
+        self.wires = []
+        for nonce in nonces:
+            self._seq += 1
+            frame = v2mod.pack_frame(
+                v2mod.MSG_SUBMIT_SHARES_STANDARD,
+                v2mod.SubmitSharesStandard(
+                    channel_id=self.channel_id,
+                    sequence_number=self._seq, job_id=self.job_id,
+                    nonce=nonce, ntime=self.ntime,
+                    version=self.version).encode())
+            self.wires.append(frame if self.session is None
+                              else self.session.seal(frame))
+        return self.wires
+
+    async def submit_phase(self, job: Job, wires: list[bytes],
+                           window: float, t_start: float) -> list[float]:
+        start = len(self.latencies)
+        await self.submit_all(job, wires, window, t_start)
+        return self.latencies[start:]
+
+    async def submit_all(self, job: Job, wires: list[bytes],
+                         window: float, t_start: float) -> None:
+        """Same absolute-schedule pacing as the V1 miner, over
+        pre-sealed wires (``prepare``); response frames still decrypt
+        in-window — the verdict read IS the measured latency."""
+        rng = random.Random(self.ident)
+        grid = 0.02
+        deadlines = sorted(
+            round(rng.random() * window / grid) * grid for _ in wires)
+        for wire, deadline in zip(wires, deadlines):
+            delay = t_start + deadline - time.monotonic()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            t0 = time.monotonic()
+            self.writer.write(wire)
+            self.bytes_out += len(wire)
+            _, mtype, payload = await self._read_frame()
+            self.latencies.append(time.monotonic() - t0)
+            # sealed frames add the u16 noise envelope + AEAD tag
+            self.bytes_in += 6 + len(payload) + (
+                18 if self.session is not None else 0)
+            if mtype == v2mod.MSG_SUBMIT_SHARES_SUCCESS:
+                self.accepted += 1
+            else:
+                self.rejected += 1
+
+    def close(self) -> None:
+        if self.writer is not None:
+            self.writer.close()
+
+
+def _premine_v2(miners: list[Sv2Miner], job: Job,
+                total_shares: int) -> float:
+    """Pre-mine every V2 share OFF the measured window: per channel the
+    extranonce is FIXED (header-only mining), so each miner scans the
+    nonce space once collecting ``total_shares`` distinct hits against
+    its channel target."""
+    t0 = time.monotonic()
+    for m in miners:
+        prefix = jobmod.build_header_prefix(
+            dataclasses.replace(job, extranonce1=b""), m.en2)
+        nonces: list[int] = []
+        nonce = 0
+        while len(nonces) < total_shares:
+            if tgt.hash_meets_target(
+                    sha256d(prefix + struct.pack(">I", nonce)), m.target):
+                nonces.append(nonce)
+            nonce += 1
+        m.nonces = nonces
+    return time.monotonic() - t0
 
 
 def percentile(values: list[float], q: float) -> float:
@@ -508,7 +696,8 @@ async def _drive_fleet(port: int, connections: int, shares_per_conn: int,
 
 def _fleet_proc(conn, port: int, connections: int, phase_shares: list[int],
                 window: float, connect_rate: float, job_wire: dict,
-                ident_base: int) -> None:
+                ident_base: int, protocol: str = "v1",
+                v2_noise: bool = False) -> None:
     """Child-process fleet driver (top-level for the spawn start
     method). Speaks a phased protocol over its Pipe so one connected
     fleet can run several paced submit phases (the ``--pace`` sweep):
@@ -529,14 +718,15 @@ def _fleet_proc(conn, port: int, connections: int, phase_shares: list[int],
             try:
                 prof.runcall(asyncio.run, _fleet_child(
                     conn, port, connections, phase_shares, window,
-                    connect_rate, job_from_wire(job_wire), ident_base))
+                    connect_rate, job_from_wire(job_wire), ident_base,
+                    protocol, v2_noise))
             finally:
                 prof.dump_stats(os.path.join(
                     profile_dir, f"fleet-{ident_base}.pstats"))
         else:
             asyncio.run(_fleet_child(
                 conn, port, connections, phase_shares, window, connect_rate,
-                job_from_wire(job_wire), ident_base))
+                job_from_wire(job_wire), ident_base, protocol, v2_noise))
     except Exception as e:  # surfaced parent-side as a loud failure
         try:
             conn.send({"t": "error", "error": repr(e)})
@@ -549,16 +739,28 @@ def _fleet_proc(conn, port: int, connections: int, phase_shares: list[int],
 async def _fleet_child(conn, port: int, connections: int,
                        phase_shares: list[int], window: float,
                        connect_rate: float, job: Job,
-                       ident_base: int) -> None:
+                       ident_base: int, protocol: str = "v1",
+                       v2_noise: bool = False) -> None:
     loop = asyncio.get_running_loop()
     target = tgt.difficulty_to_target(EASY)
-    miners = [Miner(ident_base + i, port) for i in range(connections)]
-    connect_seconds = await _connect_ramp(miners, connect_rate)
-    mined, mine_seconds = _premine(miners, job, sum(phase_shares), target)
+    if protocol == "v2":
+        miners = [Sv2Miner(ident_base + i, port, v2_noise)
+                  for i in range(connections)]
+        connect_seconds = await _connect_ramp(miners, connect_rate)
+        mine_seconds = _premine_v2(miners, job, sum(phase_shares))
+        t0 = time.monotonic()
+        mined = [m.prepare(m.nonces) for m in miners]
+        mine_seconds += time.monotonic() - t0  # pre-seal rides premine
+    else:
+        miners = [Miner(ident_base + i, port) for i in range(connections)]
+        connect_seconds = await _connect_ramp(miners, connect_rate)
+        mined, mine_seconds = _premine(
+            miners, job, sum(phase_shares), target)
     conn.send({
         "t": "ready",
         "connect_seconds": connect_seconds,
         "connect_lat": [m.connect_latency for m in miners],
+        "handshake_lat": [m.handshake_latency for m in miners],
         "premine_seconds": mine_seconds,
     })
     offset = 0
@@ -591,6 +793,8 @@ async def _fleet_child(conn, port: int, connections: int,
         "t": "done",
         "accepted": sum(m.accepted for m in miners),
         "rejected": sum(m.rejected for m in miners),
+        "bytes_out": sum(m.bytes_out for m in miners),
+        "bytes_in": sum(m.bytes_in for m in miners),
         "per_worker_client": {
             f"w.{m.ident}": m.accepted for m in miners if m.accepted
         },
@@ -633,6 +837,8 @@ class _Fleet:
         return {
             "connect_seconds": max(p["connect_seconds"] for p in parts),
             "connect_lat": [v for p in parts for v in p["connect_lat"]],
+            "handshake_lat": [v for p in parts
+                              for v in p.get("handshake_lat", [])],
             "premine_seconds": max(p["premine_seconds"] for p in parts),
         }
 
@@ -653,6 +859,8 @@ class _Fleet:
         out = {
             "accepted": sum(p["accepted"] for p in parts),
             "rejected": sum(p["rejected"] for p in parts),
+            "bytes_out": sum(p.get("bytes_out", 0) for p in parts),
+            "bytes_in": sum(p.get("bytes_in", 0) for p in parts),
             "per_worker_client": {},
         }
         for p in parts:
@@ -672,7 +880,8 @@ class _Fleet:
 
 def _spawn_fleet(port: int, connections: int, phase_shares: list[int],
                  window: float, connect_rate: float, job: Job,
-                 procs: int = 2) -> _Fleet:
+                 procs: int = 2, protocol: str = "v1",
+                 v2_noise: bool = False) -> _Fleet:
     """Spawn the swarm as ``procs`` child processes, each driving an
     even split of the connections (paced so the AGGREGATE connect rate
     is ``connect_rate``). One process per ~5k connections keeps the
@@ -693,7 +902,8 @@ def _spawn_fleet(port: int, connections: int, phase_shares: list[int],
         proc = ctx.Process(
             target=_fleet_proc,
             args=(child_conn, port, n, phase_shares, window,
-                  connect_rate / procs, job_to_wire(job), base),
+                  connect_rate / procs, job_to_wire(job), base,
+                  protocol, v2_noise),
             daemon=True,
         )
         proc.start()
@@ -703,18 +913,20 @@ def _spawn_fleet(port: int, connections: int, phase_shares: list[int],
     return _Fleet(children)
 
 
-def _hist_state(server) -> tuple[dict, int, float]:
-    """Snapshot the server-side accept histogram (cumulative counts,
+def _hist_state(h) -> tuple[dict, int, float]:
+    """Snapshot a server-side accept histogram (cumulative counts,
     count, sum) — phase percentiles come from DIFFS of these."""
-    h = server.latency
     return h.cumulative(), h.count, h.sum
 
 
-def _diff_quantile(before: tuple, after: tuple, q: float) -> float:
+def _diff_quantile(before: tuple, after: tuple, q: float):
     """Bucket-resolution quantile of the observations BETWEEN two
     cumulative-histogram snapshots (the per-phase server percentile of
     the ``--pace`` sweep). Same conservative upper-bound semantics as
-    LatencyHistogram.quantile."""
+    LatencyHistogram.quantile — except beyond-top-bucket reports None
+    (JSON null) instead of float('inf'): the artifact must stay
+    strict-JSON parseable, and null is unambiguous "over the histogram's
+    top bound"."""
     dcount = after[1] - before[1]
     if dcount <= 0:
         return 0.0
@@ -722,7 +934,7 @@ def _diff_quantile(before: tuple, after: tuple, q: float) -> float:
     for bound in sorted(after[0]):
         if after[0][bound] - before[0].get(bound, 0) >= rank:
             return bound
-    return float("inf")
+    return None
 
 
 async def run_leg(connections: int, shares_per_conn: int, window: float,
@@ -730,7 +942,9 @@ async def run_leg(connections: int, shares_per_conn: int, window: float,
                   remote_miners: bool | None = None,
                   paces: list[float] | None = None,
                   validate: bool = False,
-                  durable: bool = False) -> dict:
+                  durable: bool = False,
+                  protocol: str = "v1",
+                  v2_noise: bool = False) -> dict:
     """One full soak leg (either serving mode) with PoolManager
     accounting; returns metrics + the per-worker books for cross-leg
     comparison. ``remote_miners`` (default: on for multi-worker runs
@@ -793,6 +1007,22 @@ async def run_leg(connections: int, shares_per_conn: int, window: float,
         return await pool.on_share_batch(shares)
 
     sharded = workers > 1
+    is_v2 = protocol == "v2"
+    v2cfg = None
+    if is_v2:
+        # the V2 serving config: same EASY channel difficulty so every
+        # share earns identical credit (the PPLNS audit needs it), and
+        # the Noise transport when the leg measures the encrypted wire
+        v2cfg = v2mod.Sv2ServerConfig(
+            host="127.0.0.1", port=0, initial_difficulty=EASY,
+            max_clients=connections + 64, noise=v2_noise,
+            # the bench deliberately holds ONE job for the whole soak
+            # (premine runs off-window); V1 only prunes jobs at
+            # set_job, so without this the V2 submit-path age check
+            # would turn every share after 300 s into a stale-job
+            # reject and break the cross-protocol audit
+            job_max_age=7200.0,
+        )
     if sharded:
         server = ShardSupervisor(
             _bench_server_config(max_clients=connections + 64),
@@ -806,7 +1036,10 @@ async def run_leg(connections: int, shares_per_conn: int, window: float,
                         ack_timeout=180.0),
             on_share=on_share,
             on_share_batch=on_share_batch,
+            v2_config=v2cfg,
         )
+    elif is_v2:
+        server = v2mod.Sv2MiningServer(v2cfg, on_share=on_share)
     else:
         server = StratumServer(
             _bench_server_config(max_clients=connections + 64),
@@ -815,6 +1048,13 @@ async def run_leg(connections: int, shares_per_conn: int, window: float,
     await server.start()
     job = make_job()
     server.set_job(job)
+    # where the fleet connects, and whose accept histogram the phase
+    # percentiles diff (sharded V2: the workers' V2 siblings + the
+    # supervisor's merged V2 histogram)
+    miner_port = (server.v2_config.port if (is_v2 and sharded)
+                  else server.port)
+    hist_of = ((lambda: server.v2_latency) if (is_v2 and sharded)
+               else (lambda: server.latency))
 
     if paces:
         # offered rate pace -> shares per connection per phase
@@ -824,7 +1064,7 @@ async def run_leg(connections: int, shares_per_conn: int, window: float,
     else:
         phase_shares = [shares_per_conn]
     if remote_miners is None:
-        remote_miners = sharded or bool(paces)
+        remote_miners = sharded or bool(paces) or is_v2
     if remote_miners:
         # fleet shards: one per ~4k connections, few in total. On this
         # class of sandbox kernel the syscall budget is GLOBAL
@@ -835,19 +1075,19 @@ async def run_leg(connections: int, shares_per_conn: int, window: float,
         # of the aggregate send budget to scheduler churn).
         procs = min(int(os.environ.get('STRATUM_FLEET_PROCS', 3)), max(1, connections // 4000) + 1)
         handle = _spawn_fleet(
-            server.port, connections, phase_shares, window, connect_rate,
-            job, procs=procs)
+            miner_port, connections, phase_shares, window, connect_rate,
+            job, procs=procs, protocol=protocol, v2_noise=v2_noise)
         try:
             fleet = await handle.ready()
             phases = []
-            prev = _hist_state(server)
+            prev = _hist_state(hist_of())
             for n in phase_shares:
                 res = await handle.run_phase()
                 if sharded:
                     # let every worker's histogram push land before the
                     # phase's closing snapshot
                     await asyncio.sleep(2 * server.shard.snapshot_interval)
-                cur = _hist_state(server)
+                cur = _hist_state(hist_of())
                 res["server_hist"] = (prev, cur)
                 prev = cur
                 phases.append(res)
@@ -861,7 +1101,7 @@ async def run_leg(connections: int, shares_per_conn: int, window: float,
         fleet["elapsed"] = sum(p["elapsed"] for p in phases)
     else:
         fleet = await _drive_fleet(
-            server.port, connections, shares_per_conn, window,
+            miner_port, connections, shares_per_conn, window,
             connect_rate, job)
 
     accepted = fleet["accepted"]
@@ -875,7 +1115,13 @@ async def run_leg(connections: int, shares_per_conn: int, window: float,
         # one final push interval so every worker's counters land
         await asyncio.sleep(2 * server.shard.snapshot_interval)
     snap_stats = server.snapshot()
-    hist = server.latency.snapshot()
+    hist = hist_of().snapshot()
+    if is_v2:
+        server_accepted = (snap_stats.get("v2", {}).get("shares_accepted")
+                          if sharded
+                          else snap_stats.get("shares_accepted"))
+    else:
+        server_accepted = snap_stats.get("shares_valid")
 
     # exact accounting, three independent ledgers:
     #   client ground truth == hook deliveries == db rows (+ per-worker)
@@ -887,11 +1133,12 @@ async def run_leg(connections: int, shares_per_conn: int, window: float,
     exact = (
         accepted == hook_count == db_rows
         and per_worker_client == per_worker_db
-        and accepted == snap_stats.get("shares_valid")
+        and accepted == server_accepted
     )
     split = _pplns_split(pool)
 
     result = {
+        "protocol": protocol,
         "workers": max(1, workers),
         "connections": connections,
         "shares_submitted": accepted + rejected,
@@ -913,7 +1160,34 @@ async def run_leg(connections: int, shares_per_conn: int, window: float,
         "client_p99_ms": round(1e3 * percentile(client_lat, 0.99), 3),
         "exact_accounting": exact,
     }
+    wired = accepted + rejected
+    if wired and fleet.get("bytes_out"):
+        # measured per-share wire cost from the miner's seat: submit
+        # frame/line out, verdict frame/line in (noise legs include the
+        # u16 envelope + AEAD tag) — the bytes/syscall win the binary
+        # protocol exists for, recorded next to the throughput numbers
+        result["wire_bytes_per_share"] = {
+            "out": round(fleet["bytes_out"] / wired, 1),
+            "in": round(fleet["bytes_in"] / wired, 1),
+        }
+    if is_v2:
+        result["v2_noise"] = v2_noise
+        hs = fleet.get("handshake_lat") or []
+        if v2_noise and hs:
+            # the Noise handshake's share of the connect ramp, reported
+            # SEPARATELY (PR 9: connect bursts dominate client p99 —
+            # here 3 pure-Python X25519 ops ride every connect)
+            result["noise_handshake_p50_ms"] = round(
+                1e3 * percentile(hs, 0.50), 3)
+            result["noise_handshake_p99_ms"] = round(
+                1e3 * percentile(hs, 0.99), 3)
+        if sharded:
+            result["v2_server"] = snap_stats.get("v2", {})
     if paces:
+        def _ms(v):
+            # None = beyond the histogram's top bucket (kept as JSON null)
+            return None if v is None else 1e3 * v
+
         sweep = []
         for pace, n, p in zip(paces, phase_shares, fleet["phases"]):
             before, after = p["server_hist"]
@@ -925,8 +1199,8 @@ async def run_leg(connections: int, shares_per_conn: int, window: float,
                 "shares_submitted": done,
                 "shares_per_sec": round(done / p["elapsed"], 1),
                 "submit_window_seconds": round(p["elapsed"], 3),
-                "server_p50_ms": 1e3 * _diff_quantile(before, after, 0.5),
-                "server_p99_ms": 1e3 * _diff_quantile(before, after, 0.99),
+                "server_p50_ms": _ms(_diff_quantile(before, after, 0.5)),
+                "server_p99_ms": _ms(_diff_quantile(before, after, 0.99)),
                 "client_p50_ms": round(
                     1e3 * percentile(p["client_lat"], 0.50), 3),
                 "client_p99_ms": round(
@@ -977,19 +1251,25 @@ async def run_leg(connections: int, shares_per_conn: int, window: float,
 async def run_bench(connections: int, shares_per_conn: int, window: float,
                     workers: int, connect_rate: float,
                     control: bool, paces: list[float] | None = None,
-                    validate: bool = False, durable: bool = False) -> dict:
+                    validate: bool = False, durable: bool = False,
+                    protocol: str = "v1", v2_noise: bool = False) -> dict:
     result, split, books = await run_leg(
         connections, shares_per_conn, window, workers, connect_rate,
-        paces=paces, validate=validate, durable=durable)
+        paces=paces, validate=validate, durable=durable,
+        protocol=protocol, v2_noise=v2_noise)
     if control and workers > 1:
-        # single-process control: the IDENTICAL workload through the
-        # proven r06 path — fan-out must not change the books. The
-        # control's miners also run from the fleet child so the control
-        # server process holds only its own socket ends (the 2x
-        # single-process estimate cannot fit a 10k soak under capped
-        # hard limits — the point of the multi-process fd budget). A
-        # pace sweep runs the SAME phases on the control so the total
-        # share set (and with it the PPLNS split) stays comparable.
+        # single-process V1 control: the IDENTICAL workload through the
+        # proven r06 path — fan-out must not change the books, and for
+        # a --v2 leg this is the CROSS-PROTOCOL audit: V2's accepted
+        # totals and PPLNS split must be byte-identical to the same
+        # workload over V1 (a share earns the same credit regardless of
+        # which wire carried it). The control's miners also run from
+        # the fleet child so the control server process holds only its
+        # own socket ends (the 2x single-process estimate cannot fit a
+        # 10k soak under capped hard limits — the point of the
+        # multi-process fd budget). A pace sweep runs the SAME phases
+        # on the control so the total share set (and with it the PPLNS
+        # split) stays comparable.
         ctrl, ctrl_split, ctrl_books = await run_leg(
             connections, shares_per_conn, window, 1, connect_rate,
             remote_miners=True, paces=paces)
@@ -1011,6 +1291,16 @@ def main() -> None:
                     help="seconds the submit load is spread over")
     ap.add_argument("--workers", type=int, default=0,
                     help="acceptor worker processes (0/1 = single-process)")
+    ap.add_argument("--v2", action="store_true",
+                    help="drive the fleet over Stratum V2 (binary frames "
+                         "against the workers' SO_REUSEPORT V2 siblings; "
+                         "Noise-NX transport on unless --v2-cleartext). "
+                         "--control still runs the V1 path for the "
+                         "cross-protocol accounting audit")
+    ap.add_argument("--v2-cleartext", action="store_true",
+                    help="serve --v2 without the Noise transport "
+                         "(isolates the binary-framing win from the "
+                         "pure-Python AEAD cost)")
     ap.add_argument("--connect-rate", type=float, default=500.0,
                     help="paced connect ramp, connections per second")
     ap.add_argument("--control", action="store_true",
@@ -1057,10 +1347,13 @@ def main() -> None:
         args.connections, args.shares, args.window, args.workers,
         args.connect_rate, args.control, paces=paces,
         validate=args.validate, durable=args.durable,
+        protocol="v2" if args.v2 else "v1",
+        v2_noise=args.v2 and not args.v2_cleartext,
     ))
     if harness is not None:
         result["harness_echo_rt_per_sec"] = harness
-    result["bench"] = "stratum_v1_share_accept"
+    result["bench"] = ("stratum_v2_share_accept" if args.v2
+                       else "stratum_v1_share_accept")
     result["timestamp"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     with open(args.out, "w") as f:
         json.dump(result, f, indent=2, sort_keys=True)
@@ -1072,6 +1365,17 @@ def main() -> None:
         failed = failed or not result.get("pplns_identical_to_control")
         failed = failed or not result.get("control", {}).get(
             "exact_accounting")
+    if args.v2 and args.control and args.workers > 1:
+        # the binary protocol's reason to exist at this layer: fewer
+        # wire bytes per share than the V1 JSON lines, measured on the
+        # same workload — a regression here fails the bench loudly
+        wb_v2 = result.get("wire_bytes_per_share")
+        wb_v1 = result.get("control", {}).get("wire_bytes_per_share")
+        if wb_v2 and wb_v1 and not (
+                wb_v2["out"] + wb_v2["in"] < wb_v1["out"] + wb_v1["in"]):
+            print(f"FATAL: V2 wire bytes/share {wb_v2} not below V1 "
+                  f"{wb_v1}", file=sys.stderr)
+            failed = True
     if failed:
         print("FATAL: share accounting mismatch", file=sys.stderr)
         sys.exit(1)
