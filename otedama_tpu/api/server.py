@@ -909,3 +909,53 @@ class ApiServer:
                 flushes.count,
                 help_="Group-commit ledger flush latency",
             )
+
+    def sync_profit_metrics(self, snapshot: dict) -> None:
+        """Profit orchestration telemetry from a ProfitOrchestrator
+        snapshot: per-coin profitability, feed freshness/failures, and
+        the switch state machine's verdict/hold counters."""
+        reg = self.registry
+        with reg.atomic():
+            # label sets churn as coins/feeds come and go: a vanished
+            # coin must not latch its last profit estimate forever
+            reg.clear_family("otedama_profit_per_day")
+            for coin, d in (snapshot.get("profit") or {}).items():
+                reg.gauge_set(
+                    "otedama_profit_per_day",
+                    d.get("profit_per_day", 0.0), {"coin": coin},
+                    help_="Estimated profit per day by coin (fiat)",
+                )
+        for name, d in (snapshot.get("feeds") or {}).items():
+            labels = {"feed": name}
+            age = d.get("age_seconds")
+            if age is not None:
+                reg.gauge_set("otedama_profit_feed_age_seconds", age,
+                              labels, help_="Seconds since the feed last "
+                              "delivered sane market data")
+            reg.gauge_set("otedama_profit_feed_stale",
+                          1.0 if d.get("stale") else 0.0, labels,
+                          help_="1 when the feed is past its staleness "
+                          "horizon (stale data holds, never switches)")
+            reg.counter_set("otedama_profit_feed_failures_total",
+                            d.get("failures", 0), labels,
+                            help_="Feed fetch errors (retried with backoff)")
+            reg.counter_set("otedama_profit_feed_rejected_total",
+                            d.get("rejected", 0), labels,
+                            help_="Corrupt market rows the sanitizer dropped")
+        for verdict, n in (snapshot.get("switches") or {}).items():
+            reg.counter_set("otedama_switches_total", n,
+                            {"verdict": verdict},
+                            help_="Algorithm switch outcomes by verdict")
+        for reason, n in (snapshot.get("holds") or {}).items():
+            reg.counter_set("otedama_switch_holds_total", n,
+                            {"reason": reason},
+                            help_="Switch decisions held, by reason")
+        reg.counter_set("otedama_switch_failures_total",
+                        snapshot.get("switch_failures", 0),
+                        help_="Failed switch attempts (rolled back)")
+        reg.gauge_set("otedama_switch_downtime_seconds",
+                      snapshot.get("last_switch_downtime_seconds", 0.0),
+                      help_="Mining downtime of the last committed switch")
+        reg.gauge_set("otedama_profit_market_stale",
+                      1.0 if snapshot.get("market_stale") else 0.0,
+                      help_="1 when ALL market data is stale (HOLD)")

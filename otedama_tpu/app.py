@@ -68,7 +68,8 @@ class Application:
         self.failure_detector = None
         self.backups = None
         self.profit_analyzer = None
-        self.profit_switcher = None
+        self.profit_orchestrator = None
+        self.failover = None        # upstream failover manager (miner mode)
         self._solo_jobs: dict[str, Job] = {}
         self._solo_last_height = -1  # solo template gate (see _solo_job_loop)
         # engine restarts are requested by two supervisors (failure detector
@@ -527,40 +528,72 @@ class Application:
                     log.exception("warmup backend %r close failed", name)
             log.info("algorithm %s warmed into the compile cache", name)
 
+    async def _connect_upstream(self, selected) -> None:
+        """Re-point the stratum client at ``selected``, with session
+        handoff: the old client's resume token rides along so a sibling
+        region recovers our difficulty and extranonce lease instead of
+        resetting the session."""
+        from otedama_tpu.stratum.client import ClientConfig, StratumClient
+
+        old = self.client
+        username, password = self._upstream_auth.get(
+            selected.name, ("", "x"))
+        self.client = StratumClient(
+            ClientConfig(
+                host=selected.host, port=selected.port,
+                username=username, password=password,
+                algorithm=self.config.mining.algorithm,
+            ),
+            on_job=self.engine.set_job,
+        )
+        if old is not None:
+            self.client.resume_token = old.resume_token
+        self._active_upstream = selected
+        await self.client.start()
+        # keep shutdown bookkeeping pointed at the live client
+        self._started = [
+            self.client if c is old else c for c in self._started
+        ]
+        if old is not None:
+            await old.stop()
+
     async def _failover_loop(self) -> None:
         """Re-point the stratum client when a better upstream wins the
         health-scored selection (reference: advanced_failover strategies)."""
-        from otedama_tpu.stratum.client import ClientConfig, StratumClient
-
         while True:
             await asyncio.sleep(self.failover.check_interval)
             selected = self.failover.select()
             if selected is self._active_upstream:
                 continue
             log.info("failing over to upstream %s", selected.name)
-            old = self.client
-            username, password = self._upstream_auth[selected.name]
-            self.client = StratumClient(
-                ClientConfig(
-                    host=selected.host, port=selected.port,
-                    username=username, password=password,
-                    algorithm=self.config.mining.algorithm,
-                ),
-                on_job=self.engine.set_job,
-            )
-            if old is not None:
-                # session handoff: present the dying upstream's resume
-                # token so a sibling region recovers our difficulty and
-                # extranonce lease instead of resetting the session
-                self.client.resume_token = old.resume_token
-            self._active_upstream = selected
-            await self.client.start()
-            # keep shutdown bookkeeping pointed at the live client
-            self._started = [
-                self.client if c is old else c for c in self._started
-            ]
-            if old is not None:
-                await old.stop()
+            await self._connect_upstream(selected)
+
+    async def _retarget_upstreams(self, plan) -> None:
+        """A committed profit switch drives failover onto the new coin's
+        OWN upstream pool list (each coin mines at different pools), then
+        connects the best of them — resume-token handoff included."""
+        if self.failover is None or not plan.pools:
+            return
+        from otedama_tpu.config.schema import normalize_profit_pools
+        from otedama_tpu.pool.failover import UpstreamPool
+
+        ups, auth = [], {}
+        for i, entry in enumerate(normalize_profit_pools(plan.pools)):
+            url = str(entry["url"])
+            host, port = parse_upstream_url(url)
+            ups.append(UpstreamPool(
+                name=url, host=host, port=port,
+                priority=int(entry.get("priority", i)),
+            ))
+            auth[url] = (str(entry.get("username", "")),
+                         str(entry.get("password", "x")))
+        if not ups:
+            return
+        self.failover.pools = ups
+        self._upstream_auth = auth
+        log.info("retargeting upstreams for %s: %s",
+                 plan.coin, [u.name for u in ups])
+        await self._connect_upstream(self.failover.select())
 
     async def _solo_job_loop(self) -> None:
         counter = 0
@@ -824,22 +857,59 @@ class Application:
         self._wire_profit()
         await self.api.start()
         self._started.append(self.api)
-        if self.profit_switcher is not None:
-            await self.profit_switcher.start()
-            self._started.append(self.profit_switcher)
+        if (self.profit_orchestrator is not None
+                and self.config.profit.enabled):
+            # the autonomous loop is opt-in; the wiring (API control,
+            # providers, metrics) is live either way
+            await self.profit_orchestrator.start()
+            self._started.append(self.profit_orchestrator)
         self._tasks.append(asyncio.create_task(self._metrics_loop()))
 
+    def _build_profit_feeds(self) -> list:
+        """FeedTracker per configured market feed (profit/feeds.py)."""
+        from otedama_tpu.config.schema import normalize_profit_feeds
+        from otedama_tpu.profit import FakeFeed, FeedTracker, HttpJsonFeed
+
+        pcfg = self.config.profit
+        trackers = []
+        for entry in normalize_profit_feeds(pcfg.feeds):
+            kind = str(entry.get("type", "http"))
+            name = str(entry.get("name") or entry.get("url")
+                       or f"feed{len(trackers)}")
+            if kind == "fake":
+                feed = FakeFeed(name=name)
+            else:
+                url = entry.get("url")
+                if not url:
+                    continue
+                feed = HttpJsonFeed(name=name, url=str(url))
+            trackers.append(FeedTracker(
+                feed, stale_seconds=pcfg.feed_stale_seconds))
+        return trackers
+
     def _wire_profit(self) -> None:
-        """Profit analyzer + switcher: market data arrives via the
-        update_market control; the metrics loop samples profitability for
-        trend/forecast; the switcher re-points the engine algorithm."""
-        from otedama_tpu.profit import ProfitAnalyzer, ProfitSwitcher
+        """Profit orchestration (profit/orchestrator.py): configured
+        feeds (plus the update_market control) drive the analyzer; the
+        orchestrator owns the whole switch state machine — the API
+        switch_algorithm control and the autonomous loop share its
+        commit_switch/rollback bookkeeping."""
+        from otedama_tpu.config.schema import normalize_profit_pools
+        from otedama_tpu.profit import (
+            CoinPlan,
+            OrchestratorConfig,
+            ProfitAnalyzer,
+            ProfitOrchestrator,
+        )
 
-        self.profit_analyzer = ProfitAnalyzer()
+        pcfg = self.config.profit
+        self.profit_analyzer = ProfitAnalyzer(
+            power_watts=pcfg.power_watts,
+            power_price_kwh=pcfg.power_price_kwh,
+        )
 
-        async def on_switch(algorithm, est):
+        async def prepare(algorithm, est):
             if self.engine is None:
-                return
+                raise RuntimeError("no mining engine to switch")
             if self.server is not None and not self.config.upstreams:
                 # pool mode with loopback mining: the engine mines THIS
                 # pool's own chain, whose algorithm is fixed — a switch
@@ -854,13 +924,15 @@ class Application:
             # mining the old one; planned_batch as the warm count means
             # batch-shape-keyed programs (pallas/pods) compile the exact
             # shape the hot loop will dispatch
-            engine = self.engine
-            backend = await self.algo_manager.prepare_backend_async(
-                algorithm, warm_count=engine.planned_batch,
+            return await self.algo_manager.prepare_backend_async(
+                algorithm, warm_count=self.engine.planned_batch,
                 **self._backend_kwargs(),
             )
+
+        async def commit(algorithm, backend, est):
+            engine = self.engine
             async with self._restart_lock:
-                await engine.switch_algorithm(
+                downtime = await engine.switch_algorithm(
                     algorithm,
                     {getattr(backend, "name", "device0"): backend},
                 )
@@ -877,46 +949,66 @@ class Application:
                 self.client.config.algorithm = algorithm
             self._solo_last_height = -1
             log.info("algorithm switched to %s", algorithm)
+            return downtime
 
-        self.profit_switcher = ProfitSwitcher(
-            self.profit_analyzer, on_switch,
+        async def rollback(incumbent):
+            # the engine never left the incumbent (commit mutates job
+            # sources only after a successful swap) — re-assert the
+            # labels anyway so a failure between those mutations can't
+            # leave a job source pointed at an algorithm that never
+            # arrived
+            self.config.mining.algorithm = incumbent
+            if self.client is not None:
+                self.client.config.algorithm = incumbent
+            self._solo_last_height = -1
+
+        coins = {}
+        for coin, spec in (pcfg.coins or {}).items():
+            if not isinstance(spec, dict) or not spec.get("algorithm"):
+                continue
+            coins[str(coin)] = CoinPlan(
+                coin=str(coin),
+                algorithm=str(spec["algorithm"]),
+                pools=normalize_profit_pools(spec.get("pools")),
+            )
+
+        self.profit_orchestrator = ProfitOrchestrator(
+            self.profit_analyzer,
+            self._build_profit_feeds(),
+            prepare=prepare,
+            commit=commit,
+            rollback=rollback,
+            retarget=(self._retarget_upstreams
+                      if self.config.upstreams else None),
+            coins=coins,
+            config=OrchestratorConfig(
+                interval_seconds=pcfg.interval,
+                min_improvement_percent=pcfg.min_improvement_percent,
+                dwell_seconds=pcfg.dwell_seconds,
+                cooldown_seconds=pcfg.cooldown_seconds,
+                feed_stale_seconds=pcfg.feed_stale_seconds,
+                failure_backoff_base=pcfg.failure_backoff_base,
+                failure_backoff_max=pcfg.failure_backoff_max,
+            ),
             current_algorithm=self.config.mining.algorithm,
         )
 
         if self.api is not None:
             async def switch_algorithm(params: dict) -> dict:
-                """Admin override: force the engine onto an algorithm (same
-                path the auto-switcher takes; canonical gate still applies
-                via backend_for -> algos)."""
+                """Admin override: force the engine onto an algorithm via
+                the orchestrator's own state machine (prepare -> commit,
+                rollback + target backoff on failure), so a concurrent
+                auto-evaluation can never race a half-applied override."""
                 if "algorithm" not in params:
                     raise ValueError("missing 'algorithm' parameter")
                 algorithm = str(params["algorithm"])
-                from otedama_tpu.engine import algos as _algos
-
-                if not _algos.switchable(algorithm):
-                    raise ValueError(
-                        f"{algorithm!r} is not switchable (unimplemented "
-                        f"or not certified canonical)"
-                    )
-                # point the switcher BEFORE the (awaited) restart so a
-                # concurrent auto-evaluation can't compare against the old
-                # algorithm and immediately revert the admin's override;
-                # roll back if the restart fails so the switcher baseline
-                # matches what the engine actually runs
-                prev_algo = self.profit_switcher.current_algorithm
-                prev_switch = self.profit_switcher.last_switch
-                self.profit_switcher.current_algorithm = algorithm
-                self.profit_switcher.last_switch = time.time()
-                try:
-                    await on_switch(algorithm, None)
-                except Exception:
-                    self.profit_switcher.current_algorithm = prev_algo
-                    self.profit_switcher.last_switch = prev_switch
-                    raise
-                return {"algorithm": algorithm}
+                downtime = await self.profit_orchestrator.request_switch(
+                    algorithm)
+                return {"algorithm": algorithm,
+                        "downtime_seconds": round(downtime, 4)}
 
             self.api.add_control("switch_algorithm", switch_algorithm)
-        if self.api is not None:
+
             async def update_market(params: dict) -> dict:
                 from otedama_tpu.profit import CoinMetrics
 
@@ -932,7 +1024,8 @@ class Application:
 
             self.api.add_control("update_market", update_market)
             self.api.add_provider("profit", self.profit_analyzer.snapshot)
-            self.api.add_provider("switcher", self.profit_switcher.snapshot)
+            self.api.add_provider(
+                "switcher", self.profit_orchestrator.snapshot)
 
     async def _start_supervision(self) -> None:
         """Failure detector + component recovery + scheduled backups
@@ -1153,15 +1246,20 @@ class Application:
                 self.api.sync_engine_metrics(snap)
                 if self.client is not None:
                     self.api.sync_client_metrics(self.client)
-                if self.profit_analyzer is not None and self.profit_switcher is not None:
-                    self.profit_switcher.record_hashrate(
+                orch = self.profit_orchestrator
+                if self.profit_analyzer is not None and orch is not None:
+                    orch.record_hashrate(
                         snap.get("algorithm", ""), snap.get("hashrate", 0.0)
                     )
-                    # record profitability history for trend/forecast
-                    for coin, m in self.profit_analyzer.metrics.items():
-                        h = self.profit_switcher.hashrates.get(m.algorithm)
-                        if h:
-                            self.profit_analyzer.sample(coin, h)
+                    if not self.config.profit.enabled:
+                        # the orchestrator loop samples profitability
+                        # history itself; in manual (update_market-only)
+                        # mode this keeps trend/forecast alive
+                        for coin, m in self.profit_analyzer.metrics.items():
+                            h = orch.hashrates.get(m.algorithm)
+                            if h:
+                                self.profit_analyzer.sample(coin, h)
+                    self.api.sync_profit_metrics(orch.snapshot())
 
     async def stop(self) -> None:
         for t in self._tasks:

@@ -54,6 +54,8 @@ def _coerce_scalar(s: str):
     if s.startswith("[") and s.endswith("]"):
         inner = s[1:-1].strip()
         return [_coerce_scalar(x) for x in inner.split(",")] if inner else []
+    if s == "{}":
+        return {}
     return s
 
 
@@ -338,6 +340,30 @@ class ValidationSettings:
 
 
 @dataclasses.dataclass
+class ProfitSettings:
+    """Profit orchestration (profit/orchestrator.py): feeds, two-sided
+    hysteresis, per-coin upstream plans."""
+
+    enabled: bool = False              # autonomous switch loop (the API
+    #                                    admin control works regardless)
+    interval: float = 30.0             # orchestrator tick cadence, seconds
+    min_improvement_percent: float = 10.0  # hysteresis 1: must beat this
+    dwell_seconds: float = 120.0       # hysteresis 2: must LEAD this long
+    cooldown_seconds: float = 600.0    # gap between committed switches
+    feed_stale_seconds: float = 120.0  # older market data => HOLD
+    failure_backoff_base: float = 30.0   # failed-switch target backoff
+    failure_backoff_max: float = 3600.0
+    power_watts: float = 0.0           # rig draw (profit = revenue - power)
+    power_price_kwh: float = 0.0
+    # market data sources: [{name, type: fake|http, url}] (mini-yaml's
+    # named-nested form {name: {type, url}} is also accepted)
+    feeds: list = dataclasses.field(default_factory=list)
+    # per-coin switch plans: {COIN: {algorithm, pools: [url, ...]}} —
+    # a committed switch re-targets failover onto the coin's own pools
+    coins: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
 class ApiConfig:
     enabled: bool = True
     host: str = "127.0.0.1"
@@ -364,6 +390,7 @@ class AppConfig:
     validation: ValidationSettings = dataclasses.field(
         default_factory=ValidationSettings)
     p2p: P2PConfig = dataclasses.field(default_factory=P2PConfig)
+    profit: ProfitSettings = dataclasses.field(default_factory=ProfitSettings)
     api: ApiConfig = dataclasses.field(default_factory=ApiConfig)
     logging: LoggingConfig = dataclasses.field(default_factory=LoggingConfig)
     upstreams: list = dataclasses.field(default_factory=list)  # [UpstreamConfig]
@@ -377,6 +404,7 @@ _SECTIONS = {
     "region": RegionSettings,
     "validation": ValidationSettings,
     "p2p": P2PConfig,
+    "profit": ProfitSettings,
     "api": ApiConfig,
     "logging": LoggingConfig,
 }
@@ -401,6 +429,28 @@ def _apply_dict(cfg: AppConfig, data: dict) -> None:
         cfg.upstreams = [
             UpstreamConfig(**v) for v in ups.values() if isinstance(v, dict)
         ]
+
+
+def normalize_profit_feeds(feeds) -> list:
+    """Accept both feed-list shapes: a JSON-style list of entry dicts,
+    or mini-yaml's named-nested form ``{name: {type, url}}``."""
+    if isinstance(feeds, dict):
+        return [dict(v, name=str(k)) for k, v in feeds.items()
+                if isinstance(v, dict)]
+    if isinstance(feeds, list):
+        return [dict(e) for e in feeds if isinstance(e, dict)]
+    return []
+
+
+def normalize_profit_pools(pools) -> list:
+    """Coin pool entries: bare ``host:port`` strings or upstream dicts."""
+    out = []
+    for i, entry in enumerate(pools if isinstance(pools, list) else []):
+        if isinstance(entry, str) and entry:
+            out.append({"url": entry, "priority": i})
+        elif isinstance(entry, dict) and entry.get("url"):
+            out.append(dict(entry))
+    return out
 
 
 def _apply_env(cfg: AppConfig, environ=None) -> None:
@@ -591,6 +641,66 @@ def validate_config(cfg: AppConfig) -> list[str]:
             "p2p.chain_ring_max must be >= p2p.chain_fsync_interval "
             "(the writer must be able to assemble one fsync group)"
         )
+    prof = cfg.profit
+    if prof.enabled:
+        if not cfg.mining.enabled:
+            errors.append(
+                "profit.enabled requires mining.enabled "
+                "(there is no engine to re-point)"
+            )
+        if cfg.pool.enabled and not cfg.upstreams:
+            errors.append(
+                "profit.enabled with pool.enabled requires upstreams "
+                "(the loopback engine mines this pool's own "
+                "fixed-algorithm chain)"
+            )
+    if prof.interval <= 0:
+        errors.append("profit.interval must be positive")
+    if prof.min_improvement_percent < 0:
+        errors.append("profit.min_improvement_percent must be >= 0")
+    if prof.dwell_seconds < 0:
+        errors.append("profit.dwell_seconds must be >= 0")
+    if prof.cooldown_seconds < 0:
+        errors.append("profit.cooldown_seconds must be >= 0")
+    if prof.feed_stale_seconds <= 0:
+        errors.append("profit.feed_stale_seconds must be positive")
+    if prof.failure_backoff_base <= 0:
+        errors.append("profit.failure_backoff_base must be positive")
+    if prof.failure_backoff_max < prof.failure_backoff_base:
+        errors.append(
+            "profit.failure_backoff_max must be >= failure_backoff_base")
+    for entry in normalize_profit_feeds(prof.feeds):
+        label = entry.get("name") or entry.get("url") or "?"
+        kind = str(entry.get("type", "http"))
+        if kind not in ("fake", "http"):
+            errors.append(
+                f"profit feed {label!r}: type must be 'fake' or 'http'")
+        if kind == "http" and not entry.get("url"):
+            errors.append(f"profit feed {label!r}: http feed needs a url")
+    if not isinstance(prof.coins, dict):
+        errors.append("profit.coins must map coin -> {algorithm, pools}")
+    else:
+        for coin, spec in prof.coins.items():
+            if not isinstance(spec, dict) or not spec.get("algorithm"):
+                errors.append(
+                    f"profit.coins.{coin}: entry needs an algorithm")
+                continue
+            algo = str(spec["algorithm"])
+            try:
+                algos.get(algo)
+            except KeyError:
+                errors.append(
+                    f"profit.coins.{coin}: unknown algorithm {algo!r}")
+                continue
+            except ValueError:
+                pass  # alias of an uncertified chain — gated below
+            if prof.enabled and not algos.switchable(algo):
+                # a plan the orchestrator can never take is a
+                # misconfiguration, not a latent option
+                errors.append(
+                    f"profit.coins.{coin}: {algo!r} is not switchable "
+                    "(unimplemented or not certified canonical)"
+                )
     return errors
 
 
@@ -682,6 +792,31 @@ p2p:
                           # any verdict/db row; async = ack immediately,
                           # crash loss bounded by the persist-lag export
   chain_ring_max: 65536   # bounded commit->writer event ring
+
+profit:
+  enabled: false          # autonomous profit-switch loop (needs mining;
+                          # with pool.enabled it also needs upstreams —
+                          # the loopback engine mines a fixed chain)
+  interval: 30.0          # orchestrator tick cadence, seconds
+  min_improvement_percent: 10.0  # hysteresis side 1: beat incumbent by this
+  dwell_seconds: 120.0    # hysteresis side 2: candidate must LEAD this long
+  cooldown_seconds: 600.0 # minimum gap between committed switches
+  feed_stale_seconds: 120.0  # market data older than this => HOLD
+  failure_backoff_base: 30.0 # failed-switch per-target backoff (doubles)
+  failure_backoff_max: 3600.0
+  power_watts: 0.0        # rig draw; profit = revenue - power cost
+  power_price_kwh: 0.0
+  feeds: []               # market sources, e.g. as named entries:
+                          #   ticker:
+                          #     type: http
+                          #     url: http://127.0.0.1:9100/market.json
+  coins: {}               # per-coin switch plans with their OWN pools:
+                          #   BTC:
+                          #     algorithm: sha256d
+                          #     pools: [us.pool.example:3333]
+                          #   LTC:
+                          #     algorithm: scrypt
+                          #     pools: [ltc.pool.example:3333]
 
 api:
   enabled: true

@@ -3,12 +3,35 @@ from otedama_tpu.profit.analyzer import (
     ProfitAnalyzer,
     ProfitEstimate,
 )
-from otedama_tpu.profit.switcher import ProfitSwitcher, SwitcherConfig
+from otedama_tpu.profit.feeds import (
+    FakeFeed,
+    FeedTracker,
+    HttpJsonFeed,
+    MarketFeed,
+)
+from otedama_tpu.profit.orchestrator import (
+    CoinPlan,
+    OrchestratorConfig,
+    ProfitOrchestrator,
+)
+from otedama_tpu.profit.switcher import (
+    ProfitSwitcher,
+    SwitcherConfig,
+    effective_hashrates,
+)
 
 __all__ = [
     "CoinMetrics",
+    "CoinPlan",
+    "FakeFeed",
+    "FeedTracker",
+    "HttpJsonFeed",
+    "MarketFeed",
+    "OrchestratorConfig",
     "ProfitAnalyzer",
     "ProfitEstimate",
+    "ProfitOrchestrator",
     "ProfitSwitcher",
     "SwitcherConfig",
+    "effective_hashrates",
 ]

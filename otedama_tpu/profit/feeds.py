@@ -1,0 +1,237 @@
+"""Pluggable market data feeds for the profit orchestrator.
+
+The reference polls public price APIs straight from its switch loop
+(internal/profit/profit_switcher.go fetchPrices); here the data source is
+an abstract ``MarketFeed`` so the orchestrator stays deterministic and
+testable — ``FakeFeed`` scripts a market for tests and benches,
+``HttpJsonFeed`` is the production polling shape (stdlib urllib in an
+executor; the zero-egress default deployment simply configures no http
+feed and drives ``update_market`` instead).
+
+Every fetch crosses the ``profit.feed`` fault point (tag = feed name) and
+then a ``FeedTracker``, which owns the per-feed hardening:
+
+- fetch errors retry with exponential backoff (never a tight error loop
+  against a dead API);
+- every returned row is sanitized — non-finite or non-positive price /
+  difficulty is rejected and counted, because one poisoned sample must
+  surface as growing staleness, never steer a switch;
+- ``age_seconds``/``stale`` expose the per-feed staleness horizon the
+  orchestrator's hold-on-stale rule gates on.
+
+Fault actions at ``profit.feed``: ``error`` (API down), ``crash``,
+``delay`` (slow API), ``drop`` (response lost in transit), ``corrupt``
+(mangled payload values — exercises the sanitizer).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import logging
+import math
+import time
+import urllib.request
+
+from otedama_tpu.profit.analyzer import CoinMetrics
+from otedama_tpu.utils import faults
+
+log = logging.getLogger("otedama.profit.feeds")
+
+# profit.feed supports every transport failure a price API can exhibit
+FEED_ACTIONS = frozenset({"error", "crash", "delay", "drop", "corrupt"})
+
+
+class MarketFeed:
+    """One price/difficulty source. ``fetch()`` returns fresh rows or
+    raises; retry, staleness and sanitization live in ``FeedTracker``."""
+
+    name: str = "feed"
+
+    async def fetch(self) -> list[CoinMetrics]:
+        raise NotImplementedError
+
+
+class FakeFeed(MarketFeed):
+    """Deterministic in-memory feed for tests and benches.
+
+    Rows are pushed with ``set()``; an optional ``script`` callable
+    receives ``(feed, fetch_ordinal)`` before each snapshot and may
+    mutate the rows — that is how chaos scenarios script a market whose
+    profit leader swings on a known schedule.
+    """
+
+    def __init__(self, name: str = "fake", script=None):
+        self.name = name
+        self.script = script
+        self.fetches = 0
+        self._coins: dict[str, CoinMetrics] = {}
+
+    def set(self, coin: str, algorithm: str, price: float,
+            difficulty: float, reward: float = 3.125) -> None:
+        self._coins[coin] = CoinMetrics(
+            coin=coin, algorithm=algorithm, price=price,
+            network_difficulty=difficulty, block_reward=reward,
+        )
+
+    async def fetch(self) -> list[CoinMetrics]:
+        n = self.fetches
+        self.fetches += 1
+        if self.script is not None:
+            self.script(self, n)
+        # fresh timestamps per fetch: staleness is the tracker's business
+        return [dataclasses.replace(m, updated_at=time.time())
+                for m in self._coins.values()]
+
+
+class HttpJsonFeed(MarketFeed):
+    """Polling HTTP feed: GET ``url`` returning a JSON array of
+    ``{coin, algorithm, price, difficulty, reward}`` rows (the shape a
+    small aggregator sidecar serves). The blocking socket work runs in
+    an executor so the event loop never waits on a price API."""
+
+    def __init__(self, name: str, url: str, timeout: float = 10.0):
+        self.name = name
+        self.url = url
+        self.timeout = timeout
+
+    def _get(self) -> bytes:
+        with urllib.request.urlopen(self.url, timeout=self.timeout) as resp:
+            status = getattr(resp, "status", 200)
+            if status != 200:
+                raise RuntimeError(f"feed {self.name}: HTTP {status}")
+            return resp.read()
+
+    async def fetch(self) -> list[CoinMetrics]:
+        loop = asyncio.get_running_loop()
+        raw = await loop.run_in_executor(None, self._get)
+        rows = json.loads(raw)
+        if not isinstance(rows, list):
+            raise ValueError(f"feed {self.name}: payload is not a list")
+        out = []
+        for row in rows:
+            out.append(CoinMetrics(
+                coin=str(row["coin"]),
+                algorithm=str(row["algorithm"]),
+                price=float(row["price"]),
+                network_difficulty=float(row["difficulty"]),
+                block_reward=float(row.get("reward", 0.0)),
+            ))
+        return out
+
+
+def sane_metrics(m: CoinMetrics) -> bool:
+    """Reject a corrupt market row: non-finite or non-positive price /
+    difficulty, negative reward. A rejected row is dropped and counted —
+    the coin's data simply ages toward the staleness horizon."""
+    values = (m.price, m.network_difficulty, m.block_reward)
+    if not all(math.isfinite(v) for v in values):
+        return False
+    return m.price > 0 and m.network_difficulty > 0 and m.block_reward >= 0
+
+
+# fixed mangles, cycled per row index: corruption stays deterministic
+# (same seed, same schedule) without a per-directive RNG
+_MANGLES = (
+    {"price": float("nan")},
+    {"network_difficulty": -1.0},
+    {"price": float("inf")},
+    {"network_difficulty": 0.0},
+    {"block_reward": float("-inf")},
+)
+
+
+def _corrupt_rows(rows: list[CoinMetrics]) -> list[CoinMetrics]:
+    return [dataclasses.replace(m, **_MANGLES[i % len(_MANGLES)])
+            for i, m in enumerate(rows)]
+
+
+class FeedTracker:
+    """Retry/backoff + staleness + sanitization shell around one feed.
+
+    ``poll()`` never raises: a failed fetch counts, backs off
+    exponentially, and surfaces as growing ``age_seconds`` until the
+    staleness horizon trips — the orchestrator's hold-on-stale rule
+    does the rest. All clocks are monotonic and injectable (``now``)
+    so chaos tests replay deterministically.
+    """
+
+    def __init__(self, feed: MarketFeed, stale_seconds: float = 120.0,
+                 retry_base_seconds: float = 2.0,
+                 retry_max_seconds: float = 300.0):
+        self.feed = feed
+        self.stale_seconds = stale_seconds
+        self.retry_base_seconds = retry_base_seconds
+        self.retry_max_seconds = retry_max_seconds
+        self.failures = 0              # total fetch errors
+        self.consecutive_failures = 0
+        self.drops = 0                 # responses lost in transit (drop)
+        self.rejected = 0              # corrupt rows the sanitizer killed
+        self.last_success: float | None = None   # monotonic stamp
+        self._next_attempt = 0.0
+
+    async def poll(self, now: float | None = None) -> list[CoinMetrics]:
+        """One fetch attempt; returns only sane rows (possibly none)."""
+        now = time.monotonic() if now is None else now
+        if now < self._next_attempt:
+            return []                  # backing off after failures
+        try:
+            d = faults.hit("profit.feed", self.feed.name, FEED_ACTIONS)
+            if d is not None and d.delay > 0:
+                await asyncio.sleep(d.delay)
+            rows = await self.feed.fetch()
+            if d is not None:
+                if d.drop:
+                    # the fetch happened, the response never arrived:
+                    # no failure, no data — staleness just accrues
+                    self.drops += 1
+                    return []
+                if d.corrupt:
+                    rows = _corrupt_rows(rows)
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:
+            self.failures += 1
+            self.consecutive_failures += 1
+            backoff = min(
+                self.retry_base_seconds * 2 ** (self.consecutive_failures - 1),
+                self.retry_max_seconds,
+            )
+            self._next_attempt = now + backoff
+            log.warning("feed %s fetch failed (%s); retrying in %.1fs",
+                        self.feed.name, exc, backoff)
+            return []
+        good = [r for r in rows if sane_metrics(r)]
+        bad = len(rows) - len(good)
+        if bad:
+            self.rejected += bad
+            log.warning("feed %s: rejected %d corrupt row(s)",
+                        self.feed.name, bad)
+        if good:
+            self.consecutive_failures = 0
+            self._next_attempt = 0.0
+            self.last_success = now
+        return good
+
+    def age_seconds(self, now: float | None = None) -> float | None:
+        if self.last_success is None:
+            return None                # never delivered
+        now = time.monotonic() if now is None else now
+        return max(0.0, now - self.last_success)
+
+    def stale(self, now: float | None = None) -> bool:
+        age = self.age_seconds(now)
+        return age is None or age > self.stale_seconds
+
+    def snapshot(self, now: float | None = None) -> dict:
+        now = time.monotonic() if now is None else now
+        age = self.age_seconds(now)
+        return {
+            "age_seconds": round(age, 1) if age is not None else None,
+            "stale": self.stale(now),
+            "failures": self.failures,
+            "consecutive_failures": self.consecutive_failures,
+            "drops": self.drops,
+            "rejected": self.rejected,
+        }

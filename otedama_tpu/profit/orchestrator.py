@@ -1,0 +1,425 @@
+"""Profit orchestrator: feeds -> analyzer -> hysteresis -> warm switch.
+
+The continuously-running multi-coin decision loop the reference keeps in
+internal/profit/profit_switcher.go + algorithm_manager_unified.go:502-560,
+hardened the way the rest of this repo is. One ``tick()`` runs the whole
+decision pipeline:
+
+1. poll every feed (``FeedTracker``: retry/backoff, sanitize, staleness);
+2. hold-on-stale — if all market data has aged past its horizon the
+   verdict is HOLD, never a blind switch on dead data;
+3. compute effective hashrates ONCE, sample profitability history;
+4. pick the best switchable coin (canonical gate included);
+5. two-sided hysteresis: the candidate must beat the incumbent by
+   ``min_improvement_percent`` AND have led continuously for
+   ``dwell_seconds`` (a price spike that flickers shorter than the dwell
+   never pays the compile+switch cost);
+6. cooldown since the last committed switch, and per-target exponential
+   failure backoff (a target that keeps failing to arrive is not
+   re-attempted every tick);
+7. pre-warm-then-commit: ``prepare`` builds + precompiles the target
+   backend off the loop while the incumbent keeps mining, ``commit``
+   swaps it in only once warm (the engine's zero-stall path). A failure
+   anywhere (the ``profit.switch`` fault point covers both stages)
+   triggers ``rollback`` — the incumbent keeps mining, job sources are
+   re-asserted, and the target backs off.
+
+The autonomous loop and the API admin path share ONE state machine:
+``request_switch`` (forced) and the loop both run ``execute_switch``,
+which owns ``commit_switch``/``rollback`` — there is no second copy of
+the switch bookkeeping to drift out of sync.
+
+A committed switch with a per-coin upstream plan also drives pool
+re-targeting (``retarget`` callback -> FailoverManager + resume-token
+handoff); retarget failures are counted but do not undo the switch —
+the failover health loop keeps healing the upstream side.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import time
+from typing import Awaitable, Callable
+
+from otedama_tpu.engine import algos
+from otedama_tpu.profit.analyzer import ProfitAnalyzer, ProfitEstimate
+from otedama_tpu.profit.feeds import FeedTracker
+from otedama_tpu.profit.switcher import effective_hashrates
+from otedama_tpu.utils import faults
+
+log = logging.getLogger("otedama.profit.orchestrator")
+
+PrepareFn = Callable[[str, "ProfitEstimate | None"], Awaitable[object]]
+CommitFn = Callable[[str, object, "ProfitEstimate | None"],
+                    Awaitable["float | None"]]
+
+
+@dataclasses.dataclass
+class CoinPlan:
+    """Per-coin switch plan: the algorithm that mines it and the coin's
+    own upstream pool list (``[{url, username, password, priority}]`` or
+    bare url strings) a committed switch re-targets failover onto."""
+
+    coin: str
+    algorithm: str
+    pools: list = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class OrchestratorConfig:
+    interval_seconds: float = 30.0
+    min_improvement_percent: float = 10.0
+    dwell_seconds: float = 120.0
+    cooldown_seconds: float = 600.0
+    # with no feeds configured (manual update_market mode) staleness is
+    # judged against the analyzer rows' own wall-clock age instead
+    feed_stale_seconds: float = 120.0
+    failure_backoff_base: float = 30.0
+    failure_backoff_max: float = 3600.0
+    implemented_only: bool = True      # never switch to a stub algorithm
+
+
+class ProfitOrchestrator:
+    def __init__(
+        self,
+        analyzer: ProfitAnalyzer,
+        feeds: list[FeedTracker] | None = None,
+        *,
+        prepare: PrepareFn,
+        commit: CommitFn,
+        rollback: Callable[[str], Awaitable[None]] | None = None,
+        retarget: Callable[[CoinPlan], Awaitable[None]] | None = None,
+        coins: dict[str, CoinPlan] | None = None,
+        config: OrchestratorConfig | None = None,
+        current_algorithm: str = "sha256d",
+    ):
+        self.analyzer = analyzer
+        self.feeds = list(feeds or [])
+        self.prepare = prepare
+        self.commit = commit
+        self._rollback_cb = rollback
+        self.retarget = retarget
+        self.coins = dict(coins or {})
+        self.config = config or OrchestratorConfig()
+        self.current_algorithm = current_algorithm
+        self.current_coin: str | None = None
+        self.hashrates: dict[str, float] = {}   # algorithm -> measured H/s
+        self.switching = False                  # a switch is in flight
+        self.last_switch = 0.0                  # monotonic commit stamp
+        self.last_downtime = 0.0
+        self.switch_failures = 0
+        self.verdicts: dict[str, int] = {}      # committed/failed/...
+        self.holds: dict[str, int] = {}         # hold reason -> count
+        self.ticks = 0
+        self._leader: str | None = None         # current best candidate
+        self._leader_since = 0.0
+        self._target_failures: dict[str, int] = {}
+        self._target_blocked_until: dict[str, float] = {}
+        self._task: asyncio.Task | None = None
+
+    # -- inputs ---------------------------------------------------------------
+
+    def record_hashrate(self, algorithm: str, hashrate: float) -> None:
+        if algorithm:
+            self.hashrates[algorithm] = hashrate
+
+    async def poll_feeds(self, now: float | None = None) -> int:
+        """Poll every feed and fold sane rows into the analyzer.
+        Returns the number of rows accepted."""
+        accepted = 0
+        for tracker in self.feeds:
+            for m in await tracker.poll(now):
+                self.analyzer.update_metrics(m)
+                accepted += 1
+        return accepted
+
+    def market_stale(self, now: float | None = None) -> bool:
+        """True when NO feed has fresh data — the hold-on-stale gate.
+        Without feeds, the analyzer rows' wall-clock age decides (the
+        manual update_market path ages out the same way)."""
+        if self.feeds:
+            now = time.monotonic() if now is None else now
+            return all(t.stale(now) for t in self.feeds)
+        if not self.analyzer.metrics:
+            return True
+        newest = max(m.updated_at for m in self.analyzer.metrics.values())
+        return time.time() - newest > self.config.feed_stale_seconds
+
+    def _effective_hashrates(self) -> dict[str, float]:
+        return effective_hashrates(
+            self.hashrates, implemented_only=self.config.implemented_only)
+
+    # -- decision pipeline ----------------------------------------------------
+
+    def _hold(self, reason: str) -> None:
+        self.holds[reason] = self.holds.get(reason, 0) + 1
+
+    def _incumbent_estimate(
+            self, rates: dict[str, float]) -> ProfitEstimate | None:
+        best = None
+        for coin, m in self.analyzer.metrics.items():
+            if m.algorithm != self.current_algorithm:
+                continue
+            h = rates.get(m.algorithm)
+            if not h:
+                continue
+            est = self.analyzer.estimate(coin, h)
+            if est and (best is None
+                        or est.profit_per_day > best.profit_per_day):
+                best = est
+        return best
+
+    def evaluate(self, now: float | None = None,
+                 rates: dict[str, float] | None = None
+                 ) -> ProfitEstimate | None:
+        """One switch decision. Returns the winning estimate when a
+        switch should proceed; otherwise records the hold reason and
+        returns None."""
+        now = time.monotonic() if now is None else now
+        if self.switching:
+            self._hold("switching")
+            return None
+        if self.market_stale(now):
+            # dead market data: the incumbent keeps mining. Feeds coming
+            # back (or update_market) lift the hold on a later tick.
+            self._hold("stale")
+            return None
+        rates = self._effective_hashrates() if rates is None else rates
+        best = self.analyzer.best(rates)
+        if best is None:
+            self._hold("no_candidate")
+            return None
+        if best.algorithm == self.current_algorithm:
+            # steady state: the incumbent leads; reset dwell tracking so
+            # a later challenger starts its window from zero
+            self._leader = None
+            return None
+        if (self.config.implemented_only
+                and not algos.switchable(best.algorithm)):
+            # implemented-but-not-canonical would mine work the live
+            # network rejects — refuse, whatever the price says
+            self._hold("not_switchable")
+            return None
+        if self._leader != best.algorithm:
+            self._leader = best.algorithm
+            self._leader_since = now
+        if now - self._leader_since < self.config.dwell_seconds:
+            self._hold("dwell")
+            return None
+        incumbent = self._incumbent_estimate(rates)
+        if incumbent is not None and incumbent.profit_per_day > 0:
+            improvement = (
+                (best.profit_per_day - incumbent.profit_per_day)
+                / incumbent.profit_per_day * 100.0
+            )
+            if improvement < self.config.min_improvement_percent:
+                self._hold("improvement")
+                return None
+        if now - self.last_switch < self.config.cooldown_seconds:
+            self._hold("cooldown")
+            return None
+        if now < self._target_blocked_until.get(best.algorithm, 0.0):
+            self._hold("backoff")
+            return None
+        return best
+
+    async def tick(self, now: float | None = None) -> bool:
+        """One orchestrator round: poll, sample, decide, maybe switch.
+        Returns True when a switch committed."""
+        now = time.monotonic() if now is None else now
+        self.ticks += 1
+        await self.poll_feeds(now)
+        rates = self._effective_hashrates()
+        for coin, m in self.analyzer.metrics.items():
+            h = rates.get(m.algorithm)
+            if h:
+                self.analyzer.sample(coin, h)
+        best = self.evaluate(now, rates)
+        if best is None:
+            return False
+        try:
+            await self.execute_switch(best.algorithm, estimate=best)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            log.exception("profit switch to %s failed", best.algorithm)
+            return False
+        return True
+
+    # -- switch state machine -------------------------------------------------
+
+    def plan_for(self, algorithm: str) -> CoinPlan | None:
+        for plan in self.coins.values():
+            if plan.algorithm == algorithm:
+                return plan
+        return None
+
+    async def execute_switch(self, algorithm: str,
+                             estimate: ProfitEstimate | None = None,
+                             *, forced: bool = False) -> float:
+        """Pre-warm-then-commit switch shared by the autonomous loop and
+        the API admin path. Returns the committed downtime (seconds);
+        raises on failure after rolling back to the incumbent."""
+        if self.switching:
+            raise RuntimeError("an algorithm switch is already in flight")
+        if algorithm == self.current_algorithm:
+            return 0.0
+        incumbent = self.current_algorithm
+        plan = self.plan_for(algorithm)
+        self.switching = True
+        try:
+            # two stages of the profit.switch seam: a prepare fault is a
+            # failed compile/build, a commit fault is the device dying
+            # mid-swap — both must leave the incumbent mining
+            faults.hit("profit.switch", "prepare", faults.POINT)
+            prepared = await self.prepare(algorithm, estimate)
+            faults.hit("profit.switch", "commit", faults.POINT)
+            downtime = await self.commit(algorithm, prepared, estimate)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            await self.rollback(incumbent, target=algorithm)
+            raise
+        finally:
+            self.switching = False
+        self.commit_switch(
+            algorithm,
+            coin=plan.coin if plan is not None else None,
+            downtime=float(downtime or 0.0),
+            forced=forced,
+        )
+        if plan is not None and plan.pools and self.retarget is not None:
+            try:
+                await self.retarget(plan)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # the engine already mines the new algorithm; upstream
+                # re-pointing is left to the failover health loop
+                self._count("retarget_failed")
+                log.exception("upstream retarget for %s failed", plan.coin)
+        return float(downtime or 0.0)
+
+    def commit_switch(self, algorithm: str, *, coin: str | None = None,
+                      downtime: float = 0.0, forced: bool = False) -> None:
+        """Record a completed switch: THE single place decision state
+        advances (autonomous and admin paths both land here)."""
+        self.current_algorithm = algorithm
+        self.current_coin = coin
+        self.last_switch = time.monotonic()
+        self.last_downtime = downtime
+        self._leader = None
+        self._target_failures.pop(algorithm, None)
+        self._target_blocked_until.pop(algorithm, None)
+        self._count("forced" if forced else "committed")
+        log.info("switch committed: %s (coin=%s, downtime=%.3fs)",
+                 algorithm, coin, downtime)
+
+    async def rollback(self, incumbent: str, *,
+                       target: str | None = None) -> None:
+        """Restore the incumbent after a failed switch attempt: decision
+        state never advanced, the failed target backs off exponentially,
+        and the app's rollback hook re-asserts job sources."""
+        self.switch_failures += 1
+        self._count("failed")
+        if target is not None:
+            n = self._target_failures.get(target, 0) + 1
+            self._target_failures[target] = n
+            backoff = min(
+                self.config.failure_backoff_base * 2 ** (n - 1),
+                self.config.failure_backoff_max,
+            )
+            self._target_blocked_until[target] = time.monotonic() + backoff
+            log.warning("switch to %s failed (%d); backing off %.0fs",
+                        target, n, backoff)
+        self.current_algorithm = incumbent
+        self._leader = None   # a challenger re-earns its dwell window
+        if self._rollback_cb is not None:
+            try:
+                await self._rollback_cb(incumbent)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                self._count("rollback_failed")
+                log.exception("rollback to %s failed", incumbent)
+                return
+        self._count("rolled_back")
+
+    async def request_switch(self, algorithm: str) -> float:
+        """Admin override (API control path): same prepare/commit/rollback
+        machine, hysteresis and cooldown waived, canonical gate kept."""
+        if (self.config.implemented_only
+                and not algos.switchable(algorithm)):
+            raise ValueError(
+                f"{algorithm!r} is not switchable (unimplemented or not "
+                "certified canonical)"
+            )
+        # an operator override also overrides the failure backoff
+        self._target_blocked_until.pop(algorithm, None)
+        return await self.execute_switch(algorithm, forced=True)
+
+    def _count(self, verdict: str) -> None:
+        self.verdicts[verdict] = self.verdicts.get(verdict, 0) + 1
+
+    # -- lifecycle ------------------------------------------------------------
+
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self.tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("profit tick failed")
+            await asyncio.sleep(self.config.interval_seconds)
+
+    # -- observability --------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        now = time.monotonic()
+        rates = self._effective_hashrates()
+        profit = {}
+        for coin, m in self.analyzer.metrics.items():
+            h = rates.get(m.algorithm)
+            est = self.analyzer.estimate(coin, h) if h else None
+            if est is not None:
+                profit[coin] = {
+                    "algorithm": m.algorithm,
+                    "profit_per_day": est.profit_per_day,
+                }
+        return {
+            "current_algorithm": self.current_algorithm,
+            "current_coin": self.current_coin,
+            "switching": self.switching,
+            "ticks": self.ticks,
+            "switches": dict(self.verdicts),
+            "holds": dict(self.holds),
+            "switch_failures": self.switch_failures,
+            "last_switch_downtime_seconds": self.last_downtime,
+            "market_stale": self.market_stale(now),
+            "hashrates": dict(self.hashrates),
+            "feeds": {t.feed.name: t.snapshot(now) for t in self.feeds},
+            "targets": {
+                a: {
+                    "failures": n,
+                    "blocked_seconds": round(max(
+                        0.0, self._target_blocked_until.get(a, 0.0) - now), 1),
+                }
+                for a, n in self._target_failures.items()
+            },
+            "profit": profit,
+        }

@@ -31,6 +31,34 @@ class SwitcherConfig:
     min_improvement_percent: float = 10.0
     cooldown_seconds: float = 1800.0
     implemented_only: bool = True      # never switch to a stub algorithm
+    # per-target exponential backoff after a FAILED switch (a target
+    # whose compile/swap keeps dying must not be re-attempted every
+    # interval — that re-pays the same multi-minute compile forever)
+    failure_backoff_base: float = 60.0
+    failure_backoff_max: float = 3600.0
+
+
+def effective_hashrates(measured: dict[str, float],
+                        implemented_only: bool = True) -> dict[str, float]:
+    """Measured rates, falling back to registry planning rates
+    (reference: engine.go:1092-1104 hard-coded assumptions). Shared by
+    ProfitSwitcher and ProfitOrchestrator so canonical gating has ONE
+    implementation."""
+    if implemented_only:
+        # non-canonical chains must never enter the race — including
+        # measured rates (mining x11 framework-internally records one);
+        # a non-switchable winner would wedge evaluate() into returning
+        # None forever instead of taking the next-best canonical switch
+        out = {n: h for n, h in measured.items() if algos.switchable(n)}
+    else:
+        out = dict(measured)
+    for name in algos.names(implemented_only=implemented_only):
+        if implemented_only and not algos.switchable(name):
+            continue
+        spec = algos.get(name)
+        if name not in out and spec.planning_hashrate > 0:
+            out[name] = spec.planning_hashrate
+    return out
 
 
 class ProfitSwitcher:
@@ -47,32 +75,18 @@ class ProfitSwitcher:
         self.current_algorithm = current_algorithm
         self.hashrates: dict[str, float] = {}   # algorithm -> measured H/s
         self.switches = 0
+        self.switch_failures = 0
         self.last_switch = 0.0
+        self.target_failures: dict[str, int] = {}
+        self.target_blocked_until: dict[str, float] = {}
         self._task: asyncio.Task | None = None
 
     def record_hashrate(self, algorithm: str, hashrate: float) -> None:
         self.hashrates[algorithm] = hashrate
 
     def _effective_hashrates(self) -> dict[str, float]:
-        """Measured rates, falling back to registry planning rates
-        (reference: engine.go:1092-1104 hard-coded assumptions)."""
-        if self.config.implemented_only:
-            # non-canonical chains must never enter the race — including
-            # measured rates (mining x11 framework-internally records one);
-            # a non-switchable winner would wedge evaluate() into returning
-            # None forever instead of taking the next-best canonical switch
-            out = {
-                n: h for n, h in self.hashrates.items() if algos.switchable(n)
-            }
-        else:
-            out = dict(self.hashrates)
-        for name in algos.names(implemented_only=self.config.implemented_only):
-            if self.config.implemented_only and not algos.switchable(name):
-                continue
-            spec = algos.get(name)
-            if name not in out and spec.planning_hashrate > 0:
-                out[name] = spec.planning_hashrate
-        return out
+        return effective_hashrates(
+            self.hashrates, implemented_only=self.config.implemented_only)
 
     def evaluate(self, now: float | None = None) -> ProfitEstimate | None:
         """One switch decision. Returns the estimate if a switch should
@@ -80,17 +94,21 @@ class ProfitSwitcher:
         now = now if now is not None else time.time()
         if now - self.last_switch < self.config.cooldown_seconds:
             return None
-        best = self.analyzer.best(self._effective_hashrates())
+        rates = self._effective_hashrates()
+        best = self.analyzer.best(rates)
         if best is None or best.algorithm == self.current_algorithm:
             return None
         if self.config.implemented_only and not algos.switchable(best.algorithm):
             # implemented-but-not-canonical (e.g. an uncertified x11 chain)
             # would mine work the live network rejects — refuse the switch
             return None
+        if now < self.target_blocked_until.get(best.algorithm, 0.0):
+            # this target's last switch attempt failed; it is backing off
+            return None
         current_est = None
         for coin, m in self.analyzer.metrics.items():
             if m.algorithm == self.current_algorithm:
-                h = self._effective_hashrates().get(m.algorithm)
+                h = rates.get(m.algorithm)
                 if h:
                     est = self.analyzer.estimate(coin, h)
                     if est and (current_est is None or est.profit_per_day > current_est.profit_per_day):
@@ -112,10 +130,27 @@ class ProfitSwitcher:
             "switching %s -> %s (%s, %.2f/day)",
             self.current_algorithm, best.algorithm, best.coin, best.profit_per_day,
         )
-        await self.on_switch(best.algorithm, best)
+        try:
+            await self.on_switch(best.algorithm, best)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            self.switch_failures += 1
+            n = self.target_failures.get(best.algorithm, 0) + 1
+            self.target_failures[best.algorithm] = n
+            backoff = min(
+                self.config.failure_backoff_base * 2 ** (n - 1),
+                self.config.failure_backoff_max,
+            )
+            self.target_blocked_until[best.algorithm] = time.time() + backoff
+            log.exception("switch to %s failed (attempt %d); backing off "
+                          "%.0fs", best.algorithm, n, backoff)
+            return False
         self.current_algorithm = best.algorithm
         self.switches += 1
         self.last_switch = time.time()
+        self.target_failures.pop(best.algorithm, None)
+        self.target_blocked_until.pop(best.algorithm, None)
         return True
 
     async def start(self) -> None:
@@ -139,9 +174,16 @@ class ProfitSwitcher:
                 log.exception("switch evaluation failed")
 
     def snapshot(self) -> dict:
+        now = time.time()
         return {
             "current_algorithm": self.current_algorithm,
             "switches": self.switches,
+            "switch_failures": self.switch_failures,
             "last_switch": self.last_switch,
             "hashrates": dict(self.hashrates),
+            "blocked_targets": {
+                a: round(until - now, 1)
+                for a, until in self.target_blocked_until.items()
+                if until > now
+            },
         }

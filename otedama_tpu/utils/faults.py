@@ -53,6 +53,8 @@ Fault point registry (grep for ``faults.hit`` to verify):
     worker.crash                                (stratum/shard.py worker share-forward; tag worker id)
     pool.submitter.submit                       (pool/submitter.py retry loop)
     pool.failover.check                         (pool/failover.py; tag pool name)
+    profit.feed                                 (profit/feeds.py fetch; tag feed name)
+    profit.switch                               (profit/orchestrator.py; tag prepare|commit)
     engine.batch                                (engine/engine.py; tag backend)
     device.call                                 (engine/engine.py executor wrapper; tag backend)
 

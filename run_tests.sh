@@ -30,6 +30,14 @@
 #                   totals + PPLNS split byte-identical ACROSS
 #                   PROTOCOLS and measured per-share wire bytes
 #                   V2 < V1; writes a BENCH_STRATUM json artifact.
+#   profit-bench    opt-in profit-orchestration bench: scripted market
+#                   leader flips drive real warm switches on a live
+#                   engine, fault-free vs chaos (feed outage/drop/
+#                   corrupt + one mid-switch death); reports switches/
+#                   hour and per-switch mining-idle + share-loss bounds;
+#                   writes a BENCH_PROFIT json artifact and fails if a
+#                   leg under-switched, exceeded one batch of idle, or
+#                   the chaos leg missed its rollback/hold.
 #   switch-bench    opt-in compilation-lifecycle bench: cold-start with
 #                   cold vs warm persistent XLA cache + mid-run
 #                   sha256d->scrypt warm switch; writes a BENCH_SWITCH
@@ -121,6 +129,9 @@ case "$tier" in
   switch-bench)
     exec env JAX_PLATFORMS=cpu python tools/bench_switch.py \
       --out "${SWITCH_BENCH_OUT:-BENCH_SWITCH_manual.json}" "$@" ;;
+  profit-bench)
+    exec env JAX_PLATFORMS=cpu python tools/bench_profit.py \
+      --out "${PROFIT_BENCH_OUT:-BENCH_PROFIT_manual.json}" "$@" ;;
   degrade-bench)
     exec env JAX_PLATFORMS=cpu python tools/bench_degrade.py \
       --out "${DEGRADE_BENCH_OUT:-BENCH_DEGRADE_manual.json}" "$@" ;;
@@ -143,5 +154,5 @@ case "$tier" in
   chain-bench)
     exec env JAX_PLATFORMS=cpu python tools/bench_chain.py \
       --out "${CHAIN_BENCH_OUT:-BENCH_CHAIN_manual.json}" "$@" ;;
-  *) echo "usage: $0 [fast|slow|all|audit|stratum-bench|stratum-shard-bench|stratum-v2-bench|switch-bench|degrade-bench|engine-bench|validate-bench|sharechain-bench|region-bench|payout-bench|chain-bench] [pytest args...]" >&2; exit 2 ;;
+  *) echo "usage: $0 [fast|slow|all|audit|stratum-bench|stratum-shard-bench|stratum-v2-bench|profit-bench|switch-bench|degrade-bench|engine-bench|validate-bench|sharechain-bench|region-bench|payout-bench|chain-bench] [pytest args...]" >&2; exit 2 ;;
 esac

@@ -88,6 +88,67 @@ def test_profit_best_picks_highest():
     assert best.coin == "LTC"
 
 
+def test_estimate_guards_missing_and_degenerate_metrics():
+    pa = ProfitAnalyzer()
+    assert pa.estimate("NOPE", hashrate=1e12) is None
+    pa.update_metrics(_metrics("BAD", "sha256d", 50000.0, diff=0.0))
+    assert pa.estimate("BAD", hashrate=1e12) is None
+    pa.update_metrics(_metrics("NEG", "sha256d", 50000.0, diff=-1.0))
+    assert pa.estimate("NEG", hashrate=1e12) is None
+
+
+def test_trend_edge_cases():
+    pa = ProfitAnalyzer()
+    # no history at all, then a single sample: slope must be 0, not a crash
+    assert pa.trend("BTC") == 0.0
+    pa._history["BTC"] = [(1000.0, 5.0)]
+    assert pa.trend("BTC") == 0.0
+    # all samples at the SAME timestamp: zero-variance x -> denominator
+    # guard, not a ZeroDivisionError
+    pa._history["BTC"] = [(1000.0, 5.0), (1000.0, 7.0), (1000.0, 9.0)]
+    assert pa.trend("BTC") == 0.0
+    # a clean linear series recovers its slope exactly
+    pa._history["BTC"] = [(1000.0 + i, 5.0 + 2.0 * i) for i in range(5)]
+    assert pa.trend("BTC") == pytest.approx(2.0)
+    pa._history["BTC"] = [(1000.0 + i, 5.0 - 0.5 * i) for i in range(5)]
+    assert pa.trend("BTC") == pytest.approx(-0.5)
+
+
+def test_forecast_edge_cases():
+    pa = ProfitAnalyzer()
+    # no history: there is nothing to extrapolate from
+    assert pa.forecast("BTC") is None
+    # one sample: flat forecast (trend 0) anchored at the last value
+    pa._history["BTC"] = [(1000.0, 5.0)]
+    assert pa.forecast("BTC", horizon_seconds=3600.0) == pytest.approx(5.0)
+    # linear history: last value + slope * horizon
+    pa._history["BTC"] = [(1000.0 + i, 5.0 + 2.0 * i) for i in range(5)]
+    assert pa.forecast("BTC", horizon_seconds=10.0) == pytest.approx(
+        13.0 + 2.0 * 10.0)
+
+
+def test_margin_guards_zero_revenue():
+    pa = ProfitAnalyzer(power_watts=1000.0, power_price_kwh=0.10)
+    # price 0 -> revenue 0, profit negative: margin must clamp to 0.0
+    # instead of dividing by zero
+    pa.update_metrics(_metrics("BTC", "sha256d", price=0.0, diff=1e12))
+    est = pa.estimate("BTC", hashrate=1e12)
+    assert est.revenue_per_day == 0.0 and est.profit_per_day < 0
+    assert est.margin == 0.0
+    pa2 = ProfitAnalyzer()
+    pa2.update_metrics(_metrics("BTC", "sha256d", price=50000.0, diff=1e12))
+    est2 = pa2.estimate("BTC", hashrate=1e12)
+    assert est2.margin == pytest.approx(1.0)   # no power cost: pure profit
+
+
+def test_sample_trims_history_to_window():
+    pa = ProfitAnalyzer(history_window=4)
+    pa.update_metrics(_metrics("BTC", "sha256d", 50000.0, 1e12))
+    for _ in range(10):
+        pa.sample("BTC", hashrate=1e12)
+    assert len(pa._history["BTC"]) == 4
+
+
 # -- switcher ----------------------------------------------------------------
 
 @pytest.mark.asyncio
@@ -141,6 +202,84 @@ def test_switcher_never_picks_unimplemented():
                         current_algorithm="sha256d")
     sw.record_hashrate("kawpow", 1e12)
     assert sw.evaluate() is None
+
+
+@pytest.mark.asyncio
+async def test_switcher_zero_profit_incumbent_skips_improvement_gate():
+    """An incumbent losing money (profit <= 0) must not block escape via
+    the percent-improvement test — percent-of-nonpositive is meaningless."""
+    pa = ProfitAnalyzer(power_watts=10000.0, power_price_kwh=1.0)
+    # BTC at this difficulty earns ~0.31/day against 240/day power: deep red
+    pa.update_metrics(_metrics("BTC", "sha256d", 50000.0, 1e13))
+    pa.update_metrics(_metrics("LTC", "scrypt", 80000.0, 1e7, reward=6.25))
+    switched = []
+
+    async def on_switch(a, e):
+        switched.append(a)
+
+    sw = ProfitSwitcher(
+        pa, on_switch,
+        SwitcherConfig(cooldown_seconds=0.0, min_improvement_percent=1e12),
+        current_algorithm="sha256d",
+    )
+    sw.record_hashrate("sha256d", 1e12)
+    sw.record_hashrate("scrypt", 1e9)
+    incumbent = pa.estimate("BTC", 1e12)
+    assert incumbent.profit_per_day < 0
+    # the absurd improvement threshold is bypassed: get out of the red
+    assert await sw.maybe_switch()
+    assert switched == ["scrypt"]
+
+
+@pytest.mark.asyncio
+async def test_failed_switch_backs_off_instead_of_retry_storm():
+    """Satellite regression: a target whose switch keeps failing must not
+    be re-attempted every interval — each failure doubles its backoff, and
+    a success clears the failure state."""
+    pa = ProfitAnalyzer()
+    pa.update_metrics(_metrics("BTC", "sha256d", 50000.0, 1e13))
+    pa.update_metrics(_metrics("LTC", "scrypt", 80.0, 1e7, reward=6.25))
+    attempts = []
+    fail = [True]
+
+    async def on_switch(a, e):
+        attempts.append(a)
+        if fail[0]:
+            raise RuntimeError("compile died")
+
+    sw = ProfitSwitcher(
+        pa, on_switch,
+        SwitcherConfig(cooldown_seconds=0.0, min_improvement_percent=10.0,
+                       failure_backoff_base=60.0,
+                       failure_backoff_max=3600.0),
+        current_algorithm="sha256d",
+    )
+    sw.record_hashrate("sha256d", 1e12)
+    sw.record_hashrate("scrypt", 1e9)
+
+    assert not await sw.maybe_switch()
+    assert attempts == ["scrypt"] and sw.switch_failures == 1
+    b1 = sw.target_blocked_until["scrypt"] - time.time()
+    assert 55.0 < b1 <= 60.5
+    # the very next tick must NOT re-attempt (this was the retry storm)
+    assert not await sw.maybe_switch()
+    assert attempts == ["scrypt"]
+    assert sw.evaluate() is None
+    assert sw.snapshot()["blocked_targets"].get("scrypt", 0) > 0
+    # past the backoff: attempt #2 fails, the backoff doubles
+    sw.target_blocked_until["scrypt"] = time.time() - 1.0
+    assert not await sw.maybe_switch()
+    assert attempts == ["scrypt", "scrypt"] and sw.switch_failures == 2
+    b2 = sw.target_blocked_until["scrypt"] - time.time()
+    assert 115.0 < b2 <= 120.5
+    # a success clears the per-target failure state entirely
+    fail[0] = False
+    sw.target_blocked_until["scrypt"] = time.time() - 1.0
+    assert await sw.maybe_switch()
+    assert sw.current_algorithm == "scrypt"
+    assert "scrypt" not in sw.target_failures
+    assert "scrypt" not in sw.target_blocked_until
+    assert sw.switches == 1
 
 
 # -- canonical gating (ADVICE r1/r2 high-severity regression) ----------------

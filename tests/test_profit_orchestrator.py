@@ -1,0 +1,527 @@
+"""Profit orchestrator: feeds, hold-on-stale, dwell, rollback, chaos sim.
+
+Pins the tentpole guarantees of profit/orchestrator.py + profit/feeds.py:
+
+- feed hardening: fetch errors retry with exponential backoff, corrupt
+  rows die at the sanitizer, dropped responses age into staleness;
+- hold-on-stale: dead market data NEVER steers a switch;
+- two-sided hysteresis: a candidate must beat the incumbent by the
+  improvement threshold AND lead continuously for the dwell window;
+- pre-warm-then-commit with rollback: a failed switch (profit.switch
+  fault point) leaves the incumbent mining and backs the target off;
+- one state machine: the forced admin path and the autonomous path both
+  run commit_switch/rollback;
+- the seeded end-to-end simulation: prices swing (the profit leader
+  changes >= 3 times), a pool flaps, the feed goes dark (orchestrator
+  HOLDs), a device dies mid-switch (rollback, then a successful retry) —
+  shares keep flowing the whole time, the engine ends on the
+  profit-leading algorithm, and accounting stays exactly-once.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from otedama_tpu.engine.engine import EngineConfig, MiningEngine
+from otedama_tpu.engine.types import Job
+from otedama_tpu.pool.failover import FailoverManager, UpstreamPool
+from otedama_tpu.profit import (
+    CoinMetrics,
+    CoinPlan,
+    FakeFeed,
+    FeedTracker,
+    OrchestratorConfig,
+    ProfitAnalyzer,
+    ProfitOrchestrator,
+)
+from otedama_tpu.runtime.search import SearchResult, Winner
+from otedama_tpu.utils import faults
+
+
+# -- plumbing -----------------------------------------------------------------
+
+class StubBackend:
+    """Minimal engine backend: one fabricated winner per search call."""
+
+    def __init__(self, name: str, algorithm: str):
+        self.name = name
+        self.algorithm = algorithm
+        self.calls = 0
+        self.closed = False
+        self.max_batch = 256
+
+    def precompile(self, jc=None, count=None) -> float:
+        return 0.0
+
+    def search(self, jc, base, count) -> SearchResult:
+        self.calls += 1
+        time.sleep(0.002)
+        return SearchResult(
+            [Winner(base & 0xFFFFFFFF, b"\xff" * 32)], count, 0xFFFFFFFF
+        )
+
+    def close(self) -> None:
+        self.closed = True
+
+
+def make_job(job_id: str, algorithm: str) -> Job:
+    return Job(
+        job_id=job_id,
+        prev_hash=bytes(range(32)),
+        coinb1=bytes.fromhex("01000000010000000000000000"),
+        coinb2=bytes.fromhex("ffffffff0100f2052a01000000"),
+        merkle_branch=[bytes([i] * 32) for i in (7, 9)],
+        version=0x20000000,
+        nbits=0x1D00FFFF,
+        ntime=int(time.time()),
+        clean=True,
+        algorithm=algorithm,
+    )
+
+
+def _set_market(pa: ProfitAnalyzer, btc_diff: float) -> None:
+    """BTC at diff 1e12 dominates (profit ~3.1/day at 1 TH/s sha256d);
+    at diff 1e13 it collapses to ~0.31 and LTC/scrypt (~1.0) leads."""
+    pa.update_metrics(CoinMetrics(
+        coin="BTC", algorithm="sha256d", price=50000.0,
+        network_difficulty=btc_diff, block_reward=3.125))
+    pa.update_metrics(CoinMetrics(
+        coin="LTC", algorithm="scrypt", price=80.0,
+        network_difficulty=1e7, block_reward=6.25))
+
+
+def _orchestrator(pa, feeds=(), *, config=None, commit_log=None,
+                  rollback_log=None, retarget_log=None):
+    async def prepare(algorithm, est):
+        return algorithm
+
+    async def commit(algorithm, backend, est):
+        if commit_log is not None:
+            commit_log.append(algorithm)
+        return 0.01
+
+    async def rollback(incumbent):
+        if rollback_log is not None:
+            rollback_log.append(incumbent)
+
+    async def retarget(plan):
+        if retarget_log is not None:
+            retarget_log.append(plan.coin)
+
+    orch = ProfitOrchestrator(
+        pa, list(feeds),
+        prepare=prepare, commit=commit, rollback=rollback,
+        retarget=retarget,
+        coins={
+            "BTC": CoinPlan("BTC", "sha256d", [{"url": "btc.pool:3333"}]),
+            "LTC": CoinPlan("LTC", "scrypt", [{"url": "ltc.pool:3333"}]),
+        },
+        config=config or OrchestratorConfig(
+            dwell_seconds=0.0, cooldown_seconds=0.0,
+            min_improvement_percent=10.0, feed_stale_seconds=60.0),
+        current_algorithm="sha256d",
+    )
+    orch.record_hashrate("sha256d", 1e12)
+    orch.record_hashrate("scrypt", 1e9)
+    return orch
+
+
+# -- feed hardening -----------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_feed_tracker_retries_with_exponential_backoff():
+    feed = FakeFeed("flaky")
+    feed.set("BTC", "sha256d", 50000.0, 1e12)
+    tracker = FeedTracker(feed, stale_seconds=10.0,
+                          retry_base_seconds=2.0, retry_max_seconds=60.0)
+    inj = faults.FaultInjector(seed=7)
+    inj.error("profit.feed:flaky", max_fires=3)
+    with faults.active(inj):
+        assert await tracker.poll(now=1000.0) == []
+        assert tracker.consecutive_failures == 1
+        # inside the 2s backoff window: no fetch attempt at all
+        assert await tracker.poll(now=1001.0) == []
+        assert tracker.failures == 1
+        # past it: attempt #2 fails, backoff doubles to 4s
+        assert await tracker.poll(now=1002.5) == []
+        assert tracker.failures == 2
+        assert await tracker.poll(now=1004.0) == []   # still backing off
+        assert tracker.failures == 2
+        assert await tracker.poll(now=1006.6) == []   # attempt #3
+        assert tracker.failures == 3
+        # rule exhausted (max_fires=3): next attempt past 8s succeeds
+        rows = await tracker.poll(now=1015.0)
+    assert len(rows) == 1 and rows[0].coin == "BTC"
+    assert tracker.consecutive_failures == 0
+    assert not tracker.stale(now=1016.0)
+    assert tracker.stale(now=1026.0)
+
+
+@pytest.mark.asyncio
+async def test_feed_tracker_sanitizes_corrupt_rows():
+    feed = FakeFeed("poison")
+    feed.set("BTC", "sha256d", 50000.0, 1e12)
+    tracker = FeedTracker(feed, stale_seconds=10.0)
+    inj = faults.FaultInjector(seed=7)
+    inj.corrupt("profit.feed:poison", once=True)
+    with faults.active(inj):
+        assert await tracker.poll(now=1000.0) == []
+        assert tracker.rejected == 1
+        # a poisoned fetch is NOT a success: staleness keeps accruing
+        assert tracker.stale(now=1000.0)
+        rows = await tracker.poll(now=1001.0)
+    assert len(rows) == 1 and rows[0].price == 50000.0
+    assert not tracker.stale(now=1001.0)
+
+
+@pytest.mark.asyncio
+async def test_feed_tracker_counts_dropped_responses():
+    feed = FakeFeed("lossy")
+    feed.set("BTC", "sha256d", 50000.0, 1e12)
+    tracker = FeedTracker(feed, stale_seconds=10.0)
+    inj = faults.FaultInjector(seed=7)
+    inj.drop("profit.feed:lossy", once=True)
+    with faults.active(inj):
+        assert await tracker.poll(now=1000.0) == []
+    assert tracker.drops == 1 and tracker.failures == 0
+    assert tracker.last_success is None
+    assert tracker.stale(now=1000.0)
+
+
+# -- decision pipeline --------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_hold_on_stale_never_switches_on_dead_data():
+    feed = FakeFeed("m")
+    tracker = FeedTracker(feed, stale_seconds=0.5)
+    pa = ProfitAnalyzer()
+    _set_market(pa, 1e13)          # scrypt leads: a switch is on the table
+    commits = []
+    orch = _orchestrator(pa, [tracker], commit_log=commits)
+    # the feed never delivered: market is stale, verdict is HOLD
+    now = time.monotonic()
+    assert orch.evaluate(now) is None
+    assert orch.holds.get("stale", 0) == 1
+    # fresh data lifts the hold
+    feed.set("BTC", "sha256d", 50000.0, 1e13)
+    feed.set("LTC", "scrypt", 80.0, 1e7, reward=6.25)
+    await orch.poll_feeds(now)
+    best = orch.evaluate(now)
+    assert best is not None and best.algorithm == "scrypt"
+    # ... and aging past the horizon re-arms it
+    assert orch.evaluate(now + 10.0) is None
+    assert orch.holds["stale"] == 2
+    assert commits == []
+
+
+def test_manual_market_mode_staleness_uses_metrics_age():
+    pa = ProfitAnalyzer()
+    orch = _orchestrator(pa, [])       # no feeds: update_market mode
+    assert orch.market_stale()         # no data at all
+    _set_market(pa, 1e13)
+    assert not orch.market_stale()
+    pa.metrics["BTC"].updated_at -= 120.0
+    pa.metrics["LTC"].updated_at -= 120.0
+    assert orch.market_stale()
+
+
+def test_dwell_requires_sustained_leadership():
+    pa = ProfitAnalyzer()
+    _set_market(pa, 1e13)              # scrypt leads
+    orch = _orchestrator(pa, [], config=OrchestratorConfig(
+        dwell_seconds=100.0, cooldown_seconds=0.0,
+        min_improvement_percent=10.0, feed_stale_seconds=1e9))
+    t0 = time.monotonic()
+    assert orch.evaluate(t0) is None           # leader just appeared
+    assert orch.holds.get("dwell") == 1
+    assert orch.evaluate(t0 + 50.0) is None    # still inside the window
+    # leadership flips back before the dwell elapses: timer resets
+    _set_market(pa, 1e12)
+    assert orch.evaluate(t0 + 99.0) is None    # sha leads: steady state
+    _set_market(pa, 1e13)
+    assert orch.evaluate(t0 + 120.0) is None   # scrypt re-earns its window
+    best = orch.evaluate(t0 + 221.0)
+    assert best is not None and best.algorithm == "scrypt"
+
+
+def test_min_improvement_is_the_other_hysteresis_side():
+    pa = ProfitAnalyzer()
+    _set_market(pa, 1e13)
+    orch = _orchestrator(pa, [], config=OrchestratorConfig(
+        dwell_seconds=0.0, cooldown_seconds=0.0,
+        min_improvement_percent=100000.0, feed_stale_seconds=1e9))
+    now = time.monotonic()
+    assert orch.evaluate(now) is None
+    assert orch.holds.get("improvement") == 1
+
+
+@pytest.mark.asyncio
+async def test_failed_switch_rolls_back_and_backs_off_target():
+    pa = ProfitAnalyzer()
+    _set_market(pa, 1e13)
+    commits, rollbacks = [], []
+    orch = _orchestrator(pa, [], commit_log=commits,
+                         rollback_log=rollbacks,
+                         config=OrchestratorConfig(
+                             dwell_seconds=0.0, cooldown_seconds=0.0,
+                             min_improvement_percent=10.0,
+                             feed_stale_seconds=1e9,
+                             failure_backoff_base=100.0))
+    inj = faults.FaultInjector(seed=11)
+    inj.error("profit.switch:commit", once=True)   # device dies mid-switch
+    with faults.active(inj):
+        with pytest.raises(faults.FaultInjectedError):
+            await orch.execute_switch("scrypt")
+    assert orch.current_algorithm == "sha256d"     # incumbent kept mining
+    assert commits == [] and rollbacks == ["sha256d"]
+    assert orch.switch_failures == 1
+    assert orch.verdicts.get("failed") == 1
+    assert orch.verdicts.get("rolled_back") == 1
+    # the failed target is backing off: evaluate refuses it
+    now = time.monotonic()
+    assert orch.evaluate(now) is None
+    assert orch.holds.get("backoff") == 1
+    # past the backoff the same switch goes through (the fault was once=)
+    best = orch.evaluate(now + 101.0)
+    assert best is not None and best.algorithm == "scrypt"
+    await orch.execute_switch("scrypt", estimate=best)
+    assert commits == ["scrypt"]
+    assert orch.current_algorithm == "scrypt"
+    assert orch.current_coin == "LTC"
+    assert "scrypt" not in orch._target_blocked_until
+
+
+@pytest.mark.asyncio
+async def test_forced_and_autonomous_paths_share_the_state_machine():
+    pa = ProfitAnalyzer()
+    _set_market(pa, 1e12)
+    commits, retargets = [], []
+    orch = _orchestrator(pa, [], commit_log=commits,
+                         retarget_log=retargets)
+    # admin override commits through commit_switch (verdict 'forced'),
+    # drives the coin's upstream retarget, and resets the cooldown the
+    # autonomous loop then honors
+    await orch.request_switch("scrypt")
+    assert orch.current_algorithm == "scrypt"
+    assert commits == ["scrypt"] and retargets == ["LTC"]
+    assert orch.verdicts.get("forced") == 1
+    snap = orch.snapshot()
+    assert snap["current_algorithm"] == "scrypt"
+    assert snap["current_coin"] == "LTC"
+    # the canonical gate survives the override path
+    with pytest.raises(ValueError, match="not switchable"):
+        await orch.request_switch("kawpow")
+
+
+@pytest.mark.asyncio
+async def test_retarget_failure_does_not_undo_a_committed_switch():
+    pa = ProfitAnalyzer()
+    _set_market(pa, 1e13)
+
+    async def prepare(a, e):
+        return a
+
+    async def commit(a, b, e):
+        return 0.0
+
+    async def retarget(plan):
+        raise RuntimeError("pool connect refused")
+
+    orch = ProfitOrchestrator(
+        pa, [], prepare=prepare, commit=commit, retarget=retarget,
+        coins={"LTC": CoinPlan("LTC", "scrypt", ["ltc.pool:3333"])},
+        config=OrchestratorConfig(feed_stale_seconds=1e9),
+        current_algorithm="sha256d",
+    )
+    await orch.execute_switch("scrypt")
+    assert orch.current_algorithm == "scrypt"      # the switch stands
+    assert orch.verdicts.get("committed") == 1
+    assert orch.verdicts.get("retarget_failed") == 1
+
+
+# -- the seeded end-to-end chaos simulation -----------------------------------
+
+@pytest.mark.asyncio
+async def test_profit_chaos_simulation():
+    """Scripted market + chaos: the leader changes >= 3 times, the feed
+    goes dark mid-run (HOLD), a switch dies mid-commit (rollback + retry),
+    one upstream pool flaps — shares keep flowing, accounting stays
+    exactly-once, and the engine ends on the profit leader."""
+    # -- exactly-once share ledger -------------------------------------------
+    ledger: dict = {}
+    share_algos = set()
+
+    async def on_share(share):
+        key = (share.job_id, share.extranonce2, share.nonce_word)
+        ledger[key] = ledger.get(key, 0) + 1
+        share_algos.add(share.algorithm)
+
+    # -- engine on stub backends ---------------------------------------------
+    backends = {"sha256d": StubBackend("stub-sha", "sha256d"),
+                "scrypt": StubBackend("stub-scrypt", "scrypt")}
+    engine = MiningEngine(
+        backends={backends["sha256d"].name: backends["sha256d"]},
+        on_share=on_share,
+        config=EngineConfig(batch_size=256, auto_batch=False,
+                            pipeline_depth=1),
+    )
+    await engine.start()
+    jobs = [0]
+
+    def issue_job(algorithm):
+        jobs[0] += 1
+        engine.set_job(make_job(f"sim-{jobs[0]}-{algorithm}", algorithm))
+
+    issue_job("sha256d")
+
+    # -- scripted market: ordinal-driven, fully deterministic ----------------
+    # phase 1 (n<6):    sha256d leads (the incumbent; steady state)
+    # phase 2 (6..14):  leader change 1 -> scrypt. The FIRST switch attempt
+    #                   dies mid-commit (profit.switch fault), rolls back,
+    #                   backs off, then a retry commits.
+    # dark (15..21):    the feed raises. The last good data says the
+    #                   incumbent leads; once it ages out the verdict must
+    #                   be HOLD until light returns.
+    # phase 3 (22..29): leader change 2 -> sha256d (fresh data again)
+    # phase 4 (n>=30):  leader change 3 -> scrypt; the run must END there.
+    def script(feed, n):
+        if 15 <= n < 22:
+            raise RuntimeError("market API dark")
+        if n < 6:
+            btc_diff = 1e12
+        elif n < 15:
+            btc_diff = 1e13
+        elif n < 30:
+            btc_diff = 1e12
+        else:
+            btc_diff = 1e13
+        feed.set("BTC", "sha256d", 50000.0, btc_diff)
+        feed.set("LTC", "scrypt", 80.0, 1e7, reward=6.25)
+
+    feed = FakeFeed("sim-market", script=script)
+    tracker = FeedTracker(feed, stale_seconds=0.10,
+                          retry_base_seconds=0.01, retry_max_seconds=0.02)
+
+    # -- per-coin upstream plans + a flapping failover set --------------------
+    async def serve(reader, writer):
+        writer.close()
+
+    srv_a = await asyncio.start_server(serve, "127.0.0.1", 0)
+    srv_b = await asyncio.start_server(serve, "127.0.0.1", 0)
+    port_a = srv_a.sockets[0].getsockname()[1]
+    port_b = srv_b.sockets[0].getsockname()[1]
+    failover = FailoverManager(
+        [UpstreamPool(name="ltc-a", host="127.0.0.1", port=port_a,
+                      priority=0),
+         UpstreamPool(name="ltc-b", host="127.0.0.1", port=port_b,
+                      priority=1)],
+        failure_threshold=2,
+    )
+    retargets = []
+
+    async def retarget(plan):
+        retargets.append(plan.coin)
+
+    # -- orchestrator wired to the engine -------------------------------------
+    pa = ProfitAnalyzer()
+
+    async def prepare(algorithm, est):
+        return backends[algorithm]
+
+    async def commit(algorithm, backend, est):
+        downtime = await engine.switch_algorithm(
+            algorithm, {backend.name: backend})
+        issue_job(algorithm)
+        return downtime
+
+    rollbacks = []
+
+    async def rollback(incumbent):
+        rollbacks.append(incumbent)
+
+    orch = ProfitOrchestrator(
+        pa, [tracker],
+        prepare=prepare, commit=commit, rollback=rollback,
+        retarget=retarget,
+        coins={
+            "BTC": CoinPlan("BTC", "sha256d", ["127.0.0.1:%d" % port_a]),
+            "LTC": CoinPlan("LTC", "scrypt", ["ltc-a:%d" % port_a,
+                                              "ltc-b:%d" % port_b]),
+        },
+        config=OrchestratorConfig(
+            interval_seconds=0.02,
+            min_improvement_percent=10.0,
+            dwell_seconds=0.055,
+            cooldown_seconds=0.08,
+            feed_stale_seconds=0.10,
+            failure_backoff_base=0.05,
+            failure_backoff_max=0.4,
+        ),
+        current_algorithm="sha256d",
+    )
+    orch.record_hashrate("sha256d", 1e12)
+    orch.record_hashrate("scrypt", 1e9)
+
+    # -- seeded chaos ---------------------------------------------------------
+    inj = faults.FaultInjector(seed=20160)
+    # the device dies mid-commit on the FIRST switch attempt only
+    inj.error("profit.switch:commit", once=True)
+    # upstream ltc-a flaps: its first four health checks fail
+    inj.error("pool.failover.check:ltc-a", max_fires=4)
+
+    algos_seen = set()
+    held_during_dark = True
+    flap_seen = False
+    shares_before_dark = 0
+    with faults.active(inj):
+        for step in range(46):
+            before = orch.verdicts.get("committed", 0) + \
+                orch.verdicts.get("forced", 0)
+            await orch.tick()
+            if 15 <= feed.fetches - 1 < 22:
+                # dark window: no switch may commit while the feed is out
+                if (orch.verdicts.get("committed", 0)
+                        + orch.verdicts.get("forced", 0)) != before:
+                    held_during_dark = False
+                if not shares_before_dark:
+                    shares_before_dark = len(ledger)
+            algos_seen.add(orch.current_algorithm)
+            if step % 4 == 0:
+                await failover.check_all()
+                if failover.select().name == "ltc-b":
+                    flap_seen = True
+            await asyncio.sleep(0.02)
+    await asyncio.sleep(0.05)
+    await engine.stop()
+    srv_a.close()
+    srv_b.close()
+    await srv_a.wait_closed()
+    await srv_b.wait_closed()
+
+    # the profit leader changed >= 3 times and the engine tracked it: it
+    # ends on scrypt, the leader of the final phase
+    assert algos_seen == {"sha256d", "scrypt"}
+    assert orch.current_algorithm == "scrypt"
+    assert engine.config.algorithm == "scrypt"
+    committed = orch.verdicts.get("committed", 0)
+    assert committed >= 3, orch.verdicts
+    # the first attempt died mid-commit and rolled back to the incumbent
+    assert orch.verdicts.get("failed") == 1
+    assert rollbacks == ["sha256d"]
+    # the dark window held: no switch committed without fresh market data
+    assert held_during_dark
+    assert orch.holds.get("stale", 0) >= 1
+    assert tracker.failures >= 1
+    # committed switches drove the per-coin upstream retarget
+    assert "LTC" in retargets and "BTC" in retargets
+    # the flapping upstream lost selection to its healthy backup
+    assert flap_seen
+    # shares kept flowing across switches, flaps and the dark window —
+    # on BOTH algorithms — and every one is accounted exactly once
+    assert len(ledger) > shares_before_dark > 0
+    assert share_algos == {"sha256d", "scrypt"}
+    assert all(count == 1 for count in ledger.values())
+    snap = orch.snapshot()
+    assert snap["switches"]["committed"] == committed
+    assert snap["feeds"]["sim-market"]["failures"] == tracker.failures

@@ -1,0 +1,338 @@
+"""Profit-orchestration bench: switch cadence + per-switch share loss.
+
+Drives the real ``ProfitOrchestrator`` against a real ``MiningEngine`` on
+XLA backends (CPU-friendly shapes) with a scripted market whose profit
+leader flips on a known schedule, and emits a ``BENCH_PROFIT_*.json``
+artifact with the numbers the orchestrator exists to bound:
+
+1. **Fault-free leg** — leader flips drive warm switches through the
+   prepare->commit pipeline. Reported per switch: the true mining idle
+   window (last incumbent batch end -> first new-algorithm batch start,
+   from per-search timestamps) and the share-loss bound it implies
+   (idle x measured hashrate / 2^32 = expected diff-1 shares forgone),
+   plus the realized switches/hour.
+
+2. **Chaos leg** — the same market under ``profit.feed`` faults (an API
+   outage burst, dropped responses, corrupt payloads) plus one
+   ``profit.switch`` commit failure (device dies mid-switch). The
+   orchestrator must hold on stale data, roll back the failed attempt,
+   back off, and still converge on the profit leader — with the same
+   idle bounds.
+
+Hard gates (exit 2): too few committed switches, a switch idle window
+exceeding one batch boundary, a missing rollback/hold in the chaos leg,
+or the run not ending on the profit-leading algorithm.
+
+Usage:
+    python tools/bench_profit.py --out BENCH_PROFIT_r19.json [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import platform
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from otedama_tpu.engine.algo_manager import AlgorithmManager   # noqa: E402
+from otedama_tpu.engine.engine import EngineConfig, MiningEngine  # noqa: E402
+from otedama_tpu.engine.types import Job                       # noqa: E402
+from otedama_tpu.profit import (                               # noqa: E402
+    CoinPlan,
+    FakeFeed,
+    FeedTracker,
+    OrchestratorConfig,
+    ProfitAnalyzer,
+    ProfitOrchestrator,
+)
+from otedama_tpu.utils import faults                           # noqa: E402
+
+
+class TimedBackend:
+    """Pass-through backend recording per-search (start, end) stamps.
+    ``close()`` is a no-op so the inner backend survives engine retirement
+    and can be swapped back in on a later switch (the orchestrator's
+    pre-warmed pool)."""
+
+    def __init__(self, inner, algorithm: str):
+        self._inner = inner
+        self.name = f"timed-{algorithm}"
+        self.algorithm = algorithm
+        for attr in ("max_batch", "preferred_batch", "en2_fanout"):
+            if hasattr(inner, attr):
+                setattr(self, attr, getattr(inner, attr))
+        self.events: list[tuple[float, float]] = []
+
+    def precompile(self, jc=None, count=None) -> float:
+        return self._inner.precompile(jc, count=count)
+
+    def search(self, jc, base, count):
+        t0 = time.monotonic()
+        result = self._inner.search(jc, base, count)
+        self.events.append((t0, time.monotonic()))
+        return result
+
+    def close(self) -> None:
+        pass
+
+
+def _job(job_id: str, algorithm: str) -> Job:
+    return Job(
+        job_id=job_id,
+        prev_hash=bytes(range(32)),
+        coinb1=bytes.fromhex("01000000010000000000000000"),
+        coinb2=bytes.fromhex("ffffffff0100f2052a01000000"),
+        merkle_branch=[bytes([i] * 32) for i in (7, 9)],
+        version=0x20000000,
+        nbits=0x1D00FFFF,
+        ntime=int(time.time()),
+        clean=True,
+        algorithm=algorithm,
+    )
+
+
+def _hashrate(backend: TimedBackend, batch: int) -> float:
+    if len(backend.events) < 2:
+        return 0.0
+    t0 = backend.events[0][0]
+    t1 = backend.events[-1][1]
+    if t1 <= t0:
+        return 0.0
+    return len(backend.events) * batch / (t1 - t0)
+
+
+async def run_leg(label: str, inners: dict, *, batch: int, steps: int,
+                  phase_len: int, injector=None) -> dict:
+    """One orchestrator soak: scripted leader flips, real warm switches."""
+    wrapped = {a: TimedBackend(b, a) for a, b in inners.items()}
+    shares = {"count": 0, "dups": 0}
+    seen: set = set()
+
+    async def on_share(share):
+        key = (share.job_id, share.extranonce2, share.nonce_word)
+        if key in seen:
+            shares["dups"] += 1
+        seen.add(key)
+        shares["count"] += 1
+
+    engine = MiningEngine(
+        backends={wrapped["sha256d"].name: wrapped["sha256d"]},
+        on_share=on_share,
+        config=EngineConfig(batch_size=batch, auto_batch=False,
+                            pipeline_depth=2),
+    )
+    await engine.start()
+    jobs = [0]
+
+    def issue_job(algorithm):
+        jobs[0] += 1
+        engine.set_job(_job(f"bench-{jobs[0]}-{algorithm}", algorithm))
+
+    issue_job("sha256d")
+
+    # the leader walks sha -> scrypt -> sha -> scrypt and STAYS on the
+    # final phase, so a settled run must end on scrypt
+    phases = ["sha256d", "scrypt", "sha256d", "scrypt"]
+
+    def script(feed, n):
+        leader = phases[min(n // phase_len, len(phases) - 1)]
+        btc_diff = 1e12 if leader == "sha256d" else 1e13
+        feed.set("BTC", "sha256d", 50000.0, btc_diff)
+        feed.set("LTC", "scrypt", 80.0, 1e7, reward=6.25)
+
+    feed = FakeFeed("bench-market", script=script)
+    tracker = FeedTracker(feed, stale_seconds=0.5,
+                          retry_base_seconds=0.02, retry_max_seconds=0.05)
+
+    switch_records: list[dict] = []
+
+    async def prepare(algorithm, est):
+        # the pre-warmed pool: both backends were built + precompiled up
+        # front; a production app pays this in prepare_backend_async
+        # while the incumbent keeps mining
+        return wrapped[algorithm]
+
+    async def commit(algorithm, backend, est):
+        old = wrapped[orch.current_algorithm]
+        swap_at = time.monotonic()
+        downtime = await engine.switch_algorithm(
+            algorithm, {backend.name: backend})
+        issue_job(algorithm)
+        n_before = len(backend.events)
+        deadline = time.monotonic() + 120.0
+        while len(backend.events) <= n_before:
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"{algorithm} produced no batch within 120s of the swap")
+            await asyncio.sleep(0.005)
+        first_new_start = backend.events[n_before][0]
+        last_old_end = max((e for _, e in old.events), default=swap_at)
+        idle = max(0.0, first_new_start - max(last_old_end, swap_at))
+        rate = max(_hashrate(old, batch), _hashrate(backend, batch))
+        switch_records.append({
+            "to": algorithm,
+            "engine_downtime_seconds": round(downtime, 4),
+            "mining_idle_seconds": round(idle, 4),
+            "share_loss_bound_diff1": round(idle * rate / 4294967296.0, 9),
+        })
+        return downtime
+
+    orch = ProfitOrchestrator(
+        ProfitAnalyzer(), [tracker],
+        prepare=prepare, commit=commit,
+        coins={
+            "BTC": CoinPlan("BTC", "sha256d"),
+            "LTC": CoinPlan("LTC", "scrypt"),
+        },
+        config=OrchestratorConfig(
+            interval_seconds=0.03,
+            min_improvement_percent=10.0,
+            dwell_seconds=0.08,
+            cooldown_seconds=0.15,
+            feed_stale_seconds=0.5,
+            failure_backoff_base=0.1,
+            failure_backoff_max=0.5,
+        ),
+        current_algorithm="sha256d",
+    )
+    orch.record_hashrate("sha256d", 1e12)
+    orch.record_hashrate("scrypt", 1e9)
+
+    t_start = time.monotonic()
+    ctx = faults.active(injector) if injector is not None else None
+    if ctx is not None:
+        ctx.__enter__()
+    try:
+        for _ in range(steps):
+            await orch.tick()
+            await asyncio.sleep(0.03)
+        # settle: the script is sticky on its last phase; give the
+        # orchestrator room to converge on the final leader
+        for _ in range(40):
+            await orch.tick()
+            if (orch.current_algorithm == "scrypt"
+                    and not orch.switching):
+                break
+            await asyncio.sleep(0.03)
+    finally:
+        if ctx is not None:
+            ctx.__exit__(None, None, None)
+    elapsed = time.monotonic() - t_start
+    await engine.stop()
+
+    committed = orch.verdicts.get("committed", 0)
+    batch_times = [
+        e - s for b in wrapped.values() for s, e in b.events]
+    max_batch_seconds = max(batch_times) if batch_times else 0.0
+    idles = [r["mining_idle_seconds"] for r in switch_records]
+    return {
+        "label": label,
+        "elapsed_seconds": round(elapsed, 2),
+        "ticks": orch.ticks,
+        "committed_switches": committed,
+        "switches_per_hour": round(committed / elapsed * 3600.0, 1),
+        "switch_failures": orch.switch_failures,
+        "verdicts": dict(orch.verdicts),
+        "holds": dict(orch.holds),
+        "final_algorithm": orch.current_algorithm,
+        "hashrate_sha256d": round(_hashrate(wrapped["sha256d"], batch), 1),
+        "hashrate_scrypt": round(_hashrate(wrapped["scrypt"], batch), 1),
+        "max_single_batch_seconds": round(max_batch_seconds, 4),
+        "mining_idle_seconds_max": round(max(idles), 4) if idles else 0.0,
+        "share_loss_bound_diff1_total": round(
+            sum(r["share_loss_bound_diff1"] for r in switch_records), 9),
+        "switches": switch_records,
+        "shares_found": shares["count"],
+        "duplicate_shares": shares["dups"],
+        "feed": tracker.snapshot(),
+        "idle_bounded_by_one_batch": all(
+            i <= max_batch_seconds + 0.25 for i in idles),
+    }
+
+
+async def run_bench(batch: int, steps: int, phase_len: int) -> dict:
+    mgr = AlgorithmManager(preferred_backend="xla")
+    print("== building + precompiling backends (the pre-warm pool) ==",
+          flush=True)
+    inners = {
+        "sha256d": await mgr.prepare_backend_async(
+            "sha256d", kind="xla", warm_count=batch,
+            chunk=min(batch, 1 << 10), rolled=True),
+        "scrypt": await mgr.prepare_backend_async(
+            "scrypt", kind="xla", warm_count=batch, chunk=64, rolled=True),
+    }
+
+    print("== fault-free leg ==", flush=True)
+    fault_free = await run_leg("fault_free", inners, batch=batch,
+                               steps=steps, phase_len=phase_len)
+    print(json.dumps(fault_free, indent=2), flush=True)
+
+    print("== chaos leg: feed outage/drop/corrupt + mid-switch death ==",
+          flush=True)
+    inj = faults.FaultInjector(seed=19)
+    inj.error("profit.feed:bench-market", max_fires=3)   # API outage burst
+    inj.drop("profit.feed:bench-market", every_nth=6)
+    inj.corrupt("profit.feed:bench-market", every_nth=9)
+    inj.error("profit.switch:commit", once=True)         # dies mid-switch
+    chaos = await run_leg("chaos", inners, batch=batch, steps=steps,
+                          phase_len=phase_len, injector=inj)
+    print(json.dumps(chaos, indent=2), flush=True)
+
+    return {"fault_free": fault_free, "chaos": chaos}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--out", default="BENCH_PROFIT_manual.json")
+    ap.add_argument("--quick", action="store_true",
+                    help="smaller shapes (CI smoke, not a real measurement)")
+    args = ap.parse_args()
+
+    batch = 512 if args.quick else 1024
+    steps = 60 if args.quick else 120
+    phase_len = 8 if args.quick else 12
+
+    legs = asyncio.run(run_bench(batch, steps, phase_len))
+
+    result = {
+        "bench": "profit_orchestration",
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "platform": platform.platform(),
+        "jax_platform": os.environ.get("JAX_PLATFORMS", "default"),
+        "batch_size": batch,
+        **legs,
+    }
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {args.out}")
+
+    ff, ch = legs["fault_free"], legs["chaos"]
+    if ff["committed_switches"] < 2:
+        sys.exit("FAIL: fault-free leg committed fewer than 2 switches")
+    if ch["committed_switches"] < 2:
+        sys.exit("FAIL: chaos leg committed fewer than 2 switches")
+    for leg in (ff, ch):
+        if leg["final_algorithm"] != "scrypt":
+            sys.exit(f"FAIL: {leg['label']} leg did not end on the "
+                     "profit-leading algorithm")
+        if not leg["idle_bounded_by_one_batch"]:
+            sys.exit(f"FAIL: {leg['label']} leg switch idle exceeded one "
+                     "batch boundary")
+        if leg["duplicate_shares"]:
+            sys.exit(f"FAIL: {leg['label']} leg double-counted shares")
+    if ch["switch_failures"] != 1 or ch["verdicts"].get("failed") != 1:
+        sys.exit("FAIL: chaos leg did not record exactly one failed switch")
+    if ch["holds"].get("stale", 0) < 1:
+        sys.exit("FAIL: chaos leg never held on stale market data")
+    if ch["feed"]["failures"] < 1:
+        sys.exit("FAIL: chaos leg feed never saw an injected outage")
+
+
+if __name__ == "__main__":
+    main()
