@@ -838,6 +838,43 @@ class ApiServer:
                     labels={"path": path},
                     help_="Validation batch latency, by execution path")
 
+    def sync_native_metrics(self, snap: dict) -> None:
+        """Native batch-path health (utils/native_batch.py, PR 17): the
+        native/python call split per op (is the fast path actually
+        taken?), refused-load + faulted-call fallbacks, tripwire alarms
+        (MUST stay 0 — a mismatch means the .so disagreed with the
+        python oracle), and the batch-size shape the whole win rides
+        on (windows/groups must clear the crossover constants)."""
+        reg = self.registry
+        for op, paths in snap.get("calls", {}).items():
+            for path, count in paths.items():
+                reg.counter_set(
+                    "otedama_native_calls_total", count,
+                    labels={"op": op, "path": path},
+                    help_="Batch-op calls, by op and execution path")
+        reg.counter_set("otedama_native_fallbacks_total",
+                        snap.get("fallbacks", 0),
+                        help_="Native paths degraded to python "
+                              "(refused library or faulted call)")
+        reg.counter_set("otedama_native_tripwire_mismatches_total",
+                        snap.get("tripwire_mismatches", 0),
+                        help_="Native outputs contradicted by the python "
+                              "oracle (op permanently degraded)")
+        reg.gauge_set("otedama_native_available",
+                      1 if snap.get("available") else 0,
+                      help_="Native batch library loaded and ABI-matched")
+        tripped = snap.get("tripped", {})
+        reg.gauge_set("otedama_native_tripped",
+                      1 if any(tripped.values()) else 0,
+                      help_="Any op pinned to python by a tripwire mismatch")
+        for op, state in snap.get("batch_sizes", {}).items():
+            if state.get("count", 0) > 0:
+                reg.histogram_set(
+                    "otedama_native_batch_size",
+                    dict(zip(state["bounds"], state["counts"])),
+                    state["sum"], state["count"], labels={"op": op},
+                    help_="Records per native batch call, by op")
+
     def sync_pool_server_metrics(self, server=None, server_v2=None) -> None:
         """Export the POOL-side share-accept latency SLO histograms
         (submit-received -> verdict-written, per protocol). The client

@@ -181,6 +181,18 @@ class Application:
         else:
             compile_cache.install()  # observability even without the cache
 
+        # native batch paths (PR 17): push the measured crossover knobs
+        # into the process-global gate before any stratum/chain component
+        # seals a frame or drains a journal group
+        from otedama_tpu.utils import native_batch
+
+        native_batch.configure(
+            enabled=cfg.native.enabled,
+            aead_min_batch=cfg.native.aead_min_batch,
+            chainframe_min_batch=cfg.native.chainframe_min_batch,
+            tripwire_rate=cfg.native.tripwire_rate,
+        )
+
         if cfg.pool.enabled:
             await self._start_pool_side()
         if cfg.p2p.enabled:
@@ -851,6 +863,10 @@ class Application:
         from otedama_tpu.utils import faults as _faults
 
         self.api.add_provider("fault_injection", _faults.snapshot_active)
+        # native batch-path health: call split, fallbacks, tripwire state
+        from otedama_tpu.utils import native_batch as _native_batch
+
+        self.api.add_provider("native", _native_batch.snapshot)
         if self.db is not None:
             # /api/v1/logs/audit reads the pool db's audit trail
             self.api.audit_source = self.db.query_audit
@@ -1238,6 +1254,9 @@ class Application:
                 self.api.sync_settlement_metrics(self.settlement.snapshot())
             if self.validator is not None:
                 self.api.sync_validation_metrics(self.validator)
+            from otedama_tpu.utils import native_batch as _nb
+
+            self.api.sync_native_metrics(_nb.snapshot())
             self.api.sync_compile_metrics(
                 compile_cache.counters(), compile_cache.histograms()
             )
@@ -1300,4 +1319,7 @@ class Application:
             out["region"] = self.regions.snapshot()
         if self.settlement is not None:
             out["settlement"] = self.settlement.snapshot()
+        from otedama_tpu.utils import native_batch as _nb
+
+        out["native"] = _nb.snapshot()
         return out

@@ -340,6 +340,34 @@ class ValidationSettings:
 
 
 @dataclasses.dataclass
+class NativeSettings:
+    """GIL-releasing native batch paths (utils/native_batch.py →
+    libotedama_native.so): batch AEAD seal/open for Noise frames and
+    vectorized chain-frame encode+CRC for the journal writer thread.
+    Every path degrades to its pure-python oracle (identical bytes) when
+    the library is missing/stale/mismatched or a tripwire fires."""
+
+    enabled: bool = True
+    # seal/open batches under this many AEAD records stay in python.
+    # Measured (BENCH_NATIVE_r20 crossover probe): the native call wins
+    # from batch 1 — one python ChaCha20-Poly1305 op costs ~0.4 ms vs
+    # single-digit µs of ctypes dispatch — so the knob exists for
+    # symmetry with the chainframe crossover, not because python ever
+    # wins here
+    aead_min_batch: int = 1
+    # journal groups under this many records frame in python: the
+    # framing is cheap (~3-4 µs/record of struct+crc32) so ctypes
+    # dispatch overhead needs a few records to amortize
+    # (BENCH_NATIVE_r20 crossover probe)
+    chainframe_min_batch: int = 32
+    # fraction of native calls re-verified against the python oracle
+    # (one sampled record per verified call); any mismatch permanently
+    # trips that op back to python (counted + alarmed). 0 disables —
+    # not recommended
+    tripwire_rate: float = 0.02
+
+
+@dataclasses.dataclass
 class ProfitSettings:
     """Profit orchestration (profit/orchestrator.py): feeds, two-sided
     hysteresis, per-coin upstream plans."""
@@ -390,6 +418,8 @@ class AppConfig:
     validation: ValidationSettings = dataclasses.field(
         default_factory=ValidationSettings)
     p2p: P2PConfig = dataclasses.field(default_factory=P2PConfig)
+    native: NativeSettings = dataclasses.field(
+        default_factory=NativeSettings)
     profit: ProfitSettings = dataclasses.field(default_factory=ProfitSettings)
     api: ApiConfig = dataclasses.field(default_factory=ApiConfig)
     logging: LoggingConfig = dataclasses.field(default_factory=LoggingConfig)
@@ -404,6 +434,7 @@ _SECTIONS = {
     "region": RegionSettings,
     "validation": ValidationSettings,
     "p2p": P2PConfig,
+    "native": NativeSettings,
     "profit": ProfitSettings,
     "api": ApiConfig,
     "logging": LoggingConfig,
@@ -607,6 +638,12 @@ def validate_config(cfg: AppConfig) -> list[str]:
         errors.append("validation.quarantine_seconds must be >= 0")
     if cfg.validation.x11_chain not in ("numpy", "jax"):
         errors.append("validation.x11_chain must be 'numpy' or 'jax'")
+    if cfg.native.aead_min_batch < 1:
+        errors.append("native.aead_min_batch must be >= 1")
+    if cfg.native.chainframe_min_batch < 1:
+        errors.append("native.chainframe_min_batch must be >= 1")
+    if not (0.0 <= cfg.native.tripwire_rate <= 1.0):
+        errors.append("native.tripwire_rate must be in [0, 1]")
     if cfg.region.token_ttl <= 0:
         errors.append("region.token_ttl must be positive")
     if cfg.region.recommit_interval <= 0:
@@ -771,6 +808,13 @@ validation:
   tripwire_rate: 0.05  # host-oracle sample per device batch (corruption trap)
   quarantine_seconds: 60.0  # device-path timeout after an error/mismatch
   x11_chain: numpy     # x11 tier: numpy (lane-parallel host) | jax (device)
+
+native:
+  enabled: true          # GIL-free batch AEAD + chain-frame encode (.so)
+  aead_min_batch: 1      # native wins from batch 1 (BENCH_NATIVE_r20:
+                         # ~0.4 ms/op python AEAD vs µs-scale native)
+  chainframe_min_batch: 32  # journal framing crossover (BENCH_NATIVE_r20)
+  tripwire_rate: 0.02    # python-oracle sample rate; mismatch trips to python
 
 p2p:
   enabled: false

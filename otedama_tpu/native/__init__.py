@@ -31,6 +31,20 @@ def _build() -> None:
     )
 
 
+# Every exported-signature change bumps the tag (src/chainframe.cc);
+# a library without the symbol predates the tag and is equally stale.
+ABI_VERSION = 2
+
+
+def _abi_ok(lib: ctypes.CDLL) -> bool:
+    try:
+        fn = lib.otedama_abi_version
+    except AttributeError:
+        return False
+    fn.restype = ctypes.c_int32
+    return int(fn()) == ABI_VERSION
+
+
 def _load() -> ctypes.CDLL:
     if not os.path.exists(_LIB_PATH):
         try:
@@ -41,6 +55,24 @@ def _load() -> ctypes.CDLL:
                 f"native library missing and build failed: {detail}"
             ) from None
     lib = ctypes.CDLL(_LIB_PATH)
+    if not _abi_ok(lib):
+        # stale committed binary: one rebuild attempt, then refuse —
+        # calling through a wrong prototype corrupts memory, a refused
+        # import degrades to the python/JAX paths (callers probe-guard)
+        log.warning("native library ABI tag mismatch (want %d) — "
+                    "rebuilding", ABI_VERSION)
+        try:
+            _build()
+        except (subprocess.CalledProcessError, FileNotFoundError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            raise ImportError(
+                f"native library ABI-stale and rebuild failed: {detail}"
+            ) from None
+        lib = ctypes.CDLL(_LIB_PATH)
+        if not _abi_ok(lib):
+            raise ImportError(
+                f"native library ABI tag still != {ABI_VERSION} after "
+                "rebuild (mixed checkout?)")
 
     u32p = ctypes.POINTER(ctypes.c_uint32)
     u64p = ctypes.POINTER(ctypes.c_uint64)

@@ -1,0 +1,253 @@
+// ChaCha20-Poly1305 AEAD (RFC 8439) — batch seal/open entry points.
+//
+// The pure-python implementation in stratum/noise.py is the oracle: the
+// same construction (otk = first 32 bytes of the counter-0 keystream
+// block; ciphertext from counter 1; the MAC over aad|pad16|ct|pad16|
+// LE64 lens), so the bytes here are identical by construction and the
+// ctypes layer sample-verifies them at runtime (tripwire).  The batch
+// shape exists for the GIL: one ctypes call seals a whole coalesce
+// window of Noise frames while the interpreter keeps serving.
+//
+// Poly1305 uses the 26-bit-limb schoolbook (poly1305-donna-32 shape):
+// every product fits a uint64_t, so the arithmetic is portable and the
+// RFC vectors in tests/test_native_batch.py pin it.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+inline uint32_t rotl32(uint32_t v, int c) {
+  return (v << c) | (v >> (32 - c));
+}
+
+inline uint32_t le32(const uint8_t* p) {
+  return (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
+         ((uint32_t)p[3] << 24);
+}
+
+inline void store_le32(uint8_t* p, uint32_t v) {
+  p[0] = (uint8_t)v;
+  p[1] = (uint8_t)(v >> 8);
+  p[2] = (uint8_t)(v >> 16);
+  p[3] = (uint8_t)(v >> 24);
+}
+
+inline void store_le64(uint8_t* p, uint64_t v) {
+  store_le32(p, (uint32_t)v);
+  store_le32(p + 4, (uint32_t)(v >> 32));
+}
+
+#define QR(a, b, c, d)          \
+  a += b; d = rotl32(d ^ a, 16); \
+  c += d; b = rotl32(b ^ c, 12); \
+  a += b; d = rotl32(d ^ a, 8);  \
+  c += d; b = rotl32(b ^ c, 7)
+
+void chacha20_block(const uint32_t key[8], uint32_t counter,
+                    const uint32_t nonce[3], uint8_t out[64]) {
+  uint32_t s[16] = {0x61707865u, 0x3320646Eu, 0x79622D32u, 0x6B206574u,
+                    key[0], key[1], key[2], key[3],
+                    key[4], key[5], key[6], key[7],
+                    counter, nonce[0], nonce[1], nonce[2]};
+  uint32_t w[16];
+  std::memcpy(w, s, sizeof(w));
+  for (int i = 0; i < 10; i++) {
+    QR(w[0], w[4], w[8], w[12]);
+    QR(w[1], w[5], w[9], w[13]);
+    QR(w[2], w[6], w[10], w[14]);
+    QR(w[3], w[7], w[11], w[15]);
+    QR(w[0], w[5], w[10], w[15]);
+    QR(w[1], w[6], w[11], w[12]);
+    QR(w[2], w[7], w[8], w[13]);
+    QR(w[3], w[4], w[9], w[14]);
+  }
+  for (int i = 0; i < 16; i++) store_le32(out + 4 * i, w[i] + s[i]);
+}
+
+void chacha20_xor(const uint32_t key[8], uint32_t counter,
+                  const uint32_t nonce[3], const uint8_t* in, uint64_t len,
+                  uint8_t* out) {
+  uint8_t block[64];
+  for (uint64_t off = 0; off < len; off += 64, counter++) {
+    chacha20_block(key, counter, nonce, block);
+    uint64_t n = len - off < 64 ? len - off : 64;
+    for (uint64_t i = 0; i < n; i++) out[off + i] = in[off + i] ^ block[i];
+  }
+}
+
+// -- Poly1305 -----------------------------------------------------------------
+
+struct Poly1305 {
+  uint32_t r[5];
+  uint32_t h[5];
+  uint32_t pad[4];
+  uint8_t buf[16];
+  size_t buflen;
+
+  void init(const uint8_t otk[32]) {
+    r[0] = (le32(otk + 0)) & 0x3ffffff;
+    r[1] = (le32(otk + 3) >> 2) & 0x3ffff03;
+    r[2] = (le32(otk + 6) >> 4) & 0x3ffc0ff;
+    r[3] = (le32(otk + 9) >> 6) & 0x3f03fff;
+    r[4] = (le32(otk + 12) >> 8) & 0x00fffff;
+    for (int i = 0; i < 5; i++) h[i] = 0;
+    for (int i = 0; i < 4; i++) pad[i] = le32(otk + 16 + 4 * i);
+    buflen = 0;
+  }
+
+  void block(const uint8_t m[16], uint32_t hibit) {
+    uint64_t r0 = r[0], r1 = r[1], r2 = r[2], r3 = r[3], r4 = r[4];
+    uint64_t s1 = r1 * 5, s2 = r2 * 5, s3 = r3 * 5, s4 = r4 * 5;
+    uint64_t h0 = h[0] + ((le32(m + 0)) & 0x3ffffff);
+    uint64_t h1 = h[1] + ((le32(m + 3) >> 2) & 0x3ffffff);
+    uint64_t h2 = h[2] + ((le32(m + 6) >> 4) & 0x3ffffff);
+    uint64_t h3 = h[3] + ((le32(m + 9) >> 6) & 0x3ffffff);
+    uint64_t h4 = h[4] + ((le32(m + 12) >> 8) | hibit);
+    uint64_t d0 = h0 * r0 + h1 * s4 + h2 * s3 + h3 * s2 + h4 * s1;
+    uint64_t d1 = h0 * r1 + h1 * r0 + h2 * s4 + h3 * s3 + h4 * s2;
+    uint64_t d2 = h0 * r2 + h1 * r1 + h2 * r0 + h3 * s4 + h4 * s3;
+    uint64_t d3 = h0 * r3 + h1 * r2 + h2 * r1 + h3 * r0 + h4 * s4;
+    uint64_t d4 = h0 * r4 + h1 * r3 + h2 * r2 + h3 * r1 + h4 * r0;
+    uint64_t c;
+    c = d0 >> 26; d1 += c; h0 = d0 & 0x3ffffff;
+    c = d1 >> 26; d2 += c; h1 = d1 & 0x3ffffff;
+    c = d2 >> 26; d3 += c; h2 = d2 & 0x3ffffff;
+    c = d3 >> 26; d4 += c; h3 = d3 & 0x3ffffff;
+    c = d4 >> 26; h4 = d4 & 0x3ffffff;
+    h0 += c * 5;
+    c = h0 >> 26; h0 &= 0x3ffffff; h1 += c;
+    h[0] = (uint32_t)h0; h[1] = (uint32_t)h1; h[2] = (uint32_t)h2;
+    h[3] = (uint32_t)h3; h[4] = (uint32_t)h4;
+  }
+
+  void update(const uint8_t* m, uint64_t len) {
+    if (buflen) {
+      while (buflen < 16 && len) { buf[buflen++] = *m++; len--; }
+      if (buflen < 16) return;
+      block(buf, 1u << 24);
+      buflen = 0;
+    }
+    while (len >= 16) { block(m, 1u << 24); m += 16; len -= 16; }
+    while (len) { buf[buflen++] = *m++; len--; }
+  }
+
+  void finish(uint8_t mac[16]) {
+    if (buflen) {
+      buf[buflen] = 1;
+      for (size_t i = buflen + 1; i < 16; i++) buf[i] = 0;
+      block(buf, 0);
+    }
+    uint32_t h0 = h[0], h1 = h[1], h2 = h[2], h3 = h[3], h4 = h[4];
+    uint32_t c;
+    c = h1 >> 26; h1 &= 0x3ffffff; h2 += c;
+    c = h2 >> 26; h2 &= 0x3ffffff; h3 += c;
+    c = h3 >> 26; h3 &= 0x3ffffff; h4 += c;
+    c = h4 >> 26; h4 &= 0x3ffffff; h0 += c * 5;
+    c = h0 >> 26; h0 &= 0x3ffffff; h1 += c;
+    // compute h + -p = h - (2^130 - 5) and select constant-time
+    uint32_t g0 = h0 + 5; c = g0 >> 26; g0 &= 0x3ffffff;
+    uint32_t g1 = h1 + c; c = g1 >> 26; g1 &= 0x3ffffff;
+    uint32_t g2 = h2 + c; c = g2 >> 26; g2 &= 0x3ffffff;
+    uint32_t g3 = h3 + c; c = g3 >> 26; g3 &= 0x3ffffff;
+    uint32_t g4 = h4 + c - (1u << 26);
+    uint32_t mask = (g4 >> 31) - 1;  // all-ones when h >= p
+    g0 &= mask; g1 &= mask; g2 &= mask; g3 &= mask; g4 &= mask;
+    mask = ~mask;
+    h0 = (h0 & mask) | g0; h1 = (h1 & mask) | g1; h2 = (h2 & mask) | g2;
+    h3 = (h3 & mask) | g3; h4 = (h4 & mask) | g4;
+    h0 = (h0 | (h1 << 26)) & 0xffffffff;
+    h1 = ((h1 >> 6) | (h2 << 20)) & 0xffffffff;
+    h2 = ((h2 >> 12) | (h3 << 14)) & 0xffffffff;
+    h3 = ((h3 >> 18) | (h4 << 8)) & 0xffffffff;
+    uint64_t f;
+    f = (uint64_t)h0 + pad[0]; h0 = (uint32_t)f;
+    f = (uint64_t)h1 + pad[1] + (f >> 32); h1 = (uint32_t)f;
+    f = (uint64_t)h2 + pad[2] + (f >> 32); h2 = (uint32_t)f;
+    f = (uint64_t)h3 + pad[3] + (f >> 32); h3 = (uint32_t)f;
+    store_le32(mac + 0, h0); store_le32(mac + 4, h1);
+    store_le32(mac + 8, h2); store_le32(mac + 12, h3);
+  }
+};
+
+const uint8_t ZEROS[16] = {0};
+
+// MAC over aad|pad16(aad)|ct|pad16(ct)|LE64(aadlen)|LE64(ctlen) with the
+// one-time key from the counter-0 keystream block (RFC 8439 §2.8).
+void aead_tag(const uint32_t key[8], const uint32_t nonce[3],
+              const uint8_t* aad, uint64_t aadlen, const uint8_t* ct,
+              uint64_t ctlen, uint8_t tag[16]) {
+  uint8_t otk[64];
+  chacha20_block(key, 0, nonce, otk);
+  Poly1305 mac;
+  mac.init(otk);
+  mac.update(aad, aadlen);
+  if (aadlen % 16) mac.update(ZEROS, 16 - aadlen % 16);
+  mac.update(ct, ctlen);
+  if (ctlen % 16) mac.update(ZEROS, 16 - ctlen % 16);
+  uint8_t lens[16];
+  store_le64(lens, aadlen);
+  store_le64(lens + 8, ctlen);
+  mac.update(lens, 16);
+  mac.finish(tag);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Seal n records: for record i the nonce is nonces[12*i..], the aad is
+// aad[aad_off[i]..aad_off[i+1]) and the plaintext pt[pt_off[i]..
+// pt_off[i+1]).  Output is the concatenation of (ciphertext || 16-byte
+// tag) per record — caller sizes out as pt_total + 16*n.  Returns 0.
+int otedama_aead_seal_many(const uint8_t* key, const uint8_t* nonces,
+                           int32_t n, const uint64_t* aad_off,
+                           const uint8_t* aad, const uint64_t* pt_off,
+                           const uint8_t* pt, uint8_t* out) {
+  uint32_t k[8];
+  for (int i = 0; i < 8; i++) k[i] = le32(key + 4 * i);
+  uint64_t opos = 0;
+  for (int32_t i = 0; i < n; i++) {
+    uint32_t nc[3] = {le32(nonces + 12 * i), le32(nonces + 12 * i + 4),
+                      le32(nonces + 12 * i + 8)};
+    uint64_t alen = aad_off[i + 1] - aad_off[i];
+    uint64_t plen = pt_off[i + 1] - pt_off[i];
+    uint8_t* ct = out + opos;
+    chacha20_xor(k, 1, nc, pt + pt_off[i], plen, ct);
+    aead_tag(k, nc, aad + aad_off[i], alen, ct, plen, ct + plen);
+    opos += plen + 16;
+  }
+  return 0;
+}
+
+// Open n records (ct lengths INCLUDE the 16-byte tag).  Output is the
+// concatenation of plaintexts (ctlen-16 each).  Returns -1 when every
+// tag verified, else the index of the FIRST failing record; records
+// before it are decrypted in out, nothing after it is touched — the
+// caller mirrors the python oracle's per-op nonce advancement exactly.
+int otedama_aead_open_many(const uint8_t* key, const uint8_t* nonces,
+                           int32_t n, const uint64_t* aad_off,
+                           const uint8_t* aad, const uint64_t* ct_off,
+                           const uint8_t* ct, uint8_t* out) {
+  uint32_t k[8];
+  for (int i = 0; i < 8; i++) k[i] = le32(key + 4 * i);
+  uint64_t opos = 0;
+  for (int32_t i = 0; i < n; i++) {
+    uint64_t clen = ct_off[i + 1] - ct_off[i];
+    if (clen < 16) return i;
+    uint32_t nc[3] = {le32(nonces + 12 * i), le32(nonces + 12 * i + 4),
+                      le32(nonces + 12 * i + 8)};
+    uint64_t alen = aad_off[i + 1] - aad_off[i];
+    const uint8_t* c = ct + ct_off[i];
+    uint8_t tag[16];
+    aead_tag(k, nc, aad + aad_off[i], alen, c, clen - 16, tag);
+    uint8_t diff = 0;  // constant-time compare
+    for (int j = 0; j < 16; j++) diff |= tag[j] ^ c[clen - 16 + j];
+    if (diff) return i;
+    chacha20_xor(k, 1, nc, c, clen - 16, out + opos);
+    opos += clen - 16;
+  }
+  return -1;
+}
+
+}  // extern "C"

@@ -1,0 +1,105 @@
+// Vectorized chain-journal frame encode (+ the library's ABI tag).
+//
+// The ChainStore writer thread drains its ring in groups; framing each
+// record in python (struct.pack + two chained zlib.crc32 calls + joins)
+// holds the GIL against the serving loop's ShareChain.connect.  This
+// entry point emits the whole group's magic/type/len/payload/crc32
+// framing in ONE ctypes call (GIL released), byte-identical to
+// chainstore._frame: crc32 is the zlib/IEEE one (reflected 0xEDB88320,
+// init/xorout 0xFFFFFFFF) chained over head[1:] (type + LE32 len) then
+// the payload — exactly zlib.crc32(payload, zlib.crc32(head[1:])).
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// Slice-by-8: zlib's own crc32 runs ~1 byte/cycle, so a byte-at-a-time
+// table here would LOSE to the python oracle (measured: 0.75x at
+// n=256).  The 8-lane table closes that gap.  The u32 loads assume a
+// little-endian host — same assumption as the keccak sponge, and
+// equally probe-guarded: the loader's chainframe KAT refuses the
+// library if this ever produces non-zlib bytes.
+uint32_t CRC_TABLE[8][256];
+bool crc_ready = false;
+
+void crc_init() {
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++)
+      c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    CRC_TABLE[0][i] = c;
+  }
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = CRC_TABLE[0][i];
+    for (int t = 1; t < 8; t++) {
+      c = CRC_TABLE[0][c & 0xFF] ^ (c >> 8);
+      CRC_TABLE[t][i] = c;
+    }
+  }
+  crc_ready = true;
+}
+
+inline uint32_t crc32_update(uint32_t crc, const uint8_t* p, uint64_t len) {
+  while (len >= 8) {
+    uint32_t lo, hi;
+    std::memcpy(&lo, p, 4);
+    std::memcpy(&hi, p + 4, 4);
+    lo ^= crc;
+    crc = CRC_TABLE[7][lo & 0xFF] ^ CRC_TABLE[6][(lo >> 8) & 0xFF] ^
+          CRC_TABLE[5][(lo >> 16) & 0xFF] ^ CRC_TABLE[4][lo >> 24] ^
+          CRC_TABLE[3][hi & 0xFF] ^ CRC_TABLE[2][(hi >> 8) & 0xFF] ^
+          CRC_TABLE[1][(hi >> 16) & 0xFF] ^ CRC_TABLE[0][hi >> 24];
+    p += 8;
+    len -= 8;
+  }
+  for (uint64_t i = 0; i < len; i++)
+    crc = CRC_TABLE[0][(crc ^ p[i]) & 0xFF] ^ (crc >> 8);
+  return crc;
+}
+
+inline void store_le32(uint8_t* p, uint32_t v) {
+  p[0] = (uint8_t)v;
+  p[1] = (uint8_t)(v >> 8);
+  p[2] = (uint8_t)(v >> 16);
+  p[3] = (uint8_t)(v >> 24);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Bumped whenever an exported signature changes; the ctypes loader
+// refuses a library whose tag (or absence of one) does not match, so a
+// stale committed .so degrades to the python oracle instead of calling
+// through a wrong prototype.
+int32_t otedama_abi_version() { return 2; }
+
+// Frame n records: record i has type types[i] and payload
+// payloads[offsets[i]..offsets[i+1]).  Output is the concatenation of
+// magic(1) | type(1) | payload_len(LE32) | payload | crc32(LE32) per
+// record — caller sizes out as payload_total + 10*n.  Returns the total
+// bytes written.
+int64_t otedama_chain_frames(uint8_t magic, int32_t n, const uint8_t* types,
+                             const uint64_t* offsets, const uint8_t* payloads,
+                             uint8_t* out) {
+  if (!crc_ready) crc_init();
+  uint64_t opos = 0;
+  for (int32_t i = 0; i < n; i++) {
+    uint64_t plen = offsets[i + 1] - offsets[i];
+    uint8_t* rec = out + opos;
+    rec[0] = magic;
+    rec[1] = types[i];
+    store_le32(rec + 2, (uint32_t)plen);
+    const uint8_t* payload = payloads + offsets[i];
+    std::memcpy(rec + 6, payload, plen);
+    // crc over head[1:] (type + len) then payload, zlib init/xorout
+    uint32_t crc = crc32_update(0xFFFFFFFFu, rec + 1, 5);
+    crc = crc32_update(crc, payload, plen) ^ 0xFFFFFFFFu;
+    store_le32(rec + 6 + plen, crc);
+    opos += plen + 10;
+  }
+  return (int64_t)opos;
+}
+
+}  // extern "C"

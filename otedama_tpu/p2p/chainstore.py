@@ -99,6 +99,7 @@ from bisect import bisect_right
 from collections import OrderedDict, deque
 
 from otedama_tpu.utils import faults
+from otedama_tpu.utils import native_batch
 from otedama_tpu.utils.histogram import LatencyHistogram
 
 log = logging.getLogger("otedama.p2p.chainstore")
@@ -745,6 +746,29 @@ class ChainStore:
                                                     cumwork))
         return _frame(REC_REORG, _REORG.pack(job[1]))
 
+    def _event_frames(self, events: list[tuple]) -> list[bytes]:
+        """Frame a drained group: payload serialization (encode_extend)
+        stays in python — it IS the record format — but the
+        magic/type/len/crc32 framing of the WHOLE group happens in one
+        GIL-releasing native call when the group clears the measured
+        crossover (utils.native_batch, PR 17).  ``_frame`` is the oracle
+        the native path is tripwire-verified against, and the fallback,
+        so journal bytes are identical either way."""
+        types: list[int] = []
+        payloads: list[bytes] = []
+        for job in events:
+            if job[0] == "extend":
+                types.append(REC_EXTEND)
+                payloads.append(encode_extend(job[1], job[2], job[3],
+                                              job[4]))
+            else:
+                types.append(REC_REORG)
+                payloads.append(_REORG.pack(job[1]))
+        frames = native_batch.chain_frames(_MAGIC, types, payloads)
+        if frames is None:
+            frames = [_frame(t, p) for t, p in zip(types, payloads)]
+        return frames
+
     def _write_events(self, batch: list[tuple]) -> None:
         """One journal group: encode every event, ONE buffered write,
         ONE fsync. ``chain.fsync`` is the writer thread's own seam (per
@@ -790,7 +814,7 @@ class ChainStore:
             written = False
             if events:
                 try:
-                    frames = [self._event_frame(j) for j in events]
+                    frames = self._event_frames(events)
                     first = self.journal.append_frames(frames)
                     written = True
                     cache = self._frame_cache
@@ -1093,6 +1117,7 @@ class ChainStore:
                 self._archive_ok = True
                 return True
             frames: list[bytes] = []
+            misses: list[tuple] = []  # (slot, height, share, sid, cumwork)
             failed = False
             for i, (sid, share, cumwork) in enumerate(entries):
                 if chaos:
@@ -1121,8 +1146,20 @@ class ChainStore:
                 if cached is not None and cached[0] == sid:
                     frames.append(cached[1])
                 else:
-                    frames.append(_frame(REC_EXTEND, encode_extend(
-                        h0 + i, share, sid, cumwork)))
+                    frames.append(b"")  # patched from the miss batch below
+                    misses.append((len(frames) - 1, h0 + i, share, sid,
+                                   cumwork))
+            if misses:
+                # cache misses re-encode in one native framing call (the
+                # same group batching as the journal hot path)
+                payloads = [encode_extend(h, s, sid_, cw)
+                            for _, h, s, sid_, cw in misses]
+                built = native_batch.chain_frames(
+                    _MAGIC, [REC_EXTEND] * len(payloads), payloads)
+                if built is None:
+                    built = [_frame(REC_EXTEND, p) for p in payloads]
+                for (slot, *_rest), fr in zip(misses, built):
+                    frames[slot] = fr
             if frames:
                 try:
                     self.archive.append_frames(frames)

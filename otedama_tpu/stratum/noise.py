@@ -44,6 +44,8 @@ import os
 import struct
 import time as _time
 
+from otedama_tpu.utils import native_batch as _native
+
 # -- X25519 (RFC 7748) --------------------------------------------------------
 
 P25519 = 2**255 - 19
@@ -239,7 +241,13 @@ class CipherState:
             return plaintext
         if self.n >= MAX_NONCE:
             raise AuthError("nonce exhausted; rekey required")
-        out = aead_encrypt(self.k, self._nonce(), plaintext, aad)
+        nonce = self._nonce()
+        # native fast path (PR 17): the pure-python AEAD below is the
+        # oracle it is tripwire-verified against, so the bytes are
+        # identical either way
+        res = _native.aead_seal_many(self.k, [nonce], [plaintext], [aad])
+        out = (res[0] if res is not None
+               else aead_encrypt(self.k, nonce, plaintext, aad))
         self.n += 1
         return out
 
@@ -248,9 +256,58 @@ class CipherState:
             return ciphertext
         if self.n >= MAX_NONCE:
             raise AuthError("nonce exhausted; rekey required")
-        out = aead_decrypt(self.k, self._nonce(), ciphertext, aad)
+        nonce = self._nonce()
+        res = _native.aead_open_many(self.k, [nonce], [ciphertext], [aad])
+        if res is not None:
+            pts, fail = res
+            if fail >= 0:
+                raise AuthError("poly1305 tag mismatch")
+            out = pts[0]
+        else:
+            out = aead_decrypt(self.k, nonce, ciphertext, aad)
         self.n += 1  # only on successful auth (failed decrypt raises)
         return out
+
+    def encrypt_many(self, chunks: list[bytes]) -> list[bytes]:
+        """Seal consecutive chunks under consecutive nonces in ONE
+        GIL-releasing native call — a whole CoalescingWriter window per
+        call.  The counter advances by ``len(chunks)`` exactly as the
+        per-op path would; fallback IS the per-op path."""
+        if self.k is None:
+            return list(chunks)
+        if not chunks:
+            return []
+        if self.n + len(chunks) >= MAX_NONCE:  # raise at the exact op
+            return [self.encrypt(c) for c in chunks]
+        nonces = [b"\x00" * 4 + struct.pack("<Q", self.n + i)
+                  for i in range(len(chunks))]
+        res = _native.aead_seal_many(self.k, nonces, list(chunks))
+        if res is None:
+            return [self.encrypt(c) for c in chunks]
+        self.n += len(chunks)
+        return res
+
+    def decrypt_many(self, chunks: list[bytes]) -> list[bytes]:
+        """Open consecutive chunks in one native call.  On a tag failure
+        the counter lands exactly where the per-op oracle leaves it (one
+        increment per chunk that verified) before AuthError."""
+        if self.k is None:
+            return list(chunks)
+        if not chunks:
+            return []
+        if self.n + len(chunks) >= MAX_NONCE:
+            return [self.decrypt(c) for c in chunks]
+        nonces = [b"\x00" * 4 + struct.pack("<Q", self.n + i)
+                  for i in range(len(chunks))]
+        res = _native.aead_open_many(self.k, nonces, list(chunks))
+        if res is None:
+            return [self.decrypt(c) for c in chunks]
+        pts, fail = res
+        if fail >= 0:
+            self.n += fail
+            raise AuthError("poly1305 tag mismatch")
+        self.n += len(chunks)
+        return pts
 
 
 class SymmetricState:
@@ -473,6 +530,21 @@ class NoiseSession:
             parts.append(struct.pack("<H", len(ct)) + ct)
         return b"".join(parts)
 
+    def seal_many(self, frames: list[bytes]) -> bytes:
+        """Seal a whole coalesce window of SV2 frames at once.
+
+        Fragmentation and nonce ordering are EXACTLY ``seal()`` applied
+        to each frame in sequence — the chunks of all frames are sealed
+        under consecutive nonces in one GIL-releasing native call
+        (``CipherState.encrypt_many``), and the fallback is that very
+        sequence, so the wire bytes are identical either way."""
+        chunks = []
+        for frame in frames:
+            for off in range(0, max(len(frame), 1), MAX_NOISE_PLAINTEXT):
+                chunks.append(frame[off:off + MAX_NOISE_PLAINTEXT])
+        cts = self.send_cipher.encrypt_many(chunks)
+        return b"".join(struct.pack("<H", len(ct)) + ct for ct in cts)
+
     async def recv_frame_bytes(self, reader) -> bytes:
         """Read + decrypt one whole SV2 frame, reassembling fragments.
 
@@ -486,8 +558,24 @@ class NoiseSession:
         if len(buf) < 6:
             return buf  # short/garbage frame: the parser's problem
         need = 6 + int.from_bytes(buf[3:6], "little")
-        while len(buf) < need:
-            buf += self.recv_cipher.decrypt(await _read_lp(reader))
+        # oversized frame: read every remaining fragment's ciphertext
+        # first, then open them in ONE native call (decrypt_many) — the
+        # per-op oracle is the fallback, so ordering/auth semantics are
+        # unchanged (a chunk shorter than its tag fails immediately)
+        cts: list[bytes] = []
+        expect = len(buf)
+        while expect < need:
+            ct = await _read_lp(reader)
+            if len(ct) < AEAD_TAG_LEN:
+                for pt in self.recv_cipher.decrypt_many(cts):
+                    buf += pt
+                cts = []
+                buf += self.recv_cipher.decrypt(ct)  # raises: short ct
+                continue
+            cts.append(ct)
+            expect += len(ct) - AEAD_TAG_LEN
+        for pt in self.recv_cipher.decrypt_many(cts):
+            buf += pt
         return buf
 
 

@@ -138,10 +138,18 @@ class CoalescingWriter:
 
     ``flush()`` exists for shutdown seams: a pending flush would be
     lost if the writer closes first (the final worker snapshot rides
-    on it)."""
+    on it).
+
+    ``pre_flush`` (optional callable) runs at the top of every flush,
+    while the window is still armed: a producer that defers per-frame
+    work to the window boundary (the V2 server seals a whole window of
+    noise frames in one native AEAD call — PR 17) materializes its
+    bytes there via ``send()``, which won't re-arm mid-flush.
+    ``schedule()`` arms the flush timer without enqueuing bytes, for
+    exactly that deferred-producer pattern."""
 
     __slots__ = ("_writer", "_loop", "_chunks", "_scheduled", "_delay",
-                 "_handle")
+                 "_handle", "pre_flush")
 
     def __init__(self, writer: asyncio.StreamWriter, delay: float = 0.0):
         self._writer = writer
@@ -150,9 +158,13 @@ class CoalescingWriter:
         self._scheduled = False
         self._delay = delay
         self._handle = None
+        self.pre_flush = None
 
     def send(self, data: bytes) -> None:
         self._chunks.append(data)
+        self.schedule()
+
+    def schedule(self) -> None:
         if not self._scheduled:
             self._scheduled = True
             if self._delay > 0:
@@ -161,6 +173,8 @@ class CoalescingWriter:
                 self._loop.call_soon(self.flush)
 
     def flush(self) -> None:
+        if self.pre_flush is not None:
+            self.pre_flush()  # before disarming: send() won't re-schedule
         self._scheduled = False
         if self._handle is not None:
             self._handle.cancel()

@@ -265,9 +265,14 @@ class FrameConn:
     window (stratum/shard.py): frames queued within the window share
     ONE transport write, so submit/ack bursts cost ~one send syscall
     per window instead of one per frame — the same amortization the
-    share bus runs on, applied to the miner-facing wire. Frames are
-    still sealed individually (the noise receiver reassembles by SV2
-    frame header), only the socket writes coalesce."""
+    share bus runs on, applied to the miner-facing wire. With a noise
+    session attached, sealing is deferred to the same boundary: the
+    whole window's frames are encrypted in ONE GIL-releasing native
+    AEAD call (``NoiseSession.seal_many``, PR 17) with nonce order ==
+    send order, identical wire bytes to sealing each frame as it was
+    queued. When a fault injector is armed, frames seal one at a time
+    again so ``sv2.conn.send`` directives keep acting on each frame's
+    own sealed bytes (deterministic chaos schedules)."""
 
     def __init__(self, reader: asyncio.StreamReader,
                  writer: asyncio.StreamWriter, session=None,
@@ -275,10 +280,12 @@ class FrameConn:
         self.reader = reader
         self.writer = writer
         self.session = session
+        self._pending_pt: list[bytes] = []  # plaintext frames this window
         if coalesce > 0:
             from otedama_tpu.stratum.shard import CoalescingWriter
 
             self._coalescer = CoalescingWriter(writer, coalesce)
+            self._coalescer.pre_flush = self._seal_pending
         else:
             self._coalescer = None
 
@@ -302,6 +309,14 @@ class FrameConn:
         if (max_backlog is not None and transport is not None
                 and transport.get_write_buffer_size() > max_backlog):
             raise ConnectionError("write backlog over cap (stalled peer)")
+        if (self.session is not None and self._coalescer is not None
+                and faults.get() is None):
+            # defer sealing to the window boundary: one native AEAD call
+            # seals every frame queued this coalesce window (pre_flush)
+            self._pending_pt.append(frame)
+            self._coalescer.schedule()
+            return
+        self._seal_pending()  # nonce order: window backlog seals first
         wire = frame if self.session is None else self.session.seal(frame)
         d = faults.hit("sv2.conn.send", supports=faults.SEND_SYNC)
         if d is not None:
@@ -320,6 +335,15 @@ class FrameConn:
             self._coalescer.send(wire)
         else:
             self.writer.write(wire)
+
+    def _seal_pending(self) -> None:
+        """Window boundary: seal every deferred plaintext frame in one
+        ``seal_many`` call and hand the bytes to the coalescer (safe
+        inside ``pre_flush`` — send() won't re-arm mid-flush)."""
+        if not self._pending_pt:
+            return
+        frames, self._pending_pt = self._pending_pt, []
+        self._coalescer.send(self.session.seal_many(frames))
 
     async def drain(self) -> None:
         if self._coalescer is not None:

@@ -57,6 +57,10 @@ Fault point registry (grep for ``faults.hit`` to verify):
     profit.switch                               (profit/orchestrator.py; tag prepare|commit)
     engine.batch                                (engine/engine.py; tag backend)
     device.call                                 (engine/engine.py executor wrapper; tag backend)
+    native.call                                 (utils/native_batch.py; tag seal|open|chainframe;
+                                                 error/crash -> counted python fallback,
+                                                 corrupt -> mangled native result the sampled
+                                                 tripwire must catch, delay -> slow .so call)
 
 Usage (tests / chaos drivers):
 

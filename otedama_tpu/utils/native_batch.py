@@ -1,0 +1,468 @@
+"""GIL-releasing batch entry points into libotedama_native.so (PR 17).
+
+The two measured pure-python walls (ROADMAP item 2) are the Stratum V2
+Noise leg (~0.42 ms of python ChaCha20-Poly1305 per share,
+BENCH_STRATUM_r18) and the durable chain's writer-thread encode+CRC
+(GIL-serialized against the serving loop, BENCH_CHAIN_r17).  Both are
+batch-shaped at their call sites — a CoalescingWriter window of frames
+per connection pass, a drained ring group per journal write — so each
+becomes ONE ctypes call here; ctypes releases the GIL for the duration,
+which is the entire point.
+
+Contract (the sha256_host / PR 12 validation-tripwire discipline):
+
+- **The python implementation is the oracle.**  Callers treat a ``None``
+  return as "do it in python"; every native result is sample-re-verified
+  against the oracle (``tripwire_rate`` of calls) and a single mismatch
+  permanently trips that op back to python (counted + logged loudly).
+  Wire and disk bytes are therefore identical by construction: the fast
+  path is bit-checked against the same code that would otherwise run.
+- **Measured crossover gating**: batches below ``aead_min_batch`` /
+  ``chainframe_min_batch`` return ``None`` so per-call dispatch overhead
+  never makes a small batch slower (the NUMPY_LANE_MIN_BATCH
+  discipline; constants pinned by tools/bench_native.py →
+  BENCH_NATIVE_r20.json).
+- **Loader hardening**: the .so must export ``otedama_abi_version()``
+  matching ABI_VERSION.  A missing, stale (sources newer), or
+  version-mismatched library triggers one rebuild attempt; failure of
+  that counts a ``native_fallbacks`` and pins the python path for the
+  process.  This module deliberately does NOT import
+  ``otedama_tpu.native`` (which pulls numpy + engine.algos): it dlopens
+  the same .so directly so stratum/chainstore hot paths stay light.
+- **Chaos seam**: every native call crosses the ``native.call`` fault
+  point (error/crash/delay/corrupt) so the tripwire-degrade path is
+  testable — ``corrupt`` mangles the native result exactly like a
+  miscompiled library would, and a sampled tripwire must catch it.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import struct
+import subprocess
+import threading
+import time
+import zlib
+from itertools import accumulate
+
+from otedama_tpu.utils import faults
+from otedama_tpu.utils.histogram import LatencyHistogram
+
+log = logging.getLogger("otedama.native_batch")
+
+ABI_VERSION = 2
+
+_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    "native")
+_LIB_PATH = os.path.join(_DIR, "libotedama_native.so")
+_SRC_DIR = os.path.join(_DIR, "src")
+
+_OPS = ("seal", "open", "chainframe")
+
+# batch-size histograms (how big the windows/groups actually are — the
+# whole win depends on them being > the crossover constants)
+_BATCH_BOUNDS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None | bool = None  # None = not tried, False = refused
+_load_reason: str | None = None
+
+# config knobs — see config.schema.NativeSettings for the annotated
+# defaults; configure() overwrites them at app startup
+_enabled = True
+_aead_min_batch = 1
+_chainframe_min_batch = 32
+_tripwire_rate = 0.02
+
+_calls = {(op, path): 0 for op in _OPS for path in ("native", "python")}
+_fallbacks = 0           # refused loads + faulted/failed native calls
+_mismatches = 0          # tripwire oracle disagreements (should be 0)
+_tripped = {op: False for op in _OPS}
+_trip_acc = {op: 0.0 for op in _OPS}  # sampling accumulators
+_batch_hist = {op: LatencyHistogram(bounds=_BATCH_BOUNDS) for op in _OPS}
+
+def _offsets(lens: list[int]):
+    """(packed LE64 offsets, offsets list).  Packed as bytes rather than
+    a ctypes array: building a c_uint64 array element-wise costs more
+    than the whole python framing oracle at journal-group sizes
+    (measured 4.7us vs 0.8us for struct.pack at n=64)."""
+    off = list(accumulate(lens, initial=0))
+    return struct.pack("<%dQ" % len(off), *off), off
+
+
+def _py_frame(magic: int, rtype: int, payload: bytes) -> bytes:
+    """The chainstore._frame oracle, restated here for the load probe and
+    tripwire (importing chainstore from utils would be circular)."""
+    head = struct.pack("<BBI", magic, rtype, len(payload))
+    return b"".join((head, payload,
+                     struct.pack("<I", zlib.crc32(payload,
+                                                  zlib.crc32(head[1:])))))
+
+
+# RFC 8439 §2.8.2 AEAD vector — the same KAT that pins the python oracle
+# in tests/test_noise.py; a library that cannot reproduce it is refused
+# at load (big-endian host, miscompile, wrong ABI).
+_KAT_KEY = bytes(range(0x80, 0xA0))
+_KAT_NONCE = bytes([7, 0, 0, 0, 0x40, 0x41, 0x42, 0x43,
+                    0x44, 0x45, 0x46, 0x47])
+_KAT_AAD = bytes.fromhex("50515253c0c1c2c3c4c5c6c7")
+_KAT_PT = (b"Ladies and Gentlemen of the class of '99: If I could offer "
+           b"you only one tip for the future, sunscreen would be it.")
+_KAT_CT = bytes.fromhex(
+    "d31a8d34648e60db7b86afbc53ef7ec2a4aded51296e08fea9e2b5a736ee62d6"
+    "3dbea45e8ca9671282fafb69da92728b1a71de0a9e060b2905d6a5b67ecd3b36"
+    "92ddbd7f2d778b8c9803aee328091b58fab324e4fad675945585808b4831d7bc"
+    "3ff4def08e4b7a9de576d26586cec64b6116"
+    "1ae10b594f09e26a7e902ecbd0600691")
+
+
+def _raw_seal(lib, key: bytes, nonces: bytes, n: int, aad_off, aads: bytes,
+              pt_off, pts: bytes, out_len: int) -> bytes:
+    out = ctypes.create_string_buffer(out_len)
+    lib.otedama_aead_seal_many(key, nonces, n, aad_off, aads, pt_off, pts,
+                               out)
+    return out.raw
+
+
+def _stale() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    so_mtime = os.path.getmtime(_LIB_PATH)
+    try:
+        srcs = [os.path.join(_SRC_DIR, f) for f in os.listdir(_SRC_DIR)
+                if f.endswith(".cc")]
+    except OSError:
+        return False
+    return any(os.path.getmtime(s) > so_mtime for s in srcs)
+
+
+def _try_open() -> ctypes.CDLL:
+    lib = ctypes.CDLL(_LIB_PATH)
+    ver_fn = getattr(lib, "otedama_abi_version")  # AttributeError if stale
+    ver_fn.restype = ctypes.c_int32
+    ver = int(ver_fn())
+    if ver != ABI_VERSION:
+        raise RuntimeError(
+            f"native ABI version {ver} != expected {ABI_VERSION}")
+    # offsets cross as raw LE64 bytes (see _offsets); c_char_p for every
+    # pointer keeps the marshalling to a handful of refcount bumps
+    c = ctypes.c_char_p
+    lib.otedama_aead_seal_many.argtypes = [
+        c, c, ctypes.c_int32, c, c, c, c, c]
+    lib.otedama_aead_seal_many.restype = ctypes.c_int32
+    lib.otedama_aead_open_many.argtypes = [
+        c, c, ctypes.c_int32, c, c, c, c, c]
+    lib.otedama_aead_open_many.restype = ctypes.c_int32
+    lib.otedama_chain_frames.argtypes = [
+        ctypes.c_uint8, ctypes.c_int32, c, c, c, c]
+    lib.otedama_chain_frames.restype = ctypes.c_int64
+    # KAT probe: RFC 8439 AEAD vector + one chain frame vs the zlib oracle
+    aad_off, _ = _offsets([len(_KAT_AAD)])
+    pt_off, _ = _offsets([len(_KAT_PT)])
+    got = _raw_seal(lib, _KAT_KEY, _KAT_NONCE, 1, aad_off, _KAT_AAD,
+                    pt_off, _KAT_PT, len(_KAT_PT) + 16)
+    if got != _KAT_CT:
+        raise RuntimeError("native AEAD failed the RFC 8439 KAT probe")
+    payload = b"\x01probe\xff"
+    p_off, _ = _offsets([len(payload)])
+    out = ctypes.create_string_buffer(len(payload) + 10)
+    wrote = lib.otedama_chain_frames(0xC5, 1, bytes([7]), p_off, payload,
+                                     out)
+    if wrote != len(payload) + 10 or out.raw != _py_frame(0xC5, 7, payload):
+        raise RuntimeError("native chain framing failed the CRC probe")
+    return lib
+
+
+def _load() -> ctypes.CDLL | None:
+    """First-call load with rebuild-on-stale; any failure pins the python
+    path for the process (counted, loud, never raised to the caller)."""
+    global _lib, _load_reason, _fallbacks
+    if _lib is not None:
+        return _lib or None
+    with _lock:
+        if _lib is not None:
+            return _lib or None
+        try:
+            if _stale():
+                subprocess.run(["make", "-C", _DIR], check=True,
+                               capture_output=True, text=True)
+                lib = _try_open()
+            else:
+                try:
+                    lib = _try_open()
+                except (OSError, AttributeError, RuntimeError) as first:
+                    # present but unloadable/stale-ABI: one rebuild attempt
+                    log.warning("native library refused (%s) — rebuilding",
+                                first)
+                    subprocess.run(["make", "-C", _DIR], check=True,
+                                   capture_output=True, text=True)
+                    lib = _try_open()
+            _lib = lib
+            log.info("native batch paths live (abi %d)", ABI_VERSION)
+        except (OSError, AttributeError, RuntimeError,
+                subprocess.CalledProcessError, FileNotFoundError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            _load_reason = detail.strip()[:500]
+            _lib = False
+            _fallbacks += 1
+            log.warning(
+                "native batch library unavailable (%s) — python oracle "
+                "paths only", _load_reason)
+    return _lib or None
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def configure(enabled: bool | None = None,
+              aead_min_batch: int | None = None,
+              chainframe_min_batch: int | None = None,
+              tripwire_rate: float | None = None) -> None:
+    global _enabled, _aead_min_batch, _chainframe_min_batch, _tripwire_rate
+    if enabled is not None:
+        _enabled = bool(enabled)
+    if aead_min_batch is not None:
+        _aead_min_batch = max(1, int(aead_min_batch))
+    if chainframe_min_batch is not None:
+        _chainframe_min_batch = max(1, int(chainframe_min_batch))
+    if tripwire_rate is not None:
+        _tripwire_rate = min(1.0, max(0.0, float(tripwire_rate)))
+
+
+def _reset_for_tests() -> None:
+    """Clear counters/trips (NOT the loaded library) between tests."""
+    global _fallbacks, _mismatches, _enabled, _aead_min_batch
+    global _chainframe_min_batch, _tripwire_rate
+    with _lock:
+        for k in _calls:
+            _calls[k] = 0
+        _fallbacks = 0
+        _mismatches = 0
+        for op in _OPS:
+            _tripped[op] = False
+            _trip_acc[op] = 0.0
+            _batch_hist[op] = LatencyHistogram(bounds=_BATCH_BOUNDS)
+    _enabled = True
+    _aead_min_batch = 1
+    _chainframe_min_batch = 32
+    _tripwire_rate = 0.02
+
+
+def _count(op: str, path: str) -> None:
+    with _lock:
+        _calls[(op, path)] += 1
+
+
+def _note_fallback(op: str, reason: str) -> None:
+    global _fallbacks
+    with _lock:
+        _fallbacks += 1
+    log.warning("native %s fell back to python: %s", op, reason)
+
+
+def _trip(op: str, detail: str) -> None:
+    """Tripwire mismatch: the native path disagreed with the oracle.
+    Permanent python fallback for this op — wrong bytes on the wire or
+    disk are strictly worse than slow ones."""
+    global _mismatches
+    with _lock:
+        _mismatches += 1
+        _tripped[op] = True
+    log.error("NATIVE TRIPWIRE: %s output mismatched the python oracle "
+              "(%s) — op permanently degraded to python", op, detail)
+
+
+def _sample(op: str) -> bool:
+    """Deterministic rate-proportional sampling (no RNG: accumulate the
+    rate, verify when it crosses 1)."""
+    with _lock:
+        _trip_acc[op] += _tripwire_rate
+        if _trip_acc[op] >= 1.0:
+            _trip_acc[op] -= 1.0
+            return True
+    return False
+
+
+def _gate(op: str, n: int, min_batch: int):
+    """Common preamble: returns the lib to call, or None → python path."""
+    if not _enabled or _tripped[op] or n < min_batch:
+        _count(op, "python")
+        return None
+    lib = _load()
+    if lib is None:
+        _count(op, "python")
+        return None
+    try:
+        d = faults.hit("native.call", op, faults.DEVICE)
+    except Exception as e:  # injected error/crash: the degrade path
+        _count(op, "python")
+        _note_fallback(op, f"fault injected: {e}")
+        return None
+    if d is not None and d.delay:
+        time.sleep(d.delay)
+    return lib, (d.corrupt if d is not None else False)
+
+
+# -- batch AEAD ---------------------------------------------------------------
+
+def aead_seal_many(key: bytes, nonces: list[bytes], plaintexts: list[bytes],
+                   aads: list[bytes] | None = None) -> list[bytes] | None:
+    """Seal a batch of (nonce, aad, plaintext) records in one native call.
+
+    Returns per-record ``ciphertext || tag`` bytes, or ``None`` when the
+    caller must run the python oracle (disabled, below crossover,
+    library refused, tripped, or fault-injected)."""
+    n = len(plaintexts)
+    gate = _gate("seal", n, _aead_min_batch)
+    if gate is None:
+        return None
+    lib, corrupt = gate
+    if aads is None:
+        aads = [b""] * n
+    pt_lens = [len(p) for p in plaintexts]
+    pt_off, off = _offsets(pt_lens)
+    aad_off, _ = _offsets([len(a) for a in aads])
+    out_len = off[-1] + 16 * n
+    try:
+        raw = _raw_seal(lib, key, b"".join(nonces), n, aad_off,
+                        b"".join(aads), pt_off, b"".join(plaintexts),
+                        out_len)
+    except Exception as e:  # never let a native fault corrupt the stream
+        _count("seal", "python")
+        _note_fallback("seal", f"native call raised: {e}")
+        return None
+    _count("seal", "native")
+    _batch_hist["seal"].observe(n)
+    pos, res = 0, []
+    for ln in pt_lens:
+        res.append(raw[pos:pos + ln + 16])
+        pos += ln + 16
+    if corrupt and res:
+        res[0] = bytes([res[0][0] ^ 0xFF]) + res[0][1:]
+    if _sample("seal"):
+        from otedama_tpu.stratum.noise import aead_encrypt
+        i = (_calls[("seal", "native")] - 1) % n
+        if res[i] != aead_encrypt(key, nonces[i], plaintexts[i], aads[i]):
+            _trip("seal", f"record {i} of {n}")
+            return None
+    return res
+
+
+def aead_open_many(key: bytes, nonces: list[bytes], ciphertexts: list[bytes],
+                   aads: list[bytes] | None = None
+                   ) -> tuple[list[bytes], int] | None:
+    """Open a batch in one native call.  Returns ``(plaintexts, fail)``
+    where ``fail`` is -1 when every tag verified, else the index of the
+    first failing record (earlier records ARE decrypted — the caller
+    advances its nonce counter exactly like the per-op oracle would).
+    ``None`` → run the python oracle."""
+    n = len(ciphertexts)
+    gate = _gate("open", n, _aead_min_batch)
+    if gate is None:
+        return None
+    lib, corrupt = gate
+    if aads is None:
+        aads = [b""] * n
+    ct_lens = [len(c) for c in ciphertexts]
+    if any(ln < 16 for ln in ct_lens):
+        _count("open", "python")
+        return None  # short-ciphertext errors: oracle's exception text
+    ct_off, off = _offsets(ct_lens)
+    aad_off, _ = _offsets([len(a) for a in aads])
+    out = ctypes.create_string_buffer(max(off[-1] - 16 * n, 1))
+    try:
+        fail = int(lib.otedama_aead_open_many(
+            key, b"".join(nonces), n, aad_off, b"".join(aads), ct_off,
+            b"".join(ciphertexts), out))
+    except Exception as e:
+        _count("open", "python")
+        _note_fallback("open", f"native call raised: {e}")
+        return None
+    _count("open", "native")
+    _batch_hist["open"].observe(n)
+    good = n if fail < 0 else fail
+    raw, pos, res = out.raw, 0, []
+    for ln in ct_lens[:good]:
+        res.append(raw[pos:pos + ln - 16])
+        pos += ln - 16
+    if corrupt and res:
+        res[0] = bytes([res[0][0] ^ 0xFF]) + res[0][1:]
+    if good and _sample("open"):
+        from otedama_tpu.stratum.noise import AuthError, aead_decrypt
+        i = (_calls[("open", "native")] - 1) % good
+        try:
+            expect = aead_decrypt(key, nonces[i], ciphertexts[i], aads[i])
+        except AuthError:
+            expect = None
+        if res[i] != expect:
+            _trip("open", f"record {i} of {n}")
+            return None
+    return res, fail
+
+
+# -- batch chain framing ------------------------------------------------------
+
+def chain_frames(magic: int, types: list[int],
+                 payloads: list[bytes]) -> list[bytes] | None:
+    """Frame a drained journal group (magic/type/len/payload/crc32 each)
+    in one native call.  Returns per-record frame bytes, or ``None`` →
+    run the python encoder."""
+    n = len(payloads)
+    gate = _gate("chainframe", n, _chainframe_min_batch)
+    if gate is None:
+        return None
+    lib, corrupt = gate
+    p_lens = [len(p) for p in payloads]
+    p_off, off = _offsets(p_lens)
+    out = ctypes.create_string_buffer(off[-1] + 10 * n)
+    try:
+        wrote = int(lib.otedama_chain_frames(magic, n, bytes(types), p_off,
+                                             b"".join(payloads), out))
+    except Exception as e:
+        _count("chainframe", "python")
+        _note_fallback("chainframe", f"native call raised: {e}")
+        return None
+    if wrote != off[-1] + 10 * n:
+        _count("chainframe", "python")
+        _note_fallback("chainframe", f"short native write ({wrote} bytes)")
+        return None
+    _count("chainframe", "native")
+    _batch_hist["chainframe"].observe(n)
+    raw, pos, res = out.raw, 0, []
+    for ln in p_lens:
+        res.append(raw[pos:pos + ln + 10])
+        pos += ln + 10
+    if corrupt and res:
+        res[0] = res[0][:-1] + bytes([res[0][-1] ^ 0xFF])
+    if _sample("chainframe"):
+        i = (_calls[("chainframe", "native")] - 1) % n
+        if res[i] != _py_frame(magic, types[i], payloads[i]):
+            _trip("chainframe", f"record {i} of {n}")
+            return None
+    return res
+
+
+def snapshot() -> dict:
+    """Plain-data state for ApiServer.sync_native_metrics / app snapshot."""
+    with _lock:
+        calls = {op: {"native": _calls[(op, "native")],
+                      "python": _calls[(op, "python")]} for op in _OPS}
+        snap = {
+            "available": _lib is not None and _lib is not False,
+            "loaded": bool(_lib),
+            "reason": _load_reason,
+            "abi_version": ABI_VERSION,
+            "enabled": _enabled,
+            "calls": calls,
+            "fallbacks": _fallbacks,
+            "tripwire_mismatches": _mismatches,
+            "tripped": dict(_tripped),
+            "min_batch": {"aead": _aead_min_batch,
+                          "chainframe": _chainframe_min_batch},
+            "tripwire_rate": _tripwire_rate,
+        }
+    snap["batch_sizes"] = {op: _batch_hist[op].state() for op in _OPS}
+    return snap

@@ -83,6 +83,12 @@
 #                   batch-size crossover probe; asserts device and host
 #                   verdicts bit-identical (exit 2 otherwise); writes a
 #                   BENCH_VALIDATE json artifact.
+#   native-bench    opt-in native batch-seam bench: ctypes dispatch
+#                   overhead plus seal_many/open_many and chain_frames
+#                   crossover curves vs their python oracles (every
+#                   measured batch byte-verified — exit 2 on mismatch);
+#                   writes a BENCH_NATIVE json artifact pinning the
+#                   native.*_min_batch config defaults.
 #   engine-bench    opt-in live-engine throughput bench: drives the real
 #                   mining engine loop (pipelined dispatch, on-device
 #                   winner selection, share path) on the production
@@ -96,10 +102,24 @@ set -euo pipefail
 cd "$(dirname "$0")"
 tier="${1:-fast}"
 shift || true
+
+# tier-1 pre-step: keep libotedama_native.so fresh so the batch seam's
+# stale-source rebuild never fires mid-test. No compiler is a NOTICE,
+# not a failure — the native tests skip and every caller degrades to
+# its python oracle (that degradation is itself under test).
+native_build() {
+  if command -v "${CXX:-g++}" >/dev/null 2>&1; then
+    make -C otedama_tpu/native >/dev/null
+  else
+    echo "NOTICE: ${CXX:-g++} not found — skipping native build; native" \
+         "batch paths degrade to the python oracles" >&2
+  fi
+}
+
 case "$tier" in
-  fast)  exec python -m pytest tests/ -q "$@" ;;
-  slow)  exec python -m pytest tests/ -q -m slow "$@" ;;
-  all)   exec python -m pytest tests/ -q -m '' "$@" ;;
+  fast)  native_build; exec python -m pytest tests/ -q "$@" ;;
+  slow)  native_build; exec python -m pytest tests/ -q -m slow "$@" ;;
+  all)   native_build; exec python -m pytest tests/ -q -m '' "$@" ;;
   audit) exec python tools/security_audit.py ;;
   stratum-bench)
     exec env JAX_PLATFORMS=cpu python tools/bench_stratum.py \
@@ -154,5 +174,9 @@ case "$tier" in
   chain-bench)
     exec env JAX_PLATFORMS=cpu python tools/bench_chain.py \
       --out "${CHAIN_BENCH_OUT:-BENCH_CHAIN_manual.json}" "$@" ;;
-  *) echo "usage: $0 [fast|slow|all|audit|stratum-bench|stratum-shard-bench|stratum-v2-bench|profit-bench|switch-bench|degrade-bench|engine-bench|validate-bench|sharechain-bench|region-bench|payout-bench|chain-bench] [pytest args...]" >&2; exit 2 ;;
+  native-bench)
+    native_build
+    exec env JAX_PLATFORMS=cpu python tools/bench_native.py \
+      --out "${NATIVE_BENCH_OUT:-BENCH_NATIVE_manual.json}" "$@" ;;
+  *) echo "usage: $0 [fast|slow|all|audit|stratum-bench|stratum-shard-bench|stratum-v2-bench|profit-bench|switch-bench|degrade-bench|engine-bench|validate-bench|sharechain-bench|region-bench|payout-bench|chain-bench|native-bench] [pytest args...]" >&2; exit 2 ;;
 esac

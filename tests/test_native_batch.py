@@ -1,0 +1,412 @@
+"""Bit-identity corpus for the native batch paths (PR 17).
+
+The contract under test: ``utils/native_batch`` may only ever produce
+bytes IDENTICAL to its pure-python oracles (stratum/noise.py AEAD,
+p2p/chainstore._frame), and every degradation — missing/stale library,
+injected fault, tripwire mismatch, below-crossover batch — must land on
+those oracles, loudly counted, never silently wrong:
+
+- RFC 7539/8439 AEAD vector through the native path;
+- randomized seal/open batches vs the python loop, including the
+  nonce-counter state a failed tag leaves behind;
+- oversized-u24 SV2 frames fragmented by ``seal_many`` byte-identical
+  to sequential ``seal()``, reassembled by ``recv_frame_bytes``;
+- chain-frame groups (extend/reorg) byte-identical to the python
+  encoder; a natively-written journal reboots through the existing
+  torn-tail recovery;
+- the ``native.call`` chaos seam: error -> counted fallback,
+  corrupt -> the sampled tripwire catches it and pins python;
+- the V2 FrameConn window path: a whole coalesce window sealed in one
+  native call, decrypted by an ordinary python-path peer.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import random
+import struct
+
+import pytest
+
+from otedama_tpu.p2p import chainstore as cs
+from otedama_tpu.p2p import sharechain as sc
+from otedama_tpu.p2p.chainstore import ChainStore, ChainStoreConfig
+from otedama_tpu.p2p.sharechain import ChainParams, ShareChain
+from otedama_tpu.stratum import noise
+from otedama_tpu.stratum.v2 import FrameConn, pack_frame, parse_frame
+from otedama_tpu.utils import faults
+from otedama_tpu.utils import native_batch as nb
+
+NATIVE = nb.available()
+needs_native = pytest.mark.skipif(
+    not NATIVE, reason="native library unavailable (no compiler?)")
+
+
+@pytest.fixture(autouse=True)
+def _clean_native_state():
+    nb._reset_for_tests()
+    yield
+    nb._reset_for_tests()
+
+
+def _pair() -> tuple[noise.NoiseSession, noise.NoiseSession]:
+    k_ab, k_ba = os.urandom(32), os.urandom(32)
+    a = noise.NoiseSession(noise.CipherState(k_ab), noise.CipherState(k_ba))
+    b = noise.NoiseSession(noise.CipherState(k_ba), noise.CipherState(k_ab))
+    return a, b
+
+
+def _feed(wire: bytes) -> asyncio.StreamReader:
+    reader = asyncio.StreamReader()
+    reader.feed_data(wire)
+    reader.feed_eof()
+    return reader
+
+
+# -- AEAD vectors and batch agreement -----------------------------------------
+
+@needs_native
+def test_rfc8439_aead_vector_native():
+    """The RFC 7539/8439 §2.8.2 vector through the native path — the
+    same KAT that pins the python oracle in tests/test_noise.py."""
+    sealed = nb.aead_seal_many(nb._KAT_KEY, [nb._KAT_NONCE], [nb._KAT_PT],
+                               [nb._KAT_AAD])
+    assert sealed is not None and sealed[0] == nb._KAT_CT
+    opened = nb.aead_open_many(nb._KAT_KEY, [nb._KAT_NONCE], [nb._KAT_CT],
+                               [nb._KAT_AAD])
+    assert opened is not None
+    pts, fail = opened
+    assert fail == -1 and pts[0] == nb._KAT_PT
+
+
+@needs_native
+def test_seal_open_many_match_python_oracle():
+    rng = random.Random(1717)
+    key = bytes(rng.randrange(256) for _ in range(32))
+    sizes = [0, 1, 15, 16, 17, 63, 64, 65, 200, 4096]
+    nonces = [b"\x00" * 4 + struct.pack("<Q", i) for i in range(len(sizes))]
+    pts = [bytes(rng.randrange(256) for _ in range(n)) for n in sizes]
+    aads = [bytes(rng.randrange(256) for _ in range(n % 33)) for n in sizes]
+    sealed = nb.aead_seal_many(key, nonces, pts, aads)
+    assert sealed == [noise.aead_encrypt(key, nc, p, a)
+                      for nc, p, a in zip(nonces, pts, aads)]
+    opened = nb.aead_open_many(key, nonces, sealed, aads)
+    assert opened is not None and opened[1] == -1 and opened[0] == pts
+
+
+@needs_native
+def test_open_many_failure_index_and_partial_decrypt():
+    key = os.urandom(32)
+    nonces = [b"\x00" * 4 + struct.pack("<Q", i) for i in range(5)]
+    pts = [os.urandom(30 + i) for i in range(5)]
+    sealed = nb.aead_seal_many(key, nonces, pts)
+    bad = list(sealed)
+    bad[3] = bad[3][:-1] + bytes([bad[3][-1] ^ 1])
+    res = nb.aead_open_many(key, nonces, bad)
+    assert res is not None
+    pts_out, fail = res
+    assert fail == 3 and pts_out == pts[:3]
+
+
+def test_cipherstate_bit_identity_and_counter_parity():
+    """Native and python-pinned CipherStates produce identical bytes and
+    identical counters over the same op sequence (incl. aad)."""
+    key = os.urandom(32)
+    fast, slow = noise.CipherState(key), noise.CipherState(key)
+    ops = [(os.urandom(50), os.urandom(7)), (b"", b""),
+           (os.urandom(200), b"hdr")]
+    for pt, aad in ops:
+        native_out = fast.encrypt(pt, aad)
+        nb.configure(enabled=False)
+        python_out = slow.encrypt(pt, aad)
+        nb.configure(enabled=True)
+        assert native_out == python_out
+    assert fast.n == slow.n == len(ops)
+
+
+def test_encrypt_many_matches_sequential_and_decrypt_many_state():
+    key = os.urandom(32)
+    chunks = [os.urandom(40 + i) for i in range(6)]
+    batch, seq = noise.CipherState(key), noise.CipherState(key)
+    out_batch = batch.encrypt_many(chunks)
+    nb.configure(enabled=False)
+    out_seq = [seq.encrypt(c) for c in chunks]
+    nb.configure(enabled=True)
+    assert out_batch == out_seq and batch.n == seq.n == len(chunks)
+
+    # tag failure at fragment 4: both paths raise AND leave the counter
+    # exactly where the last verified fragment put it
+    bad = list(out_batch)
+    bad[4] = bad[4][:-1] + bytes([bad[4][-1] ^ 1])
+    rx_native, rx_python = noise.CipherState(key), noise.CipherState(key)
+    with pytest.raises(noise.AuthError):
+        rx_native.decrypt_many(bad)
+    nb.configure(enabled=False)
+    with pytest.raises(noise.AuthError):
+        for c in bad:
+            rx_python.decrypt(c)
+    nb.configure(enabled=True)
+    assert rx_native.n == rx_python.n == 4
+
+
+def test_seal_many_fragmented_u24_frame_bit_identity():
+    """An oversized SV2 frame (u24 payload > one u16 noise message)
+    fragments through seal_many exactly like sequential seal(): same
+    wire bytes, same final nonce counter, reassembled by the peer."""
+    big = pack_frame(0x1E, bytes(range(256)) * 300)   # 76800 B payload
+    small = pack_frame(0x1F, b"after")
+    a1, _ = _pair()
+    k_send, k_recv = a1.send_cipher.k, a1.recv_cipher.k
+    a2 = noise.NoiseSession(noise.CipherState(k_send),
+                            noise.CipherState(k_recv))
+    wire_batch = a1.seal_many([big, small])
+    nb.configure(enabled=False)
+    wire_seq = a2.seal(big) + a2.seal(small)
+    nb.configure(enabled=True)
+    assert wire_batch == wire_seq
+    assert a1.send_cipher.n == a2.send_cipher.n == 3  # 2 fragments + 1
+
+    b = noise.NoiseSession(noise.CipherState(k_recv),
+                           noise.CipherState(k_send))
+
+    async def recv_two():
+        reader = _feed(wire_batch)
+        one = parse_frame(await b.recv_frame_bytes(reader))
+        two = parse_frame(await b.recv_frame_bytes(reader))
+        return one, two
+
+    one, two = asyncio.run(recv_two())
+    assert one == parse_frame(big) and two == parse_frame(small)
+
+
+def test_frameconn_window_seal_one_native_call():
+    """The V2 server send path: frames queued inside one coalesce window
+    are sealed by ONE seal_many call at the flush boundary, and an
+    ordinary python-path peer decrypts the result."""
+    async def run():
+        srv_sess, cli_sess = _pair()
+        received = []
+        done = asyncio.Event()
+
+        async def handler(reader, writer):
+            conn = FrameConn(reader, writer, session=srv_sess,
+                             coalesce=0.003)
+            for i in range(5):
+                conn.send(0x20 + i, b"frame%d" % i)
+            await conn.drain()
+
+        server = await asyncio.start_server(handler, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        nb.configure(enabled=False)  # the peer decrypts pure-python
+        try:
+            for _ in range(5):
+                received.append(parse_frame(
+                    await cli_sess.recv_frame_bytes(reader)))
+        finally:
+            nb.configure(enabled=True)
+            writer.close()
+            server.close()
+            await server.wait_closed()
+        done.set()
+        return received
+
+    received = asyncio.run(run())
+    assert [(m, p) for _e, m, p in received] == [
+        (0x20 + i, b"frame%d" % i) for i in range(5)]
+    if NATIVE:
+        snap = nb.snapshot()
+        assert snap["calls"]["seal"]["native"] >= 1
+        # the window really batched: one call carried multiple frames
+        assert snap["batch_sizes"]["seal"]["sum"] >= 5
+
+
+# -- chain framing ------------------------------------------------------------
+
+@needs_native
+def test_chain_frames_bit_identical_to_python_encoder():
+    rng = random.Random(99)
+    shares = [sc.mine_share(sc.GENESIS, "w", f"j{i}", 1e-9)
+              for i in range(3)]
+    payloads, types = [], []
+    for h, s in enumerate(shares):
+        types.append(cs.REC_EXTEND)
+        payloads.append(cs.encode_extend(h, s, s.share_id, 100 + h))
+    types.append(cs.REC_REORG)
+    payloads.append(cs._REORG.pack(7))
+    for n in (1, 4, 40):  # below/at/above the default crossover
+        nb.configure(chainframe_min_batch=1)
+        ts = (types * ((n // len(types)) + 1))[:n]
+        ps = (payloads * ((n // len(payloads)) + 1))[:n]
+        ps = [p + bytes(rng.randrange(256) for _ in range(rng.randrange(8)))
+              if t == cs.REC_EXTEND and False else p
+              for t, p in zip(ts, ps)]
+        frames = nb.chain_frames(cs._MAGIC, ts, ps)
+        assert frames == [cs._frame(t, p) for t, p in zip(ts, ps)]
+
+
+def test_chainstore_native_journal_reboots_and_survives_torn_tail(tmp_path):
+    """A natively-framed journal is indistinguishable from a python one:
+    same records on replay, same recovery behavior at a torn tail."""
+    nb.configure(chainframe_min_batch=1)  # force native framing per group
+    p = ChainParams(min_difficulty=1e-9, window=8, max_reorg_depth=4,
+                    sync_page=5)
+    native_dir, python_dir = tmp_path / "native", tmp_path / "python"
+    shares = []
+    prev = sc.GENESIS
+    for i in range(10):
+        s = sc.mine_share(prev, "w", f"j{i}", 1e-9)
+        shares.append(s)
+        prev = s.share_id
+
+    def build(path):
+        chain = ShareChain(p, store=ChainStore(ChainStoreConfig(
+            path=str(path), fsync_interval=1, snapshot_interval=100,
+            tail_shares=32, segment_bytes=1 << 20)))
+        for s in shares:
+            chain.connect(s)
+        chain.drain()
+        chain.store.close()
+
+    build(native_dir)
+    nb.configure(enabled=False)
+    build(python_dir)
+    nb.configure(enabled=True, chainframe_min_batch=1)
+
+    def journal_records(path):
+        log = cs.SegmentLog(str(path), "wal", segment_bytes=1 << 20)
+        try:
+            return [(t, p_) for _s, t, p_ in log.iter_from(0)]
+        finally:
+            log.close()
+
+    assert journal_records(native_dir) == journal_records(python_dir)
+
+    # reboot from the natively-written journal
+    chain = ShareChain(p, store=ChainStore(ChainStoreConfig(
+        path=str(native_dir), fsync_interval=1, snapshot_interval=100,
+        tail_shares=32, segment_bytes=1 << 20)))
+    chain.load()
+    assert chain.height == 10 and chain.tip == shares[-1].share_id
+    chain.store.close()
+
+    # torn tail on the native journal: half a frame header appended —
+    # recovery truncates it, every whole record intact
+    seg = sorted(f for f in os.listdir(native_dir)
+                 if f.startswith("wal") and f.endswith(".seg"))[-1]
+    with open(native_dir / seg, "ab") as f:
+        f.write(b"\xc5\x01")
+    log = cs.SegmentLog(str(native_dir), "wal", segment_bytes=1 << 20)
+    assert log.torn_records == 1
+    assert len(list(log.iter_from(0))) == 10
+    log.close()
+
+
+# -- degradation: faults, tripwire, crossover, loader -------------------------
+
+@needs_native
+def test_native_call_error_counts_fallback_not_permanent():
+    key = os.urandom(32)
+    nonces = [b"\x00" * 4 + struct.pack("<Q", i) for i in range(4)]
+    pts = [os.urandom(32)] * 4
+    inj = faults.FaultInjector(seed=3).error("native.call:seal")
+    with faults.active(inj):
+        assert nb.aead_seal_many(key, nonces, pts) is None
+    snap = nb.snapshot()
+    assert snap["fallbacks"] >= 1
+    assert snap["calls"]["seal"]["python"] == 1
+    assert not snap["tripped"]["seal"]  # fault != mismatch: not permanent
+    assert nb.aead_seal_many(key, nonces, pts) is not None
+
+
+@needs_native
+@pytest.mark.parametrize("op", ["seal", "chainframe"])
+def test_tripwire_catches_corrupt_and_pins_python(op):
+    nb.configure(tripwire_rate=1.0, chainframe_min_batch=1)
+    inj = faults.FaultInjector(seed=5).corrupt(f"native.call:{op}")
+
+    def call():
+        if op == "seal":
+            return nb.aead_seal_many(
+                os.urandom(32),
+                [b"\x00" * 4 + struct.pack("<Q", i) for i in range(3)],
+                [os.urandom(20)] * 3)
+        return nb.chain_frames(0xC5, [1, 2], [b"abc", b"de"])
+
+    with faults.active(inj):
+        assert call() is None  # the sampled re-verify caught the mangle
+    snap = nb.snapshot()
+    assert snap["tripwire_mismatches"] == 1 and snap["tripped"][op]
+    assert call() is None  # permanently pinned to python, even fault-free
+
+
+@needs_native
+def test_crossover_gate_keeps_small_batches_python():
+    nb.configure(chainframe_min_batch=8)
+    assert nb.chain_frames(0xC5, [1] * 4, [b"x"] * 4) is None
+    snap = nb.snapshot()
+    assert snap["calls"]["chainframe"] == {"native": 0, "python": 1}
+    assert snap["fallbacks"] == 0  # gating is not a fallback
+
+
+def test_disabled_is_pure_python_and_counted():
+    nb.configure(enabled=False)
+    key = os.urandom(32)
+    assert nb.aead_seal_many(key, [b"\x00" * 12], [b"hi"]) is None
+    a, b = _pair()
+    wire = a.seal_many([pack_frame(1, b"p")])
+
+    async def recv_one():
+        return await b.recv_frame_bytes(_feed(wire))
+
+    got = asyncio.run(recv_one())
+    assert parse_frame(got) == parse_frame(pack_frame(1, b"p"))
+    assert nb.snapshot()["calls"]["seal"]["python"] >= 1
+
+
+def test_abi_version_tag_exported():
+    import ctypes
+
+    if not os.path.exists(nb._LIB_PATH):
+        pytest.skip("no built library")
+    lib = ctypes.CDLL(nb._LIB_PATH)
+    lib.otedama_abi_version.restype = ctypes.c_int32
+    assert int(lib.otedama_abi_version()) == nb.ABI_VERSION
+
+
+def test_config_section_and_validation():
+    from otedama_tpu.config.schema import AppConfig, validate_config
+
+    cfg = AppConfig()
+    assert validate_config(cfg) == []
+    assert cfg.native.enabled and cfg.native.aead_min_batch == 1
+    cfg.native.aead_min_batch = 0
+    cfg.native.chainframe_min_batch = 0
+    cfg.native.tripwire_rate = 1.5
+    errs = "\n".join(validate_config(cfg))
+    assert "native.aead_min_batch" in errs
+    assert "native.chainframe_min_batch" in errs
+    assert "native.tripwire_rate" in errs
+
+
+def test_sync_native_metrics_exports():
+    from otedama_tpu.api.server import ApiServer
+
+    key = os.urandom(32)
+    nb.aead_seal_many(key, [b"\x00" * 12], [b"hi"])  # at least one call
+    api = ApiServer()
+    api.sync_native_metrics(nb.snapshot())
+    text = api.registry.render()
+    assert "otedama_native_calls_total" in text
+    assert "otedama_native_fallbacks_total" in text
+    assert "otedama_native_tripwire_mismatches_total" in text
+    assert "otedama_native_available" in text
+
+
+def test_snapshot_shape_is_json_serializable():
+    snap = nb.snapshot()
+    json.dumps(snap)
+    assert set(snap["calls"]) == {"seal", "open", "chainframe"}
+    assert snap["abi_version"] == nb.ABI_VERSION
