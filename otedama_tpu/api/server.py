@@ -946,6 +946,37 @@ class ApiServer:
                 flushes.count,
                 help_="Group-commit ledger flush latency",
             )
+        # fleet registry (a ledger host serving the TCP share bus):
+        # membership and remote capacity — the first gauges an operator
+        # reads when an acceptor host drops out of the fleet
+        fleet_fn = getattr(server, "fleet_snapshot", None)
+        fleet = (fleet_fn()
+                 if fleet_fn is not None
+                 and getattr(server, "fleet_address", None) is not None
+                 else None)
+        if fleet is not None:
+            reg = self.registry
+            hosts = fleet.get("hosts", {})
+            reg.gauge_set(
+                "otedama_fleet_hosts", len(hosts),
+                help_="Acceptor hosts currently joined to this ledger")
+            reg.gauge_set(
+                "otedama_fleet_remote_workers",
+                fleet.get("remote_workers", 0),
+                help_="Acceptor worker links from remote fleet hosts")
+            reg.counter_set(
+                "otedama_fleet_hosts_joined_total",
+                fleet.get("hosts_joined", 0),
+                help_="Fleet host joins since start")
+            reg.counter_set(
+                "otedama_fleet_hosts_left_total",
+                fleet.get("hosts_left", 0),
+                help_="Fleet host departures (leave or crash) since start")
+            for h, info in hosts.items():
+                reg.gauge_set(
+                    "otedama_fleet_host_workers_alive",
+                    info.get("workers_alive", 0), {"host": str(h)},
+                    help_="Live acceptor workers per fleet host")
 
     def sync_profit_metrics(self, snapshot: dict) -> None:
         """Profit orchestration telemetry from a ProfitOrchestrator

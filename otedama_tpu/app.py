@@ -57,6 +57,7 @@ class Application:
         self.chain = None           # chain client (solo mode)
         self.server = None          # stratum server (pool mode)
         self.server_v2 = None       # stratum V2 server (optional, pool mode)
+        self.fleet = None           # fleet acceptor-host role (stratum/fleet.py)
         self.pool = None            # pool manager
         self.db = None
         self.p2p = None
@@ -223,6 +224,12 @@ class Application:
         # cross-region duplicate check, and its accepted shares would
         # never reach chain accounting
         await self._start_stratum_listeners()
+        if cfg.stratum.enabled and cfg.stratum.fleet_ledger:
+            # acceptor-host role: this node owns NO books — it joins the
+            # fleet ledger named in config, receives its lease slot and
+            # the fleet-wide policy in the welcome handshake, and its
+            # workers feed the ledger's group-commit queue over TCP
+            await self._start_fleet_acceptor()
         if cfg.mining.enabled:
             await self._start_miner_side()
         if cfg.settlement.enabled:
@@ -331,7 +338,7 @@ class Application:
                 noise_static_key=noise_key,
                 noise_certificate=noise_cert,
             )
-        if cfg.stratum.workers > 1:
+        if cfg.stratum.workers > 1 or cfg.stratum.fleet_listen:
             # sharded front-end: N acceptor worker processes share the
             # listening port (SO_REUSEPORT), THIS process stays the
             # single owner of PoolManager/db/settlement and receives
@@ -349,9 +356,14 @@ class Application:
             # feeds the API/metrics surfaces instead).
             from otedama_tpu.stratum.shard import ShardConfig, ShardSupervisor
 
+            # With fleet_listen the supervisor ALSO serves the share bus
+            # over TCP so remote acceptor hosts can join (workers: 0 =
+            # dedicated ledger host — no local miners at all).
             self.server = ShardSupervisor(
                 server_cfg,
-                ShardConfig(workers=cfg.stratum.workers),
+                ShardConfig(workers=cfg.stratum.workers,
+                            fleet_listen=cfg.stratum.fleet_listen,
+                            fleet_host_bits=cfg.stratum.fleet_host_bits),
                 on_share=self.pool.on_share,
                 on_block=self.pool.on_block,
                 # group-commit: the supervisor drains the share bus into
@@ -387,6 +399,22 @@ class Application:
         if self.server_v2 is not None:
             await self.server_v2.start()
             self._started.append(self.server_v2)
+
+    async def _start_fleet_acceptor(self) -> None:
+        from otedama_tpu.stratum.fleet import FleetAcceptor, FleetAcceptorConfig
+
+        cfg = self.config.stratum
+        lhost, _, lport = cfg.fleet_ledger.rpartition(":")
+        self.fleet = FleetAcceptor(FleetAcceptorConfig(
+            ledger_host=lhost or "127.0.0.1",
+            ledger_port=int(lport),
+            workers=max(1, cfg.workers),
+            host=cfg.host,
+            port=cfg.port,
+            v2_port=cfg.v2_port,
+        ))
+        await self.fleet.start()
+        self._started.append(self.fleet)
 
     async def _template_loop(self, chain) -> None:
         """Poll the chain for templates and broadcast jobs (pool mode)."""
@@ -1308,6 +1336,8 @@ class Application:
             out["engine"] = self.engine.snapshot()
         if self.server is not None:
             out["stratum"] = self.server.snapshot()
+        if self.fleet is not None:
+            out["fleet"] = self.fleet.snapshot()
         v2_surface = self._v2_metrics_surface()
         if v2_surface is not None:
             out["stratum_v2"] = v2_surface.snapshot()

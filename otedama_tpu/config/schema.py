@@ -172,6 +172,21 @@ class StratumSettings:
     # hex-encoded NoiseCertificate (the authority's BIP340 endorsement
     # of the static key); empty = no certificate in the handshake
     v2_noise_cert_file: str = ""
+    # fleet topology (stratum/fleet.py): this node ALSO serves the
+    # share bus over TCP at "host:port" so remote acceptor HOSTS can
+    # join its fleet and feed its group-commit ledger. With it set,
+    # workers may be 0 — a dedicated LEDGER host that accepts no
+    # miners itself and spends its core on the chain writer
+    fleet_listen: str = ""
+    # host bits in the [region|host|worker|counter] lease space
+    # (0 = auto: 4 bits -> 15 remote hosts per ledger)
+    fleet_host_bits: int = 0
+    # acceptor-host role: join the fleet ledger at "host:port" instead
+    # of owning a ledger; the welcome handshake hands this host its
+    # lease slot and the fleet-wide policy/secret. Mutually exclusive
+    # with fleet_listen and with pool.enabled (the ledger owns the
+    # books)
+    fleet_ledger: str = ""
 
 
 @dataclasses.dataclass
@@ -583,6 +598,22 @@ def validate_config(cfg: AppConfig) -> list[str]:
             "combines with stratum.workers > 1 or region.enabled (the V2 "
             "channel prefix carries the [region|worker|counter] lease)"
         )
+    if cfg.stratum.fleet_listen and cfg.stratum.fleet_ledger:
+        errors.append(
+            "stratum.fleet_listen and stratum.fleet_ledger are mutually "
+            "exclusive (a node is a ledger host OR an acceptor host)")
+    if cfg.stratum.fleet_ledger and cfg.pool.enabled:
+        errors.append(
+            "stratum.fleet_ledger excludes pool.enabled (the fleet's "
+            "ledger host owns the books; acceptor hosts are stateless)")
+    if cfg.stratum.fleet_ledger and cfg.stratum.workers < 1:
+        errors.append(
+            "stratum.fleet_ledger requires stratum.workers >= 1 (an "
+            "acceptor host exists to run acceptor workers)")
+    if not (0 <= cfg.stratum.fleet_host_bits <= 8):
+        # 8 host bits = 255 remote hosts per ledger; beyond that the
+        # [region|host|worker|counter] space starves the counter field
+        errors.append("stratum.fleet_host_bits out of range (0..8)")
     if not (0 <= cfg.pool.fee_percent < 100):
         errors.append("pool.fee_percent out of range")
     if cfg.pool.pplns_window <= 0:
@@ -777,6 +808,14 @@ stratum:
   v2_noise: false     # Noise-NX encrypted transport for V2
   v2_noise_key_file: ""  # hex X25519 static key (empty = fresh each start)
   v2_noise_cert_file: ""  # hex authority certificate (optional)
+  fleet_listen: ""    # "host:port": ALSO serve the share bus over TCP so
+                      # acceptor HOSTS can join this node's fleet; with it
+                      # set, workers: 0 = dedicated ledger host (no miners,
+                      # the core belongs to the chain writer)
+  fleet_host_bits: 0  # host bits in the [region|host|worker|counter]
+                      # lease space (0 = auto: 4 -> 15 remote hosts)
+  fleet_ledger: ""    # "host:port" of the fleet ledger to JOIN as an
+                      # acceptor host (stateless; excludes pool.enabled)
 
 pool:
   enabled: false

@@ -138,6 +138,12 @@ class PoolManager:
         # template refresh mid-round must not change the split of a block
         # found on the previous job
         self._job_rewards: dict[str, int] = {}
+        # ledger-host accounting: every group-commit flush lands here,
+        # whether its shares came from local workers or remote fleet
+        # hosts — the counters a fleet-wide exactly-once audit compares
+        # client verdicts against (tools/bench_fleet.py)
+        self.ledger_stats = {
+            "batches": 0, "shares_ok": 0, "shares_rejected": 0}
         self._tasks: list[asyncio.Task] = []
 
     # -- job production -----------------------------------------------------
@@ -260,7 +266,7 @@ class PoolManager:
                 else:
                     outcomes[i] = ("err", "share failed validation")
             if not live:
-                return outcomes
+                return self._note_batch(outcomes)
             if len(live) < len(batch):
                 batch_live = [batch[i] for i in live]
             else:
@@ -289,7 +295,7 @@ class PoolManager:
                 if wait is not None:
                     await wait()
         if not live:
-            return outcomes
+            return self._note_batch(outcomes)
         # ledger.flush: THE crash window of the group-commit pipeline —
         # after the batch is on the chain, before its db transaction.
         # A parent dying here loses the db copy but never chain credit:
@@ -302,7 +308,7 @@ class PoolManager:
             msg = str(e) or type(e).__name__
             for i in live:
                 outcomes[i] = ("err", msg)
-            return outcomes
+            return self._note_batch(outcomes)
         if d is not None:
             if d.delay:
                 await asyncio.sleep(d.delay)
@@ -312,7 +318,7 @@ class PoolManager:
                 # from chain state); without a replicator this is a
                 # share the books silently miss, which is exactly the
                 # audit hole chaos runs exist to surface
-                return outcomes
+                return self._note_batch(outcomes)
         try:
             self._flush_db_batch([(i, batch[i]) for i in live], outcomes)
         except Exception as e:
@@ -323,6 +329,16 @@ class PoolManager:
             for i in live:
                 if outcomes[i][0] == "ok":
                     outcomes[i] = ("err", msg)
+        return self._note_batch(outcomes)
+
+    def _note_batch(
+        self, outcomes: list[tuple[str, str]]
+    ) -> list[tuple[str, str]]:
+        st = self.ledger_stats
+        st["batches"] += 1
+        ok = sum(1 for status, _ in outcomes if status == "ok")
+        st["shares_ok"] += ok
+        st["shares_rejected"] += len(outcomes) - ok
         return outcomes
 
     def _flush_db_batch(
@@ -491,6 +507,7 @@ class PoolManager:
             "shares": self.shares.count(),
             "blocks": len(self.blocks.list()),
             "scheme": self.config.payout.scheme.value,
+            "ledger": dict(self.ledger_stats),
         }
         if self.validator is not None:
             snap["validation"] = self.validator.snapshot()

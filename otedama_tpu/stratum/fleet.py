@@ -1,0 +1,409 @@
+"""Fleet acceptor host: remote front-end feeding a ledger host's bus.
+
+stratum/shard.py scales ONE host: N SO_REUSEPORT acceptor workers
+around one parent-owned ledger. This module is the next ring out —
+O(100) acceptor HOSTS per region feeding ONE ledger host (a
+``ShardSupervisor`` with ``ShardConfig.fleet_listen`` set, usually
+``workers=0`` so the chain writer and the group-commit loop own that
+whole process). The primitives generalize, they do not change:
+
+- **Same bus, over TCP.** An acceptor host's workers open TCP links to
+  the ledger's fleet listener and speak the identical frame protocol —
+  binary share frames in, coalesced multi-verdict acks out, JSON
+  control frames for jobs/snapshots/blocks. Persist-before-verdict is
+  unchanged: a worker's accept still awaits the ledger's ack, so a
+  share's verdict implies its commit no matter which host accepted it.
+  Every TCP link sets ``TCP_NODELAY`` — the ``CoalescingWriter`` window
+  already batches frames into one send per window, and Nagle stacked on
+  top would hold those sends hostage to the peer's ack clock.
+
+- **Host-sliced leases.** The ledger assigns each joining host a slot
+  in the ``[region | host | worker | counter]`` lease space
+  (``lease_slice_params`` — ONE function for V1 extranonce1 and V2
+  channel ids), so cross-host leases are disjoint by construction,
+  exactly like worker slices within a host.
+
+- **One fleet policy.** The join handshake (control hello → welcome)
+  hands the acceptor the ledger's worker-spec template: server/vardiff/
+  ddos/V2 config, timeouts, and the shared session secret. A resume
+  token minted by ANY host verifies on EVERY host, so miners of a dead
+  host reconnect anywhere and keep their lease and difficulty.
+
+- **Supervisor-style respawn, generalized.** The acceptor respawns its
+  own dead workers into their slots (same backoff discipline as the
+  single-host supervisor). A worker dying with the HOST crash exit
+  code (the ``host.bus`` fault point's crash action) escalates: the
+  acceptor kills every sibling and exits — whole-machine loss, the
+  failure k8s replaces pods for. The ledger's registry entry dies with
+  the control link; a replacement host joining later is assigned the
+  freed slot.
+
+Crash semantics at each hop: a WORKER death loses only unacked
+verdicts (miners resubmit; committed replays die in the ledger's dedup
+window). A HOST death is all its workers at once — same guarantee,
+wider blast radius. A LEDGER death stops the fleet: acceptors see
+their control link EOF and stop serving, because no one owns the
+books (deployments restart the ledger first; acceptors are stateless
+and rejoin).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import multiprocessing as mp
+import os
+import socket
+import time
+
+from otedama_tpu.stratum.shard import (
+    _HOST_CRASH_EXIT,
+    _WorkerProc,
+    CoalescingWriter,
+    encode_frame,
+    read_frame,
+    set_tcp_nodelay,
+    worker_main,
+)
+
+log = logging.getLogger("otedama.stratum.fleet")
+
+
+@dataclasses.dataclass
+class FleetAcceptorConfig:
+    # the ledger host's fleet TCP bus (ShardConfig.fleet_listen)
+    ledger_host: str = "127.0.0.1"
+    ledger_port: int = 0
+    # acceptor workers on THIS host (SO_REUSEPORT siblings, exactly the
+    # single-host shard model)
+    workers: int = 2
+    # this host's miner-facing bind; port 0 = ephemeral, resolved
+    # before the workers spawn (per-process "hosts" on one sandbox box
+    # each get their own port — in a real fleet every host binds the
+    # same well-known port on its own address)
+    host: str = "127.0.0.1"
+    port: int = 0
+    v2_port: int = 0
+    respawn: bool = True
+    respawn_backoff: float = 0.5      # doubled per consecutive fast death
+    hello_timeout: float = 30.0       # join handshake + worker boot budget
+    snapshot_interval: float = 1.0    # host_snap cadence to the registry
+    # seeded fault plan shipped to FIRST-incarnation workers (e.g. a
+    # host.bus crash rule); respawns always run clean
+    fault_spec: dict | None = None
+    start_method: str = ""
+
+
+class FleetAcceptor:
+    """One acceptor host: joins a ledger's fleet, spawns local workers
+    whose bus links feed the ledger directly, respawns them on death,
+    and pushes registry snapshots over its control link."""
+
+    def __init__(self, config: FleetAcceptorConfig | None = None):
+        self.config = config or FleetAcceptorConfig()
+        self.host_index = 0
+        self.host_bits = 0
+        self.port = 0                  # resolved miner-facing V1 port
+        self.v2_port: int | None = None
+        self.crashed = False           # an injected host death happened
+        self.stats = {"worker_deaths": 0, "worker_respawns": 0}
+        self._procs: dict[int, _WorkerProc] = {}
+        self._reserve: socket.socket | None = None
+        self._v2_reserve: socket.socket | None = None
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._bus: CoalescingWriter | None = None
+        self._tmpl: dict = {}
+        self._worker_bits = 0
+        self._tasks: list[asyncio.Task] = []
+        self._respawns: set[asyncio.Task] = set()
+        self._stopping = False
+        self._ctx = None
+        # set when this host stops serving for ANY reason: injected
+        # host crash, ledger stop/death, or stop(). acceptor_main waits
+        # on it; in-process users may too.
+        self.done = asyncio.Event()
+
+    async def start(self) -> None:
+        cfg = self.config
+        if not hasattr(socket, "SO_REUSEPORT"):  # pragma: no cover
+            raise RuntimeError(
+                "fleet acceptor hosts require SO_REUSEPORT "
+                "(per-worker listening siblings)")
+        self._reader, self._writer = await asyncio.open_connection(
+            cfg.ledger_host, cfg.ledger_port)
+        set_tcp_nodelay(self._writer)
+        self._bus = CoalescingWriter(self._writer, 0.0)
+        self._bus.send(encode_frame({
+            "t": "hello", "kind": "host", "pid": os.getpid(),
+            "workers": int(cfg.workers),
+        }))
+        welcome = await asyncio.wait_for(
+            read_frame(self._reader), cfg.hello_timeout)
+        if (not isinstance(welcome, dict) or welcome.get("t") != "welcome"
+                or welcome.get("error") or "host_index" not in welcome):
+            err = (welcome.get("error") if isinstance(welcome, dict)
+                   else repr(welcome))
+            self._writer.close()
+            raise RuntimeError(f"fleet join refused: {err}")
+        self.host_index = int(welcome["host_index"])
+        self.host_bits = int(welcome["host_bits"])
+        self._tmpl = dict(welcome["spec"])
+        n = max(1, int(cfg.workers))
+        self._worker_bits = (n - 1).bit_length()
+        # pin this host's ports before any worker binds (the shard
+        # supervisor's reserve-socket trick, per host)
+        self._reserve = self._reserve_sock(cfg.host, cfg.port)
+        self.port = self._reserve.getsockname()[1]
+        if self._tmpl.get("v2"):
+            self._v2_reserve = self._reserve_sock(cfg.host, cfg.v2_port)
+            self.v2_port = self._v2_reserve.getsockname()[1]
+        method = cfg.start_method or (
+            "fork" if "fork" in mp.get_all_start_methods() else "spawn")
+        self._ctx = mp.get_context(method)
+        for wid in range(n):
+            self._spawn(wid, fault_spec=cfg.fault_spec)
+        self._tasks = [
+            asyncio.create_task(self._monitor_loop()),
+            asyncio.create_task(self._snap_loop()),
+            asyncio.create_task(self._control_loop()),
+        ]
+        self._push_snap()
+        log.info(
+            "fleet acceptor host %d serving %s:%d (%d workers) -> "
+            "ledger %s:%d", self.host_index, cfg.host, self.port, n,
+            cfg.ledger_host, cfg.ledger_port)
+
+    @staticmethod
+    def _reserve_sock(host: str, port: int) -> socket.socket:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        s.bind((host, port))
+        return s
+
+    def _close_fds(self) -> list[int]:
+        """Acceptor-side fds a forked worker must NOT keep: the control
+        link (a worker holding a duplicate would stop the acceptor's
+        death from EOFing the ledger's registry entry) and the port
+        reserve sockets. No-op under spawn."""
+        fds: list[int] = []
+        sock = (self._writer.get_extra_info("socket")
+                if self._writer is not None else None)
+        if sock is not None:
+            fds.append(sock.fileno())
+        for s in (self._reserve, self._v2_reserve):
+            if s is not None:
+                fds.append(s.fileno())
+        return [fd for fd in fds if isinstance(fd, int) and fd >= 0]
+
+    def _worker_spec(self, wid: int, fault_spec: dict | None) -> dict:
+        """One worker's spec: the ledger's fleet-wide template with
+        this host's fields filled in — lease slice coordinates, the TCP
+        bus address, and this host's listen ports."""
+        cfg = self.config
+        spec = dict(self._tmpl)
+        spec["server"] = dict(spec["server"])
+        spec["worker_id"] = wid
+        spec["worker_bits"] = self._worker_bits
+        spec["host_index"] = self.host_index
+        spec["host_bits"] = self.host_bits
+        spec["bus_tcp"] = [cfg.ledger_host, int(cfg.ledger_port)]
+        spec["host"] = cfg.host
+        spec["port"] = self.port
+        spec["server"]["host"] = cfg.host
+        spec["server"]["port"] = self.port
+        if spec.get("v2"):
+            spec["v2"] = dict(spec["v2"])
+            spec["v2"]["host"] = cfg.host
+            spec["v2"]["port"] = self.v2_port
+        spec["fault_spec"] = fault_spec
+        spec["close_fds"] = self._close_fds()
+        return spec
+
+    def _spawn(self, wid: int, fault_spec: dict | None = None) -> None:
+        prev = self._procs.get(wid)
+        proc = self._ctx.Process(
+            target=worker_main,
+            args=(self._worker_spec(wid, fault_spec),),
+            name=f"fleet-h{self.host_index}-w{wid}",
+            daemon=True,
+        )
+        proc.start()
+        self._procs[wid] = _WorkerProc(
+            proc=proc,
+            spawned_at=time.monotonic(),
+            fast_deaths=prev.fast_deaths if prev else 0,
+        )
+
+    # -- serving loops -------------------------------------------------------
+
+    async def _monitor_loop(self) -> None:
+        while not self._stopping:
+            await asyncio.sleep(0.2)
+            for wid, wp in list(self._procs.items()):
+                if wp.proc.is_alive() or self._stopping:
+                    continue
+                code = wp.proc.exitcode
+                del self._procs[wid]
+                self.stats["worker_deaths"] += 1
+                if code == _HOST_CRASH_EXIT:
+                    # an injected host.bus crash: the whole HOST dies —
+                    # every sibling with it, no goodbye on any link
+                    # (the ledger sees the control link EOF; miners
+                    # token-resume onto surviving hosts)
+                    log.warning(
+                        "fleet host %d: injected host crash (worker %d); "
+                        "killing the whole host", self.host_index, wid)
+                    self._host_crash()
+                    return
+                log.warning(
+                    "fleet host %d: worker %d died (exit %s); respawning",
+                    self.host_index, wid, code)
+                if not self.config.respawn:
+                    continue
+                lived = time.monotonic() - wp.spawned_at
+                fast = wp.fast_deaths + 1 if lived < 5.0 else 0
+                delay = min(self.config.respawn_backoff * (2 ** fast), 10.0)
+                self.stats["worker_respawns"] += 1
+                task = asyncio.create_task(
+                    self._respawn_later(wid, delay, fast))
+                self._respawns.add(task)
+                task.add_done_callback(self._respawns.discard)
+
+    async def _respawn_later(self, wid: int, delay: float,
+                             fast_deaths: int) -> None:
+        await asyncio.sleep(delay)
+        if self._stopping:
+            return
+        # respawns run clean — the chaos plan applies to first
+        # incarnations only (the single-host supervisor's rule)
+        self._spawn(wid, fault_spec=None)
+        self._procs[wid].fast_deaths = fast_deaths
+
+    def _host_crash(self) -> None:
+        self.crashed = True
+        self._stopping = True
+        for wp in self._procs.values():
+            if wp.proc.is_alive():
+                wp.proc.kill()
+        self._procs.clear()
+        if self._writer is not None:
+            self._writer.close()
+        self.done.set()
+
+    def _push_snap(self) -> None:
+        if self._bus is None or self._writer is None:
+            return
+        try:
+            self._bus.send(encode_frame({
+                "t": "host_snap",
+                "host": self.host_index,
+                "port": self.port,
+                "v2_port": self.v2_port,
+                "workers_alive": sum(
+                    1 for wp in self._procs.values() if wp.proc.is_alive()),
+            }))
+        except (ConnectionError, RuntimeError):  # link gone mid-shutdown
+            pass
+
+    async def _snap_loop(self) -> None:
+        while not self._stopping:
+            await asyncio.sleep(float(self.config.snapshot_interval))
+            self._push_snap()
+
+    async def _control_loop(self) -> None:
+        try:
+            while True:
+                msg = await read_frame(self._reader)
+                if isinstance(msg, dict) and msg.get("t") == "stop":
+                    break
+        except (asyncio.IncompleteReadError, ConnectionError, ValueError):
+            pass
+        if self._stopping:
+            return
+        # the ledger stopped (or died): no one owns the books — stop
+        # serving so miners fail over to a fleet that does
+        log.warning("fleet host %d: ledger control link closed; "
+                    "stopping", self.host_index)
+        await self._shutdown(send_bye=False)
+        self.done.set()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def stop(self) -> None:
+        if self._stopping:
+            return
+        await self._shutdown(send_bye=True)
+        self.done.set()
+
+    async def _shutdown(self, send_bye: bool) -> None:
+        self._stopping = True
+        for t in self._tasks:
+            if t is not asyncio.current_task():
+                t.cancel()
+        for t in list(self._respawns):
+            t.cancel()
+        if send_bye and self._bus is not None and self._writer is not None:
+            try:
+                self._bus.send(encode_frame({"t": "bye"}))
+                self._bus.flush()
+                await self._writer.drain()
+            except (ConnectionError, RuntimeError):
+                pass
+        loop = asyncio.get_running_loop()
+        for wp in list(self._procs.values()):
+            wp.proc.terminate()
+            await loop.run_in_executor(None, wp.proc.join, 2.0)
+            if wp.proc.is_alive():  # pragma: no cover - last resort
+                wp.proc.kill()
+        self._procs.clear()
+        if self._writer is not None:
+            self._writer.close()
+        for s in (self._reserve, self._v2_reserve):
+            if s is not None:
+                s.close()
+        self._reserve = self._v2_reserve = None
+        log.info("fleet acceptor host %d stopped", self.host_index)
+
+    def snapshot(self) -> dict:
+        return {
+            "host_index": self.host_index,
+            "host_bits": self.host_bits,
+            "port": self.port,
+            "v2_port": self.v2_port,
+            "workers": {
+                "configured": max(1, int(self.config.workers)),
+                "alive": sum(1 for wp in self._procs.values()
+                             if wp.proc.is_alive()),
+                "deaths": self.stats["worker_deaths"],
+                "respawns": self.stats["worker_respawns"],
+            },
+            "crashed": self.crashed,
+        }
+
+
+async def _acceptor_async(spec: dict) -> int:
+    acc = FleetAcceptor(FleetAcceptorConfig(**spec))
+    await acc.start()
+    await acc.done.wait()
+    if not acc.crashed:
+        await acc.stop()
+    return _HOST_CRASH_EXIT if acc.crashed else 0
+
+
+def acceptor_main(spec: dict) -> None:
+    """Entry point for one acceptor HOST process (tests/benches model a
+    fleet as processes standing in for hosts — the r14 discipline).
+    Must stay a plain top-level function for the spawn start method.
+    Exits with the host crash code when an injected host death fired,
+    so the driving test can tell crash from clean stop."""
+    logging.basicConfig(level=getattr(
+        logging, str(spec.pop("log_level", "WARNING")).upper(),
+        logging.WARNING))
+    try:
+        code = asyncio.run(_acceptor_async(spec))
+    except KeyboardInterrupt:  # pragma: no cover - operator ^C
+        code = 0
+    os._exit(code)

@@ -42,28 +42,45 @@ log = logging.getLogger("otedama.stratum.server")
 
 
 def lease_slice_params(prefix: int | None, worker_index: int,
-                       worker_bits: int) -> tuple[int, int]:
-    """Validate the ``[region byte | worker_index (worker_bits) |
-    counter]`` slice parameters and return ``(counter_bits,
-    slice_base)``. ONE function defines the partitioned lease space for
-    BOTH stratum wires — V1 extranonce1 (`_alloc_extranonce1`) and V2
-    channel ids (`stratum/v2.py _alloc_channel`) — so the slice math
-    can never drift between them."""
+                       worker_bits: int, host_index: int = 0,
+                       host_bits: int = 0) -> tuple[int, int]:
+    """Validate the ``[region byte | host_index (host_bits) |
+    worker_index (worker_bits) | counter]`` slice parameters and return
+    ``(counter_bits, slice_base)``. ONE function defines the
+    partitioned lease space for BOTH stratum wires — V1 extranonce1
+    (`_alloc_extranonce1`) and V2 channel ids (`stratum/v2.py
+    _alloc_channel`) — so the slice math can never drift between them.
+
+    The host field (stratum/fleet.py) sits ABOVE the worker field:
+    acceptor hosts of one fleet partition the space exactly like
+    workers partition one host's, so cross-host leases stay disjoint
+    by construction. ``host_bits = 0`` is the pre-fleet layout —
+    existing leases and resume tokens decode identically."""
     if prefix is not None and not (0 <= prefix <= 0xFF):
         raise ValueError(f"region prefix {prefix} is not a byte")
     space_bits = 24 if prefix is not None else 32
-    counter_bits = space_bits - worker_bits
+    counter_bits = space_bits - host_bits - worker_bits
     if counter_bits < 8:
         raise ValueError(
-            f"worker_bits {worker_bits} leaves {counter_bits} counter "
-            f"bits in the {space_bits}-bit lease space (need >= 8)"
+            f"host_bits {host_bits} + worker_bits {worker_bits} leave "
+            f"{counter_bits} counter bits in the {space_bits}-bit lease "
+            "space (need >= 8)"
+        )
+    if not (0 <= host_index < (1 << host_bits)):
+        # covers host_bits == 0 too: a nonzero host index with no host
+        # field would silently shift out of the lease space
+        raise ValueError(
+            f"host_index {host_index} does not fit host_bits {host_bits}"
         )
     if worker_bits and not (0 <= worker_index < (1 << worker_bits)):
         raise ValueError(
             f"worker_index {worker_index} does not fit "
             f"worker_bits {worker_bits}"
         )
-    return counter_bits, worker_index << counter_bits
+    return counter_bits, (
+        (host_index << (worker_bits + counter_bits))
+        | (worker_index << counter_bits)
+    )
 
 
 def compose_lease(prefix: int | None, lease: int) -> int:
@@ -103,6 +120,14 @@ class ServerConfig:
     # unsharded (the whole counter space belongs to this process).
     worker_index: int = 0
     worker_bits: int = 0
+    # -- fleet front-end (stratum/fleet.py) ----------------------------------
+    # host slice composed ABOVE the worker slice: [region byte |
+    # host_index (host_bits) | worker_index (worker_bits) | counter].
+    # Acceptor hosts of one fleet partition the lease space exactly
+    # like workers partition one host's. host_bits = 0 = single host
+    # (the pre-fleet layout, bit-identical leases).
+    host_index: int = 0
+    host_bits: int = 0
     region_id: int = 0                   # stamped into issued resume tokens
     # deployment-wide HMAC secret for signed session resume tokens
     # (stratum/resume.py); "" disables issuing AND honouring them
@@ -395,7 +420,8 @@ class StratumServer:
             return self.config.extranonce1_factory(session_id)
         prefix = self.config.extranonce1_prefix
         wbits = self.config.worker_bits
-        if prefix is None and wbits == 0:
+        hbits = self.config.host_bits
+        if prefix is None and wbits == 0 and hbits == 0:
             # single front-end, single process: the legacy bare counter
             v = self._next_extranonce1
             self._next_extranonce1 += 1
@@ -414,7 +440,8 @@ class StratumServer:
         # all (the space is saturated, or another allocator is flooding
         # OUR partition: two processes misconfigured with one slice).
         counter_bits, slice_base = lease_slice_params(
-            prefix, self.config.worker_index, wbits)
+            prefix, self.config.worker_index, wbits,
+            self.config.host_index, hbits)
         if self._region_counter is None:
             import secrets
 
@@ -432,6 +459,7 @@ class StratumServer:
                 "session?); skipping", en1.hex())
         raise AssertionError(
             f"no free extranonce1 lease in slice (prefix={prefix} "
+            f"host={self.config.host_index}/{hbits} bits "
             f"worker={self.config.worker_index}/{wbits} bits): the space "
             "is saturated or the slice is not exclusively ours"
         )

@@ -114,6 +114,22 @@ log = logging.getLogger("otedama.stratum.shard")
 # hundreds of bytes; anything near the cap is a protocol bug, not load.
 MAX_FRAME = 8 * 1024 * 1024
 _WORKER_CRASH_EXIT = 17  # exit code of an injected worker.crash
+_HOST_CRASH_EXIT = 23    # injected host.bus crash: the WHOLE host dies
+
+
+def set_tcp_nodelay(writer: asyncio.StreamWriter) -> None:
+    """Disable Nagle on a TCP bus link. The bus already coalesces frames
+    into one send per ``CoalescingWriter`` window — Nagle stacked on top
+    would hold those sends hostage to the peer's ack clock and add RTTs
+    to every verdict, buying nothing the window didn't already buy.
+    No-op for unix sockets (they have no Nagle to disable)."""
+    sock = writer.get_extra_info("socket")
+    if sock is not None and sock.family in (
+            socket.AF_INET, getattr(socket, "AF_INET6", socket.AF_INET)):
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:  # pragma: no cover - exotic transports
+            pass
 
 
 # -- wire helpers -------------------------------------------------------------
@@ -406,6 +422,21 @@ class ShardConfig:
     worker_bits: int = 0
     # unix-socket share-bus directory; "" = private tempdir
     bus_dir: str = ""
+    # -- fleet serving (stratum/fleet.py) ------------------------------------
+    # "host:port" to ALSO serve the share bus over TCP: remote acceptor
+    # hosts' workers feed this supervisor's group-commit queue exactly
+    # like local workers do (same frames, same ack semantics), and
+    # acceptor-host control links join the fleet registry here. Port 0
+    # resolves at bind; "" = single-host (unix-socket bus only).
+    # With fleet_listen set, ``workers`` may be 0: a DEDICATED ledger
+    # host that serves no miners itself — the chain writer and the
+    # ledger loop get the whole process (the r20 ack residue's fix).
+    fleet_listen: str = ""
+    # width of the host field in the [region|host|worker|counter]
+    # lease space; 0 = auto (4 → 15 remote hosts) when fleet_listen is
+    # set, else no host field (the pre-fleet layout). Host index 0 is
+    # the ledger host's own local workers; remote hosts lease 1..2^b-1.
+    fleet_host_bits: int = 0
     respawn: bool = True
     respawn_backoff: float = 0.5      # doubled per consecutive fast death
     snapshot_interval: float = 1.0    # worker stats push cadence
@@ -484,6 +515,11 @@ def worker_main(spec: dict) -> None:
         # OOM-kill would — no goodbye on the bus, sessions cut mid-verdict
         inj.register_crash_handler(
             "worker", lambda: os._exit(_WORKER_CRASH_EXIT))
+        # "crash the host": this worker dies with the host exit code and
+        # its fleet acceptor (stratum/fleet.py) escalates — every
+        # sibling on the host dies too, modeling whole-machine loss
+        inj.register_crash_handler(
+            "host", lambda: os._exit(_HOST_CRASH_EXIT))
         faults.activate(inj)
     profile_dir = os.environ.get("OTEDAMA_SHARD_PROFILE", "")
     try:
@@ -530,7 +566,19 @@ async def _worker_async(spec: dict) -> None:
     from otedama_tpu.security.ddos import DDoSConfig
 
     wid = int(spec["worker_id"])
-    reader, writer = await asyncio.open_unix_connection(spec["bus_path"])
+    hidx = int(spec.get("host_index", 0))
+    hbits = int(spec.get("host_bits", 0))
+    bus_tcp = spec.get("bus_tcp")
+    if bus_tcp:
+        # fleet link: this worker lives on an acceptor HOST and feeds
+        # the ledger host's group-commit queue over TCP — same frames,
+        # same coalescing windows, same ack-awaited verdicts as the
+        # unix-socket bus
+        reader, writer = await asyncio.open_connection(
+            str(bus_tcp[0]), int(bus_tcp[1]))
+        set_tcp_nodelay(writer)
+    else:
+        reader, writer = await asyncio.open_unix_connection(spec["bus_path"])
     loop = asyncio.get_running_loop()
     bus = CoalescingWriter(writer, float(spec.get("bus_coalesce", 0.0)))
     ack_timeout = float(spec["ack_timeout"])
@@ -553,13 +601,18 @@ async def _worker_async(spec: dict) -> None:
         finally:
             pending.pop(s, None)
 
-    async def share_call(accepted: AcceptedShare) -> tuple[str, str]:
+    async def share_call(accepted: AcceptedShare,
+                         dropped: bool = False) -> tuple[str, str]:
         # the binary hot-path twin of bus_call: one struct pack instead
         # of share_to_wire + json.dumps per share
         s = next(seq)
         fut = loop.create_future()
         pending[s] = (fut, loop.time() + ack_timeout)
-        bus.send(encode_share_frame(s, accepted))
+        if not dropped:
+            bus.send(encode_share_frame(s, accepted))
+        # a dropped frame (host.bus drop directive: the fleet link lost
+        # it) still parks here — the ack watchdog times the verdict out,
+        # exactly what a real lost frame costs the miner
         try:
             return await fut
         finally:
@@ -587,7 +640,19 @@ async def _worker_async(spec: dict) -> None:
             d = faults.hit("worker.crash", str(wid), faults.POINT)
             if d is not None and d.delay:
                 await asyncio.sleep(d.delay)
-            status, error = await share_call(accepted)
+            dropped = False
+            if bus_tcp:
+                # the fleet-link seam (docs/FAULT_INJECTION.md
+                # ``host.bus``): drop/delay/crash on this host's TCP
+                # bus link, tag = host index. A crash rule kills this
+                # worker with the HOST exit code and the acceptor
+                # escalates it to whole-host death.
+                hd = faults.hit("host.bus", str(hidx), faults.SEND_ASYNC)
+                if hd is not None:
+                    if hd.delay:
+                        await asyncio.sleep(hd.delay)
+                    dropped = hd.drop
+            status, error = await share_call(accepted, dropped)
             if status == "dup":
                 # the parent's ledger (cross-worker window / chain
                 # index) already has this submission: a policy reject
@@ -619,6 +684,8 @@ async def _worker_async(spec: dict) -> None:
         ddos=DDoSConfig(**spec["ddos"]) if spec.get("ddos") else None,
         worker_index=wid,
         worker_bits=int(spec["worker_bits"]),
+        host_index=hidx,
+        host_bits=hbits,
     )
     server = StratumServer(cfg, on_share=on_share, on_block=on_block)
     await server.start(sock=_worker_listen_socket(spec))
@@ -642,6 +709,8 @@ async def _worker_async(spec: dict) -> None:
                                if v2spec.get("noise_certificate") else None),
             worker_index=wid,
             worker_bits=int(spec["worker_bits"]),
+            host_index=hidx,
+            host_bits=hbits,
         )
         server_v2 = v2mod.Sv2MiningServer(
             v2cfg,
@@ -683,8 +752,10 @@ async def _worker_async(spec: dict) -> None:
     pusher = asyncio.create_task(snapshot_loop())
     watchdog = asyncio.create_task(ack_watchdog())
     # hello AFTER the listener is up: the supervisor treats a hello as
-    # "this worker serves the port now"
-    bus.send(encode_frame({"t": "hello", "worker": wid, "pid": os.getpid()}))
+    # "this worker serves the port now". The host index keys the link
+    # fleet-wide — two hosts' worker 0s are different links.
+    bus.send(encode_frame({"t": "hello", "worker": wid, "pid": os.getpid(),
+                           "host": hidx}))
     try:
         while True:
             msg = await read_frame(reader)
@@ -853,6 +924,8 @@ class ShardSupervisor:
             "worker_deaths": 0,
             "worker_respawns": 0,
             "ledger_flushes": 0,
+            "hosts_joined": 0,
+            "hosts_left": 0,
         }
         # batch-shape observability: how many shares each flush carried
         # and how long the flush took — the knee of the group-commit
@@ -864,8 +937,23 @@ class ShardSupervisor:
         self.jobs: dict[str, Job] = {}
         self.current_job: Job | None = None
         self._current_clean = True
-        self._links: dict[int, _WorkerLink] = {}
+        # worker links are keyed (host_index, worker_id): host 0 is the
+        # supervisor's own local workers, remote acceptor hosts' workers
+        # key under their fleet-assigned index — two hosts' worker 0s
+        # are different links
+        self._links: dict[tuple[int, int], _WorkerLink] = {}
         self._procs: dict[int, _WorkerProc] = {}
+        # fleet registry: host_index -> membership entry (control link,
+        # pid, advertised serving ports, last_seen). Populated by
+        # acceptor-host control hellos on the TCP bus; an entry dies
+        # with its control link (crash semantics: the host is GONE,
+        # its miners token-resume onto survivors).
+        self._fleet_hosts: dict[int, dict] = {}
+        self._fleet_server: asyncio.AbstractServer | None = None
+        # (host, port) the TCP bus actually serves on (fleet_listen
+        # with port 0 resolves at bind)
+        self.fleet_address: tuple[str, int] | None = None
+        self._host_bits = 0
         self._retired_stats: dict = {}
         self._retired_latency = LatencyHistogram()
         self._retired_v2_stats: dict = {}
@@ -902,8 +990,13 @@ class ShardSupervisor:
 
     async def start(self) -> None:
         shard = self.shard
-        n = max(1, int(shard.workers))
-        self._worker_bits = shard.worker_bits or (n - 1).bit_length()
+        fleet = bool(shard.fleet_listen)
+        # workers == 0 is legal ONLY as a dedicated ledger host: no
+        # local acceptors, every share arrives over the fleet TCP bus,
+        # and the chain writer + ledger loop own this whole process
+        n = int(shard.workers) if fleet else max(1, int(shard.workers))
+        self._worker_bits = shard.worker_bits or max(0, n - 1).bit_length()
+        self._host_bits = shard.fleet_host_bits or (4 if fleet else 0)
         if not self.config.session_secret:
             # without a shared secret, a worker crash would cost every
             # one of its miners their tuned difficulty and nonce lease.
@@ -940,7 +1033,18 @@ class ShardSupervisor:
         self._bus = await asyncio.start_unix_server(
             self._handle_bus_conn, path=bus_path)
         self._bus_path = bus_path
-        self._resolve_listener()
+        if fleet:
+            # the SAME bus, served over TCP: remote acceptor hosts'
+            # workers and control links speak the identical frame
+            # protocol into the identical handler — the ledger loop
+            # cannot tell a fleet share from a local one
+            fhost, _, fport = shard.fleet_listen.rpartition(":")
+            self._fleet_server = await asyncio.start_server(
+                self._handle_bus_conn, fhost or "127.0.0.1", int(fport))
+            sockname = self._fleet_server.sockets[0].getsockname()
+            self.fleet_address = (sockname[0], sockname[1])
+        if n > 0:
+            self._resolve_listener()
         method = shard.start_method or (
             "fork" if "fork" in mp.get_all_start_methods() else "spawn")
         if self._listen_sock is not None and method != "fork":
@@ -954,6 +1058,7 @@ class ShardSupervisor:
                 "cannot carry the socket"
             )
         self._ctx = mp.get_context(method)
+        self._local_workers = n
         for wid in range(n):
             self._spawn(wid, fault_spec=shard.fault_spec)
         await self._await_hellos(n)
@@ -1013,6 +1118,10 @@ class ShardSupervisor:
         spec = {
             "worker_id": wid,
             "worker_bits": self._worker_bits,
+            # local workers are host 0 of the fleet lease space (the
+            # ledger host's own acceptors); host_bits 0 = no fleet
+            "host_index": 0,
+            "host_bits": self._host_bits,
             "bus_path": self._bus_path,
             "host": cfg.host,
             "port": cfg.port,
@@ -1084,9 +1193,9 @@ class ShardSupervisor:
 
     async def _await_hellos(self, n: int) -> None:
         deadline = time.monotonic() + self.shard.hello_timeout
-        while len(self._links) < n:
+        while sum(1 for h, _ in self._links if h == 0) < n:
             for wid, wp in self._procs.items():
-                if not wp.proc.is_alive() and wid not in self._links:
+                if not wp.proc.is_alive() and (0, wid) not in self._links:
                     raise RuntimeError(
                         f"shard worker {wid} died during startup "
                         f"(exit {wp.proc.exitcode})"
@@ -1126,6 +1235,14 @@ class ShardSupervisor:
                 link.bus.flush()
             except Exception:
                 pass
+        # fleet hosts get the same stop: the acceptor kills its workers
+        # and exits — nobody owns the ledger once this process stops
+        for entry in list(self._fleet_hosts.values()):
+            try:
+                entry["link"].send({"t": "stop"})
+                entry["link"].bus.flush()
+            except Exception:
+                pass
         loop = asyncio.get_running_loop()
         for wp in self._procs.values():
             await loop.run_in_executor(None, wp.proc.join, 5.0)
@@ -1139,6 +1256,13 @@ class ShardSupervisor:
             self._bus.close()
             await self._bus.wait_closed()
             self._bus = None
+        if self._fleet_server is not None:
+            self._fleet_server.close()
+            await self._fleet_server.wait_closed()
+            self._fleet_server = None
+        for entry in list(self._fleet_hosts.values()):
+            entry["link"].writer.close()
+        self._fleet_hosts.clear()
         for link in list(self._links.values()):
             self._fold_link(link)
             link.writer.close()
@@ -1177,7 +1301,7 @@ class ShardSupervisor:
                 log.warning(
                     "shard worker %d died (exit %s); miners will resume "
                     "on survivors", wid, wp.proc.exitcode)
-                link = self._links.pop(wid, None)
+                link = self._links.pop((0, wid), None)
                 if link is not None:
                     self._fold_link(link)
                     link.writer.close()
@@ -1207,6 +1331,7 @@ class ShardSupervisor:
 
     async def _handle_bus_conn(self, reader: asyncio.StreamReader,
                                writer: asyncio.StreamWriter) -> None:
+        set_tcp_nodelay(writer)
         try:
             hello = await asyncio.wait_for(
                 read_frame(reader), self.shard.hello_timeout)
@@ -1214,12 +1339,17 @@ class ShardSupervisor:
                 ValueError, ConnectionError):
             writer.close()
             return
-        if hello.get("t") != "hello":
+        if not isinstance(hello, dict) or hello.get("t") != "hello":
             writer.close()
             return
+        if hello.get("kind") == "host":
+            # an acceptor host's CONTROL link: membership, not shares
+            await self._handle_host_conn(reader, writer, hello)
+            return
         wid = int(hello["worker"])
+        key = (int(hello.get("host", 0)), wid)
         link = _WorkerLink(wid, writer, self.shard.bus_coalesce_seconds)
-        self._links[wid] = link
+        self._links[key] = link
         if self.current_job is not None:
             link.send({
                 "t": "job",
@@ -1249,11 +1379,135 @@ class ShardSupervisor:
                 KeyError):
             pass
         finally:
-            if self._links.get(wid) is link:
-                del self._links[wid]
+            if self._links.get(key) is link:
+                del self._links[key]
             self._fold_link(link)
             link.bus.flush()
             writer.close()
+
+    # -- fleet membership (acceptor-host control links) -----------------------
+
+    def _host_spec_template(self) -> dict:
+        """The worker-spec template an acceptor host builds ITS workers
+        from. The fleet serves ONE policy — server/vardiff/ddos/V2
+        config, the shared session secret, timeouts, the coalescing
+        window — dictated by the ledger host, so a miner's difficulty,
+        resume token, and DDoS treatment are identical on every host
+        (and a token minted by a dead host verifies on every survivor).
+        The acceptor overrides the per-host fields: listen host/port,
+        worker ids/bits, its assigned host index, and its fault plan."""
+        tmpl = self._worker_spec(0, None)
+        for k in ("worker_id", "listen_fd", "close_fds", "fault_spec"):
+            tmpl.pop(k, None)
+        return tmpl
+
+    async def _handle_host_conn(self, reader: asyncio.StreamReader,
+                                writer: asyncio.StreamWriter,
+                                hello: dict) -> None:
+        """One acceptor host's control link: assign it a free host slot,
+        hand it the fleet's worker-spec template, and hold the registry
+        entry until the link dies. Crash semantics: the entry (and the
+        slot) die with the link — the host's workers EOF off the bus on
+        their own, and its miners token-resume onto surviving hosts
+        because every host serves the same session secret."""
+        cap = 1 << self._host_bits
+        # remote hosts lease indices 1..cap-1; 0 is the ledger host's
+        # own local workers
+        hidx = next((i for i in range(1, cap)
+                     if i not in self._fleet_hosts), 0)
+        if hidx == 0:
+            # no fleet serving configured, or every slot taken: refuse
+            # LOUDLY — silently sharing a host slice would merge two
+            # hosts' nonce spaces
+            writer.write(encode_frame({
+                "t": "welcome",
+                "error": ("fleet host slots exhausted "
+                          f"(host_bits={self._host_bits})"
+                          if self._host_bits else
+                          "fleet serving disabled (no fleet_listen)"),
+            }))
+            try:
+                await writer.drain()
+            except (ConnectionError, RuntimeError):
+                pass
+            writer.close()
+            return
+        link = _WorkerLink(hidx, writer, self.shard.bus_coalesce_seconds)
+        entry = {
+            "pid": int(hello.get("pid", 0)),
+            "workers": int(hello.get("workers", 0)),
+            "workers_alive": None,
+            "joined_at": time.time(),
+            "last_seen": time.time(),
+            "port": None,
+            "v2_port": None,
+            "link": link,
+        }
+        self._fleet_hosts[hidx] = entry
+        self.stats["hosts_joined"] += 1
+        log.info("fleet host %d joined (%d workers, pid %s)",
+                 hidx, entry["workers"], entry["pid"])
+        link.send({
+            "t": "welcome",
+            "host_index": hidx,
+            "host_bits": self._host_bits,
+            "spec": self._host_spec_template(),
+        })
+        try:
+            while True:
+                msg = await read_frame(reader)
+                if not isinstance(msg, dict):
+                    continue
+                t = msg.get("t")
+                if t == "host_snap":
+                    entry["last_seen"] = time.time()
+                    for k in ("port", "v2_port", "workers_alive"):
+                        if k in msg:
+                            entry[k] = msg[k]
+                elif t == "bye":
+                    break
+                else:
+                    log.warning("fleet host %d: unknown control frame %r",
+                                hidx, t)
+        except (asyncio.IncompleteReadError, ConnectionError, ValueError,
+                KeyError):
+            pass
+        finally:
+            if self._fleet_hosts.get(hidx) is entry:
+                del self._fleet_hosts[hidx]
+                if not self._stopping:
+                    self.stats["hosts_left"] += 1
+                    log.warning("fleet host %d left; its miners resume "
+                                "on survivors", hidx)
+            link.bus.flush()
+            writer.close()
+
+    def fleet_snapshot(self) -> dict:
+        """Fleet registry view: live membership, each host's advertised
+        serving ports and live worker links, and join/leave counters
+        (`/metrics`: otedama_fleet_hosts / otedama_fleet_remote_workers
+        and the joined/left counters)."""
+        hosts = {}
+        for h, e in sorted(self._fleet_hosts.items()):
+            hosts[str(h)] = {
+                "pid": e["pid"],
+                "workers": e["workers"],
+                "workers_alive": e["workers_alive"],
+                "port": e["port"],
+                "v2_port": e["v2_port"],
+                "joined_at": e["joined_at"],
+                "last_seen": e["last_seen"],
+                "links": sum(1 for hh, _ in self._links if hh == h),
+            }
+        return {
+            "listen": (list(self.fleet_address)
+                       if self.fleet_address else None),
+            "host_bits": self._host_bits,
+            "hosts": hosts,
+            "hosts_joined": self.stats["hosts_joined"],
+            "hosts_left": self.stats["hosts_left"],
+            "remote_workers": sum(1 for h, _ in self._links if h != 0),
+        }
 
     # -- the group-commit ledger loop ----------------------------------------
 
@@ -1563,14 +1817,16 @@ class ShardSupervisor:
         if self.v2_config is not None:
             merged["v2"] = self.v2_snapshot()
         sessions = 0
-        per_worker: dict[int, dict] = {}
-        for wid, link in sorted(self._links.items()):
+        per_worker: dict = {}
+        for (host, wid), link in sorted(self._links.items()):
             snap = link.last_snap
             if snap is None:
                 continue
             merge_counters(merged, snap.get("stats", {}))
             sessions += int(snap.get("sessions", 0))
-            per_worker[wid] = {
+            # local workers keep their bare integer key (the pre-fleet
+            # shape); remote hosts' workers key as "h<host>w<worker>"
+            per_worker[wid if host == 0 else f"h{host}w{wid}"] = {
                 "sessions": snap.get("sessions", 0),
                 "shares_valid": snap.get("stats", {}).get("shares_valid", 0),
             }
@@ -1581,7 +1837,8 @@ class ShardSupervisor:
                             if self.current_job else None),
             "accept_latency": self.latency.snapshot(),
             "workers": {
-                "configured": max(1, int(self.shard.workers)),
+                "configured": getattr(
+                    self, "_local_workers", max(1, int(self.shard.workers))),
                 "alive": sum(
                     1 for wp in self._procs.values() if wp.proc.is_alive()),
                 "deaths": self.stats["worker_deaths"],
@@ -1592,6 +1849,9 @@ class ShardSupervisor:
                 "shares_committed", "duplicates_refused", "share_errors",
                 "blocks_relayed", "block_errors",
             )},
+            "fleet": (self.fleet_snapshot()
+                      if (self.fleet_address is not None
+                          or self._fleet_hosts) else None),
             "ledger": {
                 "flushes": self.stats["ledger_flushes"],
                 # batch size is a SHARE COUNT distribution: raw units,

@@ -795,6 +795,11 @@ class Sv2ServerConfig:
     # worker_bits = 0 disables worker slicing (single process)
     worker_index: int = 0
     worker_bits: int = 0
+    # fleet host slice above the worker slice (stratum/fleet.py):
+    # [region byte | host | worker | counter]; host_bits = 0 = single
+    # host (pre-fleet layout)
+    host_index: int = 0
+    host_bits: int = 0
     region_id: int = 0                 # stamped into issued resume tokens
     # shared HMAC secret for signed channel-resume tokens
     # (stratum/resume.py); "" disables resume
@@ -1175,8 +1180,9 @@ class Sv2MiningServer:
         cfg = self.config
         prefix = cfg.extranonce_prefix_byte
         wbits = cfg.worker_bits
+        hbits = cfg.host_bits
         width = cfg.extranonce2_size
-        if prefix is None and wbits == 0:
+        if prefix is None and wbits == 0 and hbits == 0:
             # single front-end, single process: the legacy counter —
             # but the liveness check still applies: with resume
             # enabled, a post-restart counter can walk into a channel
@@ -1195,13 +1201,13 @@ class Sv2MiningServer:
         if width < 4:
             raise ValueError(
                 f"extranonce2_size {width} cannot carry the 32-bit "
-                "[region|worker|counter] channel lease (need >= 4)"
+                "[region|host|worker|counter] channel lease (need >= 4)"
             )
         # ONE definition of the slice math, shared with V1's
         # _alloc_extranonce1 (stratum/server.py) — the two allocators
         # partition the same space and must never drift
         counter_bits, slice_base = lease_slice_params(
-            prefix, cfg.worker_index, wbits)
+            prefix, cfg.worker_index, wbits, cfg.host_index, hbits)
         if self._chan_counter is None:
             self._chan_counter = secrets.randbits(counter_bits)
         for _ in range(4096):
@@ -1221,6 +1227,7 @@ class Sv2MiningServer:
                 "channel?); skipping", cid)
         raise AssertionError(
             f"no free sv2 channel lease in slice (prefix={prefix} "
+            f"host={cfg.host_index}/{hbits} bits "
             f"worker={cfg.worker_index}/{wbits} bits): the space is "
             "saturated or the slice is not exclusively ours"
         )

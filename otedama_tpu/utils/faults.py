@@ -51,6 +51,10 @@ Fault point registry (grep for ``faults.hit`` to verify):
     region.handoff                              (stratum/server.py resume verification; tag session id)
     validation.verify                           (runtime/validate.py device verdict; tag algorithm)
     worker.crash                                (stratum/shard.py worker share-forward; tag worker id)
+    host.bus                                    (stratum/shard.py worker share-forward on FLEET
+                                                 (TCP) bus links only; tag host index; drop/delay
+                                                 shape the link, crash kills the whole acceptor
+                                                 host via stratum/fleet.py escalation)
     pool.submitter.submit                       (pool/submitter.py retry loop)
     pool.failover.check                         (pool/failover.py; tag pool name)
     profit.feed                                 (profit/feeds.py fetch; tag feed name)

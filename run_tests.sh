@@ -174,9 +174,12 @@ case "$tier" in
   chain-bench)
     exec env JAX_PLATFORMS=cpu python tools/bench_chain.py \
       --out "${CHAIN_BENCH_OUT:-BENCH_CHAIN_manual.json}" "$@" ;;
+  fleet-bench)
+    exec env JAX_PLATFORMS=cpu python tools/bench_fleet.py \
+      --out "${FLEET_BENCH_OUT:-BENCH_FLEET_manual.json}" "$@" ;;
   native-bench)
     native_build
     exec env JAX_PLATFORMS=cpu python tools/bench_native.py \
       --out "${NATIVE_BENCH_OUT:-BENCH_NATIVE_manual.json}" "$@" ;;
-  *) echo "usage: $0 [fast|slow|all|audit|stratum-bench|stratum-shard-bench|stratum-v2-bench|profit-bench|switch-bench|degrade-bench|engine-bench|validate-bench|sharechain-bench|region-bench|payout-bench|chain-bench|native-bench] [pytest args...]" >&2; exit 2 ;;
+  *) echo "usage: $0 [fast|slow|all|audit|stratum-bench|stratum-shard-bench|stratum-v2-bench|profit-bench|switch-bench|degrade-bench|engine-bench|validate-bench|sharechain-bench|region-bench|payout-bench|chain-bench|fleet-bench|native-bench] [pytest args...]" >&2; exit 2 ;;
 esac

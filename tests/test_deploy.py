@@ -35,6 +35,21 @@ def _rendered_series() -> list[str]:
         latency_buckets = {0.005: 1, 0.05: 2}
 
     api.sync_client_metrics(_Client())
+
+    class _FleetLedger:
+        # a ledger host serving the TCP share bus: the fleet-registry
+        # gauges the fleet alert group selects on ride this sync path
+        fleet_address = ("127.0.0.1", 3335)
+
+        def fleet_snapshot(self):
+            return {
+                "hosts": {"1": {"workers_alive": 2}},
+                "remote_workers": 2,
+                "hosts_joined": 1,
+                "hosts_left": 1,
+            }
+
+    api.sync_pool_server_metrics(server=_FleetLedger())
     api.registry.gauge_set("otedama_uptime_seconds", 1.0)
     api.registry.gauge_set("otedama_memory_usage_bytes", 1.0)
     api.registry.gauge_set("otedama_cpu_usage_percent", 1.0)

@@ -1,0 +1,603 @@
+"""Fleet front-end tests (stratum/fleet.py + host-sliced leases).
+
+Covers the host-widened ``[region | host | worker | counter]`` lease
+space (disjointness across every axis, saturation assertion, pre-fleet
+backward compatibility for leases AND resume tokens), the TCP share
+bus (TCP_NODELAY set, the CoalescingWriter window still amortizing to
+~1 transport write per window over TCP), fleet membership (join /
+welcome / refuse-when-full / registry teardown on link death), live
+end-to-end exact accounting with a REAL acceptor-host process feeding
+the ledger over TCP, cross-host token resume, and the ``host.bus``
+chaos scenario: an injected crash kills a whole acceptor host
+mid-traffic, its miners token-resume onto survivors, and every share
+stays in the books exactly once.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import multiprocessing as mp
+import socket
+import struct
+
+import pytest
+
+from otedama_tpu.engine import jobs as jobmod
+from otedama_tpu.engine.types import Job
+from otedama_tpu.kernels import target as tgt
+from otedama_tpu.stratum import protocol as sp
+from otedama_tpu.stratum import resume as session_resume
+from otedama_tpu.stratum.fleet import acceptor_main
+from otedama_tpu.stratum.server import (
+    ServerConfig,
+    Session,
+    StratumServer,
+    compose_lease,
+    lease_slice_params,
+)
+from otedama_tpu.stratum.shard import (
+    _HOST_CRASH_EXIT,
+    CoalescingWriter,
+    ShardConfig,
+    ShardSupervisor,
+    encode_frame,
+    read_frame,
+    set_tcp_nodelay,
+)
+from otedama_tpu.utils.sha256_host import sha256d
+
+EASY = 1e-7
+
+
+def make_job(job_id: str = "fj1") -> Job:
+    return Job(
+        job_id=job_id,
+        prev_hash=bytes(32),
+        coinb1=bytes.fromhex("01000000010000000000000000"),
+        coinb2=bytes.fromhex("ffffffff0100f2052a01000000"),
+        merkle_branch=[bytes(range(32))],
+        version=0x20000000,
+        nbits=0x1D00FFFF,
+        ntime=1_700_000_000,
+        clean=True,
+        algorithm="sha256d",
+    )
+
+
+def mine(job: Job, en1: bytes, en2: bytes, difficulty: float = EASY) -> int:
+    target = tgt.difficulty_to_target(difficulty)
+    j = dataclasses.replace(job, extranonce1=en1)
+    prefix = jobmod.build_header_prefix(j, en2)
+    for nonce in range(1 << 22):
+        if tgt.hash_meets_target(
+                sha256d(prefix + struct.pack(">I", nonce)), target):
+            return nonce
+    raise AssertionError("unlucky premine")
+
+
+# -- host-widened lease slices ------------------------------------------------
+
+
+def test_host_slice_layout_and_prefleet_identity():
+    # host_bits=0 is bit-identical to the pre-fleet layout
+    assert lease_slice_params(None, 3, 2) == lease_slice_params(
+        None, 3, 2, 0, 0)
+    assert lease_slice_params(7, 1, 3) == lease_slice_params(7, 1, 3, 0, 0)
+    # the host field sits ABOVE the worker field
+    cb, base = lease_slice_params(None, 1, 2, 5, 4)
+    assert cb == 32 - 4 - 2
+    assert base == (5 << (2 + cb)) | (1 << cb)
+    # under a region prefix the space is 24-bit
+    cb, base = lease_slice_params(7, 1, 2, 5, 4)
+    assert cb == 24 - 4 - 2
+    assert compose_lease(7, base | 1) >> 24 == 7
+
+
+def test_host_slices_disjoint_across_region_host_worker():
+    servers = [
+        StratumServer(ServerConfig(
+            extranonce1_prefix=region, host_index=host, host_bits=2,
+            worker_index=worker, worker_bits=2))
+        for region in (None, 7)
+        for host in (0, 1, 3)
+        for worker in (0, 2)
+    ]
+    leased = [
+        {s._alloc_extranonce1(i) for i in range(200)} for s in servers
+    ]
+    for i, a in enumerate(leased):
+        assert len(a) == 200
+        for b in leased[i + 1:]:
+            assert not (a & b), "leases overlap across (region,host,worker)"
+    # and the host/worker fields actually land where the layout says
+    s = StratumServer(ServerConfig(
+        host_index=3, host_bits=2, worker_index=2, worker_bits=2))
+    for i in range(50):
+        v = int.from_bytes(s._alloc_extranonce1(i), "big")
+        assert v >> 30 == 3 and (v >> 28) & 0x3 == 2
+
+
+def test_host_slice_saturation_asserts():
+    # region prefix + 8 host bits + 8 worker bits leaves an 8-bit
+    # counter: occupy all 256 leases with live sessions and the scan
+    # must refuse loudly, never silently re-lease a live nonce space
+    s = StratumServer(ServerConfig(
+        extranonce1_prefix=1, host_index=3, host_bits=8,
+        worker_index=9, worker_bits=8))
+    for i in range(256):
+        lease = (3 << 16) | (9 << 8) | i
+        s.sessions[i] = Session(
+            id=i, peer="t",
+            extranonce1=compose_lease(1, lease).to_bytes(4, "big"),
+            extranonce2_size=4, writer=None,
+        )
+    with pytest.raises(AssertionError):
+        s._alloc_extranonce1(1000)
+    assert s.stats["extranonce_collisions"] >= 256
+
+
+def test_host_bits_floor_and_fit_refused():
+    # host+worker bits starving the 8-bit counter floor
+    with pytest.raises(ValueError):
+        lease_slice_params(1, 0, 9, 0, 8)
+    # host index that does not fit its bits
+    with pytest.raises(ValueError):
+        lease_slice_params(None, 0, 2, 16, 4)
+    # a nonzero host index with NO host field must refuse, not shift
+    # silently out of the lease space
+    with pytest.raises(ValueError):
+        lease_slice_params(None, 0, 2, 1, 0)
+
+
+@pytest.mark.asyncio
+async def test_prefleet_token_resumes_on_fleet_server():
+    """A resume token minted before the fleet existed (no host bits in
+    its lease) must still parse and recover its session on a
+    host-sliced server — tokens carry the lease as opaque bytes, so
+    widening the allocator must not orphan live miners mid-upgrade."""
+    secret = "fleet-upgrade-secret"
+    server = StratumServer(ServerConfig(
+        port=0, initial_difficulty=EASY, session_secret=secret,
+        host_index=2, host_bits=4, worker_index=1, worker_bits=2))
+    await server.start()
+    try:
+        prefleet_en1 = struct.pack(">I", 0x00000007)  # legacy bare counter
+        token = session_resume.issue_token(secret, 0, prefleet_en1, EASY)
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", server.port)
+        writer.write(sp.encode_line(sp.Message(
+            id=1, method="mining.subscribe", params=["old-miner", token])))
+        await writer.drain()
+        while True:
+            m = sp.decode_line(await asyncio.wait_for(reader.readline(), 10))
+            if m.is_response and m.id == 1:
+                break
+        assert bytes.fromhex(m.result[1]) == prefleet_en1
+        assert server.stats["resumes_accepted"] == 1
+        writer.close()
+    finally:
+        await server.stop()
+
+
+# -- TCP bus: NODELAY + coalescing amortization (satellite) -------------------
+
+
+@pytest.mark.asyncio
+async def test_tcp_bus_nodelay_and_window_amortization():
+    """The 3 ms coalescing window was tuned on unix sockets; over TCP
+    it must still amortize to ~1 transport write (syscall) per window —
+    with TCP_NODELAY set so Nagle cannot stack extra RTTs on top."""
+    async def sink(reader, writer):
+        while await reader.read(65536):
+            pass
+
+    srv = await asyncio.start_server(sink, "127.0.0.1", 0)
+    port = srv.sockets[0].getsockname()[1]
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    try:
+        set_tcp_nodelay(writer)
+        sock = writer.get_extra_info("socket")
+        assert sock.getsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY) == 1
+
+        writes: list[int] = []
+        real_write = writer.write
+
+        def counting_write(data: bytes):
+            writes.append(len(data))
+            return real_write(data)
+
+        writer.write = counting_write
+        bus = CoalescingWriter(writer, 0.003)
+        frame = encode_frame({"t": "share", "seq": 1, "pad": "x" * 40})
+        bursts, per_burst = 4, 100
+        for _ in range(bursts):
+            for _ in range(per_burst):
+                bus.send(frame)
+            await asyncio.sleep(0.008)  # let the window fire
+        bus.flush()
+        await writer.drain()
+        assert sum(writes) == bursts * per_burst * len(frame)
+        # ~1 write per window: 4 windows of 100 frames each must come
+        # nowhere near 400 transport writes
+        assert len(writes) <= 2 * bursts, (
+            f"{len(writes)} transport writes for {bursts} windows — "
+            "the coalescing window is not amortizing over TCP")
+        assert max(writes) >= per_burst * len(frame)
+    finally:
+        writer.close()
+        srv.close()
+        await srv.wait_closed()
+
+
+# -- fleet membership ---------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_fleet_join_welcome_and_slot_exhaustion():
+    """The join handshake assigns host slots 1..2^bits-1 and hands out
+    the fleet's worker-spec template; with every slot taken the ledger
+    refuses LOUDLY (a silently shared slot would merge nonce spaces)."""
+    sup = ShardSupervisor(
+        ServerConfig(port=0, initial_difficulty=EASY, max_clients=16),
+        ShardConfig(workers=1, fleet_listen="127.0.0.1:0",
+                    fleet_host_bits=1),  # exactly ONE remote slot
+    )
+    await sup.start()
+    try:
+        host, port = sup.fleet_address
+
+        async def join():
+            r, w = await asyncio.open_connection(host, port)
+            w.write(encode_frame(
+                {"t": "hello", "kind": "host", "workers": 2, "pid": 1}))
+            await w.drain()
+            return r, w, await asyncio.wait_for(read_frame(r), 10)
+
+        r1, w1, welcome = await join()
+        assert welcome["t"] == "welcome" and welcome["host_index"] == 1
+        assert welcome["host_bits"] == 1
+        spec = welcome["spec"]
+        # the template carries the fleet-wide policy: ONE secret for
+        # cross-host token resume, and no per-host fields
+        assert spec["server"]["session_secret"]
+        assert "worker_id" not in spec and "fault_spec" not in spec
+        assert sup.fleet_snapshot()["hosts_joined"] == 1
+
+        r2, w2, refused = await join()
+        assert refused.get("error"), "a full fleet must refuse, not share"
+        w2.close()
+
+        # the registry entry dies with the control link
+        w1.close()
+        for _ in range(100):
+            if not sup.fleet_snapshot()["hosts"]:
+                break
+            await asyncio.sleep(0.05)
+        snap = sup.fleet_snapshot()
+        assert not snap["hosts"] and snap["hosts_left"] == 1
+    finally:
+        await sup.stop()
+
+
+# -- live fleet ---------------------------------------------------------------
+
+
+class _MinerConn:
+    """Raw-wire test miner with resume-token handoff (the shard test's
+    miner, plus a mutable port so a dead HOST's miner can fail over to
+    a survivor host's address)."""
+
+    def __init__(self, ident: int, port: int):
+        self.ident = ident
+        self.port = port
+        self.reader = None
+        self.writer = None
+        self.extranonce1 = b""
+        self.token = ""
+        self.reconnects = 0
+        self.resumed_all = True
+        self._msg_id = 100
+
+    async def connect(self) -> None:
+        last: Exception | None = None
+        for _ in range(60):
+            try:
+                await self._handshake()
+                return
+            except (OSError, ConnectionError, asyncio.TimeoutError) as e:
+                last = e
+                if self.writer is not None:
+                    self.writer.close()
+                await asyncio.sleep(0.25)
+        raise ConnectionError(f"no worker ever accepted: {last}")
+
+    async def _handshake(self) -> None:
+        self.reader, self.writer = await asyncio.open_connection(
+            "127.0.0.1", self.port)
+        params = [f"miner-{self.ident}"]
+        if self.token:
+            params.append(self.token)
+        sub = await self.call("mining.subscribe", params)
+        en1 = bytes.fromhex(sub.result[1])
+        if self.token and self.extranonce1 and en1 != self.extranonce1:
+            self.resumed_all = False
+        self.extranonce1 = en1
+        if len(sub.result) > 3:
+            self.token = str(sub.result[3])
+        await self.call("mining.authorize", [f"w.{self.ident}", "x"])
+
+    async def call(self, method: str, params: list) -> sp.Message:
+        self._msg_id += 1
+        mid = self._msg_id
+        self.writer.write(sp.encode_line(
+            sp.Message(id=mid, method=method, params=params)))
+        await self.writer.drain()
+        while True:
+            line = await asyncio.wait_for(self.reader.readline(), 30)
+            if not line:
+                raise ConnectionError("server closed")
+            m = sp.decode_line(line)
+            if m.method == "mining.set_resume_token" and m.params:
+                self.token = str(m.params[0])
+            if m.is_response and m.id == mid:
+                return m
+
+    def close(self) -> None:
+        if self.writer is not None:
+            self.writer.close()
+
+
+async def _submit(m: _MinerConn, job: Job, en2: bytes, nonce: int):
+    return await m.call("mining.submit", [
+        f"w.{m.ident}", job.job_id, en2.hex(),
+        f"{job.ntime:08x}", f"{nonce:08x}",
+    ])
+
+
+def _spawn_acceptor(fleet_addr: tuple[str, int], workers: int = 2,
+                    fault_spec: dict | None = None) -> mp.Process:
+    ctx = mp.get_context(
+        "fork" if "fork" in mp.get_all_start_methods() else "spawn")
+    spec = {
+        "ledger_host": fleet_addr[0], "ledger_port": fleet_addr[1],
+        "workers": workers, "snapshot_interval": 0.2,
+        "respawn_backoff": 0.1,
+    }
+    if fault_spec is not None:
+        spec["fault_spec"] = fault_spec
+    # NOT daemonic: the acceptor spawns its own worker children
+    proc = ctx.Process(target=acceptor_main, args=(spec,))
+    proc.start()
+    return proc
+
+
+async def _await_host_port(sup: ShardSupervisor, hidx: int = 1,
+                           timeout: float = 20.0) -> int:
+    """Wait for the acceptor's registry entry to advertise its port."""
+    for _ in range(int(timeout / 0.05)):
+        entry = sup.fleet_snapshot()["hosts"].get(str(hidx))
+        if entry and entry["port"]:
+            return int(entry["port"])
+        await asyncio.sleep(0.05)
+    raise AssertionError(f"fleet host {hidx} never advertised a port")
+
+
+@pytest.mark.asyncio
+async def test_fleet_exact_accounting_remote_and_local():
+    """Tentpole proof at test scale: a REAL acceptor-host process joins
+    the ledger over TCP, its workers' shares feed the same group-commit
+    queue as the ledger's local worker, leases are disjoint across
+    hosts by construction, a miner of the remote host hands off onto
+    the ledger host with its token (cross-host resume), its replay dies
+    at the ledger's dedup window, and every share lands exactly once."""
+    hooked = []
+
+    async def on_share(s):
+        hooked.append(s)
+
+    sup = ShardSupervisor(
+        ServerConfig(port=0, initial_difficulty=EASY, max_clients=64),
+        ShardConfig(workers=1, snapshot_interval=0.2,
+                    fleet_listen="127.0.0.1:0"),
+        on_share=on_share,
+    )
+    await sup.start()
+    proc = None
+    try:
+        job = make_job()
+        sup.set_job(job)
+        proc = _spawn_acceptor(sup.fleet_address, workers=2)
+        aport = await _await_host_port(sup)
+
+        remote = [_MinerConn(i, aport) for i in range(4)]
+        local = [_MinerConn(10 + i, sup.port) for i in range(2)]
+        for m in remote + local:
+            await m.connect()
+        # leases disjoint fleet-wide; the host field says which host
+        leases = {m.extranonce1 for m in remote + local}
+        assert len(leases) == 6
+        hbits = sup.fleet_snapshot()["host_bits"]
+        assert all(int.from_bytes(m.extranonce1, "big") >> (32 - hbits) == 1
+                   for m in remote)
+        assert all(int.from_bytes(m.extranonce1, "big") >> (32 - hbits) == 0
+                   for m in local)
+
+        for i, m in enumerate(remote + local):
+            en2 = struct.pack(">I", i)
+            r = await _submit(m, job, en2, mine(job, m.extranonce1, en2))
+            assert r.result is True
+
+        # cross-host token handoff: a remote miner "loses" its host and
+        # reconnects to the LEDGER host's local worker — same secret,
+        # so the token recovers the lease there; its replay then dies
+        # at the ledger dedup window, a fresh share still lands
+        m = remote[0]
+        en1 = m.extranonce1
+        en2 = struct.pack(">I", 0)
+        nonce = mine(job, en1, en2)
+        m.close()
+        m.port = sup.port
+        await m.connect()
+        assert m.extranonce1 == en1, "token must carry the lease across hosts"
+        r = await _submit(m, job, en2, nonce)
+        assert r.error and r.error[0] == sp.ERR_DUPLICATE
+        en2b = struct.pack(">I", 0x77)
+        r = await _submit(m, job, en2b, mine(job, en1, en2b))
+        assert r.result is True
+
+        headers = [s.header for s in hooked]
+        assert len(headers) == len(set(headers)) == 7
+
+        await asyncio.sleep(0.5)
+        snap = sup.snapshot()
+        assert snap["bus"]["shares_committed"] == 7
+        assert snap["bus"]["duplicates_refused"] == 1
+        fleet = snap["fleet"]
+        assert fleet["hosts_joined"] == 1 and fleet["remote_workers"] == 2
+        assert fleet["hosts"]["1"]["workers_alive"] == 2
+        # remote worker links show up in the per-worker view under
+        # their fleet key
+        assert any(str(k).startswith("h1w")
+                   for k in snap["workers"]["per_worker"])
+        for m in remote + local:
+            m.close()
+    finally:
+        if proc is not None:
+            proc.terminate()
+            proc.join(5)
+        await sup.stop()
+
+
+@pytest.mark.asyncio
+async def test_host_bus_crash_chaos_miners_resume_on_survivors():
+    """The fleet chaos scenario (seeded ``host.bus`` plan): the 4th
+    share forwarded over the acceptor host's fleet link kills the WHOLE
+    host — every worker at once, no goodbye on any link. Its miners
+    fail over to the surviving ledger host, token-resume their leases,
+    and retry; at the end every logical share is in the books exactly
+    once and the registry recorded the host's death."""
+    hooked = []
+
+    async def on_share(s):
+        hooked.append(s)
+
+    sup = ShardSupervisor(
+        ServerConfig(port=0, initial_difficulty=EASY, max_clients=64),
+        ShardConfig(workers=1, snapshot_interval=0.2,
+                    fleet_listen="127.0.0.1:0"),
+        on_share=on_share,
+    )
+    await sup.start()
+    proc = None
+    try:
+        job = make_job()
+        sup.set_job(job)
+        proc = _spawn_acceptor(
+            sup.fleet_address, workers=2,
+            fault_spec={"seed": 7, "rules": [{
+                "point": "host.bus:*", "action": "crash",
+                "component": "host", "every_nth": 4, "max_fires": 1,
+            }]})
+        aport = await _await_host_port(sup)
+
+        miners = [_MinerConn(i, aport) for i in range(6)]
+        for m in miners:
+            await m.connect()
+
+        async def drive(m: _MinerConn) -> tuple[int, int]:
+            accepted = dup_rejected = 0
+            for i in range(4):
+                en2 = struct.pack(">I", (m.ident << 8) | i)
+                nonce = mine(job, m.extranonce1, en2)
+                for _ in range(8):
+                    try:
+                        r = await _submit(m, job, en2, nonce)
+                    except (ConnectionError, asyncio.TimeoutError, OSError):
+                        # the whole host is gone: fail over to the
+                        # surviving ledger host (in production: the LB /
+                        # DNS pool of acceptor addresses)
+                        m.reconnects += 1
+                        m.port = sup.port
+                        await m.connect()
+                        continue
+                    if r.result is True:
+                        accepted += 1
+                    elif r.error and r.error[0] == sp.ERR_DUPLICATE:
+                        # verdict lost in the crash but the commit
+                        # landed: exactly-once holds, the reject is the
+                        # correct second answer
+                        dup_rejected += 1
+                    else:
+                        raise AssertionError(f"unexpected verdict {r}")
+                    break
+                else:
+                    raise AssertionError("share never got a verdict")
+            return accepted, dup_rejected
+
+        results = await asyncio.gather(*[drive(m) for m in miners])
+        accepted = sum(a for a, _ in results)
+        dup_rejected = sum(d for _, d in results)
+
+        headers = [s.header for s in hooked]
+        assert len(headers) == len(set(headers)), "double-committed share"
+        assert accepted + dup_rejected == 24
+        assert len(hooked) == 24, f"{len(hooked)} committed != 24 submitted"
+        assert sum(m.reconnects for m in miners) >= 1, "the plan never bit"
+        assert all(m.resumed_all for m in miners), (
+            "a failover lost its lease")
+
+        proc.join(15)
+        assert proc.exitcode == _HOST_CRASH_EXIT
+        for _ in range(100):
+            if sup.fleet_snapshot()["hosts_left"] >= 1:
+                break
+            await asyncio.sleep(0.05)
+        fleet = sup.fleet_snapshot()
+        assert fleet["hosts_left"] == 1 and not fleet["hosts"]
+        assert fleet["remote_workers"] == 0
+        for m in miners:
+            m.close()
+    finally:
+        if proc is not None:
+            proc.terminate()
+            proc.join(5)
+        await sup.stop()
+
+
+@pytest.mark.asyncio
+async def test_dedicated_ledger_host_workers_zero():
+    """``workers: 0`` + ``fleet_listen``: a DEDICATED ledger host — no
+    local acceptors at all, every share arrives over the fleet TCP bus
+    (the r20 residue's fix: the chain writer owns this process)."""
+    hooked = []
+
+    async def on_share(s):
+        hooked.append(s)
+
+    sup = ShardSupervisor(
+        ServerConfig(port=0, initial_difficulty=EASY, max_clients=64),
+        ShardConfig(workers=0, snapshot_interval=0.2,
+                    fleet_listen="127.0.0.1:0"),
+        on_share=on_share,
+    )
+    await sup.start()
+    proc = None
+    try:
+        job = make_job()
+        sup.set_job(job)
+        assert sup.snapshot()["workers"]["configured"] == 0
+        proc = _spawn_acceptor(sup.fleet_address, workers=2)
+        aport = await _await_host_port(sup)
+        m = _MinerConn(0, aport)
+        await m.connect()
+        en2 = struct.pack(">I", 5)
+        r = await _submit(m, job, en2, mine(job, m.extranonce1, en2))
+        assert r.result is True
+        assert len(hooked) == 1
+        m.close()
+    finally:
+        if proc is not None:
+            proc.terminate()
+            proc.join(5)
+        await sup.stop()
