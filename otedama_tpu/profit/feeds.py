@@ -40,7 +40,7 @@ from otedama_tpu.utils import faults
 log = logging.getLogger("otedama.profit.feeds")
 
 # profit.feed supports every transport failure a price API can exhibit
-FEED_ACTIONS = frozenset({"error", "crash", "delay", "drop", "corrupt"})
+FEED_ACTIONS = faults.FEED
 
 
 class MarketFeed:

@@ -1,0 +1,32 @@
+"""Digital twin: seeded scenario model + protocol drivers + the
+composed deployment harness (see ARCHITECTURE.md, "Digital twin")."""
+
+from otedama_tpu.sim.drivers import V1Conn, V2Conn
+from otedama_tpu.sim.scenario import (
+    ChaosEvent,
+    MinerSpec,
+    Population,
+    build_population,
+    default_chaos,
+    distinct_points,
+    host_fault_spec,
+    parent_injector,
+    validate_chaos,
+)
+from otedama_tpu.sim.twin import DigitalTwin, TwinConfig
+
+__all__ = [
+    "ChaosEvent",
+    "DigitalTwin",
+    "MinerSpec",
+    "Population",
+    "TwinConfig",
+    "V1Conn",
+    "V2Conn",
+    "build_population",
+    "default_chaos",
+    "distinct_points",
+    "host_fault_spec",
+    "parent_injector",
+    "validate_chaos",
+]

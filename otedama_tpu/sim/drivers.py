@@ -1,0 +1,449 @@
+"""Miner drivers for the digital twin: raw-wire V1 and V2 clients.
+
+Both drivers are the load-generating half of the twin's exactly-once
+contract: they record, per submitted share, the submission tag the
+chain will carry (``submission_id(header)``), and classify every
+verdict into the three buckets the audit compares —
+
+- ``accepted``: the books must show this share exactly once;
+- ``dup_landed``: the verdict was lost to chaos (dead socket, dropped
+  write, crashed host) but the RETRY came back ``duplicate`` — the
+  commit landed, exactly-once holds, the share counts as in the books;
+- refused (``replays_refused`` / ``corrupt_refused``): Byzantine input
+  the books must NOT show.
+
+Failure handling mirrors tests/test_fleet.py's chaos miner: any
+transport death mid-call rotates to the next port in the failover list
+and reconnects with the signed resume token, so a whole-host crash
+becomes a token handoff onto a survivor, never lost accounting.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import struct
+
+from otedama_tpu.engine import jobs as jobmod
+from otedama_tpu.engine.types import Job
+from otedama_tpu.kernels import target as tgt
+from otedama_tpu.pool.regions import submission_id
+from otedama_tpu.sim.scenario import MinerSpec
+from otedama_tpu.stratum import protocol as sp
+from otedama_tpu.stratum import v2 as v2mod
+from otedama_tpu.utils.sha256_host import sha256d
+
+CALL_TIMEOUT = 5.0
+
+
+def mine_nonce(job: Job, extranonce1: bytes, en2: bytes,
+               difficulty: float) -> int:
+    """Scan nonces until one meets ``difficulty`` for this work."""
+    target = tgt.difficulty_to_target(difficulty)
+    j = dataclasses.replace(job, extranonce1=extranonce1)
+    prefix = jobmod.build_header_prefix(j, en2)
+    for nonce in range(1 << 24):
+        if tgt.hash_meets_target(
+                sha256d(prefix + struct.pack(">I", nonce)), target):
+            return nonce
+    raise AssertionError("no share in 2^24 nonces")
+
+
+def v1_header(job: Job, en1: bytes, en2: bytes, nonce: int) -> bytes:
+    return jobmod.header_from_share(
+        dataclasses.replace(job, extranonce1=en1), en2, job.ntime, nonce)
+
+
+def share_tag(header: bytes) -> str:
+    return submission_id(header).hex()[:24]
+
+
+class V1Conn:
+    """Raw-wire Stratum V1 driver with token failover across a port
+    rotation (acceptor host -> ledger host -> region B, as configured
+    by the twin per miner's home region)."""
+
+    def __init__(self, spec: MinerSpec, ports: list[int]):
+        self.spec = spec
+        self.ports = ports            # failover rotation; twin may append
+        self._pi = 0
+        self.reader: asyncio.StreamReader | None = None
+        self.writer: asyncio.StreamWriter | None = None
+        self.extranonce1 = b""
+        self.token = ""
+        self.reconnects = 0
+        self.resumed_all = True       # every token resume kept the lease
+        self.accepted: list[str] = []
+        self.dup_landed: list[str] = []
+        self.replays_refused = 0
+        self.corrupt_refused = 0
+        self.submitted: list[str] = []    # every tag offered (audit bound)
+        self.latencies: list[float] = []
+        self._msg_id = 100
+
+    @property
+    def port(self) -> int:
+        return self.ports[self._pi % len(self.ports)]
+
+    def rotate(self) -> None:
+        self._pi = (self._pi + 1) % len(self.ports)
+
+    async def connect(self) -> None:
+        # lease-sticky resume: a reconnect racing the server's session
+        # reaper gets REFUSED a resume (live-collision scan) and minted
+        # a fresh extranonce — which would silently change the header of
+        # any in-flight retry and unlink it from the dedup index. Keep
+        # presenting the ORIGINAL token until the old lease is freed.
+        want = self.extranonce1 if self.token else b""
+        token0 = self.token
+        last: Exception | None = None
+        for attempt in range(60):
+            try:
+                await self._handshake()
+            except (OSError, ConnectionError, EOFError,
+                    asyncio.TimeoutError) as e:
+                last = e
+                if self.writer is not None:
+                    self.writer.close()
+                self.rotate()
+                await asyncio.sleep(0.15)
+                continue
+            if not want or self.extranonce1 == want:
+                return
+            if attempt >= 30:
+                self.resumed_all = False    # lease genuinely gone
+                return
+            self.writer.close()
+            self.extranonce1 = want
+            self.token = token0
+            await asyncio.sleep(0.1)
+        raise ConnectionError(
+            f"miner {self.spec.ident} never connected: {last}")
+
+    async def _handshake(self) -> None:
+        # drop any abandoned transport FIRST: a socket left open (e.g.
+        # after a verdict-read timeout) keeps the server-side session
+        # alive, and its live lease blocks every resume of our token
+        if self.writer is not None:
+            self.writer.close()
+        self.reader, self.writer = await asyncio.open_connection(
+            "127.0.0.1", self.port)
+        params = [f"twin-{self.spec.ident}"]
+        if self.token:
+            params.append(self.token)
+        sub = await self.call("mining.subscribe", params)
+        self.extranonce1 = bytes.fromhex(sub.result[1])
+        if len(sub.result) > 3:
+            self.token = str(sub.result[3])
+        await self.call("mining.authorize", [self.spec.worker, "x"])
+
+    async def call(self, method: str, params: list) -> sp.Message:
+        self._msg_id += 1
+        mid = self._msg_id
+        self.writer.write(sp.encode_line(
+            sp.Message(id=mid, method=method, params=params)))
+        await self.writer.drain()
+        while True:
+            line = await asyncio.wait_for(
+                self.reader.readline(), CALL_TIMEOUT)
+            if not line:
+                raise ConnectionError("server closed")
+            m = sp.decode_line(line)
+            if m.method == "mining.set_resume_token" and m.params:
+                self.token = str(m.params[0])
+            if m.is_response and m.id == mid:
+                return m
+
+    async def reconnect(self) -> None:
+        """Churn: drop the socket, token-resume (possibly elsewhere)."""
+        if self.writer is not None:
+            self.writer.close()
+        self.reconnects += 1
+        await self.connect()
+
+    async def submit(self, job: Job, en2: bytes, nonce: int) -> str:
+        """Submit until a verdict lands, failing over on dead sockets.
+        Returns "accepted" | "dup" | "rejected" and books the tag."""
+        header = v1_header(job, self.extranonce1, en2, nonce)
+        tag = share_tag(header)
+        self.submitted.append(tag)
+        loop = asyncio.get_running_loop()
+        for _ in range(10):
+            t0 = loop.time()
+            try:
+                r = await self.call("mining.submit", [
+                    self.spec.worker, job.job_id, en2.hex(),
+                    f"{job.ntime:08x}", f"{nonce:08x}"])
+            except (ConnectionError, EOFError, asyncio.TimeoutError, OSError):
+                # flaky link or dead host: token-resume on the rotation.
+                # The lease survives the handoff so the SAME header is
+                # retried — a lost verdict surfaces as "duplicate".
+                self.reconnects += 1
+                self.rotate()
+                await self.connect()
+                continue
+            self.latencies.append(loop.time() - t0)
+            if r.result is True:
+                self.accepted.append(tag)
+                return "accepted"
+            if r.error and r.error[0] == sp.ERR_DUPLICATE:
+                self.dup_landed.append(tag)
+                return "dup"
+            self.submitted.pop()      # refused: not a candidate for books
+            return "rejected"
+        raise AssertionError(
+            f"miner {self.spec.ident}: share never got a verdict")
+
+    async def replay(self, job: Job, en2: bytes, nonce: int) -> bool:
+        """Byzantine replay of an already-accepted share; True when the
+        dedup index refused it (the only correct outcome)."""
+        try:
+            r = await self.call("mining.submit", [
+                self.spec.worker, job.job_id, en2.hex(),
+                f"{job.ntime:08x}", f"{nonce:08x}"])
+        except (ConnectionError, EOFError, asyncio.TimeoutError, OSError):
+            self.reconnects += 1
+            await self.connect()
+            return False
+        refused = r.result is not True
+        if refused:
+            self.replays_refused += 1
+        return refused
+
+    async def submit_corrupt(self, job: Job, en2: bytes, nonce: int) -> bool:
+        """Byzantine garbage: a nonce that misses the target. True when
+        refused (never booked). Garbage is never committed, so blind
+        resubmission through flaky links is safe."""
+        for _ in range(10):
+            try:
+                r = await self.call("mining.submit", [
+                    self.spec.worker, job.job_id, en2.hex(),
+                    f"{job.ntime:08x}", f"{nonce:08x}"])
+            except (ConnectionError, EOFError,
+                    asyncio.TimeoutError, OSError):
+                self.reconnects += 1
+                await self.connect()
+                continue
+            refused = r.result is not True
+            if refused:
+                self.corrupt_refused += 1
+            return refused
+        return False
+
+    def close(self) -> None:
+        if self.writer is not None:
+            self.writer.close()
+
+
+class V2Conn:
+    """Raw-wire Stratum V2 driver (standard channel, cleartext) with
+    resume-token capture and cross-host failover via ResumeChannel."""
+
+    def __init__(self, spec: MinerSpec, ports: list[int]):
+        self.spec = spec
+        self.ports = ports
+        self._pi = 0
+        self.reader: asyncio.StreamReader | None = None
+        self.writer: asyncio.StreamWriter | None = None
+        self.channel_id = 0
+        self.en2 = b""
+        self.target = 0
+        self.job_id = 0
+        self.ntime = 0
+        self.version = 0
+        self.token = ""
+        self.reconnects = 0
+        self.resumed_all = True
+        self.accepted: list[str] = []
+        self.dup_landed: list[str] = []
+        self.replays_refused = 0
+        self.submitted: list[str] = []
+        self.latencies: list[float] = []
+        self.errors: list[str] = []
+        self._seq = 0
+        self._job: Job | None = None
+
+    @property
+    def port(self) -> int:
+        return self.ports[self._pi % len(self.ports)]
+
+    def rotate(self) -> None:
+        self._pi = (self._pi + 1) % len(self.ports)
+
+    async def _read_frame(self):
+        return await asyncio.wait_for(
+            v2mod.read_frame(self.reader), CALL_TIMEOUT)
+
+    def _send(self, msg_type: int, payload: bytes) -> None:
+        self.writer.write(v2mod.pack_frame(msg_type, payload))
+
+    async def connect(self, job: Job) -> None:
+        # lease-sticky resume (the V1Conn.connect rule): a resume
+        # refused by the live-collision check mints a fresh channel
+        # prefix, changing every retried header. Re-present the ORIGINAL
+        # token until the drained channel is reaped and the prefix comes
+        # back.
+        want = self.en2 if self.token else b""
+        token0 = self.token
+        last: Exception | None = None
+        for attempt in range(60):
+            try:
+                await self._open(job)
+            except (OSError, ConnectionError, EOFError,
+                    asyncio.TimeoutError) as e:
+                last = e
+                if self.writer is not None:
+                    self.writer.close()
+                self.rotate()
+                await asyncio.sleep(0.15)
+                continue
+            if not want or self.en2 == want:
+                return
+            if attempt >= 30:
+                self.resumed_all = False    # lease genuinely gone
+                return
+            self.writer.close()
+            self.en2 = want
+            self.token = token0
+            await asyncio.sleep(0.1)
+        raise ConnectionError(
+            f"v2 miner {self.spec.ident} never connected: {last}")
+
+    async def _open(self, job: Job) -> None:
+        self._job = job
+        # the V1Conn._handshake rule: close any abandoned transport so
+        # the server reaps the old channel before we present its token
+        if self.writer is not None:
+            self.writer.close()
+        self.reader, self.writer = await asyncio.open_connection(
+            "127.0.0.1", self.port)
+        self._send(v2mod.MSG_SETUP_CONNECTION,
+                   v2mod.SetupConnection().encode())
+        await self.writer.drain()
+        _, mtype, _payload = await self._read_frame()
+        if mtype != v2mod.MSG_SETUP_CONNECTION_SUCCESS:
+            raise ConnectionError(f"sv2 setup rejected: 0x{mtype:02x}")
+        if self.token:
+            # token handoff: reopen the SAME channel state elsewhere
+            self._send(v2mod.MSG_RESUME_CHANNEL, v2mod.ResumeChannel(
+                request_id=1, user_identity=self.spec.worker,
+                token=self.token).encode())
+        else:
+            self._send(v2mod.MSG_OPEN_STANDARD_MINING_CHANNEL,
+                       v2mod.OpenStandardMiningChannel(
+                           request_id=1,
+                           user_identity=self.spec.worker).encode())
+        await self.writer.drain()
+        self.channel_id = 0
+        self.job_id = 0
+        got_prevhash = False
+        while not (self.channel_id and self.job_id and got_prevhash):
+            _, mtype, payload = await self._read_frame()
+            if mtype == v2mod.MSG_OPEN_STANDARD_MINING_CHANNEL_SUCCESS:
+                ok = v2mod.OpenStandardMiningChannelSuccess.decode(payload)
+                self.channel_id = ok.channel_id
+                self.en2 = ok.extranonce_prefix
+                self.target = ok.target
+            elif mtype == v2mod.MSG_OPEN_STANDARD_MINING_CHANNEL_ERROR:
+                raise ConnectionError("sv2 channel rejected")
+            elif mtype == v2mod.MSG_SET_RESUME_TOKEN:
+                self.token = v2mod.SetResumeToken.decode(payload).token
+            elif mtype == v2mod.MSG_NEW_MINING_JOB:
+                nm = v2mod.NewMiningJob.decode(payload)
+                self.job_id = nm.job_id
+                self.version = nm.version
+            elif mtype == v2mod.MSG_SET_NEW_PREV_HASH:
+                self.ntime = v2mod.SetNewPrevHash.decode(payload).min_ntime
+                got_prevhash = True
+
+    def header(self, nonce: int) -> bytes:
+        """The 80-byte header the server reconstructs for this submit:
+        the channel's fixed extranonce prefix is the WHOLE coinbase
+        extranonce (standard channel, header-only mining)."""
+        j = dataclasses.replace(
+            self._job, extranonce1=b"", ntime=self.ntime)
+        return (jobmod.build_header_prefix(j, self.en2)
+                + struct.pack(">I", nonce))
+
+    def mine(self, count: int, start: int = 0) -> list[int]:
+        j = dataclasses.replace(
+            self._job, extranonce1=b"", ntime=self.ntime)
+        prefix = jobmod.build_header_prefix(j, self.en2)
+        nonces: list[int] = []
+        nonce = start
+        while len(nonces) < count:
+            if tgt.hash_meets_target(
+                    sha256d(prefix + struct.pack(">I", nonce)), self.target):
+                nonces.append(nonce)
+            nonce += 1
+        return nonces
+
+    async def _roundtrip(self, nonce: int) -> tuple[int, bytes]:
+        """One submit; returns the verdict (message type, payload)."""
+        self._seq += 1
+        self._send(v2mod.MSG_SUBMIT_SHARES_STANDARD,
+                   v2mod.SubmitSharesStandard(
+                       channel_id=self.channel_id,
+                       sequence_number=self._seq, job_id=self.job_id,
+                       nonce=nonce, ntime=self.ntime,
+                       version=self.version).encode())
+        await self.writer.drain()
+        while True:
+            _, mtype, payload = await self._read_frame()
+            if mtype in (v2mod.MSG_SUBMIT_SHARES_SUCCESS,
+                         v2mod.MSG_SUBMIT_SHARES_ERROR):
+                return mtype, payload
+
+    async def submit(self, nonce: int) -> str:
+        tag = share_tag(self.header(nonce))
+        self.submitted.append(tag)
+        loop = asyncio.get_running_loop()
+        for _ in range(10):
+            t0 = loop.time()
+            try:
+                mtype, payload = await self._roundtrip(nonce)
+            except (ConnectionError, EOFError, asyncio.TimeoutError, OSError):
+                # host died: ResumeChannel onto the next port — the
+                # token restores the channel extranonce prefix, so the
+                # retried header is byte-identical and a landed commit
+                # surfaces as a duplicate refusal
+                self.reconnects += 1
+                self.rotate()
+                await self.connect(self._job)
+                continue
+            self.latencies.append(loop.time() - t0)
+            if mtype == v2mod.MSG_SUBMIT_SHARES_SUCCESS:
+                self.accepted.append(tag)
+                return "accepted"
+            err = v2mod.SubmitSharesError.decode(payload).error_code
+            if "duplicate" in err:
+                self.dup_landed.append(tag)
+                return "dup"
+            self.errors.append(err)
+            self.submitted.pop()
+            return "rejected"
+        raise AssertionError(
+            f"v2 miner {self.spec.ident}: share never got a verdict")
+
+    async def replay(self, nonce: int) -> bool:
+        """Byzantine replay; True when refused AS A DUPLICATE — any
+        other verdict (accept, low-diff from a mismatched channel)
+        means the dedup index failed to see the resubmission."""
+        try:
+            mtype, payload = await self._roundtrip(nonce)
+        except (ConnectionError, EOFError, asyncio.TimeoutError, OSError):
+            self.reconnects += 1
+            await self.connect(self._job)
+            return False
+        if mtype != v2mod.MSG_SUBMIT_SHARES_ERROR:
+            return False
+        err = v2mod.SubmitSharesError.decode(payload).error_code
+        if "duplicate" not in err:
+            self.errors.append(err)
+            return False
+        self.replays_refused += 1
+        return True
+
+    def close(self) -> None:
+        if self.writer is not None:
+            self.writer.close()

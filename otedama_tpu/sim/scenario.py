@@ -1,0 +1,274 @@
+"""Scenario model for the digital twin (sim/twin.py, tools/bench_twin.py).
+
+Two declarative inputs fully determine a twin run:
+
+- a **population** of :class:`MinerSpec` rows — who mines, over which
+  protocol, against which region, with what share quota (power-law
+  hashrate weights), and which members churn (disconnect mid-run and
+  resume with their signed token) or act Byzantine (replay their own
+  accepted shares cross-host/cross-region and submit corrupt headers);
+- a **chaos schedule** of :class:`ChaosEvent` rows — seeded fault
+  directives validated against ``faults.REGISTRY`` (unknown points and
+  unsupported actions refuse loudly at build time, not as silently
+  inert rules mid-soak), split by ``where`` into the parent process's
+  injector and the acceptor host's ``fault_spec``.
+
+Everything is derived from one integer seed through ``random.Random``
+— the same seed replays the same population, quotas, churn picks and
+fault plan on any host, which is what makes the emitted
+``BENCH_TWIN_*.json`` artifact re-runnable unmodified off-sandbox.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+
+from otedama_tpu.utils import faults
+
+PROTOCOLS = ("v1", "v2")
+
+
+@dataclasses.dataclass(frozen=True)
+class MinerSpec:
+    """One population member: a logical rig with a payout account."""
+
+    ident: int
+    worker: str          # payout account the books must credit
+    protocol: str        # "v1" | "v2"
+    region: int          # home region (V2 rides the fleet region only)
+    weight: float        # relative hashrate from the power-law draw
+    shares: int          # share quota for the run (largest-remainder split)
+    churn: bool          # disconnects mid-quota and token-resumes
+    byzantine: bool      # replays accepted shares + corrupt headers
+
+
+@dataclasses.dataclass
+class Population:
+    seed: int
+    miners: list[MinerSpec]
+
+    @property
+    def total_shares(self) -> int:
+        return sum(m.shares for m in self.miners)
+
+    def summary(self) -> dict:
+        return {
+            "seed": self.seed,
+            "size": len(self.miners),
+            "total_shares": self.total_shares,
+            "v2": sum(1 for m in self.miners if m.protocol == "v2"),
+            "churn": sum(1 for m in self.miners if m.churn),
+            "byzantine": sum(1 for m in self.miners if m.byzantine),
+            "regions": sorted({m.region for m in self.miners}),
+            "max_quota": max(m.shares for m in self.miners),
+            "min_quota": min(m.shares for m in self.miners),
+        }
+
+
+def build_population(seed: int, size: int = 12, total_shares: int = 40,
+                     v2_fraction: float = 0.25, churn_fraction: float = 0.25,
+                     byzantine: int = 2, regions: tuple[int, ...] = (0, 1),
+                     alpha: float = 1.6) -> Population:
+    """Deterministic heterogeneous population.
+
+    Hashrate weights are Pareto(``alpha``) draws (capped so one whale
+    cannot starve everyone else's quota to the 1-share floor), share
+    quotas split ``total_shares`` by largest remainder with a floor of
+    one share per miner, and the V1 miners are dealt round-robin across
+    ``regions`` while V2 miners all ride the fleet region
+    (``regions[0]`` — the sharded front-end is the only V2 listener).
+    Byzantine picks cover BOTH protocols when the population has both.
+    """
+    if size < 2 or total_shares < size:
+        raise ValueError("population needs >= 2 miners and >= 1 share each")
+    rng = random.Random(seed)
+    weights = [min(rng.paretovariate(alpha), 40.0) for _ in range(size)]
+    total_w = sum(weights)
+    # largest-remainder quota split over (total_shares - size) with a
+    # guaranteed floor of 1 so every account appears in the books
+    spare = total_shares - size
+    raw = [w / total_w * spare for w in weights]
+    quotas = [1 + int(r) for r in raw]
+    remainders = sorted(
+        range(size), key=lambda i: (raw[i] - int(raw[i]), -i), reverse=True)
+    for i in remainders[: spare - sum(int(r) for r in raw)]:
+        quotas[i] += 1
+
+    n_v2 = max(1, round(size * v2_fraction)) if v2_fraction > 0 else 0
+    v2_idx = set(rng.sample(range(size), n_v2)) if n_v2 else set()
+    v1_idx = [i for i in range(size) if i not in v2_idx]
+    # churn only makes sense with >= 2 shares (disconnect MID-quota)
+    churnable = [i for i in v1_idx if quotas[i] >= 2]
+    n_churn = min(len(churnable), max(1, round(size * churn_fraction)))
+    churn_idx = set(rng.sample(churnable, n_churn)) if n_churn else set()
+
+    byz_idx: set[int] = set()
+    if byzantine:
+        # cover BOTH protocols first, then fill from whatever is left
+        v1_cand = [i for i in v1_idx if i not in churn_idx and quotas[i] >= 2]
+        v2_cand = [i for i in sorted(v2_idx) if quotas[i] >= 2]
+        if v1_cand:
+            pick = rng.choice(v1_cand)
+            byz_idx.add(pick)
+            v1_cand.remove(pick)
+        if len(byz_idx) < byzantine and v2_cand:
+            pick = rng.choice(v2_cand)
+            byz_idx.add(pick)
+            v2_cand.remove(pick)
+        rest = v1_cand + v2_cand
+        while len(byz_idx) < byzantine and rest:
+            pick = rng.choice(rest)
+            rest.remove(pick)
+            byz_idx.add(pick)
+
+    miners = []
+    v1_seen = 0
+    for i in range(size):
+        if i in v2_idx:
+            protocol, region = "v2", regions[0]
+        else:
+            protocol, region = "v1", regions[v1_seen % len(regions)]
+            v1_seen += 1
+        miners.append(MinerSpec(
+            ident=i, worker=f"m{i}.w", protocol=protocol, region=region,
+            weight=weights[i], shares=quotas[i],
+            churn=i in churn_idx, byzantine=i in byz_idx,
+        ))
+    return Population(seed=seed, miners=miners)
+
+
+# -- chaos schedule -----------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosEvent:
+    """One seeded fault directive, validated against ``faults.REGISTRY``.
+
+    ``where`` routes the rule: ``"parent"`` arms it in the twin
+    process's injector (region B's front-end, the replicators, the
+    durable chain writer thread, the profit stack and the ledger all
+    live there), ``"host"`` ships it to the acceptor host via the
+    ``fault_spec`` process-spawn channel (``FaultInjector.from_spec``).
+    """
+
+    point: str
+    action: str
+    tag: str = ""                 # "" = bare point, else "point:tag"
+    where: str = "parent"         # "parent" | "host"
+    seconds: float = 0.0          # delay action
+    keep_bytes: int = 0           # truncate action
+    component: str = ""           # crash action
+    probability: float = 1.0
+    every_nth: int = 0
+    once: bool = False
+    max_fires: int = 0
+    window: tuple[float, float] | None = None
+
+    @property
+    def rule_point(self) -> str:
+        return f"{self.point}:{self.tag}" if self.tag else self.point
+
+    def rule(self) -> dict:
+        r: dict = {"point": self.rule_point, "action": self.action}
+        if self.seconds:
+            r["seconds"] = self.seconds
+        if self.keep_bytes:
+            r["keep_bytes"] = self.keep_bytes
+        if self.component:
+            r["component"] = self.component
+        if self.probability != 1.0:
+            r["probability"] = self.probability
+        if self.every_nth:
+            r["every_nth"] = self.every_nth
+        if self.once:
+            r["once"] = True
+        if self.max_fires:
+            r["max_fires"] = self.max_fires
+        if self.window is not None:
+            r["window"] = list(self.window)
+        return r
+
+
+def validate_chaos(events: list[ChaosEvent]) -> None:
+    """Refuse unknown points and unsupported actions at BUILD time.
+
+    A typo'd point in a chaos schedule would otherwise arm an inert
+    rule and the run would audit green having injected nothing — the
+    registry makes that a loud ``ValueError`` instead.
+    """
+    for e in events:
+        entry = faults.REGISTRY.get(e.point)
+        if entry is None:
+            raise ValueError(
+                f"chaos schedule names unknown fault point {e.point!r} "
+                f"(see faults.REGISTRY)")
+        if e.action not in entry.supports:
+            raise ValueError(
+                f"fault point {e.point!r} does not support action "
+                f"{e.action!r} (supports: {sorted(entry.supports)})")
+        if e.where not in ("parent", "host"):
+            raise ValueError(f"ChaosEvent.where must be parent|host, "
+                             f"got {e.where!r}")
+        if e.action == "crash" and not e.component:
+            raise ValueError(
+                f"crash rule at {e.point!r} needs a component name")
+
+
+def parent_injector(events: list[ChaosEvent],
+                    seed: int) -> faults.FaultInjector:
+    validate_chaos(events)
+    return faults.FaultInjector.from_spec({
+        "seed": seed,
+        "rules": [e.rule() for e in events if e.where == "parent"],
+    })
+
+
+def host_fault_spec(events: list[ChaosEvent], seed: int) -> dict | None:
+    validate_chaos(events)
+    rules = [e.rule() for e in events if e.where == "host"]
+    if not rules:
+        return None
+    return {"seed": seed, "rules": rules}
+
+
+def distinct_points(events: list[ChaosEvent]) -> list[str]:
+    return sorted({e.point for e in events})
+
+
+def default_chaos() -> list[ChaosEvent]:
+    """The standard composed schedule: every layer of the deployment
+    takes at least one hit, with budgets small enough for the tier-1
+    smoke run and a whole-host crash driving the mid-run restart.
+
+    Eight distinct fault points across both processes and both regions:
+    flaky miner links (``stratum.server.read``/``write`` at region B's
+    in-process front-end), a region commit dropped mid-submit
+    (``region.sever`` on region 1, healed by the recommit sweep), the
+    durable chain writer stalling mid-fsync (``chain.fsync``), the
+    group-commit ledger flush stalling (``ledger.flush``), a market
+    feed outage then a poisoned payload (``profit.feed``), a switch
+    commit blowing up once (``profit.switch:commit`` — rollback path),
+    and the acceptor host dying wholesale on its 4th bus share
+    (``host.bus`` crash — miners token-resume onto survivors, the twin
+    spawns a replacement host mid-run).
+    """
+    return [
+        # per-session fault tags mean per-session schedule counters, so
+        # flaky links use probability (seeded per session) rather than
+        # every_nth quotas no single short-lived session would reach
+        ChaosEvent("stratum.server.read", "error",
+                   probability=0.12, max_fires=2),
+        ChaosEvent("stratum.server.write", "drop",
+                   probability=0.08, max_fires=1),
+        ChaosEvent("region.sever", "drop", tag="1", once=True),
+        ChaosEvent("chain.fsync", "delay", seconds=0.05,
+                   every_nth=3, max_fires=2),
+        ChaosEvent("ledger.flush", "delay", seconds=0.02,
+                   every_nth=2, max_fires=2),
+        ChaosEvent("profit.feed", "error", once=True),
+        ChaosEvent("profit.feed", "corrupt", once=True),
+        ChaosEvent("profit.switch", "error", tag="commit", once=True),
+        ChaosEvent("host.bus", "crash", tag="*", where="host",
+                   component="host", every_nth=4, max_fires=1),
+    ]

@@ -1,0 +1,770 @@
+"""The digital twin: one process tree standing up the full deployment.
+
+``DigitalTwin`` composes every subsystem the repo has grown — the
+fleet ledger host with its group-commit ``PoolManager`` and host-sliced
+leases, a real acceptor-host child process joined over the TCP fleet
+bus (serving V1 AND V2 front-ends), a second single-process region
+replicated over the P2P share chain, a durable ``ChainStore`` under
+region 0, per-region settlement engines electing one writer over the
+converged chain, and the profit orchestrator polling a scripted
+``FakeFeed`` — then drives it with a seeded heterogeneous population
+(sim/scenario.py) under a registry-validated chaos schedule.
+
+The run's contract is the **three-way exactly-once audit**:
+
+1. ``db == client ground truth`` — per-worker share rows summed across
+   both regions' operational databases equal what the drivers recorded
+   as committed (accepted verdicts plus duplicate-after-retry, the
+   lost-verdict-landed-commit case);
+2. ``chain dedup index`` — both regions' converged chains agree, every
+   committed share's submission tag appears on chain exactly once,
+   and the chain carries nothing that was not submitted;
+3. **independent recompute** — the PPLNS split recomputed from client
+   ground truth equals the split recomputed from the db rows bit-exact,
+   and the elected settlement leader's ledger equals an independent
+   ``PayoutCalculator`` pass over the chain window bit-exact.
+
+A run that survives the default chaos schedule has composed eight
+distinct fault points across two processes (three hosts counting the
+mid-run replacement acceptor) and two regions, with a whole-host crash
+and a token-resume handoff in the middle — and still balanced the
+books to the satoshi.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import multiprocessing as mp
+import shutil
+import struct
+import tempfile
+import time
+
+from otedama_tpu.db import connect_database
+from otedama_tpu.db.database import Database
+from otedama_tpu.db.repos import BlockRepository
+from otedama_tpu.engine.types import Job
+from otedama_tpu.engine.vardiff import VardiffConfig
+from otedama_tpu.p2p.chainstore import ChainStore, ChainStoreConfig
+from otedama_tpu.p2p.memnet import MemoryNetwork
+from otedama_tpu.p2p.node import NodeConfig
+from otedama_tpu.p2p.pool import P2PPool
+from otedama_tpu.p2p.sharechain import ChainParams
+from otedama_tpu.pool.blockchain import MockChainClient
+from otedama_tpu.pool.manager import MockWallet, PoolConfig, PoolManager
+from otedama_tpu.pool.payouts import (
+    PayoutCalculator,
+    PayoutConfig,
+    PayoutScheme,
+)
+from otedama_tpu.pool.regions import (
+    RegionConfig,
+    RegionReplicator,
+    parse_chain_claim,
+)
+from otedama_tpu.pool.settlement import SettlementConfig, SettlementEngine
+from otedama_tpu.profit.analyzer import ProfitAnalyzer
+from otedama_tpu.profit.feeds import FakeFeed, FeedTracker
+from otedama_tpu.profit.orchestrator import (
+    CoinPlan,
+    OrchestratorConfig,
+    ProfitOrchestrator,
+)
+from otedama_tpu.security.ddos import DDoSConfig
+from otedama_tpu.sim import drivers as drv
+from otedama_tpu.sim.scenario import (
+    ChaosEvent,
+    Population,
+    build_population,
+    default_chaos,
+    host_fault_spec,
+    parent_injector,
+    validate_chaos,
+)
+from otedama_tpu.stratum.fleet import acceptor_main
+from otedama_tpu.stratum.server import ServerConfig, StratumServer
+from otedama_tpu.stratum.shard import (
+    _HOST_CRASH_EXIT,
+    ShardConfig,
+    ShardSupervisor,
+)
+from otedama_tpu.stratum.v2 import Sv2ServerConfig
+from otedama_tpu.utils import faults
+
+EASY = 1e-7     # stratum share difficulty: ~430 hashes per find
+TEST_D = 1e-6   # chain share difficulty: a few ms of host grinding
+REWARD = 50 * 10**8
+
+
+def make_job(job_id: str = "twin1") -> Job:
+    return Job(
+        job_id=job_id,
+        prev_hash=bytes(32),
+        coinb1=bytes.fromhex("01000000010000000000000000"),
+        coinb2=bytes.fromhex("ffffffff0100f2052a01000000"),
+        merkle_branch=[bytes(range(32))],
+        version=0x20000000,
+        nbits=0x1D00FFFF,
+        ntime=1_700_000_000,
+        clean=True,
+        algorithm="sha256d",
+    )
+
+
+def _pctl(values: list[float], q: float) -> float:
+    if not values:
+        return 0.0
+    s = sorted(values)
+    return s[min(len(s) - 1, int(q * len(s)))]
+
+
+@dataclasses.dataclass
+class TwinConfig:
+    seed: int = 1
+    # durable chain home for region 0 (None = a private tempdir, removed
+    # at stop; pass a path to keep the journal around for inspection)
+    chain_dir: str | None = None
+    acceptor_workers: int = 2
+    ledger_workers: int = 1
+    session_secret: str = "twin-secret"
+    max_clients: int = 256
+    # offered rate, shares/s across the whole population (0 = unpaced)
+    pace: float = 0.0
+    population: Population | None = None
+    chaos: list[ChaosEvent] | None = None
+
+
+class DigitalTwin:
+    """One seeded end-to-end deployment + chaos run + audit."""
+
+    def __init__(self, config: TwinConfig | None = None):
+        self.config = config or TwinConfig()
+        self.population = (self.config.population
+                           or build_population(self.config.seed))
+        self.chaos = (list(self.config.chaos)
+                      if self.config.chaos is not None else default_chaos())
+        validate_chaos(self.chaos)
+        self.injector = parent_injector(self.chaos, self.config.seed)
+        self.job = make_job()
+        self.drivers: list = []
+        self.commit_log: list[str] = []
+        self.rollback_log: list[str] = []
+        self.acceptor: mp.Process | None = None
+        self.acceptor2: mp.Process | None = None
+        self.accepted_a: list = []       # ledger-committed (region 0)
+        self.accepted_b: list = []       # region 1 accepts
+        self._own_chain_dir: str | None = None
+        self._started = False
+
+    # -- deployment -----------------------------------------------------------
+
+    async def start(self) -> None:
+        cfg = self.config
+        chain_dir = cfg.chain_dir
+        if chain_dir is None:
+            self._own_chain_dir = tempfile.mkdtemp(prefix="twin-chain-")
+            chain_dir = self._own_chain_dir
+        params = ChainParams(min_difficulty=TEST_D, window=4096,
+                             max_reorg_depth=6, sync_page=50)
+        self.store = ChainStore(ChainStoreConfig(
+            path=chain_dir, fsync_interval=8, snapshot_interval=2048,
+            durability="ack"))
+        self.pool_a = P2PPool(
+            NodeConfig(node_id="01" * 32), params, store=self.store)
+        self.pool_b = P2PPool(NodeConfig(node_id="02" * 32), params)
+        self.net = MemoryNetwork()
+        self.net.link(self.pool_a.node, self.pool_b.node)
+        secret = cfg.session_secret
+        self.repl_a = RegionReplicator(self.pool_a, RegionConfig(
+            region_id=0, regions=(0, 1), session_secret=secret,
+            recommit_interval=0.05))
+        self.repl_b = RegionReplicator(self.pool_b, RegionConfig(
+            region_id=1, regions=(0, 1), session_secret=secret,
+            recommit_interval=0.05))
+        # the recommit loop is the run's ONLY in-traffic healer: a
+        # severed commit parks the submitting session inside
+        # wait_durable until the sweep re-grinds it, and a parked
+        # session holds its lease (blocking every token resume)
+        await self.repl_a.start()
+        await self.repl_b.start()
+
+        def ledger_config() -> PoolConfig:
+            return PoolConfig(payout=PayoutConfig(
+                scheme=PayoutScheme.PPLNS, pplns_window=1 << 22))
+
+        self.manager_a = PoolManager(
+            connect_database(":memory:"), MockChainClient(),
+            config=ledger_config())
+        self.manager_a.replicator = self.repl_a
+        self.manager_b = PoolManager(
+            connect_database(":memory:"), MockChainClient(),
+            config=ledger_config())
+        self.manager_b.replicator = self.repl_b
+
+        def front_config(region: int, checker) -> ServerConfig:
+            # vardiff retargets pushed out of the run so every share is
+            # credited at EASY — the PPLNS recompute then needs only
+            # per-worker counts; DDoS caps lifted for the loopback swarm
+            return ServerConfig(
+                host="127.0.0.1", port=0, initial_difficulty=EASY,
+                max_clients=cfg.max_clients, extranonce1_prefix=region,
+                region_id=region, session_secret=secret,
+                duplicate_checker=checker,
+                vardiff=VardiffConfig(retarget_seconds=3600.0),
+                ddos=DDoSConfig(max_concurrent_per_ip=1 << 20,
+                                connects_per_minute=1e12,
+                                bytes_per_window=1 << 40),
+            )
+
+        self.sup = ShardSupervisor(
+            front_config(0, self.repl_a.seen_submission),
+            ShardConfig(workers=cfg.ledger_workers,
+                        fleet_listen="127.0.0.1:0", snapshot_interval=0.2),
+            on_share_batch=self._ledger_batch,
+            v2_config=Sv2ServerConfig(
+                host="127.0.0.1", port=0, initial_difficulty=EASY,
+                job_max_age=7200.0, max_clients=cfg.max_clients),
+        )
+        await self.sup.start()
+        self.server_b = StratumServer(
+            front_config(1, self.repl_b.seen_submission),
+            on_share=self._on_share_b)
+        await self.server_b.start()
+        self.sup.set_job(self.job)
+        self.server_b.set_job(self.job)
+
+        # settlement substrate: ONE shared ledger db + wallet for the
+        # deployment, one engine per region, the election picks a writer
+        self.settle_db = Database()
+        self.wallet = MockWallet()
+        blocks = BlockRepository(self.settle_db)
+        blocks.create("blk0" + "0" * 8, "m0.w", height=1, reward=REWARD)
+        blocks.set_status("blk0" + "0" * 8, "confirmed", 101)
+        payout = PayoutConfig(pplns_window=4096, minimum_payout=1_000,
+                              payout_fee=10)
+        self.engines = [
+            SettlementEngine(
+                self.settle_db, pool.chain, self.wallet, payout=payout,
+                config=SettlementConfig(interval=3600.0),
+                leader_check=repl.is_settlement_leader)
+            for pool, repl in ((self.pool_a, self.repl_a),
+                               (self.pool_b, self.repl_b))
+        ]
+
+        # profit stack on a scripted market: BTC leads until fetch #2,
+        # then its difficulty 10x's and LTC/scrypt takes the lead
+        self.feed = FakeFeed("twin", script=_market_script)
+        self.tracker = FeedTracker(self.feed, stale_seconds=120.0,
+                                   retry_base_seconds=2.0)
+
+        async def prepare(algorithm, est):
+            return algorithm
+
+        async def commit(algorithm, backend, est):
+            self.commit_log.append(algorithm)
+            return 0.01
+
+        async def rollback(incumbent):
+            self.rollback_log.append(incumbent)
+
+        self.orch = ProfitOrchestrator(
+            ProfitAnalyzer(), [self.tracker],
+            prepare=prepare, commit=commit, rollback=rollback,
+            coins={"BTC": CoinPlan("BTC", "sha256d", []),
+                   "LTC": CoinPlan("LTC", "scrypt", [])},
+            config=OrchestratorConfig(
+                dwell_seconds=0.0, cooldown_seconds=0.0,
+                min_improvement_percent=10.0, feed_stale_seconds=120.0),
+            current_algorithm="sha256d",
+        )
+        self.orch.record_hashrate("sha256d", 1e12)
+        self.orch.record_hashrate("scrypt", 1e9)
+        self._started = True
+
+    async def _ledger_batch(self, batch):
+        outcomes = await self.manager_a.on_share_batch(list(batch))
+        for share, (status, _err) in zip(batch, outcomes):
+            if status == "ok":
+                self.accepted_a.append(share)
+        return outcomes
+
+    async def _on_share_b(self, share) -> None:
+        await self.manager_b.on_share(share)
+        self.accepted_b.append(share)
+
+    def _spawn_acceptor(self, fault_spec: dict | None = None) -> mp.Process:
+        ctx = mp.get_context(
+            "fork" if "fork" in mp.get_all_start_methods() else "spawn")
+        host, port = self.sup.fleet_address
+        spec = {
+            "ledger_host": host, "ledger_port": port,
+            "workers": self.config.acceptor_workers,
+            "snapshot_interval": 0.2, "respawn_backoff": 0.1,
+        }
+        if fault_spec is not None:
+            spec["fault_spec"] = fault_spec
+        proc = ctx.Process(target=acceptor_main, args=(spec,))
+        proc.start()
+        return proc
+
+    async def _await_host(self, timeout: float = 20.0) -> tuple[int, int]:
+        """Wait for an acceptor host to advertise (port, v2_port)."""
+        for _ in range(int(timeout / 0.05)):
+            for entry in self.sup.fleet_snapshot()["hosts"].values():
+                if entry.get("port") and entry.get("v2_port"):
+                    return int(entry["port"]), int(entry["v2_port"])
+            await asyncio.sleep(0.05)
+        raise AssertionError("no acceptor host ever advertised its ports")
+
+    # -- the run --------------------------------------------------------------
+
+    async def run(self) -> dict:
+        """Deploy, drive chaos traffic + market, restart the crashed
+        host, run Byzantine replays, converge, audit. Returns the
+        report dict (the bench artifact's core)."""
+        await self.start()
+        try:
+            armed = self.injector.snapshot()
+            report = {
+                "seed": self.config.seed,
+                "population": self.population.summary(),
+                "chaos_armed": {
+                    "rules": [
+                        {k: r[k] for k in
+                         ("point", "action", "per_point_cap")}
+                        for r in armed["rules"]
+                    ],
+                    "host_rules": host_fault_spec(
+                        self.chaos, self.config.seed)["rules"]
+                    if host_fault_spec(self.chaos, self.config.seed)
+                    else [],
+                },
+            }
+            t0 = time.monotonic()
+            with faults.active(self.injector):
+                traffic = await self._drive()
+            report["traffic"] = traffic
+            report["wall_seconds"] = round(time.monotonic() - t0, 2)
+            report["market"] = self._market_report()
+            report["fleet"] = self._fleet_report()
+            report["chaos_fired"] = self._chaos_report(
+                traffic["host_crashed"])
+            report["audit"] = await self._converge_and_audit()
+            return report
+        finally:
+            await self.stop()
+
+    async def _drive(self) -> dict:
+        cfg = self.config
+        self.acceptor = self._spawn_acceptor(
+            host_fault_spec(self.chaos, cfg.seed))
+        aport, a_v2 = await self._await_host()
+        lport = self.sup.port
+        l_v2 = self.sup.v2_config.port
+        self._live_v2 = [a_v2, l_v2]
+
+        for spec in self.population.miners:
+            if spec.protocol == "v2":
+                ports = [a_v2, l_v2] if spec.ident % 2 == 0 else [l_v2, a_v2]
+                self.drivers.append(drv.V2Conn(spec, ports))
+            elif spec.region == 0:
+                self.drivers.append(drv.V1Conn(spec, [aport, lport]))
+            else:
+                self.drivers.append(
+                    drv.V1Conn(spec, [self.server_b.port]))
+
+        pace_delay = (len(self.drivers) / cfg.pace) if cfg.pace > 0 else 0.0
+
+        async def drive_v1(c: drv.V1Conn) -> None:
+            await c.connect()
+            quota = c.spec.shares - (1 if c.spec.byzantine else 0)
+            for k in range(quota):
+                if c.spec.churn and k == max(1, quota // 2):
+                    await c.reconnect()     # token-resume churn
+                en2 = struct.pack(">HH", c.spec.ident, k)
+                nonce = drv.mine_nonce(self.job, c.extranonce1, en2, EASY)
+                res = await c.submit(self.job, en2, nonce)
+                if c.spec.byzantine and not hasattr(c, "byz_share") \
+                        and res in ("accepted", "dup"):
+                    # pin the COMMITTED header: recomputing it later
+                    # would silently follow any lease drift
+                    c.byz_share = (en2, nonce)
+                    c.byz_header = drv.v1_header(
+                        self.job, c.extranonce1, en2, nonce)
+                if pace_delay:
+                    await asyncio.sleep(pace_delay)
+
+        async def drive_v2(c: drv.V2Conn) -> None:
+            await c.connect(self.job)
+            quota = c.spec.shares - (1 if c.spec.byzantine else 0)
+            nonces = c.mine(quota + 1)    # +1 spare for the byz fresh share
+            c.byz_nonces = nonces
+            for nonce in nonces[:quota]:
+                res = await c.submit(nonce)
+                if c.spec.byzantine and not hasattr(c, "byz_nonce") \
+                        and res in ("accepted", "dup"):
+                    c.byz_nonce = nonce
+                if pace_delay:
+                    await asyncio.sleep(pace_delay)
+
+        market_task = asyncio.ensure_future(self._drive_market())
+        await asyncio.gather(*[
+            drive_v1(c) if isinstance(c, drv.V1Conn) else drive_v2(c)
+            for c in self.drivers
+        ])
+        await market_task
+
+        # the seeded host.bus crash killed the acceptor host mid-traffic
+        # (its miners token-resumed onto the ledger host above). Join it,
+        # then stand up the REPLACEMENT host — the mid-run crash-restart.
+        self.acceptor.join(15)
+        host_crashed = self.acceptor.exitcode == _HOST_CRASH_EXIT
+        restart_shares = 0
+        if host_crashed:
+            for _ in range(200):
+                if not self.sup.fleet_snapshot()["hosts"]:
+                    break
+                await asyncio.sleep(0.05)
+            self.acceptor2 = self._spawn_acceptor()
+            new_port, new_v2 = await self._await_host()
+            self._live_v2 = [new_v2, l_v2]
+            movers = [c for c in self.drivers
+                      if isinstance(c, drv.V1Conn) and c.spec.region == 0
+                      and not c.spec.byzantine][:2]
+            for c in movers:
+                c.ports = [new_port, lport]
+                c._pi = 0
+                await c.reconnect()    # token-resume onto the NEW host
+                en2 = struct.pack(">HH", c.spec.ident, 500)
+                nonce = drv.mine_nonce(self.job, c.extranonce1, en2, EASY)
+                assert await c.submit(self.job, en2, nonce) in (
+                    "accepted", "dup")
+                restart_shares += 1
+            v2_movers = [c for c in self.drivers
+                         if isinstance(c, drv.V2Conn)
+                         and not c.spec.byzantine][:1]
+            for c in v2_movers:
+                c.close()
+                c.ports = [new_v2, l_v2]
+                c._pi = 0
+                c.reconnects += 1
+                await c.connect(self.job)   # ResumeChannel onto new host
+                nonce = c.mine(1, start=1 << 22)[0]
+                assert await c.submit(nonce) in ("accepted", "dup")
+                restart_shares += 1
+
+        byz = await self._byzantine_phase()
+
+        return {
+            "submitted": sum(len(c.submitted) for c in self.drivers),
+            "committed": sum(len(c.accepted) + len(c.dup_landed)
+                             for c in self.drivers),
+            "dup_landed": sum(len(c.dup_landed) for c in self.drivers),
+            "reconnects": sum(c.reconnects for c in self.drivers),
+            "leases_preserved": all(c.resumed_all for c in self.drivers),
+            "host_crashed": host_crashed,
+            "restart_shares": restart_shares,
+            "submit_p50_ms": round(1e3 * _pctl(
+                [v for c in self.drivers for v in c.latencies], 0.50), 3),
+            "submit_p99_ms": round(1e3 * _pctl(
+                [v for c in self.drivers for v in c.latencies], 0.99), 3),
+            "byzantine": byz,
+        }
+
+    async def _drive_market(self) -> None:
+        """Five scripted orchestrator rounds against the chaos'd feed:
+        outage -> poisoned payload -> clean BTC -> flip + failed commit
+        (rollback) -> committed switch to scrypt. ``now`` values ride
+        the real monotonic clock (the orchestrator stamps failure
+        backoff with it) at +50 s strides so backoff and staleness
+        horizons behave as if the run took minutes."""
+        base = time.monotonic()
+        for i in range(5):
+            await self.orch.tick(now=base + 50.0 * i)
+            await asyncio.sleep(0.05)
+
+    async def _await_seen(self, repl: RegionReplicator, pool: P2PPool,
+                          header: bytes, timeout: float = 20.0) -> bool:
+        """Poll until the OTHER region observed the submission via
+        gossip — replaying before visibility would double-commit, which
+        is a convergence race, not a dedup failure. The share may be
+        stuck in its HOME region's ``_pending`` (a severed commit), so
+        each sweep also recommits drops on both replicators."""
+        for _ in range(int(timeout / 0.05)):
+            if repl.seen_submission(header):
+                return True
+            for r in (self.repl_a, self.repl_b):
+                await r.recommit_dropped()
+            for p in (self.pool_a, self.pool_b):
+                await p.request_sync()
+            await asyncio.sleep(0.05)
+        return False
+
+    async def _retry_replay_v1(self, c: drv.V1Conn, en2: bytes,
+                               nonce: int) -> bool:
+        for _ in range(5):
+            if await c.replay(self.job, en2, nonce):
+                return True
+        return False
+
+    async def _byzantine_phase(self) -> dict:
+        """Satellite: every Byzantine replay must be refused while
+        batchmates land — cross-host over the fleet bus (V1 and V2) and
+        cross-region over the share chain (V1)."""
+        out = {"v1_replays_refused": 0, "v2_replays_refused": 0,
+               "corrupt_refused": 0, "fresh_after_replay": 0}
+        for c in self.drivers:
+            if not c.spec.byzantine:
+                continue
+            if isinstance(c, drv.V1Conn) and hasattr(c, "byz_share"):
+                en2, nonce = c.byz_share
+                # same-session replay dies at the dedup index
+                assert await self._retry_replay_v1(c, en2, nonce), \
+                    "V1 same-host replay was not refused"
+                # hop regions with the token; wait out gossip visibility
+                header = c.byz_header
+                if c.spec.region == 0:
+                    repl, pool, ports = (self.repl_b, self.pool_b,
+                                         [self.server_b.port])
+                else:
+                    repl, pool, ports = (self.repl_a, self.pool_a,
+                                         [self.sup.port])
+                assert await self._await_seen(repl, pool, header), \
+                    "replayed share never became visible cross-region"
+                c.ports = ports
+                c._pi = 0
+                await c.reconnect()
+                assert await self._retry_replay_v1(c, en2, nonce), \
+                    "V1 cross-region replay was not refused"
+                out["v1_replays_refused"] += c.replays_refused
+                # corrupt header: a nonce that misses the target
+                bad = nonce
+                target = drv.tgt.difficulty_to_target(EASY)
+                while True:
+                    bad = (bad + 1) & 0xFFFFFFFF
+                    h = drv.v1_header(self.job, c.extranonce1, en2, bad)
+                    if not drv.tgt.hash_meets_target(
+                            drv.sha256d(h), target):
+                        break
+                assert await c.submit_corrupt(self.job, en2, bad), \
+                    "corrupt header was not refused"
+                out["corrupt_refused"] += c.corrupt_refused
+                # the batchmate proof: a FRESH share still lands
+                en2f = struct.pack(">HH", c.spec.ident, 999)
+                noncef = drv.mine_nonce(
+                    self.job, c.extranonce1, en2f, EASY)
+                assert await c.submit(self.job, en2f, noncef) in (
+                    "accepted", "dup")
+                out["fresh_after_replay"] += 1
+            elif isinstance(c, drv.V2Conn) and hasattr(c, "byz_nonce"):
+                # hop to the OTHER live host with the resume token, then
+                # replay: the channel extranonce prefix survives the
+                # hop, so the header is byte-identical and the
+                # fleet-wide index (parent bus dedup + chain) must
+                # refuse it. (Resuming on the SAME server is refused —
+                # the channel id is still leased there — which would
+                # mint a fresh prefix and void the replay.)
+                other = [p for p in self._live_v2 if p != c.port]
+                c.close()
+                c.ports = other or list(self._live_v2)
+                c._pi = 0
+                c.reconnects += 1
+                await c.connect(self.job)
+                refused = False
+                for _ in range(5):
+                    if await c.replay(c.byz_nonce):
+                        refused = True
+                        break
+                assert refused, "V2 cross-host replay was not refused"
+                out["v2_replays_refused"] += c.replays_refused
+                assert await c.submit(c.byz_nonces[-1]) in (
+                    "accepted", "dup"), "V2 fresh share after replay lost"
+                out["fresh_after_replay"] += 1
+        return out
+
+    # -- convergence + audit --------------------------------------------------
+
+    async def _converge_and_audit(self) -> dict:
+        pools = (self.pool_a, self.pool_b)
+        repls = (self.repl_a, self.repl_b)
+        # tail padding so every tracked commit ages past the reorg
+        # horizon and the recommit sweeps can land dropped commits
+        for k in range(8):
+            await self.pool_a.announce_share("pad", TEST_D, f"pad{k}")
+
+        async def converge():
+            pad = 0
+            while True:
+                for p in pools:
+                    await p.request_sync()
+                for r in repls:
+                    await r.recommit_dropped()
+                tips = {p.chain.tip for p in pools}
+                unresolved = sum(
+                    1 for r, p in zip(repls, pools)
+                    for cmt in r._pending.values()
+                    if p.chain.position_of(cmt.chain_id) is None)
+                if len(tips) == 1 and unresolved == 0:
+                    return
+                await self.pool_a.announce_share(
+                    "pad", TEST_D, f"cpad{pad}")
+                pad += 1
+                await asyncio.sleep(0.05)
+
+        await asyncio.wait_for(converge(), 60)
+
+        # (1) db == client ground truth, per worker across both regions
+        truth: dict[str, int] = {}
+        submitted_tags: set[str] = set()
+        truth_tags: set[str] = set()
+        for c in self.drivers:
+            submitted_tags.update(c.submitted)
+            for tag in c.accepted + c.dup_landed:
+                truth[c.spec.worker] = truth.get(c.spec.worker, 0) + 1
+                truth_tags.add(tag)
+        db_rows: dict[str, int] = {}
+        for mgr in (self.manager_a, self.manager_b):
+            for row in mgr.db.query(
+                    "SELECT worker, COUNT(*) AS c FROM shares "
+                    "GROUP BY worker"):
+                db_rows[row["worker"]] = (
+                    db_rows.get(row["worker"], 0) + int(row["c"]))
+        assert db_rows == truth, (
+            f"db rows diverge from client ground truth: "
+            f"db={db_rows} truth={truth}")
+
+        # (2) chain dedup index: converged, unique, bounded by reality
+        chain_tag_lists = []
+        for p in pools:
+            tags = []
+            for s in p.chain.chain_slice(0, p.chain.height):
+                t = parse_chain_claim(s.job_id)
+                if t is not None:
+                    tags.append(t)
+            chain_tag_lists.append(tags)
+        assert chain_tag_lists[0] == chain_tag_lists[1], \
+            "converged chains disagree"
+        tags = chain_tag_lists[0]
+        assert len(tags) == len(set(tags)), \
+            "a submission appears twice on chain"
+        assert truth_tags <= set(tags), (
+            f"committed shares missing from chain: "
+            f"{truth_tags - set(tags)}")
+        assert set(tags) <= submitted_tags, \
+            "chain carries unknown submissions"
+
+        # (3a) PPLNS recompute: client truth vs db rows, bit-exact
+        calc = PayoutCalculator(PayoutConfig(pplns_window=1 << 22))
+
+        def split(counts: dict[str, int]) -> dict[str, int]:
+            rows = [{"worker": w, "difficulty": EASY}
+                    for w, n in sorted(counts.items()) for _ in range(n)]
+            return {p.worker: p.amount
+                    for p in calc.calculate_block(REWARD, rows).payouts}
+
+        assert split(truth) == split(db_rows), \
+            "PPLNS split diverges between ground truth and db"
+
+        # (3b) settlement: one elected writer, ledger == independent
+        # recompute over the converged chain window
+        leaders = [r.is_settlement_leader() for r in repls]
+        assert sum(leaders) == 1, f"split settlement leadership: {leaders}"
+        outs = [await eng.settle_once() for eng in self.engines]
+        assert sum(1 for o in outs if o.get("settled")) == 1
+        leader_eng = self.engines[leaders.index(True)]
+        horizon = self.pool_a.chain.settled_height()
+        window = self.pool_a.chain.chain_slice(0, horizon)
+        scalc = PayoutCalculator(PayoutConfig(pplns_window=4096))
+        expected = {
+            p.worker: p.amount
+            for p in scalc.calculate_block(
+                REWARD,
+                [{"worker": s.worker, "difficulty": s.difficulty}
+                 for s in window]).payouts
+        }
+        earned = {
+            b["worker"]: b["balance"] + b["paid_total"]
+            for b in leader_eng.balances()
+        }
+        assert earned == expected, \
+            "settlement ledger diverges from independent recompute"
+
+        return {
+            "exactly_once": True,
+            "workers": len(truth),
+            "committed_shares": sum(truth.values()),
+            "chain_submissions": len(tags),
+            "settlement_leader_region": leaders.index(True),
+            "settled_workers": len(earned),
+            "pplns_bit_exact": True,
+            "settlement_bit_exact": True,
+        }
+
+    # -- reports --------------------------------------------------------------
+
+    def _market_report(self) -> dict:
+        return {
+            "ticks": self.orch.ticks,
+            "holds": dict(self.orch.holds),
+            "switch_failures": self.orch.switch_failures,
+            "switches_committed": list(self.commit_log),
+            "rollbacks": list(self.rollback_log),
+            "current_algorithm": self.orch.current_algorithm,
+            "feed": self.tracker.snapshot(),
+        }
+
+    def _fleet_report(self) -> dict:
+        snap = self.sup.fleet_snapshot()
+        return {
+            "host_bits": snap.get("host_bits"),
+            "hosts_joined": snap.get("hosts_joined"),
+            "hosts_left": snap.get("hosts_left"),
+            "live_hosts": len(snap.get("hosts", {})),
+        }
+
+    def _chaos_report(self, host_crashed: bool) -> dict:
+        snap = self.injector.snapshot()
+        fired: dict[str, int] = {}
+        for r in snap["rules"]:
+            point = r["point"].split(":")[0]
+            fired[point] = fired.get(point, 0) + int(r["fires"])
+        if host_crashed:
+            fired["host.bus"] = fired.get("host.bus", 0) + 1
+        return {
+            "points_fired": {p: n for p, n in sorted(fired.items())
+                             if n > 0},
+            "distinct_points_fired": sum(1 for n in fired.values()
+                                         if n > 0),
+            "crash_handlers": snap.get("crash_handlers", []),
+        }
+
+    async def stop(self) -> None:
+        if not self._started:
+            return
+        self._started = False
+        for c in self.drivers:
+            c.close()
+        for proc in (self.acceptor, self.acceptor2):
+            if proc is not None and proc.is_alive():
+                proc.terminate()
+                proc.join(5)
+        await self.server_b.stop()
+        await self.sup.stop()
+        await self.repl_a.stop()
+        await self.repl_b.stop()
+        await self.pool_a.stop()
+        await self.pool_b.stop()
+        await self.net.close()
+        if self._own_chain_dir is not None:
+            shutil.rmtree(self._own_chain_dir, ignore_errors=True)
+            self._own_chain_dir = None
+
+
+def _market_script(feed: FakeFeed, n: int) -> None:
+    """Scripted market: BTC/sha256d leads while its difficulty sits at
+    1e12; from fetch #2 it 10x's and LTC/scrypt takes the profit lead
+    (>10% improvement at the twin's recorded hashrates)."""
+    diff = 1e12 if n < 2 else 1e13
+    feed.set("BTC", "sha256d", 50000.0, diff, reward=3.125)
+    feed.set("LTC", "scrypt", 80.0, 1e7, reward=6.25)

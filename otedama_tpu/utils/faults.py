@@ -30,41 +30,13 @@ Design constraints, in order:
    written short by the code that knows the framing. That keeps every
    fault representable as something the real world can do to that seam.
 
-Fault point registry (grep for ``faults.hit`` to verify):
-
-    stratum.client.read / stratum.client.send   (stratum/client.py; tag host:port)
-    stratum.server.read / stratum.server.write  (stratum/server.py; tag session id)
-    sv2.conn.send / sv2.conn.recv               (stratum/v2.py FrameConn)
-    sv2.submit                                  (stratum/v2.py submit path; tag channel id)
-    p2p.peer.send / p2p.peer.recv               (p2p/node.py; tag peer id prefix)
-    p2p.mem.send                                (p2p/memnet.py MemoryWriter)
-    p2p.share.verify                            (p2p/pool.py; tag share id prefix)
-    p2p.sync                                    (p2p/pool.py; tag peer id prefix)
-    db.execute                                  (db/database.py writes)
-    payout.settle                               (pool/settlement.py; tag pipeline stage)
-    payout.submit                               (pool/settlement.py wallet send)
-    region.sever                                (pool/regions.py commit path; tag region id)
-    chain.persist                               (p2p/chainstore.py journal/archive appends on the writer thread; tag journal|archive)
-    chain.snapshot                              (p2p/chainstore.py write_snapshot, on the writer thread)
-    chain.fsync                                 (p2p/chainstore.py writer thread, once per journal group-fsync)
-    ledger.flush                                (pool/manager.py on_share_batch, between chain and db commit)
-    region.handoff                              (stratum/server.py resume verification; tag session id)
-    validation.verify                           (runtime/validate.py device verdict; tag algorithm)
-    worker.crash                                (stratum/shard.py worker share-forward; tag worker id)
-    host.bus                                    (stratum/shard.py worker share-forward on FLEET
-                                                 (TCP) bus links only; tag host index; drop/delay
-                                                 shape the link, crash kills the whole acceptor
-                                                 host via stratum/fleet.py escalation)
-    pool.submitter.submit                       (pool/submitter.py retry loop)
-    pool.failover.check                         (pool/failover.py; tag pool name)
-    profit.feed                                 (profit/feeds.py fetch; tag feed name)
-    profit.switch                               (profit/orchestrator.py; tag prepare|commit)
-    engine.batch                                (engine/engine.py; tag backend)
-    device.call                                 (engine/engine.py executor wrapper; tag backend)
-    native.call                                 (utils/native_batch.py; tag seal|open|chainframe;
-                                                 error/crash -> counted python fallback,
-                                                 corrupt -> mangled native result the sampled
-                                                 tripwire must catch, delay -> slow .so call)
+Fault point registry: machine-readable in ``REGISTRY`` below — one
+``FaultPoint`` per point with its location, tag semantics, and the
+action set the seam actually applies. Chaos drivers (otedama_tpu/sim)
+validate their schedules against it, and
+``tests/test_chaos.py::test_fault_registry_parity`` pins REGISTRY ==
+docs/FAULT_INJECTION.md table == the literal ``faults.hit`` call sites,
+both directions, so the three can't drift.
 
 Usage (tests / chaos drivers):
 
@@ -95,8 +67,10 @@ __all__ = [
     "Directive",
     "FaultInjectedError",
     "FaultInjector",
+    "FaultPoint",
     "FaultRule",
     "POINT",
+    "REGISTRY",
     "SEND_ASYNC",
     "SEND_SYNC",
     "STEP",
@@ -124,6 +98,102 @@ SEND_SYNC = frozenset({"error", "crash", "drop", "truncate"})
 # thread, the watchdog's target failure), error = backend crash,
 # corrupt = wrong results past the device filter (silent data error)
 DEVICE = frozenset({"error", "crash", "delay", "corrupt"})
+# market feed fetches: a lossy+lying API (profit/feeds.py FEED_ACTIONS
+# aliases this) — drop ages data toward staleness, corrupt feeds the
+# sanitizer garbage, but a feed can't short-write (no truncate)
+FEED = frozenset({"error", "crash", "delay", "drop", "corrupt"})
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPoint:
+    """One registered fault point: the machine-readable row behind the
+    docs/FAULT_INJECTION.md table. ``supports`` is the EXACT action set
+    the call site passes to ``hit()`` — a chaos plan naming any other
+    action at this point would be silently skipped, so schedule
+    validators (otedama_tpu/sim/scenario.py) refuse it up front."""
+
+    point: str
+    location: str    # module + seam, matching the docs table's Where
+    tag: str         # tag semantics; "" = the point is untagged
+    supports: frozenset
+
+
+def _reg(*points: FaultPoint) -> dict:
+    return {p.point: p for p in points}
+
+
+# THE registry. Adding a fault point means adding a row here, a row in
+# docs/FAULT_INJECTION.md, and the faults.hit call — the parity test
+# fails if any of the three is missing or stale.
+REGISTRY: dict[str, FaultPoint] = _reg(
+    FaultPoint("stratum.client.read", "stratum/client.py read loop",
+               "host:port", POINT),
+    FaultPoint("stratum.client.send", "stratum/client.py _send",
+               "host:port", SEND_ASYNC),
+    FaultPoint("stratum.server.read", "stratum/server.py per-client loop",
+               "session id", POINT),
+    FaultPoint("stratum.server.write", "stratum/server.py _write_line",
+               "session id", SEND_SYNC),
+    FaultPoint("sv2.conn.send", "stratum/v2.py FrameConn (both ends)",
+               "", SEND_SYNC),
+    FaultPoint("sv2.conn.recv", "stratum/v2.py FrameConn (both ends)",
+               "", POINT),
+    FaultPoint("sv2.submit", "stratum/v2.py _on_submit, pre-validation",
+               "channel id", STEP),
+    FaultPoint("p2p.peer.send", "p2p/node.py writer",
+               "peer id prefix (12 hex)", SEND_SYNC),
+    FaultPoint("p2p.peer.recv", "p2p/node.py reader",
+               "peer id prefix (12 hex)", POINT),
+    FaultPoint("p2p.mem.send", "p2p/memnet.py MemoryWriter",
+               "remote id prefix (8 hex)", SEND_SYNC),
+    FaultPoint("p2p.share.verify", "p2p/pool.py _on_share",
+               "share id prefix (12 hex)", STEP),
+    FaultPoint("p2p.sync", "p2p/pool.py locator sync",
+               "peer id prefix (12 hex)", STEP),
+    FaultPoint("db.execute", "db/database.py execute/executemany",
+               "", POINT),
+    FaultPoint("payout.settle", "pool/settlement.py pipeline transitions",
+               "stage (snapshot|calculate|credit|stage-payouts)", POINT),
+    FaultPoint("payout.submit", "pool/settlement.py _submit wallet send",
+               "", STEP),
+    FaultPoint("region.sever", "pool/regions.py commit path",
+               "region id", STEP),
+    FaultPoint("region.handoff", "stratum/server.py _try_resume",
+               "session id", POINT),
+    FaultPoint("chain.persist",
+               "p2p/chainstore.py journal/archive appends (writer thread)",
+               "journal|archive", STEP),
+    FaultPoint("chain.snapshot",
+               "p2p/chainstore.py write_snapshot (writer thread)",
+               "", STEP),
+    FaultPoint("chain.fsync",
+               "p2p/chainstore.py writer thread, per journal group-fsync",
+               "", POINT),
+    FaultPoint("ledger.flush",
+               "pool/manager.py on_share_batch, between chain and db",
+               "", STEP),
+    FaultPoint("validation.verify", "runtime/validate.py device verdict",
+               "algorithm", DEVICE),
+    FaultPoint("worker.crash", "stratum/shard.py worker share-forward",
+               "worker id", POINT),
+    FaultPoint("host.bus",
+               "stratum/shard.py share-forward, FLEET (TCP) bus links",
+               "host index", SEND_ASYNC),
+    FaultPoint("pool.submitter.submit", "pool/submitter.py retry loop",
+               "", STEP),
+    FaultPoint("pool.failover.check", "pool/failover.py check_pool",
+               "pool name", POINT),
+    FaultPoint("profit.feed", "profit/feeds.py FeedTracker.poll",
+               "feed name", FEED),
+    FaultPoint("profit.switch", "profit/orchestrator.py execute_switch",
+               "prepare|commit", POINT),
+    FaultPoint("engine.batch", "engine/engine.py search loop",
+               "backend name", STEP),
+    FaultPoint("device.call", "engine/engine.py _call_device_sync",
+               "backend name", DEVICE),
+    FaultPoint("native.call", "utils/native_batch.py _gate",
+               "seal|open|chainframe", DEVICE),
+)
 
 
 @dataclasses.dataclass
@@ -366,23 +436,45 @@ class FaultInjector:
 
     def snapshot(self) -> dict:
         """Injector state for the API/engine snapshot: chaos runs are
-        only trustworthy when you can SEE which seams actually fired."""
+        only trustworthy when you can SEE which seams actually fired.
+
+        Beyond the hit/fault counters, this exposes what a chaos driver
+        needs to verify its schedule actually ARMED before trusting a
+        green audit: the registered crash-handler names (a crash rule
+        with no handler degrades to a raise, which is usually not what
+        the plan meant) and each rule's per-point remaining-fire budget
+        (``once``/``max_fires`` rules that never reached their cap mean
+        the scenario under-fired)."""
         with self._lock:
+            rules = []
+            for idx, r in enumerate(self.rules):
+                cap = 1 if r.once else (r.max_fires or 0)
+                entry = {
+                    "point": r.point,
+                    "action": r.action,
+                    "fires": r.fires,
+                    # 0 = unlimited; else the per-matched-point fire cap
+                    "per_point_cap": cap,
+                }
+                if cap:
+                    # keys this rule has fired at, with budget left;
+                    # points never hit simply don't appear (full budget)
+                    entry["remaining"] = {
+                        key: cap - fired
+                        for (i, key), fired in sorted(
+                            self._rule_fires.items())
+                        if i == idx
+                    }
+                rules.append(entry)
             return {
                 "active": self is _active,
                 "seed": self.seed,
+                "crash_handlers": sorted(self._crash_handlers),
                 "points": {
                     key: {"hits": s.hits, "faults": s.faults}
                     for key, s in sorted(self.points.items())
                 },
-                "rules": [
-                    {
-                        "point": r.point,
-                        "action": r.action,
-                        "fires": r.fires,
-                    }
-                    for r in self.rules
-                ],
+                "rules": rules,
             }
 
 

@@ -83,6 +83,19 @@
 #                   batch-size crossover probe; asserts device and host
 #                   verdicts bit-identical (exit 2 otherwise); writes a
 #                   BENCH_VALIDATE json artifact.
+#   twin-bench      opt-in digital-twin chaos run: stands up the FULL
+#                   deployment in one process tree (fleet ledger +
+#                   acceptor host child serving V1+V2, second
+#                   replicated region, durable chain, settlement
+#                   election, profit orchestrator on a scripted feed)
+#                   and drives a seeded heterogeneous population
+#                   through the registry-validated chaos schedule —
+#                   whole-host crash + replacement included — at each
+#                   TWIN_BENCH_PACES offered rate; every run ends in
+#                   the three-way exactly-once audit (db == chain dedup
+#                   index == independent PPLNS/settlement recompute,
+#                   exit 2 on any imbalance); writes a BENCH_TWIN json
+#                   artifact re-runnable unmodified off-sandbox.
 #   native-bench    opt-in native batch-seam bench: ctypes dispatch
 #                   overhead plus seal_many/open_many and chain_frames
 #                   crossover curves vs their python oracles (every
@@ -181,5 +194,10 @@ case "$tier" in
     native_build
     exec env JAX_PLATFORMS=cpu python tools/bench_native.py \
       --out "${NATIVE_BENCH_OUT:-BENCH_NATIVE_manual.json}" "$@" ;;
-  *) echo "usage: $0 [fast|slow|all|audit|stratum-bench|stratum-shard-bench|stratum-v2-bench|profit-bench|switch-bench|degrade-bench|engine-bench|validate-bench|sharechain-bench|region-bench|payout-bench|chain-bench|fleet-bench|native-bench] [pytest args...]" >&2; exit 2 ;;
+  twin-bench)
+    exec env JAX_PLATFORMS=cpu python tools/bench_twin.py \
+      --seed "${TWIN_BENCH_SEED:-22}" \
+      --pace "${TWIN_BENCH_PACES:-0,20}" \
+      --out "${TWIN_BENCH_OUT:-BENCH_TWIN_manual.json}" "$@" ;;
+  *) echo "usage: $0 [fast|slow|all|audit|stratum-bench|stratum-shard-bench|stratum-v2-bench|profit-bench|switch-bench|degrade-bench|engine-bench|validate-bench|sharechain-bench|region-bench|payout-bench|chain-bench|fleet-bench|native-bench|twin-bench] [pytest args...]" >&2; exit 2 ;;
 esac

@@ -1003,3 +1003,87 @@ def test_pow_host_epoch_cache_locked_and_donated():
     finally:
         with pow_host._ETHASH_LOCK:
             pow_host._ETHASH_CACHES.pop(0, None)
+
+
+# ---------------------------------------------------------------------------
+# fault-point registry parity (ISSUE 19): faults.REGISTRY is the machine-
+# readable source of truth; the docs table and the actual call sites must
+# agree with it BOTH ways, or a new/renamed point silently escapes chaos
+# coverage.
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _doc_table_points() -> set:
+    """Every `point` named in a docs/FAULT_INJECTION.md table row's first
+    column (one row may document several points, e.g. sv2.conn.send/recv)."""
+    import re
+    path = os.path.join(_repo_root(), "docs", "FAULT_INJECTION.md")
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    points = set()
+    for line in text.splitlines():
+        if not line.startswith("| `"):
+            continue
+        first_cell = line.split("|")[1]
+        points.update(re.findall(r"`([a-z0-9_.]+)`", first_cell))
+    return points
+
+
+def _call_site_points() -> set:
+    """Every literal point name passed to faults.hit() in the package."""
+    import re
+    pkg = os.path.join(_repo_root(), "otedama_tpu")
+    points = set()
+    for dirpath, _dirs, files in os.walk(pkg):
+        for name in files:
+            if not name.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, name), encoding="utf-8") as f:
+                text = f.read()
+            points.update(
+                re.findall(r'faults\.hit\(\s*"([a-z0-9_.]+)"', text))
+    return points
+
+
+def test_fault_registry_parity():
+    registry = set(faults.REGISTRY)
+    docs = _doc_table_points()
+    sites = _call_site_points()
+    assert registry == docs, (
+        f"registry-only: {sorted(registry - docs)}, "
+        f"docs-only: {sorted(docs - registry)}")
+    assert registry == sites, (
+        f"registry-only (no faults.hit call site): {sorted(registry - sites)}, "
+        f"call-site-only (unregistered point): {sorted(sites - registry)}")
+    known = {"error", "crash", "delay", "drop", "truncate", "corrupt"}
+    for p in faults.REGISTRY.values():
+        assert p.supports and p.supports <= known, p.point
+        assert p.location, p.point
+
+
+def test_snapshot_exposes_crash_handlers_and_budgets():
+    inj = (faults.FaultInjector(seed=3)
+           .drop("host.bus:*", every_nth=2, max_fires=2)
+           .delay("chain.fsync", seconds=0.0))
+    inj.register_crash_handler("host", lambda: None)
+    inj.register_crash_handler("ledger", lambda: None)
+    snap = inj.snapshot()
+    assert snap["crash_handlers"] == ["host", "ledger"]
+    # armed but unfired: cap visible, no per-point spend yet
+    assert snap["rules"][0]["per_point_cap"] == 2
+    assert snap["rules"][0]["remaining"] == {}
+    assert snap["rules"][1]["per_point_cap"] == 0      # unlimited
+    assert "remaining" not in snap["rules"][1]
+    with faults.active(inj):
+        for _ in range(5):
+            faults.hit("host.bus", "1", faults.SEND_ASYNC)
+        faults.hit("host.bus", "2", faults.SEND_ASYNC)
+        faults.hit("chain.fsync", None, faults.POINT)
+    snap = inj.snapshot()
+    # host 1 exhausted its 2-fire budget (hits 2 and 4); host 2 has not
+    # reached every_nth yet so its full budget is implicit
+    assert snap["rules"][0]["remaining"] == {"host.bus:1": 0}
+    assert snap["rules"][0]["fires"] == 2
+    assert snap["rules"][1]["fires"] == 1

@@ -1,0 +1,126 @@
+"""Digital twin: the composed end-to-end chaos harness (sim/).
+
+The smoke test is the tier-1 guarantee: one seeded run composing the
+whole deployment — fleet ledger + acceptor host child process (V1+V2),
+a second replicated region, durable chain, settlement election, profit
+orchestrator on a scripted feed — under the default chaos schedule,
+ending in the three-way exactly-once audit. The audit itself lives in
+``DigitalTwin._converge_and_audit`` and raises on any imbalance; the
+assertions here pin the COMPOSITION (what must have happened during the
+run), not just the outcome.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from otedama_tpu.sim import (
+    ChaosEvent,
+    DigitalTwin,
+    TwinConfig,
+    build_population,
+    default_chaos,
+    validate_chaos,
+)
+
+SMOKE_SEED = 1  # population AND fault plan derive from this one integer
+
+
+# -- scenario model (no deployment) ------------------------------------------
+
+
+def test_population_is_seed_deterministic_and_heterogeneous():
+    a = build_population(7, size=12, total_shares=40)
+    b = build_population(7, size=12, total_shares=40)
+    assert [m for m in a.miners] == [m for m in b.miners]
+    assert build_population(8, size=12, total_shares=40).miners != a.miners
+    s = a.summary()
+    assert s["total_shares"] == 40
+    assert s["v2"] >= 1 and s["churn"] >= 1 and s["byzantine"] == 2
+    assert s["regions"] == [0, 1]
+    # power-law quotas: somebody is a whale, everybody holds the floor
+    assert s["max_quota"] > s["min_quota"] >= 1
+    protos = {m.protocol for m in a.miners if m.byzantine}
+    assert protos == {"v1", "v2"}, "byzantine picks must cover both wires"
+
+
+def test_chaos_schedule_validates_against_registry():
+    validate_chaos(default_chaos())  # the shipped schedule is well-formed
+    with pytest.raises(ValueError, match="unknown fault point"):
+        validate_chaos([ChaosEvent("stratum.server.raed", "error")])
+    with pytest.raises(ValueError, match="does not support"):
+        validate_chaos([ChaosEvent("ledger.flush", "corrupt")])
+    with pytest.raises(ValueError, match="component"):
+        validate_chaos([ChaosEvent("host.bus", "crash", where="host")])
+
+
+# -- the composed run --------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_twin_smoke_full_deployment_chaos_audit():
+    """One seeded run: >= 6 distinct fault points across 2 processes
+    and 2 regions, a whole-host crash with a mid-run replacement, every
+    Byzantine replay refused, and the three-way audit bit-exact."""
+    twin = DigitalTwin(TwinConfig(
+        seed=SMOKE_SEED,
+        population=build_population(SMOKE_SEED, size=10, total_shares=28)))
+    report = await twin.run()
+
+    # the audit passed (it raises otherwise) and balanced real traffic
+    audit = report["audit"]
+    assert audit["exactly_once"]
+    assert audit["pplns_bit_exact"] and audit["settlement_bit_exact"]
+    assert audit["committed_shares"] >= 28
+    assert audit["chain_submissions"] == audit["committed_shares"]
+    assert audit["workers"] == 10
+
+    # composition floor: the chaos schedule actually hit the deployment
+    chaos = report["chaos_fired"]
+    assert chaos["distinct_points_fired"] >= 6, chaos
+    assert chaos["points_fired"].get("host.bus") == 1
+
+    # the whole-host crash-restart: host died, replacement joined, and
+    # displaced miners landed shares on it
+    traffic = report["traffic"]
+    assert traffic["host_crashed"]
+    assert traffic["restart_shares"] >= 3
+    assert report["fleet"]["hosts_joined"] >= 2
+    assert report["fleet"]["hosts_left"] >= 1
+
+    # Byzantine satellite: replays refused cross-host AND cross-region
+    # on both wires, corrupt header refused, batchmates landed
+    byz = traffic["byzantine"]
+    assert byz["v1_replays_refused"] >= 2
+    assert byz["v2_replays_refused"] >= 1
+    assert byz["corrupt_refused"] >= 1
+    assert byz["fresh_after_replay"] == 2
+
+    # market scenario: outage + poisoned payload held, one switch
+    # failed and rolled back, then the switch to scrypt committed
+    market = report["market"]
+    assert market["holds"].get("stale", 0) >= 2
+    assert market["switch_failures"] == 1
+    assert market["rollbacks"] == ["sha256d"]
+    assert market["switches_committed"] == ["scrypt"]
+    assert market["current_algorithm"] == "scrypt"
+    assert market["feed"]["rejected"] >= 1
+
+    # every disconnect resumed its lease (this seed never loses one)
+    assert traffic["leases_preserved"]
+    assert traffic["reconnects"] >= 3
+
+
+@pytest.mark.slow
+@pytest.mark.asyncio
+async def test_twin_soak_larger_population_paced():
+    """Soak: the default 12-miner population at a paced offered rate,
+    same composition floor and audit."""
+    twin = DigitalTwin(TwinConfig(
+        seed=22, pace=20.0,
+        population=build_population(22, size=12, total_shares=40)))
+    report = await twin.run()
+    assert report["audit"]["exactly_once"]
+    assert report["chaos_fired"]["distinct_points_fired"] >= 6
+    assert report["traffic"]["host_crashed"]
+    assert report["traffic"]["byzantine"]["fresh_after_replay"] == 2

@@ -61,6 +61,7 @@ sys.path.insert(0, os.path.dirname(_TOOLS))
 sys.path.insert(0, _TOOLS)
 
 import bench_stratum as bs                                  # noqa: E402
+import benchlib                                             # noqa: E402
 
 import multiprocessing as mp                                # noqa: E402
 
@@ -75,7 +76,7 @@ from otedama_tpu.stratum.shard import (                     # noqa: E402
 SWITCH_INTERVAL = 0.001
 sys.setswitchinterval(SWITCH_INTERVAL)
 
-EASY = bs.EASY
+EASY = benchlib.EASY
 BENCH_D = 1e-9        # chain leg: effectively free PoW, real headers
 CHAIN_WORKERS = 23    # distinct weight-accumulator keys (r16/r20 shape)
 LEDGER_BATCH = 256    # shares per ledger flush (r14 batch p99)
@@ -110,7 +111,7 @@ async def _independent_pplns(per_worker_accepted: dict[str, int],
     synthesized purely from the CLIENTS' verdict records (worker name +
     the flat EASY credit every share earned). If the fleet dropped,
     double-committed, or mis-credited anything, this split diverges."""
-    control = bs._make_ledger()
+    control = benchlib.make_ledger()
     batch: list[AcceptedShare] = []
     seq = 0
     for worker, n in sorted(per_worker_accepted.items()):
@@ -127,7 +128,7 @@ async def _independent_pplns(per_worker_accepted: dict[str, int],
     for i in range(0, len(batch), LEDGER_BATCH):
         outcomes = await control.on_share_batch(batch[i:i + LEDGER_BATCH])
         assert all(s == "ok" for s, _ in outcomes)
-    return bs._pplns_split(control)
+    return benchlib.pplns_split(control)
 
 
 async def _fleet_leg(hosts: int, conns_per_host: int, shares_per_conn: int,
@@ -135,7 +136,7 @@ async def _fleet_leg(hosts: int, conns_per_host: int, shares_per_conn: int,
                      failures: list[str]) -> dict:
     """One fleet size: dedicated ledger host (workers=0, every share
     arrives over the TCP bus) + ``hosts`` real acceptor processes."""
-    pool = bs._make_ledger()
+    pool = benchlib.make_ledger()
     hooked: list = []
 
     async def on_share(s):
@@ -146,7 +147,7 @@ async def _fleet_leg(hosts: int, conns_per_host: int, shares_per_conn: int,
         return await pool.on_share_batch(shares)
 
     sup = ShardSupervisor(
-        bs._bench_server_config(max_clients=hosts * conns_per_host + 64),
+        benchlib.bench_server_config(max_clients=hosts * conns_per_host + 64),
         ShardConfig(workers=0, snapshot_interval=0.5, ack_timeout=180.0,
                     fleet_listen="127.0.0.1:0"),
         on_share=on_share, on_share_batch=on_share_batch,
@@ -154,7 +155,7 @@ async def _fleet_leg(hosts: int, conns_per_host: int, shares_per_conn: int,
     await sup.start()
     procs: list = []
     try:
-        job = bs.make_job()
+        job = benchlib.make_job()
         sup.set_job(job)
         ctx = _ctx()
         fhost, fport = sup.fleet_address
@@ -191,7 +192,7 @@ async def _fleet_leg(hosts: int, conns_per_host: int, shares_per_conn: int,
 
         # pre-mine OFF the measured window (unique en2 per share)
         t0 = time.monotonic()
-        target = bs.tgt.difficulty_to_target(EASY)
+        target = benchlib.tgt.difficulty_to_target(EASY)
         premined: dict[int, list[tuple[bytes, int]]] = {}
         for m in miners:
             out = []
@@ -199,7 +200,7 @@ async def _fleet_leg(hosts: int, conns_per_host: int, shares_per_conn: int,
             while len(out) < shares_per_conn:
                 en2 = struct.pack(">I", (m.ident << 12) | i)
                 i += 1
-                nonce = bs.mine_share(job, m.extranonce1, en2, target)
+                nonce = benchlib.mine_share(job, m.extranonce1, en2, target)
                 if nonce is not None:
                     out.append((en2, nonce))
             premined[m.ident] = out
@@ -240,7 +241,7 @@ async def _fleet_leg(hosts: int, conns_per_host: int, shares_per_conn: int,
                 f"{ledger}, bus {snap['bus']})")
 
         per_worker = {f"w.{m.ident}": m.accepted for m in miners}
-        split = bs._pplns_split(pool)
+        split = benchlib.pplns_split(pool)
         control_split = await _independent_pplns(per_worker, job.job_id)
         pplns_ok = split == control_split and len(split) == len(miners)
         if not pplns_ok:
@@ -262,11 +263,11 @@ async def _fleet_leg(hosts: int, conns_per_host: int, shares_per_conn: int,
             "submit_window_seconds": round(elapsed, 3),
             "connect_seconds": round(connect_seconds, 3),
             "connect_p99_ms": round(
-                bs.percentile(connect_lat, 0.99) * 1000, 3),
+                benchlib.percentile(connect_lat, 0.99) * 1000, 3),
             "client_p50_ms": round(
-                bs.percentile(client_lat, 0.50) * 1000, 3),
+                benchlib.percentile(client_lat, 0.50) * 1000, 3),
             "client_p99_ms": round(
-                bs.percentile(client_lat, 0.99) * 1000, 3),
+                benchlib.percentile(client_lat, 0.99) * 1000, 3),
             "premine_seconds": round(premine_seconds, 3),
             "bus": snap["bus"],
             "ledger": dict(ledger),
@@ -468,7 +469,7 @@ def main() -> int:
     failures: list[str] = []
 
     print("harness calibration (r14 discipline)...", file=sys.stderr)
-    echo = bs.harness_calibration(
+    echo = benchlib.harness_calibration(
         workers=2, fleet=2, conns=200 if args.quick else 500,
         dur=4.0 if args.quick else 8.0, trials=1 if args.quick else 3)
 

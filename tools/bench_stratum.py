@@ -77,24 +77,20 @@ import multiprocessing as mp
 import os
 import queue
 import random
-import resource
 import shutil
 import socket
 import struct
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_TOOLS))
+sys.path.insert(0, _TOOLS)
 
-from otedama_tpu.db import connect_database                # noqa: E402
 from otedama_tpu.engine import jobs as jobmod              # noqa: E402
 from otedama_tpu.engine.types import Job                   # noqa: E402
-from otedama_tpu.engine.vardiff import VardiffConfig       # noqa: E402
 from otedama_tpu.kernels import target as tgt              # noqa: E402
-from otedama_tpu.pool.blockchain import MockChainClient    # noqa: E402
-from otedama_tpu.pool.manager import PoolConfig, PoolManager  # noqa: E402
-from otedama_tpu.pool.payouts import PayoutConfig, PayoutScheme  # noqa: E402
-from otedama_tpu.security.ddos import DDoSConfig           # noqa: E402
+from otedama_tpu.pool.manager import PoolManager           # noqa: E402
 from otedama_tpu.stratum import protocol as sp             # noqa: E402
 from otedama_tpu.stratum.server import (                   # noqa: E402
     ServerConfig, StratumServer,
@@ -106,81 +102,22 @@ from otedama_tpu.stratum import noise as noise_mod        # noqa: E402
 from otedama_tpu.stratum import v2 as v2mod               # noqa: E402
 from otedama_tpu.utils.sha256_host import sha256d          # noqa: E402
 
-EASY = 1e-7  # ~2.3e-3 hit probability per hash: shares mine in ~430 tries
-REWARD = 50 * 10**8  # block reward the PPLNS control split divides
+# shared bench machinery (tools/benchlib.py): one calibration + one
+# pace-sweep + one exactness-audit implementation across bench_stratum,
+# bench_fleet and bench_twin. The leading-underscore aliases keep this
+# module's historical internal names (and bench_fleet's ``bs.*`` uses)
+# pointing at the single shared implementation.
+import benchlib                                            # noqa: E402
+from benchlib import (                                     # noqa: E402
+    EASY, REWARD, ensure_fd_budget, fd_budget, harness_calibration,
+    make_job, mine_share, percentile,
+)
 
-
-def fd_budget(connections: int, workers: int = 1) -> int:
-    """Pure fd-need estimate for the soak's rlimit (shared by every
-    process — children inherit the raise at fork).
-
-    Classic single-process mode (``workers <= 1``) keeps BOTH socket
-    ends of every connection in this one process (2x). At ``workers >
-    1`` no process holds both ends: server ends live in the acceptor
-    workers (SO_REUSEPORT makes no skew promise, so the worst case is
-    every connection landing on ONE worker), client ends live in the
-    dedicated miner-fleet child — the limit must fit ``connections`` +
-    per-worker bus/listen overhead + baseline in EVERY process, not 2x
-    in one. That halved per-process budget is exactly what lets a 10k+
-    soak (and its same-workload control leg, which also drives its
-    miners from the fleet child) run under fd ceilings the 2x estimate
-    could never fit.
-    """
-    if workers <= 1:
-        return 2 * connections + 128
-    return connections + 64 * max(1, workers) + 256
-
-
-def ensure_fd_budget(connections: int, workers: int = 1) -> None:
-    """Raise RLIMIT_NOFILE to fit ``fd_budget`` (BEFORE any worker
-    forks, so the raise is inherited); exit 2 loudly if it can't fit."""
-    need = fd_budget(connections, workers)
-    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
-    if soft < need:
-        try:
-            resource.setrlimit(
-                resource.RLIMIT_NOFILE, (min(need, hard), hard)
-            )
-        except (ValueError, OSError):
-            pass
-        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
-    if soft < need:
-        print(
-            f"FATAL: fd limit too low for the soak: need {need} "
-            f"({connections} connections x {max(1, workers)} worker(s) "
-            f"budget), have soft={soft} hard={hard}. Raise it "
-            f"(ulimit -n {need}) or lower --connections. Refusing to "
-            "silently under-test.",
-            file=sys.stderr,
-        )
-        sys.exit(2)
-
-
-def make_job(job_id: str = "bench1") -> Job:
-    return Job(
-        job_id=job_id,
-        prev_hash=bytes(32),
-        coinb1=bytes.fromhex("01000000010000000000000000"),
-        coinb2=bytes.fromhex("ffffffff0100f2052a01000000"),
-        merkle_branch=[bytes(range(32))],
-        version=0x20000000,
-        nbits=0x1D00FFFF,
-        ntime=1_700_000_000,
-        clean=True,
-        algorithm="sha256d",
-    )
-
-
-def mine_share(job: Job, extranonce1: bytes, en2: bytes,
-               target: int) -> int | None:
-    """Find a nonce for (job, en1, en2) meeting target; None if unlucky."""
-    j = dataclasses.replace(job, extranonce1=extranonce1)
-    prefix = jobmod.build_header_prefix(j, en2)
-    for nonce in range(1 << 20):
-        if tgt.hash_meets_target(
-                sha256d(prefix + struct.pack(">I", nonce)), target):
-            return nonce
-    return None
+_bench_server_config = benchlib.bench_server_config
+_make_ledger = benchlib.make_ledger
+_pplns_split = benchlib.pplns_split
+_hist_state = benchlib.hist_state
+_diff_quantile = benchlib.diff_quantile
 
 
 class Miner:
@@ -453,168 +390,6 @@ def _premine_v2(miners: list[Sv2Miner], job: Job,
             nonce += 1
         m.nonces = nonces
     return time.monotonic() - t0
-
-
-def percentile(values: list[float], q: float) -> float:
-    if not values:
-        return 0.0
-    s = sorted(values)
-    return s[min(len(s) - 1, int(q * len(s)))]
-
-
-def _echo_server_proc(q, reuse_port: int) -> None:
-    """Bare asyncio echo worker for the harness calibration below."""
-    async def main():
-        async def handle(r, w):
-            try:
-                while True:
-                    w.write(await r.readexactly(64))
-            except (asyncio.IncompleteReadError, ConnectionError):
-                pass
-
-        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
-        sock.bind(("127.0.0.1", reuse_port))
-        sock.listen(512)
-        sock.setblocking(False)
-        srv = await asyncio.start_server(handle, sock=sock)
-        q.put(srv.sockets[0].getsockname()[1])
-        # generous lifetime: on the interposed sandbox the client
-        # shards' 1,000-connection setup alone can take tens of
-        # seconds, and a server dying mid-pump aborts the sample
-        await asyncio.sleep(300)
-
-    asyncio.run(main())
-
-
-def _echo_client_proc(port: int, out, conns: int, dur: float) -> None:
-    async def main():
-        cs = [await asyncio.open_connection("127.0.0.1", port)
-              for _ in range(conns)]
-        count = 0
-        stop = time.monotonic() + dur
-
-        async def pump(r, w):
-            nonlocal count
-            payload = b"y" * 64
-            while time.monotonic() < stop:
-                w.write(payload)
-                await r.readexactly(64)
-                count += 1
-
-        await asyncio.gather(*[pump(r, w) for r, w in cs])
-        for _, w in cs:
-            w.close()
-        out.put(count / dur)
-
-    try:
-        asyncio.run(main())
-    except Exception:
-        # a reset/slow connect must degrade to a zero sample, never
-        # leave the parent blocked on a result that will never come
-        out.put(0.0)
-
-
-def harness_calibration(workers: int = 4, fleet: int = 2,
-                        conns: int = 1000, dur: float = 8.0,
-                        trials: int = 3) -> float:
-    """Measure what THIS host's kernel/scheduler can move at all: a
-    bare 64-byte asyncio echo in the soak's exact process topology
-    (``workers`` SO_REUSEPORT echo servers + ``fleet`` client shards,
-    one request in flight per connection) with zero pool logic. On
-    syscall-interposed sandbox kernels the whole box shares one
-    serialized syscall/wakeup budget, so this round-trip rate — not
-    CPU, not the ledger — is the bench's true ceiling; committing it
-    with the artifact makes the achieved shares/s interpretable as a
-    fraction of what the harness could carry.
-
-    The interposed scheduler is NOISY (same topology measures 3x apart
-    run to run), so the ceiling is the MAX over ``trials`` — a lower
-    trial means the scheduler was having a bad day, not that the box
-    shrank."""
-    if trials > 1:
-        return max(
-            harness_calibration(workers, fleet, conns, dur, trials=1)
-            for _ in range(trials)
-        )
-    ctx = mp.get_context(
-        "fork" if "fork" in mp.get_all_start_methods() else "spawn")
-    q = ctx.Queue()
-    out = ctx.Queue()
-    servers = [ctx.Process(target=_echo_server_proc, args=(q, 0),
-                           daemon=True)]
-    servers[0].start()
-    port = q.get()
-    for _ in range(workers - 1):
-        p = ctx.Process(target=_echo_server_proc, args=(q, port),
-                        daemon=True)
-        p.start()
-        q.get()
-        servers.append(p)
-    clients = [
-        ctx.Process(target=_echo_client_proc,
-                    args=(port, out, conns // fleet, dur), daemon=True)
-        for _ in range(fleet)
-    ]
-    for c in clients:
-        c.start()
-    # liveness-polled collection (the _Fleet._recv_all rule): a child
-    # that died without reporting yields a zero sample instead of
-    # wedging the whole bench on a Queue.get that can never return
-    total = 0.0
-    deadline = time.monotonic() + dur + 120.0
-    for c in clients:
-        while True:
-            try:
-                total += out.get(timeout=1.0)
-                break
-            except queue.Empty:
-                if not c.is_alive():
-                    break
-                if time.monotonic() > deadline:
-                    break
-    for c in clients:
-        c.join(10.0)
-        if c.is_alive():
-            c.kill()
-    for p in servers:
-        p.terminate()
-    return total
-
-
-def _bench_server_config(max_clients: int) -> ServerConfig:
-    # loopback fleet: the whole swarm shares one IP — lift the per-IP
-    # caps IN CONFIG (sharded workers build their own guards from it),
-    # keep the guard code in the path. Vardiff retargets are pushed out
-    # of the run so every share is credited at EASY in every leg — the
-    # PPLNS comparison needs identical credit, not mid-run retunes.
-    return ServerConfig(
-        host="127.0.0.1", port=0, initial_difficulty=EASY,
-        max_clients=max_clients,
-        vardiff=VardiffConfig(retarget_seconds=3600.0),
-        ddos=DDoSConfig(
-            max_concurrent_per_ip=1 << 20, connects_per_minute=1e12,
-            bytes_per_window=1 << 40,
-        ),
-    )
-
-
-def _make_ledger() -> PoolManager:
-    db = connect_database(":memory:")
-    return PoolManager(db, MockChainClient(), config=PoolConfig(
-        payout=PayoutConfig(
-            scheme=PayoutScheme.PPLNS, pplns_window=1 << 22,
-        ),
-    ))
-
-
-def _pplns_split(pool: PoolManager) -> dict[str, int]:
-    """The PPLNS payout split the leg's db would produce for one block:
-    the cross-leg invariant (worker -> atomic units)."""
-    window = pool.shares.last_n(pool.config.payout.pplns_window)
-    result = pool.calculator.calculate_block(REWARD, window)
-    return {p.worker: p.amount for p in result.payouts}
 
 
 async def _connect_ramp(miners: list[Miner], connect_rate: float) -> float:
@@ -911,30 +686,6 @@ def _spawn_fleet(port: int, connections: int, phase_shares: list[int],
         children.append((proc, parent_conn))
         base += n
     return _Fleet(children)
-
-
-def _hist_state(h) -> tuple[dict, int, float]:
-    """Snapshot a server-side accept histogram (cumulative counts,
-    count, sum) — phase percentiles come from DIFFS of these."""
-    return h.cumulative(), h.count, h.sum
-
-
-def _diff_quantile(before: tuple, after: tuple, q: float):
-    """Bucket-resolution quantile of the observations BETWEEN two
-    cumulative-histogram snapshots (the per-phase server percentile of
-    the ``--pace`` sweep). Same conservative upper-bound semantics as
-    LatencyHistogram.quantile — except beyond-top-bucket reports None
-    (JSON null) instead of float('inf'): the artifact must stay
-    strict-JSON parseable, and null is unambiguous "over the histogram's
-    top bound"."""
-    dcount = after[1] - before[1]
-    if dcount <= 0:
-        return 0.0
-    rank = q * dcount
-    for bound in sorted(after[0]):
-        if after[0][bound] - before[0].get(bound, 0) >= rank:
-            return bound
-    return None
 
 
 async def run_leg(connections: int, shares_per_conn: int, window: float,

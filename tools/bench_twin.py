@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Digital-twin bench: one seeded chaos run per pace rate, audited.
+
+Each ``--pace`` rate stands up a FRESH full deployment via
+``otedama_tpu.sim.DigitalTwin`` — fleet ledger + acceptor host child
+process (V1+V2), a second replicated region, durable chain, settlement,
+and the profit orchestrator on a scripted feed — drives the seeded
+population through the default chaos schedule at that offered rate, and
+records the run's three-way exactly-once audit alongside throughput and
+submit latency percentiles.
+
+The emitted ``BENCH_TWIN_*.json`` is designed to be re-run UNMODIFIED
+on an un-interposed host:
+
+    python tools/bench_twin.py --seed <seed from the artifact> \
+        --pace <rates from the artifact> --out BENCH_TWIN_yourhost.json
+
+Identical seeds replay the identical population and fault plan (see
+otedama_tpu/sim/scenario.py); only the wall-clock numbers move. The
+committed artifact's ``harness_calibration`` block records what the
+recording host's kernel could move at all (bare echo round-trips in the
+soak's process topology), so achieved shares/s are read as a fraction
+of that ceiling, not as absolute hardware truth.
+
+Exit code 2 when any run failed its audit or assertions.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+for _p in (os.path.dirname(_HERE), _HERE):
+    if _p not in sys.path:
+        sys.path.insert(0, _p)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import benchlib  # noqa: E402
+from otedama_tpu.sim import (  # noqa: E402
+    DigitalTwin,
+    TwinConfig,
+    build_population,
+    default_chaos,
+    distinct_points,
+)
+
+
+async def one_run(seed: int, pace: float, size: int,
+                  total_shares: int) -> dict:
+    twin = DigitalTwin(TwinConfig(
+        seed=seed, pace=pace,
+        population=build_population(seed, size=size,
+                                    total_shares=total_shares)))
+    report = await twin.run()
+    wall = max(report["wall_seconds"], 1e-9)
+    report["pace_offered"] = pace
+    report["achieved_shares_per_sec"] = round(
+        report["traffic"]["committed"] / wall, 2)
+    return report
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=22,
+                    help="scenario seed (population + fault plan)")
+    ap.add_argument("--pace", default="0,20",
+                    help="comma-separated offered rates in shares/s "
+                         "(0 = unpaced); one fresh twin per rate")
+    ap.add_argument("--size", type=int, default=12,
+                    help="population size (miners)")
+    ap.add_argument("--shares", type=int, default=40,
+                    help="total share quota across the population")
+    ap.add_argument("--quick", action="store_true",
+                    help="small population, unpaced only, short "
+                         "calibration")
+    ap.add_argument("--no-calibration", action="store_true",
+                    help="skip the echo-topology calibration")
+    ap.add_argument("--out", default="",
+                    help="artifact path (default BENCH_TWIN_r<seed>.json)")
+    args = ap.parse_args()
+
+    if args.quick:
+        args.size, args.shares, args.pace = 10, 28, "0"
+    rates = [float(r) for r in args.pace.split(",") if r.strip() != ""]
+    benchlib.ensure_fd_budget(4 * args.size, workers=4)
+
+    calibration = None
+    if not args.no_calibration:
+        print("calibrating harness ceiling (echo topology)...",
+              flush=True)
+        calibration = benchlib.harness_calibration(
+            dur=2.0 if args.quick else 8.0,
+            trials=1 if args.quick else 3)
+        print(f"  echo round-trips/s: {calibration:.0f}", flush=True)
+
+    runs = []
+    failures = []
+    for pace in rates:
+        label = "unpaced" if pace == 0 else f"{pace:g} shares/s"
+        print(f"twin run: seed={args.seed} pace={label} "
+              f"miners={args.size} quota={args.shares}", flush=True)
+        try:
+            report = asyncio.run(
+                one_run(args.seed, pace, args.size, args.shares))
+        except (AssertionError, Exception) as e:  # noqa: BLE001 - audit
+            # failures and harness faults both belong in the artifact
+            failures.append({"pace": pace,
+                             "error": f"{type(e).__name__}: {e}"})
+            print(f"  FAILED: {type(e).__name__}: {e}", flush=True)
+            continue
+        runs.append(report)
+        a = report["audit"]
+        print(f"  audit: exactly_once={a['exactly_once']} "
+              f"committed={a['committed_shares']} "
+              f"chain={a['chain_submissions']} "
+              f"points={report['chaos_fired']['distinct_points_fired']} "
+              f"wall={report['wall_seconds']}s "
+              f"rate={report['achieved_shares_per_sec']}/s", flush=True)
+
+    artifact = {
+        "bench": "twin",
+        "timestamp_utc": benchlib.utc_timestamp(),
+        "platform": benchlib.platform_block(calibration),
+        "scenario": {
+            "seed": args.seed,
+            "size": args.size,
+            "total_shares": args.shares,
+            "chaos_points": distinct_points(default_chaos()),
+        },
+        "rerun": ("python tools/bench_twin.py "
+                  f"--seed {args.seed} --size {args.size} "
+                  f"--shares {args.shares} --pace "
+                  + ",".join(f"{r:g}" for r in rates)),
+        "runs": runs,
+        "failures": failures,
+    }
+    out = args.out or f"BENCH_TWIN_r{args.seed}.json"
+    with open(out, "w") as fh:
+        json.dump(artifact, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    print(f"wrote {out}", flush=True)
+    return 2 if failures or not runs else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
