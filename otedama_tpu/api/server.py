@@ -697,6 +697,76 @@ class ApiServer:
                         snapshot.get("wallet_duplicates_avoided", 0),
                         help_="Re-submitted batches deduplicated by idempotency key")
 
+    def sync_worksource_metrics(self, snapshot: dict) -> None:
+        """Work-source tier health from a TemplateSource snapshot: the
+        template lifecycle (age, refresh latency, rejects — a stale or
+        rejected template means the job stream is serving old work) and
+        the AuxPoW merged-mining funnel (chains tracked, aux blocks
+        found/submitted/accepted/rejected, per chain)."""
+        reg = self.registry
+        reg.gauge_set("otedama_worksource_template_height",
+                      snapshot.get("template_height", 0),
+                      help_="Height of the last good template")
+        reg.gauge_set("otedama_worksource_template_age_seconds",
+                      snapshot.get("template_age_seconds", -1.0),
+                      help_="Seconds since the last good template "
+                            "(-1 = never fetched)")
+        reg.gauge_set("otedama_worksource_refresh_seconds",
+                      snapshot.get("refresh_ema_seconds", 0.0),
+                      help_="Template refresh latency (EMA over polls)")
+        reg.counter_set("otedama_worksource_templates_fetched_total",
+                        snapshot.get("templates_fetched", 0),
+                        help_="Templates fetched from the chain node")
+        reg.counter_set("otedama_worksource_templates_rejected_total",
+                        snapshot.get("templates_rejected", 0),
+                        help_="Templates rejected as impossible "
+                              "(last good job served on)")
+        reg.counter_set("otedama_worksource_rpc_failures_total",
+                        snapshot.get("rpc_failures", 0),
+                        help_="Template fetches that failed at the RPC layer")
+        reg.counter_set("otedama_worksource_jobs_emitted_total",
+                        snapshot.get("jobs_emitted", 0),
+                        help_="Jobs originated from local templates")
+        reg.counter_set("otedama_worksource_clean_jobs_total",
+                        snapshot.get("clean_jobs", 0),
+                        help_="Emitted jobs that flushed miner work "
+                              "(new tip)")
+        reg.counter_set("otedama_worksource_race_refreshes_total",
+                        snapshot.get("race_refreshes", 0),
+                        help_="Same-height template refreshes "
+                              "(template races / aux slate changes)")
+        aux = snapshot.get("aux") or {}
+        reg.gauge_set("otedama_worksource_aux_chains",
+                      aux.get("chains", 0),
+                      help_="Aux chains merged-mined against the parent")
+        reg.counter_set("otedama_worksource_aux_refresh_failures_total",
+                        aux.get("refresh_failures", 0),
+                        help_="Aux work refreshes that failed or returned "
+                              "invalid work (last good unit kept)")
+        reg.counter_set("otedama_worksource_aux_found_total",
+                        aux.get("found", 0),
+                        help_="Parent shares that met an aux chain target")
+        reg.counter_set("otedama_worksource_aux_submitted_total",
+                        aux.get("submitted", 0),
+                        help_="AuxPoW proofs submitted to aux chains")
+        reg.counter_set("otedama_worksource_aux_accepted_total",
+                        aux.get("accepted", 0),
+                        help_="Aux blocks accepted by their chains")
+        reg.counter_set("otedama_worksource_aux_rejected_total",
+                        aux.get("rejected", 0),
+                        help_="AuxPoW proofs rejected by their chains")
+        for name, per in (aux.get("per_chain") or {}).items():
+            labels = {"chain": name}
+            reg.counter_set("otedama_worksource_aux_chain_accepted_total",
+                            per.get("accepted", 0), labels=labels,
+                            help_="Aux blocks accepted, per chain")
+            reg.counter_set("otedama_worksource_aux_chain_rejected_total",
+                            per.get("rejected", 0), labels=labels,
+                            help_="AuxPoW proofs rejected, per chain")
+            reg.gauge_set("otedama_worksource_aux_chain_height",
+                          per.get("height", 0), labels=labels,
+                          help_="Last known aux work height, per chain")
+
     def sync_chain_metrics(self, chain: dict) -> None:
         """Durable share-chain health from a ShareChain snapshot (the
         ``chain`` sub-dict of the P2P snapshot): the memory bound (tail

@@ -25,7 +25,7 @@ from otedama_tpu.api.server import ApiConfig as ApiServerConfig, ApiServer
 from otedama_tpu.config.schema import AppConfig
 from otedama_tpu.engine.algo_manager import AlgorithmManager
 from otedama_tpu.engine.engine import EngineConfig, MiningEngine
-from otedama_tpu.engine.types import Job, Share
+from otedama_tpu.engine.types import Share
 from otedama_tpu.engine.vardiff import VardiffConfig
 from otedama_tpu.kernels import target as tgt
 from otedama_tpu.utils import compile_cache
@@ -71,8 +71,8 @@ class Application:
         self.profit_analyzer = None
         self.profit_orchestrator = None
         self.failover = None        # upstream failover manager (miner mode)
-        self._solo_jobs: dict[str, Job] = {}
-        self._solo_last_height = -1  # solo template gate (see _solo_job_loop)
+        self.worksource = None      # TemplateSource (pool or solo mode)
+        self.auxwork = None         # AuxWorkManager (merged mining)
         # engine restarts are requested by two supervisors (failure detector
         # and recovery manager); serialize them or interleaved stop/start
         # orphans search tasks
@@ -153,20 +153,35 @@ class Application:
             # solo: submit headers that meet the network target to the chain
             if self.engine is not None:
                 self.engine.stats.shares_accepted += 1
-            job = self._solo_jobs.get(share.job_id)
+            source = self.worksource
+            job = source.get_job(share.job_id) if source is not None else None
             if job is None:
                 return
-            if tgt.hash_meets_target(share.digest, tgt.bits_to_target(job.nbits)):
+            block = tgt.hash_meets_target(
+                share.digest, tgt.bits_to_target(job.nbits))
+            offer_aux = source is not None and source.aux is not None
+            if block or offer_aux:
                 from otedama_tpu.engine.jobs import header_from_share
 
                 header = header_from_share(
                     job, share.extranonce2, share.ntime, share.nonce_word
                 )
+            if block:
                 outcome = await self.chain.submit_block(header)
                 if outcome.accepted:
                     log.info("solo block accepted: %s", outcome.block_hash[:24])
                 else:
                     log.warning("solo block rejected: %s", outcome.reason)
+            if offer_aux:
+                # every solo share gets its shot at the aux slates too —
+                # failures must never poison the parent submit path
+                try:
+                    await source.on_accepted_share(
+                        share.job_id, share.digest, header, b"",
+                        share.extranonce2, self.config.mining.worker_name,
+                    )
+                except Exception:
+                    log.exception("solo aux offer failed")
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -388,7 +403,74 @@ class Application:
                 )
         await self.pool.start()
         self._started.append(self.pool)
-        self._tasks.append(asyncio.create_task(self._template_loop(chain)))
+        self._start_worksource(chain, pool_cfg)
+
+    def _start_worksource(self, chain, pool_cfg) -> None:
+        """The pool's own upstream: a TemplateSource originating jobs from
+        the chain node, with AuxPoW merged mining layered on when aux
+        chains are configured (otedama_tpu/work/)."""
+        from otedama_tpu.work.template import TemplateSource
+
+        cfg = self.config
+        aux = None
+        if cfg.work.aux_chains:
+            from otedama_tpu.work.aux import AuxWorkManager, build_aux_clients
+
+            aux = AuxWorkManager(
+                build_aux_clients(cfg.work.aux_chains),
+                blocks=self.pool.blocks,
+                confirmations_required=cfg.work.aux_confirmations,
+            )
+            self.auxwork = aux
+        source = TemplateSource(
+            chain, pool=self.pool, aux=aux,
+            algorithm=self._pool_algorithm,
+            poll_seconds=(cfg.work.poll_seconds if cfg.work.enabled
+                          else pool_cfg.template_poll_seconds),
+            extranonce2_size=cfg.stratum.extranonce2_size,
+            payout_script=bytes.fromhex(cfg.work.payout_script),
+            coinbase_tag=cfg.work.coinbase_tag.encode(),
+        )
+        source.add_sink(self._fan_out_job)
+        self.worksource = source
+        if aux is not None:
+            # aux offers ride the accepted-share path — the manager calls
+            # the hook AFTER the books commit, so merged mining can never
+            # gate parent accounting
+            self.pool.work_source = source
+        self._tasks.append(asyncio.create_task(source.run()))
+        if aux is not None:
+            self._tasks.append(asyncio.create_task(self._aux_sweep_loop(aux)))
+
+    def _fan_out_job(self, job, clean: bool) -> None:
+        """TemplateSource sink: the same set_job fan-out the upstream
+        stratum path uses (V1 + V2 surfaces alike)."""
+        if self.server is not None:
+            self.server.set_job(job, clean=clean)
+        if self.server_v2 is not None:
+            self.server_v2.set_job(job, clean=clean)
+
+    async def _aux_sweep_loop(self, aux) -> None:
+        """Confirmation sweep for found aux blocks: one loop polls every
+        aux chain's node, mirroring BlockSubmitter's pending poll so
+        chain-tagged rows mature into the same settlement stream."""
+        poll = self.pool.submitter.config.confirm_poll_seconds
+        while True:
+            await asyncio.sleep(poll)
+            try:
+                await aux.check_pending()
+            except Exception:
+                log.exception("aux confirmation sweep failed")
+
+    def _retarget_solo_worksource(self, algorithm: str) -> None:
+        """Profit switch follow-through for SOLO mode only: relabel the
+        template source and force an immediate re-issue. The pool-mode
+        source deliberately stays on the snapshotted pool algorithm — a
+        miner-side switch must never re-label the pool's jobs out from
+        under its external miners."""
+        if self.chain is not None and self.worksource is not None:
+            self.worksource.algorithm = algorithm
+            self.worksource.reissue()
 
     async def _start_stratum_listeners(self) -> None:
         """Open the stratum listening sockets (see start() for why this
@@ -415,25 +497,6 @@ class Application:
         ))
         await self.fleet.start()
         self._started.append(self.fleet)
-
-    async def _template_loop(self, chain) -> None:
-        """Poll the chain for templates and broadcast jobs (pool mode)."""
-        last_height = -1
-        while True:
-            try:
-                t = await chain.get_block_template()
-                if t.height != last_height and self.pool is not None:
-                    job = self.pool.job_from_template(
-                        t, algorithm=self._pool_algorithm
-                    )
-                    last_height = t.height
-                    if self.server is not None:
-                        self.server.set_job(job, clean=True)
-                    if self.server_v2 is not None:
-                        self.server_v2.set_job(job, clean=True)
-            except Exception:
-                log.exception("template poll failed")
-            await asyncio.sleep(self.pool.config.template_poll_seconds if self.pool else 5.0)
 
     async def _start_miner_side(self) -> None:
         self.engine = self._build_engine()
@@ -494,7 +557,31 @@ class Application:
                 if cfg.pool.chain_rpc_url
                 else MockChainClient()
             )
-            self._tasks.append(asyncio.create_task(self._solo_job_loop()))
+            from otedama_tpu.work.template import TemplateSource
+
+            source = TemplateSource(
+                self.chain, algorithm=cfg.mining.algorithm,
+                poll_seconds=(cfg.work.poll_seconds if cfg.work.enabled
+                              else 5.0),
+                # solo shares carry no extranonce1 — the coinbase gap is
+                # extranonce2 alone
+                extranonce1_len=0,
+                payout_script=bytes.fromhex(cfg.work.payout_script),
+                coinbase_tag=cfg.work.coinbase_tag.encode(),
+            )
+            if cfg.work.aux_chains:
+                from otedama_tpu.work.aux import (
+                    AuxWorkManager, build_aux_clients,
+                )
+
+                source.aux = AuxWorkManager(
+                    build_aux_clients(cfg.work.aux_chains),
+                    confirmations_required=cfg.work.aux_confirmations,
+                )
+                self.auxwork = source.aux
+            source.add_sink(lambda job, clean: self.engine.set_job(job))
+            self.worksource = source
+            self._tasks.append(asyncio.create_task(source.run()))
         if cfg.mining.precompile and any(
             getattr(b, "precompile", None) is not None
             for b in self.engine.backends.values()
@@ -634,42 +721,6 @@ class Application:
         log.info("retargeting upstreams for %s: %s",
                  plan.coin, [u.name for u in ups])
         await self._connect_upstream(self.failover.select())
-
-    async def _solo_job_loop(self) -> None:
-        counter = 0
-        # instance attr, not a local: an algorithm switch resets it to
-        # force an immediate re-issue of the current template under the
-        # new algorithm label (otherwise the engine idles until the next
-        # block arrives)
-        self._solo_last_height = -1
-        while True:
-            try:
-                t = await self.chain.get_block_template()
-                if t.height != self._solo_last_height:
-                    counter += 1
-                    self._solo_last_height = t.height
-                    job = Job(
-                        job_id=f"solo-{counter:x}",
-                        prev_hash=t.prev_hash,
-                        coinb1=t.coinb1,
-                        coinb2=t.coinb2,
-                        merkle_branch=t.merkle_branch,
-                        version=t.version,
-                        nbits=t.nbits,
-                        ntime=t.ntime,
-                        clean=True,
-                        algorithm=self.config.mining.algorithm,
-                        share_target=tgt.bits_to_target(t.nbits),
-                    )
-                    self._solo_jobs[job.job_id] = job
-                    if len(self._solo_jobs) > 64:
-                        for jid in list(self._solo_jobs)[:-32]:
-                            del self._solo_jobs[jid]
-                    if self.engine is not None:
-                        self.engine.set_job(job)
-            except Exception:
-                log.exception("solo template poll failed")
-            await asyncio.sleep(5.0)
 
     async def _start_p2p(self) -> None:
         from otedama_tpu.p2p.node import NodeConfig
@@ -859,6 +910,8 @@ class Application:
             self.api.add_provider("p2p", self.p2p.snapshot)
         if self.regions is not None:
             self.api.add_provider("region", self.regions.snapshot)
+        if self.worksource is not None:
+            self.api.add_provider("worksource", self.worksource.snapshot)
         if self.settlement is not None:
             self.api.add_provider("settlement", self.settlement.snapshot)
             # operator surface: carried balances + pending/recent payouts
@@ -991,7 +1044,7 @@ class Application:
             self.config.mining.algorithm = algorithm
             if self.client is not None:
                 self.client.config.algorithm = algorithm
-            self._solo_last_height = -1
+            self._retarget_solo_worksource(algorithm)
             log.info("algorithm switched to %s", algorithm)
             return downtime
 
@@ -1004,7 +1057,7 @@ class Application:
             self.config.mining.algorithm = incumbent
             if self.client is not None:
                 self.client.config.algorithm = incumbent
-            self._solo_last_height = -1
+            self._retarget_solo_worksource(incumbent)
 
         coins = {}
         for coin, spec in (pcfg.coins or {}).items():
@@ -1280,6 +1333,8 @@ class Application:
                 )
             if self.settlement is not None:
                 self.api.sync_settlement_metrics(self.settlement.snapshot())
+            if self.worksource is not None:
+                self.api.sync_worksource_metrics(self.worksource.snapshot())
             if self.validator is not None:
                 self.api.sync_validation_metrics(self.validator)
             from otedama_tpu.utils import native_batch as _nb
@@ -1319,7 +1374,12 @@ class Application:
             except Exception:
                 log.exception("stopping %s failed", type(component).__name__)
         self._started.clear()
-        for chain in (self.chain, getattr(self.pool, "chain", None)):
+        aux_clients = (
+            list(self.auxwork.clients.values()) if self.auxwork is not None
+            else []
+        )
+        for chain in (self.chain, getattr(self.pool, "chain", None),
+                      *aux_clients):
             close = getattr(chain, "close", None)
             if close is not None:
                 try:
@@ -1349,6 +1409,8 @@ class Application:
             out["region"] = self.regions.snapshot()
         if self.settlement is not None:
             out["settlement"] = self.settlement.snapshot()
+        if self.worksource is not None:
+            out["worksource"] = self.worksource.snapshot()
         from otedama_tpu.utils import native_batch as _nb
 
         out["native"] = _nb.snapshot()

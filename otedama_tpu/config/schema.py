@@ -234,6 +234,32 @@ class SettlementSettings:
 
 
 @dataclasses.dataclass
+class WorkSettings:
+    """Work-source tier (otedama_tpu/work): the pool as its own upstream.
+    When enabled, a ``TemplateSource`` polls the chain node configured in
+    ``pool.chain_rpc_url`` (or the in-process mock chain when unset),
+    assembles coinbases locally, and originates jobs — no upstream
+    stratum client required. ``aux_chains`` turns on AuxPoW merged
+    mining: every listed chain's work unit is committed in the parent
+    coinbase, so one nonce search settles them all."""
+
+    enabled: bool = False
+    # seconds between template polls (refresh/longpoll cadence)
+    poll_seconds: float = 2.0
+    # hex scriptPubKey paid by locally built coinbases; "" keeps the
+    # node-shipped coinbase halves (mock/regtest) or pays an empty script
+    payout_script: str = ""
+    # marker pushed in the coinbase scriptSig after the BIP34 height
+    coinbase_tag: str = "/otedama/"
+    # merged-mining aux chains, comma-separated: "name" entries get an
+    # in-process mock aux chain (tests/dry runs); "name=url" entries a
+    # JSON-RPC client. [] / "" disables merged mining.
+    aux_chains: str = ""
+    # confirmations before an aux block row settles
+    aux_confirmations: int = 6
+
+
+@dataclasses.dataclass
 class RegionSettings:
     """Multi-region pool replication (pool/regions.py): several stratum
     front-ends ("regions") serve one logical pool over the shared share
@@ -429,6 +455,7 @@ class AppConfig:
     pool: PoolSettings = dataclasses.field(default_factory=PoolSettings)
     settlement: SettlementSettings = dataclasses.field(
         default_factory=SettlementSettings)
+    work: WorkSettings = dataclasses.field(default_factory=WorkSettings)
     region: RegionSettings = dataclasses.field(default_factory=RegionSettings)
     validation: ValidationSettings = dataclasses.field(
         default_factory=ValidationSettings)
@@ -446,6 +473,7 @@ _SECTIONS = {
     "stratum": StratumSettings,
     "pool": PoolSettings,
     "settlement": SettlementSettings,
+    "work": WorkSettings,
     "region": RegionSettings,
     "validation": ValidationSettings,
     "p2p": P2PConfig,
@@ -632,6 +660,26 @@ def validate_config(cfg: AppConfig) -> list[str]:
         )
     if cfg.settlement.interval <= 0:
         errors.append("settlement.interval must be positive")
+    if cfg.work.poll_seconds <= 0:
+        errors.append("work.poll_seconds must be positive")
+    if cfg.work.aux_confirmations < 1:
+        errors.append("work.aux_confirmations must be >= 1")
+    if cfg.work.payout_script:
+        try:
+            bytes.fromhex(cfg.work.payout_script)
+        except ValueError:
+            errors.append("work.payout_script must be hex")
+    if cfg.work.aux_chains:
+        seen_aux = set()
+        for entry in cfg.work.aux_chains.split(","):
+            name = entry.split("=", 1)[0].strip()
+            if not name:
+                errors.append("work.aux_chains has an empty chain name")
+            elif name in seen_aux or name == "parent":
+                errors.append(
+                    f"work.aux_chains name {name!r} duplicate or reserved "
+                    "('parent' tags the primary chain's block rows)")
+            seen_aux.add(name)
     if cfg.settlement.drain_timeout <= 0:
         errors.append("settlement.drain_timeout must be positive")
     if cfg.region.enabled:
@@ -830,6 +878,17 @@ settlement:
   enabled: false       # crash-safe exactly-once payouts (needs pool + p2p)
   interval: 60.0       # seconds between settlement ticks
   drain_timeout: 10.0  # stop(): bound on waiting out an in-flight tick
+
+work:
+  enabled: false       # work-source tier: originate jobs from a chain node
+                       # (pool.chain_rpc_url, or the in-process mock chain)
+                       # instead of an upstream stratum server
+  poll_seconds: 2.0    # template refresh cadence (longpoll analogue)
+  payout_script: ""    # hex scriptPubKey for locally built coinbases
+  coinbase_tag: /otedama/  # scriptSig marker after the BIP34 height push
+  aux_chains: ""       # AuxPoW merged mining: "namecoin,syscoin" (mock
+                       # aux chains) or "namecoin=http://127.0.0.1:8336"
+  aux_confirmations: 6 # confirmations before an aux block row settles
 
 region:
   enabled: false       # multi-region pool replication (needs pool + p2p)

@@ -203,6 +203,15 @@ MIGRATIONS: list[tuple[int, str]] = [
     CREATE INDEX idx_payout_txs_worker ON payout_txs(worker);
     CREATE INDEX idx_payout_txs_status ON payout_txs(status);
     """),
+    # merged mining (otedama_tpu/work): block rows are chain-tagged so
+    # the parent submitter and each aux chain's confirmation sweep poll
+    # ONLY their own node (a parent reorg must never orphan an aux row),
+    # while settlement keeps consuming ONE unsettled_confirmed() stream
+    # across every chain — per-chain splits derive from the same rows.
+    (4, """
+    ALTER TABLE blocks ADD COLUMN chain TEXT NOT NULL DEFAULT 'parent';
+    CREATE INDEX idx_blocks_chain_status ON blocks(chain, status);
+    """),
 ]
 
 

@@ -160,11 +160,13 @@ class BlockRepository:
     def __init__(self, db: Database):
         self.db = db
 
-    def create(self, block_hash: str, worker: str, height: int = 0, reward: int = 0) -> int:
+    def create(self, block_hash: str, worker: str, height: int = 0,
+               reward: int = 0, chain: str = "parent") -> int:
         cur = self.db.execute(
-            """INSERT INTO blocks (height, hash, worker, reward, created_at)
-               VALUES (?,?,?,?,?)""",
-            (height, block_hash, worker, reward, time.time()),
+            """INSERT INTO blocks (height, hash, worker, reward, chain,
+                                   created_at)
+               VALUES (?,?,?,?,?,?)""",
+            (height, block_hash, worker, reward, chain, time.time()),
         )
         return cur.lastrowid
 
@@ -174,9 +176,16 @@ class BlockRepository:
             (status, confirmations, block_hash),
         )
 
-    def pending(self) -> list[dict]:
+    def pending(self, chain: str | None = None) -> list[dict]:
+        """Pending rows, optionally one chain's — each chain's
+        confirmation sweep must poll only its own node."""
+        if chain is None:
+            return [dict(r) for r in self.db.query(
+                "SELECT * FROM blocks WHERE status='pending' ORDER BY id"
+            )]
         return [dict(r) for r in self.db.query(
-            "SELECT * FROM blocks WHERE status='pending' ORDER BY id"
+            "SELECT * FROM blocks WHERE status='pending' AND chain=? "
+            "ORDER BY id", (chain,)
         )]
 
     def list(self, limit: int = 100) -> list[dict]:
@@ -197,6 +206,16 @@ class BlockRepository:
             "UPDATE blocks SET settled_skey=? WHERE id=?",
             [(skey, bid) for bid in block_ids],
         )
+
+    def rewards_by_chain(self, skey: str) -> dict[str, int]:
+        """Per-chain reward totals of one settlement's consumed blocks —
+        the input to the merged-mining per-chain credit split."""
+        return {
+            r["chain"]: int(r["total"]) for r in self.db.query(
+                "SELECT chain, SUM(reward) AS total FROM blocks "
+                "WHERE settled_skey=? GROUP BY chain", (skey,)
+            )
+        }
 
 
 class PayoutRepository:

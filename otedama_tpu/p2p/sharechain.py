@@ -102,13 +102,24 @@ class ChainParams:
         return difficulty_to_target(self.min_difficulty)
 
 
+def tagged_sha256d(tag: bytes, *fields: bytes) -> bytes:
+    """Domain-separated sha256d over NUL-joined fields.
+
+    Every commitment in the system hashes under a distinct tag so a digest
+    valid in one role can never be replayed in another (share-chain claim
+    vs settlement key vs aux-chain slot). The work-source tier's AuxPoW
+    commitments (otedama_tpu/work/aux.py) reuse this exact construction.
+    """
+    return pow_host.sha256d(tag + b"\0" + b"\0".join(fields))
+
+
 def commitment(worker: str, job_id: str, ts_ms: int, algorithm: str,
                block_number: int) -> bytes:
     """The 32-byte claim commitment carried in the header's merkle field."""
-    return pow_host.sha256d(
-        COMMIT_TAG + b"\0" + worker.encode() + b"\0" + job_id.encode()
-        + b"\0" + struct.pack("<Q", ts_ms) + b"\0" + algorithm.encode()
-        + b"\0" + struct.pack("<q", block_number)
+    return tagged_sha256d(
+        COMMIT_TAG, worker.encode(), job_id.encode(),
+        struct.pack("<Q", ts_ms), algorithm.encode(),
+        struct.pack("<q", block_number),
     )
 
 

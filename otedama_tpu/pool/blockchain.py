@@ -14,14 +14,42 @@ import asyncio
 import dataclasses
 import json
 import logging
-import secrets
+import struct
 import time
 from typing import Protocol
 
 from otedama_tpu.kernels import target as tgt
+from otedama_tpu.utils import faults
 from otedama_tpu.utils.sha256_host import sha256d
 
 log = logging.getLogger("otedama.pool.chain")
+
+
+async def _rpc_gate(method: str) -> faults.Directive:
+    """Chaos seam for every chain-RPC call (mock and real clients alike).
+
+    ``error``/``crash`` raise from inside :func:`faults.hit`; ``delay`` is
+    awaited here so the event loop (not the executor) absorbs the stall;
+    ``corrupt`` is returned for the caller to mangle its result — each
+    method substitutes the degenerate value its consumers must reject
+    loudly (see docs/FAULT_INJECTION.md, ``chain.rpc`` row).
+    """
+    d = faults.hit("chain.rpc", method, supports=faults.DEVICE)
+    if d is None:
+        return faults.Directive()
+    if d.delay:
+        await asyncio.sleep(d.delay)
+    return d
+
+
+def _corrupt_template() -> BlockTemplate:
+    """The wrong-result mode for template fetches: structurally present but
+    semantically impossible, so TemplateSource's validation MUST catch it
+    (height < 0, empty prev hash, zero nbits)."""
+    return BlockTemplate(
+        height=-1, prev_hash=b"", coinb1=b"", coinb2=b"",
+        merkle_branch=[], version=0, nbits=0, ntime=0, reward=0,
+    )
 
 
 @dataclasses.dataclass
@@ -55,21 +83,69 @@ class BlockchainClient(Protocol):
 
 
 class MockChainClient:
-    """In-process regtest-style chain for tests and solo-mode dry runs."""
+    """In-process regtest-style chain for tests and solo-mode dry runs.
 
-    def __init__(self, nbits: int = 0x207FFFFF, reward: int = 50 * 100_000_000):
+    Deterministic by construction: templates derive entirely from the chain
+    state (height, tip, an explicit race counter), never from entropy, so a
+    seeded test replays bit-identically. Two knobs grow it into a reorg /
+    template-race harness for the work-source tier:
+
+    - ``bump_template()`` stages a SECOND distinct template at the current
+      height (the getblocktemplate race a real node exhibits when its
+      mempool churns between polls) — same height + prev, different
+      coinbase bytes, so refresh paths that key on height alone miss it.
+    - ``reorg(depth)`` rewinds the tip onto a fork: the orphaned blocks'
+      hashes vanish from the confirmation index (``get_confirmations``
+      answers -1, exactly like bitcoind for a block off the active chain)
+      and subsequent templates build on the fork tip.
+    - ``reject_stale=True`` refuses submits whose prev-hash is not the
+      current tip (``stale-prevblk``), the real-node behavior a solo pool
+      must survive across a reorg. Off by default: chaos tests predating
+      this knob submit headers minted against synthetic jobs.
+    """
+
+    def __init__(self, nbits: int = 0x207FFFFF, reward: int = 50 * 100_000_000,
+                 *, reject_stale: bool = False):
         self.nbits = nbits
         self.reward = reward
         self.height = 100
         self.tip = b"\x00" * 32
+        self.reject_stale = reject_stale
         self.submitted: list[tuple[int, bytes, str]] = []
         self.confirmations: dict[str, int] = {}
+        self.template_nonce = 0     # bumped per race/reorg: changes coinb1
+        self.reorgs = 0
+
+    def bump_template(self) -> None:
+        """Stage a template race: the next template shares height+prev with
+        the last one but carries different coinbase bytes."""
+        self.template_nonce += 1
+
+    def reorg(self, depth: int) -> None:
+        """Rewind ``depth`` blocks onto a deterministic fork tip. The
+        orphaned submits become unknown to ``get_confirmations`` (-1)."""
+        depth = min(depth, len(self.submitted))
+        if depth <= 0:
+            return
+        for _, _, orphaned_hash in self.submitted[-depth:]:
+            self.confirmations.pop(orphaned_hash, None)
+        del self.submitted[-depth:]
+        self.height -= depth
+        self.reorgs += 1
+        # fork tip: deterministic, distinct from every honest tip
+        self.tip = sha256d(b"mock-fork" + struct.pack("<II", self.height,
+                                                      self.reorgs))
+        self.template_nonce += 1
 
     async def get_block_template(self) -> BlockTemplate:
+        d = await _rpc_gate("template")
+        if d.corrupt:
+            return _corrupt_template()
         return BlockTemplate(
             height=self.height + 1,
             prev_hash=self.tip,
-            coinb1=bytes.fromhex("01000000010000000000000000") + secrets.token_bytes(4),
+            coinb1=bytes.fromhex("01000000010000000000000000")
+            + struct.pack("<I", (self.height + 1) ^ (self.template_nonce << 20)),
             coinb2=bytes.fromhex("ffffffff0100f2052a01000000"),
             merkle_branch=[],
             version=0x20000000,
@@ -79,8 +155,13 @@ class MockChainClient:
         )
 
     async def submit_block(self, header: bytes) -> SubmitOutcome:
+        d = await _rpc_gate("submit")
+        if d.corrupt:
+            return SubmitOutcome(False, reason="rpc-corrupt")
         if len(header) != 80:
             return SubmitOutcome(False, reason="bad header size")
+        if self.reject_stale and header[4:36] != self.tip:
+            return SubmitOutcome(False, reason="stale-prevblk")
         digest = sha256d(header)
         if not tgt.hash_meets_target(digest, tgt.bits_to_target(self.nbits)):
             return SubmitOutcome(False, reason="high-hash")
@@ -93,12 +174,18 @@ class MockChainClient:
         return SubmitOutcome(True, block_hash=block_hash)
 
     async def get_confirmations(self, block_hash: str) -> int:
+        d = await _rpc_gate("confirmations")
+        if d.corrupt:
+            return 0
         if block_hash not in self.confirmations:
             return -1  # orphaned / unknown
         self.confirmations[block_hash] += 1
         return self.confirmations[block_hash]
 
     async def get_network_difficulty(self) -> float:
+        d = await _rpc_gate("difficulty")
+        if d.corrupt:
+            return 0.0
         return tgt.target_to_difficulty(tgt.bits_to_target(self.nbits))
 
 
@@ -181,6 +268,9 @@ class BitcoinRPCClient:
         return self._pool.snapshot()
 
     async def get_block_template(self) -> BlockTemplate:
+        d = await _rpc_gate("template")
+        if d.corrupt:
+            return _corrupt_template()
         t = await self._rpc("getblocktemplate", [{"rules": ["segwit"]}])
         # NOTE: coinbase construction from template transactions is chain-
         # specific; here we expose the raw template fields the stratum job
@@ -199,12 +289,18 @@ class BitcoinRPCClient:
         )
 
     async def submit_block(self, header: bytes) -> SubmitOutcome:
+        d = await _rpc_gate("submit")
+        if d.corrupt:
+            return SubmitOutcome(False, reason="rpc-corrupt")
         res = await self._rpc("submitblock", [header.hex()])
         if res is None:
             return SubmitOutcome(True, block_hash=sha256d(header)[::-1].hex())
         return SubmitOutcome(False, reason=str(res))
 
     async def get_confirmations(self, block_hash: str) -> int:
+        d = await _rpc_gate("confirmations")
+        if d.corrupt:
+            return 0
         try:
             block = await self._rpc("getblock", [block_hash])
             return int(block.get("confirmations", 0))
@@ -212,4 +308,7 @@ class BitcoinRPCClient:
             return -1
 
     async def get_network_difficulty(self) -> float:
+        d = await _rpc_gate("difficulty")
+        if d.corrupt:
+            return 0.0
         return float(await self._rpc("getdifficulty"))

@@ -118,6 +118,11 @@ class PoolManager:
         # the local db write — the chain is the authoritative
         # cross-region accounting, the db this region's operational copy
         self.replicator = None
+        # work-source tier (otedama_tpu/work): when set, every accepted
+        # share is offered to the aux-chain slates AFTER its books
+        # commit — an aux hit must never gate or reorder parent
+        # accounting, and an aux outage must never reject a share
+        self.work_source = None
         # device-batched re-validation (runtime/validate.py): when set,
         # every ledger batch is re-verified on the accelerator BEFORE
         # anything is chain-committed or booked — the authoritative
@@ -213,6 +218,7 @@ class PoolManager:
         # only after the commit: a rolled-back first share must retry
         # its upsert, not skip it
         self._known_workers.add(worker)
+        await self._offer_aux(share)
 
     # -- group-commit share intake (sharded front-end) -----------------------
 
@@ -329,7 +335,27 @@ class PoolManager:
             for i in live:
                 if outcomes[i][0] == "ok":
                     outcomes[i] = ("err", msg)
-        return self._note_batch(outcomes)
+        res = self._note_batch(outcomes)
+        if self.work_source is not None:
+            for i, (status, _) in enumerate(outcomes):
+                if status == "ok":
+                    await self._offer_aux(batch[i])
+        return res
+
+    async def _offer_aux(self, share: AcceptedShare) -> None:
+        """Give one committed share its shot at the aux slates (merged
+        mining). Failures are counted + logged by the aux manager; they
+        must never surface into the share's already-delivered verdict."""
+        ws = self.work_source
+        if ws is None:
+            return
+        try:
+            await ws.on_accepted_share(
+                share.job_id, share.digest, share.header,
+                share.extranonce1, share.extranonce2, share.worker_user,
+            )
+        except Exception:
+            log.exception("aux offer failed for job %s", share.job_id)
 
     def _note_batch(
         self, outcomes: list[tuple[str, str]]

@@ -108,6 +108,38 @@ def payout_key(tip_id: bytes, worker: str) -> str:
     ).hex()
 
 
+def split_credits_by_chain(credits: dict[str, int],
+                           chain_rewards: dict[str, int]) -> dict[str, dict[str, int]]:
+    """Exact per-chain attribution of one settlement's worker credits.
+
+    Merged mining feeds settlement ONE pot (parent + aux block rewards
+    consumed by the same tick); this derives how much of each worker's
+    credit came from each chain. Largest-remainder apportionment per
+    worker, chains tie-broken by name: every worker's per-chain amounts
+    sum EXACTLY to their credit (no atomic unit minted or lost), and the
+    result is a pure function of its inputs — an auditor recomputing
+    from the ledger rows gets bit-identical numbers.
+    """
+    total = sum(chain_rewards.values())
+    if total <= 0 or not chain_rewards:
+        return {w: {} for w in credits}
+    names = sorted(chain_rewards)
+    out: dict[str, dict[str, int]] = {}
+    for worker, amount in credits.items():
+        floors = {}
+        remainders = []
+        assigned = 0
+        for name in names:
+            exact = amount * chain_rewards[name]
+            floors[name] = exact // total
+            assigned += floors[name]
+            remainders.append((-(exact % total), name))
+        for _, name in sorted(remainders)[: amount - assigned]:
+            floors[name] += 1
+        out[worker] = floors
+    return out
+
+
 class SettleInterrupted(RuntimeError):
     """A settlement tick aborted mid-pipeline (injected or real); the
     ledger holds the completed prefix and the next tick replays."""
@@ -526,6 +558,21 @@ class SettlementEngine:
         return {
             "pending": self.payout_txs.pending(),
             "recent": self.payout_txs.recent(limit),
+        }
+
+    def chain_split(self, skey: str) -> dict:
+        """Per-chain, per-worker attribution of one settlement (merged
+        mining): derived from the ledger rows alone, so any auditor can
+        recompute it — see ``split_credits_by_chain``."""
+        rewards = self.blocks.rewards_by_chain(skey)
+        credits = {
+            c["worker"]: int(c["amount"])
+            for c in self.settlements.credits_for(skey)
+        }
+        return {
+            "skey": skey,
+            "chain_rewards": rewards,
+            "split": split_credits_by_chain(credits, rewards),
         }
 
     def snapshot(self) -> dict:

@@ -31,10 +31,15 @@ class BlockSubmitter:
         chain: BlockchainClient,
         blocks: BlockRepository | None = None,
         config: SubmitterConfig | None = None,
+        chain_name: str = "parent",
     ):
         self.chain = chain
         self.blocks = blocks
         self.config = config or SubmitterConfig()
+        # which chain's rows this submitter owns: the confirmation sweep
+        # must never poll the parent node for an aux chain's hashes (it
+        # would answer -1 and falsely orphan them)
+        self.chain_name = chain_name
         self._confirm_task: asyncio.Task | None = None
 
     async def submit(self, header: bytes, worker: str, reward: int = 0) -> SubmitOutcome:
@@ -60,7 +65,8 @@ class BlockSubmitter:
                 break
             await asyncio.sleep(self.config.retry_delay * (attempt + 1))
         if self.blocks is not None and last.accepted:
-            self.blocks.create(last.block_hash, worker, reward=reward)
+            self.blocks.create(last.block_hash, worker, reward=reward,
+                               chain=self.chain_name)
         if not last.accepted:
             log.warning("block submit failed for %s: %s", worker, last.reason)
         return last
@@ -90,7 +96,7 @@ class BlockSubmitter:
     async def check_pending(self) -> None:
         if self.blocks is None:
             return
-        for block in self.blocks.pending():
+        for block in self.blocks.pending(chain=self.chain_name):
             try:
                 confs = await self.chain.get_confirmations(block["hash"])
             except Exception as e:

@@ -177,6 +177,10 @@ class AcceptedShare:
     # its submission id without a second host hash of the same header
     algorithm: str = "sha256d"
     block_number: int = 0
+    # the session's extranonce1 lease: with coinb1/coinb2 + extranonce2
+    # it lets the work-source tier rebuild the EXACT coinbase bytes this
+    # share hashed — what an AuxPoW proof must carry (otedama_tpu/work)
+    extranonce1: bytes = b""
 
 
 ShareHook = Callable[[AcceptedShare], Awaitable[None]]
@@ -970,6 +974,7 @@ class StratumServer:
             submitted_at=time.time(),
             algorithm=job.algorithm,
             block_number=job.block_number,
+            extranonce1=session.extranonce1,
         )
         outcome = ShareOutcome.BLOCK_FOUND if is_block else ShareOutcome.ACCEPTED
         return outcome, accepted

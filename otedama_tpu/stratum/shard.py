@@ -243,6 +243,8 @@ def encode_share_frame(seq: int, s: AcceptedShare) -> bytes:
         struct.pack(">H", len(algo)),
         algo,
         struct.pack(">I", s.block_number & 0xFFFFFFFF),
+        struct.pack(">H", len(s.extranonce1)),
+        s.extranonce1,
     ))
     return struct.pack(">I", len(body)) + body
 
@@ -276,6 +278,10 @@ def decode_share_frame(body: bytes) -> tuple[int, AcceptedShare]:
     algorithm = body[off:off + alen].decode()
     off += alen
     (block_number,) = struct.unpack_from(">I", body, off)
+    off += 4
+    (e1len,) = struct.unpack_from(">H", body, off)
+    off += 2
+    extranonce1 = body[off:off + e1len]
     if len(header) != 80:
         raise ValueError("binary share frame truncated")
     return seq, AcceptedShare(
@@ -284,7 +290,7 @@ def decode_share_frame(body: bytes) -> tuple[int, AcceptedShare]:
         header=header, extranonce2=extranonce2, ntime=ntime,
         nonce_word=nonce_word, is_block=bool(is_block),
         submitted_at=submitted_at, algorithm=algorithm,
-        block_number=block_number,
+        block_number=block_number, extranonce1=extranonce1,
     )
 
 
@@ -388,6 +394,7 @@ def share_to_wire(s: AcceptedShare) -> dict:
         "submitted_at": s.submitted_at,
         "algorithm": s.algorithm,
         "block_number": s.block_number,
+        "extranonce1": s.extranonce1.hex(),
     }
 
 
@@ -407,6 +414,7 @@ def share_from_wire(d: dict) -> AcceptedShare:
         submitted_at=float(d["submitted_at"]),
         algorithm=str(d.get("algorithm", "sha256d")),
         block_number=int(d.get("block_number", 0)),
+        extranonce1=bytes.fromhex(d.get("extranonce1", "")),
     )
 
 

@@ -1464,6 +1464,10 @@ class Sv2MiningServer:
             submitted_at=time.time(),
             algorithm=job.algorithm,
             block_number=job.block_number,
+            # V2 coinbases assemble as coinb1 + job.extranonce1 + the
+            # channel's fixed extranonce2 (build_coinbase above) — job
+            # extranonce1 IS this share's en1 for coinbase rebuilds
+            extranonce1=job.extranonce1,
         )
         # persist BEFORE the success frame (V1 server parity): an accept
         # the miner saw must be in the books exactly once, so a failing

@@ -193,6 +193,8 @@ REGISTRY: dict[str, FaultPoint] = _reg(
                "backend name", DEVICE),
     FaultPoint("native.call", "utils/native_batch.py _gate",
                "seal|open|chainframe", DEVICE),
+    FaultPoint("chain.rpc", "pool/blockchain.py _rpc_gate (every client call)",
+               "method (template|submit|confirmations|difficulty)", DEVICE),
 )
 
 

@@ -1,0 +1,31 @@
+"""Work-source tier: the pool as its own upstream (PR 20).
+
+Until this package, every scenario assumed exactly one upstream stratum
+job stream. Here the pool *originates* work instead: ``TemplateSource``
+polls a ``BlockchainClient`` (getblocktemplate-style), assembles the
+coinbase halves + merkle branch locally, and emits real ``Job``s into the
+same ``set_job`` fan-out the stratum upstream path uses — so the entire
+downstream stack (midstate assembly, share bus, exactly-once settlement)
+is reused unchanged. ``AuxWorkManager`` layers AuxPoW merged mining on
+top: K aux-chain work units committed in a tagged-sha256d merkle tree
+whose root rides the parent coinbase, so one nonce search settles the
+parent plus K aux chains.
+"""
+
+from otedama_tpu.work.aux import (       # noqa: F401
+    AUX_COMMIT_TAG,
+    AUX_MAGIC,
+    AuxProof,
+    AuxRPCClient,
+    AuxWork,
+    AuxWorkManager,
+    MockAuxChainClient,
+    aux_leaf,
+    aux_merkle,
+    build_aux_clients,
+    commitment_blob,
+    find_commitment,
+    fold_aux_branch,
+    serialize_auxpow,
+)
+from otedama_tpu.work.template import TemplateSource  # noqa: F401

@@ -1,0 +1,454 @@
+"""AuxPoW merged mining: K aux chains settled by one parent nonce search.
+
+Scheme (the classic Namecoin construction, rebuilt on the share chain's
+tagged-sha256d commitments from PR 5):
+
+- every aux chain's current work unit hashes to a LEAF
+  ``tagged_sha256d(AUX_COMMIT_TAG, chain_name, aux_hash)`` — the domain
+  tag means an aux commitment can never be replayed as a share-chain
+  claim or settlement key, and the chain name inside the leaf pins each
+  chain to its slot (no two chains can claim one leaf);
+- leaves fold into a merkle tree whose ROOT rides the parent coinbase
+  scriptSig inside ``AUX_MAGIC + root + count + nonce`` (the
+  ``0xfa 0xbe 'm' 'm'`` marker real merged-mining parsers scan for);
+- a parent share whose digest meets an aux chain's target yields an
+  ``AuxProof``: parent header + full coinbase bytes + the coinbase's
+  merkle branch into the parent header root + the aux leaf's branch into
+  the committed aux root. The aux chain verifies the whole spine
+  (commitment present exactly once per coinbase, both branches fold,
+  parent PoW meets the aux target) — ONE nonce search, K+1 chains.
+
+Bounds: the aux tree is rebuilt per template refresh over at most
+``MAX_AUX_CHAINS`` leaves (tree depth <= 5), so commitment cost is
+O(K log K) hashes per refresh, nothing per share; the per-share cost is
+K target compares (integers), and proof assembly happens only on an aux
+hit. Found aux blocks land as ``blocks`` rows tagged with their chain and
+ride the PR 6 settlement engine unchanged — per-chain payout splits are
+derived from the same credit rows by ``pool/settlement.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import struct
+from typing import Protocol
+
+from otedama_tpu.kernels import target as tgt
+from otedama_tpu.p2p.sharechain import tagged_sha256d
+from otedama_tpu.pool.blockchain import SubmitOutcome, _rpc_gate
+from otedama_tpu.utils.sha256_host import sha256d
+
+log = logging.getLogger("otedama.work.aux")
+
+AUX_COMMIT_TAG = b"otedama-auxpow-v1"
+AUX_MAGIC = b"\xfa\xbemm"      # 0xfa 0xbe 'm' 'm' — merged-mining marker
+MAX_AUX_CHAINS = 32
+
+
+@dataclasses.dataclass(frozen=True)
+class AuxWork:
+    """One aux chain's current work unit (its getauxblock answer)."""
+
+    chain: str
+    aux_hash: bytes             # 32 bytes, the aux block hash to commit
+    target: int                 # aux network target (hash must be <=)
+    reward: int                 # atomic units credited when this lands
+    height: int
+
+
+@dataclasses.dataclass(frozen=True)
+class AuxProof:
+    """Everything an aux chain needs to verify one parent PoW."""
+
+    chain: str
+    aux_hash: bytes
+    parent_header: bytes        # the 80 PoW'd bytes
+    coinbase: bytes             # full serialized coinbase (commitment inside)
+    coinbase_branch: list[bytes]  # coinbase txid -> parent header root
+    aux_branch: list[bytes]     # aux leaf -> committed aux root
+    index: int                  # leaf index in the aux tree
+
+
+class AuxChainClient(Protocol):
+    """What the manager needs from an aux chain node."""
+
+    async def get_aux_work(self) -> AuxWork: ...
+    async def submit_aux_block(self, proof: AuxProof) -> SubmitOutcome: ...
+    async def get_confirmations(self, block_hash: str) -> int: ...
+
+
+# -- commitment math ---------------------------------------------------------
+
+def aux_leaf(chain: str, aux_hash: bytes) -> bytes:
+    """The tagged leaf committing one chain's work unit."""
+    return tagged_sha256d(AUX_COMMIT_TAG, chain.encode(), aux_hash)
+
+
+def aux_merkle(leaves: list[bytes]) -> tuple[bytes, list[list[bytes]]]:
+    """Root + per-leaf branches, bitcoin-style (odd levels duplicate)."""
+    if not leaves:
+        return b"\x00" * 32, []
+    branches: list[list[bytes]] = [[] for _ in leaves]
+    idx = list(range(len(leaves)))
+    level = list(leaves)
+    while len(level) > 1:
+        if len(level) % 2:
+            level.append(level[-1])
+        for leaf, pos in enumerate(idx):
+            branches[leaf].append(level[pos ^ 1])
+        level = [sha256d(level[i] + level[i + 1])
+                 for i in range(0, len(level), 2)]
+        idx = [pos // 2 for pos in idx]
+    return level[0], branches
+
+
+def fold_aux_branch(leaf: bytes, branch: list[bytes], index: int) -> bytes:
+    """Fold a leaf up its branch to the root (index picks left/right)."""
+    h = leaf
+    for node in branch:
+        h = sha256d(node + h) if index & 1 else sha256d(h + node)
+        index >>= 1
+    return h
+
+
+def commitment_blob(root: bytes, count: int) -> bytes:
+    """The bytes riding the parent coinbase scriptSig."""
+    return AUX_MAGIC + root + struct.pack("<II", count, 0)
+
+
+def find_commitment(coinbase: bytes) -> tuple[bytes, int] | None:
+    """Locate the merged-mining commitment in a serialized coinbase.
+    Rejects coinbases carrying the magic more than once (a second
+    occurrence would let a miner prove two different aux trees)."""
+    first = coinbase.find(AUX_MAGIC)
+    if first < 0 or coinbase.find(AUX_MAGIC, first + 1) >= 0:
+        return None
+    blob = coinbase[first + 4:first + 4 + 40]
+    if len(blob) < 40:
+        return None
+    root = blob[:32]
+    count, _nonce = struct.unpack_from("<II", blob, 32)
+    return root, count
+
+
+# -- the manager -------------------------------------------------------------
+
+@dataclasses.dataclass
+class AuxSlate:
+    """One frozen aux commitment: the tree a given parent job carries."""
+
+    root: bytes
+    works: dict[str, AuxWork]                   # chain -> work unit
+    branches: dict[str, tuple[list[bytes], int]]  # chain -> (branch, index)
+
+    def key(self) -> bytes:
+        return self.root
+
+
+def build_slate(works: dict[str, AuxWork]) -> AuxSlate:
+    """Deterministic tree over the slate: chains sorted by name."""
+    names = sorted(works)
+    leaves = [aux_leaf(n, works[n].aux_hash) for n in names]
+    root, branches = aux_merkle(leaves)
+    return AuxSlate(
+        root=root,
+        works=dict(works),
+        branches={n: (branches[i], i) for i, n in enumerate(names)},
+    )
+
+
+class AuxWorkManager:
+    """Collects aux work units, freezes them into slates, and settles
+    aux hits found by the parent nonce search."""
+
+    def __init__(self, clients: dict[str, "AuxChainClient"], *,
+                 blocks=None, confirmations_required: int = 6):
+        if len(clients) > MAX_AUX_CHAINS:
+            raise ValueError(f"at most {MAX_AUX_CHAINS} aux chains")
+        self.clients = dict(clients)
+        self.blocks = blocks            # BlockRepository (chain-tagged rows)
+        self.confirmations_required = confirmations_required
+        self._works: dict[str, AuxWork] = {}
+        self.stats = {
+            "refreshes": 0, "refresh_failures": 0,
+            "found": 0, "submitted": 0, "accepted": 0, "rejected": 0,
+        }
+        self.per_chain: dict[str, dict] = {
+            n: {"found": 0, "accepted": 0, "rejected": 0, "height": 0}
+            for n in clients
+        }
+
+    async def refresh(self) -> bool:
+        """Poll every aux client; True when the slate changed. A chain
+        whose poll fails keeps its LAST work unit — aux outages must
+        never stall the parent job stream."""
+        changed = False
+        for name, client in self.clients.items():
+            try:
+                work = await client.get_aux_work()
+            except Exception as exc:
+                self.stats["refresh_failures"] += 1
+                log.warning("aux work poll failed for %s: %s", name, exc)
+                continue
+            if len(work.aux_hash) != 32 or work.height < 0 or work.target <= 0:
+                # corrupt-rpc answer: reject loudly, keep the last good unit
+                self.stats["refresh_failures"] += 1
+                log.warning("aux work rejected for %s: corrupt unit", name)
+                continue
+            prev = self._works.get(name)
+            if prev is None or prev.aux_hash != work.aux_hash:
+                self._works[name] = work
+                self.per_chain[name]["height"] = work.height
+                changed = True
+        if changed:
+            self.stats["refreshes"] += 1
+        return changed
+
+    def slate(self) -> AuxSlate | None:
+        """Freeze the current works into the slate a new job will commit."""
+        if not self._works:
+            return None
+        return build_slate(self._works)
+
+    async def on_share(self, digest: bytes, header: bytes, coinbase: bytes,
+                       coinbase_branch: list[bytes], slate: AuxSlate,
+                       worker: str) -> list[tuple[str, SubmitOutcome]]:
+        """Check one accepted parent share against every slated aux
+        target; assemble + submit proofs for the hits. Returns the
+        per-chain outcomes (empty for the overwhelmingly common miss)."""
+        outcomes: list[tuple[str, SubmitOutcome]] = []
+        for name, work in slate.works.items():
+            if not tgt.hash_meets_target(digest, work.target):
+                continue
+            self.stats["found"] += 1
+            self.per_chain[name]["found"] += 1
+            branch, index = slate.branches[name]
+            proof = AuxProof(
+                chain=name, aux_hash=work.aux_hash, parent_header=header,
+                coinbase=coinbase, coinbase_branch=list(coinbase_branch),
+                aux_branch=branch, index=index,
+            )
+            client = self.clients[name]
+            try:
+                self.stats["submitted"] += 1
+                outcome = await client.submit_aux_block(proof)
+            except Exception as exc:
+                outcome = SubmitOutcome(False, reason=f"rpc: {exc}")
+            if outcome.accepted:
+                self.stats["accepted"] += 1
+                self.per_chain[name]["accepted"] += 1
+                if self.blocks is not None:
+                    self.blocks.create(
+                        outcome.block_hash or work.aux_hash[::-1].hex(),
+                        worker, height=work.height, reward=work.reward,
+                        chain=name,
+                    )
+                log.info("aux block found on %s height %d by %s",
+                         name, work.height, worker)
+            else:
+                self.stats["rejected"] += 1
+                self.per_chain[name]["rejected"] += 1
+                log.warning("aux submit rejected on %s: %s",
+                            name, outcome.reason)
+            outcomes.append((name, outcome))
+        return outcomes
+
+    async def check_pending(self) -> None:
+        """Confirmation sweep for aux block rows — each chain polls ITS
+        node, so a parent-chain reorg can never orphan an aux row and
+        vice versa (the simultaneous-reorg bench pins this)."""
+        if self.blocks is None:
+            return
+        for name, client in self.clients.items():
+            for block in self.blocks.pending(chain=name):
+                try:
+                    confs = await client.get_confirmations(block["hash"])
+                except Exception:
+                    continue
+                if confs < 0:
+                    self.blocks.set_status(block["hash"], "orphaned", 0)
+                elif confs >= self.confirmations_required:
+                    self.blocks.set_status(block["hash"], "confirmed", confs)
+                else:
+                    self.blocks.set_status(block["hash"], "pending", confs)
+
+    def snapshot(self) -> dict:
+        return {
+            "chains": len(self.clients),
+            **self.stats,
+            "per_chain": {n: dict(d) for n, d in self.per_chain.items()},
+        }
+
+
+class MockAuxChainClient:
+    """In-process aux chain: deterministic work units, FULL proof
+    verification on submit (commitment, both merkle folds, parent PoW vs
+    the aux target, staleness), and the same reorg surface as
+    ``MockChainClient`` so simultaneous parent+aux reorgs are scriptable."""
+
+    def __init__(self, name: str, *, nbits: int = 0x207FFFFF,
+                 reward: int = 25 * 100_000_000):
+        self.name = name
+        self.nbits = nbits
+        self.target = tgt.bits_to_target(nbits)
+        self.reward = reward
+        self.height = 50
+        self.tip = sha256d(b"aux-genesis" + name.encode())
+        self.submitted: list[tuple[int, bytes, str]] = []
+        self.confirmations: dict[str, int] = {}
+        self.reorgs = 0
+
+    def _work_hash(self) -> bytes:
+        return sha256d(b"aux-work" + self.name.encode()
+                       + struct.pack("<I", self.height + 1) + self.tip)
+
+    def reorg(self, depth: int) -> None:
+        """Rewind onto a fork, orphaning the last ``depth`` aux blocks."""
+        depth = min(depth, len(self.submitted))
+        if depth <= 0:
+            return
+        for _, _, orphaned_hash in self.submitted[-depth:]:
+            self.confirmations.pop(orphaned_hash, None)
+        del self.submitted[-depth:]
+        self.height -= depth
+        self.reorgs += 1
+        self.tip = sha256d(b"aux-fork" + self.name.encode()
+                           + struct.pack("<II", self.height, self.reorgs))
+
+    async def get_aux_work(self) -> AuxWork:
+        d = await _rpc_gate("template")
+        if d.corrupt:
+            return AuxWork(self.name, b"", 0, 0, -1)
+        return AuxWork(
+            chain=self.name, aux_hash=self._work_hash(),
+            target=self.target, reward=self.reward, height=self.height + 1,
+        )
+
+    async def submit_aux_block(self, proof: AuxProof) -> SubmitOutcome:
+        d = await _rpc_gate("submit")
+        if d.corrupt:
+            return SubmitOutcome(False, reason="rpc-corrupt")
+        if proof.aux_hash != self._work_hash():
+            return SubmitOutcome(False, reason="stale-auxwork")
+        if len(proof.parent_header) != 80:
+            return SubmitOutcome(False, reason="bad parent header")
+        found = find_commitment(proof.coinbase)
+        if found is None:
+            return SubmitOutcome(False, reason="no aux commitment")
+        root, _count = found
+        leaf = aux_leaf(self.name, proof.aux_hash)
+        if fold_aux_branch(leaf, proof.aux_branch, proof.index) != root:
+            return SubmitOutcome(False, reason="bad aux branch")
+        cb_root = fold_aux_branch(sha256d(proof.coinbase),
+                                  proof.coinbase_branch, 0)
+        if cb_root != proof.parent_header[36:68]:
+            return SubmitOutcome(False, reason="bad coinbase branch")
+        digest = sha256d(proof.parent_header)
+        if not tgt.hash_meets_target(digest, self.target):
+            return SubmitOutcome(False, reason="high-hash")
+        block_hash = proof.aux_hash[::-1].hex()
+        self.height += 1
+        self.tip = proof.aux_hash
+        self.submitted.append((self.height, proof.parent_header, block_hash))
+        self.confirmations[block_hash] = 1
+        log.info("mock aux chain %s accepted block %d %s",
+                 self.name, self.height, block_hash[:16])
+        return SubmitOutcome(True, block_hash=block_hash)
+
+    async def get_confirmations(self, block_hash: str) -> int:
+        d = await _rpc_gate("confirmations")
+        if d.corrupt:
+            return 0
+        if block_hash not in self.confirmations:
+            return -1
+        self.confirmations[block_hash] += 1
+        return self.confirmations[block_hash]
+
+    async def get_network_difficulty(self) -> float:
+        d = await _rpc_gate("difficulty")
+        if d.corrupt:
+            return 0.0
+        return tgt.target_to_difficulty(self.target)
+
+
+def serialize_auxpow(proof: AuxProof) -> bytes:
+    """Canonical AuxPoW wire serialization (namecoin lineage): coinbase
+    tx bytes, parent block hash, coinbase branch, aux branch, parent
+    header. What ``getauxblock <hash> <auxpow>`` submits."""
+    def _branch(nodes: list[bytes], index: int) -> bytes:
+        return (_compact(len(nodes)) + b"".join(nodes)
+                + struct.pack("<i", index))
+
+    def _compact(n: int) -> bytes:
+        if n < 0xFD:
+            return bytes([n])
+        return b"\xfd" + struct.pack("<H", n)
+
+    parent_hash = sha256d(proof.parent_header)
+    return (
+        proof.coinbase + parent_hash
+        + _branch(proof.coinbase_branch, 0)
+        + _branch(proof.aux_branch, proof.index)
+        + proof.parent_header
+    )
+
+
+class AuxRPCClient:
+    """getauxblock-style JSON-RPC aux chain client. NOTE: like
+    ``BitcoinRPCClient.get_block_template``, serving a real aux chain
+    needs chain-specific fields (getauxblock answers vary per fork);
+    this client speaks the namecoin-lineage common denominator."""
+
+    def __init__(self, name: str, url: str, user: str = "",
+                 password: str = "", reward: int = 0):
+        from otedama_tpu.pool.blockchain import BitcoinRPCClient
+
+        self.name = name
+        self.reward = reward
+        self._client = BitcoinRPCClient(url, user, password)
+
+    def close(self) -> None:
+        self._client.close()
+
+    async def get_aux_work(self) -> AuxWork:
+        d = await _rpc_gate("template")
+        if d.corrupt:
+            return AuxWork(self.name, b"", 0, 0, -1)
+        r = await self._client._rpc("getauxblock", [])
+        return AuxWork(
+            chain=self.name,
+            aux_hash=bytes.fromhex(r["hash"])[::-1],
+            target=int(r["_target" if "_target" in r else "target"], 16),
+            reward=int(r.get("coinbasevalue", self.reward)),
+            height=int(r.get("height", 0)),
+        )
+
+    async def submit_aux_block(self, proof: AuxProof) -> SubmitOutcome:
+        d = await _rpc_gate("submit")
+        if d.corrupt:
+            return SubmitOutcome(False, reason="rpc-corrupt")
+        ok = await self._client._rpc("getauxblock", [
+            proof.aux_hash[::-1].hex(), serialize_auxpow(proof).hex(),
+        ])
+        if ok:
+            return SubmitOutcome(True, block_hash=proof.aux_hash[::-1].hex())
+        return SubmitOutcome(False, reason="aux submit refused")
+
+    async def get_confirmations(self, block_hash: str) -> int:
+        return await self._client.get_confirmations(block_hash)
+
+
+def build_aux_clients(spec: str) -> dict[str, object]:
+    """Parse the ``work.aux_chains`` config string: ``name`` entries get
+    an in-process mock aux chain, ``name=url`` a JSON-RPC client."""
+    clients: dict[str, object] = {}
+    for entry in (spec or "").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, _, url = entry.partition("=")
+        name = name.strip()
+        clients[name] = (AuxRPCClient(name, url.strip()) if url
+                         else MockAuxChainClient(name))
+    return clients

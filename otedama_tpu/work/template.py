@@ -1,0 +1,303 @@
+"""TemplateSource: local block templates as the pool's own upstream.
+
+Lifecycle of one template:
+
+1. poll ``BlockchainClient.get_block_template`` (the ``chain.rpc`` fault
+   point wraps every call) and refresh the aux slate;
+2. VALIDATE — a corrupt template (impossible height/prev/nbits) is
+   rejected loudly and the last good job keeps serving; the job stream
+   must never wedge on a sick node;
+3. assemble the coinbase halves locally: either adopt the template's
+   bytes (mock/regtest nodes ship them) or build a real coinbase around
+   the payout script — BIP34 height push + pool tag + an extranonce gap
+   of ``extranonce1_len + extranonce2_size`` bytes between the halves,
+   exactly the split ``ShareAssembler``'s midstate machinery expects.
+   The aux commitment rides the scriptSig suffix either way;
+4. emit a ``Job`` into the same ``set_job`` fan-out the stratum upstream
+   path uses: ``clean=True`` on a new tip (height/prev changed — miners
+   must abandon work), ``clean=False`` on a same-height refresh (a
+   template race or an aux-slate change — new work, old shares still
+   valid);
+5. retain the (job, aux slate) pair so a found share's proof can be
+   assembled against EXACTLY the slate its coinbase committed — a slate
+   refreshed after the job went out must not leak into older proofs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import itertools
+import logging
+import struct
+import time
+
+from otedama_tpu.engine.types import Job
+from otedama_tpu.kernels import target as tgt
+from otedama_tpu.pool.blockchain import BlockTemplate
+from otedama_tpu.work.aux import AuxSlate, AuxWorkManager, commitment_blob
+
+log = logging.getLogger("otedama.work.template")
+
+# bitcoin consensus: coinbase scriptSig length in [2, 100]
+_MAX_SCRIPTSIG = 100
+
+
+def _varint(n: int) -> bytes:
+    if n < 0xFD:
+        return bytes([n])
+    if n <= 0xFFFF:
+        return b"\xfd" + struct.pack("<H", n)
+    return b"\xfe" + struct.pack("<I", n)
+
+
+def _push(data: bytes) -> bytes:
+    if len(data) >= 0x4C:
+        raise ValueError("script push too long for a coinbase tag")
+    return bytes([len(data)]) + data
+
+
+def _push_height(height: int) -> bytes:
+    """BIP34: the block height as a minimal script number push."""
+    if height == 0:
+        return b"\x00"
+    out = b""
+    n = height
+    while n:
+        out += bytes([n & 0xFF])
+        n >>= 8
+    if out[-1] & 0x80:
+        out += b"\x00"
+    return _push(out)
+
+
+def build_coinbase_halves(height: int, reward: int, payout_script: bytes,
+                          tag: bytes, extranonce_gap: int,
+                          aux_blob: bytes = b"") -> tuple[bytes, bytes]:
+    """A real coinbase transaction split around the extranonce gap.
+
+    coinb1 ends exactly where extranonce1 begins and coinb2 starts right
+    after extranonce2 — the same contract stratum's ``mining.notify``
+    halves obey, so the midstate path needs no special case. The aux
+    commitment is pushed in the scriptSig suffix (the classic
+    merged-mining placement real parsers scan for).
+    """
+    prefix = _push_height(height) + (_push(tag) if tag else b"")
+    suffix = _push(aux_blob) if aux_blob else b""
+    script_len = len(prefix) + extranonce_gap + len(suffix)
+    if script_len > _MAX_SCRIPTSIG:
+        raise ValueError(f"coinbase scriptSig {script_len} > {_MAX_SCRIPTSIG}")
+    coinb1 = (
+        struct.pack("<I", 1)                    # tx version
+        + b"\x01"                               # one input
+        + b"\x00" * 32 + b"\xff\xff\xff\xff"    # null prevout
+        + _varint(script_len) + prefix
+    )
+    coinb2 = (
+        suffix
+        + b"\xff\xff\xff\xff"                   # sequence
+        + b"\x01"                               # one output
+        + struct.pack("<q", reward)
+        + _varint(len(payout_script)) + payout_script
+        + struct.pack("<I", 0)                  # locktime
+    )
+    return coinb1, coinb2
+
+
+@dataclasses.dataclass
+class WorkContext:
+    """What a found share needs back: the job AND the slate it committed."""
+
+    job: Job
+    slate: AuxSlate | None
+    template: BlockTemplate
+
+
+class TemplateSource:
+    """Polls a chain node and originates jobs (docstring at module top)."""
+
+    def __init__(self, chain, *, pool=None, aux: AuxWorkManager | None = None,
+                 algorithm: str = "sha256d", poll_seconds: float = 2.0,
+                 extranonce1_len: int = 4, extranonce2_size: int = 4,
+                 payout_script: bytes = b"", coinbase_tag: bytes = b"/otedama/"):
+        self.chain = chain
+        self.pool = pool                    # PoolManager (reward bookkeeping)
+        self.aux = aux
+        self.algorithm = algorithm
+        self.poll_seconds = poll_seconds
+        self.extranonce1_len = extranonce1_len
+        self.extranonce2_size = extranonce2_size
+        self.payout_script = payout_script
+        self.coinbase_tag = coinbase_tag
+        self._sinks: list = []              # fn(job, clean) fan-out
+        self._contexts: dict[str, WorkContext] = {}
+        self._counter = itertools.count(1)
+        self._last_tip: tuple[int, bytes] | None = None
+        self._last_sig: tuple | None = None
+        self._template_at = 0.0
+        self._refresh_ema = 0.0
+        self.stats = {
+            "templates_fetched": 0, "templates_rejected": 0,
+            "rpc_failures": 0, "jobs_emitted": 0, "clean_jobs": 0,
+            "race_refreshes": 0, "template_height": 0,
+            "last_refresh_seconds": 0.0,
+        }
+
+    def add_sink(self, fn) -> None:
+        """Register a ``fn(job, clean)`` consumer (server/engine adapter)."""
+        self._sinks.append(fn)
+
+    def reissue(self) -> None:
+        """Forget the last-emitted signature so the next poll re-emits
+        even on an unchanged template — an algorithm switch relabels
+        jobs, and the dedup gate would otherwise idle the engine until
+        the next block arrives."""
+        self._last_sig = None
+        self._last_tip = None
+
+    def get_job(self, job_id: str) -> Job | None:
+        ctx = self._contexts.get(job_id)
+        return ctx.job if ctx else None
+
+    def job_context(self, job_id: str) -> WorkContext | None:
+        return self._contexts.get(job_id)
+
+    # -- template pipeline ---------------------------------------------------
+
+    @staticmethod
+    def _validate(t: BlockTemplate) -> str | None:
+        if t.height < 0:
+            return "height"
+        if len(t.prev_hash) != 32:
+            return "prev-hash"
+        if t.nbits == 0 or tgt.bits_to_target(t.nbits) <= 0:
+            return "nbits"
+        if t.ntime <= 0:
+            return "ntime"
+        return None
+
+    def _assemble(self, t: BlockTemplate,
+                  slate: AuxSlate | None) -> tuple[bytes, bytes]:
+        blob = commitment_blob(slate.root, len(slate.works)) if slate else b""
+        if t.coinb1:
+            # the node shipped coinbase halves — adopt them, the aux
+            # commitment rides the scriptSig tail of the first half's
+            # continuation (raw append: scanners key on the magic)
+            return t.coinb1, (blob + t.coinb2 if blob else t.coinb2)
+        gap = self.extranonce1_len + self.extranonce2_size
+        return build_coinbase_halves(
+            t.height, t.reward, self.payout_script, self.coinbase_tag,
+            gap, blob,
+        )
+
+    async def poll_once(self) -> Job | None:
+        """One template fetch -> at most one emitted job."""
+        t0 = time.monotonic()
+        if self.aux is not None:
+            await self.aux.refresh()
+        try:
+            t = await self.chain.get_block_template()
+        except Exception as exc:
+            self.stats["rpc_failures"] += 1
+            log.warning("template fetch failed: %s — last good job serves on",
+                        exc)
+            return None
+        self.stats["templates_fetched"] += 1
+        reason = self._validate(t)
+        if reason is not None:
+            self.stats["templates_rejected"] += 1
+            log.warning("template rejected (%s): height=%d — last good job "
+                        "serves on", reason, t.height)
+            return None
+        slate = self.aux.slate() if self.aux is not None else None
+        coinb1, coinb2 = self._assemble(t, slate)
+        sig = (t.height, t.prev_hash, coinb1, coinb2,
+               tuple(t.merkle_branch), t.nbits)
+        if sig == self._last_sig:
+            self._template_at = time.time()
+            return None
+        clean = self._last_tip != (t.height, t.prev_hash)
+        job = self._emit(t, coinb1, coinb2, slate, clean)
+        self._last_sig = sig
+        self._last_tip = (t.height, t.prev_hash)
+        self._template_at = time.time()
+        self.stats["template_height"] = t.height
+        dt = time.monotonic() - t0
+        self.stats["last_refresh_seconds"] = dt
+        self._refresh_ema = dt if not self._refresh_ema else (
+            0.3 * dt + 0.7 * self._refresh_ema)
+        return job
+
+    def _emit(self, t: BlockTemplate, coinb1: bytes, coinb2: bytes,
+              slate: AuxSlate | None, clean: bool) -> Job:
+        t2 = dataclasses.replace(t, coinb1=coinb1, coinb2=coinb2)
+        if self.pool is not None:
+            job = self.pool.job_from_template(t2, algorithm=self.algorithm)
+            job.clean = clean
+        else:
+            job = Job(
+                job_id=f"tmpl-{next(self._counter):x}",
+                prev_hash=t2.prev_hash, coinb1=coinb1, coinb2=coinb2,
+                merkle_branch=list(t2.merkle_branch), version=t2.version,
+                nbits=t2.nbits, ntime=t2.ntime, clean=clean,
+                algorithm=self.algorithm,
+                extranonce2_size=self.extranonce2_size,
+                block_number=t2.height,
+                share_target=tgt.bits_to_target(t2.nbits),
+            )
+        self._contexts[job.job_id] = WorkContext(job=job, slate=slate,
+                                                 template=t2)
+        if len(self._contexts) > 64:
+            for jid in list(self._contexts)[:-32]:
+                del self._contexts[jid]
+        self.stats["jobs_emitted"] += 1
+        if clean:
+            self.stats["clean_jobs"] += 1
+        else:
+            self.stats["race_refreshes"] += 1
+        for sink in self._sinks:
+            sink(job, clean)
+        log.info("work source emitted job %s height %d clean=%s aux=%d",
+                 job.job_id, t2.height, clean,
+                 len(slate.works) if slate else 0)
+        return job
+
+    async def run(self) -> None:
+        """The poll loop (longpoll analogue: height-gated + race-aware)."""
+        while True:
+            try:
+                await self.poll_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # a bug here must not kill the job stream — count + carry on
+                self.stats["rpc_failures"] += 1
+                log.exception("template poll crashed; retrying")
+            await asyncio.sleep(self.poll_seconds)
+
+    # -- found-share hook ----------------------------------------------------
+
+    async def on_accepted_share(self, job_id: str, digest: bytes,
+                                header: bytes, extranonce1: bytes,
+                                extranonce2: bytes, worker: str) -> list:
+        """Give every accepted parent share its shot at the aux slates.
+        Returns the (chain, outcome) list from the aux manager (empty on
+        the common miss)."""
+        if self.aux is None:
+            return []
+        ctx = self._contexts.get(job_id)
+        if ctx is None or ctx.slate is None:
+            return []
+        coinbase = ctx.job.coinb1 + extranonce1 + extranonce2 + ctx.job.coinb2
+        return await self.aux.on_share(
+            digest, header, coinbase, ctx.job.merkle_branch, ctx.slate,
+            worker,
+        )
+
+    def snapshot(self) -> dict:
+        snap = dict(self.stats)
+        snap["refresh_ema_seconds"] = round(self._refresh_ema, 6)
+        snap["template_age_seconds"] = round(
+            time.time() - self._template_at, 3) if self._template_at else -1.0
+        snap["aux"] = self.aux.snapshot() if self.aux is not None else {}
+        return snap

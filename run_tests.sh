@@ -96,6 +96,14 @@
 #                   index == independent PPLNS/settlement recompute,
 #                   exit 2 on any imbalance); writes a BENCH_TWIN json
 #                   artifact re-runnable unmodified off-sandbox.
+#   aux-bench       opt-in merged-mining bench: times the accepted-
+#                   share -> K aux chains accepted proof path (assembly
+#                   + full mock-node spine verification) and runs a
+#                   seeded simultaneous parent+aux reorg schedule whose
+#                   settled ledger is audited against an independent
+#                   recompute (surviving blocks read from the chains,
+#                   PPLNS pot + per-chain split recomputed — exit 2 on
+#                   ANY mismatch); writes a BENCH_AUX json artifact.
 #   native-bench    opt-in native batch-seam bench: ctypes dispatch
 #                   overhead plus seal_many/open_many and chain_frames
 #                   crossover curves vs their python oracles (every
@@ -187,6 +195,10 @@ case "$tier" in
   chain-bench)
     exec env JAX_PLATFORMS=cpu python tools/bench_chain.py \
       --out "${CHAIN_BENCH_OUT:-BENCH_CHAIN_manual.json}" "$@" ;;
+  aux-bench)
+    exec env JAX_PLATFORMS=cpu python tools/bench_aux.py \
+      --seed "${AUX_BENCH_SEED:-20}" \
+      --out "${AUX_BENCH_OUT:-BENCH_AUX_manual.json}" "$@" ;;
   fleet-bench)
     exec env JAX_PLATFORMS=cpu python tools/bench_fleet.py \
       --out "${FLEET_BENCH_OUT:-BENCH_FLEET_manual.json}" "$@" ;;
@@ -199,5 +211,5 @@ case "$tier" in
       --seed "${TWIN_BENCH_SEED:-22}" \
       --pace "${TWIN_BENCH_PACES:-0,20}" \
       --out "${TWIN_BENCH_OUT:-BENCH_TWIN_manual.json}" "$@" ;;
-  *) echo "usage: $0 [fast|slow|all|audit|stratum-bench|stratum-shard-bench|stratum-v2-bench|profit-bench|switch-bench|degrade-bench|engine-bench|validate-bench|sharechain-bench|region-bench|payout-bench|chain-bench|fleet-bench|native-bench|twin-bench] [pytest args...]" >&2; exit 2 ;;
+  *) echo "usage: $0 [fast|slow|all|audit|stratum-bench|stratum-shard-bench|stratum-v2-bench|profit-bench|switch-bench|degrade-bench|engine-bench|validate-bench|sharechain-bench|region-bench|payout-bench|chain-bench|aux-bench|fleet-bench|native-bench|twin-bench] [pytest args...]" >&2; exit 2 ;;
 esac

@@ -1,0 +1,591 @@
+"""Work-source tier: local block templates + AuxPoW merged mining (ISSUE 20).
+
+The invariants under test:
+
+- header assembly (``engine/jobs.py``) is bit-exact against REAL mainnet
+  data: bitcoin block #100000's coinbase txid, merkle root, and block
+  hash fall out of ``build_coinbase``/``merkle_root``/``header_from_share``
+  fed the stratum-shaped inputs — the fixed vectors pin the byte-order
+  conventions the whole tier stands on;
+- E2E solo: the pool mines against ``MockChainClient`` with NO upstream
+  stratum client — template -> job -> accepted share -> block found ->
+  submitted -> confirmed -> settled exactly-once through the PR 6 engine;
+- merged mining: ONE nonce search settles the parent plus K=3 aux chains
+  (the mock aux clients verify the full AuxPoW spine: commitment present
+  exactly once, both merkle folds, parent PoW), per-chain payout splits
+  are audited against an independent recompute, and the books stay exact
+  under a SIMULTANEOUS parent+aux reorg;
+- seeded ``chain.rpc`` chaos (template outage + corrupt template + stale
+  submit) degrades loudly without wedging the job stream, and recovery
+  resumes fresh templates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+import time
+
+import pytest
+
+from otedama_tpu.db.database import Database
+from otedama_tpu.engine import jobs as jobmod
+from otedama_tpu.engine.types import Job
+from otedama_tpu.kernels import target as tgt
+from otedama_tpu.p2p import sharechain as sc
+from otedama_tpu.p2p.sharechain import ChainParams, ShareChain
+from otedama_tpu.pool.blockchain import MockChainClient
+from otedama_tpu.pool.manager import MockWallet, PoolConfig, PoolManager
+from otedama_tpu.pool.payouts import PayoutCalculator, PayoutConfig
+from otedama_tpu.pool.settlement import (
+    SettlementConfig,
+    SettlementEngine,
+    split_credits_by_chain,
+)
+from otedama_tpu.stratum.server import AcceptedShare
+from otedama_tpu.utils import faults
+from otedama_tpu.utils.sha256_host import sha256d
+from otedama_tpu.work.aux import (
+    AUX_MAGIC,
+    AuxWorkManager,
+    MockAuxChainClient,
+    aux_leaf,
+    aux_merkle,
+    commitment_blob,
+    find_commitment,
+    fold_aux_branch,
+)
+from otedama_tpu.work.template import TemplateSource, build_coinbase_halves
+
+# -- fixed vectors: bitcoin mainnet block #100000 -----------------------------
+#
+# Independent constants from the public chain; everything below must fall
+# out of the code under test, not be recomputed by it.
+
+B100K_HASH = "000000000003ba27aa200b1cecaad478d2b00432346c3f1f3986da1afd33e506"
+B100K_PREV = "000000000002d01c1fccc21636b607dfd930d31d01c3a62104612a1719011250"
+B100K_ROOT = "f3e94742aca4b5ef85488dc37c06c3282295ffec960994b2c0d5ac2a25a95766"
+B100K_VERSION = 1
+B100K_NTIME = 1293623863
+B100K_NBITS = 0x1B04864C
+# header nonce bytes are 0f2b5710; nonce_word is their big-endian reading
+B100K_NONCE_WORD = 0x0F2B5710
+B100K_CB_TXID = "8c14f0db3df150123e6f3dbbf30f8b955a8249b62ac1d1ff16284aefa3d06d87"
+# the raw coinbase tx, split stratum-style around its 2-byte extranonce
+# ("0602" inside the scriptSig "04 4c86041b 02 0602")
+B100K_COINB1 = (
+    "01000000010000000000000000000000000000000000000000000000000000000000"
+    "000000ffffffff08044c86041b02"
+)
+B100K_EN2 = "0602"
+B100K_COINB2 = (
+    "ffffffff0100f2052a010000004341041b0e8c2567c12536aa13357b79a073dc4444"
+    "acb83c4ec7a0e2f99dd7457516c5817242da796924ca4e99947d087fedf9ce467cb9"
+    "f7c6287078f801df276fdf84ac00000000"
+)
+B100K_TXIDS = [
+    "fff2525b8931402dd09222c50775608f75787bd2b87e56995a7bdd30f79702c4",
+    "6359f0868171b1d194cbee1af2f16ea598ae8fad666d9b012c8ed2b79a236ec4",
+    "e9a66845e05d5abc0ad04ec80f774a7e585c6e8db975962d069a522137b80c1d",
+]
+
+
+def b100k_job() -> Job:
+    """Block #100000 as the stratum-shaped Job the engine consumes."""
+    tx1, tx2, tx3 = (bytes.fromhex(t)[::-1] for t in B100K_TXIDS)
+    return Job(
+        job_id="b100k",
+        prev_hash=bytes.fromhex(B100K_PREV)[::-1],
+        coinb1=bytes.fromhex(B100K_COINB1),
+        coinb2=bytes.fromhex(B100K_COINB2),
+        # the coinbase's merkle branch at index 0: its sibling txid, then
+        # the hash of the other pair
+        merkle_branch=[tx1, sha256d(tx2 + tx3)],
+        version=B100K_VERSION,
+        nbits=B100K_NBITS,
+        ntime=B100K_NTIME,
+        extranonce1=b"",
+        extranonce2_size=2,
+    )
+
+
+def test_vectors_block100000_coinbase_merkle_header():
+    job = b100k_job()
+    en2 = bytes.fromhex(B100K_EN2)
+    coinbase = jobmod.build_coinbase(job, en2)
+    assert sha256d(coinbase)[::-1].hex() == B100K_CB_TXID
+    root = jobmod.merkle_root(coinbase, job.merkle_branch)
+    assert root[::-1].hex() == B100K_ROOT
+    header = jobmod.header_from_share(job, en2, B100K_NTIME, B100K_NONCE_WORD)
+    assert len(header) == 80
+    assert sha256d(header)[::-1].hex() == B100K_HASH
+    # the hot-path assembler produces the identical 80 bytes
+    asm = jobmod.ShareAssembler(job)
+    assert asm.header(en2, B100K_NTIME, B100K_NONCE_WORD) == header
+
+
+def test_vectors_block100000_wrong_inputs_move_the_hash():
+    """The vector is sharp: any field off by one bit misses the hash."""
+    job = b100k_job()
+    en2 = bytes.fromhex(B100K_EN2)
+    good = sha256d(jobmod.header_from_share(
+        job, en2, B100K_NTIME, B100K_NONCE_WORD))[::-1].hex()
+    assert good == B100K_HASH
+    bad_en2 = sha256d(jobmod.header_from_share(
+        job, b"\x06\x03", B100K_NTIME, B100K_NONCE_WORD))[::-1].hex()
+    assert bad_en2 != B100K_HASH
+    bad_nonce = sha256d(jobmod.header_from_share(
+        job, en2, B100K_NTIME, B100K_NONCE_WORD + 1))[::-1].hex()
+    assert bad_nonce != B100K_HASH
+
+
+# -- local coinbase construction ----------------------------------------------
+
+def test_build_coinbase_halves_layout_and_bip34():
+    script_pk = bytes.fromhex("76a914") + b"\x11" * 20 + bytes.fromhex("88ac")
+    coinb1, coinb2 = build_coinbase_halves(
+        height=100_000, reward=50 * 100_000_000, payout_script=script_pk,
+        tag=b"/otedama/", extranonce_gap=8,
+    )
+    # BIP34: 100000 = 0x0186a0 -> minimal push "03 a08601" opens the script
+    sig_start = coinb1.index(b"\xff\xff\xff\xff") + 4 + 1
+    assert coinb1[sig_start:sig_start + 4] == bytes.fromhex("03a08601")
+    full = coinb1 + b"\x00" * 8 + coinb2
+    # scriptSig length byte covers exactly prefix + gap + (no aux suffix)
+    script_len = full[sig_start - 1]
+    assert script_len == len(coinb1) - sig_start + 8
+    # one output paying the script, reward amount, locktime 0
+    assert struct.pack("<q", 50 * 100_000_000) in coinb2
+    assert script_pk in coinb2
+    assert full.endswith(struct.pack("<I", 0))
+    # aux blob rides the scriptSig suffix and is found by the scanner
+    blob = commitment_blob(b"\xab" * 32, 3)
+    c1, c2 = build_coinbase_halves(
+        height=100_000, reward=1, payout_script=script_pk, tag=b"/o/",
+        extranonce_gap=8, aux_blob=blob,
+    )
+    assert find_commitment(c1 + b"\x00" * 8 + c2) == (b"\xab" * 32, 3)
+    # consensus bound: an oversized scriptSig must refuse to assemble
+    with pytest.raises(ValueError):
+        build_coinbase_halves(
+            height=100_000, reward=1, payout_script=script_pk,
+            tag=b"t" * 60, extranonce_gap=40,
+        )
+
+
+# -- aux merkle + commitment --------------------------------------------------
+
+def test_aux_merkle_roots_and_branches_fold():
+    for k in range(1, 6):
+        leaves = [aux_leaf(f"chain{i}", bytes([i]) * 32) for i in range(k)]
+        root, branches = aux_merkle(leaves)
+        assert len(branches) == k
+        for i, leaf in enumerate(leaves):
+            assert fold_aux_branch(leaf, branches[i], i) == root
+        # a forged leaf cannot fold to the same root
+        forged = aux_leaf("chain0", b"\xff" * 32)
+        assert fold_aux_branch(forged, branches[0], 0) != root
+
+
+def test_commitment_blob_scan_rules():
+    blob = commitment_blob(b"\x42" * 32, 3)
+    assert blob.startswith(AUX_MAGIC)
+    assert find_commitment(b"prefix" + blob + b"suffix") == (b"\x42" * 32, 3)
+    assert find_commitment(b"no magic here") is None
+    # the magic twice is ambiguous — real merged-mining parsers reject it,
+    # and so must we (an attacker could otherwise smuggle a second root)
+    assert find_commitment(blob + blob) is None
+
+
+# -- shared harness -----------------------------------------------------------
+
+TEST_D = 1e-6
+DEPTH = 8
+WINDOW = 64
+WORKERS = ["ann.w1", "bob.w1", "cat.w1", "dan.w1"]
+
+
+def make_chain(n: int) -> ShareChain:
+    chain = ShareChain(ChainParams(
+        min_difficulty=TEST_D, window=WINDOW, max_reorg_depth=DEPTH,
+    ))
+    prev = sc.GENESIS
+    for i in range(n):
+        s = sc.mine_share(prev, WORKERS[i % len(WORKERS)], f"job{i}", TEST_D)
+        assert chain.connect(s) == "accepted"
+        prev = s.share_id
+    return chain
+
+
+def expected_split(chain: ShareChain, end: int, reward: int) -> dict[str, int]:
+    calc = PayoutCalculator(PayoutConfig(pplns_window=WINDOW))
+    shares = chain.chain_slice(max(0, end - WINDOW), end)
+    res = calc.calculate_block(
+        reward, [{"worker": s.worker, "difficulty": s.difficulty} for s in shares],
+    )
+    return {p.worker: p.amount for p in res.payouts}
+
+
+def grind_block_share(job: Job, extranonce1: bytes, en2: bytes,
+                      worker: str = "ann.w1") -> AcceptedShare:
+    """Mine a nonce whose header meets the job's NETWORK target (regtest
+    nbits makes this a handful of tries) and wrap it as the AcceptedShare
+    the stratum servers would deliver."""
+    full = dataclasses.replace(job, extranonce1=extranonce1)
+    prefix = jobmod.build_header_prefix(full, en2)
+    network = tgt.bits_to_target(job.nbits)
+    for nonce in range(1 << 20):
+        header = prefix + struct.pack(">I", nonce)
+        digest = sha256d(header)
+        if tgt.hash_meets_target(digest, network):
+            return AcceptedShare(
+                session_id=1, worker_user=worker, job_id=job.job_id,
+                difficulty=1e-4, actual_difficulty=1e-4, digest=digest,
+                header=header, extranonce2=en2, ntime=job.ntime,
+                nonce_word=nonce, is_block=True, submitted_at=time.time(),
+                algorithm=job.algorithm, block_number=job.block_number,
+                extranonce1=extranonce1,
+            )
+    raise AssertionError("no block-grade share found")
+
+
+async def confirm_all(pool: PoolManager, aux: AuxWorkManager | None = None,
+                      polls: int = 8) -> None:
+    """Drive the confirmation sweeps until mock confirmations mature
+    (each poll increments the mock's counter; 6 are required)."""
+    for _ in range(polls):
+        await pool.submitter.check_pending()
+        if aux is not None:
+            await aux.check_pending()
+
+
+def make_pool(db: Database, chain) -> PoolManager:
+    return PoolManager(db, chain, config=PoolConfig(
+        payout_interval=0.0, defer_block_distribution=True,
+    ))
+
+
+def make_settlement(db: Database, share_chain: ShareChain) -> SettlementEngine:
+    return SettlementEngine(
+        db, share_chain, MockWallet(),
+        payout=PayoutConfig(pplns_window=WINDOW, minimum_payout=1_000,
+                            payout_fee=10),
+        config=SettlementConfig(interval=0.05, drain_timeout=2.0),
+    )
+
+
+# -- template source lifecycle ------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_template_source_emits_races_and_reorgs():
+    chain = MockChainClient()
+    source = TemplateSource(chain, poll_seconds=0.01, extranonce1_len=0)
+    seen: list[tuple[Job, bool]] = []
+    source.add_sink(lambda job, clean: seen.append((job, clean)))
+
+    job1 = await source.poll_once()
+    assert job1 is not None and job1.clean
+    assert job1.job_id.startswith("tmpl-")
+    assert job1.block_number == 101
+    # solo jobs mine straight at the network target
+    assert job1.share_target == tgt.bits_to_target(chain.nbits)
+    # unchanged template -> no re-emission (the dedup gate)
+    assert await source.poll_once() is None
+    assert source.get_job(job1.job_id) is job1
+
+    # template race: same height+prev, different coinbase -> clean=False
+    chain.bump_template()
+    job2 = await source.poll_once()
+    assert job2 is not None and not job2.clean
+    assert source.stats["race_refreshes"] == 1
+
+    # reorg: new tip -> clean=True, and the old tip never comes back
+    chain.submitted.append((chain.height, b"x" * 80, "deadbeef"))
+    chain.confirmations["deadbeef"] = 1
+    chain.reorg(1)
+    job3 = await source.poll_once()
+    assert job3 is not None and job3.clean
+    assert [c for _, c in seen] == [True, False, True]
+
+    # reissue() (algorithm switch follow-through) re-emits the same template
+    source.algorithm = "scrypt"
+    source.reissue()
+    job4 = await source.poll_once()
+    assert job4 is not None and job4.algorithm == "scrypt"
+
+    snap = source.snapshot()
+    assert snap["jobs_emitted"] == 4
+    assert snap["template_age_seconds"] >= 0.0
+
+
+@pytest.mark.asyncio
+async def test_mock_chain_stale_submit_rejection():
+    chain = MockChainClient(reject_stale=True)
+    source = TemplateSource(chain, poll_seconds=0.01, extranonce1_len=0)
+    job = await source.poll_once()
+    share = grind_block_share(job, b"", b"\x00" * 4)
+    out = await chain.submit_block(share.header)
+    assert out.accepted
+    # the tip moved; re-submitting work minted against the old tip is stale
+    stale = grind_block_share(job, b"", b"\x01\x00\x00\x00")
+    out2 = await chain.submit_block(stale.header)
+    assert not out2.accepted and out2.reason == "stale-prevblk"
+
+
+# -- E2E solo: template -> job -> share -> block -> settled exactly once ------
+
+@pytest.mark.asyncio
+async def test_e2e_solo_pool_without_upstream_settles_exactly_once():
+    db = Database()
+    chain = MockChainClient()
+    pool = make_pool(db, chain)
+    source = TemplateSource(chain, pool=pool, poll_seconds=0.01)
+    jobs: list[tuple[Job, bool]] = []
+    source.add_sink(lambda job, clean: jobs.append((job, clean)))
+
+    job = await source.poll_once()
+    assert job is not None and jobs[0][0] is job
+
+    en1 = bytes.fromhex("000000a1")
+    share = grind_block_share(job, en1, b"\x00" * 4)
+    await pool.on_share(share)
+    await pool.on_block(share.header, job, share)
+    assert len(chain.submitted) == 1
+    rows = pool.blocks.list()
+    assert len(rows) == 1 and rows[0]["chain"] == "parent"
+    assert rows[0]["reward"] == chain.reward
+
+    # the found block moved the tip: the next poll emits a clean job
+    job2 = await source.poll_once()
+    assert job2 is not None and job2.clean
+
+    await confirm_all(pool)
+    share_chain = make_chain(DEPTH + 32)
+    eng = make_settlement(db, share_chain)
+    assert await eng.settle_once() == {"resumed": 0, "settled": 1}
+    horizon = share_chain.settled_height()
+    got = {b["worker"]: b["balance"] + b["paid_total"] for b in eng.balances()}
+    assert got == expected_split(share_chain, horizon, chain.reward)
+    # exactly-once: a second tick moves nothing
+    assert await eng.settle_once() == {"resumed": 0, "settled": 0}
+
+
+# -- merged mining: one nonce search, parent + K aux chains -------------------
+
+@pytest.mark.asyncio
+async def test_merged_mining_one_nonce_settles_parent_plus_k3():
+    db = Database()
+    chain = MockChainClient()
+    pool = make_pool(db, chain)
+    names = ["aux-a", "aux-b", "aux-c"]
+    clients = {n: MockAuxChainClient(n) for n in names}
+    aux = AuxWorkManager(clients, blocks=pool.blocks,
+                         confirmations_required=6)
+    source = TemplateSource(chain, pool=pool, aux=aux, poll_seconds=0.01)
+    pool.work_source = source
+
+    job = await source.poll_once()
+    assert job is not None
+    ctx = source.job_context(job.job_id)
+    assert ctx.slate is not None and len(ctx.slate.works) == 3
+
+    en1 = bytes.fromhex("000000b2")
+    share = grind_block_share(job, en1, b"\x00" * 4, worker="bob.w1")
+    # the coinbase this share hashed carries the slate's commitment once
+    coinbase = job.coinb1 + en1 + share.extranonce2 + job.coinb2
+    assert find_commitment(coinbase) == (ctx.slate.root, 3)
+
+    # ONE accepted share: the pool books it, then offers it to the slates
+    await pool.on_share(share)
+    await pool.on_block(share.header, job, share)
+    # every mock aux chain VERIFIED the full AuxPoW spine and accepted
+    for n in names:
+        assert len(clients[n].submitted) == 1, n
+    snap = aux.snapshot()
+    assert snap["found"] == 3 and snap["accepted"] == 3
+    assert snap["rejected"] == 0
+    rows = pool.blocks.list()
+    assert sorted(r["chain"] for r in rows) == ["aux-a", "aux-b", "aux-c",
+                                                "parent"]
+
+    await confirm_all(pool, aux)
+    share_chain = make_chain(DEPTH + 32)
+    eng = make_settlement(db, share_chain)
+    assert await eng.settle_once() == {"resumed": 0, "settled": 1}
+
+    # total pot = parent + 3 aux rewards, split over the PPLNS window
+    total = chain.reward + sum(clients[n].reward for n in names)
+    horizon = share_chain.settled_height()
+    exp = expected_split(share_chain, horizon, total)
+    got = {b["worker"]: b["balance"] + b["paid_total"] for b in eng.balances()}
+    assert got == exp
+
+    # per-chain payout splits: audited against an independent recompute
+    skey = eng.settlements.latest()["skey"]
+    audit = eng.chain_split(skey)
+    expected_rewards = {"parent": chain.reward,
+                        **{n: clients[n].reward for n in names}}
+    assert audit["chain_rewards"] == expected_rewards
+    assert audit["split"] == split_credits_by_chain(exp, expected_rewards)
+    for worker, per_chain in audit["split"].items():
+        assert sum(per_chain.values()) == exp[worker], worker
+
+
+@pytest.mark.asyncio
+async def test_merged_mining_exact_under_simultaneous_parent_and_aux_reorg():
+    db = Database()
+    chain = MockChainClient()
+    pool = make_pool(db, chain)
+    names = ["aux-a", "aux-b", "aux-c"]
+    clients = {n: MockAuxChainClient(n) for n in names}
+    aux = AuxWorkManager(clients, blocks=pool.blocks,
+                         confirmations_required=6)
+    source = TemplateSource(chain, pool=pool, aux=aux, poll_seconds=0.01)
+    pool.work_source = source
+
+    async def mine_round(en1: bytes, worker: str) -> None:
+        job = await source.poll_once()
+        assert job is not None
+        share = grind_block_share(job, en1, b"\x00" * 4, worker=worker)
+        await pool.on_share(share)
+        await pool.on_block(share.header, job, share)
+
+    await mine_round(bytes.fromhex("000000c1"), "ann.w1")
+    # SIMULTANEOUS reorg: the parent block AND aux-a's block orphan in the
+    # same instant; aux-b/aux-c keep theirs (independent chains)
+    chain.reorg(1)
+    clients["aux-a"].reorg(1)
+    await mine_round(bytes.fromhex("000000c2"), "cat.w1")
+    await confirm_all(pool, aux)
+
+    by = {}
+    for r in pool.blocks.list():
+        by.setdefault(r["chain"], []).append(r["status"])
+    assert sorted(by["parent"]) == ["confirmed", "orphaned"]
+    assert sorted(by["aux-a"]) == ["confirmed", "orphaned"]
+    assert by["aux-b"] == ["confirmed", "confirmed"]
+    assert by["aux-c"] == ["confirmed", "confirmed"]
+
+    share_chain = make_chain(DEPTH + 32)
+    eng = make_settlement(db, share_chain)
+    assert await eng.settle_once() == {"resumed": 0, "settled": 1}
+
+    # only SURVIVING rewards settle: 1x parent, 1x aux-a, 2x aux-b, 2x aux-c
+    expected_rewards = {
+        "parent": chain.reward, "aux-a": clients["aux-a"].reward,
+        "aux-b": 2 * clients["aux-b"].reward,
+        "aux-c": 2 * clients["aux-c"].reward,
+    }
+    total = sum(expected_rewards.values())
+    horizon = share_chain.settled_height()
+    exp = expected_split(share_chain, horizon, total)
+    got = {b["worker"]: b["balance"] + b["paid_total"] for b in eng.balances()}
+    assert got == exp
+    skey = eng.settlements.latest()["skey"]
+    audit = eng.chain_split(skey)
+    assert audit["chain_rewards"] == expected_rewards
+    assert audit["split"] == split_credits_by_chain(exp, expected_rewards)
+    for worker, per_chain in audit["split"].items():
+        assert sum(per_chain.values()) == exp[worker], worker
+    # the orphaned rows never settle
+    assert await eng.settle_once() == {"resumed": 0, "settled": 0}
+
+
+# -- seeded chain.rpc chaos ---------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_chain_rpc_chaos_degrades_loudly_and_recovers():
+    chain = MockChainClient(reject_stale=True)
+    source = TemplateSource(chain, poll_seconds=0.01, extranonce1_len=0)
+    emitted: list[Job] = []
+    source.add_sink(lambda job, clean: emitted.append(job))
+
+    job1 = await source.poll_once()
+    assert job1 is not None
+    # a found block advances the tip mid-chaos
+    block = grind_block_share(job1, b"", b"\x00" * 4)
+
+    inj = (faults.FaultInjector(2026)
+           .error("chain.rpc:template", max_fires=3)
+           .corrupt("chain.rpc:template", max_fires=2)
+           .delay("chain.rpc:confirmations", seconds=0.01, max_fires=1))
+    with faults.active(inj):
+        # outage: 3 polls fail at the RPC layer; the job stream serves on
+        for _ in range(3):
+            assert await source.poll_once() is None
+        assert source.stats["rpc_failures"] == 3
+        # corrupt: 2 impossible templates MUST be rejected, not served
+        for _ in range(2):
+            assert await source.poll_once() is None
+        assert source.stats["templates_rejected"] == 2
+        assert source.get_job(job1.job_id) is job1, "last good job wedged"
+        # the chain accepts real work and the confirmation path (delayed
+        # once by the injector) still answers
+        out = await chain.submit_block(block.header)
+        assert out.accepted
+        assert await chain.get_confirmations(out.block_hash) >= 1
+        # stale submit: work minted against the pre-block tip is refused
+        stale = grind_block_share(job1, b"", b"\x01\x00\x00\x00")
+        out2 = await chain.submit_block(stale.header)
+        assert not out2.accepted and out2.reason == "stale-prevblk"
+    # recovery: the injector is gone, the next poll emits a FRESH clean
+    # job at the advanced height
+    job2 = await source.poll_once()
+    assert job2 is not None and job2.clean
+    assert job2.block_number == job1.block_number + 1
+    assert emitted[-1] is job2
+    # the seeded schedule really fired every staged device
+    fired = {r.action: r.fires for r in inj.rules}
+    assert fired == {"error": 3, "corrupt": 2, "delay": 1}
+
+
+@pytest.mark.asyncio
+async def test_aux_work_outage_never_stalls_parent_stream():
+    chain = MockChainClient()
+    clients = {"aux-a": MockAuxChainClient("aux-a")}
+    aux = AuxWorkManager(clients, confirmations_required=6)
+    source = TemplateSource(chain, aux=aux, poll_seconds=0.01,
+                            extranonce1_len=0)
+    job1 = await source.poll_once()
+    assert job1 is not None
+
+    # aux refresh shares the chain.rpc point and runs BEFORE the parent
+    # fetch, so a single staged error lands on the aux node's poll: a
+    # dead aux node must count a refresh failure, keep the last good
+    # unit, and leave the parent stream alone
+    inj = faults.FaultInjector(7).error("chain.rpc:template", max_fires=1)
+    with faults.active(inj):
+        # first template hit in this window is the AUX poll (refresh runs
+        # before the parent fetch) — it eats the single staged error
+        chain.bump_template()
+        job2 = await source.poll_once()
+    assert job2 is not None, "parent stream must survive the aux outage"
+    assert aux.stats["refresh_failures"] == 1
+    assert aux.slate() is not None  # last good aux work still slated
+
+
+# -- share bus carries the extranonce1 the proofs need ------------------------
+
+def test_share_frame_roundtrips_extranonce1():
+    from otedama_tpu.stratum.shard import (
+        decode_share_frame,
+        encode_share_frame,
+        share_from_wire,
+        share_to_wire,
+    )
+
+    s = AcceptedShare(
+        session_id=7, worker_user="ann.w1", job_id="tmpl-3",
+        difficulty=0.5, actual_difficulty=0.75, digest=b"\x01" * 32,
+        header=b"\x02" * 80, extranonce2=b"\x03" * 4, ntime=1_700_000_000,
+        nonce_word=42, is_block=True, submitted_at=123.5,
+        algorithm="sha256d", block_number=101,
+        extranonce1=bytes.fromhex("0000beef"),
+    )
+    # the bus reader strips the 4-byte length prefix before decoding
+    seq, back = decode_share_frame(encode_share_frame(9, s)[4:])
+    assert seq == 9
+    assert back.extranonce1 == s.extranonce1
+    assert back.job_id == s.job_id and back.header == s.header
+    wire = share_from_wire(share_to_wire(s))
+    assert wire.extranonce1 == s.extranonce1

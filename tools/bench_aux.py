@@ -1,0 +1,347 @@
+"""Merged-mining bench: aux block latency + settlement exactness.
+
+Measures the two numbers the work-source tier (otedama_tpu/work) is
+accountable for, and emits a ``BENCH_AUX_*.json`` artifact:
+
+1. **aux_share_to_accepted_seconds_{mean,p95,max}** — the full
+   production path from ONE accepted parent share to every aux chain
+   accepting its AuxPoW proof: books commit, slate lookup, per-chain
+   target check, proof assembly (coinbase + both merkle branches),
+   and the mock node's FULL spine verification (commitment scan, both
+   folds, parent PoW). This bounds how much latency merged mining adds
+   to the share path — the parent verdict is already delivered, so
+   this is pipeline depth, not share-response time.
+2. **settlement exactness under simultaneous parent+aux reorgs** — a
+   seeded run mines blocks on the parent and K=3 aux chains while
+   randomly orphaning parent and aux tips IN THE SAME ROUND, then the
+   settled ledger is audited against an independent recompute: the
+   surviving-block set is read from the mock chains themselves (not
+   the ledger), the total pot from an independent PPLNS split, and the
+   per-chain payout split against ``split_credits_by_chain`` over that
+   pot. ANY mismatch exits 2 — a merged-mining bench that tolerates
+   settling orphaned rewards is measuring garbage.
+
+The parent PoW is real (regtest nbits, a handful of grinds per block);
+the aux chains share the parent's target so every parent block is a
+K-way aux hit — the bench times proof assembly + verification, not
+luck.
+
+Usage:
+    python tools/bench_aux.py --out BENCH_AUX_r23.json [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import dataclasses
+import json
+import os
+import platform
+import random
+import struct
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from otedama_tpu.db.database import Database                   # noqa: E402
+from otedama_tpu.engine import jobs as jobmod                  # noqa: E402
+from otedama_tpu.engine.types import Job                       # noqa: E402
+from otedama_tpu.kernels import target as tgt                  # noqa: E402
+from otedama_tpu.p2p import sharechain as sc                   # noqa: E402
+from otedama_tpu.p2p.sharechain import ChainParams, ShareChain  # noqa: E402
+from otedama_tpu.pool.blockchain import MockChainClient        # noqa: E402
+from otedama_tpu.pool.manager import (                         # noqa: E402
+    MockWallet,
+    PoolConfig,
+    PoolManager,
+)
+from otedama_tpu.pool.payouts import (                         # noqa: E402
+    PayoutCalculator,
+    PayoutConfig,
+)
+from otedama_tpu.pool.settlement import (                      # noqa: E402
+    SettlementConfig,
+    SettlementEngine,
+    split_credits_by_chain,
+)
+from otedama_tpu.stratum.server import AcceptedShare           # noqa: E402
+from otedama_tpu.utils.sha256_host import sha256d              # noqa: E402
+from otedama_tpu.work.aux import (                             # noqa: E402
+    AuxWorkManager,
+    MockAuxChainClient,
+)
+from otedama_tpu.work.template import TemplateSource           # noqa: E402
+
+AUX_NAMES = ["aux-a", "aux-b", "aux-c"]
+TEST_D = 1e-6
+DEPTH = 8
+WINDOW = 64
+WORKERS = ["ann.w1", "bob.w1", "cat.w1", "dan.w1"]
+
+
+def percentile(values: list[float], q: float) -> float:
+    if not values:
+        return 0.0
+    s = sorted(values)
+    return s[min(len(s) - 1, int(q * len(s)))]
+
+
+def make_share_chain(n: int) -> ShareChain:
+    chain = ShareChain(ChainParams(
+        min_difficulty=TEST_D, window=WINDOW, max_reorg_depth=DEPTH,
+    ))
+    prev = sc.GENESIS
+    for i in range(n):
+        s = sc.mine_share(prev, WORKERS[i % len(WORKERS)], f"job{i}", TEST_D)
+        assert chain.connect(s) == "accepted"
+        prev = s.share_id
+    return chain
+
+
+def expected_split(chain: ShareChain, end: int, reward: int) -> dict[str, int]:
+    calc = PayoutCalculator(PayoutConfig(pplns_window=WINDOW))
+    shares = chain.chain_slice(max(0, end - WINDOW), end)
+    res = calc.calculate_block(
+        reward,
+        [{"worker": s.worker, "difficulty": s.difficulty} for s in shares],
+    )
+    return {p.worker: p.amount for p in res.payouts}
+
+
+def grind_block_share(job: Job, extranonce1: bytes, en2: bytes,
+                      worker: str) -> AcceptedShare:
+    """Mine a nonce whose header meets the job's NETWORK target and wrap
+    it as the AcceptedShare the stratum servers would deliver."""
+    full = dataclasses.replace(job, extranonce1=extranonce1)
+    prefix = jobmod.build_header_prefix(full, en2)
+    network = tgt.bits_to_target(job.nbits)
+    for nonce in range(1 << 20):
+        header = prefix + struct.pack(">I", nonce)
+        digest = sha256d(header)
+        if tgt.hash_meets_target(digest, network):
+            return AcceptedShare(
+                session_id=1, worker_user=worker, job_id=job.job_id,
+                difficulty=1e-4, actual_difficulty=1e-4, digest=digest,
+                header=header, extranonce2=en2, ntime=job.ntime,
+                nonce_word=nonce, is_block=True, submitted_at=time.time(),
+                algorithm=job.algorithm, block_number=job.block_number,
+                extranonce1=extranonce1,
+            )
+    raise AssertionError("no block-grade share found")
+
+
+def make_rig(db: Database):
+    chain = MockChainClient()
+    pool = PoolManager(db, chain, config=PoolConfig(
+        payout_interval=0.0, defer_block_distribution=True,
+    ))
+    clients = {n: MockAuxChainClient(n) for n in AUX_NAMES}
+    aux = AuxWorkManager(clients, blocks=pool.blocks,
+                         confirmations_required=6)
+    source = TemplateSource(chain, pool=pool, aux=aux, poll_seconds=3600.0)
+    pool.work_source = source
+    return chain, pool, clients, aux, source
+
+
+async def confirm_all(pool: PoolManager, aux: AuxWorkManager,
+                      polls: int = 8) -> None:
+    for _ in range(polls):
+        await pool.submitter.check_pending()
+        await aux.check_pending()
+
+
+# -- 1. aux block latency ------------------------------------------------------
+
+async def bench_latency(rounds: int) -> dict:
+    """Time the accepted-share -> K aux chains accepted path per round.
+
+    ``pool.on_share`` is the production entry: it books the share, then
+    offers it to the slates; every round's share is a parent block AND
+    a 3-way aux hit (shared target), so each sample covers 3 proof
+    assemblies + 3 full mock-node verifications."""
+    db = Database()
+    chain, pool, clients, aux, source = make_rig(db)
+    share_lat: list[float] = []
+    submit_lat: list[float] = []
+    for r in range(rounds):
+        job = await source.poll_once()
+        assert job is not None, f"round {r}: template did not emit"
+        share = grind_block_share(job, struct.pack(">I", r), b"\x00" * 4,
+                                  WORKERS[r % len(WORKERS)])
+        before = aux.stats["accepted"]
+        t0 = time.perf_counter()
+        await pool.on_share(share)
+        share_lat.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        await pool.on_block(share.header, job, share)
+        submit_lat.append(time.perf_counter() - t0)
+        assert aux.stats["accepted"] == before + len(AUX_NAMES), (
+            f"round {r}: aux accepts {aux.stats['accepted']}")
+    snap = aux.snapshot()
+    return {
+        "latency_rounds": rounds,
+        "aux_blocks_accepted": snap["accepted"],
+        "aux_blocks_rejected": snap["rejected"],
+        "aux_share_to_accepted_seconds_mean": round(
+            sum(share_lat) / len(share_lat), 6),
+        "aux_share_to_accepted_seconds_p95": round(
+            percentile(share_lat, 0.95), 6),
+        "aux_share_to_accepted_seconds_max": round(max(share_lat), 6),
+        "parent_submit_seconds_mean": round(
+            sum(submit_lat) / len(submit_lat), 6),
+    }
+
+
+# -- 2. settlement exactness under simultaneous reorgs -------------------------
+
+async def bench_exactness(rounds: int, seed: int) -> dict:
+    """Seeded mining with parent+aux reorgs landing in the same round,
+    then the independent audit (mock chains are the ground truth)."""
+    rng = random.Random(seed)
+    db = Database()
+    chain, pool, clients, aux, source = make_rig(db)
+    mined = {"parent": 0, **{n: 0 for n in AUX_NAMES}}
+    reorgs = 0
+    for r in range(rounds):
+        job = await source.poll_once()
+        assert job is not None, f"round {r}: template did not emit"
+        share = grind_block_share(job, struct.pack(">I", 0x1000 + r),
+                                  b"\x00" * 4, rng.choice(WORKERS))
+        await pool.on_share(share)
+        await pool.on_block(share.header, job, share)
+        mined["parent"] += 1
+        for n in AUX_NAMES:
+            mined[n] += 1
+        # simultaneous reorg: parent and a random aux subset orphan
+        # their freshly-mined tip in the same instant
+        if rng.random() < 0.4:
+            chain.reorg(1)
+            for n in AUX_NAMES:
+                if rng.random() < 0.5:
+                    clients[n].reorg(1)
+            reorgs += 1
+    await confirm_all(pool, aux)
+
+    share_chain = make_share_chain(DEPTH + 32)
+    eng = SettlementEngine(
+        db, share_chain, MockWallet(),
+        payout=PayoutConfig(pplns_window=WINDOW, minimum_payout=1_000,
+                            payout_fee=10),
+        config=SettlementConfig(interval=3600.0, drain_timeout=2.0),
+    )
+    out = await eng.settle_once()
+
+    # independent audit ----------------------------------------------------
+    # ground truth: what the mock chains still carry AFTER the reorgs,
+    # read from the chains themselves, never from the ledger under test
+    failures: list[str] = []
+    surviving = {"parent": len(chain.submitted),
+                 **{n: len(clients[n].submitted) for n in AUX_NAMES}}
+    expected_rewards = {"parent": surviving["parent"] * chain.reward,
+                        **{n: surviving[n] * clients[n].reward
+                           for n in AUX_NAMES}}
+    by_status: dict[str, dict[str, int]] = {}
+    for row in pool.blocks.list():
+        d = by_status.setdefault(row["chain"], {})
+        d[row["status"]] = d.get(row["status"], 0) + 1
+    for name, n_alive in surviving.items():
+        got_c = by_status.get(name, {}).get("confirmed", 0)
+        got_o = by_status.get(name, {}).get("orphaned", 0)
+        if got_c != n_alive:
+            failures.append(
+                f"{name}: {got_c} confirmed rows, chain carries {n_alive}")
+        if got_o != mined[name] - n_alive:
+            failures.append(
+                f"{name}: {got_o} orphaned rows, "
+                f"expected {mined[name] - n_alive}")
+
+    if out != {"resumed": 0, "settled": 1}:
+        failures.append(f"settle_once returned {out}")
+    total = sum(expected_rewards.values())
+    horizon = share_chain.settled_height()
+    exp = expected_split(share_chain, horizon, total)
+    got = {b["worker"]: b["balance"] + b["paid_total"]
+           for b in eng.balances()}
+    if got != exp:
+        failures.append(f"settled balances {got} != PPLNS recompute {exp}")
+
+    skey = eng.settlements.latest()["skey"]
+    audit = eng.chain_split(skey)
+    if audit["chain_rewards"] != expected_rewards:
+        failures.append(
+            f"chain rewards {audit['chain_rewards']} != surviving "
+            f"{expected_rewards}")
+    if audit["split"] != split_credits_by_chain(exp, expected_rewards):
+        failures.append("per-chain split != independent recompute")
+    for worker, per_chain in audit["split"].items():
+        if sum(per_chain.values()) != exp.get(worker, -1):
+            failures.append(f"{worker}: per-chain rows do not sum to credit")
+
+    # exactly-once: a second tick must move nothing
+    again = await eng.settle_once()
+    if again != {"resumed": 0, "settled": 0}:
+        failures.append(f"second settle moved {again}")
+    if reorgs == 0:
+        failures.append("seeded run never reorged — audit untested")
+
+    return {
+        "exactness_rounds": rounds,
+        "exactness_seed": seed,
+        "exactness_reorgs": reorgs,
+        "blocks_mined": mined,
+        "blocks_surviving": surviving,
+        "chain_rewards_settled": expected_rewards,
+        "settled_total": total,
+        "audit_failures": failures,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="BENCH_AUX_manual.json")
+    ap.add_argument("--seed", type=int, default=20)
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+
+    lat_rounds, chaos_rounds = (6, 8) if args.quick else (20, 24)
+
+    latency = asyncio.run(bench_latency(lat_rounds))
+    exact = asyncio.run(bench_exactness(chaos_rounds, args.seed))
+
+    failures = list(exact.pop("audit_failures"))
+    if latency["aux_blocks_accepted"] != lat_rounds * len(AUX_NAMES):
+        failures.append("latency leg dropped aux blocks")
+    if latency["aux_blocks_rejected"] != 0:
+        failures.append("latency leg had aux rejections")
+
+    out = {
+        "bench": "aux",
+        "created": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "platform": {
+            "python": platform.python_version(),
+            "machine": platform.machine(),
+        },
+        "config": {
+            "aux_chains": len(AUX_NAMES),
+            "pplns_window": WINDOW,
+            "max_reorg_depth": DEPTH,
+            "quick": args.quick,
+        },
+        **latency,
+        **exact,
+        "failures": failures,
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print(json.dumps(out, indent=2))
+    if failures:
+        print("BENCH FAILED:", "; ".join(failures), file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
